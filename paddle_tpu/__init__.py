@@ -1,0 +1,112 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capability set, built on JAX/XLA/Pallas rather than ported from CUDA.
+
+Public surface mirrors `paddle.*` (reference: python/paddle/__init__.py)
+so reference users can switch by changing the import.
+"""
+from __future__ import annotations
+
+import os as _os
+
+# Make multi-device CPU testing work out of the box when no accelerator is
+# configured and the user asked for a virtual mesh.
+if _os.environ.get("PADDLE_TPU_FORCE_CPU_DEVICES"):
+    _n = _os.environ["PADDLE_TPU_FORCE_CPU_DEVICES"]
+    flags = _os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        _os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={_n}").strip()
+    import jax as _jx
+    _jx.config.update("jax_platforms", "cpu")
+
+import jax as _jax  # noqa: E402
+
+# Paddle defaults integer tensors to int64 and supports float64; enable
+# x64 so those dtypes are real. Default float stays float32 (weak-typed
+# python scalars do not promote f32 arrays), and the TPU hot path is
+# explicitly bf16/f32 throughout.
+_jax.config.update("jax_enable_x64", True)
+
+# Paddle's float32 matmul is true float32; this XLA build defaults f32 dots
+# to reduced (bf16-pass) precision. Default to full precision — bf16/fp16
+# compute (the TPU fast path) is unaffected by this setting. Opt back into
+# fast f32 via set_matmul_precision("default") (e.g. benchmarks).
+_jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def set_matmul_precision(level: str):
+    """'highest' (true f32), 'high' (bf16x3), or 'default' (fastest)."""
+    _jax.config.update("jax_default_matmul_precision", level)
+    from .core.dispatch import clear_caches as _cc
+    _cc()
+
+
+from .version import __version__  # noqa: E402
+
+from .core.dtype import (  # noqa: E402,F401
+    dtype, float16, bfloat16, float32, float64, int8, int16, int32, int64,
+    uint8, uint16, uint32, uint64, bool_, complex64, complex128,
+    float8_e4m3fn, float8_e5m2, set_default_dtype, get_default_dtype)
+from .core.device import (  # noqa: E402,F401
+    CPUPlace, TPUPlace, XLAPlace, CUDAPlace, CUDAPinnedPlace, set_device,
+    get_device, device_count, is_compiled_with_cuda, is_compiled_with_rocm,
+    is_compiled_with_xpu, is_compiled_with_npu, is_compiled_with_mlu,
+    is_compiled_with_ipu, is_compiled_with_cinn, is_compiled_with_distribute)
+from .core.tensor import (  # noqa: E402,F401
+    Tensor, to_tensor, no_grad, enable_grad, is_grad_enabled,
+    set_grad_enabled, grad)
+from .core.random import seed, get_rng_state, set_rng_state  # noqa: E402,F401
+from .core import random as _random_mod  # noqa: E402
+
+from .ops import *  # noqa: E402,F401,F403
+from .ops import creation as _creation  # noqa: E402
+
+# modules (populated progressively)
+from . import ops  # noqa: E402,F401
+from .ops import linalg  # noqa: E402,F401
+
+bool = bool_  # paddle.bool
+
+
+def save(obj, path, protocol=4, **configs):
+    from .framework.io import save as _save
+    return _save(obj, path, protocol=protocol, **configs)
+
+
+def load(path, **configs):
+    from .framework.io import load as _load
+    return _load(path, **configs)
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+def in_dynamic_mode():
+    from .jit.api import in_to_static_trace
+    return not in_to_static_trace()
+
+
+def in_dygraph_mode():
+    return in_dynamic_mode()
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu has no ProgramDesc static mode; use paddle_tpu.jit."
+        "to_static, which compiles whole programs through XLA (the TPU-"
+        "native equivalent of the reference's static graph executor).")
+
+
+def get_flags(flags):
+    from .utils import flags as _flags
+    return _flags.get_flags(flags)
+
+
+def set_flags(flags):
+    from .utils import flags as _flags
+    return _flags.set_flags(flags)

@@ -1,0 +1,10 @@
+"""Core runtime: dtypes, devices, Tensor, dispatch, RNG."""
+from . import dtype as dtype_mod
+from .dtype import *  # noqa: F401,F403
+from .device import *  # noqa: F401,F403
+from .tensor import (Tensor, Parameter, to_tensor, no_grad, enable_grad,
+                     is_grad_enabled, set_grad_enabled, apply_op,
+                     run_backward, grad)
+from .dispatch import register_op, clear_caches
+from .random import (Generator, default_generator, seed, get_rng_state,
+                     set_rng_state, next_key)

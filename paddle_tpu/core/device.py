@@ -1,0 +1,181 @@
+"""Device / Place abstraction.
+
+TPU-native replacement for ``phi::Place`` / ``platform::DeviceContextPool``
+(reference: paddle/fluid/platform/device_context.h:351,
+paddle/phi/common/place.h). Devices are JAX devices; there are no streams to
+manage — XLA/PJRT executes asynchronously and dependencies are tracked by
+the runtime, so Paddle's stream/event machinery collapses away.
+
+Place strings accepted: "cpu", "tpu", "tpu:0", "gpu"/"gpu:0" (alias of the
+accelerator if present), "xla:0".
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = [
+    "Place", "CPUPlace", "TPUPlace", "XLAPlace", "CUDAPlace", "CUDAPinnedPlace",
+    "set_device", "get_device", "get_all_devices", "device_count",
+    "is_compiled_with_cuda", "is_compiled_with_rocm", "is_compiled_with_xpu",
+    "is_compiled_with_npu", "is_compiled_with_mlu", "is_compiled_with_ipu",
+    "is_compiled_with_cinn", "is_compiled_with_distribute", "jax_device",
+]
+
+
+class Place:
+    """A device identified by (kind, index). Maps onto one jax.Device."""
+
+    __slots__ = ("kind", "index")
+
+    def __init__(self, kind: str, index: int = 0):
+        self.kind = kind
+        self.index = index
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.index})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Place)
+                and self.kind == other.kind and self.index == other.index)
+
+    def __hash__(self):
+        return hash((self.kind, self.index))
+
+    def is_cpu_place(self):
+        return self.kind == "cpu"
+
+    def is_tpu_place(self):
+        return self.kind in ("tpu", "xla")
+
+    # Paddle compat aliases
+    def is_gpu_place(self):
+        return self.kind in ("tpu", "xla", "gpu")
+
+    def get_device_id(self):
+        return self.index
+
+    def jax_device(self) -> jax.Device:
+        if self.kind == "cpu":
+            return jax.devices("cpu")[0]
+        accel = _accelerator_devices()
+        if not accel:
+            return jax.devices("cpu")[self.index % len(jax.devices("cpu"))]
+        return accel[self.index % len(accel)]
+
+
+def CPUPlace():
+    return Place("cpu", 0)
+
+
+def TPUPlace(index: int = 0):
+    return Place("tpu", index)
+
+
+def XLAPlace(index: int = 0):
+    return Place("xla", index)
+
+
+def CUDAPlace(index: int = 0):
+    # Paddle-compat alias: "gpu" means "the accelerator" here.
+    return Place("tpu", index)
+
+
+def CUDAPinnedPlace():
+    return Place("cpu", 0)
+
+
+def _accelerator_devices():
+    devs = jax.devices()
+    if devs and devs[0].platform != "cpu":
+        return devs
+    return []
+
+
+_current_place: Place | None = None
+
+
+def _default_place() -> Place:
+    return Place("tpu", 0) if _accelerator_devices() else Place("cpu", 0)
+
+
+def set_device(device) -> Place:
+    """paddle.device.set_device parity (python/paddle/device/__init__.py)."""
+    global _current_place
+    _current_place = _parse(device)
+    return _current_place
+
+
+def get_device() -> str:
+    p = _current_place or _default_place()
+    return f"{p.kind}:{p.index}"
+
+
+def current_place() -> Place:
+    return _current_place or _default_place()
+
+
+def _parse(device) -> Place:
+    if isinstance(device, Place):
+        return device
+    if isinstance(device, jax.Device):
+        kind = "cpu" if device.platform == "cpu" else "tpu"
+        return Place(kind, device.id)
+    s = str(device).lower()
+    if ":" in s:
+        kind, idx = s.split(":", 1)
+        idx = int(idx)
+    else:
+        kind, idx = s, 0
+    if kind in ("gpu", "cuda", "xla", "tpu"):
+        kind = "tpu" if _accelerator_devices() else "cpu"
+        return Place(kind, idx)
+    if kind == "cpu":
+        return Place("cpu", idx)
+    raise ValueError(f"Unknown device {device!r}")
+
+
+def jax_device(place=None) -> jax.Device:
+    if place is None:
+        return current_place().jax_device()
+    return _parse(place).jax_device()
+
+
+def get_all_devices():
+    return [f"{'cpu' if d.platform == 'cpu' else 'tpu'}:{d.id}" for d in jax.devices()]
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+# Capability probes (Paddle compat; this build is WITH_GPU=OFF by design).
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_mlu():
+    return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_distribute():
+    return True

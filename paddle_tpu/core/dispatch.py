@@ -1,0 +1,157 @@
+"""Op dispatch: pure-JAX op functions -> cached compiled executables.
+
+TPU-native replacement for Paddle's PHI kernel registry + generated C++ API
+(reference: paddle/phi/core/kernel_factory.h:268, paddle/phi/api/lib/).
+Where Paddle resolves {backend, layout, dtype} -> kernel fn pointer, here
+every op is a pure JAX function lowered through XLA; "kernel selection"
+collapses to a jit cache keyed by (op fn, static attrs), with XLA doing
+layout/fusion decisions. The eager path is: Python op -> cached
+PjRtLoadedExecutable -> async device execution.
+
+Backward is derived automatically with `jax.vjp` over the same pure
+function (recompute-style: inputs are saved, residual recompute happens
+fused inside the backward executable — the usual TPU remat trade). Ops may
+register a custom backward (`bwd`) that consumes saved outputs to avoid
+recompute (relu/softmax/exp-style), mirroring how Paddle pairs ops via
+backward.yaml (reference: paddle/phi/api/yaml/backward.yaml).
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable
+
+import jax
+
+__all__ = ["OpDef", "register_op", "get_jitted", "get_vjp", "clear_caches"]
+
+_JIT_CACHE: dict = {}
+_VJP_CACHE: dict = {}
+_LOCK = threading.Lock()
+
+
+def _freeze(obj):
+    """Make static attrs hashable for cache keys."""
+    if isinstance(obj, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in obj.items()))
+    if isinstance(obj, (list, tuple)):
+        return tuple(_freeze(x) for x in obj)
+    if isinstance(obj, set):
+        return tuple(sorted(_freeze(x) for x in obj))
+    return obj
+
+
+class OpDef:
+    """A named op: a pure-JAX forward fn plus optional custom backward.
+
+    fwd(*arrays, **attrs) -> array | tuple of arrays
+    bwd(attrs, saved_inputs, saved_outputs, cotangents) -> tuple of input
+        gradients (None allowed for non-differentiable inputs). Only called
+        if registered; otherwise autodiff falls back to jax.vjp(fwd).
+    """
+
+    __slots__ = ("name", "fwd", "bwd", "save_outputs", "nondiff")
+
+    def __init__(self, name, fwd, bwd=None, save_outputs=False, nondiff=False):
+        self.name = name
+        self.fwd = fwd
+        self.bwd = bwd
+        self.save_outputs = save_outputs or (bwd is not None)
+        self.nondiff = nondiff
+
+
+_OPS: dict[str, OpDef] = {}
+
+
+def register_op(name, fwd=None, bwd=None, save_outputs=False, nondiff=False):
+    """Register an op (usable as decorator)."""
+    def deco(f):
+        _OPS[name] = OpDef(name, f, bwd=bwd, save_outputs=save_outputs,
+                           nondiff=nondiff)
+        return f
+    if fwd is not None:
+        return deco(fwd)
+    return deco
+
+
+def get_op(name) -> OpDef:
+    return _OPS[name]
+
+
+def get_jitted(fn: Callable, attrs: dict[str, Any]):
+    """Compiled forward executable for (fn, attrs), cached."""
+    key = (fn, _freeze(attrs))
+    got = _JIT_CACHE.get(key)
+    if got is None:
+        with _LOCK:
+            got = _JIT_CACHE.get(key)
+            if got is None:
+                if attrs:
+                    got = jax.jit(functools.partial(fn, **attrs))
+                else:
+                    got = jax.jit(fn)
+                _JIT_CACHE[key] = got
+    return got
+
+
+def get_vjp(fn: Callable, attrs: dict[str, Any], diff_in: tuple[int, ...],
+            diff_out: tuple[int, ...], n_out: int):
+    """Compiled backward executable computing d(inputs)/d(outputs).
+
+    Signature of returned callable: (inputs_tuple, cotangents_tuple) ->
+    tuple of grads aligned with diff_in. cotangents are aligned with
+    diff_out (the float outputs of the forward).
+    """
+    key = (fn, _freeze(attrs), diff_in, diff_out, n_out)
+    got = _VJP_CACHE.get(key)
+    if got is None:
+        with _LOCK:
+            got = _VJP_CACHE.get(key)
+            if got is None:
+                got = jax.jit(functools.partial(
+                    _vjp_impl, fn, dict(attrs), diff_in, diff_out, n_out))
+                _VJP_CACHE[key] = got
+    return got
+
+
+def _vjp_impl(fn, attrs, diff_in, diff_out, n_out, inputs, cts):
+    """Differentiate fn wrt the float inputs, for its float outputs only."""
+    inputs = tuple(inputs)
+
+    def f_diff(*diff_args):
+        full = list(inputs)
+        for pos, a in zip(diff_in, diff_args):
+            full[pos] = a
+        out = fn(*full, **attrs)
+        if n_out == 1:
+            out = (out,)
+        return tuple(out[i] for i in diff_out)
+
+    _, vjp_fn = jax.vjp(f_diff, *(inputs[i] for i in diff_in))
+    return vjp_fn(tuple(cts))
+
+
+_BWD_CACHE: dict = {}
+
+
+def get_custom_bwd(op: OpDef, attrs: dict):
+    """Compiled custom-backward executable: (inputs, outputs, cts) -> grads."""
+    key = (op.name, _freeze(attrs))
+    got = _BWD_CACHE.get(key)
+    if got is None:
+        with _LOCK:
+            got = _BWD_CACHE.get(key)
+            if got is None:
+                a = dict(attrs)
+
+                def run(inputs, outputs, cts):
+                    return op.bwd(a, inputs, outputs, cts)
+                got = jax.jit(run)
+                _BWD_CACHE[key] = got
+    return got
+
+
+def clear_caches():
+    _JIT_CACHE.clear()
+    _VJP_CACHE.clear()
+    _BWD_CACHE.clear()

@@ -1,0 +1,147 @@
+"""Dtype system.
+
+TPU-native replacement for Paddle's ``VarType`` / ``phi::DataType``
+(reference: paddle/phi/common/data_type.h). We alias JAX/numpy dtypes and
+expose paddle-style names (``paddle.float32`` etc.). bfloat16 is first-class
+(it is the TPU MXU's native compute dtype).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+__all__ = [
+    "DType", "float16", "bfloat16", "float32", "float64",
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32", "uint64",
+    "bool_", "complex64", "complex128", "float8_e4m3fn", "float8_e5m2",
+    "convert_dtype", "to_np_dtype", "is_floating", "is_integer", "is_complex",
+    "set_default_dtype", "get_default_dtype", "promote_types",
+]
+
+
+class DType:
+    """A lightweight dtype wrapper comparable with strings and numpy dtypes."""
+
+    __slots__ = ("name", "np_dtype")
+    _registry: dict = {}
+
+    def __new__(cls, name: str, np_dtype):
+        if name in cls._registry:
+            return cls._registry[name]
+        self = object.__new__(cls)
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+        cls._registry[name] = self
+        return self
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            return self.name == other or _CANON.get(other) is self
+        try:
+            return np.dtype(other) == self.np_dtype and other is not None
+        except TypeError:
+            return NotImplemented
+
+    @property
+    def is_floating_point(self):
+        return is_floating(self)
+
+    @property
+    def itemsize(self):
+        return self.np_dtype.itemsize
+
+
+dtype = DType
+
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", ml_dtypes.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+uint8 = DType("uint8", np.uint8)
+uint16 = DType("uint16", np.uint16)
+uint32 = DType("uint32", np.uint32)
+uint64 = DType("uint64", np.uint64)
+bool_ = DType("bool", np.bool_)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+float8_e4m3fn = DType("float8_e4m3fn", ml_dtypes.float8_e4m3fn)
+float8_e5m2 = DType("float8_e5m2", ml_dtypes.float8_e5m2)
+
+_CANON = {
+    "float16": float16, "fp16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int": int32,
+    "int64": int64, "long": int64,
+    "uint8": uint8, "uint16": uint16, "uint32": uint32, "uint64": uint64,
+    "bool": bool_, "complex64": complex64, "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn, "float8_e5m2": float8_e5m2,
+}
+
+_FLOATS = {float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2}
+_INTS = {int8, int16, int32, int64, uint8, uint16, uint32, uint64}
+_COMPLEX = {complex64, complex128}
+
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype parity (python/paddle/framework/framework.py)."""
+    global _default_dtype
+    _default_dtype = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def convert_dtype(d) -> DType:
+    """Normalize str / numpy dtype / jnp dtype / DType to a DType."""
+    if d is None:
+        return _default_dtype
+    if isinstance(d, DType):
+        return d
+    if isinstance(d, str):
+        if d in _CANON:
+            return _CANON[d]
+        raise ValueError(f"Unknown dtype string: {d!r}")
+    np_d = np.dtype(d)
+    for t in DType._registry.values():
+        if t.np_dtype == np_d:
+            return t
+    raise ValueError(f"Unsupported dtype: {d!r}")
+
+
+def to_np_dtype(d):
+    return convert_dtype(d).np_dtype
+
+
+def is_floating(d) -> bool:
+    return convert_dtype(d) in _FLOATS
+
+
+def is_integer(d) -> bool:
+    return convert_dtype(d) in _INTS
+
+
+def is_complex(d) -> bool:
+    return convert_dtype(d) in _COMPLEX
+
+
+def promote_types(a, b) -> DType:
+    out = jnp.promote_types(to_np_dtype(a), to_np_dtype(b))
+    return convert_dtype(out)

@@ -1,0 +1,92 @@
+"""Stateful RNG facade over JAX's stateless threefry keys.
+
+TPU-native replacement for phi::Generator (reference:
+paddle/phi/core/generator.h:23, paddle/fluid/framework/generator.h:40).
+Paddle keeps a mutable Philox state per device; here a Generator holds a
+threefry key and splits off a fresh subkey per draw, which keeps every op
+pure (a requirement for jit/pjit tracing) while preserving the
+`paddle.seed(...)` API. TP/parallel RNG (RNGStatesTracker,
+fleet/layers/mpu/random.py:34) is layered on top via named generator states.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["Generator", "default_generator", "seed", "get_rng_state",
+           "set_rng_state", "next_key", "manual_seed"]
+
+
+class Generator:
+    """A splittable RNG stream with Paddle's stateful facade."""
+
+    def __init__(self, seed: int = 0):
+        self._seed = int(seed)
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def manual_seed(self, seed: int):
+        with self._lock:
+            self._seed = int(seed)
+            self._count = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        """A fresh threefry key; deterministic given (seed, draw index)."""
+        with self._lock:
+            i = self._count
+            self._count += 1
+        return jax.random.fold_in(jax.random.PRNGKey(self._seed), i)
+
+    def get_state(self):
+        return (self._seed, self._count)
+
+    def set_state(self, state):
+        self._seed, self._count = int(state[0]), int(state[1])
+        return self
+
+    # Paddle compat
+    @property
+    def state(self):
+        return self.get_state()
+
+
+default_generator = Generator(0)
+_named: dict[str, Generator] = {}
+
+
+def get_generator(name: str | None = None) -> Generator:
+    if name is None:
+        return default_generator
+    if name not in _named:
+        _named[name] = Generator(hash(name) & 0x7FFFFFFF)
+    return _named[name]
+
+
+def seed(s: int):
+    """paddle.seed parity (python/paddle/framework/random.py)."""
+    default_generator.manual_seed(s)
+    for g in _named.values():
+        g.manual_seed(s)
+    return default_generator
+
+
+manual_seed = seed
+
+
+def next_key():
+    return default_generator.next_key()
+
+
+def get_rng_state():
+    return [default_generator.get_state()] + [g.get_state() for g in _named.values()]
+
+
+def set_rng_state(states):
+    gens = [default_generator] + list(_named.values())
+    for g, s in zip(gens, states):
+        g.set_state(s)

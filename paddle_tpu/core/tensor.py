@@ -1,0 +1,576 @@
+"""Tensor and the define-by-run autograd tape.
+
+TPU-native replacement for Paddle's eager Tensor + autograd
+(reference: paddle/fluid/eager/grad_node_info.h:168 GradNodeBase,
+paddle/fluid/eager/backward.cc:105 RunBackward,
+paddle/fluid/eager/tensor_wrapper.h TensorWrapper).
+
+Design notes vs the reference:
+- A Tensor wraps an immutable ``jax.Array`` (PJRT buffer). Because JAX
+  arrays are immutable, saved-tensor version checking (TensorWrapper's
+  inplace_version machinery) is unnecessary: in-place Python ops rebind the
+  wrapper, never mutate the buffer.
+- GradNodes hold the op's pure function + saved input arrays; backward runs
+  a cached jitted VJP (see core/dispatch.py). The ready-queue walk mirrors
+  egr::RunBackward's in-degree scheme.
+- When a Tensor holds a JAX tracer (inside jax.jit / jax.grad — the static
+  path), tape recording is skipped automatically: autodiff there is
+  jax.grad over the functionalized program, Paddle's "static backward"
+  (python/paddle/fluid/backward.py append_backward) done by XLA instead.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtypes
+from . import device as devices
+from .dispatch import OpDef, get_jitted, get_vjp, get_op, _freeze
+
+__all__ = ["Tensor", "Parameter", "to_tensor", "no_grad", "enable_grad",
+           "is_grad_enabled", "set_grad_enabled", "apply_op", "run_backward",
+           "grad"]
+
+
+class _TapeState(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+
+
+_tape = _TapeState()
+
+
+def is_grad_enabled():
+    return _tape.grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    _tape.grad_enabled = bool(mode)
+
+
+class _GradCtx:
+    def __init__(self, mode):
+        self._mode = mode
+
+    def __enter__(self):
+        self._prev = _tape.grad_enabled
+        _tape.grad_enabled = self._mode
+        return self
+
+    def __exit__(self, *exc):
+        _tape.grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with _GradCtx(self._mode):
+                return fn(*a, **kw)
+        return wrapper
+
+
+def no_grad(fn=None):
+    """paddle.no_grad parity: context manager or decorator."""
+    if fn is not None:
+        return _GradCtx(False)(fn)
+    return _GradCtx(False)
+
+
+def enable_grad(fn=None):
+    if fn is not None:
+        return _GradCtx(True)(fn)
+    return _GradCtx(True)
+
+
+def _is_tracer(v):
+    return isinstance(v, jax.core.Tracer)
+
+
+class GradNode:
+    """One recorded op on the tape; computes input grads from output cts."""
+
+    __slots__ = ("op", "attrs", "saved_inputs", "saved_outputs", "in_edges",
+                 "diff_in", "diff_out", "n_out", "out_meta", "name",
+                 "out_refs")
+
+    def __init__(self, op: OpDef, attrs, saved_inputs, saved_outputs,
+                 in_edges, diff_in, diff_out, n_out, out_meta):
+        self.op = op
+        self.attrs = attrs
+        self.saved_inputs = saved_inputs
+        self.saved_outputs = saved_outputs
+        self.in_edges = in_edges      # aligned with diff_in: (node, slot) or leaf Tensor
+        self.diff_in = diff_in        # positions of differentiable inputs
+        self.diff_out = diff_out      # positions of float outputs
+        self.n_out = n_out
+        self.out_meta = out_meta      # [(shape, np_dtype)] aligned with diff_out
+        self.name = op.name
+        self.out_refs = [None] * len(diff_out)  # weakrefs to output Tensors
+
+    def apply(self, cts):
+        """cts: list aligned with diff_out; None entries -> zeros."""
+        if self.saved_inputs is None:
+            raise RuntimeError(
+                f"Trying to backward through op '{self.name}' a second time "
+                "after its saved tensors were freed; pass retain_graph=True "
+                "to the first backward() if you need this.")
+        full_cts = tuple(
+            ct if ct is not None else jnp.zeros(shape, dt)
+            for ct, (shape, dt) in zip(cts, self.out_meta))
+        if self.op.bwd is not None:
+            from .dispatch import get_custom_bwd
+            fn = get_custom_bwd(self.op, self.attrs)
+            grads = fn(self.saved_inputs, self.saved_outputs, full_cts)
+            return [grads[i] for i in self.diff_in]
+        fn = get_vjp(self.op.fwd, self.attrs, self.diff_in, self.diff_out,
+                     self.n_out)
+        return list(fn(self.saved_inputs, full_cts))
+
+    def release(self):
+        self.saved_inputs = None
+        self.saved_outputs = None
+
+
+class Tensor:
+    """An eager tensor over a jax.Array (or a JAX tracer under jit)."""
+
+    __slots__ = ("_value", "stop_gradient", "grad", "_grad_node", "_out_slot",
+                 "name", "persistable", "is_leaf_", "_retain_grad", "_hooks",
+                 "__weakref__")
+
+    _iid = [0]
+
+    def __init__(self, value, stop_gradient=True, name=None):
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._grad_node = None
+        self._out_slot = 0
+        self.persistable = False
+        self._retain_grad = False
+        self._hooks = None
+        if name is None:
+            Tensor._iid[0] += 1
+            name = f"generated_tensor_{Tensor._iid[0]}"
+        self.name = name
+
+    # -- basic metadata ----------------------------------------------------
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return dtypes.convert_dtype(np.dtype(self._value.dtype))
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        v = self._value
+        if _is_tracer(v):
+            return devices.current_place()
+        dev = next(iter(v.devices())) if hasattr(v, "devices") else None
+        if dev is None or dev.platform == "cpu":
+            return devices.CPUPlace()
+        return devices.Place("tpu", dev.id)
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    def rank(self):
+        return self.ndim
+
+    def element_size(self):
+        return self.dtype.itemsize
+
+    # -- conversion --------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *args):
+        a = np.asarray(self._value)
+        return a.item(*args)
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __float__(self):
+        return float(np.asarray(self._value))
+
+    def __int__(self):
+        return int(np.asarray(self._value))
+
+    def __bool__(self):
+        return bool(np.asarray(self._value))
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        grad_part = "" if self.stop_gradient else ", stop_gradient=False"
+        try:
+            data = np.asarray(self._value)
+            return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                    f"{grad_part},\n       {data})")
+        except Exception:
+            return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                    f"{grad_part}, traced)")
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def retain_grads(self):
+        self._retain_grad = True
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._value))
+        else:
+            self.grad = None
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def detach_(self):
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        from .. import ops
+        return ops.assign(self)
+
+    def register_hook(self, hook):
+        """Grad hook: called with the grad Tensor, may return a new one."""
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+
+        class _Handle:
+            def __init__(self, hooks, h):
+                self._hooks, self._h = hooks, h
+
+            def remove(self):
+                if self._h in self._hooks:
+                    self._hooks.remove(self._h)
+        return _Handle(self._hooks, hook)
+
+    # -- mutation (functional under the hood) ------------------------------
+    def _rebind(self, new_value):
+        """In-place ops rebind; the old buffer stays valid for the tape."""
+        self._value = new_value
+        return self
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        value = jnp.asarray(value, dtype=self._value.dtype)
+        if tuple(value.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._value.shape}")
+        self._value = value
+        return self
+
+    def copy_(self, other, *a):
+        return self.set_value(other)
+
+    # pytree-friendly
+    def __jax_array__(self):
+        return self._value
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: python/paddle/fluid/framework.py Parameter)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, value, name=None, trainable=True):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor parity (python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor):
+        v = data._value
+        if dtype is not None:
+            v = v.astype(dtypes.to_np_dtype(dtype))
+        t = Tensor(v, stop_gradient=stop_gradient)
+        return t
+    if dtype is not None:
+        np_dt = dtypes.to_np_dtype(dtype)
+    elif isinstance(data, (bool, np.bool_)):
+        np_dt = np.bool_
+    elif isinstance(data, (int, np.integer)):
+        np_dt = np.int64
+    elif isinstance(data, float):
+        np_dt = dtypes.get_default_dtype().np_dtype
+    elif isinstance(data, complex):
+        np_dt = np.complex64
+    else:
+        arr = np.asarray(data)
+        if arr.dtype == np.float64 and dtype is None:
+            # numpy floats default to paddle default dtype, like paddle
+            np_dt = dtypes.get_default_dtype().np_dtype
+        else:
+            np_dt = arr.dtype
+    if _is_tracer(data):
+        v = data
+    else:
+        arr = np.asarray(data, dtype=np_dt)
+        dev = devices.jax_device(place)
+        v = jax.device_put(arr, dev)
+    return Tensor(v, stop_gradient=stop_gradient)
+
+
+# ---------------------------------------------------------------------------
+# Op application: the eager hot path.
+# ---------------------------------------------------------------------------
+
+def apply_op(op_name: str, *tensors, attrs: Optional[dict] = None,
+             n_out_hint: int = None):
+    """Run a registered op on Tensors, recording the tape when needed.
+
+    Mirrors the generated `*_ad_func` flow of the reference
+    (paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:192):
+    forward executable -> wrap outputs -> create GradNode if required.
+    """
+    op = get_op(op_name)
+    attrs = attrs or {}
+    vals = tuple(t._value for t in tensors)
+    fn = get_jitted(op.fwd, attrs)
+    out = fn(*vals)
+    single = not isinstance(out, (tuple, list))
+    outs = (out,) if single else tuple(out)
+
+    traced = any(_is_tracer(v) for v in vals) or any(_is_tracer(v) for v in outs)
+    need_grad = (_tape.grad_enabled and not traced and not op.nondiff
+                 and any(not t.stop_gradient for t in tensors))
+
+    out_tensors = tuple(Tensor(o, stop_gradient=not need_grad) for o in outs)
+
+    if need_grad:
+        diff_in = tuple(i for i, t in enumerate(tensors)
+                        if not t.stop_gradient
+                        and dtypes.is_floating(np.dtype(t._value.dtype)))
+        diff_out = tuple(i for i, o in enumerate(outs)
+                         if np.issubdtype(np.dtype(o.dtype), np.floating)
+                         or np.issubdtype(np.dtype(o.dtype), np.complexfloating))
+        if diff_in and diff_out:
+            in_edges = []
+            for i in diff_in:
+                t = tensors[i]
+                if t._grad_node is not None:
+                    in_edges.append((t._grad_node, t._out_slot, t))
+                else:
+                    in_edges.append((None, 0, t))
+            out_meta = [(outs[i].shape, np.dtype(outs[i].dtype))
+                        for i in diff_out]
+            node = GradNode(
+                op, attrs, vals,
+                outs if op.save_outputs else None,
+                in_edges, diff_in, diff_out, len(outs), out_meta)
+            import weakref
+            for slot, i in enumerate(diff_out):
+                out_tensors[i]._grad_node = node
+                out_tensors[i]._out_slot = slot
+                node.out_refs[slot] = weakref.ref(out_tensors[i])
+        else:
+            for t in out_tensors:
+                t.stop_gradient = True
+
+    return out_tensors[0] if single else out_tensors
+
+
+# ---------------------------------------------------------------------------
+# Backward engine (reference: paddle/fluid/eager/backward.cc:105 RunBackward)
+# ---------------------------------------------------------------------------
+
+def _accumulate(store: dict, node, slot, g):
+    cur = store.setdefault(id(node), {})
+    if slot in cur:
+        cur[slot] = cur[slot] + g
+    else:
+        cur[slot] = g
+
+
+def run_backward(tensors: Sequence[Tensor], grad_tensors=None,
+                 retain_graph=False, accumulate_into_leaves=True,
+                 inputs=None, no_grad_vars=None):
+    """Queue-based tape walk with per-node in-degrees.
+
+    If `inputs` is given, returns grads for exactly those tensors (paddle.grad
+    semantics) instead of accumulating into leaf ``.grad``.
+    """
+    grad_tensors = grad_tensors or [None] * len(tensors)
+    node_cts: dict[int, dict[int, Any]] = {}   # id(node) -> {slot: ct}
+    roots = []
+    collected: dict[int, Any] = {}             # id(tensor) -> grad array
+    wanted = {id(t): t for t in (inputs or [])}
+    blocked = {id(t) for t in (no_grad_vars or [])}
+
+    def deposit(t, g, as_leaf):
+        """Deliver a gradient to a tensor: hooks, .grad, collection.
+
+        `as_leaf` is decided by the tape edge (captured when the op ran),
+        not by the tensor's current state — an in-place rebind after use
+        must not stop a leaf from receiving its gradient.
+        """
+        if t is None or id(t) in blocked:
+            return
+        if t._hooks:
+            gt = Tensor(g)
+            for h in t._hooks:
+                r = h(gt)
+                if r is not None:
+                    gt = r
+            g = gt._value
+        if id(t) in wanted:
+            collected[id(t)] = (collected[id(t)] + g) if id(t) in collected else g
+        if accumulate_into_leaves and (as_leaf or t._retain_grad):
+            if t.grad is None:
+                t.grad = Tensor(g)
+            else:
+                t.grad = Tensor(t.grad._value + g)
+
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._grad_node is None:
+            raise RuntimeError(
+                f"Tensor {t.name} has stop_gradient=True; cannot backward.")
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}")
+            gv = jnp.ones_like(t._value)
+        else:
+            gv = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        if t._grad_node is None:
+            deposit(t, gv, as_leaf=True)
+            continue
+        _accumulate(node_cts, t._grad_node, t._out_slot, gv)
+        roots.append(t._grad_node)
+
+    # In-degree over reachable nodes (edges: consumer -> producer), mirroring
+    # the in-degree map of egr::RunBackward.
+    indeg: dict[int, int] = {}
+    nodes: dict[int, GradNode] = {}
+    stack = list({id(n): n for n in roots}.values())
+    while stack:
+        n = stack.pop()
+        if id(n) in nodes:
+            continue
+        nodes[id(n)] = n
+        for (prod, _, _) in n.in_edges:
+            if prod is not None:
+                indeg[id(prod)] = indeg.get(id(prod), 0) + 1
+                stack.append(prod)
+
+    queue = [n for nid, n in nodes.items() if indeg.get(nid, 0) == 0]
+    processed = set()
+    while queue:
+        node = queue.pop()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+        cts_map = node_cts.pop(id(node), {})
+        cts = [cts_map.get(slot) for slot in range(len(node.diff_out))]
+        if any(ct is not None for ct in cts):
+            grads = node.apply(cts)
+        else:
+            grads = [None] * len(node.in_edges)
+        # retained intermediate outputs receive their accumulated cotangent
+        for slot, ref in enumerate(node.out_refs):
+            t = ref() if ref is not None else None
+            if t is not None and (t._retain_grad or id(t) in wanted):
+                ct = cts_map.get(slot)
+                if ct is not None:
+                    deposit(t, ct, as_leaf=False)
+        for (prod, slot, in_t), g in zip(node.in_edges, grads):
+            if prod is None:
+                if g is not None:
+                    deposit(in_t, g, as_leaf=True)
+            else:
+                if g is not None:
+                    _accumulate(node_cts, prod, slot, g)
+                indeg[id(prod)] -= 1
+                if indeg[id(prod)] == 0:
+                    queue.append(prod)
+        if not retain_graph:
+            node.release()
+
+    if inputs is not None:
+        return [Tensor(collected[id(t)]) if id(t) in collected else None
+                for t in inputs]
+    return None
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad parity (python/paddle/autograd/__init__.py).
+
+    create_graph (double grad) is not supported in eager mode; use the
+    static path (jax.grad composition) for higher-order derivatives.
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True is unsupported on the eager tape; compose "
+            "jax.grad via paddle_tpu.jit.to_static for higher-order AD.")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    retain = bool(retain_graph) if retain_graph is not None else create_graph
+    res = run_backward(outputs, grad_outputs, retain_graph=retain,
+                       accumulate_into_leaves=False, inputs=list(inputs),
+                       no_grad_vars=no_grad_vars)
+    if not allow_unused:
+        for t, g in zip(inputs, res):
+            if g is None:
+                raise RuntimeError(
+                    f"Input tensor {t.name} is unreachable from outputs; "
+                    "pass allow_unused=True to get None.")
+    return res

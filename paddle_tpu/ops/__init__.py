@@ -1,0 +1,167 @@
+"""The op zoo: functional tensor API + Tensor method patching.
+
+TPU-native replacement for Paddle's operator zoo and math_op_patch
+(reference: python/paddle/tensor/__init__.py,
+python/paddle/fluid/dygraph/math_op_patch.py). All ops are pure JAX
+functions dispatched through the cached-jit registry in core/dispatch.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from . import math as math_ops
+from . import creation
+from . import manipulation
+from . import reduction
+from . import linalg
+from . import comparison
+from . import indexing
+from ._helpers import as_tensor
+
+from .math import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .comparison import *  # noqa: F401,F403
+
+# names that collide with builtins are fine inside this namespace (paddle
+# does the same: paddle.sum/max/min/all/any/abs/pow/round)
+
+
+def _patch_tensor_methods():
+    T = Tensor
+
+    # -- arithmetic operators ---------------------------------------------
+    T.__add__ = lambda self, o: math_ops.add(self, o)
+    T.__radd__ = lambda self, o: math_ops.add(self, o)
+    T.__sub__ = lambda self, o: math_ops.subtract(self, o)
+    T.__rsub__ = lambda self, o: math_ops.subtract(o, self)
+    T.__mul__ = lambda self, o: math_ops.multiply(self, o)
+    T.__rmul__ = lambda self, o: math_ops.multiply(self, o)
+    T.__truediv__ = lambda self, o: math_ops.divide(self, o)
+    T.__rtruediv__ = lambda self, o: math_ops.divide(o, self)
+    T.__floordiv__ = lambda self, o: math_ops.floor_divide(self, o)
+    T.__rfloordiv__ = lambda self, o: math_ops.floor_divide(o, self)
+    T.__mod__ = lambda self, o: math_ops.remainder(self, o)
+    T.__rmod__ = lambda self, o: math_ops.remainder(o, self)
+    T.__pow__ = lambda self, o: math_ops.pow(self, o)
+    T.__rpow__ = lambda self, o: math_ops.pow(o, self)
+    T.__neg__ = lambda self: math_ops.neg(self)
+    T.__abs__ = lambda self: math_ops.abs(self)
+    T.__matmul__ = lambda self, o: linalg.matmul(self, o)
+    T.__rmatmul__ = lambda self, o: linalg.matmul(o, self)
+    T.__invert__ = lambda self: math_ops.logical_not(self) \
+        if np.dtype(self._value.dtype) == np.bool_ else math_ops.bitwise_not(self)
+    T.__and__ = lambda self, o: math_ops.logical_and(self, o) \
+        if np.dtype(self._value.dtype) == np.bool_ else math_ops.bitwise_and(self, o)
+    T.__or__ = lambda self, o: math_ops.logical_or(self, o) \
+        if np.dtype(self._value.dtype) == np.bool_ else math_ops.bitwise_or(self, o)
+    T.__xor__ = lambda self, o: math_ops.logical_xor(self, o) \
+        if np.dtype(self._value.dtype) == np.bool_ else math_ops.bitwise_xor(self, o)
+
+    # -- comparisons -------------------------------------------------------
+    T.__eq__ = lambda self, o: comparison.equal(self, o)
+    T.__ne__ = lambda self, o: comparison.not_equal(self, o)
+    T.__lt__ = lambda self, o: comparison.less_than(self, o)
+    T.__le__ = lambda self, o: comparison.less_equal(self, o)
+    T.__gt__ = lambda self, o: comparison.greater_than(self, o)
+    T.__ge__ = lambda self, o: comparison.greater_equal(self, o)
+    T.__hash__ = lambda self: id(self)
+
+    # -- indexing ----------------------------------------------------------
+    T.__getitem__ = lambda self, item: indexing.getitem(self, item)
+    T.__setitem__ = lambda self, item, v: indexing.setitem(self, item, v)
+
+    # -- properties --------------------------------------------------------
+    T.T = property(lambda self: manipulation.transpose(
+        self, list(range(self.ndim))[::-1]))
+    T.mT = property(lambda self: manipulation.swapaxes(self, -1, -2)
+                    if self.ndim >= 2 else self)
+    T.real = property(lambda self: math_ops.real(self))
+    T.imag = property(lambda self: math_ops.imag(self))
+
+    # -- methods from op modules ------------------------------------------
+    method_sources = [math_ops, creation, manipulation, reduction, linalg,
+                      comparison]
+    skip = {"to_tensor", "meshgrid", "linspace", "logspace", "arange", "eye",
+            "zeros", "ones", "full", "empty", "rand", "randn", "randint",
+            "uniform", "normal", "randperm", "tril_indices", "triu_indices"}
+    for mod in method_sources:
+        for nm in getattr(mod, "__all__", []):
+            if nm in skip or hasattr(T, nm):
+                continue
+            fn = getattr(mod, nm, None)
+            if callable(fn):
+                setattr(T, nm, fn)
+
+    # name those that collide with python builtins or need alias
+    T.astype = lambda self, dtype: math_ops.cast(self, dtype)
+    T.cast = lambda self, dtype: math_ops.cast(self, dtype)
+    T.abs = lambda self, name=None: math_ops.abs(self)
+    T.pow = lambda self, y, name=None: math_ops.pow(self, y)
+    T.sum = lambda self, axis=None, dtype=None, keepdim=False, name=None: \
+        reduction.sum(self, axis=axis, dtype=dtype, keepdim=keepdim)
+    T.mean = lambda self, axis=None, keepdim=False, name=None: \
+        reduction.mean(self, axis=axis, keepdim=keepdim)
+    T.max = lambda self, axis=None, keepdim=False, name=None: \
+        reduction.max(self, axis=axis, keepdim=keepdim)
+    T.min = lambda self, axis=None, keepdim=False, name=None: \
+        reduction.min(self, axis=axis, keepdim=keepdim)
+    T.prod = lambda self, axis=None, keepdim=False, dtype=None, name=None: \
+        reduction.prod(self, axis=axis, keepdim=keepdim, dtype=dtype)
+    T.all = lambda self, axis=None, keepdim=False, name=None: \
+        reduction.all(self, axis=axis, keepdim=keepdim)
+    T.any = lambda self, axis=None, keepdim=False, name=None: \
+        reduction.any(self, axis=axis, keepdim=keepdim)
+    T.norm = lambda self, p=None, axis=None, keepdim=False, name=None: \
+        linalg.norm(self, p=p, axis=axis, keepdim=keepdim)
+    T.matmul = lambda self, y, transpose_x=False, transpose_y=False, name=None: \
+        linalg.matmul(self, y, transpose_x, transpose_y)
+    T.mm = lambda self, y, name=None: linalg.matmul(self, y)
+    T.dot = lambda self, y, name=None: linalg.dot(self, y)
+    T.t = lambda self, name=None: manipulation.t(self)
+    T.item_ = T.item
+
+    # -- in-place variants (functional + rebind) ---------------------------
+    def _make_inplace(fn):
+        def inplace(self, *a, **kw):
+            out = fn(self, *a, **kw)
+            self._rebind(out._value)
+            self._grad_node = out._grad_node
+            self._out_slot = out._out_slot
+            self.stop_gradient = out.stop_gradient
+            return self
+        return inplace
+
+    for nm, fn in [
+        ("add_", math_ops.add), ("subtract_", math_ops.subtract),
+        ("multiply_", math_ops.multiply), ("divide_", math_ops.divide),
+        ("scale_", math_ops.scale), ("clip_", math_ops.clip),
+        ("exp_", math_ops.exp), ("sqrt_", math_ops.sqrt),
+        ("rsqrt_", math_ops.rsqrt), ("reciprocal_", math_ops.reciprocal),
+        ("round_", math_ops.round), ("ceil_", math_ops.ceil),
+        ("floor_", math_ops.floor), ("tanh_", math_ops.tanh),
+        ("abs_", math_ops.abs), ("neg_", math_ops.neg),
+        ("remainder_", math_ops.remainder), ("mod_", math_ops.mod),
+        ("cast_", math_ops.cast),
+    ]:
+        setattr(T, nm, _make_inplace(fn))
+
+    T.zero_ = lambda self: self._rebind(
+        creation.zeros_like(self)._value) or self
+    T.fill_ = lambda self, v: self._rebind(
+        creation.full_like(self, v)._value) or self
+
+    def _fill_diagonal_(self, value, offset=0, wrap=False, name=None):
+        import jax.numpy as jnp
+        n = min(self.shape[-2], self.shape[-1])
+        idx = np.arange(n - abs(offset))
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        return self._rebind(self._value.at[..., r, c].set(value)) or self
+    T.fill_diagonal_ = _fill_diagonal_
+
+
+_patch_tensor_methods()

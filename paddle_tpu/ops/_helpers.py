@@ -1,0 +1,58 @@
+"""Shared helpers for the op zoo wrappers."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor, to_tensor, apply_op
+
+__all__ = ["as_tensor", "scalar_operand", "axis_attr", "T", "wrap_unary",
+           "apply_op"]
+
+T = Tensor
+
+
+def as_tensor(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x
+    return to_tensor(x, dtype=dtype)
+
+
+def scalar_operand(x: Tensor, y):
+    """Convert a python scalar operand to a Tensor with Paddle's dtype rule:
+    python float + float tensor keeps the tensor dtype; int tensor with a
+    float scalar promotes to the default float dtype."""
+    xd = np.dtype(x._value.dtype)
+    if isinstance(y, (bool, np.bool_)):
+        return to_tensor(np.asarray(y))
+    if isinstance(y, (int, np.integer)):
+        if xd.kind in "fc":
+            return to_tensor(np.asarray(y, dtype=xd))
+        return to_tensor(np.asarray(y, dtype=xd))
+    if isinstance(y, (float, np.floating)):
+        if xd.kind in "fc":
+            return to_tensor(np.asarray(y, dtype=xd))
+        return to_tensor(np.asarray(y, dtype=dtypes.get_default_dtype().np_dtype))
+    if isinstance(y, complex):
+        return to_tensor(np.asarray(y, dtype=np.complex64))
+    return as_tensor(y)
+
+
+def axis_attr(axis):
+    """Normalize axis arg (None | int | list | Tensor) to a hashable attr."""
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, np.ndarray):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def wrap_unary(jnp_fn):
+    def fwd(x):
+        return jnp_fn(x)
+    return fwd

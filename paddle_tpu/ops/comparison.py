@@ -1,0 +1,79 @@
+"""Comparison ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor, apply_op
+from ._helpers import as_tensor, scalar_operand
+
+_this = sys.modules[__name__]
+
+__all__ = ["equal", "not_equal", "greater_than", "greater_equal", "less_than",
+           "less_equal", "equal_all", "allclose", "isclose", "is_empty",
+           "is_tensor"]
+
+_CMP = {
+    "equal": jnp.equal, "not_equal": jnp.not_equal,
+    "greater_than": jnp.greater, "greater_equal": jnp.greater_equal,
+    "less_than": jnp.less, "less_equal": jnp.less_equal,
+}
+
+
+def _make_cmp(opname):
+    def api(x, y, name=None):
+        if isinstance(x, Tensor):
+            y = y if isinstance(y, Tensor) else scalar_operand(x, y)
+        elif isinstance(y, Tensor):
+            x = scalar_operand(y, x)
+        else:
+            x, y = as_tensor(x), as_tensor(y)
+        return apply_op(opname, x, y)
+    api.__name__ = opname
+    return api
+
+
+for _name, _fn in _CMP.items():
+    register_op(_name, (lambda f: (lambda x, y: f(x, y)))(_fn), nondiff=True)
+    setattr(_this, _name, _make_cmp(_name))
+
+
+register_op("equal_all", lambda x, y: jnp.asarray(
+    jnp.array_equal(x, y)), nondiff=True)
+
+
+def equal_all(x, y, name=None):
+    return apply_op("equal_all", as_tensor(x), as_tensor(y))
+
+
+register_op("allclose", lambda x, y, rtol=1e-5, atol=1e-8, equal_nan=False:
+            jnp.asarray(jnp.allclose(x, y, rtol=rtol, atol=atol,
+                                     equal_nan=equal_nan)), nondiff=True)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op("allclose", as_tensor(x), as_tensor(y),
+                    attrs=dict(rtol=float(rtol), atol=float(atol),
+                               equal_nan=bool(equal_nan)))
+
+
+register_op("isclose", lambda x, y, rtol=1e-5, atol=1e-8, equal_nan=False:
+            jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan),
+            nondiff=True)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op("isclose", as_tensor(x), as_tensor(y),
+                    attrs=dict(rtol=float(rtol), atol=float(atol),
+                               equal_nan=bool(equal_nan)))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(as_tensor(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,352 @@
+"""Tensor creation ops.
+
+TPU-native replacement for Paddle's creation kernels (reference:
+python/paddle/tensor/creation.py; phi/kernels/full_kernel.h etc.).
+Creation happens on the current Place's PJRT device; random ops draw
+threefry keys from the stateful Generator facade (core/random.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core import device as devices
+from ..core import random as prandom
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor, to_tensor, apply_op
+from ._helpers import as_tensor, axis_attr
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "tril", "triu", "diag", "diagflat", "meshgrid", "assign", "clone",
+    "rand", "randn", "randint", "randint_like", "uniform", "normal",
+    "standard_normal", "randperm", "multinomial", "bernoulli", "poisson",
+    "uniform_", "normal_", "exponential_", "tril_indices", "triu_indices",
+    "complex", "polar", "as_complex", "as_real", "numel", "clone",
+]
+
+
+def _resolve_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy().reshape(-1))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    out = []
+    for s in shape:
+        if isinstance(s, Tensor):
+            out.append(int(s.item()))
+        else:
+            out.append(int(s))
+    return tuple(out)
+
+
+def _put(arr):
+    return jax.device_put(arr, devices.jax_device())
+
+
+def zeros(shape, dtype=None, name=None):
+    dt = dtypes.to_np_dtype(dtype)
+    return Tensor(_put(jnp.zeros(_resolve_shape(shape), dt)))
+
+
+def ones(shape, dtype=None, name=None):
+    dt = dtypes.to_np_dtype(dtype)
+    return Tensor(_put(jnp.ones(_resolve_shape(shape), dt)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, (bool, np.bool_)):
+            dt = np.bool_
+        elif isinstance(fill_value, (int, np.integer)):
+            dt = np.int64
+        else:
+            dt = dtypes.get_default_dtype().np_dtype
+    else:
+        dt = dtypes.to_np_dtype(dtype)
+    return Tensor(_put(jnp.full(_resolve_shape(shape), fill_value, dt)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+register_op("zeros_like", lambda x, dtype=None: jnp.zeros_like(x, dtype=dtype),
+            nondiff=True)
+register_op("ones_like", lambda x, dtype=None: jnp.ones_like(x, dtype=dtype),
+            nondiff=True)
+
+
+def zeros_like(x, dtype=None, name=None):
+    dt = dtypes.to_np_dtype(dtype).name if dtype is not None else None
+    return apply_op("zeros_like", as_tensor(x), attrs=dict(dtype=dt))
+
+
+def ones_like(x, dtype=None, name=None):
+    dt = dtypes.to_np_dtype(dtype).name if dtype is not None else None
+    return apply_op("ones_like", as_tensor(x), attrs=dict(dtype=dt))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = as_tensor(x)
+    dt = dtypes.to_np_dtype(dtype) if dtype is not None else x._value.dtype
+    return full(x.shape, fill_value, dt)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dt = np.int64
+        else:
+            dt = dtypes.get_default_dtype().np_dtype
+    else:
+        dt = dtypes.to_np_dtype(dtype)
+    return Tensor(_put(jnp.arange(start, end, step, dtype=dt)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    dt = dtypes.to_np_dtype(dtype) if dtype is not None else np.float32
+    return Tensor(_put(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                                    dtype=dt)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    dt = dtypes.to_np_dtype(dtype) if dtype is not None else np.float32
+    return Tensor(_put(jnp.logspace(_v(start), _v(stop), int(_v(num)),
+                                    base=_v(base), dtype=dt)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    dt = dtypes.to_np_dtype(dtype)
+    return Tensor(_put(jnp.eye(int(num_rows),
+                               int(num_columns) if num_columns else None,
+                               dtype=dt)))
+
+
+register_op("tril", lambda x, diagonal=0: jnp.tril(x, k=diagonal))
+register_op("triu", lambda x, diagonal=0: jnp.triu(x, k=diagonal))
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op("tril", as_tensor(x), attrs=dict(diagonal=int(diagonal)))
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op("triu", as_tensor(x), attrs=dict(diagonal=int(diagonal)))
+
+
+register_op("diag", lambda x, offset=0, padding_value=0.0:
+            jnp.diag(x, k=offset) if x.ndim == 1 else jnp.diagonal(x, offset=offset))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = as_tensor(x)
+    if x.ndim == 1 and padding_value != 0:
+        n = x.shape[0] + abs(int(offset))
+        mask = jnp.eye(n, k=int(offset), dtype=bool)
+        base = jnp.full((n, n), padding_value, x._value.dtype)
+        return Tensor(jnp.where(mask, jnp.diag(x._value, k=int(offset)), base))
+    return apply_op("diag", x, attrs=dict(offset=int(offset)))
+
+
+def diagflat(x, offset=0, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.diagflat(x._value, k=int(offset)))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    ts = [as_tensor(a) for a in args]
+    outs = jnp.meshgrid(*[t._value for t in ts], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+register_op("assign", lambda x: x + 0 if np.issubdtype(np.dtype(x.dtype), np.number) else jnp.copy(x))
+
+
+def assign(x, output=None):
+    x = as_tensor(x)
+    out = apply_op("assign", x)
+    if output is not None:
+        output._rebind(out._value)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+register_op("numel", lambda x: jnp.asarray(np.prod(x.shape, dtype=np.int64)),
+            nondiff=True)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(as_tensor(x).shape))))
+
+
+# -- random ------------------------------------------------------------------
+
+def _key():
+    return prandom.next_key()
+
+
+def rand(shape, dtype=None, name=None):
+    dt = dtypes.to_np_dtype(dtype)
+    if np.dtype(dt).kind != "f":
+        dt = dtypes.get_default_dtype().np_dtype
+    v = jax.random.uniform(_key(), _resolve_shape(shape), dtype=jnp.float32)
+    return Tensor(_put(v.astype(dt)))
+
+
+def randn(shape, dtype=None, name=None):
+    dt = dtypes.to_np_dtype(dtype)
+    if np.dtype(dt).kind != "f":
+        dt = dtypes.get_default_dtype().np_dtype
+    v = jax.random.normal(_key(), _resolve_shape(shape), dtype=jnp.float32)
+    return Tensor(_put(v.astype(dt)))
+
+
+standard_normal = randn
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dt = dtypes.to_np_dtype(dtype) if dtype is not None else np.int64
+    v = jax.random.randint(_key(), _resolve_shape(shape), int(low), int(high),
+                           dtype=jnp.int32)
+    return Tensor(_put(v.astype(dt)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = as_tensor(x)
+    dt = dtype if dtype is not None else x.dtype
+    return randint(low, high, x.shape, dt)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    dt = dtypes.to_np_dtype(dtype)
+    if np.dtype(dt).kind != "f":
+        dt = dtypes.get_default_dtype().np_dtype
+    key = jax.random.PRNGKey(seed) if seed else _key()
+    v = jax.random.uniform(key, _resolve_shape(shape), dtype=jnp.float32,
+                           minval=float(min), maxval=float(max))
+    return Tensor(_put(v.astype(dt)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = as_tensor(mean)._value if isinstance(mean, Tensor) else mean
+        s = as_tensor(std)._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(np.shape(m), np.shape(s))
+        v = jax.random.normal(_key(), shp, dtype=jnp.float32)
+        return Tensor(_put(v * s + m))
+    shp = _resolve_shape(shape) if shape is not None else (1,)
+    v = jax.random.normal(_key(), shp, dtype=jnp.float32)
+    return Tensor(_put(v * float(std) + float(mean)))
+
+
+def randperm(n, dtype="int64", name=None):
+    v = jax.random.permutation(_key(), int(n))
+    return Tensor(_put(v.astype(dtypes.to_np_dtype(dtype))))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = as_tensor(x)
+    logits = jnp.log(jnp.clip(x._value, 1e-30, None))
+    if replacement:
+        v = jax.random.categorical(_key(), logits, axis=-1,
+                                   shape=(*logits.shape[:-1], int(num_samples)))
+    else:
+        k = _key()
+        z = jax.random.gumbel(k, logits.shape, dtype=jnp.float32)
+        _, idx = jax.lax.top_k(logits + z, int(num_samples))
+        v = idx
+    return Tensor(_put(v.astype(np.int64)))
+
+
+def bernoulli(x, name=None):
+    x = as_tensor(x)
+    v = jax.random.bernoulli(_key(), x._value.astype(jnp.float32))
+    return Tensor(_put(v.astype(x._value.dtype)))
+
+
+def poisson(x, name=None):
+    x = as_tensor(x)
+    v = jax.random.poisson(_key(), x._value.astype(jnp.float32))
+    return Tensor(_put(v.astype(x._value.dtype)))
+
+
+def uniform_(x, min=-1.0, max=1.0, name=None):
+    v = jax.random.uniform(_key(), tuple(x.shape), dtype=jnp.float32,
+                           minval=float(min), maxval=float(max))
+    return x._rebind(_put(v.astype(x._value.dtype)))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    v = jax.random.normal(_key(), tuple(x.shape), dtype=jnp.float32)
+    return x._rebind(_put((v * float(std) + float(mean)).astype(x._value.dtype)))
+
+
+def exponential_(x, lam=1.0, name=None):
+    v = jax.random.exponential(_key(), tuple(x.shape), dtype=jnp.float32)
+    return x._rebind(_put((v / float(lam)).astype(x._value.dtype)))
+
+
+def tril_indices(row, col, offset=0, dtype="int64", name=None):
+    r, c = np.tril_indices(int(row), int(offset), int(col))
+    dt = dtypes.to_np_dtype(dtype)
+    return Tensor(_put(jnp.asarray(np.stack([r, c]).astype(dt))))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    col = col if col is not None else row
+    r, c = np.triu_indices(int(row), int(offset), int(col))
+    dt = dtypes.to_np_dtype(dtype)
+    return Tensor(_put(jnp.asarray(np.stack([r, c]).astype(dt))))
+
+
+register_op("complex", lambda re, im: jax.lax.complex(re, im))
+
+
+def complex(real, imag, name=None):
+    return apply_op("complex", as_tensor(real), as_tensor(imag))
+
+
+register_op("polar", lambda a, t: jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t)))
+
+
+def polar(abs, angle, name=None):
+    return apply_op("polar", as_tensor(abs), as_tensor(angle))
+
+
+register_op("as_complex", lambda x: jax.lax.complex(x[..., 0], x[..., 1]))
+register_op("as_real", lambda x: jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1))
+
+
+def as_complex(x, name=None):
+    return apply_op("as_complex", as_tensor(x))
+
+
+def as_real(x, name=None):
+    return apply_op("as_real", as_tensor(x))

@@ -1,0 +1,118 @@
+"""__getitem__ / __setitem__ with Paddle slicing semantics.
+
+TPU-native replacement for pybind slice_utils.h (reference:
+paddle/fluid/pybind/slice_utils.h). JAX arrays already implement numpy
+basic+advanced indexing; we map Paddle's accepted index forms (int, slice,
+Ellipsis, None, bool mask, Tensor index, tuples thereof) onto it, keeping
+gather/scatter differentiable through the tape.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor, apply_op
+from ._helpers import as_tensor
+
+
+def _norm_index(item):
+    """Split index into (static_part, tensor_parts) so the static shape goes
+    into attrs (hashable) and tensor indices ride as op inputs."""
+    if not isinstance(item, tuple):
+        item = (item,)
+    static = []
+    tensors = []
+    for it in item:
+        if isinstance(it, Tensor):
+            static.append(("t", len(tensors)))
+            tensors.append(it)
+        elif isinstance(it, np.ndarray):
+            static.append(("t", len(tensors)))
+            tensors.append(as_tensor(it))
+        elif isinstance(it, slice):
+            static.append(("s", it.start if not isinstance(it.start, Tensor)
+                           else int(it.start.item()),
+                           it.stop if not isinstance(it.stop, Tensor)
+                           else int(it.stop.item()),
+                           it.step if not isinstance(it.step, Tensor)
+                           else int(it.step.item())))
+        elif it is Ellipsis:
+            static.append(("e",))
+        elif it is None:
+            static.append(("n",))
+        elif isinstance(it, (bool, np.bool_)):
+            static.append(("b", bool(it)))
+        elif isinstance(it, (int, np.integer)):
+            static.append(("i", int(it)))
+        elif isinstance(it, (list,)):
+            arr = np.asarray(it)
+            static.append(("t", len(tensors)))
+            tensors.append(as_tensor(arr))
+        else:
+            raise TypeError(f"Unsupported index element: {it!r}")
+    return tuple(static), tensors
+
+
+def _build_index(static, tvals):
+    idx = []
+    for s in static:
+        kind = s[0]
+        if kind == "t":
+            idx.append(tvals[s[1]])
+        elif kind == "s":
+            idx.append(np.s_[s[1]:s[2]:s[3]])
+        elif kind == "e":
+            idx.append(Ellipsis)
+        elif kind == "n":
+            idx.append(None)
+        elif kind == "b":
+            idx.append(s[1])
+        elif kind == "i":
+            idx.append(s[1])
+    return tuple(idx)
+
+
+def _getitem_fwd(x, *tvals, static=()):
+    return x[_build_index(static, tvals)]
+
+
+def _setitem_fwd(x, value, *tvals, static=()):
+    return x.at[_build_index(static, tvals)].set(value.astype(x.dtype))
+
+
+register_op("getitem", _getitem_fwd)
+register_op("setitem", _setitem_fwd)
+
+
+def _has_bool_mask(tensors):
+    return any(np.dtype(t._value.dtype) == np.bool_ for t in tensors)
+
+
+def getitem(x: Tensor, item):
+    static, tensors = _norm_index(item)
+    if _has_bool_mask(tensors):
+        # boolean-mask gather has data-dependent shape: eager-only fast path
+        idx = _build_index(static, [t._value for t in tensors])
+        return Tensor(x._value[idx])
+    return apply_op("getitem", x, *tensors, attrs=dict(static=static))
+
+
+def setitem(x: Tensor, item, value):
+    """Paddle's inplace __setitem__: functional scatter + rebind."""
+    static, tensors = _norm_index(item)
+    if not isinstance(value, Tensor):
+        value = as_tensor(np.asarray(value, dtype=np.dtype(x._value.dtype)))
+    if _has_bool_mask(tensors):
+        idx = _build_index(static, [t._value for t in tensors])
+        new_v = x._value.at[idx].set(value._value.astype(x._value.dtype))
+        x._rebind(new_v)
+        return x
+    out = apply_op("setitem", x, value, *tensors, attrs=dict(static=static))
+    x._rebind(out._value)
+    # keep the tape: x now points at the setitem result so later uses of x
+    # differentiate through the scatter
+    x._grad_node = out._grad_node
+    x._out_slot = out._out_slot
+    x.stop_gradient = out.stop_gradient and x.stop_gradient
+    return x

@@ -1,0 +1,361 @@
+"""Linear algebra ops — the MXU's home turf.
+
+TPU-native replacement for paddle/phi/kernels/{matmul,*_grad}_kernel +
+funcs/blas (cuBLAS wrappers). matmul lowers straight to XLA dot_general
+which tiles onto the 128x128 systolic array; decompositions (svd/qr/eigh/
+cholesky) use jax.numpy.linalg (XLA custom calls on TPU).
+Reference API: python/paddle/tensor/linalg.py:142 matmul.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor, apply_op
+from ._helpers import as_tensor
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "mv", "inner", "outer", "cross", "norm",
+    "dist", "einsum", "multi_dot", "matrix_power", "transpose_matmul",
+    "cholesky", "cholesky_solve", "inv", "det", "slogdet", "svd", "qr",
+    "eig", "eigh", "eigvals", "eigvalsh", "pinv", "solve", "triangular_solve",
+    "lstsq", "matrix_rank", "cond", "lu", "lu_unpack", "corrcoef", "cov",
+    "householder_product", "pca_lowrank", "matrix_exp",
+]
+
+
+def _mm(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        if x.ndim == 1:
+            pass
+        else:
+            x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        if y.ndim == 1:
+            pass
+        else:
+            y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+# Note on backward cost: matmul is linear, so the generic VJP program
+# (dispatch.get_vjp) contains the primal dot only as dead code — XLA DCE
+# removes it, leaving exactly the two grad dots (paddle's matmul_grad,
+# phi/kernels/impl/matmul_grad_kernel_impl.h). No custom bwd needed.
+register_op("matmul", _mm)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return apply_op("matmul", as_tensor(x), as_tensor(y),
+                    attrs=dict(transpose_x=bool(transpose_x),
+                               transpose_y=bool(transpose_y)))
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    if x.ndim != 3 or y.ndim != 3:
+        raise ValueError("bmm expects 3-D tensors")
+    return matmul(x, y)
+
+
+register_op("dot", lambda x, y: jnp.sum(x * y, axis=-1))
+
+
+def dot(x, y, name=None):
+    return apply_op("dot", as_tensor(x), as_tensor(y))
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+register_op("inner", lambda x, y: jnp.inner(x, y))
+
+
+def inner(x, y, name=None):
+    return apply_op("inner", as_tensor(x), as_tensor(y))
+
+
+register_op("outer", lambda x, y: jnp.outer(x, y))
+
+
+def outer(x, y, name=None):
+    return apply_op("outer", as_tensor(x), as_tensor(y))
+
+
+register_op("cross", lambda x, y, axis=None:
+            jnp.cross(x, y, axis=axis if axis is not None else -1))
+
+
+def cross(x, y, axis=9, name=None):
+    x = as_tensor(x)
+    if axis == 9:  # paddle default: first axis of size 3
+        axis = next(i for i, s in enumerate(x.shape) if s == 3)
+    return apply_op("cross", x, as_tensor(y), attrs=dict(axis=int(axis)))
+
+
+register_op("p_norm", lambda x, p=2.0, axis=None, keepdim=False:
+            jnp.linalg.norm(x if axis is not None else x.reshape(-1),
+                            ord=p, axis=axis, keepdims=keepdim))
+register_op("fro_norm", lambda x, axis=None, keepdim=False:
+            jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim)))
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    from ._helpers import axis_attr
+    ax = axis_attr(axis)
+    if p is None:
+        p = "fro" if (ax is None or isinstance(ax, tuple)) else 2.0
+    if p == "fro":
+        return apply_op("fro_norm", x, attrs=dict(axis=ax, keepdim=bool(keepdim)))
+    if p == "nuc":
+        s = jnp.linalg.svd(x._value, compute_uv=False)
+        return Tensor(jnp.sum(s, axis=-1, keepdims=keepdim))
+    if isinstance(ax, tuple) and len(ax) == 1:
+        ax = ax[0]
+    return apply_op("p_norm", x, attrs=dict(p=float(p) if p not in
+                                            (np.inf, -np.inf) else p,
+                                            axis=ax, keepdim=bool(keepdim)))
+
+
+register_op("dist", lambda x, y, p=2.0:
+            jnp.linalg.norm((x - y).reshape(-1), ord=p))
+
+
+def dist(x, y, p=2, name=None):
+    return apply_op("dist", as_tensor(x), as_tensor(y), attrs=dict(p=float(p)))
+
+
+register_op("einsum", lambda *xs, equation=None: jnp.einsum(equation, *xs))
+
+
+def einsum(equation, *operands):
+    ts = [as_tensor(o) for o in operands]
+    return apply_op("einsum", *ts, attrs=dict(equation=equation.replace(" ", "")))
+
+
+def multi_dot(x, name=None):
+    ts = [as_tensor(t) for t in x]
+    out = ts[0]
+    for t in ts[1:]:
+        out = matmul(out, t)
+    return out
+
+
+register_op("matrix_power", lambda x, n=1: jnp.linalg.matrix_power(x, n))
+
+
+def matrix_power(x, n, name=None):
+    return apply_op("matrix_power", as_tensor(x), attrs=dict(n=int(n)))
+
+
+register_op("cholesky", lambda x, upper=False:
+            jnp.swapaxes(jnp.linalg.cholesky(x), -1, -2) if upper
+            else jnp.linalg.cholesky(x))
+
+
+def cholesky(x, upper=False, name=None):
+    return apply_op("cholesky", as_tensor(x), attrs=dict(upper=bool(upper)))
+
+
+register_op("cholesky_solve", lambda y, x, upper=False:
+            jax.scipy.linalg.cho_solve((x, not upper), y))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    return apply_op("cholesky_solve", as_tensor(x), as_tensor(y),
+                    attrs=dict(upper=bool(upper)))
+
+
+register_op("inv", lambda x: jnp.linalg.inv(x))
+
+
+def inv(x, name=None):
+    return apply_op("inv", as_tensor(x))
+
+
+register_op("det", lambda x: jnp.linalg.det(x))
+
+
+def det(x, name=None):
+    return apply_op("det", as_tensor(x))
+
+
+def slogdet(x, name=None):
+    x = as_tensor(x)
+    sign, logdet = jnp.linalg.slogdet(x._value)
+    return Tensor(jnp.stack([sign, logdet]))
+
+
+def svd(x, full_matrices=False, name=None):
+    x = as_tensor(x)
+    u, s, vh = jnp.linalg.svd(x._value, full_matrices=full_matrices)
+    return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2))
+
+
+def qr(x, mode="reduced", name=None):
+    x = as_tensor(x)
+    if mode == "r":
+        r = jnp.linalg.qr(x._value, mode="r")
+        return Tensor(r)
+    q, r = jnp.linalg.qr(x._value, mode=mode)
+    return Tensor(q), Tensor(r)
+
+
+def eig(x, name=None):
+    x = as_tensor(x)
+    w, v = np.linalg.eig(np.asarray(x._value))  # CPU fallback (XLA lacks geev)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    x = as_tensor(x)
+    w, v = jnp.linalg.eigh(x._value, symmetrize_input=True)
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    x = as_tensor(x)
+    w = np.linalg.eigvals(np.asarray(x._value))
+    return Tensor(jnp.asarray(w))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.linalg.eigvalsh(x._value))
+
+
+register_op("pinv", lambda x, rcond=1e-15, hermitian=False:
+            jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op("pinv", as_tensor(x),
+                    attrs=dict(rcond=float(rcond), hermitian=bool(hermitian)))
+
+
+register_op("solve", lambda x, y: jnp.linalg.solve(
+    x, y[..., None] if y.ndim == x.ndim - 1 else y).reshape(y.shape)
+    if y.ndim == x.ndim - 1 else jnp.linalg.solve(x, y))
+
+
+def solve(x, y, name=None):
+    return apply_op("solve", as_tensor(x), as_tensor(y))
+
+
+register_op("triangular_solve",
+            lambda x, y, upper=True, transpose=False, unitriangular=False:
+            jax.scipy.linalg.solve_triangular(
+                x, y, lower=not upper, trans=1 if transpose else 0,
+                unit_diagonal=unitriangular))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return apply_op("triangular_solve", as_tensor(x), as_tensor(y),
+                    attrs=dict(upper=bool(upper), transpose=bool(transpose),
+                               unitriangular=bool(unitriangular)))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    sol, res, rank, sv = jnp.linalg.lstsq(x._value, y._value, rcond=rcond)
+    return (Tensor(sol), Tensor(res), Tensor(rank.astype(np.int64)
+                                             if np.ndim(rank) else
+                                             jnp.asarray(int(rank))),
+            Tensor(sv))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    x = as_tensor(x)
+    r = jnp.linalg.matrix_rank(x._value, rtol=tol)
+    return Tensor(r.astype(np.int64) if hasattr(r, "astype") else jnp.asarray(r))
+
+
+def cond(x, p=None, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.linalg.cond(x._value, p=p))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    x = as_tensor(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(x._value)
+    piv = piv.astype(np.int32) + 1  # paddle returns 1-based pivots
+    info = Tensor(jnp.zeros(x.shape[:-2], dtype=np.int32))
+    if get_infos:
+        return Tensor(lu_), Tensor(piv), info
+    return Tensor(lu_), Tensor(piv)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    m, n = x.shape[-2], x.shape[-1]
+    k = min(m, n)
+    lmat = jnp.tril(x._value[..., :k], -1) + jnp.eye(m, k, dtype=x._value.dtype)
+    umat = jnp.triu(x._value[:k, :])
+    piv = np.asarray(y._value) - 1
+    p = np.eye(m, dtype=np.asarray(x._value).dtype)
+    for i, pv in enumerate(piv):
+        p[[i, pv]] = p[[pv, i]]
+    return Tensor(jnp.asarray(p.T)), Tensor(lmat), Tensor(umat)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.corrcoef(x._value, rowvar=rowvar))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    x = as_tensor(x)
+    fw = as_tensor(fweights)._value if fweights is not None else None
+    aw = as_tensor(aweights)._value if aweights is not None else None
+    return Tensor(jnp.cov(x._value, rowvar=rowvar,
+                          ddof=1 if ddof else 0, fweights=fw, aweights=aw))
+
+
+def householder_product(x, tau, name=None):
+    x, tau = as_tensor(x), as_tensor(tau)
+    *batch, m, n = x.shape
+    k = tau.shape[-1]
+
+    def one(xv, tv):
+        q = jnp.eye(m, dtype=xv.dtype)
+        for i in range(k):
+            v = jnp.where(jnp.arange(m) < i, 0.0,
+                          jnp.where(jnp.arange(m) == i, 1.0, xv[:, i]))
+            q = q - tv[i] * (q @ v)[:, None] * v[None, :]
+        return q[:, :n]
+    if batch:
+        flat_x = x._value.reshape((-1, m, n))
+        flat_t = tau._value.reshape((-1, k))
+        out = jax.vmap(one)(flat_x, flat_t)
+        return Tensor(out.reshape(*batch, m, n))
+    return Tensor(one(x._value, tau._value))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    x = as_tensor(x)
+    m, n = x.shape[-2], x.shape[-1]
+    q = q if q is not None else min(6, m, n)
+    xv = x._value
+    if center:
+        xv = xv - jnp.mean(xv, axis=-2, keepdims=True)
+    u, s, vh = jnp.linalg.svd(xv, full_matrices=False)
+    return Tensor(u[..., :q]), Tensor(s[..., :q]), \
+        Tensor(jnp.swapaxes(vh, -1, -2)[..., :q])
+
+
+def matrix_exp(x, name=None):
+    x = as_tensor(x)
+    return Tensor(jax.scipy.linalg.expm(x._value))
+
+
+def transpose_matmul(x, y):
+    return matmul(x, y, transpose_x=True)

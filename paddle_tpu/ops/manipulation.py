@@ -1,0 +1,787 @@
+"""Shape/layout manipulation ops.
+
+TPU-native replacement for Paddle's manipulation kernels (reference:
+python/paddle/tensor/manipulation.py; phi/kernels/{reshape,concat,split,
+transpose,...}). Under XLA most of these are free (layout/metadata-only) or
+fuse into adjacent compute; there is no copy-vs-view distinction at the user
+level — the functional semantics make every op safe to "view".
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor, apply_op
+from ._helpers import as_tensor, axis_attr
+
+__all__ = [
+    "reshape", "reshape_", "flatten", "squeeze", "squeeze_", "unsqueeze",
+    "unsqueeze_", "transpose", "t", "concat", "stack", "split", "chunk",
+    "tile", "expand", "expand_as", "broadcast_to", "broadcast_tensors",
+    "gather", "gather_nd", "scatter", "scatter_", "scatter_nd",
+    "scatter_nd_add", "index_select", "index_sample", "index_add",
+    "index_put", "masked_select", "masked_fill", "where", "nonzero", "roll",
+    "flip", "rot90", "unbind", "unstack", "repeat_interleave",
+    "take_along_axis", "put_along_axis", "slice", "strided_slice", "crop",
+    "unique", "unique_consecutive", "sort", "argsort", "topk", "kthvalue",
+    "mode", "searchsorted", "bucketize", "moveaxis", "swapaxes", "diagonal",
+    "tensordot", "trace", "kron", "diff", "bincount", "histogram",
+    "flatten_", "as_strided", "view", "view_as", "atleast_1d", "atleast_2d",
+    "atleast_3d", "select_scatter", "shard_index", "tolist", "pad",
+]
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy().reshape(-1))
+    out = []
+    for s in (shape if isinstance(shape, (list, tuple)) else [shape]):
+        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+register_op("reshape", lambda x, shape=None: jnp.reshape(x, shape))
+
+
+def reshape(x, shape, name=None):
+    return apply_op("reshape", as_tensor(x), attrs=dict(shape=_shape_arg(shape)))
+
+
+def reshape_(x, shape, name=None):
+    return x._rebind(reshape(x, shape)._value)
+
+
+register_op("flatten", lambda x, start=0, stop=-1:
+            jax.lax.collapse(x, start, (stop % max(x.ndim, 1)) + 1))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = as_tensor(x)
+    nd = max(x.ndim, 1)
+    return apply_op("flatten", x, attrs=dict(start=int(start_axis) % nd,
+                                             stop=int(stop_axis) % nd))
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return x._rebind(flatten(x, start_axis, stop_axis)._value)
+
+
+register_op("squeeze", lambda x, axis=None: jnp.squeeze(x, axis=axis))
+
+
+def squeeze(x, axis=None, name=None):
+    x = as_tensor(x)
+    ax = axis_attr(axis)
+    if ax is not None:
+        if isinstance(ax, int):
+            ax = (ax,)
+        ax = tuple(a % x.ndim for a in ax if x.shape[a % x.ndim] == 1)
+        if not ax:
+            return apply_op("reshape", x, attrs=dict(shape=tuple(x.shape)))
+    return apply_op("squeeze", x, attrs=dict(axis=ax))
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._rebind(squeeze(x, axis)._value)
+
+
+register_op("unsqueeze", lambda x, axis=(): jnp.expand_dims(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    ax = axis_attr(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+    return apply_op("unsqueeze", as_tensor(x), attrs=dict(axis=ax))
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._rebind(unsqueeze(x, axis)._value)
+
+
+register_op("transpose", lambda x, perm=None: jnp.transpose(x, perm))
+
+
+def transpose(x, perm=None, name=None):
+    return apply_op("transpose", as_tensor(x),
+                    attrs=dict(perm=tuple(int(p) for p in perm)
+                               if perm is not None else None))
+
+
+def t(x, name=None):
+    x = as_tensor(x)
+    if x.ndim < 2:
+        return x
+    if x.ndim == 2:
+        return transpose(x, [1, 0])
+    raise ValueError("paddle.t only supports ndim<=2; use transpose")
+
+
+register_op("concat", lambda *xs, axis=0: jnp.concatenate(xs, axis=axis))
+
+
+def concat(x, axis=0, name=None):
+    ts = [as_tensor(v) for v in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply_op("concat", *ts, attrs=dict(axis=int(axis)))
+
+
+register_op("stack", lambda *xs, axis=0: jnp.stack(xs, axis=axis))
+
+
+def stack(x, axis=0, name=None):
+    ts = [as_tensor(v) for v in x]
+    return apply_op("stack", *ts, attrs=dict(axis=int(axis)))
+
+
+register_op("split", lambda x, indices=None, axis=0:
+            tuple(jnp.split(x, indices, axis=axis)))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = as_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    axis = int(axis) % x.ndim
+    if isinstance(num_or_sections, int):
+        indices = num_or_sections
+    else:
+        secs = [int(s.item()) if isinstance(s, Tensor) else int(s)
+                for s in num_or_sections]
+        total = x.shape[axis]
+        known = sum(s for s in secs if s >= 0)
+        secs = [s if s >= 0 else total - known for s in secs]
+        indices = tuple(np.cumsum(secs)[:-1].tolist())
+    out = apply_op("split", x, attrs=dict(indices=indices, axis=axis))
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, int(chunks), axis)
+
+
+register_op("tile", lambda x, reps=None: jnp.tile(x, reps))
+
+
+def tile(x, repeat_times, name=None):
+    return apply_op("tile", as_tensor(x),
+                    attrs=dict(reps=_shape_arg(repeat_times)))
+
+
+register_op("broadcast_to", lambda x, shape=None: jnp.broadcast_to(x, shape))
+
+
+def broadcast_to(x, shape, name=None):
+    return apply_op("broadcast_to", as_tensor(x),
+                    attrs=dict(shape=_shape_arg(shape)))
+
+
+def expand(x, shape, name=None):
+    x = as_tensor(x)
+    shape = list(_shape_arg(shape))
+    xs = [1] * (len(shape) - x.ndim) + list(x.shape)
+    shape = [xs[i] if s == -1 else s for i, s in enumerate(shape)]
+    return broadcast_to(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return broadcast_to(x, as_tensor(y).shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [as_tensor(v) for v in inputs]
+    shape = jnp.broadcast_shapes(*[tuple(t.shape) for t in ts])
+    return [broadcast_to(t, shape) for t in ts]
+
+
+register_op("gather", lambda x, index, axis=0:
+            jnp.take(x, index if index.ndim <= 1 else index.reshape(-1),
+                     axis=axis))
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply_op("gather", as_tensor(x), as_tensor(index),
+                    attrs=dict(axis=int(axis)))
+
+
+register_op("gather_nd", lambda x, index: x[tuple(jnp.moveaxis(index, -1, 0))])
+
+
+def gather_nd(x, index, name=None):
+    return apply_op("gather_nd", as_tensor(x), as_tensor(index))
+
+
+def _scatter_overwrite(x, index, updates):
+    idx = index.reshape(-1) if index.ndim > 1 else index
+    return x.at[idx].set(updates)
+
+
+def _scatter_accumulate(x, index, updates):
+    # paddle semantics (python/paddle/tensor/manipulation.py scatter):
+    # rows named in index are zeroed then receive the sum of their updates.
+    idx = index.reshape(-1) if index.ndim > 1 else index
+    zeroed = x.at[idx].set(jnp.zeros(updates.shape[1:], x.dtype))
+    return zeroed.at[idx].add(updates)
+
+
+register_op("scatter_overwrite", _scatter_overwrite)
+register_op("scatter_add", _scatter_accumulate)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    op = "scatter_overwrite" if overwrite else "scatter_add"
+    return apply_op(op, as_tensor(x), as_tensor(index), as_tensor(updates))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._rebind(scatter(x, index, updates, overwrite)._value)
+
+
+register_op("scatter_nd_add", lambda x, index, updates:
+            x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return apply_op("scatter_nd_add", as_tensor(x), as_tensor(index),
+                    as_tensor(updates))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    updates = as_tensor(updates)
+    zero = Tensor(jnp.zeros(_shape_arg(shape), updates._value.dtype))
+    return scatter_nd_add(zero, index, updates)
+
+
+register_op("index_select", lambda x, index, axis=0:
+            jnp.take(x, index, axis=axis))
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op("index_select", as_tensor(x), as_tensor(index),
+                    attrs=dict(axis=int(axis)))
+
+
+register_op("index_sample", lambda x, index:
+            jnp.take_along_axis(x, index, axis=1))
+
+
+def index_sample(x, index, name=None):
+    return apply_op("index_sample", as_tensor(x), as_tensor(index))
+
+
+register_op("index_add", lambda x, index, value, axis=0:
+            x.at[(np.s_[:],) * (axis % x.ndim) + (index,)].add(value))
+
+
+def index_add(x, index, axis, value, name=None):
+    return apply_op("index_add", as_tensor(x), as_tensor(index),
+                    as_tensor(value), attrs=dict(axis=int(axis)))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = as_tensor(x)
+    idx = tuple(as_tensor(i)._value for i in indices)
+    v = as_tensor(value)._value
+    if accumulate:
+        out = x._value.at[idx].add(v)
+    else:
+        out = x._value.at[idx].set(v)
+    return Tensor(out)
+
+
+def masked_select(x, mask, name=None):
+    x, mask = as_tensor(x), as_tensor(mask)
+    # data-dependent shape: eager-only (static path must use where())
+    return Tensor(x._value[mask._value])
+
+
+register_op("masked_fill", lambda x, mask, value:
+            jnp.where(mask, jnp.asarray(value, x.dtype), x))
+
+
+def masked_fill(x, mask, value, name=None):
+    if isinstance(value, Tensor):
+        value = value.item()
+    return apply_op("masked_fill", as_tensor(x), as_tensor(mask),
+                    attrs=dict(value=float(value)))
+
+
+register_op("where", lambda cond, x, y: jnp.where(cond, x, y))
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = as_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply_op("where", condition, as_tensor(x), as_tensor(y))
+
+
+def nonzero(x, as_tuple=False):
+    x = as_tensor(x)
+    idx = jnp.nonzero(x._value)  # data-dependent: eager-only
+    if as_tuple:
+        return tuple(Tensor(i[:, None]) for i in idx)
+    return Tensor(jnp.stack(idx, axis=1).astype(np.int64))
+
+
+register_op("roll", lambda x, shifts=None, axis=None:
+            jnp.roll(x, shifts, axis=axis))
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = axis_attr(shifts)
+    ax = axis_attr(axis)
+    return apply_op("roll", as_tensor(x), attrs=dict(shifts=sh, axis=ax))
+
+
+register_op("flip", lambda x, axis=None: jnp.flip(x, axis=axis))
+
+
+def flip(x, axis, name=None):
+    return apply_op("flip", as_tensor(x), attrs=dict(axis=axis_attr(axis)))
+
+
+def reverse(x, axis, name=None):
+    return flip(x, axis)
+
+
+register_op("rot90", lambda x, k=1, axes=(0, 1): jnp.rot90(x, k=k, axes=axes))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op("rot90", as_tensor(x),
+                    attrs=dict(k=int(k), axes=tuple(axes)))
+
+
+register_op("unbind", lambda x, axis=0:
+            tuple(jnp.moveaxis(x, axis, 0)[i] for i in range(x.shape[axis])))
+
+
+def unbind(x, axis=0, name=None):
+    x = as_tensor(x)
+    out = apply_op("unbind", x, attrs=dict(axis=int(axis) % x.ndim))
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+register_op("repeat_interleave", lambda x, repeats=1, axis=None:
+            jnp.repeat(x, repeats, axis=axis))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = as_tensor(x)
+    if isinstance(repeats, Tensor):
+        return Tensor(jnp.repeat(x._value, repeats._value, axis=axis))
+    return apply_op("repeat_interleave", x,
+                    attrs=dict(repeats=int(repeats),
+                               axis=int(axis) if axis is not None else None))
+
+
+register_op("take_along_axis", lambda x, index, axis=0:
+            jnp.take_along_axis(x, index, axis=axis))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    arr, indices = as_tensor(arr), as_tensor(indices)
+    idx = indices._value
+    if broadcast:
+        dst = list(arr.shape)
+        dst[axis] = idx.shape[axis]
+        idx = jnp.broadcast_to(idx, dst)
+    return Tensor(jnp.take_along_axis(arr._value, idx, axis=axis))
+
+
+register_op("put_along_axis", lambda x, index, value, axis=0, reduce="assign":
+            x.at[tuple(
+                jnp.meshgrid(*[jnp.arange(s) for s in index.shape],
+                             indexing="ij")[:axis]
+            ) + (index,) + tuple(
+                jnp.meshgrid(*[jnp.arange(s) for s in index.shape],
+                             indexing="ij")[axis + 1:])].set(value)
+            if reduce == "assign" else
+            x.at[tuple(
+                jnp.meshgrid(*[jnp.arange(s) for s in index.shape],
+                             indexing="ij")[:axis]
+            ) + (index,) + tuple(
+                jnp.meshgrid(*[jnp.arange(s) for s in index.shape],
+                             indexing="ij")[axis + 1:])].add(value))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    arr, indices = as_tensor(arr), as_tensor(indices)
+    values = as_tensor(values) if isinstance(values, Tensor) else \
+        Tensor(jnp.broadcast_to(jnp.asarray(values, arr._value.dtype),
+                                indices._value.shape))
+    v = jnp.broadcast_to(values._value.astype(arr._value.dtype),
+                         indices._value.shape)
+    return apply_op("put_along_axis", arr, indices, Tensor(v),
+                    attrs=dict(axis=int(axis) % arr.ndim, reduce=reduce))
+
+
+def slice(input, axes, starts, ends, name=None):
+    input = as_tensor(input)
+    idx = [np.s_[:]] * input.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        s = int(s.item()) if isinstance(s, Tensor) else int(s)
+        e = int(e.item()) if isinstance(e, Tensor) else int(e)
+        idx[int(ax)] = np.s_[s:e]
+    return Tensor(input._value[tuple(idx)])
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = as_tensor(x)
+    idx = [np.s_[:]] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[int(ax)] = np.s_[int(s):int(e):int(st)]
+    return Tensor(x._value[tuple(idx)])
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = as_tensor(x)
+    shape = _shape_arg(shape)
+    offsets = _shape_arg(offsets) if offsets is not None else (0,) * x.ndim
+    shape = tuple(x.shape[i] - offsets[i] if s == -1 else s
+                  for i, s in enumerate(shape))
+    idx = tuple(np.s_[o:o + s] for o, s in zip(offsets, shape))
+    return Tensor(x._value[idx])
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    x = as_tensor(x)
+    res = jnp.unique(x._value, return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(res)
+    out = [Tensor(res[0])]
+    for r in res[1:]:
+        out.append(Tensor(r.astype(np.int64)))
+    return tuple(out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    x = as_tensor(x).numpy()
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    n = x.shape[axis]
+    keep = np.ones(n, dtype=bool)
+    sl = [np.s_[:]] * x.ndim
+    prev = None
+    groups = []
+    gid = np.zeros(n, dtype=np.int64)
+    g = -1
+    for i in range(n):
+        sl[axis] = i
+        cur = x[tuple(sl)]
+        if prev is None or not np.array_equal(cur, prev):
+            g += 1
+            groups.append(i)
+        else:
+            keep[i] = False
+        gid[i] = g
+        prev = cur
+    out_idx = np.asarray(groups)
+    out = np.take(x, out_idx, axis=axis)
+    res = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        res.append(Tensor(jnp.asarray(gid)))
+    if return_counts:
+        counts = np.bincount(gid)
+        res.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+register_op("sort", lambda x, axis=-1, descending=False:
+            -jnp.sort(-x, axis=axis) if descending else jnp.sort(x, axis=axis))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    return apply_op("sort", as_tensor(x),
+                    attrs=dict(axis=int(axis), descending=bool(descending)))
+
+
+register_op("argsort", lambda x, axis=-1, descending=False:
+            jnp.argsort(-x, axis=axis).astype(jnp.int64) if descending
+            else jnp.argsort(x, axis=axis).astype(jnp.int64), nondiff=True)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    return apply_op("argsort", as_tensor(x),
+                    attrs=dict(axis=int(axis), descending=bool(descending)))
+
+
+def _topk_fwd(x, k=1, axis=-1, largest=True):
+    axis = axis % x.ndim
+    xm = jnp.moveaxis(x, axis, -1)
+    if largest:
+        v, i = jax.lax.top_k(xm, k)
+    else:
+        v, i = jax.lax.top_k(-xm, k)
+        v = -v
+    return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis).astype(jnp.int64)
+
+
+register_op("topk", _topk_fwd)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = as_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    v, i = apply_op("topk", x, attrs=dict(k=int(k), axis=int(axis) % x.ndim,
+                                          largest=bool(largest)))
+    return v, i
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = as_tensor(x)
+    axis = int(axis) % x.ndim
+    v = jnp.sort(x._value, axis=axis)
+    i = jnp.argsort(x._value, axis=axis)
+    sl = [np.s_[:]] * x.ndim
+    sl[axis] = k - 1
+    vv, ii = v[tuple(sl)], i[tuple(sl)]
+    if keepdim:
+        vv, ii = jnp.expand_dims(vv, axis), jnp.expand_dims(ii, axis)
+    return Tensor(vv), Tensor(ii.astype(np.int64))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = as_tensor(x)
+    axis = int(axis) % x.ndim
+    xs = jnp.sort(x._value, axis=axis)
+    n = x.shape[axis]
+
+    def per_slice(v):
+        vals, counts = jnp.unique(v, return_counts=True, size=n,
+                                  fill_value=v[-1])
+        best = jnp.argmax(counts)
+        val = vals[best]
+        idx = jnp.max(jnp.where(v == val, jnp.arange(n), -1))
+        return val, idx
+    xm = jnp.moveaxis(x._value, axis, -1)
+    flat = xm.reshape(-1, n)
+    vals, idxs = jax.vmap(per_slice)(flat)
+    vals = vals.reshape(xm.shape[:-1])
+    idxs = idxs.reshape(xm.shape[:-1])
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idxs = jnp.expand_dims(idxs, axis)
+    return Tensor(vals), Tensor(idxs.astype(np.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    ss, v = as_tensor(sorted_sequence), as_tensor(values)
+    side = "right" if right else "left"
+    if ss.ndim == 1:
+        out = jnp.searchsorted(ss._value, v._value, side=side)
+    else:
+        out = jax.vmap(lambda s, val: jnp.searchsorted(s, val, side=side))(
+            ss._value.reshape(-1, ss.shape[-1]),
+            v._value.reshape(-1, v.shape[-1]))
+        out = out.reshape(v.shape)
+    return Tensor(out.astype(np.int32 if out_int32 else np.int64))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+register_op("moveaxis", lambda x, src=0, dst=0: jnp.moveaxis(x, src, dst))
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op("moveaxis", as_tensor(x),
+                    attrs=dict(src=axis_attr(source), dst=axis_attr(destination)))
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    x = as_tensor(x)
+    perm = list(range(x.ndim))
+    perm[axis0], perm[axis1] = perm[axis1], perm[axis0]
+    return transpose(x, perm)
+
+
+swapdims = swapaxes
+
+
+register_op("diagonal", lambda x, offset=0, axis1=0, axis2=1:
+            jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("diagonal", as_tensor(x),
+                    attrs=dict(offset=int(offset), axis1=int(axis1),
+                               axis2=int(axis2)))
+
+
+register_op("trace", lambda x, offset=0, axis1=0, axis2=1:
+            jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("trace", as_tensor(x),
+                    attrs=dict(offset=int(offset), axis1=int(axis1),
+                               axis2=int(axis2)))
+
+
+register_op("tensordot", lambda x, y, axes=2: jnp.tensordot(x, y, axes=axes))
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = ax.tolist()
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in ax)
+    return apply_op("tensordot", as_tensor(x), as_tensor(y),
+                    attrs=dict(axes=ax))
+
+
+register_op("kron", lambda x, y: jnp.kron(x, y))
+
+
+def kron(x, y, name=None):
+    return apply_op("kron", as_tensor(x), as_tensor(y))
+
+
+register_op("diff", lambda x, n=1, axis=-1: jnp.diff(x, n=n, axis=axis))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = as_tensor(x)
+    parts = []
+    if prepend is not None:
+        parts.append(as_tensor(prepend))
+    parts.append(x)
+    if append is not None:
+        parts.append(as_tensor(append))
+    if len(parts) > 1:
+        x = concat(parts, axis=axis)
+    return apply_op("diff", x, attrs=dict(n=int(n), axis=int(axis)))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = as_tensor(x)
+    w = as_tensor(weights)._value if weights is not None else None
+    n = int(max(int(jnp.max(x._value)) + 1 if x.size else 0, minlength))
+    out = jnp.bincount(x._value, weights=w, length=n)
+    return Tensor(out)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    x = as_tensor(input)
+    if min == 0 and max == 0:
+        mn, mx = float(jnp.min(x._value)), float(jnp.max(x._value))
+    else:
+        mn, mx = float(min), float(max)
+    hist, _ = jnp.histogram(x._value, bins=int(bins), range=(mn, mx))
+    return Tensor(hist.astype(np.int64))
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    x = as_tensor(x)
+    arr = np.lib.stride_tricks.as_strided(
+        np.asarray(x._value).reshape(-1)[offset:],
+        shape=shape, strides=[s * x._value.dtype.itemsize for s in stride])
+    return Tensor(jnp.asarray(arr.copy()))
+
+
+def view(x, shape_or_dtype, name=None):
+    x = as_tensor(x)
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return Tensor(x._value.view(dtypes.to_np_dtype(shape_or_dtype)))
+
+
+def view_as(x, other, name=None):
+    return reshape(x, as_tensor(other).shape)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [Tensor(jnp.atleast_1d(as_tensor(t)._value)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [Tensor(jnp.atleast_2d(as_tensor(t)._value)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [Tensor(jnp.atleast_3d(as_tensor(t)._value)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def select_scatter(x, values, axis, index, name=None):
+    x, values = as_tensor(x), as_tensor(values)
+    idx = [np.s_[:]] * x.ndim
+    idx[axis] = index
+    return Tensor(x._value.at[tuple(idx)].set(
+        values._value.astype(x._value.dtype)))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    input = as_tensor(input)
+    shard_size = (index_num + nshards - 1) // nshards
+    lo, hi = shard_id * shard_size, (shard_id + 1) * shard_size
+    v = input._value
+    out = jnp.where((v >= lo) & (v < hi), v - lo, ignore_value)
+    return Tensor(out)
+
+
+def tolist(x):
+    return as_tensor(x).tolist()
+
+
+# -- pad ---------------------------------------------------------------------
+register_op("pad", lambda x, paddings=None, mode="constant", value=0.0:
+            jnp.pad(x, paddings, mode=mode, constant_values=value)
+            if mode == "constant" else
+            jnp.pad(x, paddings,
+                    mode={"reflect": "reflect", "replicate": "edge",
+                          "circular": "wrap"}[mode]))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """paddle.nn.functional.pad semantics (reference:
+    python/paddle/nn/functional/common.py pad)."""
+    x = as_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # paddle "all-dim" form: [dim0_lo, dim0_hi, dim1_lo, ...]
+        pads = tuple((pad[2 * i], pad[2 * i + 1]) for i in range(nd))
+    else:
+        # NCHW-style form: pad applies to trailing spatial dims, given as
+        # [left, right, (top, bottom, (front, back))] over last dims
+        nspatial = len(pad) // 2
+        pads = [(0, 0)] * nd
+        if data_format in ("NCHW", "NCL", "NCDHW"):
+            spatial_axes = list(range(nd - nspatial, nd))
+        else:  # NHWC-style: spatial dims before channel
+            spatial_axes = list(range(1, 1 + nspatial))
+        # paddle orders pad pairs from last spatial dim backwards? No:
+        # paddle pad is [left,right,top,bottom,front,back] applying to
+        # W,H,D i.e. reversed spatial order
+        for i, ax in enumerate(reversed(spatial_axes)):
+            pads[ax] = (pad[2 * i], pad[2 * i + 1])
+        pads = tuple(pads)
+    return apply_op("pad", x, attrs=dict(paddings=pads, mode=mode,
+                                         value=float(value)))

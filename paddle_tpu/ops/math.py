@@ -1,0 +1,266 @@
+"""Elementwise & scalar math ops.
+
+TPU-native replacement for Paddle's elementwise/activation kernels
+(reference: paddle/fluid/operators/elementwise/, paddle/phi/kernels/
+{activation,elementwise}*). Every op is a pure jnp function dispatched
+through the cached-jit registry; XLA fuses chains of these into single
+VPU kernels, which subsumes Paddle's handwritten fused elementwise CUDA.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+from ._helpers import as_tensor, scalar_operand, axis_attr, apply_op
+
+_this = sys.modules[__name__]
+
+__all__ = []
+
+
+# -- generated unary ops -----------------------------------------------------
+_UNARY = {
+    "abs": jnp.abs, "neg": jnp.negative, "exp": jnp.exp, "expm1": jnp.expm1,
+    "log": jnp.log, "log2": jnp.log2, "log10": jnp.log10, "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt, "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "square": jnp.square, "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh, "acosh": jnp.arccosh, "atanh": jnp.arctanh,
+    "floor": jnp.floor, "ceil": jnp.ceil, "round": jnp.round,
+    "trunc": jnp.trunc, "frac": lambda x: x - jnp.trunc(x),
+    "sign": jnp.sign, "reciprocal": jnp.reciprocal,
+    "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "lgamma": jax.scipy.special.gammaln, "digamma": jax.scipy.special.digamma,
+    "i0": lambda x: jax.scipy.special.i0(x), "i0e": lambda x: jax.scipy.special.i0e(x),
+    "i1": lambda x: jax.scipy.special.i1(x), "i1e": lambda x: jax.scipy.special.i1e(x),
+    "sigmoid": jax.nn.sigmoid, "logsigmoid": jax.nn.log_sigmoid,
+    "angle": jnp.angle, "conj": jnp.conj, "real": jnp.real, "imag": jnp.imag,
+    "deg2rad": jnp.deg2rad, "rad2deg": jnp.rad2deg,
+}
+
+_NONDIFF_UNARY = {
+    "isnan": jnp.isnan, "isinf": jnp.isinf, "isfinite": jnp.isfinite,
+    "logical_not": jnp.logical_not, "bitwise_not": jnp.invert,
+}
+
+
+def _make_unary_api(opname):
+    def api(x, name=None):
+        return apply_op(opname, as_tensor(x))
+    api.__name__ = opname
+    return api
+
+
+for _name, _fn in _UNARY.items():
+    register_op(_name, (lambda f: (lambda x: f(x)))(_fn))
+    setattr(_this, _name, _make_unary_api(_name))
+    __all__.append(_name)
+
+for _name, _fn in _NONDIFF_UNARY.items():
+    register_op(_name, (lambda f: (lambda x: f(x)))(_fn), nondiff=True)
+    setattr(_this, _name, _make_unary_api(_name))
+    __all__.append(_name)
+
+
+# -- generated binary ops ----------------------------------------------------
+_BINARY = {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "divide": jnp.divide, "pow": jnp.power,
+    "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "fmax": jnp.fmax, "fmin": jnp.fmin, "atan2": jnp.arctan2,
+    "logaddexp": jnp.logaddexp, "nextafter": jnp.nextafter,
+    "copysign": jnp.copysign, "hypot": jnp.hypot,
+    "heaviside": jnp.heaviside, "ldexp": jnp.ldexp,
+    "gcd": jnp.gcd, "lcm": jnp.lcm,
+}
+
+_NONDIFF_BINARY = {
+    "floor_divide": jnp.floor_divide,
+    "logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+    "bitwise_and": jnp.bitwise_and, "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "left_shift": jnp.left_shift, "right_shift": jnp.right_shift,
+}
+
+
+def _make_binary_api(opname):
+    def api(x, y, name=None):
+        if isinstance(x, Tensor):
+            y = y if isinstance(y, Tensor) else scalar_operand(x, y)
+        elif isinstance(y, Tensor):
+            x = scalar_operand(y, x)
+        else:
+            x, y = as_tensor(x), as_tensor(y)
+        return apply_op(opname, x, y)
+    api.__name__ = opname
+    return api
+
+
+for _name, _fn in _BINARY.items():
+    register_op(_name, (lambda f: (lambda x, y: f(x, y)))(_fn))
+    setattr(_this, _name, _make_binary_api(_name))
+    __all__.append(_name)
+
+for _name, _fn in _NONDIFF_BINARY.items():
+    register_op(_name, (lambda f: (lambda x, y: f(x, y)))(_fn), nondiff=True)
+    setattr(_this, _name, _make_binary_api(_name))
+    __all__.append(_name)
+
+
+# -- mod / remainder (paddle semantics follow python %) ----------------------
+register_op("remainder", lambda x, y: jnp.remainder(x, y))
+register_op("fmod", lambda x, y: jnp.fmod(x, y))
+
+
+def remainder(x, y, name=None):
+    x = as_tensor(x)
+    y = scalar_operand(x, y) if not isinstance(y, Tensor) else y
+    return apply_op("remainder", x, y)
+
+
+def mod(x, y, name=None):
+    return remainder(x, y)
+
+
+def fmod(x, y, name=None):
+    x = as_tensor(x)
+    y = scalar_operand(x, y) if not isinstance(y, Tensor) else y
+    return apply_op("fmod", x, y)
+
+
+__all__ += ["remainder", "mod", "fmod"]
+
+
+# -- scale: paddle's fused a*x+b (reference: phi/kernels/scale_kernel.h) -----
+register_op("scale", lambda x, scale=1.0, bias=0.0, bias_after_scale=True:
+            x * jnp.asarray(scale, x.dtype) + jnp.asarray(bias, x.dtype)
+            if bias_after_scale
+            else (x + jnp.asarray(bias, x.dtype)) * jnp.asarray(scale, x.dtype))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = as_tensor(x)
+    if isinstance(scale, Tensor):
+        out = apply_op("multiply", x, cast(scale, x.dtype))
+        if bias:
+            out = add(out, bias)
+        return out
+    out = apply_op("scale", x, attrs=dict(scale=float(scale), bias=float(bias),
+                                          bias_after_scale=bool(bias_after_scale)))
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+__all__.append("scale")
+
+
+# -- clip --------------------------------------------------------------------
+register_op("clip", lambda x, min=None, max=None: jnp.clip(x, min, max))
+
+
+def clip(x, min=None, max=None, name=None):
+    x = as_tensor(x)
+    min = float(min) if min is not None and not isinstance(min, Tensor) else min
+    max = float(max) if max is not None and not isinstance(max, Tensor) else max
+    if isinstance(min, Tensor) or isinstance(max, Tensor):
+        out = x
+        if min is not None:
+            out = maximum(out, min)
+        if max is not None:
+            out = minimum(out, max)
+        return out
+    return apply_op("clip", x, attrs=dict(min=min, max=max))
+
+
+__all__.append("clip")
+
+
+# -- cast --------------------------------------------------------------------
+register_op("cast", lambda x, dtype=None: x.astype(dtype))
+
+
+def cast(x, dtype, name=None):
+    x = as_tensor(x)
+    np_dt = dtypes.to_np_dtype(dtype)
+    if np.dtype(x._value.dtype) == np_dt:
+        return x
+    return apply_op("cast", x, attrs=dict(dtype=np_dt.name))
+
+
+__all__.append("cast")
+
+
+# -- misc scalar math --------------------------------------------------------
+register_op("logit", lambda x, eps=None: jax.scipy.special.logit(
+    jnp.clip(x, eps, 1.0 - eps) if eps else x))
+
+
+def logit(x, eps=None, name=None):
+    return apply_op("logit", as_tensor(x),
+                    attrs=dict(eps=float(eps) if eps else None))
+
+
+register_op("nan_to_num", lambda x, nan=0.0, posinf=None, neginf=None:
+            jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op("nan_to_num", as_tensor(x),
+                    attrs=dict(nan=float(nan),
+                               posinf=float(posinf) if posinf is not None else None,
+                               neginf=float(neginf) if neginf is not None else None))
+
+
+register_op("lerp", lambda x, y, w: x + w * (y - x))
+
+
+def lerp(x, y, weight, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    if not isinstance(weight, Tensor):
+        weight = scalar_operand(x, float(weight))
+    return apply_op("lerp", x, y, weight)
+
+
+register_op("addmm", lambda inp, x, y, alpha=1.0, beta=1.0:
+            beta * inp + alpha * jnp.matmul(x, y))
+
+
+def addmm(input, x, y, alpha=1.0, beta=1.0, name=None):
+    return apply_op("addmm", as_tensor(input), as_tensor(x), as_tensor(y),
+                    attrs=dict(alpha=float(alpha), beta=float(beta)))
+
+
+register_op("stanh", lambda x, scale_a=0.67, scale_b=1.7159:
+            scale_b * jnp.tanh(scale_a * x))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op("stanh", as_tensor(x),
+                    attrs=dict(scale_a=float(scale_a), scale_b=float(scale_b)))
+
+
+register_op("multiplex", lambda index, *ins: jnp.stack(ins, 0)[
+    index[:, 0], jnp.arange(index.shape[0])])
+
+
+def multiplex(inputs, index, name=None):
+    index = as_tensor(index)
+    return apply_op("multiplex", index, *[as_tensor(i) for i in inputs])
+
+
+__all__ += ["logit", "nan_to_num", "lerp", "addmm", "stanh", "multiplex"]
+
+# re-exported names referenced above
+maximum = getattr(_this, "maximum")
+minimum = getattr(_this, "minimum")
+add = getattr(_this, "add")

@@ -1,0 +1,219 @@
+"""Reduction & scan ops.
+
+TPU-native replacement for paddle/fluid/operators/reduce_ops/ + PHI reduce
+kernels. XLA lowers these to tree reductions tiled for the VPU; fused with
+producers where profitable.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor, apply_op
+from ._helpers import as_tensor, axis_attr
+
+__all__ = [
+    "sum", "mean", "max", "min", "prod", "std", "var", "all", "any",
+    "amax", "amin", "argmax", "argmin", "logsumexp", "median", "nanmedian",
+    "quantile", "nanquantile", "nansum", "nanmean", "count_nonzero",
+    "cumsum", "cumprod", "cummax", "cummin", "logcumsumexp",
+]
+
+
+def _red(name, fn, nondiff=False):
+    register_op(name, lambda x, axis=None, keepdim=False:
+                fn(x, axis=axis, keepdims=keepdim), nondiff=nondiff)
+
+
+_red("reduce_sum", jnp.sum)
+_red("reduce_mean", jnp.mean)
+_red("reduce_max", jnp.max)
+_red("reduce_min", jnp.min)
+_red("reduce_prod", jnp.prod)
+_red("reduce_all", jnp.all, nondiff=True)
+_red("reduce_any", jnp.any, nondiff=True)
+_red("reduce_nansum", jnp.nansum)
+_red("reduce_nanmean", jnp.nanmean)
+_red("reduce_logsumexp", lambda x, axis=None, keepdims=False:
+     jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims))
+
+
+def _reduce_api(opname, int64_promote=False):
+    def api(x, axis=None, keepdim=False, name=None, dtype=None):
+        x = as_tensor(x)
+        from .math import cast
+        if dtype is not None:
+            x = cast(x, dtype)
+        elif int64_promote and np.dtype(x._value.dtype).kind in "iub":
+            x = cast(x, "int64")
+        return apply_op(opname, x, attrs=dict(axis=axis_attr(axis),
+                                              keepdim=bool(keepdim)))
+    return api
+
+
+sum = _reduce_api("reduce_sum", int64_promote=True)
+mean = _reduce_api("reduce_mean")
+prod = _reduce_api("reduce_prod", int64_promote=True)
+nansum = _reduce_api("reduce_nansum", int64_promote=True)
+nanmean = _reduce_api("reduce_nanmean")
+all = _reduce_api("reduce_all")
+any = _reduce_api("reduce_any")
+logsumexp = _reduce_api("reduce_logsumexp")
+amax = _reduce_api("reduce_max")
+amin = _reduce_api("reduce_min")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply_op("reduce_max", as_tensor(x),
+                    attrs=dict(axis=axis_attr(axis), keepdim=bool(keepdim)))
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply_op("reduce_min", as_tensor(x),
+                    attrs=dict(axis=axis_attr(axis), keepdim=bool(keepdim)))
+
+
+register_op("std", lambda x, axis=None, keepdim=False, ddof=1:
+            jnp.std(x, axis=axis, keepdims=keepdim, ddof=ddof))
+register_op("var", lambda x, axis=None, keepdim=False, ddof=1:
+            jnp.var(x, axis=axis, keepdims=keepdim, ddof=ddof))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op("std", as_tensor(x),
+                    attrs=dict(axis=axis_attr(axis), keepdim=bool(keepdim),
+                               ddof=1 if unbiased else 0))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op("var", as_tensor(x),
+                    attrs=dict(axis=axis_attr(axis), keepdim=bool(keepdim),
+                               ddof=1 if unbiased else 0))
+
+
+register_op("argmax", lambda x, axis=None, keepdim=False, dtype="int64":
+            jnp.argmax(x.reshape(-1) if axis is None else x,
+                       axis=None if axis is None else axis,
+                       keepdims=keepdim if axis is not None else False
+                       ).astype(dtype), nondiff=True)
+register_op("argmin", lambda x, axis=None, keepdim=False, dtype="int64":
+            jnp.argmin(x.reshape(-1) if axis is None else x,
+                       axis=None if axis is None else axis,
+                       keepdims=keepdim if axis is not None else False
+                       ).astype(dtype), nondiff=True)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import to_np_dtype
+    return apply_op("argmax", as_tensor(x),
+                    attrs=dict(axis=axis_attr(axis), keepdim=bool(keepdim),
+                               dtype=to_np_dtype(dtype).name))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import to_np_dtype
+    return apply_op("argmin", as_tensor(x),
+                    attrs=dict(axis=axis_attr(axis), keepdim=bool(keepdim),
+                               dtype=to_np_dtype(dtype).name))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = as_tensor(x)
+    v = jnp.median(x._value, axis=axis, keepdims=keepdim)
+    if mode == "min" and (x.size % 2 == 0):
+        v = jnp.quantile(x._value, 0.5, axis=axis, keepdims=keepdim,
+                         method="lower")
+    return Tensor(v)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.nanmedian(x._value, axis=axis, keepdims=keepdim))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    x = as_tensor(x)
+    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    return Tensor(jnp.quantile(x._value.astype(jnp.float32), qv, axis=axis,
+                               keepdims=keepdim, method=interpolation))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    x = as_tensor(x)
+    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    return Tensor(jnp.nanquantile(x._value.astype(jnp.float32), qv, axis=axis,
+                                  keepdims=keepdim, method=interpolation))
+
+
+register_op("count_nonzero", lambda x, axis=None, keepdim=False:
+            jnp.count_nonzero(x, axis=axis, keepdims=keepdim).astype(jnp.int64),
+            nondiff=True)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply_op("count_nonzero", as_tensor(x),
+                    attrs=dict(axis=axis_attr(axis), keepdim=bool(keepdim)))
+
+
+register_op("cumsum", lambda x, axis=None: jnp.cumsum(
+    x.reshape(-1) if axis is None else x, axis=0 if axis is None else axis))
+register_op("cumprod", lambda x, axis=None: jnp.cumprod(
+    x.reshape(-1) if axis is None else x, axis=0 if axis is None else axis))
+register_op("logcumsumexp", lambda x, axis=None:
+            jax.lax.cumlogsumexp(x.reshape(-1) if axis is None else x,
+                                 axis=0 if axis is None else axis))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = as_tensor(x)
+    if dtype is not None:
+        from .math import cast
+        x = cast(x, dtype)
+    return apply_op("cumsum", x, attrs=dict(axis=axis_attr(axis)))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = as_tensor(x)
+    if dtype is not None:
+        from .math import cast
+        x = cast(x, dtype)
+    return apply_op("cumprod", x, attrs=dict(axis=axis_attr(dim)))
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    x = as_tensor(x)
+    if dtype is not None:
+        from .math import cast
+        x = cast(x, dtype)
+    return apply_op("logcumsumexp", x, attrs=dict(axis=axis_attr(axis)))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = as_tensor(x)
+    v = x._value.reshape(-1) if axis is None else x._value
+    ax = 0 if axis is None else axis
+    vals = jax.lax.associative_scan(jnp.maximum, v, axis=ax)
+    n = v.shape[ax]
+    eq = v == vals
+
+    def scan_idx(carry, xs):
+        e, i = xs
+        idx = jnp.where(e, i, carry)
+        return idx, idx
+    im = jnp.moveaxis(eq, ax, 0)
+    iota = jnp.arange(n)
+    iotas = jnp.broadcast_to(iota.reshape((n,) + (1,) * (im.ndim - 1)),
+                             im.shape)
+    init = jnp.zeros(im.shape[1:], dtype=jnp.int64)
+    _, idxs = jax.lax.scan(scan_idx, init, (im, iotas.astype(jnp.int64)))
+    idxs = jnp.moveaxis(idxs, 0, ax)
+    return Tensor(vals), Tensor(idxs)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    neg, i = cummax(Tensor(-as_tensor(x)._value), axis, dtype)
+    return Tensor(-neg._value), i

@@ -1,0 +1,63 @@
+"""Flag system (reference: paddle/phi/core/flags.h PADDLE_DEFINE_EXPORTED_*,
+python/paddle/fluid/framework.py set_flags/get_flags).
+
+Flags are plain process-level key/values; FLAGS_* env vars seed them at
+import, mirroring __bootstrap__'s --tryfromenv.
+"""
+from __future__ import annotations
+
+import os
+
+_FLAGS: dict = {}
+
+_DEFAULTS = {
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_use_autotune": True,
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    "FLAGS_default_compute_dtype": "float32",
+}
+
+
+def _bootstrap():
+    for k, v in _DEFAULTS.items():
+        _FLAGS[k] = v
+    for k, v in os.environ.items():
+        if k.startswith("FLAGS_"):
+            _FLAGS[k] = _parse(v)
+
+
+def _parse(v: str):
+    low = v.lower()
+    if low in ("true", "1"):
+        return True
+    if low in ("false", "0"):
+        return False
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        pass
+    return v
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {f: _FLAGS.get(f) for f in flags}
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        _FLAGS[k] = v
+
+
+def get_flag(name, default=None):
+    return _FLAGS.get(name, default)
+
+
+_bootstrap()

@@ -1,0 +1,20 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX initializes.
+
+Mirrors the reference's strategy of testing device-independent plumbing on
+fake backends (SURVEY.md §4: fake_cpu_device.h, ProcessGroupGloo): all
+sharding/parallelism tests run on 8 virtual CPU devices so no TPU pod is
+needed.
+
+Note: the env var JAX_PLATFORMS is not enough on machines where an
+accelerator PJRT plugin overrides it — jax.config.update is authoritative.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

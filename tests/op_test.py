@@ -1,0 +1,76 @@
+"""OpTest-style base: numpy-reference forward check + numeric-vs-analytic
+gradient check (reference: python/paddle/fluid/tests/unittests/op_test.py:327
+check_output / check_grad with centered differences at :134).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def check_output(api_fn, np_fn, inputs, rtol=1e-5, atol=1e-6, **kwargs):
+    """Run api_fn(*tensors, **kwargs) and np_fn(*arrays, **kwargs), compare."""
+    tensors = [paddle.to_tensor(a) for a in inputs]
+    got = api_fn(*tensors, **kwargs)
+    want = np_fn(*inputs, **kwargs)
+    if not isinstance(got, (list, tuple)):
+        got, want = [got], [want]
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g.numpy(), np.asarray(w), rtol=rtol,
+                                   atol=atol)
+
+
+def numeric_grad(fn, inputs, idx, delta=5e-3):
+    """Centered-difference gradient of sum(fn(*inputs)) wrt inputs[idx]."""
+    x = inputs[idx].astype(np.float64)
+    grad = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+
+    def eval_sum(xv):
+        args = list(inputs)
+        args[idx] = xv.astype(inputs[idx].dtype)
+        out = fn(*args)
+        if isinstance(out, (list, tuple)):
+            return float(sum(np.asarray(o).astype(np.float64).sum() for o in out))
+        return float(np.asarray(out).astype(np.float64).sum())
+
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        hi = eval_sum(x)
+        flat[i] = orig - delta
+        lo = eval_sum(x)
+        flat[i] = orig
+        gflat[i] = (hi - lo) / (2 * delta)
+    return grad
+
+
+def check_grad(api_fn, inputs, grad_inputs=None, rtol=1e-2, atol=1e-3,
+               delta=5e-3, **kwargs):
+    """Compare tape gradients against centered differences."""
+    grad_inputs = grad_inputs if grad_inputs is not None else range(len(inputs))
+    tensors = [paddle.to_tensor(a, stop_gradient=False) for a in inputs]
+    out = api_fn(*tensors, **kwargs)
+    if isinstance(out, (list, tuple)):
+        loss = None
+        for o in out:
+            s = o.sum()
+            loss = s if loss is None else loss + s
+    else:
+        loss = out.sum()
+    loss.backward()
+
+    def np_eval(*arrays):
+        ts = [paddle.to_tensor(a) for a in arrays]
+        o = api_fn(*ts, **kwargs)
+        if isinstance(o, (list, tuple)):
+            return [v.numpy() for v in o]
+        return o.numpy()
+
+    for i in grad_inputs:
+        want = numeric_grad(np_eval, list(inputs), i, delta=delta)
+        got = tensors[i].grad.numpy().astype(np.float64)
+        np.testing.assert_allclose(got, want, rtol=rtol, atol=atol,
+                                   err_msg=f"grad mismatch for input {i}")
