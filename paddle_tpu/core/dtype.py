@@ -38,6 +38,16 @@ class DType:
     def __repr__(self):
         return f"paddle_tpu.{self.name}"
 
+    # singletons: copy/pickle resolve back through the registry
+    def __copy__(self):
+        return self
+
+    def __deepcopy__(self, memo):
+        return self
+
+    def __reduce__(self):
+        return (_lookup, (self.name,))
+
     def __hash__(self):
         return hash(self.name)
 
@@ -58,6 +68,10 @@ class DType:
     @property
     def itemsize(self):
         return self.np_dtype.itemsize
+
+
+def _lookup(name):
+    return DType._registry[name]
 
 
 dtype = DType

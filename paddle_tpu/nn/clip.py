@@ -1,0 +1,123 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py,
+python/paddle/fluid/clip.py). Called by Optimizer before the update; on
+TPU the global-norm reduction fuses with the update step under jit."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+    def _dygraph_clip(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._value, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            gv = g._value
+            norm = jnp.sqrt(jnp.sum(jnp.square(gv.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+            out.append((p, Tensor((gv * scale.astype(gv.dtype)))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """reference: fluid/clip.py ClipGradByGlobalNorm; under hybrid
+    parallelism the fleet optimizer allreduces the norm across mesh axes
+    (distributed/fleet wires that in)."""
+
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+        self.auto_skip_clip = auto_skip_clip
+
+    def global_norm(self, grads):
+        sq = [jnp.sum(jnp.square(g._value.astype(jnp.float32)))
+              for g in grads]
+        return jnp.sqrt(jnp.sum(jnp.stack(sq)))
+
+    def _dygraph_clip(self, params_grads):
+        clippable = [(p, g) for p, g in params_grads
+                     if g is not None and getattr(p, "need_clip", True)]
+        if not clippable:
+            return params_grads
+        gnorm = self.global_norm([g for _, g in clippable])
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor(g._value * scale.astype(g._value.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """torch-compat utility paddle also ships (nn/utils/clip_grad_norm_)."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(g._value)) for g in grads]))
+    else:
+        total = jnp.power(
+            jnp.sum(jnp.stack(
+                [jnp.sum(jnp.power(jnp.abs(g._value.astype(jnp.float32)),
+                                   norm_type)) for g in grads])),
+            1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._rebind(p.grad._value * scale.astype(p.grad._value.dtype))
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._rebind(jnp.clip(p.grad._value, -clip_value, clip_value))
+
+
+# legacy aliases (fluid.clip)
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm

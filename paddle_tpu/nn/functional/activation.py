@@ -1,0 +1,207 @@
+"""Activation functional ops.
+
+TPU-native replacement for Paddle's activation kernels (reference:
+paddle/phi/kernels/activation_kernel.h, python/paddle/nn/functional/
+activation.py). Pure jnp/jax.nn fns; XLA fuses them into neighbouring
+matmuls, replacing Paddle's handwritten fused-activation epilogues
+(fused_gemm_epilogue_op.cu).
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import register_op
+from ...ops._helpers import as_tensor, apply_op
+
+_this = sys.modules[__name__]
+
+__all__ = []
+
+
+def _simple(op_name, fwd, n_attrs=()):
+    register_op(op_name, fwd)
+
+    def api(x, *args, name=None, **kw):
+        attrs = {}
+        for i, a in enumerate(n_attrs):
+            if i < len(args):
+                attrs[a[0]] = a[1](args[i])
+            elif a[0] in kw:
+                attrs[a[0]] = a[1](kw[a[0]])
+            else:
+                attrs[a[0]] = a[2]
+        return apply_op(op_name, as_tensor(x), attrs=attrs)
+    api.__name__ = op_name
+    setattr(_this, op_name, api)
+    __all__.append(op_name)
+    return api
+
+
+_simple("relu", lambda x: jax.nn.relu(x))
+_simple("relu6", lambda x: jnp.clip(x, 0, 6))
+_simple("relu_", lambda x: jax.nn.relu(x))
+_simple("sigmoid", lambda x: jax.nn.sigmoid(x))
+_simple("tanh", lambda x: jnp.tanh(x))
+_simple("silu", lambda x: jax.nn.silu(x))
+_simple("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+_simple("tanhshrink", lambda x: x - jnp.tanh(x))
+_simple("softsign", lambda x: jax.nn.soft_sign(x))
+_simple("log_sigmoid", lambda x: jax.nn.log_sigmoid(x))
+_simple("gelu", lambda x, approximate: jax.nn.gelu(x, approximate=approximate),
+        [("approximate", bool, False)])
+_simple("leaky_relu", lambda x, negative_slope:
+        jax.nn.leaky_relu(x, negative_slope),
+        [("negative_slope", float, 0.01)])
+_simple("elu", lambda x, alpha: jax.nn.elu(x, alpha), [("alpha", float, 1.0)])
+_simple("elu_", lambda x, alpha: jax.nn.elu(x, alpha), [("alpha", float, 1.0)])
+_simple("celu", lambda x, alpha: jax.nn.celu(x, alpha), [("alpha", float, 1.0)])
+_simple("selu", lambda x, scale, alpha:
+        scale * jnp.where(x > 0, x, alpha * jnp.expm1(x)),
+        [("scale", float, 1.0507009873554805),
+         ("alpha", float, 1.6732632423543772)])
+_simple("softplus", lambda x, beta, threshold:
+        jnp.where(x * beta > threshold, x,
+                  (1.0 / beta) * jnp.logaddexp(beta * x, 0.0)),
+        [("beta", float, 1.0), ("threshold", float, 20.0)])
+_simple("hardtanh", lambda x, min, max: jnp.clip(x, min, max),
+        [("min", float, -1.0), ("max", float, 1.0)])
+_simple("hardsigmoid", lambda x, slope, offset:
+        jnp.clip(slope * x + offset, 0.0, 1.0),
+        [("slope", float, 1.0 / 6), ("offset", float, 0.5)])
+_simple("hardswish", lambda x: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0)
+_simple("hardshrink", lambda x, threshold:
+        jnp.where(jnp.abs(x) > threshold, x, 0.0),
+        [("threshold", float, 0.5)])
+_simple("softshrink", lambda x, threshold:
+        jnp.where(x > threshold, x - threshold,
+                  jnp.where(x < -threshold, x + threshold, 0.0)),
+        [("threshold", float, 0.5)])
+_simple("thresholded_relu", lambda x, threshold:
+        jnp.where(x > threshold, x, 0.0), [("threshold", float, 1.0)])
+_simple("swish", lambda x: jax.nn.silu(x))
+
+
+def _softmax_fwd(x, axis):
+    return jax.nn.softmax(x, axis=axis)
+
+
+register_op("softmax", _softmax_fwd)
+register_op("log_softmax", lambda x, axis: jax.nn.log_softmax(x, axis=axis))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = as_tensor(x)
+    if dtype is not None:
+        from ...ops import math as math_ops
+        x = math_ops.cast(x, dtype)
+    return apply_op("softmax", x, attrs=dict(axis=int(axis)))
+
+
+softmax_ = softmax
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = as_tensor(x)
+    if dtype is not None:
+        from ...ops import math as math_ops
+        x = math_ops.cast(x, dtype)
+    return apply_op("log_softmax", x, attrs=dict(axis=int(axis)))
+
+
+__all__ += ["softmax", "softmax_", "log_softmax", "prelu", "rrelu", "maxout",
+            "glu", "gumbel_softmax", "temperature_softmax"]
+
+
+register_op("prelu_op", lambda x, w, c_axis:
+            jnp.where(x > 0, x, x * _prelu_bcast(w, x, c_axis)))
+
+
+def _prelu_bcast(w, x, c_axis):
+    if w.size == 1:
+        return w.reshape(())
+    shape = [1] * x.ndim
+    shape[c_axis] = -1
+    return w.reshape(shape)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x = as_tensor(x)
+    c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    return apply_op("prelu_op", x, as_tensor(weight),
+                    attrs=dict(c_axis=c_axis))
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    from ...core import random as random_mod
+    x = as_tensor(x)
+    if not training:
+        return apply_op("leaky_relu", x,
+                        attrs=dict(negative_slope=(lower + upper) / 2))
+    from ...core.tensor import Tensor
+    key = Tensor(random_mod.next_key())
+    return apply_op("rrelu_train", x, key,
+                    attrs=dict(lower=float(lower), upper=float(upper)))
+
+
+register_op("rrelu_train", lambda x, key, lower, upper:
+            jnp.where(x >= 0, x, x * jax.random.uniform(
+                key, x.shape, minval=lower, maxval=upper, dtype=x.dtype)))
+
+
+register_op("maxout_op", lambda x, groups, c_axis: _maxout_fwd(x, groups, c_axis))
+
+
+def _maxout_fwd(x, groups, c_axis):
+    c = x.shape[c_axis]
+    new_shape = list(x.shape)
+    new_shape[c_axis:c_axis + 1] = [c // groups, groups]
+    return x.reshape(new_shape).max(axis=c_axis + 1)
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = as_tensor(x)
+    axis = axis if axis >= 0 else x.ndim + axis
+    return apply_op("maxout_op", x, attrs=dict(groups=int(groups),
+                                               c_axis=int(axis)))
+
+
+register_op("glu_op", lambda x, axis: jax.nn.glu(x, axis=axis))
+
+
+def glu(x, axis=-1, name=None):
+    return apply_op("glu_op", as_tensor(x), attrs=dict(axis=int(axis)))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import random as random_mod
+    from ...core.tensor import Tensor
+    x = as_tensor(x)
+    key = Tensor(random_mod.next_key())
+    return apply_op("gumbel_softmax_op", x, key,
+                    attrs=dict(temperature=float(temperature),
+                               hard=bool(hard), axis=int(axis)))
+
+
+def _gumbel_fwd(x, key, temperature, hard, axis):
+    g = jax.random.gumbel(key, x.shape, dtype=x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        onehot = jnp.zeros_like(y)
+        onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis,
+                                    inplace=False)
+        y = onehot + y - jax.lax.stop_gradient(y)
+    return y
+
+
+register_op("gumbel_softmax_op", _gumbel_fwd)
+
+
+def temperature_softmax(x, temperature=1.0, axis=-1, name=None):
+    """softmax(x / T) — convenience for inference sampling."""
+    from ...ops import math as math_ops
+    return softmax(math_ops.scale(as_tensor(x), 1.0 / temperature), axis=axis)

@@ -1,0 +1,113 @@
+"""Attention functional ops.
+
+TPU-native replacement for Paddle's fused attention CUDA
+(reference: paddle/fluid/operators/fused/fused_attention_op.cu, fmha_ref.h,
+python/paddle/nn/functional/flash_attention.py in later snapshots).
+The reference hand-fuses QKV+FMHA+proj per CUDA arch; here one pure
+function lowers to XLA (which fuses the softmax chain), and on TPU the
+inner attention is swapped for a Pallas flash-attention kernel
+(paddle_tpu/ops/pallas/flash_attention.py) with identical semantics.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import register_op
+from ...core.tensor import Tensor
+from ...core import random as random_mod
+from ...ops._helpers import as_tensor, apply_op
+
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "sparse_attention"]
+
+
+def _use_pallas(q_len, head_dim):
+    import jax
+    try:
+        plat = jax.devices()[0].platform
+    except Exception:
+        plat = "cpu"
+    return plat == "tpu" and q_len >= 128 and head_dim in (64, 128, 256)
+
+
+def _sdpa_ref(q, k, v, mask, causal, scale, dropout_p, key):
+    """Reference attention: [B, L, H, D] layout (paddle convention)."""
+    dt = q.dtype
+    logits = jnp.einsum("blhd,bmhd->bhlm", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    if causal:
+        L, M = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((L, M), dtype=bool), M - L)
+        logits = jnp.where(cm, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1).astype(dt)
+    if dropout_p > 0.0 and key is not None:
+        keep = 1.0 - dropout_p
+        m = jax.random.bernoulli(key, keep, probs.shape)
+        probs = jnp.where(m, probs / keep, 0.0).astype(dt)
+    return jnp.einsum("bhlm,bmhd->blhd", probs, v)
+
+
+def _sdpa_fwd(q, k, v, causal, scale, dropout_p):
+    if _use_pallas(q.shape[1], q.shape[3]) and dropout_p == 0.0:
+        from ...ops.pallas.flash_attention import flash_attention_blhd
+        return flash_attention_blhd(q, k, v, causal=causal, scale=scale)
+    return _sdpa_ref(q, k, v, None, causal, scale, dropout_p, None)
+
+
+register_op("sdpa", _sdpa_fwd)
+register_op("sdpa_mask",
+            lambda q, k, v, mask, causal, scale, dropout_p:
+            _sdpa_ref(q, k, v, mask, causal, scale, dropout_p, None))
+register_op("sdpa_dropout",
+            lambda q, k, v, key, causal, scale, dropout_p:
+            _sdpa_ref(q, k, v, None, causal, scale, dropout_p, key))
+register_op("sdpa_mask_dropout",
+            lambda q, k, v, mask, key, causal, scale, dropout_p:
+            _sdpa_ref(q, k, v, mask, causal, scale, dropout_p, key))
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Inputs [batch, seq, num_heads, head_dim] (paddle layout)."""
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    p = float(dropout_p) if training else 0.0
+    attrs = dict(causal=bool(is_causal), scale=scale, dropout_p=p)
+    if attn_mask is None and p == 0.0:
+        return apply_op("sdpa", q, k, v, attrs=attrs)
+    if attn_mask is None:
+        rk = Tensor(random_mod.next_key())
+        return apply_op("sdpa_dropout", q, k, v, rk, attrs=attrs)
+    m = as_tensor(attn_mask)
+    if p == 0.0:
+        return apply_op("sdpa_mask", q, k, v, m, attrs=attrs)
+    rk = Tensor(random_mod.next_key())
+    return apply_op("sdpa_mask_dropout", q, k, v, m, rk, attrs=attrs)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """paddle.nn.functional.flash_attention parity; returns (out, None)."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def sparse_attention(*args, **kwargs):
+    raise NotImplementedError(
+        "block-sparse attention: planned as a Pallas kernel "
+        "(reference: python/paddle/nn/functional/sparse_attention.py)")

@@ -1,0 +1,488 @@
+"""Common functional ops: linear, dropout, embedding, padding, interpolate.
+
+TPU-native replacement for python/paddle/nn/functional/common.py and the
+matching PHI kernels. Dropout takes an explicit threefry key input (kept
+pure so it works identically under eager and pjit tracing — the reference's
+stateful per-device Philox generator has no TPU analogue).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import random as random_mod
+from ...core.dispatch import register_op
+from ...core.tensor import Tensor
+from ...ops._helpers import as_tensor, apply_op
+
+__all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+           "embedding", "one_hot", "pad", "zeropad2d", "interpolate",
+           "upsample", "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
+           "cosine_similarity", "bilinear", "label_smooth", "unfold", "fold",
+           "class_center_sample", "linear_bias"]
+
+
+# -- linear ------------------------------------------------------------------
+
+register_op("linear", lambda x, w: jnp.matmul(x, w))
+register_op("linear_bias", lambda x, w, b: jnp.matmul(x, w) + b)
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b). Weight is [in, out] (paddle convention).
+
+    Lowered as one dot_general (+fused add) on the MXU; replaces the
+    cuBLASLt epilogue path (fused_gemm_epilogue_op.cu) for free via XLA.
+    """
+    x, weight = as_tensor(x), as_tensor(weight)
+    if bias is None:
+        return apply_op("linear", x, weight)
+    return apply_op("linear_bias", x, weight, as_tensor(bias))
+
+
+def linear_bias(x, weight, bias):
+    return linear(x, weight, bias)
+
+
+# -- dropout -----------------------------------------------------------------
+
+def _dropout_fwd(x, key, p, upscale):
+    if p == 0.0:
+        return x
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if upscale:
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+def _dropout_axis_fwd(x, key, p, upscale, mask_shape):
+    if p == 0.0:
+        return x
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, mask_shape)
+    mask = jnp.broadcast_to(mask, x.shape)
+    if upscale:
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+register_op("dropout", _dropout_fwd)
+register_op("dropout_axis", _dropout_axis_fwd)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    x = as_tensor(x)
+    p = float(p)
+    if not training:
+        if mode == "upscale_in_train":
+            return x
+        from ...ops import math as math_ops
+        return math_ops.scale(x, 1.0 - p)
+    if p == 0.0:
+        return x
+    upscale = mode == "upscale_in_train"
+    key = Tensor(random_mod.next_key())
+    if axis is None:
+        return apply_op("dropout", x, key, attrs=dict(p=p, upscale=upscale))
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    mask_shape = tuple(x.shape[i] if i in axes else 1 for i in range(x.ndim))
+    return apply_op("dropout_axis", x, key,
+                    attrs=dict(p=p, upscale=upscale, mask_shape=mask_shape))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    x = as_tensor(x)
+    if data_format.startswith("NC"):
+        return dropout(x, p, axis=[0, 1], training=training)
+    return dropout(x, p, axis=[0, 3], training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    x = as_tensor(x)
+    if data_format.startswith("NC"):
+        return dropout(x, p, axis=[0, 1], training=training)
+    return dropout(x, p, axis=[0, 4], training=training)
+
+
+def _alpha_dropout_fwd(x, key, p):
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = 1.0 - p
+    a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return (a * jnp.where(mask, x, alpha_p) + b).astype(x.dtype)
+
+
+register_op("alpha_dropout", _alpha_dropout_fwd)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = as_tensor(x)
+    if not training or p == 0.0:
+        return x
+    key = Tensor(random_mod.next_key())
+    return apply_op("alpha_dropout", x, key, attrs=dict(p=float(p)))
+
+
+# -- embedding ---------------------------------------------------------------
+
+def _embedding_fwd(ids, w, padding_idx):
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids != padding_idx)[..., None]
+        out = jnp.where(mask, out, 0.0)
+    return out
+
+
+register_op("embedding", _embedding_fwd)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Vocab lookup = gather from the [V, D] table; `sparse` is accepted for
+    API parity but meaningless under XLA (grads are dense scatter-adds,
+    reference: paddle/fluid/operators/lookup_table_v2_op.cu)."""
+    x, weight = as_tensor(x), as_tensor(weight)
+    if padding_idx is not None:
+        padding_idx = int(padding_idx)
+        if padding_idx < 0:
+            padding_idx += weight.shape[0]
+    return apply_op("embedding", x, weight,
+                    attrs=dict(padding_idx=padding_idx))
+
+
+register_op("one_hot_op",
+            lambda x, num_classes: jax.nn.one_hot(x, num_classes),
+            nondiff=False)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op("one_hot_op", as_tensor(x),
+                    attrs=dict(num_classes=int(num_classes)))
+
+
+# -- padding -----------------------------------------------------------------
+
+def _pad_nd_fwd(x, pad_pairs, mode, value):
+    if mode == "constant":
+        return jnp.pad(x, pad_pairs, mode="constant", constant_values=value)
+    if mode == "reflect":
+        return jnp.pad(x, pad_pairs, mode="reflect")
+    if mode == "replicate":
+        return jnp.pad(x, pad_pairs, mode="edge")
+    if mode == "circular":
+        return jnp.pad(x, pad_pairs, mode="wrap")
+    raise ValueError(f"Unknown pad mode {mode}")
+
+
+register_op("pad_nd", _pad_nd_fwd)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None,
+        pad_from_left_axis=True):
+    x = as_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full-tensor pad, paddle semantics: [lo0, hi0, lo1, hi1, ...] when
+        # pad_from_left_axis else reversed-from-last like torch
+        if pad_from_left_axis:
+            pairs = tuple((pad[2 * i], pad[2 * i + 1]) for i in range(nd))
+        else:
+            pairs = tuple((pad[2 * (nd - 1 - i)], pad[2 * (nd - 1 - i) + 1])
+                          for i in range(nd))
+    else:
+        # spatial-only pad in data_format order: [l, r(, t, b)(, f, bk)]
+        n_sp = len(pad) // 2
+        channel_last = not data_format.startswith("NC")
+        sp_axes = (list(range(1, 1 + n_sp)) if channel_last
+                   else list(range(2, 2 + n_sp)))
+        # paddle orders spatial pads from the last axis group: for NCHW pad
+        # is [left,right,top,bottom] = W then H
+        pairs_l = [(0, 0)] * nd
+        for i, ax in enumerate(reversed(sp_axes)):
+            pairs_l[ax] = (pad[2 * i], pad[2 * i + 1])
+        pairs = tuple(pairs_l)
+    return apply_op("pad_nd", x, attrs=dict(pad_pairs=pairs, mode=mode,
+                                            value=float(value)))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+# -- interpolate -------------------------------------------------------------
+
+def _interp_fwd(x, out_sizes, mode, align_corners, channel_last):
+    n_sp = len(out_sizes)
+    if channel_last:
+        sp_axes = tuple(range(1, 1 + n_sp))
+    else:
+        sp_axes = tuple(range(2, 2 + n_sp))
+    method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+              "trilinear": "linear", "bicubic": "cubic",
+              "area": "linear"}[mode]
+    if align_corners and method != "nearest":
+        # jax.image has no align_corners; do it per-axis with explicit
+        # gather-weights
+        return _align_corners_resize(x, out_sizes, sp_axes, method)
+    new_shape = list(x.shape)
+    for ax, s in zip(sp_axes, out_sizes):
+        new_shape[ax] = s
+    return jax.image.resize(x, tuple(new_shape), method=method)
+
+
+def _align_corners_resize(x, out_sizes, sp_axes, method):
+    out = x
+    for ax, o in zip(sp_axes, out_sizes):
+        i = out.shape[ax]
+        if o == 1 or i == 1:
+            idx = jnp.zeros((o,), dtype=jnp.int32)
+            out = jnp.take(out, idx, axis=ax)
+            continue
+        pos = jnp.linspace(0.0, i - 1.0, o)
+        if method == "nearest":
+            idx = jnp.round(pos).astype(jnp.int32)
+            out = jnp.take(out, idx, axis=ax)
+        else:
+            lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, i - 1)
+            hi = jnp.clip(lo + 1, 0, i - 1)
+            w = (pos - lo).astype(out.dtype)
+            shape = [1] * out.ndim
+            shape[ax] = o
+            w = w.reshape(shape)
+            out = jnp.take(out, lo, axis=ax) * (1 - w) + \
+                jnp.take(out, hi, axis=ax) * w
+    return out
+
+
+register_op("interpolate", _interp_fwd)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    x = as_tensor(x)
+    channel_last = not data_format.startswith("NC")
+    n_sp = x.ndim - 2
+    if channel_last:
+        spatial = x.shape[1:1 + n_sp]
+    else:
+        spatial = x.shape[2:2 + n_sp]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        if isinstance(size, (int, np.integer)):
+            size = [int(size)] * n_sp
+        out_sizes = tuple(int(s) for s in size)
+    else:
+        if scale_factor is None:
+            raise ValueError("one of size/scale_factor required")
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * n_sp
+        out_sizes = tuple(int(spatial[i] * scale_factor[i])
+                          for i in range(n_sp))
+    return apply_op("interpolate", x,
+                    attrs=dict(out_sizes=out_sizes, mode=mode,
+                               align_corners=bool(align_corners),
+                               channel_last=channel_last))
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+# -- pixel shuffle et al -----------------------------------------------------
+
+def _pixel_shuffle_fwd(x, r, channel_last):
+    if channel_last:
+        n, h, w, c = x.shape
+        x = x.reshape(n, h, w, r, r, c // (r * r))
+        x = x.transpose(0, 1, 3, 2, 4, 5)
+        return x.reshape(n, h * r, w * r, c // (r * r))
+    n, c, h, w = x.shape
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = x.transpose(0, 1, 4, 2, 5, 3)
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+def _pixel_unshuffle_fwd(x, r, channel_last):
+    if channel_last:
+        n, h, w, c = x.shape
+        x = x.reshape(n, h // r, r, w // r, r, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5)
+        return x.reshape(n, h // r, w // r, c * r * r)
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    x = x.transpose(0, 1, 3, 5, 2, 4)
+    return x.reshape(n, c * r * r, h // r, w // r)
+
+
+register_op("pixel_shuffle", _pixel_shuffle_fwd)
+register_op("pixel_unshuffle", _pixel_unshuffle_fwd)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return apply_op("pixel_shuffle", as_tensor(x),
+                    attrs=dict(r=int(upscale_factor),
+                               channel_last=not data_format.startswith("NC")))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return apply_op("pixel_unshuffle", as_tensor(x),
+                    attrs=dict(r=int(downscale_factor),
+                               channel_last=not data_format.startswith("NC")))
+
+
+def _channel_shuffle_fwd(x, groups, channel_last):
+    if channel_last:
+        n, h, w, c = x.shape
+        x = x.reshape(n, h, w, groups, c // groups)
+        x = jnp.swapaxes(x, 3, 4)
+        return x.reshape(n, h, w, c)
+    n, c, h, w = x.shape
+    x = x.reshape(n, groups, c // groups, h, w)
+    x = jnp.swapaxes(x, 1, 2)
+    return x.reshape(n, c, h, w)
+
+
+register_op("channel_shuffle", _channel_shuffle_fwd)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    return apply_op("channel_shuffle", as_tensor(x),
+                    attrs=dict(groups=int(groups),
+                               channel_last=not data_format.startswith("NC")))
+
+
+# -- similarity / misc -------------------------------------------------------
+
+register_op("cosine_similarity_op",
+            lambda x1, x2, axis, eps:
+            jnp.sum(x1 * x2, axis=axis) /
+            jnp.maximum(jnp.linalg.norm(x1, axis=axis) *
+                        jnp.linalg.norm(x2, axis=axis), eps))
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return apply_op("cosine_similarity_op", as_tensor(x1), as_tensor(x2),
+                    attrs=dict(axis=int(axis), eps=float(eps)))
+
+
+register_op("bilinear_op",
+            lambda x1, x2, w: jnp.einsum("bi,oij,bj->bo", x1, w, x2))
+register_op("bilinear_bias_op",
+            lambda x1, x2, w, b: jnp.einsum("bi,oij,bj->bo", x1, w, x2) + b)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    x1, x2, weight = as_tensor(x1), as_tensor(x2), as_tensor(weight)
+    if bias is None:
+        return apply_op("bilinear_op", x1, x2, weight)
+    return apply_op("bilinear_bias_op", x1, x2, weight, as_tensor(bias))
+
+
+register_op("label_smooth_op",
+            lambda label, epsilon: (1.0 - epsilon) * label +
+            epsilon / label.shape[-1])
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = as_tensor(label)
+    if prior_dist is not None:
+        prior_dist = as_tensor(prior_dist)
+        return apply_op("label_smooth_prior_op", label, prior_dist,
+                        attrs=dict(epsilon=float(epsilon)))
+    return apply_op("label_smooth_op", label,
+                    attrs=dict(epsilon=float(epsilon)))
+
+
+register_op("label_smooth_prior_op",
+            lambda label, prior, epsilon:
+            (1.0 - epsilon) * label + epsilon * prior)
+
+
+# -- unfold / fold (im2col) --------------------------------------------------
+
+def _unfold_fwd(x, kernel, stride, padding, dilation):
+    n, c, h, w = x.shape
+    kh, kw = kernel
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=(kh, kw), window_strides=stride,
+        padding=[tuple(p) for p in padding], rhs_dilation=dilation,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: [N, C*kh*kw, oh, ow] -> [N, C*kh*kw, L]
+    return patches.reshape(n, c * kh * kw, -1)
+
+
+register_op("unfold_op", _unfold_fwd)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from .conv import _norm_tuple, _norm_padding
+    x = as_tensor(x)
+    kernel = _norm_tuple(kernel_sizes, 2, "kernel_sizes")
+    stride = _norm_tuple(strides, 2, "strides")
+    dilation = _norm_tuple(dilations, 2, "dilations")
+    padding = _norm_padding(paddings, 2, "NCHW")
+    return apply_op("unfold_op", x,
+                    attrs=dict(kernel=kernel, stride=stride, padding=padding,
+                               dilation=dilation))
+
+
+def _fold_fwd(x, output_sizes, kernel, stride, padding, dilation):
+    n, ckk, l = x.shape
+    kh, kw = kernel
+    c = ckk // (kh * kw)
+    oh, ow = output_sizes
+    # number of sliding positions
+    eff_kh = (kh - 1) * dilation[0] + 1
+    eff_kw = (kw - 1) * dilation[1] + 1
+    nh = (oh + padding[0][0] + padding[0][1] - eff_kh) // stride[0] + 1
+    nw = (ow + padding[1][0] + padding[1][1] - eff_kw) // stride[1] + 1
+    cols = x.reshape(n, c, kh, kw, nh, nw)
+    out = jnp.zeros((n, c, oh + padding[0][0] + padding[0][1],
+                     ow + padding[1][0] + padding[1][1]), dtype=x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            hi = i * dilation[0]
+            wj = j * dilation[1]
+            out = out.at[:, :, hi:hi + nh * stride[0]:stride[0],
+                         wj:wj + nw * stride[1]:stride[1]].add(
+                cols[:, :, i, j])
+    return out[:, :, padding[0][0]:padding[0][0] + oh,
+               padding[1][0]:padding[1][0] + ow]
+
+
+register_op("fold_op", _fold_fwd)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    from .conv import _norm_tuple, _norm_padding
+    x = as_tensor(x)
+    out_sizes = _norm_tuple(output_sizes, 2, "output_sizes")
+    kernel = _norm_tuple(kernel_sizes, 2, "kernel_sizes")
+    stride = _norm_tuple(strides, 2, "strides")
+    dilation = _norm_tuple(dilations, 2, "dilations")
+    padding = _norm_padding(paddings, 2, "NCHW")
+    return apply_op("fold_op", x,
+                    attrs=dict(output_sizes=out_sizes, kernel=kernel,
+                               stride=stride, padding=padding,
+                               dilation=dilation))
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError(
+        "class_center_sample (PartialFC) lands with the distributed "
+        "margin-loss work")

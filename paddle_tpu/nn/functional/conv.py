@@ -1,0 +1,236 @@
+"""Convolution functional ops.
+
+TPU-native replacement for Paddle's conv operators (reference:
+paddle/phi/kernels/gpu/conv_kernel.cu, python/paddle/nn/functional/conv.py).
+All convs lower to a single `lax.conv_general_dilated` HLO, which XLA tiles
+onto the MXU — there is no algo-selection/cuDNN layer to port; layout
+(NCHW/NHWC) is a dimension-numbers annotation, not a data movement.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import register_op
+from ...ops._helpers import as_tensor, apply_op
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _norm_tuple(v, n, name="value"):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    v = tuple(int(x) for x in v)
+    if len(v) == 1:
+        return v * n
+    if len(v) != n:
+        raise ValueError(f"{name} must have length {n}, got {v}")
+    return v
+
+
+def _norm_padding(padding, n, data_format):
+    """Normalize paddle padding forms to lax [(lo,hi)] pairs or string."""
+    if isinstance(padding, str):
+        p = padding.upper()
+        if p in ("SAME", "VALID"):
+            return p
+        raise ValueError(f"Unknown padding mode {padding}")
+    if isinstance(padding, (int, np.integer)):
+        return tuple((int(padding), int(padding)) for _ in range(n))
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return tuple((int(p), int(p)) for p in padding)
+    if len(padding) == 2 * n:
+        it = iter(int(p) for p in padding)
+        return tuple((next(it), next(it)) for _ in range(n))
+    # paddle also allows [[0,0],[0,0],[lo,hi],...] in data_format order
+    if len(padding) == n + 2 and all(
+            isinstance(p, (list, tuple)) for p in padding):
+        if data_format.startswith("NC"):
+            sp = padding[2:]
+        else:
+            sp = padding[1:-1]
+        return tuple((int(lo), int(hi)) for lo, hi in sp)
+    if all(isinstance(p, (list, tuple)) for p in padding) and len(padding) == n:
+        return tuple((int(lo), int(hi)) for lo, hi in padding)
+    raise ValueError(f"Bad padding spec: {padding}")
+
+
+def _dim_numbers(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return (("NHWC", "HWIO", "NHWC") if channel_last
+                else ("NCHW", "OIHW", "NCHW"))
+    return (("NDHWC", "DHWIO", "NDHWC") if channel_last
+            else ("NCDHW", "OIDHW", "NCDHW"))
+
+
+def _conv_fwd(x, w, stride, padding, dilation, groups, channel_last, n):
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    _dim_numbers(n, channel_last))
+    return lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None)
+
+
+def _bias_reshape(b, n, channel_last):
+    if channel_last:
+        return b
+    return b.reshape((-1,) + (1,) * n)
+
+
+for _n in (1, 2, 3):
+    def _make(n):
+        def fwd(x, w, stride, padding, dilation, groups, channel_last):
+            return _conv_fwd(x, w, stride, padding, dilation, groups,
+                             channel_last, n)
+
+        def fwd_bias(x, w, b, stride, padding, dilation, groups, channel_last):
+            out = _conv_fwd(x, w, stride, padding, dilation, groups,
+                            channel_last, n)
+            return out + _bias_reshape(b, n, channel_last)
+        return fwd, fwd_bias
+    _f, _fb = _make(_n)
+    register_op(f"conv{_n}d", _f)
+    register_op(f"conv{_n}d_bias", _fb)
+
+
+def _transpose_weight(w, groups, n):
+    """[in_c, out_c/g, *k] -> conv rhs [out_c, in_c/g, *k], spatially flipped."""
+    in_c = w.shape[0]
+    ocg = w.shape[1]
+    icg = in_c // groups
+    w = w.reshape((groups, icg, ocg) + w.shape[2:])
+    w = jnp.swapaxes(w, 1, 2)  # [g, ocg, icg, *k]
+    w = w.reshape((groups * ocg, icg) + w.shape[3:])
+    return jnp.flip(w, axis=tuple(range(2, 2 + n)))
+
+
+def _conv_transpose_fwd(x, w, stride, padding, output_padding, dilation,
+                        groups, channel_last, n):
+    w = _transpose_weight(w, groups, n)
+    pads = []
+    for i in range(n):
+        k_eff = (w.shape[2 + i] - 1) * dilation[i] + 1
+        lo, hi = padding[i]
+        pads.append((k_eff - 1 - lo, k_eff - 1 - hi + output_padding[i]))
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    _dim_numbers(n, channel_last))
+    return lax.conv_general_dilated(
+        x, w, window_strides=(1,) * n, padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups)
+
+
+for _n in (1, 2, 3):
+    def _make_t(n):
+        def fwd(x, w, stride, padding, output_padding, dilation, groups,
+                channel_last):
+            return _conv_transpose_fwd(x, w, stride, padding, output_padding,
+                                       dilation, groups, channel_last, n)
+
+        def fwd_bias(x, w, b, stride, padding, output_padding, dilation,
+                     groups, channel_last):
+            out = _conv_transpose_fwd(x, w, stride, padding, output_padding,
+                                      dilation, groups, channel_last, n)
+            return out + _bias_reshape(b, n, channel_last)
+        return fwd, fwd_bias
+    _f, _fb = _make_t(_n)
+    register_op(f"conv{_n}d_transpose", _f)
+    register_op(f"conv{_n}d_transpose_bias", _fb)
+
+
+def _conv_impl(n, x, weight, bias, stride, padding, dilation, groups,
+               data_format):
+    x, weight = as_tensor(x), as_tensor(weight)
+    channel_last = data_format.endswith("C") and not data_format.startswith("NC")
+    stride = _norm_tuple(stride, n, "stride")
+    dilation = _norm_tuple(dilation, n, "dilation")
+    padding = _norm_padding(padding, n, data_format)
+    attrs = dict(stride=stride, padding=padding, dilation=dilation,
+                 groups=int(groups), channel_last=channel_last)
+    if bias is None:
+        return apply_op(f"conv{n}d", x, weight, attrs=attrs)
+    return apply_op(f"conv{n}d_bias", x, weight, as_tensor(bias), attrs=attrs)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NLC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv_impl(1, x, weight, bias, stride, padding, dilation, groups,
+                      "NWC" if fmt == "NLC" else "NCW")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_impl(2, x, weight, bias, stride, padding, dilation, groups,
+                      data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_impl(3, x, weight, bias, stride, padding, dilation, groups,
+                      data_format)
+
+
+def _conv_transpose_impl(n, x, weight, bias, stride, padding, output_padding,
+                         dilation, groups, data_format, output_size):
+    x, weight = as_tensor(x), as_tensor(weight)
+    channel_last = data_format.endswith("C") and not data_format.startswith("NC")
+    stride = _norm_tuple(stride, n, "stride")
+    dilation = _norm_tuple(dilation, n, "dilation")
+    padding = _norm_padding(padding, n, data_format)
+    if isinstance(padding, str):
+        if padding == "VALID":
+            padding = tuple((0, 0) for _ in range(n))
+        else:
+            raise ValueError("SAME padding unsupported for conv_transpose")
+    if output_size is not None:
+        output_size = _norm_tuple(output_size, n, "output_size")
+        spatial = (x.shape[2:2 + n] if not channel_last
+                   else x.shape[1:1 + n])
+        output_padding = tuple(
+            output_size[i] - ((spatial[i] - 1) * stride[i]
+                              - padding[i][0] - padding[i][1]
+                              + (weight.shape[2 + i] - 1) * dilation[i] + 1)
+            for i in range(n))
+    else:
+        output_padding = _norm_tuple(output_padding, n, "output_padding")
+    attrs = dict(stride=stride, padding=padding,
+                 output_padding=output_padding, dilation=dilation,
+                 groups=int(groups), channel_last=channel_last)
+    if bias is None:
+        return apply_op(f"conv{n}d_transpose", x, weight, attrs=attrs)
+    return apply_op(f"conv{n}d_transpose_bias", x, weight, as_tensor(bias),
+                    attrs=attrs)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv_transpose_impl(1, x, weight, bias, stride, padding,
+                                output_padding, dilation, groups, fmt,
+                                output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose_impl(2, x, weight, bias, stride, padding,
+                                output_padding, dilation, groups,
+                                data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose_impl(3, x, weight, bias, stride, padding,
+                                output_padding, dilation, groups,
+                                data_format, output_size)

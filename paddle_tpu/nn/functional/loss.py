@@ -1,0 +1,639 @@
+"""Loss functional ops.
+
+TPU-native replacement for Paddle's loss kernels (reference:
+paddle/phi/kernels/gpu/cross_entropy_kernel.cu,
+python/paddle/nn/functional/loss.py). Softmax+CE fuses into one XLA kernel
+(logsumexp form) — no separate "softmax_with_cross_entropy" CUDA needed.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import register_op
+from ...ops._helpers import as_tensor, apply_op
+
+__all__ = ["cross_entropy", "softmax_with_cross_entropy", "mse_loss",
+           "l1_loss", "nll_loss", "binary_cross_entropy",
+           "binary_cross_entropy_with_logits", "kl_div", "smooth_l1_loss",
+           "margin_ranking_loss", "hinge_embedding_loss", "cosine_embedding_loss",
+           "sigmoid_focal_loss", "square_error_cost", "log_loss",
+           "triplet_margin_loss", "triplet_margin_with_distance_loss",
+           "soft_margin_loss", "multi_label_soft_margin_loss", "npair_loss",
+           "ctc_loss", "dice_loss", "poisson_nll_loss", "gaussian_nll_loss",
+           "hsigmoid_loss", "multi_margin_loss", "rnnt_loss"]
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def _ce_hard_fwd(logits, label, axis, ignore_index, use_softmax, smoothing,
+                 reduction, has_weight, *weight):
+    w = weight[0] if has_weight else None
+    if use_softmax:
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+    else:
+        logp = jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-10, 1.0))
+    lbl = label
+    if lbl.ndim == logp.ndim:  # trailing dim of 1
+        lbl = jnp.squeeze(lbl, axis=axis)
+    valid = lbl != ignore_index
+    safe_lbl = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(
+        logp, jnp.expand_dims(safe_lbl, axis), axis=axis)
+    picked = jnp.squeeze(picked, axis=axis)
+    if smoothing > 0.0:
+        mean_logp = jnp.mean(logp, axis=axis)
+        picked = (1.0 - smoothing) * picked + smoothing * mean_logp
+    loss = -picked
+    if w is not None:
+        wsel = jnp.take(w.astype(loss.dtype), safe_lbl, axis=0)
+        loss = loss * wsel
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        if w is not None:
+            denom = jnp.sum(jnp.where(
+                valid, jnp.take(w.astype(loss.dtype), safe_lbl, axis=0), 0.0))
+        else:
+            denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return jnp.sum(loss) / denom
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def _ce_soft_fwd(logits, label, axis, use_softmax, reduction):
+    if use_softmax:
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+    else:
+        logp = jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-10, 1.0))
+    loss = -jnp.sum(label.astype(logp.dtype) * logp, axis=axis)
+    return _reduce(loss, reduction)
+
+
+register_op("cross_entropy_hard",
+            lambda logits, label, axis, ignore_index, use_softmax, smoothing,
+            reduction: _ce_hard_fwd(logits, label, axis, ignore_index,
+                                    use_softmax, smoothing, reduction, False))
+register_op("cross_entropy_hard_w",
+            lambda logits, label, w, axis, ignore_index, use_softmax,
+            smoothing, reduction: _ce_hard_fwd(
+                logits, label, axis, ignore_index, use_softmax, smoothing,
+                reduction, True, w))
+register_op("cross_entropy_soft", _ce_soft_fwd)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    input, label = as_tensor(input), as_tensor(label)
+    if soft_label:
+        return apply_op("cross_entropy_soft", input, label,
+                        attrs=dict(axis=int(axis),
+                                   use_softmax=bool(use_softmax),
+                                   reduction=reduction))
+    if weight is not None:
+        return apply_op("cross_entropy_hard_w", input, label,
+                        as_tensor(weight),
+                        attrs=dict(axis=int(axis),
+                                   ignore_index=int(ignore_index),
+                                   use_softmax=bool(use_softmax),
+                                   smoothing=float(label_smoothing),
+                                   reduction=reduction))
+    return apply_op("cross_entropy_hard", input, label,
+                    attrs=dict(axis=int(axis), ignore_index=int(ignore_index),
+                               use_softmax=bool(use_softmax),
+                               smoothing=float(label_smoothing),
+                               reduction=reduction))
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, axis=axis,
+                         reduction="none")
+    from .activation import softmax as softmax_fn
+    # paddle returns loss with the class axis kept as size 1
+    from ...ops import manipulation
+    loss = manipulation.unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+register_op("mse_loss",
+            lambda x, y, reduction: _reduce(jnp.square(x - y), reduction))
+register_op("l1_loss",
+            lambda x, y, reduction: _reduce(jnp.abs(x - y), reduction))
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op("mse_loss", as_tensor(input), as_tensor(label),
+                    attrs=dict(reduction=reduction))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op("l1_loss", as_tensor(input), as_tensor(label),
+                    attrs=dict(reduction=reduction))
+
+
+def square_error_cost(input, label):
+    from ...ops import math as math_ops
+    d = math_ops.subtract(as_tensor(input), as_tensor(label))
+    return math_ops.multiply(d, d)
+
+
+def _nll_fwd(logp, label, ignore_index, reduction, has_weight, *weight):
+    w = weight[0] if has_weight else None
+    valid = label != ignore_index
+    safe = jnp.where(valid, label, 0)
+    if logp.ndim > 2:
+        # [N, C, d1...] -> class axis 1
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)
+        picked = jnp.squeeze(picked, 1)
+    else:
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, -1), axis=-1)[..., 0]
+    loss = -picked
+    if w is not None:
+        loss = loss * jnp.take(w.astype(loss.dtype), safe, axis=0)
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        if w is not None:
+            denom = jnp.sum(jnp.where(
+                valid, jnp.take(w.astype(loss.dtype), safe, axis=0), 0.0))
+        else:
+            denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return jnp.sum(loss) / denom
+    return _reduce(loss, reduction)
+
+
+register_op("nll_loss", lambda logp, label, ignore_index, reduction:
+            _nll_fwd(logp, label, ignore_index, reduction, False))
+register_op("nll_loss_w", lambda logp, label, w, ignore_index, reduction:
+            _nll_fwd(logp, label, ignore_index, reduction, True, w))
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    if weight is not None:
+        return apply_op("nll_loss_w", as_tensor(input), as_tensor(label),
+                        as_tensor(weight),
+                        attrs=dict(ignore_index=int(ignore_index),
+                                   reduction=reduction))
+    return apply_op("nll_loss", as_tensor(input), as_tensor(label),
+                    attrs=dict(ignore_index=int(ignore_index),
+                               reduction=reduction))
+
+
+def _bce_fwd(x, label, reduction, has_weight, *weight):
+    x = jnp.clip(x, 1e-8, 1.0 - 1e-8)
+    loss = -(label * jnp.log(x) + (1.0 - label) * jnp.log(1.0 - x))
+    if has_weight:
+        loss = loss * weight[0]
+    return _reduce(loss, reduction)
+
+
+register_op("bce_loss", lambda x, y, reduction:
+            _bce_fwd(x, y, reduction, False))
+register_op("bce_loss_w", lambda x, y, w, reduction:
+            _bce_fwd(x, y, reduction, True, w))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    if weight is not None:
+        return apply_op("bce_loss_w", as_tensor(input), as_tensor(label),
+                        as_tensor(weight), attrs=dict(reduction=reduction))
+    return apply_op("bce_loss", as_tensor(input), as_tensor(label),
+                    attrs=dict(reduction=reduction))
+
+
+def _bce_logits_fwd(x, label, reduction, has_w, has_pw, *extra):
+    i = 0
+    w = pw = None
+    if has_w:
+        w = extra[i]; i += 1
+    if has_pw:
+        pw = extra[i]
+    # numerically stable: max(x,0) - x*y + log(1+exp(-|x|)), with pos_weight
+    if pw is not None:
+        log_weight = (pw - 1.0) * label + 1.0
+        loss = (1.0 - label) * x + log_weight * (
+            jnp.logaddexp(0.0, -jnp.abs(x)) + jax.nn.relu(-x))
+    else:
+        loss = jax.nn.relu(x) - x * label + jnp.logaddexp(0.0, -jnp.abs(x))
+    if w is not None:
+        loss = loss * w
+    return _reduce(loss, reduction)
+
+
+register_op("bce_logits", lambda x, y, reduction:
+            _bce_logits_fwd(x, y, reduction, False, False))
+register_op("bce_logits_w", lambda x, y, w, reduction:
+            _bce_logits_fwd(x, y, reduction, True, False, w))
+register_op("bce_logits_pw", lambda x, y, pw, reduction:
+            _bce_logits_fwd(x, y, reduction, False, True, pw))
+register_op("bce_logits_w_pw", lambda x, y, w, pw, reduction:
+            _bce_logits_fwd(x, y, reduction, True, True, w, pw))
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    logit, label = as_tensor(logit), as_tensor(label)
+    attrs = dict(reduction=reduction)
+    if weight is not None and pos_weight is not None:
+        return apply_op("bce_logits_w_pw", logit, label, as_tensor(weight),
+                        as_tensor(pos_weight), attrs=attrs)
+    if weight is not None:
+        return apply_op("bce_logits_w", logit, label, as_tensor(weight),
+                        attrs=attrs)
+    if pos_weight is not None:
+        return apply_op("bce_logits_pw", logit, label, as_tensor(pos_weight),
+                        attrs=attrs)
+    return apply_op("bce_logits", logit, label, attrs=attrs)
+
+
+def _kl_div_fwd(x, y, reduction, log_target):
+    if log_target:
+        loss = jnp.exp(y) * (y - x)
+    else:
+        loss = jnp.where(y > 0, y * (jnp.log(jnp.maximum(y, 1e-30)) - x), 0.0)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / x.shape[0]
+    return _reduce(loss, reduction)
+
+
+register_op("kl_div_loss", _kl_div_fwd)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    return apply_op("kl_div_loss", as_tensor(input), as_tensor(label),
+                    attrs=dict(reduction=reduction,
+                               log_target=bool(log_target)))
+
+
+register_op("smooth_l1", lambda x, y, delta, reduction:
+            _reduce(jnp.where(jnp.abs(x - y) < delta,
+                              0.5 * jnp.square(x - y) / delta,
+                              jnp.abs(x - y) - 0.5 * delta), reduction))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    return apply_op("smooth_l1", as_tensor(input), as_tensor(label),
+                    attrs=dict(delta=float(delta), reduction=reduction))
+
+
+register_op("margin_ranking", lambda x1, x2, label, margin, reduction:
+            _reduce(jax.nn.relu(-label * (x1 - x2) + margin), reduction))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return apply_op("margin_ranking", as_tensor(input), as_tensor(other),
+                    as_tensor(label),
+                    attrs=dict(margin=float(margin), reduction=reduction))
+
+
+register_op("hinge_embedding", lambda x, y, margin, reduction:
+            _reduce(jnp.where(y == 1, x, jax.nn.relu(margin - x)), reduction))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    return apply_op("hinge_embedding", as_tensor(input), as_tensor(label),
+                    attrs=dict(margin=float(margin), reduction=reduction))
+
+
+def _cos_embed_fwd(x1, x2, y, margin, reduction):
+    cos = jnp.sum(x1 * x2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
+    loss = jnp.where(y == 1, 1.0 - cos, jax.nn.relu(cos - margin))
+    return _reduce(loss, reduction)
+
+
+register_op("cosine_embedding", _cos_embed_fwd)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    return apply_op("cosine_embedding", as_tensor(input1), as_tensor(input2),
+                    as_tensor(label),
+                    attrs=dict(margin=float(margin), reduction=reduction))
+
+
+def _focal_fwd(logit, label, gamma, alpha, norm, reduction):
+    p = jax.nn.sigmoid(logit)
+    ce = jax.nn.relu(logit) - logit * label + jnp.logaddexp(0.0, -jnp.abs(logit))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * jnp.power(1 - p_t, gamma) * ce
+    if norm is not None:
+        loss = loss / norm
+    return _reduce(loss, reduction)
+
+
+register_op("sigmoid_focal", lambda logit, label, gamma, alpha, reduction:
+            _focal_fwd(logit, label, gamma, alpha, None, reduction))
+register_op("sigmoid_focal_norm",
+            lambda logit, label, norm, gamma, alpha, reduction:
+            _focal_fwd(logit, label, gamma, alpha, norm, reduction))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    if normalizer is not None:
+        return apply_op("sigmoid_focal_norm", as_tensor(logit),
+                        as_tensor(label), as_tensor(normalizer),
+                        attrs=dict(gamma=float(gamma), alpha=float(alpha),
+                                   reduction=reduction))
+    return apply_op("sigmoid_focal", as_tensor(logit), as_tensor(label),
+                    attrs=dict(gamma=float(gamma), alpha=float(alpha),
+                               reduction=reduction))
+
+
+register_op("log_loss_op", lambda x, y, epsilon:
+            -y * jnp.log(x + epsilon) - (1 - y) * jnp.log(1 - x + epsilon))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply_op("log_loss_op", as_tensor(input), as_tensor(label),
+                    attrs=dict(epsilon=float(epsilon)))
+
+
+def _triplet_fwd(a, p, n, margin, pnorm, swap, eps, reduction):
+    def dist(u, v):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v) + eps, pnorm),
+                                 axis=-1), 1.0 / pnorm)
+    d_ap = dist(a, p)
+    d_an = dist(a, n)
+    if swap:
+        d_pn = dist(p, n)
+        d_an = jnp.minimum(d_an, d_pn)
+    return _reduce(jax.nn.relu(d_ap - d_an + margin), reduction)
+
+
+register_op("triplet_margin", _triplet_fwd)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    return apply_op("triplet_margin", as_tensor(input), as_tensor(positive),
+                    as_tensor(negative),
+                    attrs=dict(margin=float(margin), pnorm=float(p),
+                               swap=bool(swap), eps=float(epsilon),
+                               reduction=reduction))
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    from ...ops import math as math_ops
+    d_ap = distance_function(input, positive)
+    d_an = distance_function(input, negative)
+    if swap:
+        d_pn = distance_function(positive, negative)
+        d_an = math_ops.minimum(d_an, d_pn)
+    from .activation import relu as relu_fn
+    from ...ops import reduction as red
+    loss = relu_fn(math_ops.add(math_ops.subtract(d_ap, d_an),
+                                as_tensor(float(margin))))
+    if reduction == "mean":
+        return red.mean(loss)
+    if reduction == "sum":
+        return red.sum(loss)
+    return loss
+
+
+register_op("soft_margin", lambda x, y, reduction:
+            _reduce(jnp.log1p(jnp.exp(-y * x)), reduction))
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return apply_op("soft_margin", as_tensor(input), as_tensor(label),
+                    attrs=dict(reduction=reduction))
+
+
+def _mlsm_fwd(x, y, reduction, has_w, *w):
+    loss = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+    if has_w:
+        loss = loss * w[0]
+    loss = jnp.mean(loss, axis=-1)
+    return _reduce(loss, reduction)
+
+
+register_op("multi_label_soft_margin", lambda x, y, reduction:
+            _mlsm_fwd(x, y, reduction, False))
+register_op("multi_label_soft_margin_w", lambda x, y, w, reduction:
+            _mlsm_fwd(x, y, reduction, True, w))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    if weight is not None:
+        return apply_op("multi_label_soft_margin_w", as_tensor(input),
+                        as_tensor(label), as_tensor(weight),
+                        attrs=dict(reduction=reduction))
+    return apply_op("multi_label_soft_margin", as_tensor(input),
+                    as_tensor(label), attrs=dict(reduction=reduction))
+
+
+def _multi_margin_fwd(x, label, p, margin, reduction):
+    n, c = x.shape
+    picked = jnp.take_along_axis(x, label[:, None], axis=1)
+    m = jax.nn.relu(margin - picked + x)
+    m = jnp.power(m, p)
+    mask = jax.nn.one_hot(label, c, dtype=x.dtype)
+    loss = jnp.sum(m * (1 - mask), axis=1) / c
+    return _reduce(loss, reduction)
+
+
+register_op("multi_margin", _multi_margin_fwd)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    return apply_op("multi_margin", as_tensor(input), as_tensor(label),
+                    attrs=dict(p=float(p), margin=float(margin),
+                               reduction=reduction))
+
+
+def _npair_fwd(anchor, positive, labels, l2_reg):
+    logits = jnp.matmul(anchor, positive.T)
+    lbl = labels.reshape(-1)
+    same = (lbl[:, None] == lbl[None, :]).astype(logits.dtype)
+    target = same / jnp.sum(same, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(logits, axis=1)
+    ce1 = -jnp.mean(jnp.sum(target * logp, axis=1))
+    logp2 = jax.nn.log_softmax(logits.T, axis=1)
+    ce2 = -jnp.mean(jnp.sum(target * logp2, axis=1))
+    l2 = l2_reg * (jnp.mean(jnp.sum(jnp.square(anchor), axis=1)) +
+                   jnp.mean(jnp.sum(jnp.square(positive), axis=1))) / 2
+    return (ce1 + ce2) / 2 + l2
+
+
+register_op("npair", _npair_fwd)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    return apply_op("npair", as_tensor(anchor), as_tensor(positive),
+                    as_tensor(labels), attrs=dict(l2_reg=float(l2_reg)))
+
+
+register_op("dice_loss_op", lambda x, label, epsilon:
+            1.0 - jnp.mean(
+                (2.0 * jnp.sum(x * label, axis=tuple(range(1, x.ndim)))
+                 ) / (jnp.sum(x, axis=tuple(range(1, x.ndim))) +
+                      jnp.sum(label, axis=tuple(range(1, x.ndim))) + epsilon)))
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    label = as_tensor(label)
+    input = as_tensor(input)
+    if label.dtype not in ("float32", "float64", "bfloat16", "float16"):
+        from ...ops import math as math_ops
+        from .common import one_hot
+        label2 = one_hot(label.squeeze(-1) if label.shape[-1] == 1 else label,
+                         input.shape[-1])
+        label = label2
+    return apply_op("dice_loss_op", input, label,
+                    attrs=dict(epsilon=float(epsilon)))
+
+
+def _poisson_nll_fwd(x, label, log_input, full, epsilon, reduction):
+    if log_input:
+        loss = jnp.exp(x) - label * x
+    else:
+        loss = x - label * jnp.log(x + epsilon)
+    if full:
+        stirling = label * jnp.log(label) - label + \
+            0.5 * jnp.log(2 * np.pi * label)
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+register_op("poisson_nll", _poisson_nll_fwd)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    return apply_op("poisson_nll", as_tensor(input), as_tensor(label),
+                    attrs=dict(log_input=bool(log_input), full=bool(full),
+                               epsilon=float(epsilon), reduction=reduction))
+
+
+def _gaussian_nll_fwd(x, label, var, full, epsilon, reduction):
+    var = jnp.maximum(var, epsilon)
+    loss = 0.5 * (jnp.log(var) + jnp.square(x - label) / var)
+    if full:
+        loss = loss + 0.5 * np.log(2 * np.pi)
+    return _reduce(loss, reduction)
+
+
+register_op("gaussian_nll", _gaussian_nll_fwd)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    return apply_op("gaussian_nll", as_tensor(input), as_tensor(label),
+                    as_tensor(variance),
+                    attrs=dict(full=bool(full), epsilon=float(epsilon),
+                               reduction=reduction))
+
+
+def _ctc_fwd(log_probs, labels, input_lengths, label_lengths, blank,
+             reduction, norm_by_times):
+    """CTC via the standard alpha recursion as a lax.scan over time.
+
+    Reference semantics: paddle/fluid/operators/warpctc_op.* (warp-ctc).
+    logits layout here: [T, N, C] log-probs.
+    """
+    T, N, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    # extended label sequence: blank, l1, blank, l2, ... blank
+    ext = jnp.full((N, S), blank, dtype=labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    neg_inf = jnp.asarray(-1e30, dtype=log_probs.dtype)
+
+    # mask for allowed skip transition (s-2): ext[s] != blank and
+    # ext[s] != ext[s-2]
+    ext_prev2 = jnp.concatenate(
+        [jnp.full((N, 2), -1, dtype=ext.dtype), ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != ext_prev2)
+
+    def emit(t_lp):
+        return jnp.take_along_axis(t_lp[:, None, :].repeat(S, 1),
+                                   ext[..., None], axis=-1)[..., 0]
+
+    alpha0 = jnp.full((N, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(emit(log_probs[0])[:, 0])
+    alpha0 = alpha0.at[:, 1].set(jnp.where(L > 0, emit(log_probs[0])[:, 1],
+                                           neg_inf))
+
+    def step(alpha, t_lp):
+        a_shift1 = jnp.concatenate(
+            [jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+        a_shift2 = jnp.concatenate(
+            [jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+        a_skip = jnp.where(can_skip, a_shift2, neg_inf)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a_skip)
+        new_alpha = merged + emit(t_lp)
+        return new_alpha, new_alpha
+
+    _, alphas = jax.lax.scan(step, alpha0, log_probs[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, N, S]
+    # pick alpha at t = input_length-1, s in {2*label_len, 2*label_len-1}
+    t_idx = jnp.clip(input_lengths - 1, 0, T - 1)
+    a_final = jnp.take_along_axis(
+        alphas, t_idx[None, :, None].repeat(S, 2), axis=0)[0]  # [N, S]
+    s1 = 2 * label_lengths
+    s0 = jnp.maximum(2 * label_lengths - 1, 0)
+    lp1 = jnp.take_along_axis(a_final, s1[:, None], axis=1)[:, 0]
+    lp0 = jnp.take_along_axis(a_final, s0[:, None], axis=1)[:, 0]
+    ll = jnp.logaddexp(lp1, lp0)
+    loss = -ll
+    if norm_by_times:
+        loss = loss / input_lengths.astype(loss.dtype)
+    if reduction == "mean":
+        return jnp.mean(loss / label_lengths.astype(loss.dtype))
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+register_op("ctc_loss_op", _ctc_fwd)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    from .activation import log_softmax as lsm
+    log_probs = lsm(as_tensor(log_probs), axis=-1)
+    return apply_op("ctc_loss_op", log_probs, as_tensor(labels),
+                    as_tensor(input_lengths), as_tensor(label_lengths),
+                    attrs=dict(blank=int(blank), reduction=reduction,
+                               norm_by_times=bool(norm_by_times)))
+
+
+def hsigmoid_loss(*args, **kwargs):
+    raise NotImplementedError(
+        "hierarchical sigmoid is tied to the PS sparse-table path "
+        "(reference: paddle/fluid/operators/hierarchical_sigmoid_op.cc); "
+        "descoped on TPU — use full softmax or sampled softmax.")
+
+
+def rnnt_loss(*args, **kwargs):
+    raise NotImplementedError("rnnt_loss: planned (lax.scan lattice)")

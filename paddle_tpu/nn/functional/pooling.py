@@ -1,0 +1,249 @@
+"""Pooling functional ops.
+
+TPU-native replacement for Paddle's pool kernels (reference:
+paddle/phi/kernels/funcs/pooling.h, python/paddle/nn/functional/pooling.py).
+Fixed-window pools are one `lax.reduce_window` HLO. Adaptive average pools
+with non-divisible bins become a per-axis averaging-matrix contraction
+(static matrices, MXU-friendly) instead of CUDA's per-output-bin loops.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import register_op
+from ...ops._helpers import as_tensor, apply_op
+from .conv import _norm_tuple, _norm_padding
+
+__all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d",
+           "max_pool1d", "max_pool2d", "max_pool3d",
+           "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+           "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d"]
+
+
+def _window(n, kernel, stride, channel_last):
+    if channel_last:
+        dims = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+    else:
+        dims = (1, 1) + kernel
+        strides = (1, 1) + stride
+    return dims, strides
+
+
+def _full_pads(n, padding, channel_last):
+    if channel_last:
+        return ((0, 0),) + tuple(padding) + ((0, 0),)
+    return ((0, 0), (0, 0)) + tuple(padding)
+
+
+def _max_pool_fwd(x, kernel, stride, padding, channel_last, n):
+    dims, strides = _window(n, kernel, stride, channel_last)
+    pads = _full_pads(n, padding, channel_last)
+    init = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+            else jnp.iinfo(x.dtype).min)
+    return lax.reduce_window(x, init, lax.max, dims, strides, pads)
+
+
+def _avg_pool_fwd(x, kernel, stride, padding, exclusive, channel_last, n):
+    dims, strides = _window(n, kernel, stride, channel_last)
+    pads = _full_pads(n, padding, channel_last)
+    summed = lax.reduce_window(x.astype(jnp.float32) if x.dtype == jnp.bfloat16
+                               else x, 0.0, lax.add, dims, strides, pads)
+    if exclusive and any(lo or hi for lo, hi in padding):
+        ones = jnp.ones(x.shape, dtype=summed.dtype)
+        counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+        out = summed / counts
+    else:
+        out = summed / float(np.prod(kernel))
+    return out.astype(x.dtype)
+
+
+for _n in (1, 2, 3):
+    def _make(n):
+        def maxp(x, kernel, stride, padding, channel_last):
+            return _max_pool_fwd(x, kernel, stride, padding, channel_last, n)
+
+        def avgp(x, kernel, stride, padding, exclusive, channel_last):
+            return _avg_pool_fwd(x, kernel, stride, padding, exclusive,
+                                 channel_last, n)
+        return maxp, avgp
+    _m, _a = _make(_n)
+    register_op(f"max_pool{_n}d", _m)
+    register_op(f"avg_pool{_n}d", _a)
+
+
+def _pool_impl(op, n, x, kernel_size, stride, padding, data_format, **extra):
+    x = as_tensor(x)
+    channel_last = data_format.endswith("C") and not data_format.startswith("NC")
+    kernel = _norm_tuple(kernel_size, n, "kernel_size")
+    stride = kernel if stride is None else _norm_tuple(stride, n, "stride")
+    padding = _norm_padding(padding, n, data_format)
+    if isinstance(padding, str):
+        raise ValueError("string padding unsupported for pooling")
+    attrs = dict(kernel=kernel, stride=stride, padding=padding,
+                 channel_last=channel_last, **extra)
+    return apply_op(op, x, attrs=attrs)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    out = _pool_impl("max_pool1d", 1, x, kernel_size, stride, padding, fmt)
+    if return_mask:
+        return out, _pool_mask(x, out, 1, kernel_size, stride, padding, fmt)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool_impl("max_pool2d", 2, x, kernel_size, stride, padding,
+                     data_format)
+    if return_mask:
+        return out, _pool_mask(x, out, 2, kernel_size, stride, padding,
+                               data_format)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool_impl("max_pool3d", 3, x, kernel_size, stride, padding,
+                     data_format)
+    if return_mask:
+        return out, _pool_mask(x, out, 3, kernel_size, stride, padding,
+                               data_format)
+    return out
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _pool_impl("avg_pool1d", 1, x, kernel_size, stride, padding, fmt,
+                      exclusive=bool(exclusive))
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool_impl("avg_pool2d", 2, x, kernel_size, stride, padding,
+                      data_format, exclusive=bool(exclusive))
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool_impl("avg_pool3d", 3, x, kernel_size, stride, padding,
+                      data_format, exclusive=bool(exclusive))
+
+
+def _pool_mask(x, out, n, kernel_size, stride, padding, data_format):
+    """argmax indices for return_mask=True (flat spatial index, paddle-style)."""
+    from .. import functional as F  # lazy; avoids cycles
+    x = as_tensor(x)
+    # brute force: recompute with one-hot window positions; used rarely.
+    raise NotImplementedError(
+        "return_mask=True is not yet supported on the TPU backend")
+
+
+# -- adaptive pooling --------------------------------------------------------
+
+def _adaptive_matrix(in_size, out_size):
+    """[out, in] row-stochastic averaging matrix with paddle bin edges."""
+    m = np.zeros((out_size, in_size), dtype=np.float32)
+    for i in range(out_size):
+        lo = (i * in_size) // out_size
+        hi = -(-((i + 1) * in_size) // out_size)  # ceil
+        m[i, lo:hi] = 1.0 / (hi - lo)
+    return m
+
+
+def _adaptive_avg_fwd(x, out_sizes, channel_last, n):
+    # contract each spatial axis with its averaging matrix
+    offset = 1 if channel_last else 2
+    dt = x.dtype
+    acc = x.astype(jnp.float32) if dt == jnp.bfloat16 else x
+    for i, out_s in enumerate(out_sizes):
+        ax = offset + i
+        in_s = x.shape[ax]
+        m = jnp.asarray(_adaptive_matrix(in_s, out_s), dtype=acc.dtype)
+        acc = jnp.moveaxis(jnp.tensordot(acc, m, axes=[[ax], [1]]), -1, ax)
+    return acc.astype(dt)
+
+
+def _adaptive_max_fwd(x, out_sizes, channel_last, n):
+    offset = 1 if channel_last else 2
+    out = x
+    for i, out_s in enumerate(out_sizes):
+        ax = offset + i
+        in_s = out.shape[ax]
+        if in_s % out_s == 0:
+            k = in_s // out_s
+            new_shape = out.shape[:ax] + (out_s, k) + out.shape[ax + 1:]
+            out = out.reshape(new_shape).max(axis=ax + 1)
+        else:
+            slices = []
+            for j in range(out_s):
+                lo = (j * in_s) // out_s
+                hi = -(-((j + 1) * in_s) // out_s)
+                slices.append(lax.slice_in_dim(out, lo, hi, axis=ax)
+                              .max(axis=ax, keepdims=True))
+            out = jnp.concatenate(slices, axis=ax)
+    return out
+
+
+for _n in (1, 2, 3):
+    def _make_ad(n):
+        def avg(x, out_sizes, channel_last):
+            return _adaptive_avg_fwd(x, out_sizes, channel_last, n)
+
+        def mx(x, out_sizes, channel_last):
+            return _adaptive_max_fwd(x, out_sizes, channel_last, n)
+        return avg, mx
+    _a, _m = _make_ad(_n)
+    register_op(f"adaptive_avg_pool{_n}d", _a)
+    register_op(f"adaptive_max_pool{_n}d", _m)
+
+
+def _adaptive_impl(op, n, x, output_size, data_format):
+    x = as_tensor(x)
+    channel_last = data_format.endswith("C") and not data_format.startswith("NC")
+    spatial = x.shape[1:1 + n] if channel_last else x.shape[2:2 + n]
+    out_sizes = _norm_tuple(output_size, n, "output_size")
+    out_sizes = tuple(spatial[i] if out_sizes[i] is None else out_sizes[i]
+                      for i in range(n))
+    return apply_op(op, x, attrs=dict(out_sizes=out_sizes,
+                                      channel_last=channel_last))
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_impl("adaptive_avg_pool1d", 1, x, output_size, "NCW")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_impl("adaptive_avg_pool2d", 2, x, output_size,
+                          data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_impl("adaptive_avg_pool3d", 3, x, output_size,
+                          data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError("return_mask unsupported on TPU backend")
+    return _adaptive_impl("adaptive_max_pool1d", 1, x, output_size, "NCW")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError("return_mask unsupported on TPU backend")
+    return _adaptive_impl("adaptive_max_pool2d", 2, x, output_size, "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError("return_mask unsupported on TPU backend")
+    return _adaptive_impl("adaptive_max_pool3d", 3, x, output_size, "NCDHW")

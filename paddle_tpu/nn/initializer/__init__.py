@@ -1,0 +1,241 @@
+"""Weight initializers.
+
+TPU-native replacement for Paddle's initializer set (reference:
+python/paddle/nn/initializer/__init__.py, python/paddle/fluid/initializer.py).
+Paddle initializers append init ops to a startup program; here each
+initializer is a pure function of (shape, dtype, threefry key) evaluated
+eagerly at parameter creation — no startup program exists because XLA
+compiles per-call, not per-graph.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import dtype as dtypes
+from ...core import random as random_mod
+from ...core.tensor import Tensor
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Orthogonal", "Dirac", "calculate_gain",
+]
+
+
+def _fan_in_out(shape):
+    shape = tuple(int(s) for s in shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # matches paddle convention: weight is [in, out] for nn.Linear
+        return shape[0], shape[1]
+    # conv kernels [out_c, in_c/groups, *k]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+def calculate_gain(nonlinearity, param=None):
+    """paddle.nn.initializer.calculate_gain parity."""
+    recommended = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0, "tanh": 5.0 / 3,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4,
+    }
+    if nonlinearity not in recommended:
+        raise ValueError(f"Unsupported nonlinearity: {nonlinearity}")
+    return recommended[nonlinearity]
+
+
+class Initializer:
+    """Base: subclasses implement _generate(shape, np_dtype, key) -> array."""
+
+    _trunc_stds = None
+
+    def __call__(self, param, block=None):
+        """Fill a Tensor/Parameter in place (Paddle call signature)."""
+        shape = tuple(param.shape)
+        np_dt = np.dtype(param._value.dtype)
+        gen_dt = np_dt if np_dt.kind == "f" else np_dt
+        value = self._generate(shape, gen_dt, random_mod.next_key())
+        param._rebind(jnp.asarray(value, dtype=np_dt))
+        return param
+
+    def init_array(self, shape, dtype):
+        """Functional entry: returns a fresh jnp array."""
+        np_dt = dtypes.to_np_dtype(dtype)
+        return jnp.asarray(
+            self._generate(tuple(int(s) for s in shape), np_dt,
+                           random_mod.next_key()), dtype=np_dt)
+
+    def _generate(self, shape, np_dtype, key):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def _generate(self, shape, np_dtype, key):
+        return jnp.full(shape, self.value, dtype=np_dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def _generate(self, shape, np_dtype, key):
+        sample_dt = np_dtype if np_dtype in (np.float32, np.float64) else np.float32
+        x = jax.random.normal(key, shape, dtype=sample_dt)
+        return (x * self.std + self.mean).astype(np_dtype)
+
+
+class TruncatedNormal(Initializer):
+    """Normal truncated to [mean-2std, mean+2std] (paddle default a=-2,b=2)."""
+
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def _generate(self, shape, np_dtype, key):
+        sample_dt = np_dtype if np_dtype in (np.float32, np.float64) else np.float32
+        x = jax.random.truncated_normal(key, self.a, self.b, shape, dtype=sample_dt)
+        return (x * self.std + self.mean).astype(np_dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def _generate(self, shape, np_dtype, key):
+        sample_dt = np_dtype if np_dtype in (np.float32, np.float64) else np.float32
+        return jax.random.uniform(
+            key, shape, minval=self.low, maxval=self.high,
+            dtype=sample_dt).astype(np_dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _generate(self, shape, np_dtype, key):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        sample_dt = np_dtype if np_dtype in (np.float32, np.float64) else np.float32
+        return (jax.random.normal(key, shape, dtype=sample_dt) * std).astype(np_dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _generate(self, shape, np_dtype, key):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        sample_dt = np_dtype if np_dtype in (np.float32, np.float64) else np.float32
+        return jax.random.uniform(key, shape, minval=-limit, maxval=limit,
+                                  dtype=sample_dt).astype(np_dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _generate(self, shape, np_dtype, key):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(max(fi, 1))
+        sample_dt = np_dtype if np_dtype in (np.float32, np.float64) else np.float32
+        return (jax.random.normal(key, shape, dtype=sample_dt) * std).astype(np_dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _generate(self, shape, np_dtype, key):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / max(fi, 1))
+        sample_dt = np_dtype if np_dtype in (np.float32, np.float64) else np.float32
+        return jax.random.uniform(key, shape, minval=-limit, maxval=limit,
+                                  dtype=sample_dt).astype(np_dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        if isinstance(value, Tensor):
+            value = np.asarray(value._value)
+        self.value = np.asarray(value)
+
+    def _generate(self, shape, np_dtype, key):
+        if tuple(self.value.shape) != tuple(shape):
+            raise ValueError(
+                f"Assign initializer shape mismatch: {self.value.shape} vs {shape}")
+        return jnp.asarray(self.value, dtype=np_dtype)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, name=None):
+        self.gain = gain
+
+    def _generate(self, shape, np_dtype, key):
+        if len(shape) < 2:
+            raise ValueError("Orthogonal initializer needs >=2 dims")
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        flat = (rows, cols)
+        sample_dt = np_dtype if np_dtype in (np.float32, np.float64) else np.float32
+        a = jax.random.normal(key, (max(flat), min(flat)), dtype=sample_dt)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(np_dtype)
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv kernel init (paddle.nn.initializer.Dirac)."""
+
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def _generate(self, shape, np_dtype, key):
+        if len(shape) not in (3, 4, 5):
+            raise ValueError("Dirac initializer needs a 3/4/5-D conv kernel")
+        out_c, in_c = shape[0], shape[1]
+        val = np.zeros(shape, dtype=np.float32)
+        centers = tuple(s // 2 for s in shape[2:])
+        min_c = min(out_c // self.groups, in_c)
+        for g in range(self.groups):
+            for i in range(min_c):
+                idx = (g * (out_c // self.groups) + i, i) + centers
+                val[idx] = 1.0
+        return jnp.asarray(val, dtype=np_dtype)
+
+
+# paddle.fluid legacy aliases
+ConstantInitializer = Constant
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+UniformInitializer = Uniform
+XavierInitializer = XavierUniform
+MSRAInitializer = KaimingNormal
+NumpyArrayInitializer = Assign

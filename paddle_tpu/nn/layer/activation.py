@@ -1,0 +1,94 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from .layers import Layer
+from .. import functional as F
+from ..initializer import Constant
+
+__all__ = ["CELU", "ELU", "GELU", "Hardshrink", "Hardsigmoid", "Hardswish",
+           "Hardtanh", "LeakyReLU", "LogSigmoid", "LogSoftmax", "Maxout",
+           "Mish", "PReLU", "ReLU", "ReLU6", "RReLU", "SELU", "Sigmoid",
+           "Silu", "Softmax", "Softplus", "Softshrink", "Softsign", "Swish",
+           "Tanh", "Tanhshrink", "ThresholdedReLU"]
+
+
+def _simple_layer(cls_name, fn_name, params=()):
+    def __init__(self, *args, **kwargs):
+        Layer.__init__(self)
+        for i, (pname, default) in enumerate(params):
+            if i < len(args):
+                setattr(self, pname, args[i])
+            else:
+                setattr(self, pname, kwargs.get(pname, default))
+
+    def forward(self, x):
+        fn = getattr(F, fn_name)
+        return fn(x, **{p: getattr(self, p) for p, _ in params})
+
+    return type(cls_name, (Layer,), {"__init__": __init__,
+                                     "forward": forward})
+
+
+CELU = _simple_layer("CELU", "celu", [("alpha", 1.0)])
+ELU = _simple_layer("ELU", "elu", [("alpha", 1.0)])
+GELU = _simple_layer("GELU", "gelu", [("approximate", False)])
+Hardshrink = _simple_layer("Hardshrink", "hardshrink", [("threshold", 0.5)])
+Hardsigmoid = _simple_layer("Hardsigmoid", "hardsigmoid", [])
+Hardswish = _simple_layer("Hardswish", "hardswish", [])
+Hardtanh = _simple_layer("Hardtanh", "hardtanh",
+                         [("min", -1.0), ("max", 1.0)])
+LeakyReLU = _simple_layer("LeakyReLU", "leaky_relu",
+                          [("negative_slope", 0.01)])
+LogSigmoid = _simple_layer("LogSigmoid", "log_sigmoid", [])
+LogSoftmax = _simple_layer("LogSoftmax", "log_softmax", [("axis", -1)])
+Mish = _simple_layer("Mish", "mish", [])
+ReLU = _simple_layer("ReLU", "relu", [])
+ReLU6 = _simple_layer("ReLU6", "relu6", [])
+SELU = _simple_layer("SELU", "selu",
+                     [("scale", 1.0507009873554805),
+                      ("alpha", 1.6732632423543772)])
+Sigmoid = _simple_layer("Sigmoid", "sigmoid", [])
+Silu = _simple_layer("Silu", "silu", [])
+Softmax = _simple_layer("Softmax", "softmax", [("axis", -1)])
+Softplus = _simple_layer("Softplus", "softplus",
+                         [("beta", 1.0), ("threshold", 20.0)])
+Softshrink = _simple_layer("Softshrink", "softshrink", [("threshold", 0.5)])
+Softsign = _simple_layer("Softsign", "softsign", [])
+Swish = _simple_layer("Swish", "swish", [])
+Tanh = _simple_layer("Tanh", "tanh", [])
+Tanhshrink = _simple_layer("Tanhshrink", "tanhshrink", [])
+ThresholdedReLU = _simple_layer("ThresholdedReLU", "thresholded_relu",
+                                [("threshold", 1.0)])
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self.groups = groups
+        self.axis = axis
+
+    def forward(self, x):
+        return F.maxout(x, self.groups, self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            shape=[num_parameters], attr=weight_attr,
+            default_initializer=Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower = lower
+        self.upper = upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)

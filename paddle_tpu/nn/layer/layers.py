@@ -1,0 +1,423 @@
+"""Layer: the module system.
+
+TPU-native replacement for Paddle's dygraph Layer (reference:
+python/paddle/fluid/dygraph/layers.py:108 class Layer). Semantics match:
+parameter/buffer/sublayer registries via __setattr__, forward pre/post
+hooks, train/eval propagation, state_dict with structured names. The TPU
+difference is invisible here — parameters wrap immutable jax.Arrays and
+optimizers rebind them — so this file is almost pure API parity.
+"""
+from __future__ import annotations
+
+import collections
+import copy as copy_mod
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core import dtype as dtypes
+from ...core.tensor import Tensor, Parameter
+from ..initializer import Initializer, Constant, XavierUniform, Uniform
+
+__all__ = ["Layer", "ParamAttr"]
+
+
+class ParamAttr:
+    """Parameter attribute bundle (reference: python/paddle/fluid/param_attr.py)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if isinstance(attr, Initializer):
+            return ParamAttr(initializer=attr)
+        if attr is False:
+            return False
+        raise TypeError(f"Bad ParamAttr spec: {attr!r}")
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, hook_id):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+_layer_counts: dict = collections.defaultdict(int)
+
+
+def _unique_name(prefix):
+    n = _layer_counts[prefix]
+    _layer_counts[prefix] += 1
+    return f"{prefix}_{n}"
+
+
+class Layer:
+    """Base class for all network layers (paddle.nn.Layer parity)."""
+
+    def __init__(self, name_scope=None, dtype="float32"):
+        if name_scope is None:
+            name_scope = _unique_name(self.__class__.__name__.lower())
+        self._full_name = name_scope
+        self._dtype = dtypes.convert_dtype(dtype) if dtype is not None else None
+        self.training = True
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self._non_persistable_buffer_names_set = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._hook_id = [0]
+        self._casted_by_pure_fp16 = False
+
+    # -- identity ----------------------------------------------------------
+    def full_name(self):
+        return self._full_name
+
+    # -- mode --------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    # -- parameter creation ------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        """reference: fluid/dygraph/layers.py create_parameter + LayerHelper."""
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = dtypes.convert_dtype(dtype) if dtype is not None else \
+            (self._dtype or dtypes.get_default_dtype())
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = Constant(0.0) if is_bias else XavierUniform()
+        value = init.init_array(shape, dtype)
+        p = Parameter(value, name=attr.name, trainable=attr.trainable)
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    def create_variable(self, name=None, persistable=None, dtype=None):
+        dtype = dtypes.convert_dtype(dtype) if dtype is not None else \
+            (self._dtype or dtypes.get_default_dtype())
+        t = Tensor(jnp.zeros((), dtype=dtype.np_dtype), name=name)
+        t.persistable = bool(persistable)
+        return t
+
+    def create_tensor(self, name=None, persistable=None, dtype=None):
+        return self.create_variable(name, persistable, dtype)
+
+    # -- registration ------------------------------------------------------
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter or None")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        if sublayer is not None and not isinstance(sublayer, Layer):
+            raise TypeError("add_sublayer expects a Layer or None")
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            raise TypeError("register_buffer expects a Tensor or None")
+        self._buffers[name] = tensor
+        if tensor is not None:
+            tensor.persistable = persistable
+        if not persistable:
+            self._non_persistable_buffer_names_set.add(name)
+        else:
+            self._non_persistable_buffer_names_set.discard(name)
+        return tensor
+
+    # -- attribute magic ---------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning params")
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning layers")
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            layers[name] = value
+        elif params is not None and name in params:
+            if value is None:
+                params[name] = None
+            elif isinstance(value, Tensor):
+                params[name].set_value(value)
+            else:
+                raise TypeError(f"cannot assign {type(value)} to parameter "
+                                f"{name}")
+        elif buffers is not None and name in buffers:
+            if value is None or isinstance(value, Tensor):
+                buffers[name] = value
+            else:
+                object.__setattr__(self, name, value)
+        elif layers is not None and name in layers and value is None:
+            layers[name] = None
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for registry in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for registry in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        extra = []
+        for registry in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(registry)
+            if d:
+                extra += list(d.keys())
+        return list(super().__dir__()) + extra
+
+    # -- traversal ---------------------------------------------------------
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        seen = set()
+        for name, l in self._sub_layers.items():
+            if l is not None and id(l) not in seen:
+                seen.add(id(l))
+                yield name, l
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, l in self.named_children():
+            if l is None or id(l) in layers_set:
+                continue
+            sub_prefix = prefix + ("." if prefix else "") + name
+            yield from l.named_sublayers(prefix=sub_prefix, include_self=True,
+                                         layers_set=layers_set)
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        if include_sublayers:
+            gen = self.named_sublayers(prefix=prefix, include_self=True)
+        else:
+            gen = [(prefix, self)]
+        for layer_prefix, layer in gen:
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (layer_prefix + ("." if layer_prefix else "") + name, p)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        if include_sublayers:
+            gen = self.named_sublayers(prefix=prefix, include_self=True)
+        else:
+            gen = [(prefix, self)]
+        for layer_prefix, layer in gen:
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (layer_prefix + ("." if layer_prefix else "") + name, b)
+
+    def apply(self, fn):
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._hook_id[0] += 1
+        hid = self._hook_id[0]
+        self._forward_pre_hooks[hid] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, hid)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id[0] += 1
+        hid = self._hook_id[0]
+        self._forward_post_hooks[hid] = hook
+        return HookRemoveHelper(self._forward_post_hooks, hid)
+
+    # -- call --------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            o = hook(self, inputs, outputs)
+            if o is not None:
+                outputs = o
+        return outputs
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = collections.OrderedDict() if destination is None else destination
+        prefix = structured_name_prefix.rstrip(".")
+        for name, p in self.named_parameters(
+                prefix=prefix, include_sublayers=include_sublayers):
+            dest[name] = p
+        gen = (self.named_sublayers(prefix=prefix, include_self=True)
+               if include_sublayers else [(prefix, self)])
+        seen = set()
+        for layer_prefix, layer in gen:
+            for name, b in layer._buffers.items():
+                if (b is None or id(b) in seen
+                        or name in layer._non_persistable_buffer_names_set):
+                    continue
+                seen.add(id(b))
+                dest[layer_prefix + ("." if layer_prefix else "") + name] = b
+        return dest
+
+    to_static_state_dict = state_dict
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        """Returns (missing_keys, unexpected_keys) like paddle."""
+        own = self.state_dict()
+        missing, unexpected = [], []
+        matched = {}
+        for k, v in state_dict.items():
+            if k in own:
+                matched[k] = v
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in matched:
+                missing.append(k)
+        for k, v in matched.items():
+            target = own[k]
+            arr = v._value if isinstance(v, Tensor) else np.asarray(v)
+            arr = jnp.asarray(arr, dtype=target._value.dtype)
+            if tuple(arr.shape) != tuple(target.shape):
+                raise ValueError(
+                    f"shape mismatch for {k}: loaded {tuple(arr.shape)} vs "
+                    f"param {tuple(target.shape)}")
+            target._rebind(arr)
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # -- dtype / device movement -------------------------------------------
+    def _transform(self, fn):
+        for _, p in self.named_parameters():
+            p._rebind(fn(p._value))
+        for _, b in self.named_buffers():
+            b._rebind(fn(b._value))
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        import jax
+        from ...core import device as devices
+        if dtype is not None:
+            np_dt = dtypes.to_np_dtype(dtype)
+            self._transform(lambda v: v.astype(np_dt)
+                            if np.dtype(v.dtype).kind in "fc" else v)
+            for l in self.sublayers(include_self=True):
+                l._dtype = dtypes.convert_dtype(dtype)
+        if device is not None:
+            dev = devices.jax_device(device)
+            self._transform(lambda v: jax.device_put(v, dev))
+        return self
+
+    def astype(self, dtype=None):
+        return self.to(dtype=dtype)
+
+    def float(self, excluded_layers=None):
+        return self.to(dtype="float32")
+
+    def float16(self, excluded_layers=None):
+        return self.to(dtype="float16")
+
+    def bfloat16(self, excluded_layers=None):
+        return self.to(dtype="bfloat16")
+
+    # -- misc --------------------------------------------------------------
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, l in self.named_children():
+            mod_str = repr(l)
+            mod_str = "\n  ".join(mod_str.split("\n"))
+            lines.append(f"({name}): {mod_str}")
+        main = self.__class__.__name__ + "("
+        if extra:
+            main += extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"

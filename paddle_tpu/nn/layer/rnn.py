@@ -1,0 +1,403 @@
+"""Recurrent layers.
+
+TPU-native replacement for Paddle's RNN stack (reference:
+python/paddle/nn/layer/rnn.py, cuDNN kernels in
+paddle/phi/kernels/gpu/rnn_kernel.cu). The whole multi-layer,
+(bi)directional recurrence is ONE registered op running `lax.scan` —
+compiled once by XLA with the weight-gemms batched on the MXU — instead of
+the per-timestep op dispatch of the reference's non-cuDNN path.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.dispatch import register_op
+from ...ops._helpers import as_tensor, apply_op
+from .layers import Layer
+from .container import LayerList
+from ..initializer import Uniform
+from .. import functional as F
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+def _cell_step(mode, x, h, c, w_ih, w_hh, b_ih, b_hh, activation="tanh"):
+    gates = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        gates = gates + b_ih + b_hh
+    if mode == "LSTM":
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+    if mode == "GRU":
+        # paddle gate order r, z, c; h' = z*h + (1-z)*c
+        xg = x @ w_ih.T + (b_ih if b_ih is not None else 0.0)
+        hg = h @ w_hh.T + (b_hh if b_hh is not None else 0.0)
+        xr, xz, xc = jnp.split(xg, 3, axis=-1)
+        hr, hz, hc = jnp.split(hg, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        c_new = jnp.tanh(xc + r * hc)
+        h_new = z * h + (1.0 - z) * c_new
+        return h_new, None
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+    h_new = act(gates)
+    return h_new, None
+
+
+def _run_direction(mode, x, h0, c0, w_ih, w_hh, b_ih, b_hh, reverse,
+                   activation):
+    """x: [T, B, I] -> (outputs [T, B, H], h_T, c_T)."""
+    if reverse:
+        x = jnp.flip(x, axis=0)
+
+    def step(carry, xt):
+        h, c = carry
+        h_new, c_new = _cell_step(mode, xt, h, c, w_ih, w_hh, b_ih, b_hh,
+                                  activation)
+        return (h_new, c_new if c_new is not None else c), h_new
+
+    (h_t, c_t), outs = jax.lax.scan(step, (h0, c0), x)
+    if reverse:
+        outs = jnp.flip(outs, axis=0)
+    return outs, h_t, c_t
+
+
+def _rnn_fwd(x, init_h, init_c, *weights, mode, num_layers, bidirectional,
+             has_bias, time_major, activation):
+    """Whole RNN as one jitted program. x: [B, T, I] or [T, B, I]."""
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)
+    n_dir = 2 if bidirectional else 1
+    w_per = 4 if has_bias else 2
+    outs = x
+    final_h, final_c = [], []
+    idx = 0
+    for layer in range(num_layers):
+        layer_outs = []
+        for d in range(n_dir):
+            w = weights[idx:idx + w_per]
+            idx += w_per
+            w_ih, w_hh = w[0], w[1]
+            b_ih = w[2] if has_bias else None
+            b_hh = w[3] if has_bias else None
+            state = layer * n_dir + d
+            h0 = init_h[state]
+            c0 = init_c[state] if init_c is not None else jnp.zeros_like(h0)
+            o, h_t, c_t = _run_direction(mode, outs, h0, c0, w_ih, w_hh,
+                                         b_ih, b_hh, d == 1, activation)
+            layer_outs.append(o)
+            final_h.append(h_t)
+            final_c.append(c_t)
+        outs = (jnp.concatenate(layer_outs, axis=-1) if n_dir == 2
+                else layer_outs[0])
+    out = outs if time_major else jnp.swapaxes(outs, 0, 1)
+    h_stack = jnp.stack(final_h)
+    if mode == "LSTM":
+        return out, h_stack, jnp.stack(final_c)
+    return out, h_stack
+
+
+register_op("rnn_net", lambda x, h, *rest, **attrs:
+            _rnn_fwd(x, h, None, *rest, **attrs))
+register_op("lstm_net", lambda x, h, c, *rest, **attrs:
+            _rnn_fwd(x, h, c, *rest, **attrs))
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...core import dtype as dtypes
+        batch = batch_ref.shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        if isinstance(shape, (list, tuple)) and isinstance(
+                shape[0], (list, tuple)):
+            return tuple(
+                Tensor(jnp.full((batch,) + tuple(s), init_value,
+                                dtypes.get_default_dtype().np_dtype))
+                for s in shape)
+        return Tensor(jnp.full((batch,) + tuple(shape), init_value,
+                               dtypes.get_default_dtype().np_dtype))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = apply_op("simple_rnn_cell", as_tensor(inputs), states,
+                     self.weight_ih, self.weight_hh, self.bias_ih,
+                     self.bias_hh, attrs=dict(activation=self.activation))
+        return h, h
+
+
+register_op("simple_rnn_cell",
+            lambda x, h, w_ih, w_hh, b_ih, b_hh, activation:
+            _cell_step("RNN", x, h, None, w_ih, w_hh, b_ih, b_hh,
+                       activation)[0])
+
+
+def _lstm_cell_fwd(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    return _cell_step("LSTM", x, h, c, w_ih, w_hh, b_ih, b_hh)
+
+
+register_op("lstm_cell", _lstm_cell_fwd)
+register_op("gru_cell",
+            lambda x, h, w_ih, w_hh, b_ih, b_hh:
+            _cell_step("GRU", x, h, None, w_ih, w_hh, b_ih, b_hh)[0])
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        h_new, c_new = apply_op(
+            "lstm_cell", as_tensor(inputs), h, c, self.weight_ih,
+            self.weight_hh, self.bias_ih, self.bias_hh)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = apply_op("gru_cell", as_tensor(inputs), states, self.weight_ih,
+                     self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (reference: nn/layer/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        from ...ops import manipulation
+        x = as_tensor(inputs)
+        time_axis = 0 if self.time_major else 1
+        steps = x.shape[time_axis]
+        if initial_states is None:
+            initial_states = self.cell.get_initial_states(
+                x, batch_dim_idx=0 if not self.time_major else 1)
+        states = initial_states
+        outs = []
+        t_range = range(steps - 1, -1, -1) if self.is_reverse \
+            else range(steps)
+        for t in t_range:
+            xt = (manipulation.slice(x, [time_axis], [t], [t + 1])
+                  .squeeze(time_axis))
+            out, states = self.cell(xt, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        stacked = manipulation.stack(outs, axis=time_axis)
+        return stacked, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        from ...ops import manipulation
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, s_fw, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, s_bw, sequence_length)
+        out = manipulation.concat([out_fw, out_bw], axis=-1)
+        return out, (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    _mode = "RNN"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        n_gates = {"RNN": 1, "LSTM": 4, "GRU": 3}[self._mode]
+        n_dir = 2 if self.bidirectional else 1
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(n_dir):
+                in_size = input_size if layer == 0 else hidden_size * n_dir
+                suffix = "_reverse" if d == 1 else ""
+                w_ih = self.create_parameter(
+                    [n_gates * hidden_size, in_size], weight_ih_attr,
+                    default_initializer=init)
+                w_hh = self.create_parameter(
+                    [n_gates * hidden_size, hidden_size], weight_hh_attr,
+                    default_initializer=init)
+                b_ih = self.create_parameter(
+                    [n_gates * hidden_size], bias_ih_attr, is_bias=True,
+                    default_initializer=init)
+                b_hh = self.create_parameter(
+                    [n_gates * hidden_size], bias_hh_attr, is_bias=True,
+                    default_initializer=init)
+                self.add_parameter(f"weight_ih_l{layer}{suffix}", w_ih)
+                self.add_parameter(f"weight_hh_l{layer}{suffix}", w_hh)
+                self.add_parameter(f"bias_ih_l{layer}{suffix}", b_ih)
+                self.add_parameter(f"bias_hh_l{layer}{suffix}", b_hh)
+                self._all_weights += [w_ih, w_hh, b_ih, b_hh]
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        import jax.numpy as jnp
+        from ...core import dtype as dtypes
+        x = as_tensor(inputs)
+        n_dir = 2 if self.bidirectional else 1
+        n_states = self.num_layers * n_dir
+        batch = x.shape[1 if self.time_major else 0]
+        np_dt = np.dtype(x._value.dtype)
+        if initial_states is None:
+            zeros = Tensor(jnp.zeros((n_states, batch, self.hidden_size),
+                                     np_dt))
+            if self._mode == "LSTM":
+                initial_states = (zeros, Tensor(zeros._value))
+            else:
+                initial_states = zeros
+        attrs = dict(mode=self._mode, num_layers=self.num_layers,
+                     bidirectional=self.bidirectional, has_bias=True,
+                     time_major=self.time_major, activation=self.activation)
+        if self._mode == "LSTM":
+            h0, c0 = initial_states
+            out, h_n, c_n = apply_op("lstm_net", x, as_tensor(h0),
+                                     as_tensor(c0), *self._all_weights,
+                                     attrs=attrs)
+            return out, (h_n, c_n)
+        out, h_n = apply_op("rnn_net", x, as_tensor(initial_states),
+                            *self._all_weights, attrs=attrs)
+        return out, h_n
+
+
+class SimpleRNN(_RNNBase):
+    _mode = "RNN"
+
+
+class LSTM(_RNNBase):
+    _mode = "LSTM"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, proj_size=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, "tanh", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr, name)
+
+
+class GRU(_RNNBase):
+    _mode = "GRU"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, "tanh", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr, name)

@@ -1,0 +1,109 @@
+"""paddle.nn.utils parity (reference: python/paddle/nn/utils/)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["parameters_to_vector", "vector_to_parameters", "weight_norm",
+           "remove_weight_norm", "spectral_norm"]
+
+
+def parameters_to_vector(parameters, name=None):
+    vals = [p._value.reshape(-1) for p in parameters]
+    return Tensor(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    v = vec._value
+    for p in parameters:
+        n = p.size
+        p._rebind(v[offset:offset + n].reshape(p.shape).astype(
+            p._value.dtype))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v/||v|| (reference:
+    python/paddle/nn/utils/weight_norm_hook.py). Implemented as a forward
+    pre-hook recomputing the weight each call."""
+    import numpy as np
+    from ...ops import linalg
+    param = getattr(layer, name)
+    w = param._value
+    if dim is None:
+        axes = None
+    else:
+        axes = tuple(i for i in range(w.ndim) if i != dim)
+    g0 = jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=True)) \
+        if axes is not None else jnp.linalg.norm(w)
+    from ...core.tensor import Parameter
+    g = Parameter(g0)
+    v = Parameter(w)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        vv = lyr._parameters[name + "_v"]
+        gg = lyr._parameters[name + "_g"]
+        if axes is not None:
+            norm = jnp.sqrt(jnp.sum(jnp.square(vv._value), axis=axes,
+                                    keepdims=True) + 1e-12)
+        else:
+            norm = jnp.linalg.norm(vv._value) + 1e-12
+        from ...core.tensor import apply_op as _apply
+        # compute in the tape so grads flow to v and g
+        from ...ops import math as math_ops
+        wt = math_ops.multiply(math_ops.divide(vv, Tensor(norm)), gg)
+        object.__setattr__(lyr, "_wn_weight", wt)
+        # forward reads self.<name> from __dict__, bypassing _parameters
+        object.__setattr__(lyr, name, wt)
+        return None
+
+    h = layer.register_forward_pre_hook(hook)
+    layer._wn_hook = h
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    if hasattr(layer, "_wn_hook"):
+        layer._wn_hook.remove()
+        del layer._wn_hook
+    v = layer._parameters.pop(name + "_v", None)
+    g = layer._parameters.pop(name + "_g", None)
+    if v is not None and g is not None:
+        w = getattr(layer, "_wn_weight", None)
+        from ...core.tensor import Parameter
+        if w is None:
+            val = v._value
+        else:
+            val = w._value
+        if name in layer.__dict__:
+            object.__delattr__(layer, name)
+        layer._parameters.pop(name, None)
+        layer.add_parameter(name, Parameter(val))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    from ..layer.norm import SpectralNorm
+    param = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = SpectralNorm(param.shape, dim=dim, power_iters=n_power_iterations,
+                      epsilon=eps)
+    layer.add_sublayer(name + "_sn", sn)
+    orig = layer._parameters[name]
+
+    def hook(lyr, inputs):
+        w = sn(lyr._parameters[name + "_orig"])
+        object.__setattr__(lyr, name, w)
+        return None
+
+    layer.add_parameter(name + "_orig", orig)
+    del layer._parameters[name]
+    layer.register_forward_pre_hook(hook)
+    return layer

@@ -47,7 +47,16 @@ register_op("reshape", lambda x, shape=None: jnp.reshape(x, shape))
 
 
 def reshape(x, shape, name=None):
-    return apply_op("reshape", as_tensor(x), attrs=dict(shape=_shape_arg(shape)))
+    x = as_tensor(x)
+    shp = list(_shape_arg(shape))
+    # paddle semantics: 0 means "copy the corresponding input dim"
+    for i, s in enumerate(shp):
+        if s == 0:
+            if i >= x.ndim:
+                raise ValueError(
+                    f"reshape dim {i} is 0 but input has only {x.ndim} dims")
+            shp[i] = x.shape[i]
+    return apply_op("reshape", x, attrs=dict(shape=tuple(shp)))
 
 
 def reshape_(x, shape, name=None):

@@ -1,0 +1,166 @@
+"""Flash attention (Pallas, TPU).
+
+TPU-native replacement for the reference's fused FMHA CUDA
+(paddle/fluid/operators/fused/fused_attention_op.cu, fmha_ref.h). Online
+softmax over K/V blocks: running (m, l, acc) scratch in VMEM, one MXU
+dot per (q-block, k-block) pair, no [L, L] logits materialized in HBM.
+
+Forward runs the kernel; backward recomputes attention with the plain-XLA
+reference math via jax.custom_vjp (the standard TPU remat trade — see
+SURVEY.md §7 "fused_attention → Pallas flash-attention custom-calls").
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+               scale, causal, block_q, block_k, kv_len):
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal: whole k-block strictly after the last query of this q-block
+    # contributes nothing — predicate the compute away.
+    run = True
+    if causal:
+        run = kj * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]                       # [bq, d]
+        k = k_ref[0]                       # [bk, d]
+        v = v_ref[0]                       # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_pos < kv_len
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = jnp.logical_and(valid, q_pos >= k_pos)
+        s = jnp.where(valid, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]              # [bq, 1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(kj == n_kv - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _pad_to(x, axis, mult):
+    n = x.shape[axis]
+    rem = (-n) % mult
+    if rem == 0:
+        return x
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (0, rem)
+    return jnp.pad(x, pads)
+
+
+def _flash_fwd_bhld(q, k, v, causal, scale, block_q, block_k):
+    """q: [BH, Lq, D], k/v: [BH, Lk, D] -> [BH, Lq, D]."""
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    block_q = min(block_q, max(128, 1))
+    qp = _pad_to(q, 1, block_q)
+    kp = _pad_to(k, 1, block_k)
+    vp = _pad_to(v, 1, block_k)
+    n_q = qp.shape[1] // block_q
+    n_k = kp.shape[1] // block_k
+
+    kernel = functools.partial(
+        _fa_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, kv_len=lk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(qp, kp, vp)
+    return out[:, :lq]
+
+
+def _ref_blhd(q, k, v, causal, scale):
+    logits = jnp.einsum("blhd,bmhd->bhlm", q, k).astype(jnp.float32) * scale
+    if causal:
+        lq, lk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((lq, lk), dtype=bool), lk - lq)
+        logits = jnp.where(cm, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhlm,bmhd->blhd", probs, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_blhd(q, k, v, causal=False, scale=None,
+                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Flash attention over [batch, seq, heads, head_dim] inputs."""
+    return _fa_fwd(q, k, v, causal, scale, block_q, block_k)[0]
+
+
+def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    out = _flash_fwd_bhld(qt, kt, vt, causal, scale, block_q, block_k)
+    out = out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+    return out, (q, k, v)
+
+
+def _fa_bwd(causal, scale, block_q, block_k, res, g):
+    q, k, v = res
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    _, vjp = jax.vjp(lambda q, k, v: _ref_blhd(q, k, v, causal, scale),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention_blhd.defvjp(_fa_fwd, _fa_bwd)

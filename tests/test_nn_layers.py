@@ -1,0 +1,441 @@
+"""nn layer tests (modelled on the reference's test_layers.py and per-op
+unittests; see SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+from op_test import check_grad
+
+
+def _randn(*shape, dtype="float32"):
+    return np.random.RandomState(sum(shape) + len(shape)).randn(
+        *shape).astype(dtype)
+
+
+class TestLinear:
+    def test_forward(self):
+        lin = nn.Linear(8, 4)
+        x = paddle.to_tensor(_randn(2, 8))
+        y = lin(x)
+        want = x.numpy() @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(y.numpy(), want, rtol=1e-5, atol=1e-5)
+
+    def test_grad(self):
+        lin = nn.Linear(5, 3)
+        check_grad(lambda x: lin(x), [_randn(4, 5)])
+
+    def test_no_bias(self):
+        lin = nn.Linear(8, 4, bias_attr=False)
+        assert lin.bias is None
+        assert lin(paddle.to_tensor(_randn(2, 8))).shape == [2, 4]
+
+
+class TestConv2D:
+    def test_forward_shape(self):
+        conv = nn.Conv2D(3, 16, 3, stride=2, padding=1)
+        y = conv(paddle.to_tensor(_randn(2, 3, 8, 8)))
+        assert y.shape == [2, 16, 4, 4]
+
+    def test_vs_numpy_1x1(self):
+        conv = nn.Conv2D(4, 2, 1, bias_attr=False)
+        x = _randn(1, 4, 5, 5)
+        y = conv(paddle.to_tensor(x))
+        w = conv.weight.numpy()  # [2, 4, 1, 1]
+        want = np.einsum("nchw,oc->nohw", x, w[:, :, 0, 0])
+        np.testing.assert_allclose(y.numpy(), want, rtol=1e-4, atol=1e-4)
+
+    def test_groups(self):
+        conv = nn.Conv2D(4, 8, 3, groups=2, padding=1)
+        assert conv(paddle.to_tensor(_randn(2, 4, 6, 6))).shape == [2, 8, 6, 6]
+
+    def test_grad(self):
+        conv = nn.Conv2D(2, 3, 3, padding=1)
+        check_grad(lambda x: conv(x), [_randn(1, 2, 5, 5)], rtol=5e-2,
+                   atol=5e-3)
+
+    def test_transpose(self):
+        convt = nn.Conv2DTranspose(4, 2, 3, stride=2, padding=1,
+                                   output_padding=1)
+        y = convt(paddle.to_tensor(_randn(2, 4, 5, 5)))
+        assert y.shape == [2, 2, 10, 10]
+
+    def test_conv1d_3d(self):
+        c1 = nn.Conv1D(3, 6, 3, padding=1)
+        assert c1(paddle.to_tensor(_randn(2, 3, 10))).shape == [2, 6, 10]
+        c3 = nn.Conv3D(2, 4, 3, padding=1)
+        assert c3(paddle.to_tensor(_randn(1, 2, 4, 4, 4))).shape == \
+            [1, 4, 4, 4, 4]
+
+
+class TestPooling:
+    def test_max_pool(self):
+        x = paddle.to_tensor(_randn(1, 2, 4, 4))
+        y = F.max_pool2d(x, 2)
+        want = x.numpy().reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+        np.testing.assert_allclose(y.numpy(), want, rtol=1e-6)
+
+    def test_avg_pool_padding_exclusive(self):
+        x = paddle.to_tensor(np.ones((1, 1, 4, 4), "float32"))
+        y = F.avg_pool2d(x, 3, stride=1, padding=1, exclusive=True)
+        # all-ones input with exclusive padding -> output all ones
+        np.testing.assert_allclose(y.numpy(), np.ones((1, 1, 4, 4)),
+                                   rtol=1e-6)
+
+    def test_adaptive_avg(self):
+        x = paddle.to_tensor(_randn(2, 3, 7, 9))
+        y = F.adaptive_avg_pool2d(x, [3, 4])
+        assert y.shape == [2, 3, 3, 4]
+        # divisible case equals reshape-mean
+        x2 = paddle.to_tensor(_randn(1, 2, 6, 6))
+        y2 = F.adaptive_avg_pool2d(x2, 3)
+        want = x2.numpy().reshape(1, 2, 3, 2, 3, 2).mean(axis=(3, 5))
+        np.testing.assert_allclose(y2.numpy(), want, rtol=1e-5, atol=1e-6)
+
+    def test_adaptive_max(self):
+        x = paddle.to_tensor(_randn(2, 3, 7, 7))
+        assert F.adaptive_max_pool2d(x, 3).shape == [2, 3, 3, 3]
+
+    def test_global_pool(self):
+        x = paddle.to_tensor(_randn(2, 5, 6, 6))
+        y = F.adaptive_avg_pool2d(x, 1)
+        np.testing.assert_allclose(
+            y.numpy()[:, :, 0, 0], x.numpy().mean(axis=(2, 3)), rtol=1e-5,
+            atol=1e-6)
+
+
+class TestNorms:
+    def test_batch_norm_train_stats(self):
+        bn = nn.BatchNorm2D(4, momentum=0.9)
+        x = _randn(8, 4, 5, 5)
+        y = bn(paddle.to_tensor(x))
+        mean = x.mean(axis=(0, 2, 3))
+        np.testing.assert_allclose(
+            bn._mean.numpy(), 0.1 * mean, rtol=1e-4, atol=1e-5)
+        got_mean = y.numpy().mean(axis=(0, 2, 3))
+        np.testing.assert_allclose(got_mean, np.zeros(4), atol=1e-5)
+
+    def test_batch_norm_eval(self):
+        bn = nn.BatchNorm2D(3)
+        bn.eval()
+        x = _randn(2, 3, 4, 4)
+        y = bn(paddle.to_tensor(x))
+        np.testing.assert_allclose(y.numpy(), x / np.sqrt(1 + 1e-5),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_layer_norm(self):
+        ln = nn.LayerNorm(16)
+        x = _randn(4, 16)
+        y = ln(paddle.to_tensor(x)).numpy()
+        want = (x - x.mean(-1, keepdims=True)) / np.sqrt(
+            x.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(y, want, rtol=1e-4, atol=1e-4)
+
+    def test_layer_norm_grad(self):
+        ln = nn.LayerNorm(8)
+        check_grad(lambda x: ln(x), [_randn(3, 8)], rtol=5e-2, atol=5e-3)
+
+    def test_group_norm(self):
+        gn = nn.GroupNorm(2, 4)
+        x = _randn(2, 4, 3, 3)
+        y = gn(paddle.to_tensor(x)).numpy()
+        xs = x.reshape(2, 2, 2, 3, 3)
+        want = ((xs - xs.mean(axis=(2, 3, 4), keepdims=True)) /
+                np.sqrt(xs.var(axis=(2, 3, 4), keepdims=True) + 1e-5)
+                ).reshape(2, 4, 3, 3)
+        np.testing.assert_allclose(y, want, rtol=1e-4, atol=1e-4)
+
+    def test_instance_norm(self):
+        inorm = nn.InstanceNorm2D(3)
+        x = _randn(2, 3, 4, 4)
+        y = inorm(paddle.to_tensor(x)).numpy()
+        want = (x - x.mean(axis=(2, 3), keepdims=True)) / np.sqrt(
+            x.var(axis=(2, 3), keepdims=True) + 1e-5)
+        np.testing.assert_allclose(y, want, rtol=1e-4, atol=1e-4)
+
+    def test_rms_norm(self):
+        rn = nn.RMSNorm(8)
+        x = _randn(2, 8)
+        y = rn(paddle.to_tensor(x)).numpy()
+        want = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(y, want, rtol=1e-4, atol=1e-4)
+
+
+class TestActivationsAndDropout:
+    def test_activations(self):
+        x = _randn(3, 5)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(F.relu(t).numpy(), np.maximum(x, 0))
+        np.testing.assert_allclose(F.sigmoid(t).numpy(),
+                                   1 / (1 + np.exp(-x)), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.softmax(t).numpy().sum(-1), np.ones(3), rtol=1e-5)
+        np.testing.assert_allclose(F.relu6(t).numpy(),
+                                   np.clip(x, 0, 6), rtol=1e-6)
+
+    def test_dropout_train_eval(self):
+        x = paddle.to_tensor(np.ones((100, 100), "float32"))
+        paddle.seed(42)
+        y = F.dropout(x, 0.5, training=True)
+        frac = float((y.numpy() == 0).mean())
+        assert 0.4 < frac < 0.6
+        # upscale keeps expectation
+        assert abs(float(y.numpy().mean()) - 1.0) < 0.1
+        y_eval = F.dropout(x, 0.5, training=False)
+        np.testing.assert_allclose(y_eval.numpy(), x.numpy())
+
+    def test_dropout2d_whole_channels(self):
+        x = paddle.to_tensor(np.ones((4, 8, 5, 5), "float32"))
+        y = F.dropout2d(x, 0.5, training=True).numpy()
+        per_chan = y.reshape(4, 8, -1)
+        is_zero = (per_chan == 0).all(axis=2)
+        is_kept = (per_chan != 0).all(axis=2)
+        assert np.all(is_zero | is_kept)
+
+
+class TestLosses:
+    def test_mse(self):
+        a, b = _randn(4, 3), _randn(4, 3)
+        got = F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(float(got), ((a - b) ** 2).mean(),
+                                   rtol=1e-5)
+
+    def test_cross_entropy_matches_numpy(self):
+        logits = _randn(6, 10)
+        label = np.array([0, 3, 9, 2, 2, 7])
+        got = float(F.cross_entropy(paddle.to_tensor(logits),
+                                    paddle.to_tensor(label)))
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        want = -np.log(p[np.arange(6), label]).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = _randn(4, 5)
+        label = np.array([0, -100, 2, -100])
+        got = float(F.cross_entropy(paddle.to_tensor(logits),
+                                    paddle.to_tensor(label),
+                                    ignore_index=-100))
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        want = -np.log(p[[0, 2], [0, 2]]).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_cross_entropy_soft_label(self):
+        logits = _randn(3, 4)
+        soft = np.abs(_randn(3, 4))
+        soft /= soft.sum(-1, keepdims=True)
+        got = float(F.cross_entropy(paddle.to_tensor(logits),
+                                    paddle.to_tensor(soft.astype("float32")),
+                                    soft_label=True))
+        logp = logits - logits.max(-1, keepdims=True)
+        logp = logp - np.log(np.exp(logp).sum(-1, keepdims=True))
+        want = -(soft * logp).sum(-1).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_bce_with_logits(self):
+        x, y = _randn(4, 3), (np.random.rand(4, 3) > 0.5).astype("float32")
+        got = float(F.binary_cross_entropy_with_logits(
+            paddle.to_tensor(x), paddle.to_tensor(y)))
+        p = 1 / (1 + np.exp(-x))
+        want = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_kl_div(self):
+        x = np.log(np.abs(_randn(3, 4)) + 0.1).astype("float32")
+        y = np.abs(_randn(3, 4)).astype("float32")
+        got = float(F.kl_div(paddle.to_tensor(x), paddle.to_tensor(y),
+                             reduction="sum"))
+        want = (y * (np.log(y) - x)).sum()
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_ctc_loss_simple(self):
+        # T=4, N=1, C=3 (blank=0); all-equal logits -> known loss
+        T, N, C = 4, 2, 3
+        logits = _randn(T, N, C)
+        labels = np.array([[1, 2], [1, 1]], dtype=np.int64)
+        got = F.ctc_loss(paddle.to_tensor(logits),
+                         paddle.to_tensor(labels),
+                         paddle.to_tensor(np.array([4, 4])),
+                         paddle.to_tensor(np.array([2, 2])),
+                         reduction="none")
+        assert got.shape == [2]
+        assert np.all(np.asarray(got.numpy()) > 0)
+
+
+class TestEmbeddingPad:
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+        y = emb(ids)
+        np.testing.assert_allclose(
+            y.numpy(), emb.weight.numpy()[[[1, 2], [3, 4]]])
+
+    def test_embedding_padding_idx(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        y = emb(paddle.to_tensor(np.array([0, 1])))
+        np.testing.assert_allclose(y.numpy()[0], np.zeros(4))
+
+    def test_pad2d(self):
+        x = paddle.to_tensor(_randn(1, 1, 2, 2))
+        y = F.pad(x, [1, 1, 2, 2])  # l, r, t, b
+        assert y.shape == [1, 1, 6, 4]
+
+    def test_interpolate_nearest(self):
+        x = paddle.to_tensor(_randn(1, 2, 4, 4))
+        y = F.interpolate(x, scale_factor=2, mode="nearest")
+        assert y.shape == [1, 2, 8, 8]
+        np.testing.assert_allclose(
+            y.numpy()[:, :, ::2, ::2], x.numpy(), rtol=1e-6)
+
+
+class TestAttention:
+    def test_sdpa_matches_ref(self):
+        q = _randn(2, 6, 4, 8)
+        k = _randn(2, 6, 4, 8)
+        v = _randn(2, 6, 4, 8)
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v))
+        logits = np.einsum("blhd,bmhd->bhlm", q, k) / np.sqrt(8)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        want = np.einsum("bhlm,bmhd->blhd", p, v)
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-4)
+
+    def test_sdpa_causal(self):
+        q = _randn(1, 4, 2, 8)
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            is_causal=True)
+        # first position attends only to itself
+        np.testing.assert_allclose(out.numpy()[:, 0], q[:, 0], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_multi_head_attention(self):
+        mha = nn.MultiHeadAttention(32, 4)
+        x = paddle.to_tensor(_randn(2, 6, 32), stop_gradient=False)
+        y = mha(x)
+        assert y.shape == [2, 6, 32]
+        y.mean().backward()
+        assert mha.q_proj.weight.grad is not None
+
+    def test_mha_cache(self):
+        mha = nn.MultiHeadAttention(16, 2)
+        x = paddle.to_tensor(_randn(1, 3, 16))
+        cache = mha.gen_cache(x, x)
+        step = paddle.to_tensor(_randn(1, 1, 16))
+        out, new_cache = mha(step, step, step, cache=cache)
+        assert out.shape == [1, 1, 16]
+        assert new_cache.k.shape[1] == 4
+
+
+class TestTransformer:
+    def test_encoder(self):
+        layer = nn.TransformerEncoderLayer(32, 4, 64)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = paddle.to_tensor(_randn(2, 5, 32))
+        assert enc(x).shape == [2, 5, 32]
+        # layers must have independent params
+        p0 = enc.layers[0].linear1.weight
+        p1 = enc.layers[1].linear1.weight
+        assert p0 is not p1
+
+    def test_full_transformer(self):
+        t = nn.Transformer(d_model=32, nhead=4, num_encoder_layers=2,
+                           num_decoder_layers=2, dim_feedforward=64)
+        src = paddle.to_tensor(_randn(2, 5, 32))
+        tgt = paddle.to_tensor(_randn(2, 4, 32))
+        out = t(src, tgt)
+        assert out.shape == [2, 4, 32]
+
+
+class TestRNN:
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(8, 16, num_layers=2)
+        x = paddle.to_tensor(_randn(3, 5, 8))
+        y, (h, c) = lstm(x)
+        assert y.shape == [3, 5, 16]
+        assert h.shape == [2, 3, 16] and c.shape == [2, 3, 16]
+
+    def test_gru_cell_vs_net(self):
+        gru = nn.GRU(4, 8, num_layers=1)
+        x = _randn(2, 3, 4)
+        y, h = gru(paddle.to_tensor(x))
+        # replay with the cell equations in numpy
+        w_ih = gru.weight_ih_l0.numpy()
+        w_hh = gru.weight_hh_l0.numpy()
+        b_ih = gru.bias_ih_l0.numpy()
+        b_hh = gru.bias_hh_l0.numpy()
+        ht = np.zeros((2, 8), "float32")
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        for t in range(3):
+            xg = x[:, t] @ w_ih.T + b_ih
+            hg = ht @ w_hh.T + b_hh
+            xr, xz, xc = np.split(xg, 3, -1)
+            hr, hz, hc = np.split(hg, 3, -1)
+            r, z = sig(xr + hr), sig(xz + hz)
+            c = np.tanh(xc + r * hc)
+            ht = z * ht + (1 - z) * c
+        np.testing.assert_allclose(y.numpy()[:, -1], ht, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_rnn_wrapper_cell(self):
+        cell = nn.LSTMCell(6, 10)
+        rnn = nn.RNN(cell)
+        x = paddle.to_tensor(_randn(2, 4, 6))
+        y, (h, c) = rnn(x)
+        assert y.shape == [2, 4, 10]
+        assert h.shape == [2, 10]
+
+
+class TestLayerMechanics:
+    def test_hooks(self):
+        lin = nn.Linear(4, 4)
+        calls = []
+        h = lin.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        lin(paddle.to_tensor(_randn(1, 4)))
+        assert calls == [1]
+        h.remove()
+        lin(paddle.to_tensor(_randn(1, 4)))
+        assert calls == [1]
+
+    def test_train_eval_propagate(self):
+        m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+        m.eval()
+        assert not m[1].training
+        m.train()
+        assert m[1].training
+
+    def test_named_parameters(self):
+        m = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 2))
+        names = dict(m.named_parameters())
+        assert "0.weight" in names and "1.bias" in names
+
+    def test_state_dict_roundtrip(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8))
+        sd = m.state_dict()
+        assert "1._mean" in sd
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8))
+        missing, unexpected = m2.set_state_dict(sd)
+        assert not missing and not unexpected
+        np.testing.assert_allclose(m2[0].weight.numpy(),
+                                   m[0].weight.numpy())
+
+    def test_apply_and_sublayers(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Sequential(nn.Linear(2, 2)))
+        n_layers = len(m.sublayers())
+        assert n_layers == 3
+        seen = []
+        m.apply(lambda l: seen.append(type(l).__name__))
+        assert len(seen) == 4  # includes self
+
+    def test_clip_global_norm(self):
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        p = paddle.to_tensor(_randn(3, 3), stop_gradient=False)
+        g = paddle.to_tensor(np.full((3, 3), 10.0, "float32"))
+        out = clip([(p, g)])
+        norm = np.linalg.norm(out[0][1].numpy())
+        np.testing.assert_allclose(norm, 1.0, rtol=1e-4)
