@@ -64,6 +64,11 @@ from .ops import creation as _creation  # noqa: E402
 # modules (populated progressively)
 from . import ops  # noqa: E402,F401
 from .ops import linalg  # noqa: E402,F401
+from . import nn  # noqa: E402,F401
+from . import optimizer  # noqa: E402,F401
+from . import regularizer  # noqa: E402,F401
+from .regularizer import L1Decay, L2Decay  # noqa: E402,F401
+from .nn.layer.layers import ParamAttr  # noqa: E402,F401
 
 bool = bool_  # paddle.bool
 

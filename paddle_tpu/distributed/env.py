@@ -1,0 +1,69 @@
+"""Distributed environment state.
+
+Reference: python/paddle/distributed/parallel.py (ParallelEnv reads
+PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM set by the launcher). On TPU a
+"rank" is a host process in a multi-host job (or a virtual position when
+one process drives the whole mesh via GSPMD — the common case — where
+world_size stays 1 and the mesh handles parallelism inside the program).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["ParallelEnv", "get_rank", "get_world_size"]
+
+
+class ParallelEnv:
+    def __init__(self):
+        self._rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._world_size = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        endpoints = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = endpoints.split(",") if endpoints else []
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+        self._device_id = int(os.getenv("FLAGS_selected_tpus",
+                                        os.getenv("FLAGS_selected_gpus",
+                                                  "0")).split(",")[0] or 0)
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def local_rank(self):
+        return self._rank
+
+    @property
+    def nranks(self):
+        return self._world_size
+
+    @property
+    def dev_id(self):
+        return self._device_id
+
+    @property
+    def device_id(self):
+        return self._device_id
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    return ParallelEnv().rank
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return ParallelEnv().world_size

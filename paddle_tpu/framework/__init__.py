@@ -1,0 +1,4 @@
+"""Framework-level helpers (reference: python/paddle/framework/)."""
+from .io import save, load  # noqa: F401
+from ..core.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from ..core.dtype import set_default_dtype, get_default_dtype  # noqa: F401

@@ -1,0 +1,79 @@
+"""Object save/load.
+
+TPU-native replacement for paddle.save/load (reference:
+python/paddle/framework/io.py:639 save, :881 load). Same pickle-compatible
+semantics: nested dicts/lists of tensors round-trip; Tensors serialize as
+numpy arrays + metadata, so checkpoints are portable across hosts and
+mesh shapes (sharded jax.Arrays gather to host first — the replacement
+for per-tensor protobuf _save_lod_tensor).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor, Parameter
+
+
+class _TensorPayload:
+    """Pickle surrogate for a Tensor."""
+
+    def __init__(self, array, name, is_parameter, stop_gradient):
+        self.array = array
+        self.name = name
+        self.is_parameter = is_parameter
+        self.stop_gradient = stop_gradient
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._value), obj.name,
+                              isinstance(obj, Parameter), obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        if hasattr(obj, "_fields"):  # namedtuple
+            return t(*[_pack(v) for v in obj])
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        import jax.numpy as jnp
+        if obj.is_parameter:
+            t = Parameter(jnp.asarray(obj.array), name=obj.name)
+        else:
+            t = Tensor(jnp.asarray(obj.array), name=obj.name,
+                       stop_gradient=obj.stop_gradient)
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        if hasattr(obj, "_fields"):
+            return t(*[_unpack(v, return_numpy) for v in obj])
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """paddle.save parity; path conventions match (*.pdparams etc.)."""
+    path = str(path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    """paddle.load parity. `return_numpy=True` gives numpy arrays."""
+    with open(str(path), "rb") as f:
+        data = pickle.load(f)
+    return _unpack(data, return_numpy=configs.get("return_numpy", False))

@@ -68,12 +68,23 @@ def _ce_hard_fwd(logits, label, axis, ignore_index, use_softmax, smoothing,
     return loss
 
 
-def _ce_soft_fwd(logits, label, axis, use_softmax, reduction):
+def _ce_soft_fwd(logits, label, axis, use_softmax, reduction, *weight):
     if use_softmax:
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
     else:
         logp = jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-10, 1.0))
-    loss = -jnp.sum(label.astype(logp.dtype) * logp, axis=axis)
+    lbl = label.astype(logp.dtype)
+    if weight:
+        w = weight[0].astype(logp.dtype)
+        shape = [1] * logp.ndim
+        shape[axis] = -1
+        wb = w.reshape(shape)
+        loss = -jnp.sum(lbl * logp * wb, axis=axis)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(lbl * wb), 1e-12)
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+    loss = -jnp.sum(lbl * logp, axis=axis)
     return _reduce(loss, reduction)
 
 
@@ -87,6 +98,9 @@ register_op("cross_entropy_hard_w",
                 logits, label, axis, ignore_index, use_softmax, smoothing,
                 reduction, True, w))
 register_op("cross_entropy_soft", _ce_soft_fwd)
+register_op("cross_entropy_soft_w",
+            lambda logits, label, w, axis, use_softmax, reduction:
+            _ce_soft_fwd(logits, label, axis, use_softmax, reduction, w))
 
 
 def cross_entropy(input, label, weight=None, ignore_index=-100,
@@ -94,6 +108,12 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                   use_softmax=True, label_smoothing=0.0, name=None):
     input, label = as_tensor(input), as_tensor(label)
     if soft_label:
+        if weight is not None:
+            return apply_op("cross_entropy_soft_w", input, label,
+                            as_tensor(weight),
+                            attrs=dict(axis=int(axis),
+                                       use_softmax=bool(use_softmax),
+                                       reduction=reduction))
         return apply_op("cross_entropy_soft", input, label,
                         attrs=dict(axis=int(axis),
                                    use_softmax=bool(use_softmax),
@@ -419,7 +439,7 @@ def triplet_margin_with_distance_loss(input, positive, negative,
 
 
 register_op("soft_margin", lambda x, y, reduction:
-            _reduce(jnp.log1p(jnp.exp(-y * x)), reduction))
+            _reduce(jnp.logaddexp(0.0, -y * x), reduction))
 
 
 def soft_margin_loss(input, label, reduction="mean", name=None):
@@ -451,21 +471,31 @@ def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
                     as_tensor(label), attrs=dict(reduction=reduction))
 
 
-def _multi_margin_fwd(x, label, p, margin, reduction):
+def _multi_margin_fwd(x, label, p, margin, reduction, *weight):
     n, c = x.shape
     picked = jnp.take_along_axis(x, label[:, None], axis=1)
     m = jax.nn.relu(margin - picked + x)
     m = jnp.power(m, p)
+    if weight:
+        m = m * jnp.take(weight[0].astype(m.dtype), label, axis=0)[:, None]
     mask = jax.nn.one_hot(label, c, dtype=x.dtype)
     loss = jnp.sum(m * (1 - mask), axis=1) / c
     return _reduce(loss, reduction)
 
 
 register_op("multi_margin", _multi_margin_fwd)
+register_op("multi_margin_w",
+            lambda x, label, w, p, margin, reduction:
+            _multi_margin_fwd(x, label, p, margin, reduction, w))
 
 
 def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
                       reduction="mean", name=None):
+    if weight is not None:
+        return apply_op("multi_margin_w", as_tensor(input), as_tensor(label),
+                        as_tensor(weight),
+                        attrs=dict(p=float(p), margin=float(margin),
+                                   reduction=reduction))
     return apply_op("multi_margin", as_tensor(input), as_tensor(label),
                     attrs=dict(p=float(p), margin=float(margin),
                                reduction=reduction))

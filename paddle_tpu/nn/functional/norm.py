@@ -270,7 +270,8 @@ def _lrn_fwd(x, size, alpha, beta, k, channel_last):
     win[c_axis] = size
     acc = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(win),
                                 (1,) * x.ndim, "valid")
-    return x / jnp.power(k + alpha * acc, beta)
+    # paddle normalizes by the window MEAN (avg_pool of squares), not sum
+    return x / jnp.power(k + alpha * acc / size, beta)
 
 
 register_op("local_response_norm",

@@ -47,12 +47,15 @@ def _max_pool_fwd(x, kernel, stride, padding, channel_last, n):
     return lax.reduce_window(x, init, lax.max, dims, strides, pads)
 
 
-def _avg_pool_fwd(x, kernel, stride, padding, exclusive, channel_last, n):
+def _avg_pool_fwd(x, kernel, stride, padding, exclusive, channel_last, n,
+                  divisor=None):
     dims, strides = _window(n, kernel, stride, channel_last)
     pads = _full_pads(n, padding, channel_last)
     summed = lax.reduce_window(x.astype(jnp.float32) if x.dtype == jnp.bfloat16
                                else x, 0.0, lax.add, dims, strides, pads)
-    if exclusive and any(lo or hi for lo, hi in padding):
+    if divisor is not None:
+        out = summed / float(divisor)
+    elif exclusive and any(lo or hi for lo, hi in padding):
         ones = jnp.ones(x.shape, dtype=summed.dtype)
         counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
         out = summed / counts
@@ -66,16 +69,18 @@ for _n in (1, 2, 3):
         def maxp(x, kernel, stride, padding, channel_last):
             return _max_pool_fwd(x, kernel, stride, padding, channel_last, n)
 
-        def avgp(x, kernel, stride, padding, exclusive, channel_last):
+        def avgp(x, kernel, stride, padding, exclusive, channel_last,
+                 divisor=None):
             return _avg_pool_fwd(x, kernel, stride, padding, exclusive,
-                                 channel_last, n)
+                                 channel_last, n, divisor)
         return maxp, avgp
     _m, _a = _make(_n)
     register_op(f"max_pool{_n}d", _m)
     register_op(f"avg_pool{_n}d", _a)
 
 
-def _pool_impl(op, n, x, kernel_size, stride, padding, data_format, **extra):
+def _pool_impl(op, n, x, kernel_size, stride, padding, data_format,
+               ceil_mode=False, **extra):
     x = as_tensor(x)
     channel_last = data_format.endswith("C") and not data_format.startswith("NC")
     kernel = _norm_tuple(kernel_size, n, "kernel_size")
@@ -83,6 +88,16 @@ def _pool_impl(op, n, x, kernel_size, stride, padding, data_format, **extra):
     padding = _norm_padding(padding, n, data_format)
     if isinstance(padding, str):
         raise ValueError("string padding unsupported for pooling")
+    if ceil_mode:
+        # grow the high-side pad so the last partial window is kept
+        spatial = (x.shape[1:1 + n] if channel_last else x.shape[2:2 + n])
+        new_pads = []
+        for i, (lo, hi) in enumerate(padding):
+            total = spatial[i] + lo + hi
+            out = -(-(total - kernel[i]) // stride[i]) + 1  # ceil div
+            needed = (out - 1) * stride[i] + kernel[i]
+            new_pads.append((lo, hi + max(needed - total, 0)))
+        padding = tuple(new_pads)
     attrs = dict(kernel=kernel, stride=stride, padding=padding,
                  channel_last=channel_last, **extra)
     return apply_op(op, x, attrs=attrs)
@@ -91,7 +106,8 @@ def _pool_impl(op, n, x, kernel_size, stride, padding, data_format, **extra):
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
     fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
-    out = _pool_impl("max_pool1d", 1, x, kernel_size, stride, padding, fmt)
+    out = _pool_impl("max_pool1d", 1, x, kernel_size, stride, padding, fmt,
+                     ceil_mode=ceil_mode)
     if return_mask:
         return out, _pool_mask(x, out, 1, kernel_size, stride, padding, fmt)
     return out
@@ -100,7 +116,7 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
     out = _pool_impl("max_pool2d", 2, x, kernel_size, stride, padding,
-                     data_format)
+                     data_format, ceil_mode=ceil_mode)
     if return_mask:
         return out, _pool_mask(x, out, 2, kernel_size, stride, padding,
                                data_format)
@@ -110,7 +126,7 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
     out = _pool_impl("max_pool3d", 3, x, kernel_size, stride, padding,
-                     data_format)
+                     data_format, ceil_mode=ceil_mode)
     if return_mask:
         return out, _pool_mask(x, out, 3, kernel_size, stride, padding,
                                data_format)
@@ -121,21 +137,26 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
                ceil_mode=False, data_format="NCL", name=None):
     fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
     return _pool_impl("avg_pool1d", 1, x, kernel_size, stride, padding, fmt,
-                      exclusive=bool(exclusive))
+                      ceil_mode=ceil_mode, exclusive=bool(exclusive),
+                      divisor=None)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW",
                name=None):
     return _pool_impl("avg_pool2d", 2, x, kernel_size, stride, padding,
-                      data_format, exclusive=bool(exclusive))
+                      data_format, ceil_mode=ceil_mode,
+                      exclusive=bool(exclusive),
+                      divisor=divisor_override)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW",
                name=None):
     return _pool_impl("avg_pool3d", 3, x, kernel_size, stride, padding,
-                      data_format, exclusive=bool(exclusive))
+                      data_format, ceil_mode=ceil_mode,
+                      exclusive=bool(exclusive),
+                      divisor=divisor_override)
 
 
 def _pool_mask(x, out, n, kernel_size, stride, padding, data_format):
@@ -210,9 +231,10 @@ def _adaptive_impl(op, n, x, output_size, data_format):
     x = as_tensor(x)
     channel_last = data_format.endswith("C") and not data_format.startswith("NC")
     spatial = x.shape[1:1 + n] if channel_last else x.shape[2:2 + n]
-    out_sizes = _norm_tuple(output_size, n, "output_size")
-    out_sizes = tuple(spatial[i] if out_sizes[i] is None else out_sizes[i]
-                      for i in range(n))
+    if isinstance(output_size, (int, np.integer)):
+        output_size = (int(output_size),) * n
+    out_sizes = tuple(spatial[i] if output_size[i] is None
+                      else int(output_size[i]) for i in range(n))
     return apply_op(op, x, attrs=dict(out_sizes=out_sizes,
                                       channel_last=channel_last))
 

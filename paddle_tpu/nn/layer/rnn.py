@@ -54,35 +54,54 @@ def _cell_step(mode, x, h, c, w_ih, w_hh, b_ih, b_hh, activation="tanh"):
     return h_new, None
 
 
-def _run_direction(mode, x, h0, c0, w_ih, w_hh, b_ih, b_hh, reverse,
+def _run_direction(mode, x, mask, h0, c0, w_ih, w_hh, b_ih, b_hh, reverse,
                    activation):
-    """x: [T, B, I] -> (outputs [T, B, H], h_T, c_T)."""
+    """x: [T, B, I], mask: [T, B] -> (outputs [T, B, H], h_T, c_T).
+
+    Masked steps hold the previous state (so h_T is the state at each
+    sequence's true length) and emit zero outputs, matching the padded-
+    batch semantics of the reference rnn kernel's sequence_length path.
+    """
     if reverse:
         x = jnp.flip(x, axis=0)
+        mask = jnp.flip(mask, axis=0)
 
-    def step(carry, xt):
+    def step(carry, inp):
+        xt, mt = inp
         h, c = carry
         h_new, c_new = _cell_step(mode, xt, h, c, w_ih, w_hh, b_ih, b_hh,
                                   activation)
-        return (h_new, c_new if c_new is not None else c), h_new
+        keep = mt[:, None]
+        h_new = jnp.where(keep, h_new, h)
+        if c_new is not None:
+            c_new = jnp.where(keep, c_new, c)
+        out = jnp.where(keep, h_new, jnp.zeros_like(h_new))
+        return (h_new, c_new if c_new is not None else c), out
 
-    (h_t, c_t), outs = jax.lax.scan(step, (h0, c0), x)
+    (h_t, c_t), outs = jax.lax.scan(step, (h0, c0), (x, mask))
     if reverse:
         outs = jnp.flip(outs, axis=0)
     return outs, h_t, c_t
 
 
-def _rnn_fwd(x, init_h, init_c, *weights, mode, num_layers, bidirectional,
-             has_bias, time_major, activation):
+def _rnn_fwd(x, init_h, init_c, seq_lens, key, *weights, mode, num_layers,
+             bidirectional, has_bias, time_major, activation, dropout_p):
     """Whole RNN as one jitted program. x: [B, T, I] or [T, B, I]."""
     if not time_major:
         x = jnp.swapaxes(x, 0, 1)
+    T = x.shape[0]
+    mask = (jnp.arange(T)[:, None] < seq_lens[None, :])
     n_dir = 2 if bidirectional else 1
     w_per = 4 if has_bias else 2
     outs = x
     final_h, final_c = [], []
     idx = 0
     for layer in range(num_layers):
+        if layer > 0 and dropout_p > 0.0:
+            lkey = jax.random.fold_in(key, layer)
+            keep = jax.random.bernoulli(lkey, 1.0 - dropout_p, outs.shape)
+            outs = jnp.where(keep, outs / (1.0 - dropout_p), 0.0).astype(
+                outs.dtype)
         layer_outs = []
         for d in range(n_dir):
             w = weights[idx:idx + w_per]
@@ -93,8 +112,9 @@ def _rnn_fwd(x, init_h, init_c, *weights, mode, num_layers, bidirectional,
             state = layer * n_dir + d
             h0 = init_h[state]
             c0 = init_c[state] if init_c is not None else jnp.zeros_like(h0)
-            o, h_t, c_t = _run_direction(mode, outs, h0, c0, w_ih, w_hh,
-                                         b_ih, b_hh, d == 1, activation)
+            o, h_t, c_t = _run_direction(mode, outs, mask, h0, c0, w_ih,
+                                         w_hh, b_ih, b_hh, d == 1,
+                                         activation)
             layer_outs.append(o)
             final_h.append(h_t)
             final_c.append(c_t)
@@ -107,10 +127,10 @@ def _rnn_fwd(x, init_h, init_c, *weights, mode, num_layers, bidirectional,
     return out, h_stack
 
 
-register_op("rnn_net", lambda x, h, *rest, **attrs:
-            _rnn_fwd(x, h, None, *rest, **attrs))
-register_op("lstm_net", lambda x, h, c, *rest, **attrs:
-            _rnn_fwd(x, h, c, *rest, **attrs))
+register_op("rnn_net", lambda x, h, lens, key, *rest, **attrs:
+            _rnn_fwd(x, h, None, lens, key, *rest, **attrs))
+register_op("lstm_net", lambda x, h, c, lens, key, *rest, **attrs:
+            _rnn_fwd(x, h, c, lens, key, *rest, **attrs))
 
 
 class RNNCellBase(Layer):
@@ -348,11 +368,12 @@ class _RNNBase(Layer):
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
         import jax.numpy as jnp
-        from ...core import dtype as dtypes
+        from ...core import random as random_mod
         x = as_tensor(inputs)
         n_dir = 2 if self.bidirectional else 1
         n_states = self.num_layers * n_dir
         batch = x.shape[1 if self.time_major else 0]
+        T = x.shape[0 if self.time_major else 1]
         np_dt = np.dtype(x._value.dtype)
         if initial_states is None:
             zeros = Tensor(jnp.zeros((n_states, batch, self.hidden_size),
@@ -361,17 +382,24 @@ class _RNNBase(Layer):
                 initial_states = (zeros, Tensor(zeros._value))
             else:
                 initial_states = zeros
+        if sequence_length is None:
+            lens = Tensor(jnp.full((batch,), T, jnp.int32))
+        else:
+            lens = as_tensor(sequence_length)
+        p = self.dropout if self.training else 0.0
+        key = Tensor(random_mod.next_key())
         attrs = dict(mode=self._mode, num_layers=self.num_layers,
                      bidirectional=self.bidirectional, has_bias=True,
-                     time_major=self.time_major, activation=self.activation)
+                     time_major=self.time_major, activation=self.activation,
+                     dropout_p=float(p))
         if self._mode == "LSTM":
             h0, c0 = initial_states
             out, h_n, c_n = apply_op("lstm_net", x, as_tensor(h0),
-                                     as_tensor(c0), *self._all_weights,
-                                     attrs=attrs)
+                                     as_tensor(c0), lens, key,
+                                     *self._all_weights, attrs=attrs)
             return out, (h_n, c_n)
-        out, h_n = apply_op("rnn_net", x, as_tensor(initial_states),
-                            *self._all_weights, attrs=attrs)
+        out, h_n = apply_op("rnn_net", x, as_tensor(initial_states), lens,
+                            key, *self._all_weights, attrs=attrs)
         return out, h_n
 
 

@@ -25,7 +25,7 @@ _NEG_INF = -1e30
 
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-               scale, causal, block_q, block_k, kv_len):
+               scale, causal, block_q, block_k, q_len, kv_len):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     n_kv = pl.num_programs(2)
@@ -36,11 +36,13 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # causal: whole k-block strictly after the last query of this q-block
-    # contributes nothing — predicate the compute away.
+    # bottom-right causal alignment (matches the XLA reference: query i may
+    # see keys j <= i + (kv_len - q_len)); whole k-blocks past the last
+    # query of this q-block are predicated away.
+    offset = kv_len - q_len
     run = True
     if causal:
-        run = kj * block_k <= qi * block_q + block_q - 1
+        run = kj * block_k <= qi * block_q + block_q - 1 + offset
 
     @pl.when(run)
     def _compute():
@@ -57,7 +59,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            valid = jnp.logical_and(valid, q_pos >= k_pos)
+            valid = jnp.logical_and(valid, q_pos + offset >= k_pos)
         s = jnp.where(valid, s, _NEG_INF)
 
         m_prev = m_ref[:, :1]              # [bq, 1]
@@ -102,7 +104,7 @@ def _flash_fwd_bhld(q, k, v, causal, scale, block_q, block_k):
 
     kernel = functools.partial(
         _fa_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, kv_len=lk)
+        block_k=block_k, q_len=lq, kv_len=lk)
     out = pl.pallas_call(
         kernel,
         grid=(bh, n_q, n_k),

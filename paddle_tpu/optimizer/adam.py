@@ -1,0 +1,310 @@
+"""Adam-family optimizers.
+
+Reference: python/paddle/optimizer/{adam,adamw,adamax,adagrad,rmsprop,
+adadelta,lamb}.py; kernels paddle/phi/kernels/gpu/adam_kernel.cu,
+operators/optimizers/lamb_op. All updates run inside the base class's
+single fused-jit program (the merged_adam multi-tensor path is the
+default here, not an option).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["Adam", "AdamW", "Adamax", "Adagrad", "RMSProp", "Adadelta",
+           "Lamb", "NAdam", "RAdam"]
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1 = float(beta1)
+        self._beta2 = float(beta2)
+        self._epsilon = float(epsilon)
+
+    def _accumulator_specs(self, p):
+        return {"moment1": jnp.zeros_like(p._value),
+                "moment2": jnp.zeros_like(p._value)}
+
+    def _global_state_spec(self):
+        return {"beta1_pow": jnp.asarray(1.0, jnp.float32),
+                "beta2_pow": jnp.asarray(1.0, jnp.float32)}
+
+    def _advance_global(self, gstate):
+        return {"beta1_pow": gstate["beta1_pow"] * self._beta1,
+                "beta2_pow": gstate["beta2_pow"] * self._beta2}
+
+    def _rule(self, p, g, state, gstate, lr):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        b1p = gstate["beta1_pow"] * self._beta1
+        b2p = gstate["beta2_pow"] * self._beta2
+        m_hat = m / (1.0 - b1p)
+        v_hat = v / (1.0 - b2p)
+        step = lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        new_p = (p32 - self._extra_decay(p32, lr) - step).astype(p.dtype)
+        return new_p, {"moment1": m, "moment2": v}
+
+    def _extra_decay(self, p32, lr):
+        return 0.0
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    _decoupled = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name=name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._decay_skip_ids = None
+
+    def step(self):
+        if self._apply_decay_param_fun is not None and \
+                self._decay_skip_ids is None:
+            self._decay_skip_ids = {
+                id(p) for p in self._parameter_list
+                if not self._apply_decay_param_fun(p.name)}
+        super().step()
+
+    def _extra_decay(self, p32, lr):
+        # per-param skip handled by zeroing decay for flagged params in
+        # _rule via closure is complex; the common case (uniform decay)
+        # runs here. Param-filtered decay falls back to coef 0 per param.
+        return lr * self._decay * p32
+
+    def _build_fused(self, n_params):
+        if not self._decay_skip_ids:
+            return super()._build_fused(n_params)
+        # bake a per-param decay mask into the fused program
+        import jax
+        rule = self._rule
+        params_now = [p for p in self._parameter_list
+                      if p.trainable and p.grad is not None]
+        decays = [0.0 if id(p) in self._decay_skip_ids else self._decay
+                  for p in params_now]
+
+        def fused(params, grads, states, gstate, lr):
+            new_params, new_states = [], []
+            for p, g, s, d in zip(params, grads, states, decays):
+                self._cur_decay = d
+                np_, ns = rule(p, g, s, gstate, lr)
+                new_params.append(np_)
+                new_states.append(ns)
+            gstate = self._advance_global(dict(gstate))
+            return new_params, new_states, gstate
+
+        return jax.jit(fused, donate_argnums=(0, 2, 3))
+
+    def _rule(self, p, g, state, gstate, lr):
+        d = getattr(self, "_cur_decay", self._decay)
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        b1p = gstate["beta1_pow"] * self._beta1
+        b2p = gstate["beta2_pow"] * self._beta2
+        m_hat = m / (1.0 - b1p)
+        v_hat = v / (1.0 - b2p)
+        step = lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        new_p = (p32 * (1.0 - lr * d) - step).astype(p.dtype)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _accumulator_specs(self, p):
+        return {"moment": jnp.zeros_like(p._value),
+                "inf_norm": jnp.zeros_like(p._value)}
+
+    def _global_state_spec(self):
+        return {"beta1_pow": jnp.asarray(1.0, jnp.float32)}
+
+    def _advance_global(self, gstate):
+        return {"beta1_pow": gstate["beta1_pow"] * self._beta1}
+
+    def _rule(self, p, g, state, gstate, lr):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g32))
+        b1p = gstate["beta1_pow"] * self._beta1
+        new_p = (p.astype(jnp.float32) -
+                 (lr / (1 - b1p)) * m / (u + self._epsilon)).astype(p.dtype)
+        return new_p, {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _accumulator_specs(self, p):
+        return {"moment": jnp.full_like(p._value, self._init_acc)}
+
+    def _rule(self, p, g, state, gstate, lr):
+        g32 = g.astype(jnp.float32)
+        mom = state["moment"] + jnp.square(g32)
+        new_p = (p.astype(jnp.float32) -
+                 lr * g32 / (jnp.sqrt(mom) + self._epsilon)).astype(p.dtype)
+        return new_p, {"moment": mom}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _accumulator_specs(self, p):
+        spec = {"mean_square": jnp.zeros_like(p._value),
+                "momentum": jnp.zeros_like(p._value)}
+        if self._centered:
+            spec["mean_grad"] = jnp.zeros_like(p._value)
+        return spec
+
+    def _rule(self, p, g, state, gstate, lr):
+        g32 = g.astype(jnp.float32)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * \
+            jnp.square(g32)
+        new_state = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g32
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+            new_state["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state["momentum"] + lr * g32 / denom
+        new_state["momentum"] = mom
+        return (p.astype(jnp.float32) - mom).astype(p.dtype), new_state
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _accumulator_specs(self, p):
+        return {"avg_squared_grad": jnp.zeros_like(p._value),
+                "avg_squared_update": jnp.zeros_like(p._value)}
+
+    def _rule(self, p, g, state, gstate, lr):
+        g32 = g.astype(jnp.float32)
+        asg = self._rho * state["avg_squared_grad"] + \
+            (1 - self._rho) * jnp.square(g32)
+        update = -jnp.sqrt((state["avg_squared_update"] + self._epsilon) /
+                           (asg + self._epsilon)) * g32
+        asu = self._rho * state["avg_squared_update"] + \
+            (1 - self._rho) * jnp.square(update)
+        new_p = (p.astype(jnp.float32) + lr * update).astype(p.dtype)
+        return new_p, {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Lamb(Optimizer):
+    """reference: python/paddle/optimizer/lamb.py,
+    operators/optimizers/lamb_op (+ the fused distributed_fused_lamb)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lamb_decay = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _accumulator_specs(self, p):
+        return {"moment1": jnp.zeros_like(p._value),
+                "moment2": jnp.zeros_like(p._value)}
+
+    def _global_state_spec(self):
+        return {"beta1_pow": jnp.asarray(1.0, jnp.float32),
+                "beta2_pow": jnp.asarray(1.0, jnp.float32)}
+
+    def _advance_global(self, gstate):
+        return {"beta1_pow": gstate["beta1_pow"] * self._beta1,
+                "beta2_pow": gstate["beta2_pow"] * self._beta2}
+
+    def _rule(self, p, g, state, gstate, lr):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        b1p = gstate["beta1_pow"] * self._beta1
+        b2p = gstate["beta2_pow"] * self._beta2
+        m_hat = m / (1 - b1p)
+        v_hat = v / (1 - b2p)
+        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon) + \
+            self._lamb_decay * p32
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where(jnp.logical_and(w_norm > 0, r_norm > 0),
+                          w_norm / r_norm, 1.0)
+        new_p = (p32 - lr * trust * r).astype(p.dtype)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class NAdam(Adam):
+    def _rule(self, p, g, state, gstate, lr):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        b1p = gstate["beta1_pow"] * self._beta1
+        b2p = gstate["beta2_pow"] * self._beta2
+        m_hat = (self._beta1 * m / (1 - b1p * self._beta1) +
+                 (1 - self._beta1) * g32 / (1 - b1p))
+        v_hat = v / (1 - b2p)
+        new_p = (p32 - lr * m_hat /
+                 (jnp.sqrt(v_hat) + self._epsilon)).astype(p.dtype)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class RAdam(Adam):
+    def _rule(self, p, g, state, gstate, lr):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        b1p = gstate["beta1_pow"] * self._beta1
+        b2p = gstate["beta2_pow"] * self._beta2
+        t = jnp.log(b1p) / jnp.log(self._beta1)  # step count
+        rho_inf = 2.0 / (1 - self._beta2) - 1.0
+        rho_t = rho_inf - 2.0 * t * b2p / (1 - b2p)
+        m_hat = m / (1 - b1p)
+        r_num = (rho_t - 4) * (rho_t - 2) * rho_inf
+        r_den = (rho_inf - 4) * (rho_inf - 2) * rho_t
+        rect = jnp.sqrt(jnp.maximum(r_num / jnp.maximum(r_den, 1e-30), 0.0))
+        v_hat = jnp.sqrt(v / (1 - b2p))
+        adaptive = rect * m_hat / (v_hat + self._epsilon)
+        plain = m_hat
+        upd = jnp.where(rho_t > 5.0, adaptive, plain)
+        new_p = (p32 - lr * upd).astype(p.dtype)
+        return new_p, {"moment1": m, "moment2": v}

@@ -1,0 +1,248 @@
+"""Optimizer base + SGD/Momentum.
+
+TPU-native replacement for Paddle's optimizer stack (reference:
+python/paddle/optimizer/optimizer.py:98 class Optimizer; update kernels
+paddle/fluid/operators/optimizers/*). Where the reference appends one
+update op per parameter (or uses merged_adam for multi-tensor), here the
+ENTIRE update — all parameters, all accumulators — is one jitted XLA
+program with donated buffers: the multi-tensor "fused" path is the only
+path. LR is a traced scalar so scheduler ticks never recompile.
+"""
+from __future__ import annotations
+
+import functools
+from collections import OrderedDict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core import dtype as dtypes
+
+__all__ = ["Optimizer", "SGD", "Momentum"]
+
+
+class _L2DecayStub:
+    def __init__(self, coeff):
+        self._coeff = float(coeff)
+
+
+def _decay_coeff(weight_decay):
+    if weight_decay is None:
+        return 0.0
+    if isinstance(weight_decay, (int, float)):
+        return float(weight_decay)
+    coeff = getattr(weight_decay, "_coeff", None)
+    if coeff is None:
+        coeff = getattr(weight_decay, "_regularization_coeff", 0.0)
+    return float(coeff)
+
+
+class Optimizer:
+    """Base optimizer. Subclasses define:
+    - _accumulator_specs(param) -> {name: init_array}
+    - _rule(p, g, state, lr) -> (new_p, new_state)   [pure jnp]
+    """
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        from .lr import LRScheduler
+        self._lr_scheduler = None
+        if isinstance(learning_rate, LRScheduler):
+            self._lr_scheduler = learning_rate
+            self._learning_rate = learning_rate()
+        else:
+            self._learning_rate = float(learning_rate)
+        self._grad_clip = grad_clip
+        self._weight_decay = weight_decay
+        self._decay = _decay_coeff(weight_decay)
+        self._param_groups = []
+        self._parameter_list = []
+        if parameters is not None:
+            parameters = list(parameters)
+            if parameters and isinstance(parameters[0], dict):
+                for group in parameters:
+                    g = dict(group)
+                    g["params"] = list(g["params"])
+                    self._param_groups.append(g)
+                    self._parameter_list += g["params"]
+            else:
+                self._parameter_list = parameters
+                self._param_groups.append({"params": parameters})
+        self._accumulators: dict = OrderedDict()
+        self._fused_update = None
+        self._sig = None
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self):
+        if self._lr_scheduler is not None:
+            return float(self._lr_scheduler())
+        return self._learning_rate
+
+    def set_lr(self, value):
+        if self._lr_scheduler is not None:
+            raise RuntimeError(
+                "cannot set_lr when a LRScheduler drives this optimizer")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr_scheduler = scheduler
+
+    # -- accumulators --------------------------------------------------------
+    def _accumulator_specs(self, p):
+        return {}
+
+    def _global_state_spec(self):
+        """Optional non-per-param state (e.g. beta1^t power)."""
+        return {}
+
+    def _state_for(self, p):
+        key = id(p)
+        if key not in self._accumulators:
+            self._accumulators[key] = {
+                name: jnp.asarray(arr)
+                for name, arr in self._accumulator_specs(p).items()}
+        return self._accumulators[key]
+
+    # -- the fused update ---------------------------------------------------
+    def _build_fused(self, n_params):
+        rule = self._rule
+
+        def fused(params, grads, states, gstate, lr):
+            new_params, new_states = [], []
+            gstate = dict(gstate)
+            for p, g, s in zip(params, grads, states):
+                np_, ns = rule(p, g, s, gstate, lr)
+                new_params.append(np_)
+                new_states.append(ns)
+            gstate = self._advance_global(gstate)
+            return new_params, new_states, gstate
+
+        return jax.jit(fused, donate_argnums=(0, 2, 3))
+
+    def _advance_global(self, gstate):
+        return gstate
+
+    @jax.named_scope("optimizer_step")
+    def step(self):
+        params = [p for p in self._parameter_list
+                  if isinstance(p, Parameter) and p.trainable
+                  and p.grad is not None]
+        if not params:
+            return
+        grads = [p.grad for p in params]
+        if self._grad_clip is not None:
+            pg = self._grad_clip(list(zip(params, grads)))
+            grads = [g for _, g in pg]
+        # L2 regularization folds into the grad (paddle semantics for
+        # `weight_decay` on non-AdamW optimizers)
+        if self._decay and not getattr(self, "_decoupled", False):
+            grads = [Tensor(g._value + self._decay * p._value)
+                     for p, g in zip(params, grads)]
+        p_vals = [p._value for p in params]
+        g_vals = [g._value for g in grads]
+        states = [self._state_for(p) for p in params]
+        if not hasattr(self, "_gstate"):
+            self._gstate = {k: jnp.asarray(v) for k, v in
+                            self._global_state_spec().items()}
+        sig = tuple((v.shape, str(v.dtype)) for v in p_vals)
+        if self._fused_update is None or sig != self._sig:
+            self._fused_update = self._build_fused(len(params))
+            self._sig = sig
+        lr = jnp.asarray(self.get_lr(), dtype=jnp.float32)
+        new_p, new_s, new_g = self._fused_update(p_vals, g_vals, states,
+                                                 self._gstate, lr)
+        self._gstate = new_g
+        for p, nv, ns in zip(params, new_p, new_s):
+            p._rebind(nv)
+            self._accumulators[id(p)] = ns
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            if isinstance(p, Tensor):
+                p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # -- checkpointing -------------------------------------------------------
+    def state_dict(self):
+        sd = {}
+        for p in self._parameter_list:
+            if id(p) in self._accumulators:
+                for name, v in self._accumulators[id(p)].items():
+                    sd[f"{p.name}_{name}"] = Tensor(v)
+        if hasattr(self, "_gstate"):
+            for k, v in self._gstate.items():
+                sd[f"global_{k}"] = Tensor(v)
+        if self._lr_scheduler is not None:
+            sd["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        for p in self._parameter_list:
+            specs = self._accumulator_specs(p) if isinstance(p, Parameter) \
+                else {}
+            st = {}
+            for name in specs:
+                key = f"{p.name}_{name}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    st[name] = v._value if isinstance(v, Tensor) \
+                        else jnp.asarray(v)
+            if st:
+                full = self._state_for(p)
+                full.update(st)
+        if not hasattr(self, "_gstate"):
+            self._gstate = {k: jnp.asarray(v) for k, v in
+                            self._global_state_spec().items()}
+        for k in list(self._gstate):
+            key = f"global_{k}"
+            if key in state_dict:
+                v = state_dict[key]
+                self._gstate[k] = v._value if isinstance(v, Tensor) \
+                    else jnp.asarray(v)
+        if self._lr_scheduler is not None and "LR_Scheduler" in state_dict:
+            self._lr_scheduler.set_state_dict(state_dict["LR_Scheduler"])
+
+    # rule interface ---------------------------------------------------------
+    def _rule(self, p, g, state, gstate, lr):
+        raise NotImplementedError
+
+
+class SGD(Optimizer):
+    """reference: python/paddle/optimizer/sgd.py; phi sgd kernel."""
+
+    def _rule(self, p, g, state, gstate, lr):
+        return p - lr.astype(p.dtype) * g.astype(p.dtype), state
+
+
+class Momentum(Optimizer):
+    """reference: python/paddle/optimizer/momentum.py (use_nesterov attr)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._momentum = float(momentum)
+        self._use_nesterov = bool(use_nesterov)
+
+    def _accumulator_specs(self, p):
+        return {"velocity": jnp.zeros_like(p._value)}
+
+    def _rule(self, p, g, state, gstate, lr):
+        g = g.astype(p.dtype)
+        v = state["velocity"] * self._momentum + g
+        if self._use_nesterov:
+            new_p = p - lr.astype(p.dtype) * (g + self._momentum * v)
+        else:
+            new_p = p - lr.astype(p.dtype) * v
+        return new_p, {"velocity": v}

@@ -1,0 +1,23 @@
+"""Regularizers (reference: python/paddle/regularizer.py). Applied by the
+optimizer by folding coeff*param (L2) or coeff*sign(param) (L1) into the
+gradient inside the fused update program."""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+        self._l1 = True
+
+    def __repr__(self):
+        return f"L1Decay({self._coeff})"
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L2Decay({self._coeff})"

@@ -1,0 +1,234 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+MNIST/Cifar read local files (no network in the TPU environment —
+`download=True` raises with instructions); FakeData generates deterministic
+synthetic samples for tests/benchmarks, mirroring the reference test
+strategy of fake inputs (SURVEY.md §4)."""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
+           "DatasetFolder", "ImageFolder"]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image dataset."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=10,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.randn(*self.image_shape).astype("float32")
+        label = np.array(rng.randint(0, self.num_classes)).astype("int64")
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+def _no_download(name):
+    raise RuntimeError(
+        f"{name}: automatic download is unavailable (no network egress). "
+        f"Place the dataset files locally and pass their paths.")
+
+
+class MNIST(Dataset):
+    """reference: python/paddle/vision/datasets/mnist.py (idx-ubyte files)."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        if image_path is None or label_path is None:
+            base = os.path.expanduser(f"~/.cache/paddle/dataset/{self.NAME}")
+            tag = "train" if self.mode == "train" else "t10k"
+            image_path = os.path.join(base, f"{tag}-images-idx3-ubyte.gz")
+            label_path = os.path.join(base, f"{tag}-labels-idx1-ubyte.gz")
+            if not (os.path.exists(image_path) and
+                    os.path.exists(label_path)):
+                _no_download(type(self).__name__)
+        self.images, self.labels = self._parse(image_path, label_path)
+
+    @staticmethod
+    def _open(path):
+        if path.endswith(".gz"):
+            return gzip.open(path, "rb")
+        return open(path, "rb")
+
+    def _parse(self, image_path, label_path):
+        with self._open(label_path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), dtype=np.uint8).astype("int64")
+        with self._open(image_path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), dtype=np.uint8).reshape(
+                n, rows, cols).astype("float32")
+        return images, labels
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = np.array(self.labels[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """reference: python/paddle/vision/datasets/cifar.py (pickle batches)."""
+
+    _n_classes = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode.lower()
+        self.transform = transform
+        if data_file is None:
+            base = os.path.expanduser("~/.cache/paddle/dataset/cifar")
+            data_file = os.path.join(base, self._archive_name())
+            if not os.path.exists(data_file):
+                _no_download(type(self).__name__)
+        self.data = []
+        self._load(data_file)
+
+    def _archive_name(self):
+        return "cifar-10-python.tar.gz"
+
+    def _batch_names(self):
+        if self.mode == "train":
+            return [f"data_batch_{i}" for i in range(1, 6)]
+        return ["test_batch"]
+
+    def _label_key(self):
+        return b"labels"
+
+    def _load(self, data_file):
+        names = self._batch_names()
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                if any(member.name.endswith(n) for n in names):
+                    f = tf.extractfile(member)
+                    batch = pickle.load(f, encoding="bytes")
+                    images = batch[b"data"].reshape(-1, 3, 32, 32)
+                    labels = batch[self._label_key()]
+                    for img, lbl in zip(images, labels):
+                        self.data.append((img.astype("float32"),
+                                          np.array(int(lbl), "int64")))
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        img, label = self.data[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class Cifar100(Cifar10):
+    _n_classes = 100
+
+    def _archive_name(self):
+        return "cifar-100-python.tar.gz"
+
+    def _batch_names(self):
+        return ["train"] if self.mode == "train" else ["test"]
+
+    def _label_key(self):
+        return b"fine_labels"
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class image folder (reference:
+    python/paddle/vision/datasets/folder.py)."""
+
+    IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        extensions = extensions or self.IMG_EXTENSIONS
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            d = os.path.join(root, c)
+            for fname in sorted(os.listdir(d)):
+                path = os.path.join(d, fname)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fname.lower().endswith(tuple(extensions)))
+                if ok:
+                    self.samples.append((path, self.class_to_idx[c]))
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+            return np.asarray(Image.open(path).convert("RGB"))
+        except ImportError as e:
+            raise RuntimeError("PIL not available; use .npy images") from e
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array(target, "int64")
+
+
+class ImageFolder(DatasetFolder):
+    """Flat folder of images, no labels."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        extensions = extensions or self.IMG_EXTENSIONS
+        self.samples = []
+        for fname in sorted(os.listdir(root)):
+            path = os.path.join(root, fname)
+            ok = (is_valid_file(path) if is_valid_file
+                  else fname.lower().endswith(tuple(extensions)))
+            if ok and os.path.isfile(path):
+                self.samples.append(path)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]

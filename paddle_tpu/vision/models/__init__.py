@@ -1,0 +1,6 @@
+"""paddle.vision.models parity (reference: python/paddle/vision/models/)."""
+from .lenet import LeNet  # noqa: F401
+from .resnet import *  # noqa: F401,F403
+from .vgg import *  # noqa: F401,F403
+from .mobilenet import *  # noqa: F401,F403
+from .alexnet import *  # noqa: F401,F403

@@ -1,0 +1,274 @@
+"""Image transforms on numpy arrays (reference: python/paddle/vision/
+transforms/). Operate on HWC uint8/float numpy (or PIL if installed);
+ToTensor produces CHW float32 scaled to [0,1] like the reference."""
+from __future__ import annotations
+
+import numbers
+import random as pyrandom
+
+import numpy as np
+
+from ...core.tensor import Tensor, to_tensor
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
+           "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "BrightnessTransform", "Pad", "RandomResizedCrop",
+           "to_tensor_transform", "normalize", "resize", "hflip", "vflip",
+           "crop", "center_crop", "pad"]
+
+
+def _as_hwc(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def resize(img, size, interpolation="bilinear"):
+    img = _as_hwc(img)
+    if isinstance(size, int):
+        h, w = img.shape[:2]
+        if h < w:
+            new_h, new_w = size, int(size * w / h)
+        else:
+            new_h, new_w = int(size * h / w), size
+    else:
+        new_h, new_w = size
+    import jax
+    import jax.numpy as jnp
+    method = {"nearest": "nearest", "bilinear": "linear",
+              "bicubic": "cubic"}[interpolation]
+    out = jax.image.resize(jnp.asarray(img, jnp.float32),
+                           (new_h, new_w, img.shape[2]), method=method)
+    out = np.asarray(out)
+    if np.issubdtype(img.dtype, np.integer):
+        out = np.clip(np.round(out), 0, 255).astype(img.dtype)
+    return out
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1]
+
+
+def crop(img, top, left, height, width):
+    return _as_hwc(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    th, tw = output_size
+    top = max((h - th) // 2, 0)
+    left = max((w - tw) // 2, 0)
+    return crop(img, top, left, th, tw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    img = _as_hwc(img)
+    if isinstance(padding, int):
+        pads = ((padding, padding), (padding, padding), (0, 0))
+    elif len(padding) == 2:
+        pads = ((padding[1], padding[1]), (padding[0], padding[0]), (0, 0))
+    else:
+        l, t, r, b = padding
+        pads = ((t, b), (l, r), (0, 0))
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    if mode == "constant":
+        return np.pad(img, pads, mode=mode, constant_values=fill)
+    return np.pad(img, pads, mode=mode)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    img = np.asarray(img, dtype=np.float32)
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        return (img - mean[:, None, None]) / std[:, None, None]
+    return (img - mean) / std
+
+
+def to_tensor_transform(img, data_format="CHW"):
+    img = _as_hwc(img)
+    arr = np.asarray(img, dtype=np.float32)
+    if np.issubdtype(np.asarray(img).dtype, np.integer):
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return to_tensor(arr)
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor_transform(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        if isinstance(img, Tensor):
+            img = img.numpy()
+        n_chan = img.shape[0] if self.data_format == "CHW" else img.shape[-1]
+        mean = (self.mean * n_chan)[:n_chan] if len(self.mean) < n_chan \
+            else self.mean[:n_chan]
+        std = (self.std * n_chan)[:n_chan] if len(self.std) < n_chan \
+            else self.std[:n_chan]
+        return normalize(img, mean, std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        if isinstance(size, int):
+            size = (size, size)
+        self.size = size
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        if self.padding is not None:
+            img = pad(img, self.padding, self.fill, self.padding_mode)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        if self.pad_if_needed and (h < th or w < tw):
+            img = pad(img, (0, 0, max(tw - w, 0), max(th - h, 0)),
+                      self.fill, self.padding_mode)
+            h, w = img.shape[:2]
+        top = pyrandom.randint(0, h - th)
+        left = pyrandom.randint(0, w - tw)
+        return crop(img, top, left, th, tw)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if pyrandom.random() < self.prob:
+            return hflip(img)
+        return _as_hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if pyrandom.random() < self.prob:
+            return vflip(img)
+        return _as_hwc(img)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4, 4.0 / 3),
+                 interpolation="bilinear", keys=None):
+        if isinstance(size, int):
+            size = (size, size)
+        self.size = size
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = area * pyrandom.uniform(*self.scale)
+            aspect = pyrandom.uniform(*self.ratio)
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = pyrandom.randint(0, h - ch)
+                left = pyrandom.randint(0, w - cw)
+                return resize(crop(img, top, left, ch, cw), self.size,
+                              self.interpolation)
+        return resize(center_crop(img, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        if isinstance(img, Tensor):
+            img = img.numpy()
+        return _as_hwc(img).transpose(self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _as_hwc(img)
+        img = _as_hwc(img)
+        dtype = img.dtype
+        alpha = 1 + pyrandom.uniform(-self.value, self.value)
+        out = np.clip(img.astype(np.float32) * alpha, 0,
+                      255 if np.issubdtype(dtype, np.integer) else None)
+        return out.astype(dtype)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
