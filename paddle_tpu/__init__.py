@@ -67,6 +67,12 @@ from .ops import linalg  # noqa: E402,F401
 from . import nn  # noqa: E402,F401
 from . import optimizer  # noqa: E402,F401
 from . import regularizer  # noqa: E402,F401
+from . import amp  # noqa: E402,F401
+from . import autograd  # noqa: E402,F401
+from . import jit  # noqa: E402,F401
+from . import io  # noqa: E402,F401
+from . import vision  # noqa: E402,F401
+from . import framework  # noqa: E402,F401
 from .regularizer import L1Decay, L2Decay  # noqa: E402,F401
 from .nn.layer.layers import ParamAttr  # noqa: E402,F401
 

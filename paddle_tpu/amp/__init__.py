@@ -1,0 +1,256 @@
+"""AMP: automatic mixed precision.
+
+TPU-native replacement for paddle.amp (reference:
+python/paddle/amp/auto_cast.py:20, grad_scaler.py:26; C++ hook
+paddle/fluid/eager/amp_utils.h; op lists
+python/paddle/fluid/dygraph/amp/auto_cast.py). Dispatch-level O1
+white/black-list casting like the reference — but the native fast dtype
+is bfloat16 (MXU), where loss scaling is unnecessary: GradScaler keeps
+the fp16 contract (dynamic scaling + inf check) and becomes a cheap
+pass-through for bf16.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate",
+           "white_list", "black_list"]
+
+# O1 lists (reference: fluid/dygraph/amp/auto_cast.py WHITE_LIST/BLACK_LIST)
+WHITE_LIST = {
+    "matmul", "linear", "linear_bias", "conv1d", "conv2d", "conv3d",
+    "conv1d_bias", "conv2d_bias", "conv3d_bias", "conv1d_transpose",
+    "conv2d_transpose", "conv3d_transpose", "conv1d_transpose_bias",
+    "conv2d_transpose_bias", "conv3d_transpose_bias", "einsum", "inner",
+    "outer", "sdpa", "sdpa_mask", "sdpa_dropout", "sdpa_mask_dropout",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "square", "pow", "sqrt", "rsqrt",
+    "softmax", "log_softmax", "cross_entropy_hard", "cross_entropy_hard_w",
+    "cross_entropy_soft", "cross_entropy_soft_w", "layer_norm",
+    "layer_norm_noaffine", "rms_norm", "batch_norm_train",
+    "batch_norm_infer", "batch_norm_train_noaffine",
+    "batch_norm_infer_noaffine", "mse_loss", "l1_loss", "nll_loss",
+    "bce_loss", "bce_logits", "kl_div_loss", "cumsum", "sum", "mean",
+    "cosine_similarity_op", "p_normalize", "logsumexp",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.level = "O1"
+        self.dtype = None  # np dtype
+        self.white = frozenset()
+        self.black = frozenset()
+
+
+_state = _AmpState()
+
+
+def amp_active():
+    return _state.enabled
+
+
+def maybe_cast_inputs(op_name, vals):
+    """Called from the eager dispatch hot path."""
+    if not _state.enabled:
+        return vals
+    amp_dt = _state.dtype
+    if _state.level == "O2":
+        if op_name in _state.black:
+            return tuple(v.astype(np.float32) if _is_half(v) else v
+                         for v in vals)
+        return tuple(v.astype(amp_dt) if _is_f32(v) else v for v in vals)
+    if op_name in _state.white:
+        return tuple(v.astype(amp_dt) if _is_f32(v) else v for v in vals)
+    if op_name in _state.black:
+        return tuple(v.astype(np.float32) if _is_half(v) else v
+                     for v in vals)
+    return vals
+
+
+def _is_f32(v):
+    return v.dtype == np.float32
+
+
+def _is_half(v):
+    return v.dtype in (np.dtype("float16"), jnp.bfloat16)
+
+
+class _AmpGuard:
+    def __init__(self, enable, custom_white_list, custom_black_list, level,
+                 dtype):
+        self.enable = enable
+        self.level = level
+        np_dt = dtypes.to_np_dtype(dtype)
+        self.dtype = np_dt
+        white = set(WHITE_LIST)
+        black = set(BLACK_LIST)
+        if custom_white_list:
+            white |= set(custom_white_list)
+            black -= set(custom_white_list)
+        if custom_black_list:
+            black |= set(custom_black_list)
+            white -= set(custom_black_list)
+        self.white = frozenset(white)
+        self.black = frozenset(black)
+
+    def __enter__(self):
+        self._prev = (_state.enabled, _state.level, _state.dtype,
+                      _state.white, _state.black)
+        _state.enabled = self.enable
+        _state.level = self.level
+        _state.dtype = self.dtype
+        _state.white = self.white
+        _state.black = self.black
+        return self
+
+    def __exit__(self, *exc):
+        (_state.enabled, _state.level, _state.dtype, _state.white,
+         _state.black) = self._prev
+        return False
+
+
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast parity; default dtype is bfloat16 (TPU-native).
+    """
+    if level not in ("O0", "O1", "O2"):
+        raise ValueError(f"bad AMP level {level}")
+    if level == "O0":
+        enable = False
+    return _AmpGuard(enable, custom_white_list, custom_black_list, level,
+                     dtype)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate parity: O2 casts model params to the AMP dtype
+    (master weights stay in the optimizer's fp32 state)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+class GradScaler:
+    """reference: python/paddle/amp/grad_scaler.py:26. Dynamic loss
+    scaling for fp16; transparent for bf16/fp32 (TPU default)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        from ..ops import math as math_ops
+        return math_ops.scale(var, self._scale)
+
+    def unscale_(self, optimizer):
+        if not self._enable or self._unscaled:
+            return
+        inv = 1.0 / self._scale
+        flags = []
+        for p in optimizer._parameter_list:
+            if p.grad is not None:
+                gv = p.grad._value * inv
+                p.grad._rebind(gv)
+                flags.append(jnp.any(~jnp.isfinite(gv)))
+        # one fused reduction -> one host sync, not one per parameter
+        self._found_inf = bool(jnp.any(jnp.stack(flags))) if flags \
+            else False
+        self._unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._update_scale()
+        self._unscaled = False
+
+    def minimize(self, optimizer, loss):
+        loss.backward()
+        self.step(optimizer)
+        optimizer.clear_grad()
+
+    def update(self):
+        # paddle's step() doesn't auto-update; update() does. Our step()
+        # already updates; keep update() idempotent for API parity.
+        return
+
+    def _update_scale(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every,
+                "decr_every_n_nan_or_inf": self._decr_every,
+                "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def set_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)
+
+
+white_list = WHITE_LIST
+black_list = BLACK_LIST
+
+
+# install the dispatch-boundary cast hook
+from ..core import tensor as _tensor_mod  # noqa: E402
+
+_tensor_mod._amp_hook = maybe_cast_inputs

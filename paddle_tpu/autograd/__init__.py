@@ -1,0 +1,170 @@
+"""paddle.autograd parity (reference: python/paddle/autograd/__init__.py).
+
+PyLayer (custom autograd function) plugs a user-defined backward into the
+eager tape: forward runs eagerly, a PyLayerNode is linked into the graph,
+and RunBackward calls the user's backward with Tensor-wrapped cotangents
+(reference: paddle/fluid/eager/pylayer/, python/paddle/autograd/py_layer.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import (  # noqa: F401
+    Tensor, no_grad, enable_grad, is_grad_enabled, set_grad_enabled, grad,
+    run_backward, GradNode)
+from ..core import dtype as dtypes
+
+__all__ = ["PyLayer", "PyLayerContext", "backward", "grad", "no_grad",
+           "enable_grad", "is_grad_enabled", "set_grad_enabled",
+           "hessian", "jacobian"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward parity."""
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors,
+                                                   (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(list(tensors), grad_tensors, retain_graph=retain_graph)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensor_(self):
+        return self._saved
+
+
+class _PyLayerNode(GradNode):
+    """Tape node whose backward is the user's Python function."""
+
+    __slots__ = ("ctx", "backward_fn")
+
+    def __init__(self, ctx, backward_fn, in_edges, diff_in, diff_out,
+                 out_meta, name):
+        self.op = None
+        self.attrs = None
+        self.ctx = ctx
+        self.backward_fn = backward_fn
+        self.saved_inputs = True  # sentinel; release() clears
+        self.saved_outputs = None
+        self.in_edges = in_edges
+        self.diff_in = diff_in
+        self.diff_out = diff_out
+        self.single = False
+        self.out_meta = out_meta
+        self.name = name
+        self.out_refs = [None] * len(diff_out)
+
+    def apply(self, cts):
+        if self.saved_inputs is None:
+            raise RuntimeError(
+                f"PyLayer '{self.name}' backward ran twice without "
+                "retain_graph=True")
+        full = [Tensor(ct if ct is not None else jnp.zeros(shape, dt))
+                for ct, (shape, dt) in zip(cts, self.out_meta)]
+        with no_grad():
+            grads = self.backward_fn(self.ctx, *full)
+        if not isinstance(grads, (list, tuple)):
+            grads = (grads,)
+        vals = []
+        for g in grads:
+            if g is None:
+                vals.append(None)
+            elif isinstance(g, Tensor):
+                vals.append(g._value)
+            else:
+                vals.append(jnp.asarray(g))
+        # align with diff_in
+        return [vals[i] if i < len(vals) else None for i in self.diff_in]
+
+    def release(self):
+        self.saved_inputs = None
+        self.ctx = None
+
+
+class PyLayer:
+    """Subclass with @staticmethod forward(ctx, ...) / backward(ctx, ...)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core.tensor import is_grad_enabled
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (list, tuple))
+        out_list = [outs] if single else list(outs)
+        need_grad = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_args)
+        if need_grad:
+            diff_in = tuple(i for i, t in enumerate(tensor_args)
+                            if not t.stop_gradient)
+            out_tensors = [o for o in out_list if isinstance(o, Tensor)]
+            diff_out = tuple(range(len(out_tensors)))
+            in_edges = []
+            for i in diff_in:
+                t = tensor_args[i]
+                if t._grad_node is not None:
+                    in_edges.append((t._grad_node, t._out_slot, t))
+                else:
+                    in_edges.append((None, 0, t))
+            out_meta = [(tuple(o.shape), np.dtype(o._value.dtype))
+                        for o in out_tensors]
+            node = _PyLayerNode(ctx, cls.backward, in_edges, diff_in,
+                                diff_out, out_meta, cls.__name__)
+            import weakref
+            for slot, o in enumerate(out_tensors):
+                o.stop_gradient = False
+                o._grad_node = node
+                o._out_slot = slot
+                node.out_refs[slot] = weakref.ref(o)
+        return outs
+
+
+def jacobian(ys, xs, create_graph=False, allow_unused=False):
+    """Dense jacobian via row-by-row VJPs over the tape (reference:
+    python/paddle/incubate/autograd/functional.py Jacobian)."""
+    single_x = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single_x else list(xs)
+    ys_list = [ys] if not isinstance(ys, (list, tuple)) else list(ys)
+    rows = []
+    for y in ys_list:
+        yv = y._value.reshape(-1)
+        for i in range(yv.shape[0]):
+            seed = jnp.zeros_like(yv).at[i].set(1.0).reshape(
+                y._value.shape)
+            gs = grad([y], xs_list, grad_outputs=[Tensor(seed)],
+                      retain_graph=True, allow_unused=True)
+            rows.append([g._value.reshape(-1) if g is not None else
+                         jnp.zeros(int(np.prod(x.shape)),
+                                   dtype=x._value.dtype)
+                         for g, x in zip(gs, xs_list)])
+    jac = [Tensor(jnp.stack([r[j] for r in rows]))
+           for j in range(len(xs_list))]
+    return jac[0] if single_x else jac
+
+
+def hessian(ys, xs, create_graph=False):
+    raise NotImplementedError(
+        "eager double-grad is unsupported; compose jax.hessian via "
+        "paddle_tpu.jit.to_static for higher-order derivatives")

@@ -95,26 +95,27 @@ def get_jitted(fn: Callable, attrs: dict[str, Any]):
 
 
 def get_vjp(fn: Callable, attrs: dict[str, Any], diff_in: tuple[int, ...],
-            diff_out: tuple[int, ...], n_out: int):
+            diff_out: tuple[int, ...], single: bool):
     """Compiled backward executable computing d(inputs)/d(outputs).
 
     Signature of returned callable: (inputs_tuple, cotangents_tuple) ->
     tuple of grads aligned with diff_in. cotangents are aligned with
-    diff_out (the float outputs of the forward).
+    diff_out (the float outputs of the forward). `single` marks ops whose
+    fwd returns a bare array rather than a tuple.
     """
-    key = (fn, _freeze(attrs), diff_in, diff_out, n_out)
+    key = (fn, _freeze(attrs), diff_in, diff_out, single)
     got = _VJP_CACHE.get(key)
     if got is None:
         with _LOCK:
             got = _VJP_CACHE.get(key)
             if got is None:
                 got = jax.jit(functools.partial(
-                    _vjp_impl, fn, dict(attrs), diff_in, diff_out, n_out))
+                    _vjp_impl, fn, dict(attrs), diff_in, diff_out, single))
                 _VJP_CACHE[key] = got
     return got
 
 
-def _vjp_impl(fn, attrs, diff_in, diff_out, n_out, inputs, cts):
+def _vjp_impl(fn, attrs, diff_in, diff_out, single, inputs, cts):
     """Differentiate fn wrt the float inputs, for its float outputs only."""
     inputs = tuple(inputs)
 
@@ -123,7 +124,7 @@ def _vjp_impl(fn, attrs, diff_in, diff_out, n_out, inputs, cts):
         for pos, a in zip(diff_in, diff_args):
             full[pos] = a
         out = fn(*full, **attrs)
-        if n_out == 1:
+        if single:
             out = (out,)
         return tuple(out[i] for i in diff_out)
 

@@ -55,6 +55,33 @@ class Generator:
         return self.get_state()
 
 
+class _TraceRng(threading.local):
+    """Trace-time RNG: while jit.to_static traces a program, random draws
+    derive from a traced key input (fold_in per draw), so compiled programs
+    get fresh randomness per call instead of baked-in constants."""
+
+    def __init__(self):
+        self.stack = []
+        self.counters = []
+
+
+_trace_rng = _TraceRng()
+
+
+def push_trace_key(key):
+    _trace_rng.stack.append(key)
+    _trace_rng.counters.append(0)
+
+
+def pop_trace_key():
+    _trace_rng.stack.pop()
+    _trace_rng.counters.pop()
+
+
+def in_trace():
+    return bool(_trace_rng.stack)
+
+
 default_generator = Generator(0)
 _named: dict[str, Generator] = {}
 
@@ -79,6 +106,10 @@ manual_seed = seed
 
 
 def next_key():
+    if _trace_rng.stack:
+        i = _trace_rng.counters[-1]
+        _trace_rng.counters[-1] += 1
+        return jax.random.fold_in(_trace_rng.stack[-1], i)
     return default_generator.next_key()
 
 

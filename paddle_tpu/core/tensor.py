@@ -43,6 +43,10 @@ class _TapeState(threading.local):
 
 _tape = _TapeState()
 
+# set by paddle_tpu.amp at import: (op_name, vals) -> vals, casting for
+# mixed precision at the dispatch boundary (reference: eager/amp_utils.h)
+_amp_hook = None
+
 
 def is_grad_enabled():
     return _tape.grad_enabled
@@ -96,11 +100,11 @@ class GradNode:
     """One recorded op on the tape; computes input grads from output cts."""
 
     __slots__ = ("op", "attrs", "saved_inputs", "saved_outputs", "in_edges",
-                 "diff_in", "diff_out", "n_out", "out_meta", "name",
+                 "diff_in", "diff_out", "single", "out_meta", "name",
                  "out_refs")
 
     def __init__(self, op: OpDef, attrs, saved_inputs, saved_outputs,
-                 in_edges, diff_in, diff_out, n_out, out_meta):
+                 in_edges, diff_in, diff_out, single, out_meta):
         self.op = op
         self.attrs = attrs
         self.saved_inputs = saved_inputs
@@ -108,7 +112,7 @@ class GradNode:
         self.in_edges = in_edges      # aligned with diff_in: (node, slot) or leaf Tensor
         self.diff_in = diff_in        # positions of differentiable inputs
         self.diff_out = diff_out      # positions of float outputs
-        self.n_out = n_out
+        self.single = single          # fwd returns bare array, not tuple
         self.out_meta = out_meta      # [(shape, np_dtype)] aligned with diff_out
         self.name = op.name
         self.out_refs = [None] * len(diff_out)  # weakrefs to output Tensors
@@ -129,7 +133,7 @@ class GradNode:
             grads = fn(self.saved_inputs, self.saved_outputs, full_cts)
             return [grads[i] for i in self.diff_in]
         fn = get_vjp(self.op.fwd, self.attrs, self.diff_in, self.diff_out,
-                     self.n_out)
+                     self.single)
         return list(fn(self.saved_inputs, full_cts))
 
     def release(self):
@@ -377,9 +381,11 @@ def apply_op(op_name: str, *tensors, attrs: Optional[dict] = None,
     (paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:192):
     forward executable -> wrap outputs -> create GradNode if required.
     """
-    op = get_op(op_name)
+    op = op_name if isinstance(op_name, OpDef) else get_op(op_name)
     attrs = attrs or {}
     vals = tuple(t._value for t in tensors)
+    if _amp_hook is not None:
+        vals = _amp_hook(op.name, vals)
     fn = get_jitted(op.fwd, attrs)
     out = fn(*vals)
     single = not isinstance(out, (tuple, list))
@@ -395,9 +401,10 @@ def apply_op(op_name: str, *tensors, attrs: Optional[dict] = None,
         diff_in = tuple(i for i, t in enumerate(tensors)
                         if not t.stop_gradient
                         and dtypes.is_floating(np.dtype(t._value.dtype)))
-        diff_out = tuple(i for i, o in enumerate(outs)
-                         if np.issubdtype(np.dtype(o.dtype), np.floating)
-                         or np.issubdtype(np.dtype(o.dtype), np.complexfloating))
+        diff_out = tuple(
+            i for i, o in enumerate(outs)
+            if dtypes.is_floating(np.dtype(o.dtype))
+            or dtypes.is_complex(np.dtype(o.dtype)))
         if diff_in and diff_out:
             in_edges = []
             for i in diff_in:
@@ -411,7 +418,7 @@ def apply_op(op_name: str, *tensors, attrs: Optional[dict] = None,
             node = GradNode(
                 op, attrs, vals,
                 outs if op.save_outputs else None,
-                in_edges, diff_in, diff_out, len(outs), out_meta)
+                in_edges, diff_in, diff_out, single, out_meta)
             import weakref
             for slot, i in enumerate(diff_out):
                 out_tensors[i]._grad_node = node

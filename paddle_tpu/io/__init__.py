@@ -386,6 +386,7 @@ class DataLoader:
         batches = list(self.batch_sampler)
         results: dict[int, object] = {}
         results_lock = threading.Condition()
+        stop = threading.Event()
         n_batches = len(batches)
         for item in enumerate(batches):
             work_q.put(item)
@@ -399,14 +400,17 @@ class DataLoader:
                                             self.dataset)
             if self.worker_init_fn is not None:
                 self.worker_init_fn(wid)
-            while True:
+            while not stop.is_set():
                 item = work_q.get()
                 if item is done_marker:
                     return
                 i, indices = item
                 with results_lock:
-                    while i - next_emit[0] >= max_ahead:
-                        results_lock.wait()
+                    while (i - next_emit[0] >= max_ahead
+                           and not stop.is_set()):
+                        results_lock.wait(timeout=1.0)
+                if stop.is_set():
+                    return
                 try:
                     out = self._fetch(indices)
                 except Exception as e:  # propagate to consumer
@@ -431,5 +435,11 @@ class DataLoader:
                     raise out
                 yield out
         finally:
+            # consumer finished or bailed early: release parked workers so
+            # no threads (or their queued batches) outlive this iterator
+            stop.set()
+            with results_lock:
+                results_lock.notify_all()
             for t in threads:
-                t.join(timeout=0.1)
+                t.join(timeout=2.0)
+            results.clear()

@@ -1,0 +1,5 @@
+"""paddle.jit parity (reference: python/paddle/jit/__init__.py)."""
+from .api import (  # noqa: F401
+    to_static, not_to_static, InputSpec, StaticFunction,
+    in_to_static_trace, ignore_module)
+from .save_load import save, load, TranslatedLayer  # noqa: F401

@@ -1,0 +1,323 @@
+"""jit.to_static: whole-program compilation.
+
+TPU-native replacement for Paddle's dy2static + static executor
+(reference: python/paddle/jit/dy2static/program_translator.py:272
+StaticFunction, python/paddle/jit/api.py:744 save). The reference
+rewrites Python ASTs into a ProgramDesc and interprets it op-by-op
+(InterpreterCore); here the decorated function is TRACED ONCE by jax.jit
+into a single StableHLO module — the "north star" executor from SURVEY.md
+§7: one XLA computation per program, buffer donation, no interpreter.
+
+Key mechanics:
+- Layer parameters/buffers become implicit traced inputs; buffer
+  mutations (BN running stats) are functionalized into extra outputs and
+  rebound after each call.
+- A fresh threefry key is an implicit input; `paddle.seed`-driven ops
+  (dropout) fold_in from it, so compiled programs see fresh randomness.
+- The compiled call is recorded on the eager tape as ONE op: backward
+  runs the jax.vjp of the whole program (compiled+cached), so
+  `loss.backward()` and optimizers work unchanged.
+- Python control flow is traced (unrolled/functionalized). Data-dependent
+  control flow must use paddle_tpu.ops.cond / while_loop, which lower to
+  lax.cond / lax.while_loop — the AST-transformer machinery of the
+  reference is unnecessary under tracing.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core import random as random_mod
+from ..core.dispatch import OpDef
+from ..core.tensor import Tensor, Parameter, apply_op
+
+__all__ = ["to_static", "not_to_static", "InputSpec", "StaticFunction",
+           "in_to_static_trace", "ignore_module"]
+
+
+class _TraceState(threading.local):
+    def __init__(self):
+        self.depth = 0
+
+
+_trace_state = _TraceState()
+
+
+def in_to_static_trace():
+    return _trace_state.depth > 0
+
+
+class InputSpec:
+    """reference: python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape=None, dtype="float32", name=None,
+                 stop_gradient=False):
+        self.shape = list(shape) if shape is not None else None
+        self.dtype = dtypes.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype.name}, "
+                f"name={self.name})")
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name or tensor.name)
+
+
+def _flatten(obj, tensors, path=()):
+    """Flatten a pytree, extracting Tensors into `tensors`; returns a spec
+    that _unflatten can rebuild with substituted leaves."""
+    if isinstance(obj, Tensor):
+        tensors.append(obj)
+        return ("T", len(tensors) - 1)
+    if isinstance(obj, dict):
+        return ("D", {k: _flatten(v, tensors) for k, v in obj.items()})
+    if isinstance(obj, (list, tuple)):
+        return ("L" if isinstance(obj, list) else "U",
+                [_flatten(v, tensors) for v in obj])
+    return ("X", obj)
+
+
+def _unflatten(spec, leaves):
+    kind, payload = spec
+    if kind == "T":
+        return leaves[payload]
+    if kind == "D":
+        return {k: _unflatten(v, leaves) for k, v in payload.items()}
+    if kind == "L":
+        return [_unflatten(v, leaves) for v in payload]
+    if kind == "U":
+        return tuple(_unflatten(v, leaves) for v in payload)
+    return payload
+
+
+def _static_key(spec):
+    """Hashable cache key for the non-tensor structure of the args."""
+    kind, payload = spec
+    if kind == "T":
+        return ("T",)
+    if kind == "D":
+        return ("D", tuple(sorted((k, _static_key(v))
+                                  for k, v in payload.items())))
+    if kind in ("L", "U"):
+        return (kind, tuple(_static_key(v) for v in payload))
+    try:
+        hash(payload)
+        return ("X", payload)
+    except TypeError:
+        return ("X", repr(payload))
+
+
+class StaticFunction:
+    """A function compiled to one XLA program per input signature."""
+
+    def __init__(self, fn, input_spec=None, build_strategy=None,
+                 full_graph=True, backend=None):
+        self._fn = fn
+        self._input_spec = input_spec
+        self._layer = None  # bound Layer instance, if method
+        functools.update_wrapper(self, fn)
+        self._cache: dict = {}
+        self._last_concrete = None
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        bound = StaticFunction(self._fn.__get__(instance, owner),
+                               self._input_spec)
+        bound._layer = instance
+        # cache the bound wrapper on the instance
+        object.__setattr__(instance, self._fn.__name__, bound)
+        return bound
+
+    @property
+    def layer(self):
+        return self._layer
+
+    def _collect_state(self):
+        """Captured Layer state: params + buffers as implicit inputs.
+
+        Finds the bound Layer, or scans the function's closure cells and
+        referenced globals for Layer/Tensor objects (the reference's
+        ProgramTranslator similarly lifts closure-captured parameters into
+        program inputs)."""
+        from ..nn.layer.layers import Layer
+        layers = []
+        loose: list[Tensor] = []
+        layer = self._layer
+        if layer is None:
+            fn_self = getattr(self._fn, "__self__", None)
+            if isinstance(fn_self, Layer):
+                self._layer = layer = fn_self
+        if layer is not None:
+            layers.append(layer)
+        else:
+            fn = self._fn
+            seen = set()
+            candidates = []
+            closure = getattr(fn, "__closure__", None) or ()
+            for cell in closure:
+                try:
+                    candidates.append(cell.cell_contents)
+                except ValueError:
+                    pass
+            code = getattr(fn, "__code__", None)
+            g = getattr(fn, "__globals__", {})
+            if code is not None:
+                for name in code.co_names:
+                    if name in g:
+                        candidates.append(g[name])
+            for obj in candidates:
+                if id(obj) in seen:
+                    continue
+                seen.add(id(obj))
+                if isinstance(obj, Layer):
+                    layers.append(obj)
+                elif isinstance(obj, Tensor) and not obj.stop_gradient:
+                    loose.append(obj)
+        params, buffers = [], []
+        pids = set()
+        for lyr in layers:
+            for _, p in lyr.named_parameters():
+                if id(p) not in pids:
+                    pids.add(id(p))
+                    params.append(p)
+            for _, b in lyr.named_buffers():
+                if id(b) not in pids:
+                    pids.add(id(b))
+                    buffers.append(b)
+        for t in loose:
+            if id(t) not in pids:
+                pids.add(id(t))
+                params.append(t)
+        return params, buffers
+
+    def _build_pure(self, arg_spec, kw_spec, n_params, n_buffers,
+                    state_tensors):
+        fn = self._fn
+
+        def pure(key, state_vals, arg_vals):
+            # Rebind live Tensor objects to tracers for the trace, run the
+            # python function, then restore. Mutation is trace-time only.
+            originals = [t._value for t in state_tensors]
+            sg = [t.stop_gradient for t in state_tensors]
+            _trace_state.depth += 1
+            random_mod.push_trace_key(key)
+            try:
+                for t, tracer in zip(state_tensors, state_vals):
+                    t._value = tracer
+                wrapped = [Tensor(v, stop_gradient=True)
+                           for v in arg_vals]
+                args = _unflatten(arg_spec, wrapped)
+                kwargs = _unflatten(kw_spec, wrapped)
+                out = fn(*args, **kwargs)
+                out_tensors: list[Tensor] = []
+                out_spec = _flatten(out, out_tensors)
+                out_vals = tuple(t._value for t in out_tensors)
+                new_buffer_vals = tuple(
+                    t._value for t in state_tensors[n_params:])
+                self._last_out_spec = out_spec
+                return out_vals + new_buffer_vals
+            finally:
+                random_mod.pop_trace_key()
+                _trace_state.depth -= 1
+                for t, v, s in zip(state_tensors, originals, sg):
+                    t._value = v
+                    t.stop_gradient = s
+
+        return pure
+
+    def __call__(self, *args, **kwargs):
+        params, buffers = self._collect_state()
+        arg_tensors: list[Tensor] = []
+        arg_spec = _flatten(list(args), arg_tensors)
+        kw_spec = _flatten(kwargs, arg_tensors)
+        state_tensors = params + buffers
+        cache_key = (_static_key(arg_spec), _static_key(kw_spec),
+                     len(params), len(buffers))
+        entry = self._cache.get(cache_key)
+        if entry is None:
+            pure = self._build_pure(arg_spec, kw_spec, len(params),
+                                    len(buffers), state_tensors)
+            # the OpDef fwd signature: (key, *state_vals, *arg_vals)
+            n_state = len(state_tensors)
+
+            def fwd(key, *vals):
+                state_vals = vals[:n_state]
+                arg_vals = vals[n_state:]
+                return pure(key, state_vals, arg_vals)
+
+            entry = {"opdef": OpDef(f"to_static::{self._fn.__qualname__}",
+                                    fwd),
+                     "pure": pure, "n_state": n_state}
+            self._cache[cache_key] = entry
+        key_t = Tensor(random_mod.default_generator.next_key())
+        all_inputs = [key_t] + state_tensors + arg_tensors
+        outs = apply_op(entry["opdef"], *all_inputs)
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        if "out_spec" not in entry:
+            entry["out_spec"] = self._last_out_spec
+        out_spec = entry["out_spec"]
+        n_buf = len(buffers)
+        if n_buf:
+            out_leaves = list(outs[:len(outs) - n_buf])
+            new_buf_vals = outs[len(outs) - n_buf:]
+            for b, nv in zip(buffers, new_buf_vals):
+                b._rebind(nv._value)
+        else:
+            out_leaves = list(outs)
+        return _unflatten(out_spec, out_leaves)
+
+    # paddle API parity ------------------------------------------------------
+    def concrete_program_specify_input_spec(self, *a, **kw):
+        raise NotImplementedError
+
+    @property
+    def code(self):
+        import inspect
+        try:
+            return inspect.getsource(self._fn)
+        except OSError:
+            return "<source unavailable>"
+
+    def get_traced(self, *args, **kwargs):
+        """Return (jitted_fn, example_inputs) for export paths."""
+        raise NotImplementedError
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """@paddle.jit.to_static parity (reference: python/paddle/jit/api.py)."""
+    def deco(fn):
+        from ..nn.layer.layers import Layer
+        if isinstance(fn, Layer):
+            layer = fn
+            sf = StaticFunction(layer.forward, input_spec, build_strategy)
+            sf._layer = layer
+            layer.forward = sf
+            return layer
+        return StaticFunction(fn, input_spec, build_strategy)
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def not_to_static(fn=None):
+    """Marks fn to run eagerly — under tracing this is identity (the traced
+    values flow through python)."""
+    if fn is None:
+        return lambda f: f
+    return fn
+
+
+def ignore_module(modules):
+    return None

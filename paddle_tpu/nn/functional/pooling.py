@@ -95,6 +95,10 @@ def _pool_impl(op, n, x, kernel_size, stride, padding, data_format,
         for i, (lo, hi) in enumerate(padding):
             total = spatial[i] + lo + hi
             out = -(-(total - kernel[i]) // stride[i]) + 1  # ceil div
+            # paddle/torch rule: a window whose START falls beyond the
+            # padded input (i.e. fully in extra padding) is dropped
+            if (out - 1) * stride[i] >= spatial[i] + lo:
+                out -= 1
             needed = (out - 1) * stride[i] + kernel[i]
             new_pads.append((lo, hi + max(needed - total, 0)))
         padding = tuple(new_pads)

@@ -69,47 +69,16 @@ class AdamW(Adam):
                          weight_decay, grad_clip, lazy_mode, multi_precision,
                          name=name)
         self._apply_decay_param_fun = apply_decay_param_fun
-        self._decay_skip_ids = None
 
-    def step(self):
-        if self._apply_decay_param_fun is not None and \
-                self._decay_skip_ids is None:
-            self._decay_skip_ids = {
-                id(p) for p in self._parameter_list
-                if not self._apply_decay_param_fun(p.name)}
-        super().step()
-
-    def _extra_decay(self, p32, lr):
-        # per-param skip handled by zeroing decay for flagged params in
-        # _rule via closure is complex; the common case (uniform decay)
-        # runs here. Param-filtered decay falls back to coef 0 per param.
-        return lr * self._decay * p32
-
-    def _build_fused(self, n_params):
-        if not self._decay_skip_ids:
-            return super()._build_fused(n_params)
-        # bake a per-param decay mask into the fused program
-        import jax
-        rule = self._rule
-        params_now = [p for p in self._parameter_list
-                      if p.trainable and p.grad is not None]
-        decays = [0.0 if id(p) in self._decay_skip_ids else self._decay
-                  for p in params_now]
-
-        def fused(params, grads, states, gstate, lr):
-            new_params, new_states = [], []
-            for p, g, s, d in zip(params, grads, states, decays):
-                self._cur_decay = d
-                np_, ns = rule(p, g, s, gstate, lr)
-                new_params.append(np_)
-                new_states.append(ns)
-            gstate = self._advance_global(dict(gstate))
-            return new_params, new_states, gstate
-
-        return jax.jit(fused, donate_argnums=(0, 2, 3))
+    def _per_param_extra(self, params):
+        if self._apply_decay_param_fun is None:
+            return None
+        return [self._decay if self._apply_decay_param_fun(p.name) else 0.0
+                for p in params]
 
     def _rule(self, p, g, state, gstate, lr):
-        d = getattr(self, "_cur_decay", self._decay)
+        d = self._cur_extra if getattr(self, "_cur_extra", None) is not None \
+            else self._decay
         g32 = g.astype(jnp.float32)
         p32 = p.astype(jnp.float32)
         m = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
@@ -240,6 +209,12 @@ class Lamb(Optimizer):
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
         self._exclude_fn = exclude_from_weight_decay_fn
 
+    def _per_param_extra(self, params):
+        if self._exclude_fn is None:
+            return None
+        return [0.0 if self._exclude_fn(p) else self._lamb_decay
+                for p in params]
+
     def _accumulator_specs(self, p):
         return {"moment1": jnp.zeros_like(p._value),
                 "moment2": jnp.zeros_like(p._value)}
@@ -261,8 +236,10 @@ class Lamb(Optimizer):
         b2p = gstate["beta2_pow"] * self._beta2
         m_hat = m / (1 - b1p)
         v_hat = v / (1 - b2p)
-        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon) + \
-            self._lamb_decay * p32
+        decay = self._cur_extra \
+            if getattr(self, "_cur_extra", None) is not None \
+            else self._lamb_decay
+        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon) + decay * p32
         w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
         r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
         trust = jnp.where(jnp.logical_and(w_norm > 0, r_norm > 0),

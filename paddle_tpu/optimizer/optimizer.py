@@ -106,29 +106,48 @@ class Optimizer:
         return self._accumulators[key]
 
     # -- the fused update ---------------------------------------------------
+    def _active_params(self):
+        """Params updated this step — the single filter every code path
+        (step, fused-build, per-param masks) must agree on."""
+        out = []
+        for p in self._parameter_list:
+            trainable = (p.trainable if isinstance(p, Parameter)
+                         else not p.stop_gradient)
+            if trainable and p.grad is not None:
+                out.append(p)
+        return out
+
+    def _per_param_extra(self, params):
+        """Optional per-param static values baked into the fused program
+        (e.g. per-param weight-decay masks). None entries -> no extra."""
+        return None
+
     def _build_fused(self, n_params):
         rule = self._rule
+        extras = self._per_param_extra(self._active_params())
 
         def fused(params, grads, states, gstate, lr):
             new_params, new_states = [], []
             gstate = dict(gstate)
-            for p, g, s in zip(params, grads, states):
+            for i, (p, g, s) in enumerate(zip(params, grads, states)):
+                self._cur_extra = extras[i] if extras is not None else None
                 np_, ns = rule(p, g, s, gstate, lr)
                 new_params.append(np_)
                 new_states.append(ns)
             gstate = self._advance_global(gstate)
             return new_params, new_states, gstate
 
-        return jax.jit(fused, donate_argnums=(0, 2, 3))
+        # Donate accumulators/global state (owned by this optimizer; the
+        # public state_dict copies). Params are NOT donated: tape nodes
+        # under retain_graph and user-held references may alias them.
+        return jax.jit(fused, donate_argnums=(2, 3))
 
     def _advance_global(self, gstate):
         return gstate
 
     @jax.named_scope("optimizer_step")
     def step(self):
-        params = [p for p in self._parameter_list
-                  if isinstance(p, Parameter) and p.trainable
-                  and p.grad is not None]
+        params = self._active_params()
         if not params:
             return
         grads = [p.grad for p in params]
@@ -174,14 +193,16 @@ class Optimizer:
 
     # -- checkpointing -------------------------------------------------------
     def state_dict(self):
+        # copies, not views: the live buffers are donated by the fused
+        # update, which would invalidate shared references
         sd = {}
         for p in self._parameter_list:
             if id(p) in self._accumulators:
                 for name, v in self._accumulators[id(p)].items():
-                    sd[f"{p.name}_{name}"] = Tensor(v)
+                    sd[f"{p.name}_{name}"] = Tensor(jnp.array(v, copy=True))
         if hasattr(self, "_gstate"):
             for k, v in self._gstate.items():
-                sd[f"global_{k}"] = Tensor(v)
+                sd[f"global_{k}"] = Tensor(jnp.array(v, copy=True))
         if self._lr_scheduler is not None:
             sd["LR_Scheduler"] = self._lr_scheduler.state_dict()
         return sd

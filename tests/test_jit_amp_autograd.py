@@ -1,0 +1,216 @@
+"""to_static / AMP / PyLayer tests (reference models:
+unittests/dygraph_to_static/, test_amp*, test_pylayer_op.py)."""
+import os
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+import paddle_tpu.amp as amp
+from paddle_tpu import jit
+from paddle_tpu.autograd import PyLayer
+
+
+def _randn(*shape):
+    return np.random.RandomState(sum(shape)).randn(*shape).astype("float32")
+
+
+class TestToStatic:
+    def test_function(self):
+        @jit.to_static
+        def f(x, y):
+            return paddle.matmul(x, y) + 1.0
+
+        a = paddle.to_tensor(_randn(2, 3), stop_gradient=False)
+        b = paddle.to_tensor(_randn(3, 4))
+        out = f(a, b)
+        np.testing.assert_allclose(out.numpy(), a.numpy() @ b.numpy() + 1,
+                                   rtol=1e-5)
+        out.sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(),
+                                   np.tile(b.numpy().sum(1), (2, 1)),
+                                   rtol=1e-5)
+
+    def test_layer_buffers_and_rng(self):
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(8, 8)
+                self.bn = nn.BatchNorm1D(8)
+                self.drop = nn.Dropout(0.5)
+
+            def forward(self, x):
+                return self.drop(self.bn(self.lin(x)))
+
+        m = jit.to_static(M())
+        x = paddle.to_tensor(_randn(16, 8))
+        mb = m.bn._mean.numpy().copy()
+        y1 = m(x)
+        assert not np.allclose(mb, m.bn._mean.numpy()), \
+            "BN stats must update through the compiled program"
+        y2 = m(x)
+        assert not np.allclose(y1.numpy(), y2.numpy()), \
+            "dropout must resample per compiled call"
+
+    def test_closure_capture_train(self):
+        model = nn.Sequential(nn.Linear(4, 16), nn.ReLU(),
+                              nn.Linear(16, 1))
+        optm = opt.Adam(1e-2, parameters=model.parameters())
+        X = paddle.to_tensor(_randn(32, 4))
+        Y = paddle.to_tensor(_randn(32, 1))
+        fwd = jit.to_static(lambda x: model(x))
+        losses = []
+        for _ in range(30):
+            loss = F.mse_loss(fwd(X), Y)
+            loss.backward()
+            optm.step()
+            optm.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.6
+
+    def test_eval_matches_eager(self):
+        model = nn.Sequential(nn.Linear(6, 6), nn.Tanh(), nn.Linear(6, 2))
+        model.eval()
+        x = paddle.to_tensor(_randn(3, 6))
+        eager = model(x).numpy()
+        static = jit.to_static(lambda v: model(v))(x).numpy()
+        np.testing.assert_allclose(static, eager, rtol=1e-5, atol=1e-6)
+
+    def test_save_load(self, tmp_path):
+        lay = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        lay.eval()
+        p = str(tmp_path / "model")
+        jit.save(lay, p, input_spec=[jit.InputSpec([1, 4], "float32")])
+        assert os.path.exists(p + ".pdmodel")
+        tl = jit.load(p)
+        x = paddle.to_tensor(_randn(1, 4))
+        np.testing.assert_allclose(tl(x).numpy(), lay(x).numpy(),
+                                   rtol=1e-5)
+
+
+class TestAmp:
+    def test_o1_white_list(self):
+        lin = nn.Linear(8, 8)
+        x = paddle.to_tensor(_randn(2, 8))
+        with amp.auto_cast():
+            y = lin(x)
+        assert y.dtype == "bfloat16"
+        assert lin(x).dtype == "float32"
+
+    def test_o1_black_list_keeps_f32(self):
+        x = paddle.to_tensor(_randn(4, 4).astype("float32"))
+        with amp.auto_cast():
+            s = F.softmax(x)
+        assert s.dtype == "float32"
+
+    def test_o2(self):
+        lin = nn.Linear(4, 4)
+        x = paddle.to_tensor(_randn(2, 4))
+        with amp.auto_cast(level="O2"):
+            y = F.relu(lin(x))
+        assert y.dtype == "bfloat16"
+
+    def test_grads_flow(self):
+        lin = nn.Linear(8, 8)
+        x = paddle.to_tensor(_randn(2, 8), stop_gradient=False)
+        with amp.auto_cast():
+            loss = lin(x).cast("float32").mean()
+        loss.backward()
+        assert lin.weight.grad is not None
+        assert x.grad is not None
+
+    def test_grad_scaler_skips_on_inf(self):
+        model = nn.Linear(2, 2)
+        o = opt.SGD(0.1, parameters=model.parameters())
+        scaler = amp.GradScaler(init_loss_scaling=4.0,
+                                decr_every_n_nan_or_inf=1)
+        before = model.weight.numpy().copy()
+        model.weight.grad = paddle.to_tensor(
+            np.full((2, 2), np.inf, "float32"))
+        model.bias.grad = paddle.to_tensor(np.zeros(2, "float32"))
+        scaler.step(o)
+        np.testing.assert_allclose(model.weight.numpy(), before)
+        assert scaler._scale == 2.0  # decreased
+
+    def test_scaler_scale_value(self):
+        scaler = amp.GradScaler(init_loss_scaling=8.0)
+        t = paddle.to_tensor(np.array([2.0], "float32"))
+        np.testing.assert_allclose(scaler.scale(t).numpy(), [16.0])
+
+
+class TestPyLayer:
+    def test_custom_grad(self):
+        class Exp(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                y = x.exp()
+                ctx.save_for_backward(y)
+                return y
+
+            @staticmethod
+            def backward(ctx, dy):
+                (y,) = ctx.saved_tensor
+                return dy * y
+
+        t = paddle.to_tensor(np.array([0.0, 1.0], "float32"),
+                             stop_gradient=False)
+        out = Exp.apply(t)
+        out.sum().backward()
+        np.testing.assert_allclose(t.grad.numpy(), np.exp(t.numpy()),
+                                   rtol=1e-5)
+
+    def test_chain(self):
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x * 2.0
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy * 2.0
+
+        t = paddle.to_tensor(np.array([3.0], "float32"),
+                             stop_gradient=False)
+        z = (Double.apply(t * t)).sum()
+        z.backward()
+        np.testing.assert_allclose(t.grad.numpy(), [12.0], rtol=1e-6)
+
+    def test_multiple_inputs_none_grad(self):
+        class MulAdd(PyLayer):
+            @staticmethod
+            def forward(ctx, x, y):
+                ctx.save_for_backward(x, y)
+                return x * y
+
+            @staticmethod
+            def backward(ctx, dy):
+                x, y = ctx.saved_tensor
+                return dy * y, dy * x
+
+        a = paddle.to_tensor(np.array([2.0], "float32"),
+                             stop_gradient=False)
+        b = paddle.to_tensor(np.array([5.0], "float32"),
+                             stop_gradient=False)
+        MulAdd.apply(a, b).backward()
+        np.testing.assert_allclose(a.grad.numpy(), [5.0])
+        np.testing.assert_allclose(b.grad.numpy(), [2.0])
+
+
+class TestAutogradExtras:
+    def test_jacobian(self):
+        from paddle_tpu.autograd import jacobian
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"),
+                             stop_gradient=False)
+        y = (x * x).sum()
+        # jacobian of scalar wrt x = gradient row
+        j = jacobian(y, x)
+        np.testing.assert_allclose(j.numpy(), [[2.0, 4.0, 6.0]], rtol=1e-6)
+
+    def test_backward_api(self):
+        from paddle_tpu import autograd
+        x = paddle.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+        loss = (x * 3.0).sum()
+        autograd.backward([loss])
+        np.testing.assert_allclose(x.grad.numpy(), [3.0] * 3)
