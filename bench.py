@@ -1,0 +1,115 @@
+"""Benchmark: GPT pretraining throughput on the available chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = achieved MFU / 0.35 (the BASELINE.md target for config #4)
+when the chip's peak FLOPs are known, else 0.0.
+
+Single-chip GPT-124M-ish config in bf16, whole train step compiled into
+one XLA program (forward+backward+AdamW, donated buffers).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# fast matmul path for the benchmark
+os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "default")
+
+
+# bf16 peak FLOPs per chip (per device_kind substring)
+_PEAK_FLOPS = {
+    "v5p": 459e12, "v5e": 197e12, "v5 lite": 197e12, "v5lite": 197e12,
+    "v4": 275e12, "v6": 918e12, "v3": 123e12, "v2": 45e12,
+}
+
+
+def _peak_flops(kind: str):
+    kind = (kind or "").lower()
+    for k, v in _PEAK_FLOPS.items():
+        if k in kind:
+            return v
+    return None
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import jit
+    from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+
+    paddle.set_matmul_precision("default")
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768,
+                        num_hidden_layers=12, num_attention_heads=12,
+                        max_position_embeddings=1024,
+                        hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+        batch, seqlen, iters, warmup = 8, 1024, 20, 3
+    else:  # CPU smoke numbers
+        cfg = GPTConfig(vocab_size=2048, hidden_size=256,
+                        num_hidden_layers=4, num_attention_heads=8,
+                        max_position_embeddings=256,
+                        hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+        batch, seqlen, iters, warmup = 4, 256, 5, 2
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.to(dtype="bfloat16")  # MXU-native weights; fp32 Adam moments
+    optimizer = opt.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters(),
+                          weight_decay=0.01)
+    step = jit.compile_train_step(
+        lambda ids, labels: model(ids, labels=labels), model, optimizer)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
+                                       (batch, seqlen)))
+    labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size,
+                                          (batch, seqlen)))
+
+    for _ in range(warmup):
+        loss = step(ids, labels)
+    # a device-to-host value fetch is the only true execution barrier
+    # through remote-tunnel PJRT transports (block_until_ready returns on
+    # buffer definition, not completion)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, labels)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seqlen * iters
+    tok_per_sec = tokens / dt
+
+    # parameter count & 6N flops/token (+ attention term)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    flops_per_token = 6 * n_params + \
+        12 * cfg.num_hidden_layers * cfg.hidden_size * seqlen
+    achieved = tok_per_sec * flops_per_token
+    peak = _peak_flops(getattr(dev, "device_kind", ""))
+    mfu = achieved / peak if peak else 0.0
+    vs_baseline = (mfu / 0.35) if peak else 0.0
+
+    print(json.dumps({
+        "metric": "gpt_pretrain_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec, 2),
+        "unit": f"tokens/s ({'tpu' if on_tpu else 'cpu-smoke'}, "
+                f"{n_params/1e6:.0f}M params, bs{batch}x{seqlen}, "
+                f"mfu={mfu:.3f})",
+        "vs_baseline": round(vs_baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -73,6 +73,8 @@ from . import jit  # noqa: E402,F401
 from . import io  # noqa: E402,F401
 from . import vision  # noqa: E402,F401
 from . import framework  # noqa: E402,F401
+from . import distributed  # noqa: E402,F401
+from .distributed.parallel import DataParallel  # noqa: E402,F401
 from .regularizer import L1Decay, L2Decay  # noqa: E402,F401
 from .nn.layer.layers import ParamAttr  # noqa: E402,F401
 

@@ -47,6 +47,11 @@ _tape = _TapeState()
 # mixed precision at the dispatch boundary (reference: eager/amp_utils.h)
 _amp_hook = None
 
+# set by paddle_tpu.distributed.mesh: vals -> vals, promoting stray
+# single-device arrays to the active mesh (replicated) so eager SPMD ops
+# can mix fresh host tensors with mesh-sharded parameters
+_mesh_hook = None
+
 
 def is_grad_enabled():
     return _tape.grad_enabled
@@ -127,6 +132,11 @@ class GradNode:
         full_cts = tuple(
             ct if ct is not None else jnp.zeros(shape, dt)
             for ct, (shape, dt) in zip(cts, self.out_meta))
+        if _mesh_hook is not None:
+            n_in = len(self.saved_inputs)
+            merged = _mesh_hook(tuple(self.saved_inputs) + full_cts)
+            self.saved_inputs = merged[:n_in]
+            full_cts = merged[n_in:]
         if self.op.bwd is not None:
             from .dispatch import get_custom_bwd
             fn = get_custom_bwd(self.op, self.attrs)
@@ -386,6 +396,8 @@ def apply_op(op_name: str, *tensors, attrs: Optional[dict] = None,
     vals = tuple(t._value for t in tensors)
     if _amp_hook is not None:
         vals = _amp_hook(op.name, vals)
+    if _mesh_hook is not None:
+        vals = _mesh_hook(vals)
     fn = get_jitted(op.fwd, attrs)
     out = fn(*vals)
     single = not isinstance(out, (tuple, list))

@@ -1,0 +1,40 @@
+"""paddle.distributed parity surface (reference:
+python/paddle/distributed/__init__.py, 106k LoC of orchestration).
+
+TPU-native architecture: ONE jax.sharding.Mesh with axes
+["dp", "pp", "sharding", "sep", "mp"] replaces per-axis NCCL process
+groups; GSPMD inserts collectives (SURVEY.md §7 idiom table). Modules:
+- mesh: ProcessMesh / shard_tensor / placements (auto-parallel API)
+- collective: eager collective API (single-controller semantics)
+- shard_ops: in-program collectives (psum/all_to_all/ppermute...)
+- fleet: hybrid topology, TP/PP layers, strategies
+- sharding: ZeRO 1/2/3 via sharding annotations
+- ring_attention: context parallelism (new vs reference)
+- moe: expert parallelism
+"""
+from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
+from .mesh import (  # noqa: F401
+    ProcessMesh, get_mesh, set_mesh, auto_mesh, shard_tensor,
+    shard_constraint, replicate, Shard, Replicate, Partial, Placement)
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, is_initialized, all_reduce,
+    all_gather, all_gather_object, reduce, broadcast,
+    broadcast_object_list, scatter, alltoall, alltoall_single, send, recv,
+    isend, irecv, barrier, reduce_scatter, stream, wait,
+    destroy_process_group, get_backend)
+from .parallel import (  # noqa: F401
+    init_parallel_env, DataParallel, shard_batch)
+from .sharding import (  # noqa: F401
+    group_sharded_parallel, save_group_sharded_model)
+from .ring_attention import ring_attention  # noqa: F401
+from . import shard_ops  # noqa: F401
+from . import fleet  # noqa: F401
+from .moe import MoELayer  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference: distributed/spawn.py. Single-controller GSPMD needs no
+    per-device processes — run func once; it sees the whole mesh."""
+    init_parallel_env()
+    func(*args)
+    return None

@@ -1,0 +1,270 @@
+"""Collective communication API.
+
+TPU-native replacement for paddle.distributed collectives (reference:
+python/paddle/distributed/collective.py, communication/*, C++
+ProcessGroupNCCL at distributed/collective/ProcessGroupNCCL.cc:169).
+
+Execution model: ONE controller process drives the whole mesh (GSPMD).
+There are no per-rank processes holding divergent tensors, so the eager
+collectives here implement the "all ranks hold this tensor" semantics —
+the exact behavior of the reference when every rank calls the collective
+with equal values (which is what its own unit tests assert,
+unittests/collective/collective_allreduce_api.py). Genuinely divergent
+per-device data lives in SHARDED arrays, where collectives are expressed
+in-program: use `paddle_tpu.distributed.shard_ops` (psum/all_gather/
+all_to_all/ppermute over named mesh axes) inside shard_map/jit — those
+lower to XLA collectives on ICI, replacing the c_* op zoo
+(operators/collective/, 160 files).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .env import ParallelEnv, get_rank, get_world_size
+from .mesh import get_mesh
+
+__all__ = ["ReduceOp", "Group", "new_group", "get_group", "is_initialized",
+           "all_reduce", "all_gather", "all_gather_object", "reduce",
+           "broadcast", "broadcast_object_list", "scatter", "alltoall",
+           "alltoall_single", "send", "recv", "isend", "irecv", "barrier",
+           "reduce_scatter", "stream", "wait", "destroy_process_group",
+           "get_backend"]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+_groups: dict = {}
+_group_counter = [0]
+_initialized = [False]
+
+
+class Group:
+    """A communication group. Binds to a mesh axis when axis_name given;
+    otherwise a trivial (world) group."""
+
+    def __init__(self, gid=0, axis_name=None, mesh=None, ranks=None):
+        self.id = gid
+        self.axis_name = axis_name
+        self.mesh = mesh
+        self._ranks = ranks
+
+    @property
+    def nranks(self):
+        if self.axis_name is not None and self.mesh is not None:
+            return self.mesh.get_dim_size(self.axis_name)
+        if self._ranks:
+            return len(self._ranks)
+        return 1
+
+    world_size = nranks
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def ranks(self):
+        return self._ranks or list(range(self.nranks))
+
+    def get_group_rank(self, rank):
+        return rank
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return (f"Group(id={self.id}, axis={self.axis_name}, "
+                f"nranks={self.nranks})")
+
+
+def _default_group():
+    if 0 not in _groups:
+        _groups[0] = Group(0)
+    return _groups[0]
+
+
+def _nranks(group):
+    return (group or _default_group()).nranks
+
+
+def is_initialized():
+    return _initialized[0]
+
+
+def mark_initialized():
+    _initialized[0] = True
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    """reference: python/paddle/distributed/collective.py:174. Pass
+    axis_name to bind the group to a mesh axis (its size = nranks)."""
+    _group_counter[0] += 1
+    gid = _group_counter[0]
+    g = Group(gid, axis_name=axis_name, mesh=get_mesh(), ranks=ranks)
+    _groups[gid] = g
+    return g
+
+
+def get_group(gid=0):
+    return _groups.get(gid, _default_group())
+
+
+def get_backend(group=None):
+    return "xla"
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _groups.clear()
+        _initialized[0] = False
+    else:
+        _groups.pop(group.id, None)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place; "every rank holds `tensor`" semantics (see module doc)."""
+    n = _nranks(group)
+    if n == 1:
+        return tensor
+    if op == ReduceOp.SUM:
+        tensor._rebind(tensor._value * n)
+    elif op == ReduceOp.PROD:
+        tensor._rebind(tensor._value ** n)
+    # MAX/MIN/AVG over equal values are identity
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    n = _nranks(group)
+    for _ in range(n):
+        tensor_list.append(Tensor(tensor._value))
+    return tensor_list
+
+
+def all_gather_object(object_list, obj, group=None):
+    n = _nranks(group)
+    for _ in range(n):
+        object_list.append(obj)
+    return object_list
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op=op, group=group)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        tensor._rebind(tensor_list[0]._value)
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None,
+             sync_op=True):
+    """Equal-rank semantics: rank 0 receives every rank's chunk 0."""
+    outs = [Tensor(in_tensor_list[0]._value)
+            for _ in range(len(in_tensor_list))]
+    if out_tensor_list is None:
+        return outs
+    if len(out_tensor_list) == 0:
+        out_tensor_list.extend(outs)
+    else:
+        for o, v in zip(out_tensor_list, outs):
+            o._rebind(v._value)
+    return out_tensor_list
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    n = _nranks(group)
+    if n == 1:
+        val = in_tensor._value
+    else:
+        first = in_tensor._value.shape[0] // n
+        chunk0 = in_tensor._value[:first]
+        val = jnp.concatenate([chunk0] * n, axis=0)
+    if out_tensor is not None:
+        out_tensor._rebind(val)
+        return out_tensor
+    return Tensor(val)
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    n = _nranks(group)
+    if tensor_list:
+        src = tensor_list[0]._value
+    else:
+        src = tensor._value[:tensor._value.shape[0] // max(n, 1)]
+    if op == ReduceOp.SUM and n > 1:
+        src = src * n
+    tensor._rebind(src)
+    return tensor
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "cross-rank p2p does not exist in the single-controller GSPMD "
+        "regime; use distributed.shard_ops.ppermute inside a compiled "
+        "program for on-mesh p2p (the replacement for partial_send/recv, "
+        "reference: operators/collective/partial_send_op.cc)")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return send(tensor, src, group, sync_op)
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group)
+
+
+class _Done:
+    def wait(self):
+        return
+
+    def is_completed(self):
+        return True
+
+
+def barrier(group=None):
+    jax.effects_barrier()
+    return _Done()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor):
+        jax.block_until_ready(tensor._value)
+    return None
+
+
+class stream:
+    """paddle.distributed.stream parity — stream-level knobs collapse
+    under PJRT async execution (SURVEY.md §7)."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    alltoall = staticmethod(alltoall)
+    reduce_scatter = staticmethod(reduce_scatter)
+    broadcast = staticmethod(broadcast)
+    scatter = staticmethod(scatter)

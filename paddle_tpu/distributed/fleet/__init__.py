@@ -1,0 +1,159 @@
+"""paddle.distributed.fleet parity.
+
+Reference: python/paddle/distributed/fleet/fleet.py:168 init,
+:384 _init_hybrid_parallel_env; fleet/base/distributed_strategy.py.
+fleet.init builds the 5-axis mesh topology (adds the "sep" sequence axis
+over the reference's 4); distributed_model/distributed_optimizer return
+mesh-aware wrappers instead of NCCL-reducer wrappers.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .topology import CommunicateTopology, HybridCommunicateGroup
+from .mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy)
+from ..env import ParallelEnv
+
+__all__ = ["init", "DistributedStrategy", "HybridCommunicateGroup",
+           "CommunicateTopology", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "worker_index", "worker_num", "is_first_worker",
+           "VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy", "meta_parallel",
+           "utils"]
+
+
+class DistributedStrategy:
+    """reference: fleet/base/distributed_strategy.py (proto-backed knob
+    bundle, framework/distributed_strategy.proto). Plain attrs here —
+    the knobs that map to GSPMD are consumed by fleet.init/wrappers; the
+    CUDA-only ones are accepted and ignored for portability."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1}
+        self.sharding_configs = {"stage": 1, "offload": False}
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.localsgd = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.sync_nccl_allreduce = True
+        self.without_graph_optimization = True
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+class _Fleet:
+    def __init__(self):
+        self._hcg = None
+        self._strategy = None
+        self._initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        from .. import collective as coll
+        self._strategy = strategy or DistributedStrategy()
+        h = self._strategy.hybrid_configs
+        topo = CommunicateTopology(
+            hybrid_group_names=["data", "pipe", "sharding", "sep",
+                                "model"],
+            dims=[h.get("dp_degree", 1), h.get("pp_degree", 1),
+                  h.get("sharding_degree", 1), h.get("sep_degree", 1),
+                  h.get("mp_degree", 1)])
+        self._hcg = HybridCommunicateGroup(topo)
+        coll.mark_initialized()
+        self._initialized = True
+        return self
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def hcg(self):
+        return self._hcg
+
+    def worker_index(self):
+        return ParallelEnv().rank
+
+    def worker_num(self):
+        return ParallelEnv().world_size
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def barrier_worker(self):
+        import jax
+        jax.effects_barrier()
+
+    def distributed_model(self, model):
+        """reference: fleet/model.py:30 — wrap by parallel mode. Under
+        GSPMD the mesh annotations already make the model distributed;
+        data parallelism is applied by sharding the batch (DataLoader /
+        shard_tensor), so the model comes back as-is with its parameters
+        placed on the mesh."""
+        if self._hcg is None:
+            raise RuntimeError("call fleet.init() first")
+        from ..parallel import _place_model_on_mesh
+        _place_model_on_mesh(model, self._hcg)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """reference: fleet/fleet.py distributed_optimizer →
+        HybridParallelOptimizer. Grad averaging across dp is implicit in
+        the global-batch loss; sharding-stage optimizer states are
+        annotated in group_sharded. The optimizer returns unchanged but
+        tagged with the hcg for API parity."""
+        optimizer._hcg = self._hcg
+        return optimizer
+
+
+fleet = _Fleet()
+
+
+def init(role_maker=None, is_collective=True, strategy=None, **kw):
+    return fleet.init(role_maker, is_collective, strategy, **kw)
+
+
+def get_hybrid_communicate_group():
+    return fleet.get_hybrid_communicate_group()
+
+
+def distributed_model(model):
+    return fleet.distributed_model(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return fleet.distributed_optimizer(optimizer, strategy)
+
+
+def worker_index():
+    return fleet.worker_index()
+
+
+def worker_num():
+    return fleet.worker_num()
+
+
+def is_first_worker():
+    return fleet.is_first_worker()
+
+
+from . import meta_parallel  # noqa: E402,F401
+from . import utils  # noqa: E402,F401

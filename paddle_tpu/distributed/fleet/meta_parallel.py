@@ -1,0 +1,27 @@
+"""fleet.meta_parallel parity surface (reference:
+python/paddle/distributed/fleet/meta_parallel/__init__.py)."""
+from .pp_layers import (  # noqa: F401
+    LayerDesc, SharedLayerDesc, PipelineLayer, SegmentLayers,
+    PipelineParallel)
+from .mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy)
+from ..parallel import DataParallel  # noqa: F401
+
+
+class TensorParallel:
+    """Wrapper marker (reference: meta_parallel/tensor_parallel.py) — the
+    mp layers already carry their shardings; wrapping is identity."""
+
+    def __new__(cls, model, hcg=None, **kwargs):
+        return model
+
+
+class ShardingParallel:
+    def __new__(cls, model, hcg=None, **kwargs):
+        return model
+
+
+def get_rng_state_tracker():
+    from .utils import RNGStatesTracker
+    return RNGStatesTracker.global_tracker()

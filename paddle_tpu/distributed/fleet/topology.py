@@ -1,0 +1,201 @@
+"""Hybrid-parallel topology.
+
+TPU-native replacement for CommunicateTopology/HybridCommunicateGroup
+(reference: python/paddle/distributed/fleet/base/topology.py:53,139).
+The reference builds per-axis NCCL groups over process ranks; here the
+axes are dimensions of ONE jax Mesh — ["data", "pipe", "sharding",
+"sep", "model"], adding the "sep" sequence axis the reference lacks —
+and a "group" is a named mesh axis handle.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+
+import numpy as np
+import jax
+
+from ..mesh import ProcessMesh, set_mesh
+from ..collective import Group, new_group
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
+
+_AXIS_ALIAS = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+               "model": "mp", "sep": "sep"}
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding",
+                                           "sep", "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = collections.namedtuple(
+            "Coordinate", self._parallel_names)
+        ranges = [range(d) for d in self._dims]
+        all_coords = [self.coordinate(*c) for c in
+                      itertools.product(*ranges)]
+        self._coord2rank = {c: i for i, c in enumerate(all_coords)}
+        self._rank2coord = {i: c for c, i in self._coord2rank.items()}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **args):
+        return self._coord2rank[self.coordinate(**args)]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        return [r for c, r in self._coord2rank.items() if c[axis] == index]
+
+    def get_comm_list(self, axis_name):
+        axis = self._parallel_names.index(axis_name)
+        other_ranges = [range(d) for i, d in enumerate(self._dims)
+                        if i != axis]
+        lists = []
+        for other in itertools.product(*other_ranges):
+            ranks = []
+            for v in range(self._dims[axis]):
+                coord = list(other)
+                coord.insert(axis, v)
+                ranks.append(self._coord2rank[self.coordinate(*coord)])
+            lists.append(ranks)
+        return lists
+
+
+class HybridCommunicateGroup:
+    """reference: fleet/base/topology.py:139. Owns the global Mesh."""
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self._dp_degree = topology.get_dim("data")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._mp_degree = topology.get_dim("model")
+        self._sep_degree = (topology.get_dim("sep")
+                            if "sep" in topology.get_hybrid_group_names()
+                            else 1)
+        self.global_rank = 0
+        world = topology.world_size()
+        n_dev = len(jax.devices())
+        if world > n_dev:
+            raise ValueError(
+                f"topology needs {world} devices, only {n_dev} visible; "
+                f"set XLA_FLAGS=--xla_force_host_platform_device_count "
+                f"for virtual-device testing")
+        dims = [self._dp_degree, self._pp_degree, self._sharding_degree,
+                self._sep_degree, self._mp_degree]
+        self._mesh = ProcessMesh(
+            shape=dims, dim_names=["dp", "pp", "sharding", "sep", "mp"])
+        set_mesh(self._mesh)
+        self._dp_group = new_group(axis_name="dp")
+        self._pp_group = new_group(axis_name="pp")
+        self._sharding_group = new_group(axis_name="sharding")
+        self._sep_group = new_group(axis_name="sep")
+        self._mp_group = new_group(axis_name="mp")
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return "pipeline"
+        if self._mp_degree > 1 or self._sep_degree > 1:
+            return "model"
+        if self._sharding_degree > 1:
+            return "sharding"
+        return "data"
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return 0
+
+    # data parallel
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    # model (tensor) parallel
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    # pipeline
+    @property
+    def stage_id(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_pipe_parallel_rank(self):
+        return 0
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def get_p2p_groups(self):
+        return None
+
+    # sharding
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sharding_parallel_group_src_rank(self):
+        return 0
+
+    # sequence (new)
+    def get_sep_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    def get_check_parallel_group(self, *a, **kw):
+        return self._mp_group
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank(
+            data=0, pipe=stage_id, sharding=0, sep=0, model=0)

@@ -1,0 +1,201 @@
+"""fleet.utils parity: recompute, hybrid-parallel grad sync, TP RNG.
+
+Reference: python/paddle/distributed/fleet/utils/__init__.py,
+fleet/recompute/recompute.py:223 RecomputeFunction,
+fleet/layers/mpu/random.py:34 RNGStatesTracker,
+fleet/utils/hybrid_parallel_util.py:203 fused_allreduce_gradients.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.dispatch import OpDef
+from ...core import random as random_mod
+
+__all__ = ["recompute", "recompute_sequential", "RNGStatesTracker",
+           "fused_allreduce_gradients", "sharding_reduce_gradients"]
+
+_recompute_ops: dict = {}
+
+
+def _closure_state(function):
+    """Params/buffers captured by the function's closure — they must
+    become op inputs so gradients reach them (same lift as
+    jit.api.StaticFunction._collect_state)."""
+    from ...nn.layer.layers import Layer
+    layers, loose, seen = [], [], set()
+    fn_self = getattr(function, "__self__", None)
+    if isinstance(fn_self, Layer):
+        layers.append(fn_self)
+    candidates = []
+    for cell in getattr(function, "__closure__", None) or ():
+        try:
+            candidates.append(cell.cell_contents)
+        except ValueError:
+            pass
+    code = getattr(function, "__code__", None)
+    g = getattr(function, "__globals__", {})
+    if code is not None:
+        for name in code.co_names:
+            if name in g:
+                candidates.append(g[name])
+    for obj in candidates:
+        if id(obj) in seen:
+            continue
+        seen.add(id(obj))
+        if isinstance(obj, Layer):
+            layers.append(obj)
+        elif isinstance(obj, Tensor) and not obj.stop_gradient:
+            loose.append(obj)
+    state, sids = [], set()
+    for lyr in layers:
+        for _, p in lyr.named_parameters():
+            if id(p) not in sids:
+                sids.add(id(p))
+                state.append(p)
+        for _, b in lyr.named_buffers():
+            if id(b) not in sids:
+                sids.add(id(b))
+                state.append(b)
+    for t in loose:
+        if id(t) not in sids:
+            sids.add(id(t))
+            state.append(t)
+    return state
+
+
+def recompute(function, *args, **kwargs):
+    """Activation checkpointing (reference: recompute.py:223). The
+    function runs under jax.checkpoint (remat): backward recomputes
+    activations inside the fused backward program — the exact
+    FLOPs-for-HBM trade the reference implements with a PyLayer.
+    Closure-captured Layer params are lifted to op inputs so their
+    gradients flow."""
+    kwargs.pop("use_reentrant", True)
+    kwargs.pop("preserve_rng_state", True)
+    tensors = [a for a in args if isinstance(a, Tensor)]
+    non_tensor = [(i, a) for i, a in enumerate(args)
+                  if not isinstance(a, Tensor)]
+    state = _closure_state(function)
+    n_state = len(state)
+    op = _recompute_ops.get(function)
+    if op is None:
+        def fwd(rng_key, *vals, _fn=function):
+            random_mod.push_trace_key(rng_key)
+            originals = [t._value for t in state]
+            try:
+                for t, tracer in zip(state, vals[:n_state]):
+                    t._value = tracer
+                arg_vals = vals[n_state:]
+                non_tensor_at = dict(non_tensor)
+                full_args = []
+                vi = 0
+                for i in range(len(args)):
+                    if i in non_tensor_at:
+                        full_args.append(non_tensor_at[i])
+                    else:
+                        full_args.append(Tensor(arg_vals[vi]))
+                        vi += 1
+                out = _fn(*full_args, **kwargs)
+                if isinstance(out, Tensor):
+                    return out._value
+                return tuple(o._value if isinstance(o, Tensor) else o
+                             for o in out)
+            finally:
+                random_mod.pop_trace_key()
+                for t, v in zip(state, originals):
+                    t._value = v
+        fwd_ckpt = jax.checkpoint(fwd)
+        op = OpDef(f"recompute::{getattr(function, '__name__', 'fn')}",
+                   fwd_ckpt)
+        _recompute_ops[function] = op
+    from ...core.tensor import apply_op
+    rk = Tensor(random_mod.next_key())
+    return apply_op(op, rk, *state, *tensors)
+
+
+def recompute_sequential(ctx, functions, *args):
+    """reference: recompute.py:496 recompute_sequential."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    if not isinstance(functions, (list, tuple)):
+        functions = list(functions)
+    n = len(functions)
+    per = max(n // segments, 1)
+    x = args[0] if len(args) == 1 else args
+
+    def seg_fn(layers):
+        def run(v):
+            for l in layers:
+                v = l(v)
+            return v
+        return run
+
+    i = 0
+    while i < n:
+        chunk = functions[i:i + per]
+        x = recompute(seg_fn(chunk), x)
+        i += per
+    return x
+
+
+class RNGStatesTracker:
+    """TP-aware RNG streams (reference: mpu/random.py:34). Named streams
+    give dropout different randomness across model-parallel shards
+    ('local_seed') or identical randomness ('global_seed')."""
+
+    _global = None
+
+    @classmethod
+    def global_tracker(cls):
+        if cls._global is None:
+            cls._global = RNGStatesTracker()
+        return cls._global
+
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already added")
+        if name in self.states_:
+            raise ValueError(f"state {name} already added")
+        self.seeds_.add(seed)
+        self.states_[name] = random_mod.Generator(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = states
+
+    @contextlib.contextmanager
+    def rng_state(self, name="model_parallel_rng"):
+        if name not in self.states_:
+            self.add(name, hash(name) & 0x7FFFFF)
+        gen = self.states_[name]
+        prev = random_mod.default_generator
+        random_mod.default_generator = gen
+        try:
+            yield
+        finally:
+            random_mod.default_generator = prev
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """reference: hybrid_parallel_util.py:203. Under GSPMD the gradient
+    reduction over dp happens inside the compiled backward; this is the
+    manual-sync entry kept for API parity (no-op on the mesh)."""
+    return None
+
+
+def sharding_reduce_gradients(parameter_list, hcg=None):
+    return None

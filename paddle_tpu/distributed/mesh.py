@@ -1,0 +1,261 @@
+"""Device-mesh state: the spine of TPU-native parallelism.
+
+TPU-native replacement for Paddle's process-group world (reference:
+paddle/fluid/distributed/collective/ProcessGroup.h:52 and the 4-axis
+fleet topology at python/paddle/distributed/fleet/base/topology.py:53).
+Where the reference builds one NCCL communicator per parallel axis and
+inserts c_* collective ops, here a single `jax.sharding.Mesh` carries ALL
+axes — ["dp", "pp", "sharding", "mp", "sep"] (+ the new sequence axis the
+reference lacks, SURVEY.md §5 "long-context = green-field") — and XLA's
+GSPMD partitioner inserts the collectives, riding ICI.
+
+One controller process drives the whole mesh (jax single/multi-host SPMD);
+"rank" collapses to a host index for data loading.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+
+__all__ = ["ProcessMesh", "get_mesh", "set_mesh", "auto_mesh",
+           "shard_tensor", "shard_constraint", "replicate", "Placement",
+           "Shard", "Replicate", "Partial"]
+
+_state = threading.local()
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    """Shard along tensor dim `dim` (reference:
+    python/paddle/distributed/auto_parallel dist_attr dims_mapping)."""
+
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+    def __hash__(self):
+        return hash(("S", self.dim))
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+    def __hash__(self):
+        return hash("R")
+
+
+class Partial(Placement):
+    """Pending-reduction placement (psum not yet applied)."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+class ProcessMesh:
+    """paddle.distributed.ProcessMesh parity (reference:
+    distributed/auto_parallel/process_mesh.h:32) backed by a jax Mesh."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None,
+                 process_ids=None):
+        if isinstance(mesh, Mesh):
+            self._jax_mesh = mesh
+            self._dim_names = list(mesh.axis_names)
+            self._shape = list(mesh.devices.shape)
+            return
+        if mesh is not None:
+            arr = np.asarray(mesh)
+            self._shape = list(arr.shape)
+        elif shape is not None:
+            self._shape = list(shape)
+        else:
+            raise ValueError("ProcessMesh needs mesh array or shape")
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(len(self._shape))]
+        self._dim_names = list(dim_names)
+        devs = np.asarray(jax.devices()[:int(np.prod(self._shape))])
+        self._jax_mesh = Mesh(devs.reshape(self._shape), self._dim_names)
+
+    @property
+    def jax_mesh(self):
+        return self._jax_mesh
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def process_ids(self):
+        return [d.id for d in self._jax_mesh.devices.flat]
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self._shape}, "
+                f"dim_names={self._dim_names})")
+
+    def __enter__(self):
+        self._prev = getattr(_state, "mesh", None)
+        set_mesh(self)
+        return self
+
+    def __exit__(self, *exc):
+        _state.mesh = self._prev
+        return False
+
+
+def set_mesh(mesh):
+    if isinstance(mesh, Mesh):
+        mesh = ProcessMesh(mesh)
+    _state.mesh = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return getattr(_state, "mesh", None)
+
+
+def auto_mesh(**axes) -> ProcessMesh:
+    """Build a mesh over all visible devices, e.g. auto_mesh(dp=2, mp=4).
+    Axis size -1 means 'all remaining devices'."""
+    n = len(jax.devices())
+    names, sizes = list(axes.keys()), list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = max(n // known, 1)
+    mesh = ProcessMesh(shape=sizes, dim_names=names)
+    set_mesh(mesh)
+    return mesh
+
+
+def _to_spec(placements, ndim, mesh):
+    """[Placement per mesh axis] -> PartitionSpec over tensor dims."""
+    entries = [None] * ndim
+    for axis_name, p in zip(mesh.dim_names, placements):
+        if isinstance(p, Shard):
+            d = p.dim % ndim
+            if entries[d] is None:
+                entries[d] = axis_name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (axis_name,)
+            else:
+                entries[d] = (entries[d], axis_name)
+    return PartitionSpec(*entries)
+
+
+def shard_tensor(x, mesh=None, placements=None, spec=None,
+                 stop_gradient=None):
+    """paddle.distributed.shard_tensor parity (reference:
+    distributed/auto_parallel/interface.py:28): place the tensor on the
+    mesh with the given layout. Eager ops on the result already execute
+    SPMD across devices — no program rewrite step."""
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return x
+    if spec is None:
+        spec = _to_spec(placements or [], x.ndim, mesh)
+    sharding = NamedSharding(mesh.jax_mesh, spec)
+    new_val = jax.device_put(x._value, sharding)
+    if isinstance(x, Tensor):
+        x._rebind(new_val)
+        if stop_gradient is not None:
+            x.stop_gradient = stop_gradient
+        return x
+    return Tensor(new_val)
+
+
+_constraint_ops: dict = {}
+
+
+def shard_constraint(x, spec, mesh=None):
+    """with_sharding_constraint for use inside jitted programs."""
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return x
+    from ..core.tensor import apply_op
+    from ..core.dispatch import OpDef
+    v = x._value
+    if not isinstance(v, jax.core.Tracer):
+        sh = getattr(v, "sharding", None)
+        if not (hasattr(sh, "mesh") and sh.mesh == mesh.jax_mesh):
+            # eager value not yet on the mesh: constraint == placement
+            return shard_tensor(x, mesh, spec=spec)
+    key = (id(mesh.jax_mesh), tuple(spec))
+    op = _constraint_ops.get(key)
+    if op is None:
+        sharding = NamedSharding(mesh.jax_mesh, spec)
+
+        def fwd(v, _sharding=sharding):
+            return jax.lax.with_sharding_constraint(v, _sharding)
+        op = OpDef(f"shard_constraint::{spec}", fwd)
+        _constraint_ops[key] = op
+    return apply_op(op, x)
+
+
+def replicate(x, mesh=None):
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return x
+    return shard_tensor(x, mesh, spec=PartitionSpec())
+
+
+def _harmonize_vals(vals):
+    """Dispatch-boundary hook: when a mesh is active and some operands
+    already live on it, promote stray single-device arrays to replicated
+    mesh placement so one jitted op can consume both. Once promoted, op
+    outputs stay on the mesh, so the transfer happens only at graph
+    boundaries (fresh to_tensor inputs)."""
+    pm = get_mesh()
+    if pm is None:
+        return vals
+    jm = pm.jax_mesh
+    if jm.size == 1:
+        return vals
+    on_mesh = []
+    for v in vals:
+        sh = getattr(v, "sharding", None)
+        if sh is None:  # tracer: jit context handles placement itself
+            return vals
+        on_mesh.append(isinstance(sh, NamedSharding) and sh.mesh == jm
+                       or getattr(sh, "num_devices", 1) == jm.size)
+    if all(on_mesh) or not any(on_mesh):
+        return vals
+    rep = NamedSharding(jm, PartitionSpec())
+    return tuple(v if ok else jax.device_put(v, rep)
+                 for v, ok in zip(vals, on_mesh))
+
+
+def _install_mesh_hook():
+    from ..core import tensor as tensor_mod
+    tensor_mod._mesh_hook = _harmonize_vals
+
+
+_install_mesh_hook()

@@ -1,0 +1,119 @@
+"""Data parallelism + environment init.
+
+TPU-native replacement for paddle.DataParallel / init_parallel_env
+(reference: python/paddle/distributed/parallel.py:108 init_parallel_env,
+python/paddle/fluid/dygraph/parallel.py:457 DataParallel with the
+EagerReducer bucketed-allreduce machinery at :739). Under GSPMD there is
+no reducer: the batch is sharded over the "dp" mesh axis, the loss is a
+global-batch mean, and XLA emits exactly one fused gradient all-reduce
+per step — what the reference's bucket fusion approximates by hand.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .env import ParallelEnv, get_rank, get_world_size
+from .mesh import get_mesh, auto_mesh, shard_tensor, replicate
+from . import collective
+
+__all__ = ["init_parallel_env", "DataParallel", "ParallelEnv",
+           "get_rank", "get_world_size", "shard_batch"]
+
+
+def init_parallel_env():
+    """reference: distributed/parallel.py:108. Multi-host: the launcher
+    sets the coordinator env and this calls jax.distributed.initialize;
+    single-host it builds a dp-only mesh over all local devices."""
+    if collective.is_initialized():
+        return ParallelEnv()
+    env = ParallelEnv()
+    if env.world_size > 1 and os.getenv("PADDLE_MASTER"):
+        jax.distributed.initialize(
+            coordinator_address=os.getenv("PADDLE_MASTER"),
+            num_processes=env.world_size, process_id=env.rank)
+    if get_mesh() is None:
+        auto_mesh(dp=-1)
+    collective.mark_initialized()
+    return env
+
+
+def shard_batch(x, mesh=None, axis="dp", batch_dim=0):
+    """Shard a host batch over the data axis — the loader-side half of
+    data parallelism (replaces per-rank DistributedBatchSampler feeds
+    when one controller loads the global batch)."""
+    mesh = mesh or get_mesh()
+    if mesh is None or axis not in mesh.dim_names \
+            or mesh.get_dim_size(axis) == 1:
+        return x
+    entries = [None] * x.ndim
+    entries[batch_dim] = axis
+    return shard_tensor(x, mesh, spec=P(*entries))
+
+
+def _place_model_on_mesh(model, hcg=None):
+    """Replicate parameters that carry no explicit sharding onto the mesh
+    so eager SPMD execution keeps everything co-located."""
+    mesh = get_mesh()
+    if mesh is None:
+        return model
+    import numpy as _np
+    n_total = int(_np.prod(mesh.shape))
+    if n_total == 1:
+        return model
+    for p in model.parameters():
+        sh = getattr(p._value, "sharding", None)
+        # only re-place fully-local arrays; keep explicit TP shardings
+        if sh is None or not getattr(sh, "mesh", None) is mesh.jax_mesh:
+            try:
+                replicate(p, mesh)
+            except Exception:
+                pass
+    for b in model.buffers():
+        try:
+            replicate(b, mesh)
+        except Exception:
+            pass
+    return model
+
+
+class DataParallel:
+    """paddle.DataParallel parity. Wraps the layer; `scale_loss` and the
+    reducer knobs are accepted for API compatibility but gradient
+    synchronization is performed by XLA on the sharded-batch program."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        _place_model_on_mesh(layers)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        return
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, sd, **kw):
+        return self._layers.set_state_dict(sd, **kw)
+
+    def no_sync(self):
+        import contextlib
+        return contextlib.nullcontext()

@@ -1,0 +1,117 @@
+"""ZeRO-style sharded training.
+
+TPU-native replacement for group_sharded / GroupSharded stages 1-3
+(reference: python/paddle/distributed/sharding/group_sharded.py;
+fleet/meta_parallel/sharding/group_sharded_optimizer_stage2.py:53,
+group_sharded_stage2.py:46, group_sharded_stage3.py:61). The reference
+manually partitions optimizer states / grads / params across ranks with
+broadcast + reduce-scatter choreography and forward prefetch (TaskFlow).
+Under GSPMD the same memory behavior (SURVEY.md §7: "match memory
+behavior, not mechanism") comes from sharding annotations:
+
+- stage 1: optimizer accumulators sharded over the "sharding" axis;
+- stage 2: + gradients reduce-scattered (XLA picks this when param
+  updates consume sharded states);
+- stage 3: + parameters sharded over the axis; XLA all-gathers weights
+  just-in-time per layer — the TaskFlow prefetch, scheduled by the
+  compiler.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import PartitionSpec as P, NamedSharding
+
+from ..core.tensor import Tensor
+from .mesh import get_mesh, shard_tensor
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model",
+           "shard_optimizer_states", "shard_parameters"]
+
+
+def _shard_axis_available(axis):
+    m = get_mesh()
+    return (m is not None and axis in m.dim_names
+            and m.get_dim_size(axis) > 1)
+
+
+def _spec_for(shape, axis, min_size=1):
+    """Shard the largest divisible dim over the axis; replicate if none."""
+    m = get_mesh()
+    n = m.get_dim_size(axis)
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for d in order:
+        if shape[d] % n == 0 and shape[d] >= n * min_size:
+            entries = [None] * len(shape)
+            entries[d] = axis
+            return P(*entries)
+    return P()
+
+
+def shard_parameters(model, axis="sharding"):
+    if not _shard_axis_available(axis):
+        return model
+    for p in model.parameters():
+        spec = _spec_for(tuple(p.shape), axis)
+        shard_tensor(p, spec=spec)
+    return model
+
+
+def shard_optimizer_states(optimizer, axis="sharding"):
+    """Annotate accumulator specs so states materialize sharded: wraps
+    _accumulator_specs to device_put each initial state with a sharded
+    layout; the fused update keeps layouts, so optimizer memory is
+    state_bytes/n per device."""
+    if not _shard_axis_available(axis):
+        return optimizer
+    mesh = get_mesh()
+    orig = optimizer._accumulator_specs
+
+    def sharded_specs(p):
+        specs = orig(p)
+        out = {}
+        for name, arr in specs.items():
+            spec = _spec_for(tuple(arr.shape), axis)
+            sh = NamedSharding(mesh.jax_mesh, spec)
+            out[name] = jax.device_put(arr, sh)
+        return out
+
+    optimizer._accumulator_specs = sharded_specs
+    return optimizer
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """reference: distributed/sharding/group_sharded.py
+    group_sharded_parallel(model, optimizer, level in {os, os_g, p_g_os}).
+    """
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(f"level must be os|os_g|p_g_os, got {level}")
+    if offload:
+        raise NotImplementedError(
+            "CPU offload: planned (jax host_offload memories)")
+    shard_optimizer_states(optimizer)
+    if level in ("os_g", "p_g_os"):
+        # grads follow param sharding decisions made by XLA once states
+        # are sharded; stage-3 additionally shards the live params:
+        if level == "p_g_os":
+            shard_parameters(model)
+    if scaler is not None:
+        return model, optimizer, scaler
+    return model, optimizer
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """reference: group_sharded.py save_group_sharded_model. Sharded
+    jax.Arrays gather transparently in .numpy(), so a plain state_dict
+    save is already the 'gather then save' path."""
+    import os as _os
+    from ..framework.io import save as _save
+    _os.makedirs(output, exist_ok=True)
+    _save(model.state_dict(), _os.path.join(output, "model.pdmodel"))
+    if optimizer is not None:
+        _save(optimizer.state_dict(), _os.path.join(output,
+                                                    "model.pdopt"))

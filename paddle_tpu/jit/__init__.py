@@ -3,3 +3,4 @@ from .api import (  # noqa: F401
     to_static, not_to_static, InputSpec, StaticFunction,
     in_to_static_trace, ignore_module)
 from .save_load import save, load, TranslatedLayer  # noqa: F401
+from .trainer import compile_train_step, CompiledTrainStep  # noqa: F401

@@ -1,0 +1,145 @@
+"""Single-XLA-program training step.
+
+This is SURVEY.md §7's north star made concrete: forward + backward +
+optimizer update compiled into ONE XLA computation with donated
+parameter/state buffers. The reference needs InterpreterCore + eager
+autograd + per-param optimizer ops; here the whole step is one
+`PjRtLoadedExecutable` — XLA fuses, schedules collectives over the mesh
+axes, and reuses parameter memory in place.
+
+Used by bench.py, __graft_entry__.dryrun_multichip, and available as
+`paddle_tpu.jit.compile_train_step` for users.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core import random as random_mod
+from ..core import dtype as dtypes
+
+__all__ = ["compile_train_step", "CompiledTrainStep"]
+
+
+class CompiledTrainStep:
+    """Owns the functionalized (params, opt-state) pytree and the jitted
+    step(params, states, gstate, key, *batch) -> (loss, new_params,
+    new_states, new_gstate)."""
+
+    def __init__(self, loss_fn, model, optimizer, donate=True,
+                 in_shardings=None):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.params = [p for p in model.parameters()
+                       if (p.trainable if isinstance(p, Parameter)
+                           else not p.stop_gradient)]
+        self.buffers = [b for _, b in model.named_buffers()]
+        self.state_tensors = self.params + self.buffers
+        self.n_params = len(self.params)
+        self.states = [dict(optimizer._state_for(p)) for p in self.params]
+        self.gstate = {k: jnp.asarray(v) for k, v in
+                       optimizer._global_state_spec().items()}
+        clip = optimizer._grad_clip
+        self._clip_norm = getattr(clip, "clip_norm", None) \
+            if clip is not None else None
+        decay = optimizer._decay if not getattr(optimizer, "_decoupled",
+                                                False) else 0.0
+        extras = optimizer._per_param_extra(self.params)
+        rule = optimizer._rule
+        advance = optimizer._advance_global
+        n_p = self.n_params
+        n_b = len(self.buffers)
+        state_tensors = self.state_tensors
+        loss_fn_ = loss_fn
+
+        def step(param_vals, buffer_vals, states, gstate, lr, key,
+                 *batch_vals):
+            def loss_of(pvals):
+                originals = [t._value for t in state_tensors]
+                random_mod.push_trace_key(key)
+                try:
+                    for t, v in zip(state_tensors,
+                                    list(pvals) + list(buffer_vals)):
+                        t._value = v
+                    batch = [Tensor(b) for b in batch_vals]
+                    out = loss_fn_(*batch)
+                    loss_val = out._value if isinstance(out, Tensor) \
+                        else out
+                    new_buf = tuple(t._value
+                                    for t in state_tensors[n_p:])
+                    return loss_val.astype(jnp.float32), new_buf
+                finally:
+                    random_mod.pop_trace_key()
+                    for t, v in zip(state_tensors, originals):
+                        t._value = v
+
+            (loss, new_bufs), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(list(param_vals))
+            if self._clip_norm is not None:
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in grads))
+                scale = self._clip_norm / jnp.maximum(gnorm,
+                                                      self._clip_norm)
+                grads = [g * scale.astype(g.dtype) for g in grads]
+            new_params, new_states = [], []
+            g2 = dict(gstate)
+            for i, (p, g, s) in enumerate(zip(param_vals, grads, states)):
+                if decay:
+                    g = g + decay * p
+                optimizer._cur_extra = (extras[i] if extras is not None
+                                        else None)
+                np_, ns = rule(p, g, s, g2, lr)
+                new_params.append(np_)
+                new_states.append(ns)
+            g2 = advance(g2)
+            return loss, new_params, list(new_bufs), new_states, g2
+
+        donate_args = (0, 1, 2, 3) if donate else ()
+        self._step = jax.jit(step, donate_argnums=donate_args)
+
+    def __call__(self, *batch):
+        batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                      for b in batch]
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        key = random_mod.next_key()
+        p_vals = [p._value for p in self.params]
+        b_vals = [b._value for b in self.buffers]
+        loss, new_p, new_b, new_s, new_g = self._step(
+            p_vals, b_vals, self.states, self.gstate, lr, key,
+            *batch_vals)
+        for p, v in zip(self.params, new_p):
+            p._rebind(v)
+        for b, v in zip(self.buffers, new_b):
+            b._rebind(v)
+        self.states = new_s
+        self.gstate = new_g
+        # keep the eager optimizer's view coherent for state_dict()
+        for p, s in zip(self.params, self.states):
+            self.optimizer._accumulators[id(p)] = s
+        self.optimizer._gstate = self.gstate
+        if self.optimizer._lr_scheduler is not None:
+            pass  # scheduler stepping stays the caller's choice
+        return Tensor(loss)
+
+    def compile_info(self, *batch):
+        """Lower + return the compiled HLO text (for inspection)."""
+        batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                      for b in batch]
+        lr = jnp.asarray(0.0, jnp.float32)
+        key = random_mod.next_key()
+        p_vals = [p._value for p in self.params]
+        b_vals = [b._value for b in self.buffers]
+        return self._step.lower(p_vals, b_vals, self.states, self.gstate,
+                                lr, key, *batch_vals)
+
+
+def compile_train_step(loss_fn, model, optimizer, donate=True):
+    """loss_fn(*batch_tensors) -> scalar loss Tensor, closing over
+    `model`. Returns a callable: step(*batch) -> loss."""
+    return CompiledTrainStep(loss_fn, model, optimizer, donate=donate)

@@ -1,0 +1,11 @@
+"""NLP model family (flagship models for BASELINE configs #3-#5).
+
+The reference delegates these to PaddleNLP; they are part of the
+capability surface (SURVEY.md §6: GPT tokens/sec is the headline metric),
+so the TPU build ships them in-tree: GPT (decoder-only LM), BERT
+(encoder), Llama (RMSNorm/RoPE/SwiGLU — exercises the new
+ring-attention/sep axis).
+"""
+from .gpt import GPTConfig, GPTModel, GPTForCausalLM  # noqa: F401
+from .bert import BertConfig, BertModel  # noqa: F401
+from .llama import LlamaConfig, LlamaModel, LlamaForCausalLM  # noqa: F401

@@ -1,0 +1,294 @@
+"""GPT: decoder-only transformer LM (BASELINE config #4).
+
+TPU-native design notes:
+- fused QKV projection: one [H, 3H] matmul feeding the MXU, then a
+  reshape — the layout the reference reaches via fused_attention_op.cu.
+- attention runs through F.scaled_dot_product_attention → the Pallas
+  flash kernel on TPU, the ring-attention path when the "sep" mesh axis
+  is active (sequence parallelism — new vs the reference).
+- tensor parallelism by construction: when fleet.init raised an "mp"
+  mesh axis, projections become Column/RowParallelLinear (GSPMD
+  shardings), embedding becomes VocabParallelEmbedding.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from ..core.tensor import Tensor
+from ..nn.initializer import Normal, Constant
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM"]
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=None, max_position_embeddings=1024,
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 initializer_range=0.02, layer_norm_epsilon=1e-5,
+                 use_recompute=False, tensor_parallel=None,
+                 sequence_parallel=False, fuse_attention_qkv=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.max_position_embeddings = max_position_embeddings
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.initializer_range = initializer_range
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.use_recompute = use_recompute
+        self.sequence_parallel = sequence_parallel
+        self.fuse_attention_qkv = fuse_attention_qkv
+
+
+def _mp_active():
+    from ..distributed.mesh import get_mesh
+    m = get_mesh()
+    return m is not None and "mp" in m.dim_names and \
+        m.get_dim_size("mp") > 1
+
+
+def _sep_active():
+    from ..distributed.mesh import get_mesh
+    m = get_mesh()
+    return m is not None and "sep" in m.dim_names and \
+        m.get_dim_size("sep") > 1
+
+
+def _make_linear(in_f, out_f, cfg, parallel=None, gather_output=False,
+                 input_is_parallel=True):
+    init = Normal(0.0, cfg.initializer_range)
+    attr = nn.ParamAttr(initializer=init)
+    if parallel == "column" and _mp_active():
+        from ..distributed import fleet
+        return fleet.ColumnParallelLinear(
+            in_f, out_f, weight_attr=attr, has_bias=True,
+            gather_output=gather_output)
+    if parallel == "row" and _mp_active():
+        from ..distributed import fleet
+        return fleet.RowParallelLinear(
+            in_f, out_f, weight_attr=attr, has_bias=True,
+            input_is_parallel=input_is_parallel)
+    return nn.Linear(in_f, out_f, weight_attr=attr)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.num_heads = config.num_attention_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        self.hidden_size = config.hidden_size
+        self.dropout = config.attention_probs_dropout_prob
+        self.qkv_proj = _make_linear(config.hidden_size,
+                                     3 * config.hidden_size, config,
+                                     parallel="column")
+        self.out_proj = _make_linear(config.hidden_size,
+                                     config.hidden_size, config,
+                                     parallel="row")
+
+    def forward(self, x, cache=None):
+        from ..ops import manipulation
+        b, l, h = x.shape[0], x.shape[1], self.hidden_size
+        qkv = self.qkv_proj(x)
+        qkv = manipulation.reshape(qkv, [b, l, self.num_heads,
+                                         3 * self.head_dim])
+        q, k, v = manipulation.split(qkv, 3, axis=-1)
+        if cache is not None:
+            k = manipulation.concat([cache[0], k], axis=1)
+            v = manipulation.concat([cache[1], v], axis=1)
+            new_cache = (k, v)
+        else:
+            new_cache = None
+        if _sep_active() and cache is None:
+            from ..distributed import ring_attention
+            out = ring_attention(q, k, v, causal=True)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, dropout_p=self.dropout, is_causal=True,
+                training=self.training)
+        out = manipulation.reshape(out, [b, l, h])
+        out = self.out_proj(out)
+        if new_cache is not None:
+            return out, new_cache
+        return out
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.fc1 = _make_linear(config.hidden_size,
+                                config.intermediate_size, config,
+                                parallel="column")
+        self.fc2 = _make_linear(config.intermediate_size,
+                                config.hidden_size, config, parallel="row")
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x), approximate=True))
+
+
+class GPTDecoderLayer(nn.Layer):
+    """Pre-LN block (reference structure: fused_multi_transformer_op.cu
+    implements exactly this layer for inference)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(config.hidden_size,
+                                epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln2 = nn.LayerNorm(config.hidden_size,
+                                epsilon=config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+        self.dropout1 = nn.Dropout(config.hidden_dropout_prob,
+                                   mode="upscale_in_train")
+        self.dropout2 = nn.Dropout(config.hidden_dropout_prob,
+                                   mode="upscale_in_train")
+        self.use_recompute = config.use_recompute
+
+    def _body(self, x):
+        x = x + self.dropout1(self.attn(self.ln1(x)))
+        x = x + self.dropout2(self.mlp(self.ln2(x)))
+        return x
+
+    def forward(self, x, cache=None):
+        if cache is not None:
+            h, new_cache = self.attn(self.ln1(x), cache=cache)
+            x = x + self.dropout1(h)
+            x = x + self.dropout2(self.mlp(self.ln2(x)))
+            return x, new_cache
+        if self.use_recompute and self.training:
+            from ..distributed.fleet.utils import recompute
+            return recompute(self._body, x)
+        return self._body(x)
+
+
+class GPTEmbeddings(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        init = Normal(0.0, config.initializer_range)
+        if _mp_active():
+            from ..distributed import fleet
+            self.word_embeddings = fleet.VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size,
+                weight_attr=nn.ParamAttr(initializer=init))
+        else:
+            self.word_embeddings = nn.Embedding(
+                config.vocab_size, config.hidden_size,
+                weight_attr=nn.ParamAttr(initializer=init))
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size,
+            weight_attr=nn.ParamAttr(initializer=init))
+        self.dropout = nn.Dropout(config.hidden_dropout_prob,
+                                  mode="upscale_in_train")
+
+    def forward(self, input_ids, position_ids=None, offset=0):
+        from ..ops import creation
+        l = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = creation.arange(offset, offset + l,
+                                           dtype="int64")
+        x = self.word_embeddings(input_ids) + \
+            self.position_embeddings(position_ids)
+        return self.dropout(x)
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.layers = nn.LayerList(
+            [GPTDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None, caches=None):
+        offset = caches[0][0].shape[1] if caches else 0
+        x = self.embeddings(input_ids, position_ids, offset=offset)
+        new_caches = [] if caches is not None else None
+        for i, layer in enumerate(self.layers):
+            if caches is not None:
+                x, c = layer(x, cache=caches[i])
+                new_caches.append(c)
+            else:
+                x = layer(x)
+        x = self.ln_f(x)
+        if caches is not None:
+            return x, new_caches
+        return x
+
+
+class GPTForCausalLM(nn.Layer):
+    """LM head ties the embedding weight (logits = h @ E^T)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(config)
+        self.config = config
+
+    def forward(self, input_ids, position_ids=None, labels=None,
+                caches=None):
+        from ..ops import linalg
+        if caches is not None:
+            h, new_caches = self.gpt(input_ids, position_ids,
+                                     caches=caches)
+        else:
+            h = self.gpt(input_ids, position_ids)
+        w = self.gpt.embeddings.word_embeddings.weight
+        logits = linalg.matmul(h, w, transpose_y=True)
+        if labels is not None:
+            loss = F.cross_entropy(logits, labels)
+            return loss
+        if caches is not None:
+            return logits, new_caches
+        return logits
+
+    def init_caches(self, batch_size):
+        """Empty KV caches for incremental decoding."""
+        import jax.numpy as jnp
+        from ..core import dtype as dtypes
+        cfg = self.config
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        caches = []
+        for _ in range(cfg.num_hidden_layers):
+            k = Tensor(jnp.zeros((batch_size, 0, cfg.num_attention_heads,
+                                  hd),
+                                 dtypes.get_default_dtype().np_dtype))
+            caches.append((k, Tensor(k._value)))
+        return caches
+
+    def generate(self, input_ids, max_new_tokens=16, temperature=1.0,
+                 top_k=None):
+        """Greedy/sampled decoding with KV cache."""
+        from ..ops import manipulation, creation
+        import jax
+        from ..core import random as random_mod
+        self.eval()
+        logits, caches = self.forward(input_ids,
+                                      caches=self.init_caches(
+                                          input_ids.shape[0]))
+        out = input_ids
+        import jax.numpy as jnp
+        for _ in range(max_new_tokens):
+            last = Tensor(logits._value[:, -1, :])
+            if temperature != 1.0:
+                last = Tensor(last._value / temperature)
+            if top_k:
+                vals, _ = jax.lax.top_k(last._value, top_k)
+                thresh = vals[:, -1:]
+                last = Tensor(jnp.where(last._value < thresh, -1e30,
+                                        last._value))
+                key = random_mod.next_key()
+                nxt = jax.random.categorical(key, last._value, axis=-1)
+            else:
+                nxt = jnp.argmax(last._value, axis=-1)
+            nxt_t = Tensor(nxt[:, None])
+            out = manipulation.concat([out, nxt_t], axis=1)
+            logits, caches = self.forward(nxt_t, caches=caches)
+        return out

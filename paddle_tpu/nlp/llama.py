@@ -1,0 +1,220 @@
+"""Llama family (BASELINE config #5: sharding-stage3/GSPMD scale-out).
+
+RMSNorm + rotary embeddings + SwiGLU + GQA — exercises rms_norm, the
+flash/ring attention paths and sharded training. TP via Column/Row
+parallel projections when the "mp" axis is live.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..core.tensor import Tensor
+from ..core.dispatch import register_op
+from ..ops._helpers import apply_op, as_tensor
+from ..nn.initializer import Normal
+from .gpt import _make_linear, _mp_active, _sep_active
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM"]
+
+
+class LlamaConfig:
+    def __init__(self, vocab_size=32000, hidden_size=4096,
+                 num_hidden_layers=32, num_attention_heads=32,
+                 num_key_value_heads=None, intermediate_size=11008,
+                 max_position_embeddings=4096, rms_norm_eps=1e-6,
+                 rope_theta=10000.0, initializer_range=0.02,
+                 use_recompute=False, sequence_parallel=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_key_value_heads or \
+            num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.rms_norm_eps = rms_norm_eps
+        self.rope_theta = rope_theta
+        self.initializer_range = initializer_range
+        self.use_recompute = use_recompute
+        self.sequence_parallel = sequence_parallel
+        self.hidden_dropout_prob = 0.0
+
+
+def _rope_fwd(x, offset, theta):
+    """x: [B, L, H, D] -> rotary-embedded."""
+    b, l, h, d = x.shape
+    pos = jnp.arange(offset, offset + l, dtype=jnp.float32)
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = jnp.outer(pos, inv)                       # [L, D/2]
+    cos = jnp.cos(freqs)[None, :, None, :]
+    sin = jnp.sin(freqs)[None, :, None, :]
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    o1 = x1 * cos - x2 * sin
+    o2 = x2 * cos + x1 * sin
+    out = jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+register_op("rope", _rope_fwd)
+
+
+def apply_rotary(x, offset=0, theta=10000.0):
+    return apply_op("rope", as_tensor(x),
+                    attrs=dict(offset=int(offset), theta=float(theta)))
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.n_heads = cfg.num_attention_heads
+        self.n_kv = cfg.num_key_value_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.theta = cfg.rope_theta
+        h = cfg.hidden_size
+        self.q_proj = _make_linear(h, self.n_heads * self.head_dim, cfg,
+                                   parallel="column")
+        self.k_proj = _make_linear(h, self.n_kv * self.head_dim, cfg,
+                                   parallel="column")
+        self.v_proj = _make_linear(h, self.n_kv * self.head_dim, cfg,
+                                   parallel="column")
+        self.o_proj = _make_linear(self.n_heads * self.head_dim, h, cfg,
+                                   parallel="row")
+
+    def forward(self, x, cache=None):
+        from ..ops import manipulation
+        b, l = x.shape[0], x.shape[1]
+        q = manipulation.reshape(self.q_proj(x),
+                                 [b, l, self.n_heads, self.head_dim])
+        k = manipulation.reshape(self.k_proj(x),
+                                 [b, l, self.n_kv, self.head_dim])
+        v = manipulation.reshape(self.v_proj(x),
+                                 [b, l, self.n_kv, self.head_dim])
+        offset = cache[0].shape[1] if cache is not None else 0
+        q = apply_rotary(q, offset, self.theta)
+        k = apply_rotary(k, offset, self.theta)
+        if cache is not None:
+            k = manipulation.concat([cache[0], k], axis=1)
+            v = manipulation.concat([cache[1], v], axis=1)
+            new_cache = (k, v)
+        else:
+            new_cache = None
+        if self.n_kv != self.n_heads:
+            rep = self.n_heads // self.n_kv
+            k = manipulation.repeat_interleave(k, rep, axis=2)
+            v = manipulation.repeat_interleave(v, rep, axis=2)
+        if _sep_active() and cache is None:
+            from ..distributed import ring_attention
+            out = ring_attention(q, k, v, causal=True)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                                 training=self.training)
+        out = manipulation.reshape(out, [b, l,
+                                         self.n_heads * self.head_dim])
+        out = self.o_proj(out)
+        if new_cache is not None:
+            return out, new_cache
+        return out
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.gate_proj = _make_linear(cfg.hidden_size,
+                                      cfg.intermediate_size, cfg,
+                                      parallel="column")
+        self.up_proj = _make_linear(cfg.hidden_size,
+                                    cfg.intermediate_size, cfg,
+                                    parallel="column")
+        self.down_proj = _make_linear(cfg.intermediate_size,
+                                      cfg.hidden_size, cfg, parallel="row")
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                          epsilon=cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = nn.RMSNorm(
+            cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+        self.mlp = LlamaMLP(cfg)
+        self.use_recompute = cfg.use_recompute
+
+    def _body(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+    def forward(self, x, cache=None):
+        if cache is not None:
+            h, new_cache = self.self_attn(self.input_layernorm(x),
+                                          cache=cache)
+            x = x + h
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return x, new_cache
+        if self.use_recompute and self.training:
+            from ..distributed.fleet.utils import recompute
+            return recompute(self._body, x)
+        return self._body(x)
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.config = cfg
+        init = nn.ParamAttr(initializer=Normal(0.0, cfg.initializer_range))
+        if _mp_active():
+            from ..distributed import fleet
+            self.embed_tokens = fleet.VocabParallelEmbedding(
+                cfg.vocab_size, cfg.hidden_size, weight_attr=init)
+        else:
+            self.embed_tokens = nn.Embedding(cfg.vocab_size,
+                                             cfg.hidden_size,
+                                             weight_attr=init)
+        self.layers = nn.LayerList([LlamaDecoderLayer(cfg)
+                                    for _ in range(cfg.num_hidden_layers)])
+        self.norm = nn.RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+
+    def forward(self, input_ids, caches=None):
+        x = self.embed_tokens(input_ids)
+        new_caches = [] if caches is not None else None
+        for i, layer in enumerate(self.layers):
+            if caches is not None:
+                x, c = layer(x, cache=caches[i])
+                new_caches.append(c)
+            else:
+                x = layer(x)
+        x = self.norm(x)
+        if caches is not None:
+            return x, new_caches
+        return x
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.llama = LlamaModel(cfg)
+        self.lm_head = _make_linear(cfg.hidden_size, cfg.vocab_size, cfg,
+                                    parallel="column", gather_output=True)
+        self.config = cfg
+
+    def forward(self, input_ids, labels=None, caches=None):
+        if caches is not None:
+            h, new_caches = self.llama(input_ids, caches=caches)
+            return self.lm_head(h), new_caches
+        h = self.llama(input_ids)
+        logits = self.lm_head(h)
+        if labels is not None:
+            return F.cross_entropy(logits, labels)
+        return logits

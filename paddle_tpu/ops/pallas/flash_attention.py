@@ -30,9 +30,12 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     kj = pl.program_id(2)
     n_kv = pl.num_programs(2)
 
+    neg_inf = jnp.float32(_NEG_INF)
+    scale32 = jnp.float32(scale)
+
     @pl.when(kj == 0)
     def _init():
-        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        m_ref[:] = jnp.full_like(m_ref, neg_inf)
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
@@ -51,7 +54,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         v = v_ref[0]                       # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+            preferred_element_type=jnp.float32) * scale32  # [bq, bk]
 
         k_pos = kj * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
@@ -60,7 +63,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             valid = jnp.logical_and(valid, q_pos + offset >= k_pos)
-        s = jnp.where(valid, s, _NEG_INF)
+        s = jnp.where(valid, s, neg_inf)
 
         m_prev = m_ref[:, :1]              # [bq, 1]
         l_prev = l_ref[:, :1]
@@ -78,7 +81,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     @pl.when(kj == n_kv - 1)
     def _finalize():
         l = l_ref[:, :1]
-        o_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        o_ref[0] = (acc_ref[:] /
+                    jnp.maximum(l, jnp.float32(1e-30))).astype(o_ref.dtype)
 
 
 def _pad_to(x, axis, mult):
@@ -105,6 +109,15 @@ def _flash_fwd_bhld(q, k, v, causal, scale, block_q, block_k):
     kernel = functools.partial(
         _fa_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, q_len=lq, kv_len=lk)
+    # Mosaic rejects i64 index arithmetic; trace the kernel in 32-bit
+    # mode regardless of the global jax_enable_x64 (paddle int64 parity)
+    with jax.enable_x64(False):
+        return _call_kernel(kernel, qp, kp, vp, bh, n_q, n_k, block_q,
+                            block_k, d, q.dtype)[:, :lq]
+
+
+def _call_kernel(kernel, qp, kp, vp, bh, n_q, n_k, block_q, block_k, d,
+                 dtype):
     out = pl.pallas_call(
         kernel,
         grid=(bh, n_q, n_k),
@@ -114,7 +127,7 @@ def _flash_fwd_bhld(q, k, v, causal, scale, block_q, block_k):
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(qp.shape, dtype),
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -123,7 +136,7 @@ def _flash_fwd_bhld(q, k, v, causal, scale, block_q, block_k):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(qp, kp, vp)
-    return out[:, :lq]
+    return out
 
 
 def _ref_blhd(q, k, v, causal, scale):
