@@ -37,8 +37,9 @@ BLACK_LIST = {
     "layer_norm_noaffine", "rms_norm", "batch_norm_train",
     "batch_norm_infer", "batch_norm_train_noaffine",
     "batch_norm_infer_noaffine", "mse_loss", "l1_loss", "nll_loss",
-    "bce_loss", "bce_logits", "kl_div_loss", "cumsum", "sum", "mean",
-    "cosine_similarity_op", "p_normalize", "logsumexp",
+    "bce_loss", "bce_logits", "kl_div_loss", "cumsum",
+    "reduce_sum", "reduce_mean", "std", "var",
+    "cosine_similarity_op", "p_normalize", "logsumexp", "logcumsumexp",
 }
 
 
@@ -144,7 +145,13 @@ def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
             m.to(dtype=dtype)
     if optimizers is None:
         return models if single else model_list
-    return (models if single else model_list), optimizers
+    single_opt = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if single_opt else list(optimizers)
+    if level == "O2" and master_weight is not False:
+        for o in opt_list:
+            o._multi_precision = True
+    return ((models if single else model_list),
+            (optimizers if single_opt else opt_list))
 
 
 class GradScaler:

@@ -129,8 +129,13 @@ class GradNode:
                 f"Trying to backward through op '{self.name}' a second time "
                 "after its saved tensors were freed; pass retain_graph=True "
                 "to the first backward() if you need this.")
+        # Cast cotangents to the recorded output dtype: AMP boundary
+        # casts are not tape ops, so a consumer running in a different
+        # precision hands back a ct in ITS input dtype — the vjp demands
+        # the producer's output dtype.
         full_cts = tuple(
-            ct if ct is not None else jnp.zeros(shape, dt)
+            (ct.astype(dt) if ct.dtype != dt else ct)
+            if ct is not None else jnp.zeros(shape, dt)
             for ct, (shape, dt) in zip(cts, self.out_meta))
         if _mesh_hook is not None:
             n_in = len(self.saved_inputs)

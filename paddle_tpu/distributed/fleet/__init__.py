@@ -16,7 +16,8 @@ from .mp_layers import (  # noqa: F401
     ParallelCrossEntropy)
 from ..env import ParallelEnv
 
-__all__ = ["init", "DistributedStrategy", "HybridCommunicateGroup",
+__all__ = ["init", "shutdown", "DistributedStrategy",
+           "HybridCommunicateGroup",
            "CommunicateTopology", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",
            "worker_index", "worker_num", "is_first_worker",
@@ -114,6 +115,19 @@ class _Fleet:
         _place_model_on_mesh(model, self._hcg)
         return model
 
+    def shutdown(self):
+        """Tear down the hybrid topology: clears the active global mesh
+        and collective-init state so subsequently built models place on
+        the default device again. The reference's NCCL groups die with
+        the process; a single-controller mesh must be reset explicitly."""
+        from .. import collective as coll
+        from ..mesh import set_mesh
+        set_mesh(None)
+        coll.destroy_process_group()  # clears group registry + init flag
+        self._hcg = None
+        self._strategy = None
+        self._initialized = False
+
     def distributed_optimizer(self, optimizer, strategy=None):
         """reference: fleet/fleet.py distributed_optimizer →
         HybridParallelOptimizer. Grad averaging across dp is implicit in
@@ -133,6 +147,10 @@ def init(role_maker=None, is_collective=True, strategy=None, **kw):
 
 def get_hybrid_communicate_group():
     return fleet.get_hybrid_communicate_group()
+
+
+def shutdown():
+    return fleet.shutdown()
 
 
 def distributed_model(model):

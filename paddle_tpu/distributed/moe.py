@@ -86,9 +86,12 @@ def _moe_dispatch_fwd(x, logits, n_expert, topk, capacity):
 
 
 def _gshard_aux(probs, onehot):
-    # load-balance loss: E * sum_e (mean_prob_e * mean_assign_e)
-    me = jnp.mean(probs, axis=0)
-    ce = jnp.mean(jnp.sum(onehot[:, 0], axis=0) / probs.shape[0], axis=0)
+    # load-balance loss: E * sum_e (mean_prob_e * frac_top1_assigned_e).
+    # ce stays the [E] vector of per-expert top-1 assignment fractions —
+    # averaging it over experts would collapse to the constant 1/E and
+    # zero the gradient.
+    me = jnp.mean(probs, axis=0)                       # [E]
+    ce = jnp.sum(onehot[:, 0], axis=0) / probs.shape[0]  # [E]
     return probs.shape[-1] * jnp.sum(me * ce)
 
 

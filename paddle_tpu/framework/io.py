@@ -1,11 +1,14 @@
 """Object save/load.
 
 TPU-native replacement for paddle.save/load (reference:
-python/paddle/framework/io.py:639 save, :881 load). Same pickle-compatible
-semantics: nested dicts/lists of tensors round-trip; Tensors serialize as
-numpy arrays + metadata, so checkpoints are portable across hosts and
-mesh shapes (sharded jax.Arrays gather to host first — the replacement
-for per-tensor protobuf _save_lod_tensor).
+python/paddle/framework/io.py:639 save, :881 load). On-disk format is
+interchangeable with the reference: a saved state_dict pickles to a dict
+of plain ``numpy.ndarray`` values keyed by structured name, plus a
+``StructuredToParameterName@@`` table mapping structured names to
+parameter names (reference _build_saved_state_dict). Sharded jax.Arrays
+gather to host first — the replacement for per-tensor protobuf
+_save_lod_tensor — so checkpoints are portable across hosts and mesh
+shapes.
 """
 from __future__ import annotations
 
@@ -16,9 +19,11 @@ import numpy as np
 
 from ..core.tensor import Tensor, Parameter
 
+_NAME_TABLE_KEY = "StructuredToParameterName@@"
+
 
 class _TensorPayload:
-    """Pickle surrogate for a Tensor."""
+    """Legacy pickle surrogate (round-1 checkpoints); still loadable."""
 
     def __init__(self, array, name, is_parameter, stop_gradient):
         self.array = array
@@ -29,8 +34,7 @@ class _TensorPayload:
 
 def _pack(obj):
     if isinstance(obj, Tensor):
-        return _TensorPayload(np.asarray(obj._value), obj.name,
-                              isinstance(obj, Parameter), obj.stop_gradient)
+        return np.asarray(obj._value)
     if isinstance(obj, dict):
         return {k: _pack(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
@@ -44,14 +48,17 @@ def _pack(obj):
 def _unpack(obj, return_numpy=False):
     if isinstance(obj, _TensorPayload):
         if return_numpy:
-            return obj.array
+            return np.asarray(obj.array)
         import jax.numpy as jnp
         if obj.is_parameter:
-            t = Parameter(jnp.asarray(obj.array), name=obj.name)
-        else:
-            t = Tensor(jnp.asarray(obj.array), name=obj.name,
-                       stop_gradient=obj.stop_gradient)
-        return t
+            return Parameter(jnp.asarray(obj.array), name=obj.name)
+        return Tensor(jnp.asarray(obj.array), name=obj.name,
+                      stop_gradient=obj.stop_gradient)
+    if isinstance(obj, np.ndarray):
+        if return_numpy:
+            return obj
+        import jax.numpy as jnp
+        return Tensor(jnp.asarray(obj))
     if isinstance(obj, dict):
         return {k: _unpack(v, return_numpy) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
@@ -62,18 +69,53 @@ def _unpack(obj, return_numpy=False):
     return obj
 
 
+def _is_state_dict(obj):
+    return (isinstance(obj, dict) and obj
+            and all(isinstance(v, (Tensor, np.ndarray))
+                    for v in obj.values()))
+
+
 def save(obj, path, protocol=4, **configs):
     """paddle.save parity; path conventions match (*.pdparams etc.)."""
     path = str(path)
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
+    if _is_state_dict(obj):
+        saved, name_table = {}, {}
+        for k, v in obj.items():
+            if isinstance(v, Parameter):
+                name_table[k] = v.name
+            saved[k] = np.asarray(v._value) if isinstance(v, Tensor) \
+                else np.asarray(v)
+        if name_table:
+            saved[_NAME_TABLE_KEY] = name_table
+        payload = saved
+    else:
+        payload = _pack(obj)
     with open(path, "wb") as f:
-        pickle.dump(_pack(obj), f, protocol=protocol)
+        pickle.dump(payload, f, protocol=protocol)
 
 
 def load(path, **configs):
-    """paddle.load parity. `return_numpy=True` gives numpy arrays."""
+    """paddle.load parity. `return_numpy=True` gives numpy arrays.
+    Accepts this framework's checkpoints and reference-produced
+    .pdparams/.pdopt pickles (dict-of-ndarray + name table)."""
     with open(str(path), "rb") as f:
         data = pickle.load(f)
-    return _unpack(data, return_numpy=configs.get("return_numpy", False))
+    return_numpy = configs.get("return_numpy", False)
+    if isinstance(data, dict) and _NAME_TABLE_KEY in data:
+        name_table = data.pop(_NAME_TABLE_KEY)
+        if return_numpy:
+            return {k: np.asarray(v) for k, v in data.items()}
+        import jax.numpy as jnp
+        out = {}
+        for k, v in data.items():
+            arr = np.asarray(v.array) if isinstance(v, _TensorPayload) \
+                else np.asarray(v)
+            if k in name_table:
+                out[k] = Parameter(jnp.asarray(arr), name=name_table[k])
+            else:
+                out[k] = Tensor(jnp.asarray(arr))
+        return out
+    return _unpack(data, return_numpy=return_numpy)

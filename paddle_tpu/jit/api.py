@@ -244,6 +244,13 @@ class StaticFunction:
         cache_key = (_static_key(arg_spec), _static_key(kw_spec),
                      len(params), len(buffers))
         entry = self._cache.get(cache_key)
+        # `pure` closes over the state tensor OBJECTS; if a parameter was
+        # replaced since the entry was built (same count/shape, new
+        # object), a retrace would bind tracers onto the stale objects
+        # and bake the live weights in as constants — rebuild instead.
+        state_ids = tuple(id(t) for t in state_tensors)
+        if entry is not None and entry.get("state_ids") != state_ids:
+            entry = None
         if entry is None:
             pure = self._build_pure(arg_spec, kw_spec, len(params),
                                     len(buffers), state_tensors)
@@ -257,7 +264,8 @@ class StaticFunction:
 
             entry = {"opdef": OpDef(f"to_static::{self._fn.__qualname__}",
                                     fwd),
-                     "pure": pure, "n_state": n_state}
+                     "pure": pure, "n_state": n_state,
+                     "state_ids": state_ids}
             self._cache[cache_key] = entry
         key_t = Tensor(random_mod.default_generator.next_key())
         all_inputs = [key_t] + state_tensors + arg_tensors

@@ -50,7 +50,7 @@ class CompiledTrainStep:
         decay = optimizer._decay if not getattr(optimizer, "_decoupled",
                                                 False) else 0.0
         extras = optimizer._per_param_extra(self.params)
-        rule = optimizer._rule
+        rule = optimizer._apply_rule
         advance = optimizer._advance_global
         n_p = self.n_params
         n_b = len(self.buffers)
@@ -102,10 +102,69 @@ class CompiledTrainStep:
 
         donate_args = (0, 1, 2, 3) if donate else ()
         self._step = jax.jit(step, donate_argnums=donate_args)
+        self._target_mesh = self._harmonize_placements()
+
+    def _harmonize_placements(self):
+        """Co-locate params/buffers/optimizer state on one device set.
+
+        One jitted program cannot consume arrays committed to different
+        device sets (a model built while a mesh was active mixes 8-device
+        and 1-device arrays the moment the mesh context ends). Target:
+        the active mesh if set, else the mesh the parameters already live
+        on, else the default device. Values already holding a
+        NamedSharding on the target mesh keep their layout (TP shards
+        survive); stragglers are replicated onto it."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        from ..distributed.mesh import get_mesh
+        pm = get_mesh()
+        target = pm.jax_mesh if pm is not None else None
+        if target is None:
+            for t in self.state_tensors:
+                sh = getattr(t._value, "sharding", None)
+                if isinstance(sh, NamedSharding) and sh.mesh.size > 1:
+                    target = sh.mesh
+                    break
+        if target is None:
+            dev = jax.devices()[0]
+
+            def place(v):
+                devs = getattr(getattr(v, "sharding", None),
+                               "device_set", None)
+                if devs is not None and devs != {dev}:
+                    return jax.device_put(v, dev)
+                return v
+        else:
+            rep = NamedSharding(target, PartitionSpec())
+
+            def place(v):
+                sh = getattr(v, "sharding", None)
+                if isinstance(sh, NamedSharding) and sh.mesh == target:
+                    return v
+                return jax.device_put(v, rep)
+
+        for t in self.state_tensors:
+            t._rebind(place(t._value))
+        self.states = [{k: place(v) for k, v in s.items()}
+                       for s in self.states]
+        self.gstate = {k: place(v) for k, v in self.gstate.items()}
+        return target
+
+    def _place_batch(self, v):
+        """Batch values must join the step's device set too; anything the
+        caller didn't shard (via dist.shard_batch) gets replicated."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        target = self._target_mesh
+        if target is None:
+            return v
+        sh = getattr(v, "sharding", None)
+        if isinstance(sh, NamedSharding) and sh.mesh == target:
+            return v
+        return jax.device_put(v, NamedSharding(target, PartitionSpec()))
 
     def __call__(self, *batch):
-        batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
-                      for b in batch]
+        batch_vals = [self._place_batch(
+            b._value if isinstance(b, Tensor) else jnp.asarray(b))
+            for b in batch]
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = random_mod.next_key()
         p_vals = [p._value for p in self.params]

@@ -26,6 +26,7 @@ class Adam(Optimizer):
         self._beta1 = float(beta1)
         self._beta2 = float(beta2)
         self._epsilon = float(epsilon)
+        self._multi_precision = bool(multi_precision)
 
     def _accumulator_specs(self, p):
         return {"moment1": jnp.zeros_like(p._value),

@@ -73,6 +73,11 @@ class Optimizer:
         self._accumulators: dict = OrderedDict()
         self._fused_update = None
         self._sig = None
+        # multi_precision: keep an fp32 master copy of half-precision
+        # params in the accumulators (reference: the multi_precision
+        # master-weight path in phi adam/momentum kernels). Enabled by
+        # optimizer kwarg or amp.decorate(level="O2").
+        self._multi_precision = False
 
     # -- lr ------------------------------------------------------------------
     def get_lr(self):
@@ -100,10 +105,26 @@ class Optimizer:
     def _state_for(self, p):
         key = id(p)
         if key not in self._accumulators:
-            self._accumulators[key] = {
-                name: jnp.asarray(arr)
-                for name, arr in self._accumulator_specs(p).items()}
+            st = {name: jnp.asarray(arr)
+                  for name, arr in self._accumulator_specs(p).items()}
+            if self._multi_precision and p._value.dtype in (
+                    jnp.float16, jnp.bfloat16):
+                st["master_weight"] = p._value.astype(jnp.float32)
+            self._accumulators[key] = st
         return self._accumulators[key]
+
+    def _apply_rule(self, p, g, s, gstate, lr):
+        """Run the update rule, routing through the fp32 master weight
+        when one exists: the master accumulates sub-ulp updates the
+        half-precision param would silently drop."""
+        mw = s.get("master_weight") if isinstance(s, dict) else None
+        if mw is None:
+            return self._rule(p, g, s, gstate, lr)
+        s2 = {k: v for k, v in s.items() if k != "master_weight"}
+        new_mw, ns = self._rule(mw, g, s2, gstate, lr)
+        ns = dict(ns)
+        ns["master_weight"] = new_mw
+        return new_mw.astype(p.dtype), ns
 
     # -- the fused update ---------------------------------------------------
     def _active_params(self):
@@ -123,7 +144,7 @@ class Optimizer:
         return None
 
     def _build_fused(self, n_params):
-        rule = self._rule
+        rule = self._apply_rule
         extras = self._per_param_extra(self._active_params())
 
         def fused(params, grads, states, gstate, lr):
@@ -199,7 +220,10 @@ class Optimizer:
         for p in self._parameter_list:
             if id(p) in self._accumulators:
                 for name, v in self._accumulators[id(p)].items():
-                    sd[f"{p.name}_{name}"] = Tensor(jnp.array(v, copy=True))
+                    # reference accumulator var naming: param_acc_0
+                    # (python/paddle/optimizer/optimizer.py:714)
+                    sd[f"{p.name}_{name}_0"] = Tensor(jnp.array(v,
+                                                                copy=True))
         if hasattr(self, "_gstate"):
             for k, v in self._gstate.items():
                 sd[f"global_{k}"] = Tensor(jnp.array(v, copy=True))
@@ -212,12 +236,18 @@ class Optimizer:
             specs = self._accumulator_specs(p) if isinstance(p, Parameter) \
                 else {}
             st = {}
-            for name in specs:
-                key = f"{p.name}_{name}"
-                if key in state_dict:
-                    v = state_dict[key]
-                    st[name] = v._value if isinstance(v, Tensor) \
-                        else jnp.asarray(v)
+            # master_weight rides in the accumulators but is not part of
+            # _accumulator_specs — restore it too or resume loses the
+            # fp32 sub-ulp accumulation it exists for
+            for name in list(specs) + ["master_weight"]:
+                # accept both the reference key (param_acc_0) and the
+                # round-1 key (param_acc)
+                for key in (f"{p.name}_{name}_0", f"{p.name}_{name}"):
+                    if key in state_dict:
+                        v = state_dict[key]
+                        st[name] = v._value if isinstance(v, Tensor) \
+                            else jnp.asarray(v)
+                        break
             if st:
                 full = self._state_for(p)
                 full.update(st)

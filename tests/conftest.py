@@ -18,3 +18,14 @@ if "host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_parallel_state():
+    """Tests that init fleet/meshes must not leak the thread-local mesh
+    into later tests (models built under a stale mesh mix device sets)."""
+    yield
+    from paddle_tpu.distributed import fleet
+    fleet.shutdown()

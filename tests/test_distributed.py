@@ -12,8 +12,10 @@ import paddle_tpu.distributed as dist
 from paddle_tpu.distributed import fleet
 
 
-@pytest.fixture(scope="module")
+@pytest.fixture()
 def hcg():
+    # function-scoped: conftest's autouse reset tears fleet down after
+    # every test, so each test re-inits (cheap — no process groups).
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
                                "pp_degree": 1, "sharding_degree": 1,
@@ -235,3 +237,67 @@ class TestRNGTracker:
         with tr.rng_state("b"):
             x2 = paddle.rand([4])
         assert not np.allclose(x1.numpy(), x2.numpy())
+
+
+class TestMeshLifecycle:
+    def test_fleet_shutdown_resets_mesh(self):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                                   "pp_degree": 1, "sharding_degree": 1,
+                                   "sep_degree": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        assert dist.get_mesh() is not None
+        fleet.shutdown()
+        assert dist.get_mesh() is None
+
+    def test_train_after_fleet_session(self):
+        # the round-1 suite-order failure: a model trained after an
+        # earlier fleet session must not see mixed device placements
+        from paddle_tpu import jit as pjit
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1,
+                                   "pp_degree": 1, "sharding_degree": 1,
+                                   "sep_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        m_dist = nn.Linear(4, 4)
+        fleet.distributed_model(m_dist)  # placed on the 8-dev mesh
+        fleet.shutdown()
+        model = nn.Linear(4, 4)
+        o = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+        step = pjit.compile_train_step(
+            lambda x, y: ((model(x) - y) ** 2).mean(), model, o)
+        x = paddle.to_tensor(_randn(2, 4))
+        y = paddle.to_tensor(_randn(2, 4))
+        loss = step(x, y)
+        assert np.isfinite(float(loss))
+
+    def test_trainer_harmonizes_stale_mesh_params(self, hcg):
+        # model built under an active mesh, trained while mesh active,
+        # with a straggler param created... (placement mix): params were
+        # placed by distributed_model; a later-added param lives on one
+        # device until CompiledTrainStep harmonizes it.
+        from paddle_tpu import jit as pjit
+        model = nn.Linear(4, 4)
+        fleet.distributed_model(model)
+        # new param created fresh (single-device committed)
+        import paddle_tpu
+        model.extra = paddle_tpu.core.tensor.Parameter(
+            __import__("jax.numpy", fromlist=["x"]).zeros((4,)))
+        o = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+        step = pjit.compile_train_step(
+            lambda x, y: ((model(x) + model.extra - y) ** 2).mean(),
+            model, o)
+        x = paddle.to_tensor(_randn(2, 4))
+        y = paddle.to_tensor(_randn(2, 4))
+        assert np.isfinite(float(step(x, y)))
+
+    def test_gshard_aux_loss_has_gradient(self, hcg):
+        moe = dist.MoELayer(8, experts=[nn.Linear(8, 8) for _ in range(4)],
+                            gate={"type": "gshard", "top_k": 2})
+        x = paddle.to_tensor(_randn(2, 8, 8), stop_gradient=False)
+        moe(x)
+        aux = moe.aux_loss
+        aux.backward()
+        g = moe.gate.gate.weight.grad
+        assert g is not None
+        assert float(np.abs(g.numpy()).max()) > 0.0

@@ -214,3 +214,114 @@ class TestAutogradExtras:
         loss = (x * 3.0).sum()
         autograd.backward([loss])
         np.testing.assert_allclose(x.grad.numpy(), [3.0] * 3)
+
+
+class TestRound1ReviewFixes:
+    def test_o2_master_weights_accumulate_tiny_updates(self):
+        # A bf16 param can't represent updates below one ulp; the fp32
+        # master weight must accumulate them across steps.
+        lin = nn.Linear(4, 4)
+        o = opt.SGD(learning_rate=1e-4, parameters=lin.parameters())
+        paddle.amp.decorate(models=lin, optimizers=o, level="O2",
+                            dtype="bfloat16")
+        w0 = lin.weight.numpy().astype(np.float32).copy()
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        for _ in range(64):
+            y = lin(x)
+            loss = y.sum()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        import jax.numpy as jnp
+        st = o._accumulators[id(lin.weight)]
+        assert "master_weight" in st
+        assert st["master_weight"].dtype == jnp.float32
+        # master moved even though each single step is sub-ulp in bf16
+        delta = np.abs(np.asarray(st["master_weight"]) - w0).max()
+        assert delta > 1e-4
+
+    def test_save_format_reference_compatible(self, tmp_path):
+        import pickle
+        lin = nn.Linear(3, 2)
+        p = str(tmp_path / "m.pdparams")
+        paddle.save(lin.state_dict(), p)
+        with open(p, "rb") as f:
+            raw = pickle.load(f)
+        # plain dict of ndarrays + the reference name table
+        assert "StructuredToParameterName@@" in raw
+        for k, v in raw.items():
+            if k == "StructuredToParameterName@@":
+                assert isinstance(v, dict)
+            else:
+                assert isinstance(v, np.ndarray)
+        # and loads back into parameters with original names
+        sd = paddle.load(p)
+        lin2 = nn.Linear(3, 2)
+        lin2.set_state_dict(sd)
+        np.testing.assert_allclose(lin2.weight.numpy(), lin.weight.numpy())
+
+    def test_load_reference_produced_pickle(self, tmp_path):
+        # simulate a checkpoint written by the reference: dict of plain
+        # ndarrays + StructuredToParameterName@@
+        import pickle
+        p = str(tmp_path / "ref.pdparams")
+        w = np.random.RandomState(0).randn(3, 2).astype("float32")
+        b = np.zeros(2, "float32")
+        with open(p, "wb") as f:
+            pickle.dump({"weight": w, "bias": b,
+                         "StructuredToParameterName@@":
+                         {"weight": "linear_0.w_0", "bias": "linear_0.b_0"}},
+                        f, protocol=2)
+        sd = paddle.load(p)
+        np.testing.assert_allclose(sd["weight"].numpy(), w)
+        assert sd["weight"].name == "linear_0.w_0"
+
+    def test_optimizer_state_keys_reference_format(self):
+        lin = nn.Linear(3, 2)
+        o = opt.Adam(parameters=lin.parameters())
+        y = lin(paddle.to_tensor(np.ones((1, 3), np.float32)))
+        y.sum().backward()
+        o.step()
+        sd = o.state_dict()
+        # reference accumulator naming: {param_name}_{acc}_0
+        assert any(k.endswith("_moment1_0") for k in sd)
+        o2 = opt.Adam(parameters=lin.parameters())
+        o2.set_state_dict(sd)
+        st = o2._accumulators[id(lin.weight)]
+        np.testing.assert_allclose(
+            np.asarray(st["moment1"]),
+            np.asarray(o._accumulators[id(lin.weight)]["moment1"]))
+
+    def test_to_static_retrace_after_param_swap(self):
+        from paddle_tpu.core.tensor import Parameter
+        import jax.numpy as jnp
+        lin = nn.Linear(2, 2)
+
+        @paddle.jit.to_static
+        def f(x):
+            return lin(x)
+
+        x32 = paddle.to_tensor(np.ones((1, 2), np.float32))
+        _ = f(x32)
+        # replace the weight with a same-shape new Parameter; the cached
+        # trace must NOT freeze the old weights in as constants
+        new_w = Parameter(jnp.full((2, 2), 5.0, jnp.float32))
+        lin.weight = new_w
+        out = f(x32)
+        expect = np.ones((1, 2)) @ np.full((2, 2), 5.0) + lin.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), expect, rtol=1e-6)
+
+    def test_o2_backward_through_mixed_precision_boundary(self):
+        # chain bf16 -> f32(black-listed op) -> reduce: the cotangent
+        # crossing the precision boundary must be cast to the producer's
+        # output dtype, not rejected by the vjp
+        lin = nn.Linear(8, 1)
+        x = paddle.to_tensor(np.ones((4, 8), "float32"))
+        y = paddle.to_tensor(np.ones((4, 1), "float32"))
+        with amp.auto_cast(level="O2", dtype="bfloat16"):
+            pred = lin(x)
+            loss = ((pred - y) ** 2).mean()
+        loss.backward()
+        assert lin.weight.grad is not None
+        assert np.all(np.isfinite(
+            lin.weight.grad.numpy().astype(np.float32)))
