@@ -1,13 +1,18 @@
-"""Flash attention (Pallas, TPU).
+"""Flash attention (Pallas, TPU) — fused forward AND backward.
 
 TPU-native replacement for the reference's fused FMHA CUDA
-(paddle/fluid/operators/fused/fused_attention_op.cu, fmha_ref.h). Online
-softmax over K/V blocks: running (m, l, acc) scratch in VMEM, one MXU
-dot per (q-block, k-block) pair, no [L, L] logits materialized in HBM.
+(paddle/fluid/operators/fused/fused_attention_op.cu, fmha_ref.h — whose
+grad kernel is fused too). Online softmax over K/V blocks: running
+(m, l, acc) scratch in VMEM, one MXU dot per (q-block, k-block) pair, no
+[L, L] logits materialized in HBM.
 
-Forward runs the kernel; backward recomputes attention with the plain-XLA
-reference math via jax.custom_vjp (the standard TPU remat trade — see
-SURVEY.md §7 "fused_attention → Pallas flash-attention custom-calls").
+Forward stores per-row logsumexp; backward is two Pallas kernels
+(structure mirrors jax.experimental.pallas.ops.tpu.flash_attention
+without importing it):
+  dq : grid (BH, nQ, nK), accumulates ds @ K over k-blocks in VMEM
+  dkv: grid (BH, nK, nQ), accumulates p^T @ dO and ds^T @ Q over q-blocks
+Both recompute p = exp(s - lse) from q/k (flash recompute trade), so
+nothing O(L^2) ever hits HBM.
 """
 from __future__ import annotations
 
@@ -19,13 +24,35 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+import os
+
+# interpret mode: run kernels on CPU for testing (conftest sets this)
+_INTERPRET = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "0") == "1"
+
+def _prec(dt):
+    # 'highest' (the package-wide default) is invalid for bf16 operands
+    # under Mosaic; bf16 x bf16 -> f32 on the MXU is exact at DEFAULT.
+    return (jax.lax.Precision.DEFAULT if jnp.dtype(dt) == jnp.bfloat16
+            else jax.lax.Precision.HIGHEST)
+
+
+# Large blocks amortize per-grid-step overhead (the kernel is VPU-bound
+# on softmax bookkeeping; profiled on v5e: 128->512 blocks cut the GPT
+# step's attention time 4x). Shrunk automatically for short sequences.
+DEFAULT_BLOCK_Q = int(os.environ.get("PADDLE_TPU_FA_BLOCK_Q", "512"))
+DEFAULT_BLOCK_K = int(os.environ.get("PADDLE_TPU_FA_BLOCK_K", "1024"))
+
+
+def _fit_block(block, length):
+    """Cap the block at the 128-padded sequence length."""
+    return max(128, min(block, -(-length // 128) * 128))
 _NEG_INF = -1e30
+_LANES = 128
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-               scale, causal, block_q, block_k, q_len, kv_len):
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+               *, scale, causal, block_q, block_k, q_len, kv_len):
+    prec = _prec(q_ref.dtype)
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     n_kv = pl.num_programs(2)
@@ -47,24 +74,24 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     if causal:
         run = kj * block_k <= qi * block_q + block_q - 1 + offset
 
-    @pl.when(run)
-    def _compute():
-        q = q_ref[0]                       # [bq, d]
-        k = k_ref[0]                       # [bk, d]
-        v = v_ref[0]                       # [bk, d]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale32  # [bq, bk]
+    # Mask generation (two iotas + compares + where) is pure VPU cost;
+    # with d=64 the MXU work per block pair is tiny, so interior blocks
+    # take a mask-free fast path and only diagonal/ragged-edge blocks
+    # pay for the mask.
+    ragged = (kv_len % block_k) != 0
+    edge = (kj == pl.num_programs(2) - 1) if ragged else False
+    if causal:
+        full = kj * block_k + block_k - 1 <= qi * block_q + offset
+        need_mask = jnp.logical_and(
+            run, jnp.logical_or(jnp.logical_not(full), edge)) \
+            if ragged else jnp.logical_and(run, jnp.logical_not(full))
+        no_mask = jnp.logical_and(run, jnp.logical_and(
+            full, jnp.logical_not(edge)) if ragged else full)
+    else:
+        need_mask = edge
+        no_mask = jnp.logical_not(edge) if ragged else True
 
-        k_pos = kj * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        valid = k_pos < kv_len
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            valid = jnp.logical_and(valid, q_pos + offset >= k_pos)
-        s = jnp.where(valid, s, neg_inf)
-
+    def _accum(s):
         m_prev = m_ref[:, :1]              # [bq, 1]
         l_prev = l_ref[:, :1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -72,17 +99,198 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0]
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32,
+            precision=prec)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
+    def _logits():
+        return jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec) * scale32      # [bq, bk]
+
+    @pl.when(no_mask)
+    def _compute_fast():
+        _accum(_logits())
+
+    @pl.when(need_mask)
+    def _compute_masked():
+        s = _logits()
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_pos < kv_len
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = jnp.logical_and(valid, q_pos + offset >= k_pos)
+        _accum(jnp.where(valid, s, neg_inf))
+
     @pl.when(kj == n_kv - 1)
     def _finalize():
-        l = l_ref[:, :1]
-        o_ref[0] = (acc_ref[:] /
-                    jnp.maximum(l, jnp.float32(1e-30))).astype(o_ref.dtype)
+        l = jnp.maximum(l_ref[:, :1], jnp.float32(1e-30))
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+        lse = m_ref[:, :1] + jnp.log(l)
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref,
+                  acc_ref, *, scale, causal, block_q, block_k, q_len,
+                  kv_len):
+    prec = _prec(q_ref.dtype)
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+    scale32 = jnp.float32(scale)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    offset = kv_len - q_len
+    run = True
+    if causal:
+        run = kj * block_k <= qi * block_q + block_q - 1 + offset
+
+    ragged = (kv_len % block_k) != 0
+    edge = (kj == pl.num_programs(2) - 1) if ragged else False
+    if causal:
+        full = kj * block_k + block_k - 1 <= qi * block_q + offset
+        base = jnp.logical_or(jnp.logical_not(full), edge) if ragged \
+            else jnp.logical_not(full)
+        need_mask = jnp.logical_and(run, base)
+        no_mask = jnp.logical_and(run, jnp.logical_and(
+            full, jnp.logical_not(edge)) if ragged else full)
+    else:
+        need_mask = edge
+        no_mask = jnp.logical_not(edge) if ragged else True
+
+    def _accum(s):
+        k = k_ref[0]                       # [bk, d]
+        v = v_ref[0]                       # [bk, d]
+        do = do_ref[0]                     # [bq, d]
+        lse = lse_ref[:, :, :1][0]         # [bq, 1]
+        di = di_ref[:, :, :1][0]           # [bq, 1]
+        p = jnp.exp(s - lse)    # masked s = -1e30 underflows to p = 0
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec)                # [bq, bk]
+        ds = p * (dp - di) * scale32
+        acc_ref[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec)
+
+    def _logits():
+        return jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec) * scale32      # [bq, bk]
+
+    @pl.when(no_mask)
+    def _compute_fast():
+        _accum(_logits())
+
+    @pl.when(need_mask)
+    def _compute_masked():
+        s = _logits()
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_pos < kv_len
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = jnp.logical_and(valid, q_pos + offset >= k_pos)
+        _accum(jnp.where(valid, s, jnp.float32(_NEG_INF)))
+
+    @pl.when(kj == n_kv - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _fa_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, di_ref,
+                   dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                   block_q, block_k, q_len, kv_len):
+    prec = _prec(q_ref.dtype)
+    ki = pl.program_id(1)
+    qj = pl.program_id(2)
+    n_q = pl.num_programs(2)
+    scale32 = jnp.float32(scale)
+
+    @pl.when(qj == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    offset = kv_len - q_len
+    run = True
+    if causal:
+        run = ki * block_k <= qj * block_q + block_q - 1 + offset
+
+    ragged = (kv_len % block_k) != 0
+    edge = (ki == pl.num_programs(1) - 1) if ragged else False
+    if causal:
+        full = ki * block_k + block_k - 1 <= qj * block_q + offset
+        base = jnp.logical_or(jnp.logical_not(full), edge) if ragged \
+            else jnp.logical_not(full)
+        need_mask = jnp.logical_and(run, base)
+        no_mask = jnp.logical_and(run, jnp.logical_and(
+            full, jnp.logical_not(edge)) if ragged else full)
+    else:
+        need_mask = edge
+        no_mask = jnp.logical_not(edge) if ragged else True
+
+    def _accum(s):
+        v = v_ref[0]                       # [bk, d]
+        q = q_ref[0]                       # [bq, d]
+        do = do_ref[0]                     # [bq, d]
+        lse = lse_ref[:, :, :1][0]         # [bq, 1]
+        di = di_ref[:, :, :1][0]           # [bq, 1]
+        p = jnp.exp(s - lse)    # masked s = -1e30 underflows to p = 0
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec)                # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec)                # [bq, bk]
+        ds = p * (dp - di) * scale32
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec)                # [bk, d]
+
+    def _logits():
+        return jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec) * scale32      # [bq, bk]
+
+    @pl.when(no_mask)
+    def _compute_fast():
+        _accum(_logits())
+
+    @pl.when(need_mask)
+    def _compute_masked():
+        s = _logits()
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_pos < kv_len
+        if causal:
+            q_pos = qj * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            valid = jnp.logical_and(valid, q_pos + offset >= k_pos)
+        _accum(jnp.where(valid, s, jnp.float32(_NEG_INF)))
+
+    @pl.when(qj == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _pad_to(x, axis, mult):
@@ -96,10 +304,11 @@ def _pad_to(x, axis, mult):
 
 
 def _flash_fwd_bhld(q, k, v, causal, scale, block_q, block_k):
-    """q: [BH, Lq, D], k/v: [BH, Lk, D] -> [BH, Lq, D]."""
+    """q: [BH, Lq, D], k/v: [BH, Lk, D] -> ([BH, Lq, D], lse)."""
     bh, lq, d = q.shape
     lk = k.shape[1]
-    block_q = min(block_q, max(128, 1))
+    block_q = _fit_block(block_q, lq)
+    block_k = _fit_block(block_k, lk)
     qp = _pad_to(q, 1, block_q)
     kp = _pad_to(k, 1, block_k)
     vp = _pad_to(v, 1, block_k)
@@ -112,31 +321,127 @@ def _flash_fwd_bhld(q, k, v, causal, scale, block_q, block_k):
     # Mosaic rejects i64 index arithmetic; trace the kernel in 32-bit
     # mode regardless of the global jax_enable_x64 (paddle int64 parity)
     with jax.enable_x64(False):
-        return _call_kernel(kernel, qp, kp, vp, bh, n_q, n_k, block_q,
-                            block_k, d, q.dtype)[:, :lq]
+        out, lse = pl.pallas_call(
+            kernel,
+            grid=(bh, n_q, n_k),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_q, _LANES),
+                             lambda b, i, j: (b, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(qp.shape, q.dtype),
+                jax.ShapeDtypeStruct((bh, qp.shape[1], _LANES),
+                                     jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_q, d), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=_INTERPRET,
+        )(qp, kp, vp)
+    return out[:, :lq], lse
 
 
-def _call_kernel(kernel, qp, kp, vp, bh, n_q, n_k, block_q, block_k, d,
-                 dtype):
-    out = pl.pallas_call(
-        kernel,
-        grid=(bh, n_q, n_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(qp.shape, dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, d), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(qp, kp, vp)
-    return out
+def _flash_bwd_bhld(q, k, v, o, lse, do, causal, scale, block_q, block_k):
+    """All [BH, L, D] (lse [BH, Lqp, 128]) -> (dq, dk, dv)."""
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    block_q = _fit_block(block_q, lq)
+    block_k = _fit_block(block_k, lk)
+    qp = _pad_to(q, 1, block_q)
+    kp = _pad_to(k, 1, block_k)
+    vp = _pad_to(v, 1, block_k)
+    dop = _pad_to(do, 1, block_q)
+    lqp, lkp = qp.shape[1], kp.shape[1]
+    n_q, n_k = lqp // block_q, lkp // block_k
+    offset = lk - lq
+
+    di = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
+                 axis=-1)                                    # [bh, lq]
+    di = _pad_to(di, 1, block_q)
+    di = jnp.broadcast_to(di[..., None], (bh, lqp, _LANES))
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    lmspec = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
+
+    if causal:
+        def kv_idx(b, i, j):
+            # skipped kv blocks prefetch block 0 (they are predicated off)
+            ok = j * block_k <= i * block_q + block_q - 1 + offset
+            return (b, jax.lax.select(ok, j, 0), 0)
+    else:
+        def kv_idx(b, i, j):
+            return (b, j, 0)
+    kvspec = pl.BlockSpec((1, block_k, d), kv_idx)
+
+    dq_kernel = functools.partial(
+        _fa_dq_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, q_len=lq, kv_len=lk)
+    with jax.enable_x64(False):
+        dq = pl.pallas_call(
+            dq_kernel,
+            grid=(bh, n_q, n_k),
+            in_specs=[qspec, kvspec, kvspec, qspec, lmspec, lmspec],
+            out_specs=pl.BlockSpec((1, block_q, d),
+                                   lambda b, i, j: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=_INTERPRET,
+        )(qp, kp, vp, dop, lse, di)
+
+    # dkv grid: (bh, n_k, n_q) — q is the sequential (accumulated) axis
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
+    if causal:
+        def q_idx(b, i, j):
+            # q blocks strictly above the diagonal band are predicated
+            # off; prefetch the first contributing q block instead
+            ok = i * block_k <= j * block_q + block_q - 1 + offset
+            first = jnp.maximum((i * block_k - offset) // block_q, 0)
+            return (b, jax.lax.select(ok, j, first), 0)
+    else:
+        def q_idx(b, i, j):
+            return (b, j, 0)
+    qspec2 = pl.BlockSpec((1, block_q, d), q_idx)
+    lmspec2 = pl.BlockSpec((1, block_q, _LANES),
+                           lambda b, i, j: q_idx(b, i, j))
+
+    dkv_kernel = functools.partial(
+        _fa_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, q_len=lq, kv_len=lk)
+    with jax.enable_x64(False):
+        dk, dv = pl.pallas_call(
+            dkv_kernel,
+            grid=(bh, n_k, n_q),
+            in_specs=[kspec2, kspec2, qspec2, qspec2, lmspec2, lmspec2],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(kp.shape, k.dtype),
+                jax.ShapeDtypeStruct(vp.shape, v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=_INTERPRET,
+        )(kp, vp, qp, dop, lse, di)
+
+    return dq[:, :lq], dk[:, :lk], dv[:, :lk]
 
 
 def _ref_blhd(q, k, v, causal, scale):
@@ -147,6 +452,16 @@ def _ref_blhd(q, k, v, causal, scale):
         logits = jnp.where(cm, logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhlm,bmhd->blhd", probs, v)
+
+
+def _to_bhld(x):
+    b, l, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+
+
+def _from_bhld(x, b, h):
+    bh, l, d = x.shape
+    return x.reshape(b, h, l, d).transpose(0, 2, 1, 3)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -160,22 +475,23 @@ def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     b, lq, h, d = q.shape
-    lk = k.shape[1]
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
-    out = _flash_fwd_bhld(qt, kt, vt, causal, scale, block_q, block_k)
-    out = out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
-    return out, (q, k, v)
+    out, lse = _flash_fwd_bhld(_to_bhld(q), _to_bhld(k), _to_bhld(v),
+                               causal, scale, block_q, block_k)
+    out = _from_bhld(out, b, h)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v = res
+    q, k, v, o, lse = res
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    _, vjp = jax.vjp(lambda q, k, v: _ref_blhd(q, k, v, causal, scale),
-                     q, k, v)
-    return vjp(g)
+    b, lq, h, d = q.shape
+    dq, dk, dv = _flash_bwd_bhld(
+        _to_bhld(q), _to_bhld(k), _to_bhld(v), _to_bhld(o), lse,
+        _to_bhld(g), causal, scale, block_q, block_k)
+    return (_from_bhld(dq, b, h).astype(q.dtype),
+            _from_bhld(dk, b, h).astype(k.dtype),
+            _from_bhld(dv, b, h).astype(v.dtype))
 
 
 flash_attention_blhd.defvjp(_fa_fwd, _fa_bwd)
