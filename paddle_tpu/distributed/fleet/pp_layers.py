@@ -6,23 +6,45 @@ pp_layers.py:209 PipelineLayer, :57 LayerDesc, :93 SegmentLayers;
 schedules fleet/meta_parallel/pipeline_parallel.py:119 1F1B, :463
 interleaved). The reference runs one stage per process with
 partial_send/recv p2p and hand-scheduled 1F1B. Here all stages live in
-ONE compiled program: stage boundaries are sharding constraints over the
-"pp" mesh axis, and the microbatch loop is a lax.scan whose per-stage
-compute XLA schedules across pp devices (GPipe-style fill/drain inside
-one XLA program — collective-permute moves activations on ICI). This is
-the SURVEY.md §7 decision: "give up cross-executable 1F1B for a compiled
-collective_permute schedule".
+ONE compiled program:
+
+* The repeated (homogeneous) blocks' parameters are STACKED along a new
+  leading layer axis and that axis is sharded over the "pp" mesh axis —
+  each pp device group physically holds 1/num_stages of the block
+  parameters (the reference's per-process stage ownership, expressed as
+  GSPMD placement).
+* forward() runs the GPipe fill/drain schedule inside a shard_map over
+  "pp": at step t, stage s computes microbatch t-s and hands its
+  activation to stage s+1 with `lax.ppermute` (the ICI hop that replaces
+  the reference's partial_send/recv p2p). M + S - 1 steps total — the
+  standard GPipe bubble. The schedule lives under `lax.scan`, so its
+  reverse-mode transpose IS the backward pipeline schedule: jax.vjp
+  derives the reference's hand-written backward p2p loop automatically.
+* Per-microbatch activation memory is bounded with jax.checkpoint around
+  each block (the reference's recompute_interval knob).
+
+Heterogeneous extras (embedding before, head after the block run) execute
+outside the pipelined section. If the layer list has no stackable
+homogeneous run (or pp degree is 1), forward falls back to plain
+sequential execution — correct, just not pipelined.
 """
 from __future__ import annotations
 
+import functools
 import math
 import re
 
 import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
 
 from ...nn.layer.layers import Layer
 from ...nn.layer.container import LayerList, Sequential
-from ...core.tensor import Tensor
+from ...core.tensor import Tensor, Parameter, apply_op
+from ...core.dispatch import OpDef
+from ...core import random as random_mod
 
 __all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer",
            "SegmentLayers", "PipelineParallel"]
@@ -102,15 +124,25 @@ class SegmentLayers:
         return result
 
 
+def _param_signature(layer):
+    """(class-name, sorted (param-name, shape, dtype)) — stackability key."""
+    sig = tuple(sorted(
+        (n, tuple(p.shape), str(p.dtype))
+        for n, p in layer.named_parameters()))
+    return (type(layer).__name__, sig)
+
+
 class PipelineLayer(Layer):
     """reference: pp_layers.py:209. Builds ALL stages (single-controller
-    owns the whole mesh); stage index is carried per sublayer so the
-    runtime can insert pp-axis sharding constraints at boundaries."""
+    owns the whole mesh). The homogeneous block run is stacked along a
+    leading layer axis sharded over "pp" (stage-s parameters live on
+    stage-s devices), and forward runs the compiled GPipe microbatch
+    schedule — see module docstring."""
 
     def __init__(self, layers, num_stages=None, topology=None,
                  loss_fn=None, seg_method="uniform",
                  recompute_interval=0, recompute_ctx=None,
-                 num_virtual_pipeline_stages=None):
+                 num_virtual_pipeline_stages=None, num_microbatches=None):
         super().__init__()
         self._layers_desc = list(layers)
         if topology is not None:
@@ -119,70 +151,283 @@ class PipelineLayer(Layer):
             self._num_stages = num_stages or 1
         self._loss_fn = loss_fn
         self._recompute_interval = recompute_interval
+        self._n_micro = num_microbatches or max(self._num_stages, 1)
         seg = SegmentLayers(self._layers_desc, self._num_stages,
                             seg_method)
         self.segment_parts = seg.do_segment()
+
+        # Build every desc into a runnable (or callable) first.
+        objs, runs = [], []
+        self._shared = {}
+        for desc in self._layers_desc:
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name not in self._shared:
+                    self._shared[desc.layer_name] = desc.build_layer()
+                lyr = self._shared[desc.layer_name]
+                fwd = desc.forward_func
+                run = (lambda l=lyr, f=fwd:
+                       (lambda *x: f(l, *x) if f else l(*x)))()
+            elif isinstance(desc, LayerDesc):
+                lyr = desc.build_layer()
+                run = lyr
+            elif isinstance(desc, Layer):
+                lyr = desc
+                run = lyr
+            elif callable(desc):
+                lyr = None
+                run = desc
+            else:
+                raise TypeError(f"bad pipeline entry {desc!r}")
+            objs.append(lyr)
+            runs.append(run)
+
+        lo, hi = self._find_stackable_run(objs, runs)
+        self._pipelined = (self._num_stages > 1 and lo is not None)
+
+        built = LayerList()
         self.run_function = []
         self._stage_of = []
-        self._shared = {}
-        built = LayerList()
-        for stage in range(self._num_stages):
-            lo, hi = self.segment_parts[stage], self.segment_parts[stage + 1]
-            for i in range(lo, hi):
-                desc = self._layers_desc[i]
-                if isinstance(desc, SharedLayerDesc):
-                    if desc.layer_name not in self._shared:
-                        self._shared[desc.layer_name] = desc.build_layer()
-                    lyr = self._shared[desc.layer_name]
-                    fwd = desc.forward_func
-                    run = (lambda l=lyr, f=fwd:
-                           (lambda *x: f(l, *x) if f else l(*x)))()
-                elif isinstance(desc, LayerDesc):
-                    lyr = desc.build_layer()
-                    run = lyr
-                elif isinstance(desc, Layer):
-                    lyr = desc
-                    run = lyr
-                elif callable(desc):
-                    lyr = None
-                    run = desc
-                else:
-                    raise TypeError(f"bad pipeline entry {desc!r}")
+        stage_bound = self.segment_parts
+        if self._pipelined:
+            blocks = objs[lo:hi]
+            self._n_blocks = len(blocks)
+            self._pre_runs = runs[:lo]
+            self._post_runs = runs[hi:]
+            # template holds the param binding slots; NOT registered as a
+            # sublayer (its values are always rebound from the stack).
+            object.__setattr__(self, "_template_block", blocks[0])
+            object.__setattr__(
+                self, "_template_params",
+                [p for _, p in sorted(blocks[0].named_parameters())])
+            self._stack_block_params(blocks)
+            for r in self._pre_runs + self._post_runs:
+                if isinstance(r, Layer):
+                    built.append(r)
+            for lyr in self._shared.values():
+                if lyr not in list(built):
+                    built.append(lyr)
+        else:
+            for i, (lyr, run) in enumerate(zip(objs, runs)):
+                stage = next(s for s in range(self._num_stages)
+                             if stage_bound[s] <= i < stage_bound[s + 1])
                 if lyr is not None:
                     built.append(lyr)
                 self.run_function.append(run)
                 self._stage_of.append(stage)
         self._built = built
+        self._pipe_ops = {}
+
+    # -- stacking ---------------------------------------------------------
+
+    def _find_stackable_run(self, objs, runs):
+        """Longest contiguous run of same-class, same-param-shape Layers
+        (no buffers, not shared) that divides evenly by num_stages."""
+        best = (None, None)
+        best_len = 0
+        i = 0
+        n = len(objs)
+        while i < n:
+            if objs[i] is None or runs[i] is not objs[i] \
+                    or objs[i] in self._shared.values() \
+                    or list(objs[i].named_buffers()) \
+                    or not list(objs[i].named_parameters()):
+                i += 1
+                continue
+            sig = _param_signature(objs[i])
+            j = i + 1
+            while j < n and objs[j] is not None and runs[j] is objs[j] \
+                    and objs[j] not in self._shared.values() \
+                    and not list(objs[j].named_buffers()) \
+                    and _param_signature(objs[j]) == sig:
+                j += 1
+            run_len = j - i
+            if run_len > best_len and run_len >= self._num_stages \
+                    and run_len % self._num_stages == 0:
+                best, best_len = (i, j), run_len
+            i = j
+        return best
+
+    def _stack_block_params(self, blocks):
+        """Stack per-block params into [n_blocks, ...] Parameters, sharded
+        over the pp mesh axis when one is active (stage ownership)."""
+        from ..mesh import get_mesh, shard_tensor
+        pm = get_mesh()
+        pp_on = (pm is not None and "pp" in pm.dim_names
+                 and pm.get_dim_size("pp") > 1)
+        names = [n for n, _ in sorted(blocks[0].named_parameters())]
+        self._stack_names = names
+        self._stacked = []
+        for k, name in enumerate(names):
+            vals = [dict(b.named_parameters())[name]._value
+                    for b in blocks]
+            p0 = dict(blocks[0].named_parameters())[name]
+            arr = jnp.stack(vals)
+            sp = Parameter(arr, trainable=(
+                p0.trainable if isinstance(p0, Parameter)
+                else not p0.stop_gradient))
+            attr = "stacked_" + name.replace(".", "_")
+            self.add_parameter(attr, sp)
+            self._stacked.append(sp)
+            if pp_on:
+                shard_tensor(sp, pm, spec=P("pp"))
+
+    # -- schedule ---------------------------------------------------------
+
+    def _block_apply(self, h, plist, key):
+        """Run the template block with `plist` bound as its parameters.
+        Pure given (h, plist, key); usable under any jax trace."""
+        tpl_params = self._template_params
+        originals = [p._value for p in tpl_params]
+        random_mod.push_trace_key(key)
+        try:
+            for p, v in zip(tpl_params, plist):
+                p._value = v
+            out = self._template_block(Tensor(h))
+            hv = out._value if isinstance(out, Tensor) else out
+        finally:
+            random_mod.pop_trace_key()
+            for p, v in zip(tpl_params, originals):
+                p._value = v
+        return hv.astype(h.dtype)
+
+    def _stage_scan(self, h, pv_local, key, t, l_per):
+        """Apply this device's l_per consecutive blocks (a lax.scan)."""
+        remat = self._recompute_interval > 0
+
+        def one_layer(carry, xs):
+            li = xs[0]
+            plist = xs[1:]
+            k = jax.random.fold_in(jax.random.fold_in(key, t), li)
+            return self._block_apply(carry, plist, k), None
+
+        body = jax.checkpoint(one_layer) if remat else one_layer
+        xs = (jnp.arange(l_per),) + tuple(pv_local)
+        h, _ = jax.lax.scan(body, h, xs)
+        return h
+
+    def _get_pipe_op(self, pm, n_micro):
+        """OpDef running the GPipe schedule over `pm`'s pp axis."""
+        key_ = (id(pm.jax_mesh), n_micro)
+        op = self._pipe_ops.get(key_)
+        if op is not None:
+            return op
+        from ..mesh import manual_collective_mode
+        mesh = pm.jax_mesh
+        S = pm.get_dim_size("pp") if "pp" in pm.dim_names else 1
+        L = self._n_blocks
+        if S > 1 and L % S != 0:
+            raise ValueError(
+                f"{L} pipelined blocks not divisible by pp={S}")
+        l_per = L // max(S, 1)
+        dp_ax = "dp" if ("dp" in pm.dim_names
+                         and pm.get_dim_size("dp") > 1) else None
+        M = n_micro
+
+        def body(x_m, key, *pvals):
+            # x_m: [M, mb_local, ...]; pvals: [l_per, ...] local shards
+            stage = jax.lax.axis_index("pp") if S > 1 else 0
+            T = M + S - 1
+            state = jnp.zeros_like(x_m[0])
+            outs = jnp.zeros_like(x_m)
+            perm = [(i, (i + 1) % S) for i in range(S)]
+
+            def sched_step(carry, t):
+                state, outs = carry
+                mb_idx = jnp.clip(t, 0, M - 1)
+                x_in = jnp.where(stage == 0, x_m[mb_idx], state) \
+                    if S > 1 else x_m[mb_idx]
+                y = self._stage_scan(x_in, pvals, key, t, l_per)
+                w = t - (S - 1)
+                wc = jnp.clip(w, 0, M - 1)
+                valid = jnp.logical_and(
+                    stage == S - 1,
+                    jnp.logical_and(w >= 0, w < M))
+                outs = outs.at[wc].set(jnp.where(valid, y, outs[wc]))
+                nxt = jax.lax.ppermute(y, "pp", perm) if S > 1 else y
+                return (nxt, outs), None
+
+            (state, outs), _ = jax.lax.scan(
+                sched_step, (state, outs), jnp.arange(T))
+            if S > 1:
+                # only the last stage holds real outputs; zero the rest
+                # and psum so every pp rank returns the same result
+                outs = jax.lax.psum(
+                    outs * (stage == S - 1).astype(outs.dtype), "pp")
+            return outs
+
+        x_spec = P(None, dp_ax)
+        p_specs = tuple(P("pp") if S > 1 else P() for _ in self._stacked)
+
+        def fwd(xv, keyv, *pvals):
+            b = xv.shape[0]
+            if b % M:
+                raise ValueError(f"batch {b} not divisible by "
+                                 f"num_microbatches {M}")
+            mb = b // M
+            x_m = xv.reshape((M, mb) + xv.shape[1:])
+            with manual_collective_mode():
+                if S > 1:
+                    out = shard_map(
+                        body, mesh=mesh,
+                        in_specs=(x_spec, P()) + p_specs,
+                        out_specs=x_spec, check_vma=False,
+                    )(x_m, keyv, *pvals)
+                else:
+                    out = body(x_m, keyv, *pvals)
+            return out.reshape((b,) + out.shape[2:])
+
+        op = OpDef(f"pipeline_gpipe::{S}x{M}", fwd)
+        self._pipe_ops[key_] = op
+        return op
+
+    # -- public API -------------------------------------------------------
 
     def get_num_stages(self):
         return self._num_stages
 
     @property
     def parameters_by_stage(self):
+        if self._pipelined:
+            return {s: list(self._stacked)
+                    for s in range(self._num_stages)}
         out = {s: [] for s in range(self._num_stages)}
-        li = 0
         for run, stage in zip(self.run_function, self._stage_of):
             if isinstance(run, Layer):
                 out[stage] += run.parameters()
         return out
 
-    def forward(self, args):
-        """Sequential execution with pp-axis resharding at boundaries:
-        inside jit, XLA turns the constraint changes into
-        collective-permutes between stage device groups."""
-        from ..mesh import get_mesh, shard_constraint
-        from jax.sharding import PartitionSpec as P
-        mesh = get_mesh()
-        pp_on = (mesh is not None and "pp" in mesh.dim_names
-                 and mesh.get_dim_size("pp") > 1)
+    def forward(self, args, num_microbatches=None):
+        from ..mesh import get_mesh
+        if not self._pipelined:
+            x = args
+            for run in self.run_function:
+                x = run(x) if not isinstance(x, tuple) else run(*x)
+            return x
         x = args
-        prev_stage = self._stage_of[0] if self._stage_of else 0
-        for run, stage in zip(self.run_function, self._stage_of):
-            if pp_on and stage != prev_stage and isinstance(x, Tensor):
-                x = shard_constraint(x, P())
-                prev_stage = stage
+        for run in self._pre_runs:
+            x = run(x) if not isinstance(x, tuple) else run(*x)
+        pm = get_mesh()
+        n_micro = num_microbatches or self._n_micro
+        if pm is None or "pp" not in pm.dim_names \
+                or pm.get_dim_size("pp") <= 1:
+            n_micro = 1
+            pm = pm or _SingleMesh()
+        op = self._get_pipe_op(pm, n_micro)
+        key = Tensor(random_mod.next_key(), stop_gradient=True)
+        x = apply_op(op, x, key, *self._stacked)
+        for run in self._post_runs:
             x = run(x) if not isinstance(x, tuple) else run(*x)
         return x
+
+
+class _SingleMesh:
+    """Stand-in ProcessMesh when no mesh is active: the stacked blocks
+    still run (plain lax.scan path, S=1)."""
+    dim_names = ()
+    jax_mesh = None
+
+    def get_dim_size(self, name):
+        return 1
 
 
 class PipelineParallel(Layer):
@@ -206,6 +451,24 @@ class PipelineParallel(Layer):
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         from ...ops import manipulation, math as math_ops
         inputs, labels = data
+        if getattr(self._layers, "_pipelined", False):
+            # compiled GPipe path: microbatching happens inside the
+            # pipeline op (fill/drain schedule), one fwd+bwd per batch
+            out = self._layers(inputs,
+                               num_microbatches=self._acc_steps
+                               if self._acc_steps > 1 else None)
+            loss = (self._layers._loss_fn(out, labels)
+                    if getattr(self._layers, "_loss_fn", None) else out)
+            if scaler is not None:
+                scaler.scale(loss).backward()
+                scaler.step(optimizer)
+            else:
+                loss.backward()
+                optimizer.step()
+            optimizer.clear_grad()
+            if lr_scheduler is not None:
+                lr_scheduler.step()
+            return loss
         micro = self._acc_steps
         total = None
         b = inputs.shape[0]

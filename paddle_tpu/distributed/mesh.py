@@ -195,10 +195,31 @@ def shard_tensor(x, mesh=None, placements=None, spec=None,
 _constraint_ops: dict = {}
 
 
+class manual_collective_mode:
+    """Context for code traced inside a shard_map body: mesh axes are
+    bound as manual axes there, so GSPMD sharding constraints are
+    meaningless (and rejected by JAX). While active, shard_constraint
+    is an identity — collectives must be written explicitly (psum/
+    ppermute), which the pipeline/ring schedules do."""
+
+    def __enter__(self):
+        self._prev = getattr(_state, "manual", False)
+        _state.manual = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.manual = self._prev
+        return False
+
+
+def in_manual_mode() -> bool:
+    return getattr(_state, "manual", False)
+
+
 def shard_constraint(x, spec, mesh=None):
     """with_sharding_constraint for use inside jitted programs."""
     mesh = mesh or get_mesh()
-    if mesh is None:
+    if mesh is None or in_manual_mode():
         return x
     from ..core.tensor import apply_op
     from ..core.dispatch import OpDef

@@ -6,6 +6,7 @@ so the TPU build ships them in-tree: GPT (decoder-only LM), BERT
 (encoder), Llama (RMSNorm/RoPE/SwiGLU — exercises the new
 ring-attention/sep axis).
 """
-from .gpt import GPTConfig, GPTModel, GPTForCausalLM  # noqa: F401
+from .gpt import (GPTConfig, GPTModel, GPTForCausalLM,  # noqa: F401
+                  GPTForCausalLMPipe)
 from .bert import BertConfig, BertModel  # noqa: F401
 from .llama import LlamaConfig, LlamaModel, LlamaForCausalLM  # noqa: F401

@@ -21,7 +21,8 @@ from ..nn import functional as F
 from ..core.tensor import Tensor
 from ..nn.initializer import Normal, Constant
 
-__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM"]
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM",
+           "GPTForCausalLMPipe"]
 
 
 class GPTConfig:
@@ -292,3 +293,46 @@ class GPTForCausalLM(nn.Layer):
             out = manipulation.concat([out, nxt_t], axis=1)
             logits, caches = self.forward(nxt_t, caches=caches)
         return out
+
+
+class GPTForCausalLMPipe(nn.Layer):
+    """Pipeline-parallel GPT: embeddings and LM head run outside the
+    pipelined section (GSPMD TP applies there); the homogeneous decoder
+    blocks are stacked along a layer axis sharded over "pp" and run as
+    the compiled GPipe schedule (see distributed/fleet/pp_layers.py).
+    Mirrors the reference's GPTForCausalLMPipe in PaddleNLP built on
+    fleet/meta_parallel/parallel_layers/pp_layers.py:209."""
+
+    def __init__(self, config: GPTConfig, num_stages=None,
+                 num_microbatches=None):
+        super().__init__()
+        from ..distributed.fleet.pp_layers import PipelineLayer
+        from ..distributed.mesh import get_mesh
+        self.config = config
+        if num_stages is None:
+            m = get_mesh()
+            num_stages = (m.get_dim_size("pp")
+                          if m is not None and "pp" in m.dim_names else 1)
+        emb = GPTEmbeddings(config)
+        blocks = [GPTDecoderLayer(config)
+                  for _ in range(config.num_hidden_layers)]
+        ln_f = nn.LayerNorm(config.hidden_size,
+                            epsilon=config.layer_norm_epsilon)
+
+        def head(x):
+            # ln_f already applied (it is the preceding pipeline entry)
+            from ..ops import linalg
+            return linalg.matmul(x, emb.word_embeddings.weight,
+                                 transpose_y=True)
+
+        self.pipeline = PipelineLayer(
+            [emb] + blocks + [ln_f, head],
+            num_stages=num_stages,
+            loss_fn=nn.CrossEntropyLoss(),
+            num_microbatches=num_microbatches)
+
+    def forward(self, input_ids, labels=None):
+        logits = self.pipeline(input_ids)
+        if labels is not None:
+            return F.cross_entropy(logits, labels)
+        return logits

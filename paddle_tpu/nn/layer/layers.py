@@ -170,6 +170,7 @@ class Layer:
             for d in (layers, buffers):
                 if d is not None:
                     d.pop(name, None)
+            self.__dict__.pop(name, None)   # plain attr must not shadow
             params[name] = value
         elif isinstance(value, Layer):
             if layers is None:
@@ -177,6 +178,7 @@ class Layer:
             for d in (params, buffers):
                 if d is not None:
                     d.pop(name, None)
+            self.__dict__.pop(name, None)   # plain attr must not shadow
             layers[name] = value
         elif params is not None and name in params:
             if value is None:

@@ -226,6 +226,156 @@ class TestPipeline:
         assert np.isfinite(float(loss))
 
 
+class _ResBlock(nn.Layer):
+    """Shape-preserving homogeneous block for pipeline stacking tests."""
+
+    def __init__(self, d):
+        super().__init__()
+        self.fc1 = nn.Linear(d, 2 * d)
+        self.fc2 = nn.Linear(2 * d, d)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x))) + x
+
+
+def _pp_fixture(pp_degree, dp_degree=1):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp_degree, "mp_degree": 1,
+                               "pp_degree": pp_degree,
+                               "sharding_degree": 1, "sep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    return fleet.get_hybrid_communicate_group()
+
+
+class TestCompiledPipeline:
+    """The GPipe schedule compiled over the pp mesh axis: loss parity
+    with sequential execution + stage ownership of parameters
+    (VERDICT round-1 item 3)."""
+
+    def _build(self, n_blocks, num_stages, d=16, seed=7):
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            PipelineLayer)
+        paddle.seed(seed)
+        blocks = [_ResBlock(d) for _ in range(n_blocks)]
+        pre = nn.Linear(d, d)
+        post = nn.Linear(d, d)
+        pp = PipelineLayer([pre] + blocks + [post],
+                           num_stages=num_stages)
+        return pp, pre, blocks, post
+
+    def _ref_forward(self, pre, blocks, post, x):
+        h = pre(x)
+        for b in blocks:
+            h = b(h)
+        return post(h)
+
+    @pytest.mark.parametrize("pp_degree", [2, 4])
+    def test_loss_and_grad_parity(self, pp_degree):
+        _pp_fixture(pp_degree, dp_degree=1)
+        pp, pre, blocks, post = self._build(4, pp_degree)
+        assert pp._pipelined
+        x_np = _randn(8, 16)
+        y_np = _randn(8, 16)
+
+        x = paddle.to_tensor(x_np, stop_gradient=False)
+        out = pp(x, num_microbatches=4)
+        loss = F.mse_loss(out, paddle.to_tensor(y_np))
+        loss.backward()
+        stacked_grads = [np.asarray(sp.grad.numpy())
+                         for sp in pp._stacked]
+        loss_pipe = float(loss)
+        for p in pp.parameters():
+            p.clear_gradient()
+
+        x2 = paddle.to_tensor(x_np, stop_gradient=False)
+        ref = self._ref_forward(pre, blocks, post, x2)
+        loss_ref = F.mse_loss(ref, paddle.to_tensor(y_np))
+        loss_ref.backward()
+
+        np.testing.assert_allclose(loss_pipe, float(loss_ref),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(),
+                                   rtol=2e-4, atol=2e-5)
+        # stacked grad slice i == block i's grad (same name order)
+        names = pp._stack_names
+        for k, name in enumerate(names):
+            for i, b in enumerate(blocks):
+                want = dict(b.named_parameters())[name].grad.numpy()
+                np.testing.assert_allclose(
+                    stacked_grads[k][i], want, rtol=2e-3, atol=2e-4,
+                    err_msg=f"{name} block {i}")
+
+    def test_stage_owns_param_shard(self):
+        _pp_fixture(4)
+        pp, *_ = self._build(8, 4)
+        import jax
+        from jax.sharding import NamedSharding
+        for sp in pp._stacked:
+            sh = sp._value.sharding
+            assert isinstance(sh, NamedSharding)
+            assert sh.spec[0] == "pp"
+            local = sp._value.addressable_shards[0].data.shape
+            assert local[0] == 8 // 4  # 1/num_stages of the layer stack
+
+    def test_microbatch_counts_agree(self):
+        _pp_fixture(2)
+        pp, *_ = self._build(4, 2)
+        x = paddle.to_tensor(_randn(8, 16))
+        o1 = pp(x, num_microbatches=2).numpy()
+        o2 = pp(x, num_microbatches=4).numpy()
+        np.testing.assert_allclose(o1, o2, rtol=2e-4, atol=2e-5)
+
+    def test_train_batch_compiled_path(self):
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            PipelineParallel)
+        import paddle_tpu.optimizer as popt
+        _pp_fixture(2, dp_degree=2)
+        pp, *_ = self._build(4, 2)
+        pp._loss_fn = nn.MSELoss()
+        strategy = fleet.DistributedStrategy()
+        strategy.pipeline_configs = {"accumulate_steps": 4}
+        runner = PipelineParallel(pp, strategy=strategy)
+        o = popt.SGD(0.05, parameters=pp.parameters())
+        x = paddle.to_tensor(_randn(8, 16))
+        y = paddle.to_tensor(_randn(8, 16))
+        losses = [float(runner.train_batch((x, y), o)) for _ in range(4)]
+        assert losses[-1] < losses[0]
+
+    def test_gpt_pipe_matches_dense(self):
+        from paddle_tpu.nlp import (GPTConfig, GPTForCausalLM,
+                                    GPTForCausalLMPipe)
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                                   "pp_degree": 2, "sharding_degree": 1,
+                                   "sep_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        cfg = GPTConfig(vocab_size=128, hidden_size=32,
+                        num_hidden_layers=4, num_attention_heads=4,
+                        max_position_embeddings=16,
+                        hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+        paddle.seed(0)
+        pipe = GPTForCausalLMPipe(cfg)
+        paddle.seed(0)
+        ref = GPTForCausalLM(cfg)
+        pipe.eval()
+        ref.eval()
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 128, (4, 8)))
+        np.testing.assert_allclose(pipe(ids).numpy(), ref(ids).numpy(),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_no_mesh_fallback_scan(self):
+        pp, pre, blocks, post = self._build(4, 2)
+        # no fleet.init: stacked params exist but run via plain scan
+        x_np = _randn(4, 16)
+        out = pp(paddle.to_tensor(x_np))
+        ref = self._ref_forward(pre, blocks, post,
+                                paddle.to_tensor(x_np))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(),
+                                   rtol=2e-4, atol=2e-5)
+
+
 class TestRNGTracker:
     def test_streams_differ(self):
         from paddle_tpu.distributed.fleet.utils import RNGStatesTracker
