@@ -35,7 +35,7 @@ class DistributedStrategy:
     def __init__(self):
         self.hybrid_configs = {
             "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
-            "sharding_degree": 1, "sep_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1, "ep_degree": 1,
         }
         self.pipeline_configs = {"accumulate_steps": 1,
                                  "micro_batch_size": 1}
@@ -74,10 +74,10 @@ class _Fleet:
         h = self._strategy.hybrid_configs
         topo = CommunicateTopology(
             hybrid_group_names=["data", "pipe", "sharding", "sep",
-                                "model"],
+                                "model", "expert"],
             dims=[h.get("dp_degree", 1), h.get("pp_degree", 1),
                   h.get("sharding_degree", 1), h.get("sep_degree", 1),
-                  h.get("mp_degree", 1)])
+                  h.get("mp_degree", 1), h.get("ep_degree", 1)])
         self._hcg = HybridCommunicateGroup(topo)
         coll.mark_initialized()
         self._initialized = True

@@ -290,14 +290,17 @@ class PipelineLayer(Layer):
                 p._value = v
         return hv.astype(h.dtype)
 
-    def _stage_scan(self, h, pv_local, key, t, l_per):
+    def _stage_scan(self, h, pv_local, key, t, l_per, stage=0):
         """Apply this device's l_per consecutive blocks (a lax.scan)."""
         remat = self._recompute_interval > 0
 
         def one_layer(carry, xs):
             li = xs[0]
             plist = xs[1:]
-            k = jax.random.fold_in(jax.random.fold_in(key, t), li)
+            # fold in the GLOBAL layer index (stage*l_per + li): stages run
+            # concurrently at the same t and must not share dropout masks
+            k = jax.random.fold_in(jax.random.fold_in(key, t),
+                                   stage * l_per + li)
             return self._block_apply(carry, plist, k), None
 
         body = jax.checkpoint(one_layer) if remat else one_layer
@@ -336,7 +339,8 @@ class PipelineLayer(Layer):
                 mb_idx = jnp.clip(t, 0, M - 1)
                 x_in = jnp.where(stage == 0, x_m[mb_idx], state) \
                     if S > 1 else x_m[mb_idx]
-                y = self._stage_scan(x_in, pvals, key, t, l_per)
+                y = self._stage_scan(x_in, pvals, key, t, l_per,
+                                     stage=stage)
                 w = t - (S - 1)
                 wc = jnp.clip(w, 0, M - 1)
                 valid = jnp.logical_and(
@@ -454,9 +458,9 @@ class PipelineParallel(Layer):
         if getattr(self._layers, "_pipelined", False):
             # compiled GPipe path: microbatching happens inside the
             # pipeline op (fill/drain schedule), one fwd+bwd per batch
-            out = self._layers(inputs,
-                               num_microbatches=self._acc_steps
-                               if self._acc_steps > 1 else None)
+            # honor the configured accumulate_steps exactly (the default 1
+            # means no microbatching — not the num_stages fallback)
+            out = self._layers(inputs, num_microbatches=self._acc_steps)
             loss = (self._layers._loss_fn(out, labels)
                     if getattr(self._layers, "_loss_fn", None) else out)
             if scaler is not None:

@@ -21,7 +21,7 @@ from ..collective import Group, new_group
 __all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
 
 _AXIS_ALIAS = {"data": "dp", "pipe": "pp", "sharding": "sharding",
-               "model": "mp", "sep": "sep"}
+               "model": "mp", "sep": "sep", "expert": "ep"}
 
 
 class CommunicateTopology:
@@ -86,6 +86,9 @@ class HybridCommunicateGroup:
         self._sep_degree = (topology.get_dim("sep")
                             if "sep" in topology.get_hybrid_group_names()
                             else 1)
+        self._ep_degree = (topology.get_dim("expert")
+                           if "expert" in topology.get_hybrid_group_names()
+                           else 1)
         self.global_rank = 0
         world = topology.world_size()
         n_dev = len(jax.devices())
@@ -95,15 +98,17 @@ class HybridCommunicateGroup:
                 f"set XLA_FLAGS=--xla_force_host_platform_device_count "
                 f"for virtual-device testing")
         dims = [self._dp_degree, self._pp_degree, self._sharding_degree,
-                self._sep_degree, self._mp_degree]
+                self._sep_degree, self._mp_degree, self._ep_degree]
         self._mesh = ProcessMesh(
-            shape=dims, dim_names=["dp", "pp", "sharding", "sep", "mp"])
+            shape=dims,
+            dim_names=["dp", "pp", "sharding", "sep", "mp", "ep"])
         set_mesh(self._mesh)
         self._dp_group = new_group(axis_name="dp")
         self._pp_group = new_group(axis_name="pp")
         self._sharding_group = new_group(axis_name="sharding")
         self._sep_group = new_group(axis_name="sep")
         self._mp_group = new_group(axis_name="mp")
+        self._ep_group = new_group(axis_name="ep")
 
     @property
     def mesh(self):
@@ -192,6 +197,16 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_group(self):
         return self._sep_group
+
+    # expert parallel
+    def get_expert_parallel_rank(self):
+        return 0
+
+    def get_expert_parallel_world_size(self):
+        return self._ep_degree
+
+    def get_expert_parallel_group(self):
+        return self._ep_group
 
     def get_check_parallel_group(self, *a, **kw):
         return self._mp_group

@@ -1,13 +1,24 @@
-"""Mixture-of-Experts with expert parallelism.
+"""Mixture-of-Experts with real expert parallelism.
 
 TPU-native replacement for the MoE stack (reference:
 python/paddle/incubate/distributed/models/moe/moe_layer.py:260 MoELayer,
 gates in moe/gate/{naive,gshard,switch}_gate.py, dispatch via
 global_scatter/global_gather CUDA all-to-all at moe_layer.py:116,164 and
-operators/collective/global_scatter_op.*). Here dispatch is a dense
-capacity-bucketed einsum (the TPU idiom: static shapes, MXU-friendly
-one-hot matmuls) and expert parallelism is a sharding annotation over
-the "mp" (or a dedicated "ep") axis — XLA emits the all-to-all on ICI.
+operators/collective/global_scatter_op.*).
+
+TPU design, not a port:
+- dispatch is a dense capacity-bucketed einsum (static shapes, MXU
+  one-hot matmuls); the reference's global_scatter all-to-all becomes
+  XLA's all-to-all, emitted where the [E, C, D] expert buffers change
+  sharding from token-sharded to expert-sharded.
+- expert parallelism is physical: the per-expert parameter pytrees are
+  stacked along a leading E axis into MoELayer-owned parameters sharded
+  over the "ep" mesh axis (fall back: "mp"), and the expert computation
+  is one vmap over E — each device runs only its local experts.
+- gates implement the real algorithms: GShard (capacity factor pair,
+  load-balance aux loss, randomized second-expert routing; reference
+  moe/gate/gshard_gate.py), Switch (top-1, training jitter, capacity,
+  aux loss; reference moe/gate/switch_gate.py).
 """
 from __future__ import annotations
 
@@ -16,18 +27,25 @@ import math
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from ..core.tensor import Tensor
-from ..core.dispatch import register_op
+from ..core.dispatch import OpDef, register_op
+from ..core import random as random_mod
 from ..ops._helpers import as_tensor, apply_op
 from ..nn.layer.layers import Layer
-from ..nn.layer.container import LayerList
+from .mesh import get_mesh, shard_tensor, shard_constraint
 
 __all__ = ["MoELayer", "NaiveGate", "GShardGate", "SwitchGate"]
 
 
 class NaiveGate(Layer):
     """Top-k softmax gate (reference: moe/gate/naive_gate.py)."""
+
+    #: dispatch policy consumed by MoELayer
+    second_policy = "all"
+    jitter_eps = 0.0
+    capacity = None  # -> MoELayer.capacity_factor
 
     def __init__(self, d_model, num_expert, world_size=1, topk=2):
         super().__init__()
@@ -41,36 +59,63 @@ class NaiveGate(Layer):
 
 
 class GShardGate(NaiveGate):
-    """Adds the GShard load-balancing auxiliary loss."""
+    """GShard top-2 gate: capacity-bounded dispatch, load-balance aux
+    loss, and randomized second-expert routing (the 2nd expert is kept
+    with probability min(1, 2*p2); reference moe/gate/gshard_gate.py)."""
+
+    second_policy = "random"
 
     def __init__(self, d_model, num_expert, world_size=1, topk=2,
                  capacity=(1.2, 2.4), group=None):
         super().__init__(d_model, num_expert, world_size, topk)
-        self.capacity = capacity
+        self.capacity = tuple(capacity)
 
 
 class SwitchGate(NaiveGate):
+    """Switch-Transformer top-1 gate: multiplicative jitter during
+    training, capacity drop, aux loss (reference: moe/gate/switch_gate.py)."""
+
+    second_policy = "all"
+    jitter_eps = 1e-2
+
     def __init__(self, d_model, num_expert, world_size=1, topk=1,
                  capacity=(1.2, 2.4), group=None):
         super().__init__(d_model, num_expert, world_size, topk=1)
-        self.capacity = capacity
+        self.capacity = tuple(capacity)
 
 
-def _moe_dispatch_fwd(x, logits, n_expert, topk, capacity):
+def _moe_dispatch_fwd(x, logits, key, n_expert, topk, capacity,
+                      second_policy="all", jitter_eps=0.0, training=True):
     """Dense dispatch: [T, D] tokens -> [E, C, D] expert buffers, plus
     combine weights. All static shapes; the scatter of the reference's
     global_scatter becomes one-hot matmuls that ride the MXU."""
     T, D = x.shape
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [T, E]
+    logits = logits.astype(jnp.float32)
+    if jitter_eps and training:
+        k_jit, key = jax.random.split(key)
+        logits = logits * jax.random.uniform(
+            k_jit, logits.shape, minval=1.0 - jitter_eps,
+            maxval=1.0 + jitter_eps)
+    probs = jax.nn.softmax(logits, axis=-1)                      # [T, E]
     gate_vals, gate_idx = jax.lax.top_k(probs, topk)             # [T, k]
-    # position of each token within its expert's buffer
     onehot = jax.nn.one_hot(gate_idx, n_expert,
                             dtype=jnp.float32)                   # [T,k,E]
-    # rank tokens per expert by arrival order (cumsum trick)
+    aux = _gshard_aux(probs, onehot)
+    if second_policy == "random" and topk >= 2:
+        # GShard randomized routing: keep expert j>=2 w.p. min(1, 2*p_j)
+        keep2 = (jax.random.uniform(key, gate_vals[:, 1:].shape)
+                 < 2.0 * gate_vals[:, 1:]).astype(jnp.float32)
+        keep_k = jnp.concatenate(
+            [jnp.ones_like(gate_vals[:, :1]), keep2], axis=1)    # [T, k]
+        gate_vals = gate_vals * keep_k
+        onehot = onehot * keep_k[:, :, None]
+    # position of each token within its expert's buffer: rank tokens per
+    # expert by arrival order (cumsum trick)
     flat = onehot.reshape(T * topk, n_expert)
     pos_in_expert = (jnp.cumsum(flat, axis=0) - 1.0) * flat      # [T*k,E]
     pos = jnp.sum(pos_in_expert, axis=-1).reshape(T, topk)
-    keep = pos < capacity
+    keep = jnp.logical_and(pos < capacity,
+                           jnp.sum(onehot, axis=-1) > 0.5)
     gate_vals = gate_vals * keep.astype(gate_vals.dtype)
     # renormalize kept gates
     denom = jnp.maximum(jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
@@ -81,7 +126,6 @@ def _moe_dispatch_fwd(x, logits, n_expert, topk, capacity):
     disp = onehot.astype(x.dtype)[:, :, :, None] * pos_oh[:, :, None, :]
     expert_in = jnp.einsum("tkec,td->ecd", disp, x)
     combine = disp * gate_vals.astype(x.dtype)[:, :, None, None]
-    aux = _gshard_aux(probs, onehot)
     return expert_in, combine, aux
 
 
@@ -101,13 +145,25 @@ register_op("moe_combine",
                 "ecd,tkec->td", expert_out, combine))
 
 
+def _sanitize(name):
+    return name.replace(".", "__")
+
+
 class MoELayer(Layer):
-    """reference: moe_layer.py:260. experts: list of Layers (the local
-    expert MLPs); gate: config dict or Layer."""
+    """reference: moe_layer.py:260. experts: list of structurally
+    identical Layers (the local expert MLPs, used as initializers for the
+    stacked expert parameters); gate: config dict or Layer.
+
+    Parameters of the experts are stacked into `expert__<name>`
+    parameters with a leading [E] axis sharded over the expert-parallel
+    mesh axis; the expert forward is one vmap over that axis, so each
+    device holds and runs only E/ep_degree experts and XLA inserts the
+    dispatch/combine all-to-alls on ICI.
+    """
 
     def __init__(self, d_model, experts=None, gate=None, moe_group=None,
                  mp_group=None, recompute_interval=0, capacity_factor=1.25,
-                 topk=2, **kwargs):
+                 topk=2, ep_axis=None, **kwargs):
         super().__init__()
         self.d_model = d_model
         if isinstance(gate, dict):
@@ -120,11 +176,87 @@ class MoELayer(Layer):
             self.gate = GShardGate(d_model, len(experts), topk=topk)
         else:
             self.gate = gate
-        self.experts = (experts if isinstance(experts, LayerList)
-                        else LayerList(experts))
-        self.topk = topk
+        self.topk = getattr(self.gate, "topk", topk)
         self.capacity_factor = capacity_factor
         self.aux_loss = None
+        self._ep_axis_arg = ep_axis or getattr(moe_group, "axis_name", None)
+        templates = list(experts)
+        self.num_expert = len(templates)
+        object.__setattr__(self, "_templates", templates)
+        self._stacked_names: list[str] = []
+        self._experts_op = None
+        if self._stack_experts(templates):
+            self._build_experts_op(templates[0])
+        else:
+            # non-identical experts: keep them as plain sublayers and run
+            # the replicated per-expert loop (no expert parallelism)
+            from ..nn.layer.container import LayerList
+            self._expert_layers = LayerList(templates)
+        self._shard_stacked()
+
+    # -- expert stacking -----------------------------------------------------
+    def _stack_experts(self, templates) -> bool:
+        """Stack per-expert parameters into [E, ...] Parameters owned by
+        this layer. Returns False (-> per-expert loop fallback) when the
+        experts are not structurally identical or carry buffers."""
+        from ..core.tensor import Parameter
+        named0 = list(templates[0].named_parameters())
+        if any(len(list(t.named_buffers())) for t in templates):
+            return False
+        per_expert = []
+        for t in templates:
+            named = list(t.named_parameters())
+            if ([n for n, _ in named] != [n for n, _ in named0] or
+                    any(p.shape != q.shape or p.dtype != q.dtype
+                        for (_, p), (_, q) in zip(named, named0))):
+                return False
+            per_expert.append(named)
+        for i, (name, p0) in enumerate(named0):
+            stacked = jnp.stack([pe[i][1]._value for pe in per_expert])
+            pname = f"expert__{_sanitize(name)}"
+            param = Parameter(stacked, trainable=not p0.stop_gradient)
+            setattr(self, pname, param)
+            self._stacked_names.append(pname)
+        return True
+
+    def _build_experts_op(self, template):
+        tmpl_params = [p for _, p in template.named_parameters()]
+
+        def fwd(expert_in, *stacked_vals):
+            def one_expert(xe, *pvals):
+                originals = [p._value for p in tmpl_params]
+                try:
+                    for p, v in zip(tmpl_params, pvals):
+                        p._value = v
+                    out = template(Tensor(xe, stop_gradient=True))
+                    return out._value
+                finally:
+                    for p, v in zip(tmpl_params, originals):
+                        p._value = v
+            return jax.vmap(one_expert)(expert_in, *stacked_vals)
+
+        self._experts_op = OpDef(
+            f"moe_experts::{type(template).__name__}", fwd)
+
+    # -- expert-parallel sharding -------------------------------------------
+    def _ep_axis(self):
+        mesh = get_mesh()
+        if mesh is None:
+            return None, None
+        for name in ([self._ep_axis_arg] if self._ep_axis_arg
+                     else ["ep", "mp"]):
+            if name in mesh.dim_names:
+                size = mesh.get_dim_size(name)
+                if size > 1 and self.num_expert % size == 0:
+                    return mesh, name
+        return mesh, None
+
+    def _shard_stacked(self):
+        mesh, axis = self._ep_axis()
+        if axis is None:
+            return
+        for pname in self._stacked_names:
+            shard_tensor(getattr(self, pname), mesh, spec=P(axis))
 
     def forward(self, x):
         from ..ops import manipulation
@@ -132,18 +264,38 @@ class MoELayer(Layer):
         T = int(np.prod(orig_shape[:-1]))
         xf = manipulation.reshape(x, [T, self.d_model])
         logits = self.gate(xf)
-        n_exp = len(self.experts)
-        capacity = max(int(self.capacity_factor * T * self.topk / n_exp), 1)
+        n_exp = self.num_expert
+        cap_tuple = getattr(self.gate, "capacity", None)
+        if cap_tuple is not None:
+            factor = cap_tuple[0] if self.training else cap_tuple[1]
+        else:
+            factor = self.capacity_factor
+        capacity = max(int(math.ceil(factor * T * self.topk / n_exp)), 1)
+        key = Tensor(random_mod.next_key())
         expert_in, combine, aux = apply_op(
-            "moe_dispatch", xf, logits,
-            attrs=dict(n_expert=n_exp, topk=self.topk, capacity=capacity))
+            "moe_dispatch", xf, logits, key,
+            attrs=dict(n_expert=n_exp, topk=self.topk, capacity=capacity,
+                       second_policy=getattr(self.gate, "second_policy",
+                                             "all"),
+                       jitter_eps=getattr(self.gate, "jitter_eps", 0.0),
+                       training=self.training))
         self.aux_loss = aux
-        # run experts on their [C, D] buffers; under expert parallelism
-        # the leading E dim is sharded and this loop vectorizes per shard
-        outs = []
-        for e, expert in enumerate(self.experts):
-            buf = expert_in[e]
-            outs.append(expert(buf))
-        expert_out = manipulation.stack(outs, axis=0)
+        mesh, axis = self._ep_axis()
+        if axis is not None:
+            # token-sharded -> expert-sharded: XLA emits the all-to-all
+            expert_in = shard_constraint(expert_in, P(axis))
+        if self._experts_op is not None:
+            stacked = [getattr(self, n) for n in self._stacked_names]
+            expert_out = apply_op(self._experts_op, expert_in, *stacked)
+        else:
+            outs = [t(expert_in[e])
+                    for e, t in enumerate(self._templates)]
+            expert_out = manipulation.stack(outs, axis=0)
+        if axis is not None:
+            expert_out = shard_constraint(expert_out, P(axis))
         yf = apply_op("moe_combine", expert_out, combine)
         return manipulation.reshape(yf, orig_shape)
+
+    @property
+    def experts(self):
+        return self._templates

@@ -451,3 +451,165 @@ class TestMeshLifecycle:
         g = moe.gate.gate.weight.grad
         assert g is not None
         assert float(np.abs(g.numpy()).max()) > 0.0
+
+
+@pytest.fixture()
+def ep_hcg():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "ep_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    return fleet.get_hybrid_communicate_group()
+
+
+def _deterministic_experts(n, d, hidden):
+    rs = np.random.RandomState(7)
+    experts = []
+    for _ in range(n):
+        mlp = nn.Sequential(nn.Linear(d, hidden), nn.GELU(),
+                            nn.Linear(hidden, d))
+        for p in mlp.parameters():
+            p.set_value(paddle.to_tensor(
+                rs.randn(*p.shape).astype("float32") * 0.1))
+        experts.append(mlp)
+    return experts
+
+
+class TestExpertParallel:
+    """VERDICT round-1 item 5: physical expert parallelism — stacked
+    expert weights live sharded over the ep axis, each device owns
+    E/ep_degree experts."""
+
+    def test_topology_has_ep_axis(self, ep_hcg):
+        assert ep_hcg.get_expert_parallel_world_size() == 4
+        assert "ep" in ep_hcg.mesh.dim_names
+
+    def test_stacked_params_sharded_over_ep(self, ep_hcg):
+        moe = dist.MoELayer(16, experts=_deterministic_experts(8, 16, 32),
+                            gate={"type": "gshard", "top_k": 2})
+        assert moe._stacked_names, "experts should stack"
+        for name in moe._stacked_names:
+            p = getattr(moe, name)
+            assert p.shape[0] == 8
+            spec = p._value.sharding.spec
+            assert spec and spec[0] == "ep", f"{name}: {spec}"
+            # physical ownership: every device shard holds E/ep experts
+            for s in p._value.addressable_shards:
+                assert s.data.shape[0] == 2
+        # stacked params are what the optimizer sees; per-expert templates
+        # are only initializers
+        names = [n for n, _ in moe.named_parameters()]
+        assert sum(n.startswith("expert__") for n in names) == \
+            len(moe._stacked_names)
+
+    def test_ep_matches_replicated(self, ep_hcg):
+        # same weights, same tokens: GSPMD expert-parallel execution must
+        # be numerically identical to the single-device run
+        experts = _deterministic_experts(8, 16, 32)
+        paddle.seed(11)
+        moe = dist.MoELayer(16, experts=experts,
+                            gate={"type": "naive", "top_k": 2},
+                            capacity_factor=8.0)
+        moe.eval()
+        x = paddle.to_tensor(_randn(4, 6, 16))
+        y = moe(x).numpy()
+
+        fleet.shutdown()
+        experts2 = _deterministic_experts(8, 16, 32)
+        paddle.seed(11)
+        moe2 = dist.MoELayer(16, experts=experts2,
+                             gate={"type": "naive", "top_k": 2},
+                             capacity_factor=8.0)
+        moe2.eval()
+        y2 = moe2(x).numpy()
+        np.testing.assert_allclose(y, y2, rtol=2e-5, atol=2e-5)
+
+    def test_backward_reaches_stacked_experts(self, ep_hcg):
+        moe = dist.MoELayer(16, experts=_deterministic_experts(4, 16, 32),
+                            gate={"type": "gshard", "top_k": 2})
+        x = paddle.to_tensor(_randn(2, 8, 16), stop_gradient=False)
+        y = moe(x)
+        (y.mean() + moe.aux_loss * 0.01).backward()
+        for name in moe._stacked_names:
+            g = getattr(moe, name).grad
+            assert g is not None
+            assert np.isfinite(g.numpy()).all()
+
+
+class TestGates:
+    """Gate algorithm unit tests vs the reference semantics
+    (moe/gate/{gshard,switch}_gate.py)."""
+
+    def _dispatch(self, probs_logits, key, **attrs):
+        import jax
+        from paddle_tpu.distributed.moe import _moe_dispatch_fwd
+        T, E = probs_logits.shape
+        x = np.ones((T, 4), dtype="float32")
+        defaults = dict(n_expert=E, topk=2, capacity=T,
+                        second_policy="all", jitter_eps=0.0, training=True)
+        defaults.update(attrs)
+        import jax.numpy as jnp
+        return _moe_dispatch_fwd(jnp.asarray(x), jnp.asarray(probs_logits),
+                                 key, **defaults)
+
+    def test_aux_loss_uniform_is_one(self):
+        import jax.numpy as jnp
+        from paddle_tpu.distributed.moe import _gshard_aux
+        T, E = 32, 4
+        probs = jnp.full((T, E), 1.0 / E)
+        onehot = jnp.zeros((T, 2, E)).at[:, 0, 0].set(1.0)
+        onehot = onehot.at[:, 1, 1].set(1.0)
+        # me uniform (1/E), all top-1 on expert 0 -> aux = E * (1/E * 1) = 1
+        assert abs(float(_gshard_aux(probs, onehot)) - 1.0) < 1e-6
+
+    def test_aux_loss_collapsed_is_E(self):
+        import jax.numpy as jnp
+        from paddle_tpu.distributed.moe import _gshard_aux
+        T, E = 32, 4
+        probs = jnp.zeros((T, E)).at[:, 0].set(1.0)
+        onehot = jnp.zeros((T, 2, E)).at[:, 0, 0].set(1.0)
+        assert abs(float(_gshard_aux(probs, onehot)) - E) < 1e-6
+
+    def test_gshard_random_routing_drops_weak_second(self):
+        import jax
+        # expert 0 dominant: p2 ~ 0 -> second expert essentially never
+        # kept; tokens land only in expert 0's buffer
+        logits = np.zeros((16, 4), dtype="float32")
+        logits[:, 0] = 20.0
+        expert_in, combine, _ = self._dispatch(
+            logits, jax.random.PRNGKey(0), second_policy="random")
+        assert float(np.abs(np.asarray(expert_in)[1:]).sum()) < 1e-5
+
+    def test_gshard_random_routing_keeps_strong_second(self):
+        import jax
+        # two equal experts: p2 = 0.5, 2*p2 = 1.0 > uniform -> always kept
+        logits = np.zeros((16, 4), dtype="float32")
+        logits[:, 0] = 5.0
+        logits[:, 1] = 5.0
+        expert_in, combine, _ = self._dispatch(
+            logits, jax.random.PRNGKey(0), second_policy="random")
+        assert float(np.abs(np.asarray(expert_in)[1]).sum()) > 1.0
+
+    def test_capacity_drops_overflow(self):
+        import jax
+        # all 8 tokens want expert 0, capacity 2 -> only 2 dispatched
+        logits = np.zeros((8, 4), dtype="float32")
+        logits[:, 0] = 20.0
+        expert_in, combine, _ = self._dispatch(
+            logits, jax.random.PRNGKey(0), topk=1, capacity=2)
+        buf0 = np.asarray(expert_in)[0]
+        assert float(np.abs(buf0[:2]).sum()) > 0
+        assert float(np.abs(np.asarray(combine)).sum()) <= 2 * 1.0 + 1e-5
+
+    def test_switch_gate_is_top1_with_jitter(self, hcg):
+        moe = dist.MoELayer(8, experts=[nn.Linear(8, 8) for _ in range(4)],
+                            gate={"type": "switch"})
+        assert moe.topk == 1
+        assert moe.gate.jitter_eps > 0
+        x = paddle.to_tensor(_randn(2, 4, 8))
+        y = moe(x)
+        assert y.shape == [2, 4, 8]
+        # eval mode: jitter off, deterministic
+        moe.eval()
+        y1 = moe(x).numpy()
+        y2 = moe(x).numpy()
+        np.testing.assert_allclose(y1, y2, rtol=1e-6)
