@@ -77,6 +77,10 @@ from . import distributed  # noqa: E402,F401
 from .distributed.parallel import DataParallel  # noqa: E402,F401
 from .regularizer import L1Decay, L2Decay  # noqa: E402,F401
 from .nn.layer.layers import ParamAttr  # noqa: E402,F401
+from . import metric  # noqa: E402,F401
+from . import hapi  # noqa: E402,F401
+from .hapi import Model  # noqa: E402,F401
+from .hapi import callbacks  # noqa: E402,F401
 
 bool = bool_  # paddle.bool
 

@@ -1,0 +1,59 @@
+"""Tensor-pytree flatten/unflatten shared by jit tracing and control flow.
+
+The framework analogue of the reference's feed/fetch structure handling
+(python/paddle/fluid/executor.py feed lists): arbitrary nests of
+Tensors/lists/tuples/dicts/constants flatten to a leaf list plus a spec
+that rebuilds the nest with substituted leaves.
+"""
+from __future__ import annotations
+
+from .tensor import Tensor
+
+__all__ = ["flatten_tensors", "unflatten_tensors", "static_key"]
+
+
+def flatten_tensors(obj, tensors):
+    """Flatten a pytree, extracting Tensors into `tensors`; returns a spec
+    that unflatten_tensors can rebuild with substituted leaves. Dict
+    insertion order is preserved."""
+    if isinstance(obj, Tensor):
+        tensors.append(obj)
+        return ("T", len(tensors) - 1)
+    if isinstance(obj, dict):
+        return ("D", {k: flatten_tensors(v, tensors)
+                      for k, v in obj.items()})
+    if isinstance(obj, (list, tuple)):
+        return ("L" if isinstance(obj, list) else "U",
+                [flatten_tensors(v, tensors) for v in obj])
+    return ("X", obj)
+
+
+def unflatten_tensors(spec, leaves):
+    kind, payload = spec
+    if kind == "T":
+        return leaves[payload]
+    if kind == "D":
+        return {k: unflatten_tensors(v, leaves)
+                for k, v in payload.items()}
+    if kind == "L":
+        return [unflatten_tensors(v, leaves) for v in payload]
+    if kind == "U":
+        return tuple(unflatten_tensors(v, leaves) for v in payload)
+    return payload
+
+
+def static_key(spec):
+    """Hashable cache key for the non-tensor structure of a spec."""
+    kind, payload = spec
+    if kind == "T":
+        return ("T",)
+    if kind == "D":
+        return ("D", tuple(sorted((k, static_key(v))
+                                  for k, v in payload.items())))
+    if kind in ("L", "U"):
+        return (kind, tuple(static_key(v) for v in payload))
+    try:
+        hash(payload)
+        return ("X", payload)
+    except TypeError:
+        return ("X", repr(payload))

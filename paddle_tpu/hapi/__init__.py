@@ -1,0 +1,5 @@
+"""High-level training API (reference: python/paddle/hapi/)."""
+from .model import Model  # noqa: F401
+from . import callbacks  # noqa: F401
+
+__all__ = ["Model", "callbacks"]

@@ -1,0 +1,325 @@
+"""hapi callbacks (reference: python/paddle/hapi/callbacks.py).
+
+ProgBarLogger/ModelCheckpoint/LRScheduler/EarlyStopping driven by
+Model.fit's event stream: on_{train,eval,predict}_{begin,end},
+on_epoch_{begin,end}, on_{mode}_batch_{begin,end}.
+"""
+from __future__ import annotations
+
+import numbers
+import os
+import time
+
+import numpy as np
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
+           "EarlyStopping"]
+
+
+def config_callbacks(callbacks=None, model=None, batch_size=None,
+                     epochs=None, steps=None, log_freq=2, verbose=2,
+                     save_freq=1, save_dir=None, metrics=None, mode="train"):
+    cbks = callbacks or []
+    cbks = cbks if isinstance(cbks, (list, tuple)) else [cbks]
+    if not any(isinstance(k, ProgBarLogger) for k in cbks) and verbose:
+        cbks = [ProgBarLogger(log_freq, verbose=verbose)] + list(cbks)
+    if not any(isinstance(k, LRScheduler) for k in cbks):
+        cbks = [LRScheduler()] + list(cbks)
+    if save_dir and not any(isinstance(k, ModelCheckpoint) for k in cbks):
+        cbks = list(cbks) + [ModelCheckpoint(save_freq, save_dir)]
+    lst = CallbackList(cbks)
+    lst.set_model(model)
+    lst.set_params({
+        "batch_size": batch_size, "epochs": epochs, "steps": steps,
+        "verbose": verbose, "metrics": metrics or [],
+    })
+    return lst
+
+
+class CallbackList:
+    def __init__(self, callbacks=None):
+        self.callbacks = list(callbacks or [])
+        self.params = {}
+        self.model = None
+
+    def append(self, callback):
+        self.callbacks.append(callback)
+
+    def __iter__(self):
+        return iter(self.callbacks)
+
+    def set_params(self, params):
+        self.params = params
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def set_model(self, model):
+        self.model = model
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def _call(self, name, *args):
+        for c in self.callbacks:
+            fn = getattr(c, name, None)
+            if fn is not None:
+                fn(*args)
+
+    def _check_mode(self, mode):
+        assert mode in ("train", "eval", "predict"), \
+            "mode should be train, eval or predict"
+
+    def on_begin(self, mode, logs=None):
+        self._check_mode(mode)
+        self._call(f"on_{mode}_begin", logs)
+
+    def on_end(self, mode, logs=None):
+        self._check_mode(mode)
+        self._call(f"on_{mode}_end", logs)
+
+    def on_epoch_begin(self, epoch=None, logs=None):
+        self._call("on_epoch_begin", epoch, logs)
+
+    def on_epoch_end(self, epoch=None, logs=None):
+        self._call("on_epoch_end", epoch, logs)
+
+    def on_batch_begin(self, mode, step=None, logs=None):
+        self._check_mode(mode)
+        self._call(f"on_{mode}_batch_begin", step, logs)
+
+    def on_batch_end(self, mode, step=None, logs=None):
+        self._check_mode(mode)
+        self._call(f"on_{mode}_batch_end", step, logs)
+
+
+class Callback:
+    """Base class (reference: callbacks.py:132)."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_predict_begin(self, logs=None):
+        pass
+
+    def on_predict_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+    def on_predict_batch_begin(self, step, logs=None):
+        pass
+
+    def on_predict_batch_end(self, step, logs=None):
+        pass
+
+
+def _fmt(v):
+    if isinstance(v, (list, tuple, np.ndarray)):
+        return " ".join(f"{float(x):.4f}" for x in np.ravel(v))
+    if isinstance(v, numbers.Number):
+        return f"{float(v):.4f}"
+    return str(v)
+
+
+class ProgBarLogger(Callback):
+    """Per-step/epoch console logging (reference: callbacks.py:301)."""
+
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+        self.steps = self.params.get("steps")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.train_step = 0
+        self._t0 = time.perf_counter()
+        if self.verbose and self.epochs:
+            print(f"Epoch {epoch + 1}/{self.epochs}")
+
+    def _print(self, mode, step, logs):
+        dt = (time.perf_counter() - self._t0) / max(step, 1) * 1000
+        parts = [f"step {step}" + (f"/{self.steps}" if self.steps else "")]
+        for k, v in (logs or {}).items():
+            if k != "samples":
+                parts.append(f"{k}: {_fmt(v)}")
+        parts.append(f"{dt:.1f} ms/step")
+        print(" - ".join(parts))
+
+    def on_train_batch_end(self, step, logs=None):
+        self.train_step += 1
+        if self.verbose > 1 and self.train_step % self.log_freq == 0:
+            self._print("train", self.train_step, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            self._print("train", self.train_step, logs)
+
+    def on_eval_begin(self, logs=None):
+        self.eval_step = 0
+        self._t0 = time.perf_counter()
+        if self.verbose:
+            n = (logs or {}).get("steps")
+            print(f"Eval begin...")
+
+    def on_eval_batch_end(self, step, logs=None):
+        self.eval_step += 1
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            parts = ["Eval samples: " + str((logs or {}).get("samples", ""))]
+            for k, v in (logs or {}).items():
+                if k != "samples":
+                    parts.append(f"{k}: {_fmt(v)}")
+            print(" - ".join(parts))
+
+
+class ModelCheckpoint(Callback):
+    """Save every `save_freq` epochs into save_dir/{epoch} and a final
+    save_dir/final (reference: callbacks.py ModelCheckpoint)."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model is not None and self.save_dir and \
+                epoch % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.model is not None and self.save_dir:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class LRScheduler(Callback):
+    """Steps the optimizer's LRScheduler (reference: callbacks.py
+    LRScheduler; by_step steps every batch, else every epoch)."""
+
+    def __init__(self, by_step=False, by_epoch=True):
+        super().__init__()
+        if by_step and by_epoch:
+            raise ValueError("by_step and by_epoch are mutually exclusive")
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        return getattr(opt, "_lr_scheduler", None)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+
+class EarlyStopping(Callback):
+    """Stop when a monitored metric stops improving (reference:
+    callbacks.py EarlyStopping)."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0,
+                 verbose=1, min_delta=0, baseline=None,
+                 save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.baseline = baseline
+        self.min_delta = abs(min_delta)
+        self.wait_epoch = 0
+        self.best_weights = None
+        self.stopped_epoch = 0
+        self.save_best_model = save_best_model
+        if mode not in ("auto", "min", "max"):
+            mode = "auto"
+        if mode == "min" or (mode == "auto" and
+                             ("acc" not in monitor and
+                              "auc" not in monitor)):
+            self.monitor_op = np.less
+            self.min_delta *= -1
+        else:
+            self.monitor_op = np.greater
+
+    def on_train_begin(self, logs=None):
+        self.wait_epoch = 0
+        if self.baseline is not None:
+            self.best_value = self.baseline
+        else:
+            self.best_value = np.inf if self.monitor_op == np.less \
+                else -np.inf
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._epoch = epoch
+
+    def on_eval_end(self, logs=None):
+        if logs is None or self.monitor not in logs:
+            return
+        current = logs[self.monitor]
+        if isinstance(current, (list, tuple, np.ndarray)):
+            current = float(np.ravel(current)[0])
+        if self.monitor_op(current - self.min_delta, self.best_value):
+            self.best_value = current
+            self.wait_epoch = 0
+            if self.save_best_model and self.model is not None:
+                self.best_weights = {
+                    k: np.asarray(v._value)
+                    for k, v in self.model.network.state_dict().items()}
+        else:
+            self.wait_epoch += 1
+        if self.wait_epoch > self.patience:
+            self.stopped_epoch = getattr(self, "_epoch", 0)
+            self.model.stop_training = True
+            if self.verbose:
+                print(f"Epoch {self.stopped_epoch}: early stopping.")
+
+    def on_train_end(self, logs=None):
+        # restore the best snapshot so the model ends at its best eval
+        if (self.save_best_model and self.best_weights is not None
+                and self.model is not None):
+            self.model.network.set_state_dict(self.best_weights)

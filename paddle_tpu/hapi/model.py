@@ -1,0 +1,345 @@
+"""hapi high-level API: Model.fit/evaluate/predict.
+
+Reference: python/paddle/hapi/model.py:1004 (Model), the reference's
+main user-facing training loop. TPU notes: each train step executes as
+cached-jit ops (the eager dispatch path), inputs move to device via the
+DataLoader's async device_put; metrics accumulate on host.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..metric import Metric
+from ..nn.layer.layers import Layer
+from . import callbacks as callbacks_mod
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _make_loader(data, batch_size, shuffle, drop_last, num_workers):
+    from ..io import DataLoader
+    if data is None or isinstance(data, DataLoader):
+        return data
+    if hasattr(data, "__getitem__") or hasattr(data, "__iter__"):
+        if isinstance(data, (list, tuple)) and len(data) and \
+                isinstance(data[0], np.ndarray):
+            # (x, y) arrays -> zip dataset
+            arrays = data
+            data = list(zip(*arrays))
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+    raise TypeError(f"unsupported data type {type(data)}")
+
+
+class Model:
+    """paddle.Model parity (reference: hapi/model.py:1004).
+
+    network: a Layer; inputs/labels: optional InputSpec lists used for
+    jit export in save(training=False).
+    """
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        if loss is not None and not (isinstance(loss, Layer)
+                                     or callable(loss)):
+            raise TypeError("loss must be a Layer or callable")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m} is not a paddle.metric.Metric")
+        return self
+
+    # -- single-batch APIs ---------------------------------------------------
+    def _forward(self, inputs):
+        outs = self.network(*inputs)
+        return outs
+
+    def _compute_loss(self, outputs, labels):
+        if self._loss is None:
+            return outputs if isinstance(outputs, Tensor) else outputs[0]
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        labs = labels if isinstance(labels, (list, tuple)) else [labels]
+        return self._loss(*outs, *labs)
+
+    def train_batch(self, inputs, labels=None, update=True,
+                    loss_scale=1.0):
+        """One optimization step; returns (loss, metrics-results) when
+        metrics are configured, else the loss float. loss_scale divides
+        the loss before backward (gradient accumulation averaging)."""
+        self.network.train()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        outputs = self._forward(inputs)
+        loss = self._compute_loss(outputs, labels)
+        lv = float(loss)
+        if loss_scale != 1.0:
+            loss = loss * loss_scale
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return ([lv], metrics) if self._metrics else [lv]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        outputs = self._forward(inputs)
+        loss = self._compute_loss(outputs, labels)
+        metrics = self._update_metrics(outputs, labels)
+        lv = float(loss)
+        return ([lv], metrics) if self._metrics else [lv]
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        outputs = self._forward(_to_list(inputs))
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        return [o.numpy() for o in outs]
+
+    def _update_metrics(self, outputs, labels):
+        results = []
+        out0 = outputs[0] if isinstance(outputs, (list, tuple)) else outputs
+        for m in self._metrics:
+            stats = m.compute(out0, *labels)
+            if not isinstance(stats, (list, tuple)):
+                stats = [stats]
+            r = m.update(*stats)
+            results.append(r)
+        return results
+
+    # -- loops ---------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1,
+            epochs=1, eval_freq=1, log_freq=10, save_dir=None,
+            save_freq=1, verbose=2, drop_last=False, shuffle=True,
+            num_workers=0, callbacks=None, accumulate_grad_batches=1,
+            num_iters=None):
+        """reference: hapi/model.py:1004 fit."""
+        assert train_data is not None, "train_data must be given"
+        train_loader = _make_loader(train_data, batch_size, shuffle,
+                                    drop_last, num_workers)
+        eval_loader = _make_loader(eval_data, batch_size, False, False,
+                                   num_workers)
+        steps = len(train_loader) if hasattr(train_loader, "__len__") \
+            else None
+        cbks = callbacks_mod.config_callbacks(
+            callbacks, model=self, batch_size=batch_size, epochs=epochs,
+            steps=steps, log_freq=log_freq, verbose=verbose,
+            save_freq=save_freq, save_dir=save_dir,
+            metrics=self._metrics_name())
+        self.stop_training = False
+        cbks.on_begin("train")
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            logs = self._run_one_epoch(train_loader, cbks, "train",
+                                       accumulate_grad_batches,
+                                       num_iters, log_freq=log_freq)
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and epoch % eval_freq == 0:
+                eval_logs = self._run_eval(eval_loader, cbks)
+        cbks.on_end("train", logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = _make_loader(eval_data, batch_size, False, False,
+                              num_workers)
+        cbks = callbacks_mod.config_callbacks(
+            callbacks, model=self, batch_size=batch_size,
+            log_freq=log_freq, verbose=verbose,
+            metrics=self._metrics_name())
+        logs = self._run_eval(loader, cbks, num_iters=num_iters)
+        return {k: v for k, v in logs.items() if k != "samples"}
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = _make_loader(test_data, batch_size, False, False,
+                              num_workers)
+        cbks = callbacks_mod.config_callbacks(
+            callbacks, model=self, batch_size=batch_size, verbose=verbose,
+            metrics=[])
+        cbks.on_begin("predict")
+        outputs = []
+        for step, batch in enumerate(loader):
+            batch = _to_list(batch)
+            if self._inputs and len(batch) >= len(self._inputs):
+                # input specs known: split by INPUT count (a multi-input
+                # network's extra inputs are not labels)
+                inputs = batch[:len(self._inputs)]
+            elif len(batch) > 1 and (self._loss or self._labels):
+                inputs = batch[:-max(len(self._labels), 1)]
+            else:
+                inputs = batch
+            cbks.on_batch_begin("predict", step, None)
+            outs = self.predict_batch(inputs)
+            outputs.append(outs)
+            cbks.on_batch_end("predict", step, None)
+        # [n_batches][n_outs] -> [n_outs][n_batches]
+        outputs = list(map(list, zip(*outputs))) if outputs else []
+        if stack_outputs:
+            outputs = [np.concatenate(o, axis=0) for o in outputs]
+        cbks.on_end("predict", None)
+        return outputs
+
+    def _split_batch(self, batch):
+        batch = _to_list(batch)
+        if len(batch) == 1:
+            return batch, []
+        n_lab = max(len(self._labels), 1)
+        return batch[:-n_lab], batch[-n_lab:]
+
+    def _run_one_epoch(self, loader, cbks, mode, acc_batches=1,
+                       num_iters=None, log_freq=10):
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        count = 0
+        pending_update = False
+        res = None
+        n = len(loader) if hasattr(loader, "__len__") else None
+        for step, batch in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            inputs, labels = self._split_batch(batch)
+            cbks.on_batch_begin(mode, step, logs)
+            update = (step + 1) % acc_batches == 0
+            res = self.train_batch(inputs, labels, update=update,
+                                   loss_scale=1.0 / acc_batches)
+            pending_update = not update
+            # metric accumulate() is host-side work (Auc walks its whole
+            # histogram) — only pay for it on steps that get logged
+            last = n is not None and step == n - 1
+            with_metrics = ((step + 1) % log_freq == 0 or last
+                            or self.stop_training)
+            logs = self._merge_logs(res, with_metrics=with_metrics,
+                                    prev=logs)
+            bs = (inputs[0].shape[0]
+                  if hasattr(inputs[0], "shape") else 1)
+            count += bs
+            cbks.on_batch_end(mode, step, logs)
+            if self.stop_training:
+                break
+        if pending_update:
+            # flush the trailing partial accumulation group so stale
+            # gradients never leak into the next epoch
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        if res is not None:
+            logs = self._merge_logs(res, with_metrics=True, prev=logs)
+        logs["samples"] = count
+        return logs
+
+    def _run_eval(self, loader, cbks, num_iters=None):
+        for m in self._metrics:
+            m.reset()
+        cbks.on_begin("eval", {"steps": len(loader)
+                               if hasattr(loader, "__len__") else None})
+        logs = {}
+        count = 0
+        res = None
+        for step, batch in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            inputs, labels = self._split_batch(batch)
+            cbks.on_batch_begin("eval", step, logs)
+            res = self.eval_batch(inputs, labels)
+            logs = self._merge_logs(res, with_metrics=False, prev=logs)
+            bs = (inputs[0].shape[0]
+                  if hasattr(inputs[0], "shape") else 1)
+            count += bs
+            cbks.on_batch_end("eval", step, logs)
+        if res is not None:
+            logs = self._merge_logs(res, with_metrics=True, prev=logs)
+        logs["samples"] = count
+        cbks.on_end("eval", logs)
+        return logs
+
+    def _merge_logs(self, res, with_metrics=True, prev=None):
+        logs = dict(prev or {})
+        if self._metrics:
+            losses, _ = res
+            logs["loss"] = losses[0]
+            if with_metrics:
+                for m in self._metrics:
+                    r = m.accumulate()
+                    names = m.name() if isinstance(m.name(), list) \
+                        else [m.name()]
+                    vals = r if isinstance(r, list) else [r]
+                    for n, v in zip(names, vals):
+                        logs[n] = v
+        else:
+            logs["loss"] = res[0]
+        return logs
+
+    def _metrics_name(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names += n if isinstance(n, list) else [n]
+        return names
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        """training=True: {path}.pdparams + {path}.pdopt; else a jit
+        export via paddle.jit.save when input specs are known
+        (reference: hapi/model.py save)."""
+        from ..framework import io as fio
+        if training:
+            fio.save(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None:
+                fio.save(self._optimizer.state_dict(), path + ".pdopt")
+        else:
+            from .. import jit
+            if not self._inputs:
+                raise ValueError(
+                    "save(training=False) needs Model(inputs=[InputSpec])")
+            jit.save(self.network, path, input_spec=self._inputs)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework import io as fio
+        state = fio.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fio.load(path + ".pdopt"))
+
+    # -- misc ----------------------------------------------------------------
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = 0
+        rows = []
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape))
+            n_params += n
+            rows.append(f"  {name:40s} {str(p.shape):20s} {n}")
+        text = "\n".join(
+            ["-" * 75] + rows + ["-" * 75,
+                                 f"Total params: {n_params}"])
+        print(text)
+        return {"total_params": n_params}

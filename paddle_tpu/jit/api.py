@@ -72,48 +72,10 @@ class InputSpec:
         return cls(tensor.shape, tensor.dtype, name or tensor.name)
 
 
-def _flatten(obj, tensors, path=()):
-    """Flatten a pytree, extracting Tensors into `tensors`; returns a spec
-    that _unflatten can rebuild with substituted leaves."""
-    if isinstance(obj, Tensor):
-        tensors.append(obj)
-        return ("T", len(tensors) - 1)
-    if isinstance(obj, dict):
-        return ("D", {k: _flatten(v, tensors) for k, v in obj.items()})
-    if isinstance(obj, (list, tuple)):
-        return ("L" if isinstance(obj, list) else "U",
-                [_flatten(v, tensors) for v in obj])
-    return ("X", obj)
-
-
-def _unflatten(spec, leaves):
-    kind, payload = spec
-    if kind == "T":
-        return leaves[payload]
-    if kind == "D":
-        return {k: _unflatten(v, leaves) for k, v in payload.items()}
-    if kind == "L":
-        return [_unflatten(v, leaves) for v in payload]
-    if kind == "U":
-        return tuple(_unflatten(v, leaves) for v in payload)
-    return payload
-
-
-def _static_key(spec):
-    """Hashable cache key for the non-tensor structure of the args."""
-    kind, payload = spec
-    if kind == "T":
-        return ("T",)
-    if kind == "D":
-        return ("D", tuple(sorted((k, _static_key(v))
-                                  for k, v in payload.items())))
-    if kind in ("L", "U"):
-        return (kind, tuple(_static_key(v) for v in payload))
-    try:
-        hash(payload)
-        return ("X", payload)
-    except TypeError:
-        return ("X", repr(payload))
+# shared Tensor-pytree helpers (also used by ops/control_flow.py)
+from ..core.pytree import (  # noqa: E402
+    flatten_tensors as _flatten, unflatten_tensors as _unflatten,
+    static_key as _static_key)
 
 
 class StaticFunction:

@@ -1,0 +1,222 @@
+"""paddle.metric parity: streaming eval metrics.
+
+Reference: python/paddle/metric/metrics.py (Metric base :33, Accuracy
+:187, Precision :338, Recall :468, Auc) — numpy accumulators on host,
+tensor `compute` stages that can run inside the compiled eval step.
+"""
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def _to_np(x):
+    if isinstance(x, Tensor):
+        return x.numpy()
+    return np.asarray(x)
+
+
+class Metric(metaclass=abc.ABCMeta):
+    """Base class (reference: metrics.py:33). Lifecycle:
+    compute(pred, label) -> per-batch tensor stats (device side),
+    update(stats) -> host accumulation, accumulate() -> scalar(s),
+    reset() between epochs."""
+
+    def __init__(self):
+        pass
+
+    @abc.abstractmethod
+    def reset(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def update(self, *args):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def accumulate(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        """Default: pass predictions/labels straight to update."""
+        return args
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (reference: metrics.py:187)."""
+
+    def __init__(self, topk=(1,), name=None, *args, **kwargs):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._init_name(name)
+        self.reset()
+
+    def _init_name(self, name):
+        name = name or "acc"
+        if self.maxk != 1:
+            self._name = [f"{name}_top{k}" for k in self.topk]
+        else:
+            self._name = [name]
+
+    def compute(self, pred, label, *args):
+        """pred: [N, C] scores; label: [N] or [N, 1] int, or [N, C]
+        one-hot. Returns [N, maxk] float 'correct' mask."""
+        pred_np = _to_np(pred)
+        label_np = _to_np(label)
+        if label_np.ndim == pred_np.ndim and label_np.shape[-1] > 1:
+            label_np = np.argmax(label_np, axis=-1)
+        label_np = label_np.reshape(label_np.shape[0], -1)[:, 0]
+        idx = np.argsort(-pred_np, axis=-1)[:, :self.maxk]   # [N, maxk]
+        correct = (idx == label_np[:, None]).astype("float32")
+        return correct
+
+    def update(self, correct, *args):
+        correct = _to_np(correct)
+        accs = []
+        for k in self.topk:
+            num = float(correct[:, :k].sum())
+            self.total[self.topk.index(k)] += num
+            self.count[self.topk.index(k)] += correct.shape[0]
+            accs.append(num / correct.shape[0])
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def accumulate(self):
+        res = [t / c if c > 0 else 0.0
+               for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        return self._name
+
+
+def _binary_preds(preds):
+    """[N], [N,1] sigmoid or [N,2] softmax -> positive-class prob [N]."""
+    preds = _to_np(preds).astype("float64")
+    if preds.ndim > 1 and preds.shape[-1] == 2:
+        return preds[:, 1]
+    return preds.reshape(-1)
+
+
+class Precision(Metric):
+    """Binary precision = tp / (tp + fp) (reference: metrics.py:338)."""
+
+    def __init__(self, name="precision", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _binary_preds(preds)
+        labels = _to_np(labels).reshape(-1)
+        pos = preds > 0.5
+        self.tp += int(np.sum(pos & (labels == 1)))
+        self.fp += int(np.sum(pos & (labels != 1)))
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        ap = self.tp + self.fp
+        return float(self.tp) / ap if ap != 0 else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """Binary recall = tp / (tp + fn) (reference: metrics.py:468)."""
+
+    def __init__(self, name="recall", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _binary_preds(preds)
+        labels = _to_np(labels).reshape(-1)
+        pos = preds > 0.5
+        self.tp += int(np.sum(pos & (labels == 1)))
+        self.fn += int(np.sum(~pos & (labels == 1)))
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        ar = self.tp + self.fn
+        return float(self.tp) / ar if ar != 0 else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """ROC AUC via threshold histogram (reference: metrics.py Auc;
+    same bucketed streaming algorithm as the auc op)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc",
+                 *args, **kwargs):
+        super().__init__()
+        self._curve = curve
+        self._num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _binary_preds(preds)
+        labels = _to_np(labels).reshape(-1)
+        buckets = np.clip((preds * self._num_thresholds).astype("int64"),
+                          0, self._num_thresholds)
+        pos = labels == 1
+        n = self._num_thresholds + 1
+        self._stat_pos += np.bincount(buckets[pos], minlength=n)
+        self._stat_neg += np.bincount(buckets[~pos], minlength=n)
+
+    def reset(self):
+        self._stat_pos = np.zeros(self._num_thresholds + 1, dtype="int64")
+        self._stat_neg = np.zeros(self._num_thresholds + 1, dtype="int64")
+
+    @staticmethod
+    def trapezoid_area(x1, x2, y1, y2):
+        return abs(x1 - x2) * (y1 + y2) / 2.0
+
+    def accumulate(self):
+        # vectorized trapezoid sum over descending thresholds
+        tp = np.cumsum(self._stat_pos[::-1].astype("float64"))
+        fp = np.cumsum(self._stat_neg[::-1].astype("float64"))
+        tot_pos, tot_neg = tp[-1], fp[-1]
+        if tot_pos == 0.0 or tot_neg == 0.0:
+            return 0.0
+        prev_tp = np.concatenate([[0.0], tp[:-1]])
+        prev_fp = np.concatenate([[0.0], fp[:-1]])
+        auc = float(np.sum((fp - prev_fp) * (tp + prev_tp) / 2.0))
+        return auc / tot_pos / tot_neg
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (reference:
+    python/paddle/metric/metrics.py accuracy op wrapper)."""
+    from ..ops import creation
+    pred = _to_np(input)
+    lab = _to_np(label).reshape(pred.shape[0], -1)[:, 0]
+    idx = np.argsort(-pred, axis=-1)[:, :k]
+    acc = float((idx == lab[:, None]).any(axis=1).mean())
+    return creation.to_tensor(np.asarray([acc], dtype="float32"))

@@ -17,6 +17,7 @@ from . import reduction
 from . import linalg
 from . import comparison
 from . import indexing
+from . import control_flow
 from ._helpers import as_tensor
 
 from .math import *  # noqa: F401,F403
@@ -25,6 +26,10 @@ from .manipulation import *  # noqa: F401,F403
 from .reduction import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .comparison import *  # noqa: F401,F403
+# control-flow cond deliberately shadows linalg.cond here (the condition
+# number stays at paddle.linalg.cond, matching the reference's namespacing)
+from .control_flow import (  # noqa: F401
+    cond, case, switch_case, while_loop, scan)
 
 # names that collide with builtins are fine inside this namespace (paddle
 # does the same: paddle.sum/max/min/all/any/abs/pow/round)

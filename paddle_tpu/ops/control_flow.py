@@ -1,0 +1,245 @@
+"""Data-dependent control flow: cond / while_loop / scan / switch_case.
+
+TPU-native replacement for the reference's structural control-flow ops
+(paddle/fluid/operators/controlflow/while_op.cc:86 WhileOp — runs a
+sub-Block via Executor per iteration; conditional_block_op.cc:43;
+Python builders python/paddle/fluid/layers/control_flow.py:1214
+while_loop, python/paddle/static/nn/control_flow.py:874 cond).
+
+Two execution regimes:
+- Eager: predicates are concrete, so `cond`/`case`/`switch_case` just
+  evaluate the chosen Python branch and `while_loop` runs a Python loop.
+  Every op inside lands on the autograd tape — grad-through-while works
+  exactly like the reference's dygraph control flow.
+- Under `jit.to_static` tracing (or any jax trace): predicates are
+  tracers; the same calls lower to `lax.cond` / `lax.while_loop` /
+  `lax.switch`, producing ONE compiled XLA program with native control
+  flow — no AST rewriting (the reference's dy2static machinery) needed.
+`scan` always lowers to `lax.scan` (differentiable in both regimes; the
+TPU-idiomatic replacement for the reference's static RNN / TensorArray
+loops at operators/controlflow/recurrent_op.cc).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply_op
+from ..core.dispatch import OpDef
+from ..core.pytree import (flatten_tensors as _flatten,
+                           unflatten_tensors as _unflatten)
+
+__all__ = ["cond", "case", "switch_case", "while_loop", "scan"]
+
+
+def _is_tracer(v):
+    return isinstance(v, jax.core.Tracer)
+
+
+def _wrap_branch(fn, operands_spec):
+    """(leaf-value list) -> (leaf-value tuple) adapter around a Python
+    branch fn taking/returning Tensors. Captures the output structure in
+    the returned state dict when traced (lax traces every branch, so it
+    is always populated before use)."""
+    state: dict = {}
+
+    def run(vals):
+        wrapped = [Tensor(v, stop_gradient=True) for v in vals]
+        args = _unflatten(operands_spec, wrapped)
+        out = fn(*args)
+        leaves: list[Tensor] = []
+        state["spec"] = _flatten(out, leaves)
+        return tuple(t._value for t in leaves)
+
+    return run, state
+
+
+def _pred_value(pred):
+    return pred._value if isinstance(pred, Tensor) else pred
+
+
+def _as_pred_tensor(pred):
+    return pred if isinstance(pred, Tensor) else Tensor(_pred_value(pred))
+
+
+def cond(pred, true_fn=None, false_fn=None, operands=(), name=None,
+         return_names=None):
+    """paddle.static.nn.cond parity. Eager: Python branch; traced:
+    lax.cond (both branches compiled into the program)."""
+    operands = tuple(operands)
+    pv = _pred_value(pred)
+    if not _is_tracer(pv):
+        return true_fn(*operands) if bool(pv) else false_fn(*operands)
+
+    leaves: list[Tensor] = []
+    op_spec = _flatten(list(operands), leaves)
+    true_run, t_state = _wrap_branch(true_fn, op_spec)
+    false_run, f_state = _wrap_branch(false_fn, op_spec)
+
+    def fwd(pred_val, *op_vals):
+        return jax.lax.cond(
+            jnp.asarray(pred_val).astype(bool).reshape(()),
+            lambda vs: true_run(list(vs)),
+            lambda vs: false_run(list(vs)),
+            tuple(op_vals))
+
+    out = apply_op(OpDef(f"cond::{getattr(true_fn, '__name__', 'fn')}",
+                         fwd), _as_pred_tensor(pred), *leaves)
+    outs = out if isinstance(out, tuple) else (out,)
+    spec = t_state.get("spec") or f_state.get("spec")
+    return _unflatten(spec, list(outs))
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """paddle.static.nn.case parity: first true predicate wins; default
+    (or the last branch) when none is true."""
+    pairs = list(pred_fn_pairs)
+    if not pairs:
+        raise ValueError("pred_fn_pairs must not be empty")
+    preds = [_pred_value(p) for p, _ in pairs]
+    if not any(_is_tracer(p) for p in preds):
+        for p, fn in pairs:
+            if bool(_pred_value(p)):
+                return fn()
+        return default() if default is not None else pairs[-1][1]()
+    # traced: chain of lax.cond
+    (p0, fn0), rest = pairs[0], pairs[1:]
+
+    def else_fn():
+        if rest:
+            return case(rest, default)
+        return default() if default is not None else fn0()
+
+    return cond(_as_pred_tensor(p0), lambda: fn0(), else_fn)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """paddle.static.nn.switch_case parity. Traced: lax.switch."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    iv = _pred_value(branch_index)
+    if not _is_tracer(iv):
+        table = dict(items)
+        i = int(iv)
+        if i in table:
+            return table[i]()
+        return default() if default is not None else items[-1][1]()
+
+    keys = [k for k, _ in items]
+    fns = [fn for _, fn in items]
+    if default is not None:
+        fns.append(default)
+    def_pos = len(fns) - 1  # unmatched -> default (or last branch)
+    runs, states = [], []
+    for fn in fns:
+        run, st = _wrap_branch(lambda _fn=fn: _fn(), ("L", []))
+        runs.append(lambda vs, _r=run: _r([]))
+        states.append(st)
+
+    def fwd(idx_val):
+        sel = jnp.full((), def_pos, dtype=jnp.int32)
+        for j, k in enumerate(keys):
+            sel = jnp.where(jnp.asarray(idx_val).reshape(()) == k, j, sel)
+        return jax.lax.switch(sel, runs, ())
+
+    out = apply_op(OpDef("switch_case", fwd),
+                   _as_pred_tensor(branch_index))
+    outs = out if isinstance(out, tuple) else (out,)
+    spec = next(s["spec"] for s in states if "spec" in s)
+    return _unflatten(spec, list(outs))
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop parity (reference:
+    python/paddle/fluid/layers/control_flow.py:1214).
+
+    Eager: Python loop — every iteration's ops are tape-recorded, so
+    gradients flow through the unrolled loop like the reference's
+    dygraph `while`. Traced: lax.while_loop (forward-only, matching
+    XLA's while semantics)."""
+    loop_vars = list(loop_vars)
+    leaves: list[Tensor] = []
+    spec = _flatten(loop_vars, leaves)
+
+    first = _pred_value(cond_fn(*loop_vars))
+    if not _is_tracer(first):
+        keep = bool(first)
+        while keep:
+            out = body_fn(*loop_vars)
+            loop_vars = list(out) if isinstance(out, (list, tuple)) \
+                else [out]
+            keep = bool(_pred_value(cond_fn(*loop_vars)))
+        return loop_vars
+
+    def fwd(*vals):
+        def c(vs):
+            wrapped = [Tensor(v, stop_gradient=True) for v in vs]
+            args = _unflatten(spec, wrapped)
+            return jnp.asarray(
+                _pred_value(cond_fn(*args))).astype(bool).reshape(())
+
+        def b(vs):
+            wrapped = [Tensor(v, stop_gradient=True) for v in vs]
+            args = _unflatten(spec, wrapped)
+            out = body_fn(*args)
+            out = list(out) if isinstance(out, (list, tuple)) else [out]
+            out_leaves: list[Tensor] = []
+            _flatten(out, out_leaves)
+            return tuple(t._value for t in out_leaves)
+
+        return jax.lax.while_loop(c, b, tuple(vals))
+
+    out = apply_op(OpDef("while_loop", fwd, nondiff=True), *leaves)
+    outs = out if isinstance(out, tuple) else (out,)
+    return _unflatten(spec, list(outs))
+
+
+def scan(fn, init, xs=None, length=None, reverse=False, name=None):
+    """lax.scan exposed at the paddle level — the TPU-idiomatic
+    replacement for the reference's static RNN (recurrent_op.cc) and
+    TensorArray loops. fn(carry, x) -> (carry, y). Differentiable in
+    both eager (tape backward runs the jax.vjp of the whole scan) and
+    traced modes. In eager mode only init/xs are differentiated inputs —
+    tensors merely closed over by fn are baked as constants; thread them
+    through the carry instead."""
+    carry_leaves: list[Tensor] = []
+    carry_spec = _flatten(init, carry_leaves)
+    xs_leaves: list[Tensor] = []
+    xs_spec = _flatten(xs, xs_leaves)
+    n_carry = len(carry_leaves)
+    state: dict = {}
+
+    def fwd(*vals):
+        c_vals = vals[:n_carry]
+        x_vals = vals[n_carry:]
+
+        def body(c, x):
+            cw = [Tensor(v, stop_gradient=True) for v in c]
+            xw = [Tensor(v, stop_gradient=True) for v in (x or ())]
+            carry = _unflatten(carry_spec, cw)
+            xarg = _unflatten(xs_spec, xw)
+            nc, y = fn(carry, xarg)
+            ncl: list[Tensor] = []
+            state["carry_spec"] = _flatten(nc, ncl)
+            yl: list[Tensor] = []
+            state["y_spec"] = _flatten(y, yl)
+            state["n_y"] = len(yl)
+            return (tuple(t._value for t in ncl),
+                    tuple(t._value for t in yl))
+
+        final, ys = jax.lax.scan(body, tuple(c_vals), tuple(x_vals)
+                                 if x_vals else None,
+                                 length=length, reverse=reverse)
+        return tuple(final) + tuple(ys)
+
+    out = apply_op(OpDef(f"scan::{getattr(fn, '__name__', 'fn')}", fwd),
+                   *carry_leaves, *xs_leaves)
+    outs = out if isinstance(out, tuple) else (out,)
+    n_final = len(outs) - state["n_y"]
+    final = _unflatten(state["carry_spec"], list(outs[:n_final]))
+    ys = _unflatten(state["y_spec"], list(outs[n_final:]))
+    return final, ys

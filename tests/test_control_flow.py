@@ -1,0 +1,185 @@
+"""Control-flow op tests: cond/while_loop/scan/case/switch_case in both
+eager (tape autograd) and to_static (lax lowering) regimes.
+
+Reference test model: unittests for while_loop/cond in
+python/paddle/fluid/tests/unittests/test_while_loop_op.py,
+test_cond.py — numpy-checked outputs plus grad-through-loop.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import jit
+from paddle_tpu.ops import cond, case, switch_case, while_loop, scan
+
+
+class TestCond:
+    def test_eager_branches(self):
+        x = paddle.to_tensor(np.float32(3.0))
+        y = paddle.to_tensor(np.float32(5.0))
+        out = cond(x < y, lambda: x + y, lambda: x - y)
+        assert float(out) == 8.0
+        out = cond(x > y, lambda: x + y, lambda: x - y)
+        assert float(out) == -2.0
+
+    def test_eager_grad_through_cond(self):
+        x = paddle.to_tensor(np.float32(3.0), stop_gradient=False)
+        out = cond(x < 10.0, lambda: x * x, lambda: x)
+        out.backward()
+        assert float(x.grad) == 6.0
+
+    def test_operands_and_structure(self):
+        a = paddle.to_tensor(np.float32(1.0))
+        b = paddle.to_tensor(np.float32(2.0))
+        outs = cond(a < b, lambda i, j: [i + j, i * j],
+                    lambda i, j: [i - j, i / j], operands=(a, b))
+        assert [float(o) for o in outs] == [3.0, 2.0]
+
+    def test_traced_cond_is_data_dependent(self):
+        @jit.to_static
+        def f(x):
+            return cond(paddle.mean(x) > 0.0,
+                        lambda: x * 2.0, lambda: x * -1.0)
+
+        pos = paddle.to_tensor(np.ones((3,), "float32"))
+        neg = paddle.to_tensor(-np.ones((3,), "float32"))
+        np.testing.assert_allclose(f(pos).numpy(), 2 * np.ones(3))
+        np.testing.assert_allclose(f(neg).numpy(), np.ones(3))
+
+    def test_traced_cond_grad(self):
+        lin = nn.Linear(4, 4)
+
+        @jit.to_static
+        def f(x):
+            return cond(paddle.mean(x) > 0.0,
+                        lambda: (lin(x) ** 2).mean(),
+                        lambda: lin(x).mean())
+
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        loss = f(x)
+        loss.backward()
+        assert lin.weight.grad is not None
+        assert np.isfinite(lin.weight.grad.numpy()).all()
+
+
+class TestWhileLoop:
+    def test_eager_loop(self):
+        i = paddle.to_tensor(np.int64(0))
+        s = paddle.to_tensor(np.float32(0.0))
+        i, s = while_loop(lambda i, s: i < 5,
+                          lambda i, s: [i + 1, s + float(2.0)], [i, s])
+        assert int(i) == 5
+        assert float(s) == 10.0
+
+    def test_eager_grad_through_while(self):
+        # s = x * 2^3: grad ds/dx = 8
+        x = paddle.to_tensor(np.float32(1.5), stop_gradient=False)
+        i = paddle.to_tensor(np.int64(0))
+        s = x
+
+        def body(i, s):
+            return [i + 1, s * 2.0]
+
+        i, s = while_loop(lambda i, s: i < 3, body, [i, s])
+        s.backward()
+        assert float(s) == 12.0
+        assert float(x.grad) == 8.0
+
+    def test_traced_while(self):
+        @jit.to_static
+        def f(n, x):
+            def c(i, acc):
+                return i < n
+
+            def b(i, acc):
+                return [i + 1, acc + x]
+
+            i0 = paddle.zeros([], dtype="int64")
+            return while_loop(c, b, [i0, paddle.zeros_like(x)])[1]
+
+        x = paddle.to_tensor(np.float32(2.5))
+        out = f(paddle.to_tensor(np.int64(4)), x)
+        assert float(out) == 10.0
+        # different trip count, same compiled program
+        out = f(paddle.to_tensor(np.int64(2)), x)
+        assert float(out) == 5.0
+
+
+class TestScan:
+    def test_scan_cumsum(self):
+        xs = paddle.to_tensor(np.arange(1, 6, dtype="float32"))
+        final, ys = scan(lambda c, x: (c + x, c + x),
+                         paddle.to_tensor(np.float32(0.0)), xs)
+        assert float(final) == 15.0
+        np.testing.assert_allclose(ys.numpy(), [1, 3, 6, 10, 15])
+
+    def test_scan_grad_eager(self):
+        # differentiated state must be threaded through init/xs (eager
+        # scan treats closed-over tensors as constants — documented)
+        x = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+        xs = paddle.to_tensor(np.ones((4,), "float32"))
+        one = paddle.to_tensor(np.float32(1.0))
+        # carry = (acc, x): acc_{t+1} = acc_t * x  => final acc = x^4,
+        # d/dx = 4 x^3 = 32
+        final, _ = scan(lambda c, s: ((c[0] * c[1] * s, c[1]), c[0]),
+                        (one, x), xs)
+        final[0].backward()
+        assert abs(float(x.grad) - 32.0) < 1e-5
+
+    def test_scan_traced(self):
+        @jit.to_static
+        def f(xs):
+            final, ys = scan(lambda c, x: (c + x, c),
+                             paddle.zeros([], dtype="float32"), xs)
+            return ys
+
+        xs = paddle.to_tensor(np.ones((3,), "float32"))
+        np.testing.assert_allclose(f(xs).numpy(), [0, 1, 2])
+
+
+class TestCaseSwitch:
+    def test_case_eager(self):
+        x = paddle.to_tensor(np.float32(0.3))
+        out = case([(x > 0.5, lambda: x * 10.0),
+                    (x > 0.2, lambda: x * 100.0)],
+                   default=lambda: x)
+        assert abs(float(out) - 30.0) < 1e-5
+
+    def test_switch_case_eager(self):
+        idx = paddle.to_tensor(np.int64(1))
+        out = switch_case(idx, {0: lambda: paddle.full([], 0.0),
+                                1: lambda: paddle.full([], 11.0)},
+                          default=lambda: paddle.full([], -1.0))
+        assert float(out) == 11.0
+        out = switch_case(paddle.to_tensor(np.int64(7)),
+                          {0: lambda: paddle.full([], 0.0),
+                           1: lambda: paddle.full([], 11.0)},
+                          default=lambda: paddle.full([], -1.0))
+        assert float(out) == -1.0
+
+    def test_switch_case_traced(self):
+        @jit.to_static
+        def f(idx, x):
+            return switch_case(
+                idx, {0: lambda: x + 1.0, 1: lambda: x * 10.0},
+                default=lambda: x * 0.0)
+
+        x = paddle.to_tensor(np.float32(3.0))
+        assert float(f(paddle.to_tensor(np.int64(0)), x)) == 4.0
+        assert float(f(paddle.to_tensor(np.int64(1)), x)) == 30.0
+        assert float(f(paddle.to_tensor(np.int64(9)), x)) == 0.0
+
+    def test_case_traced(self):
+        @jit.to_static
+        def f(x):
+            return case([(paddle.mean(x) > 1.0, lambda: x * 2.0),
+                         (paddle.mean(x) > 0.0, lambda: x * 3.0)],
+                        default=lambda: x * 0.0)
+
+        big = paddle.to_tensor(np.full((2,), 2.0, "float32"))
+        mid = paddle.to_tensor(np.full((2,), 0.5, "float32"))
+        neg = paddle.to_tensor(np.full((2,), -1.0, "float32"))
+        np.testing.assert_allclose(f(big).numpy(), [4.0, 4.0])
+        np.testing.assert_allclose(f(mid).numpy(), [1.5, 1.5])
+        np.testing.assert_allclose(f(neg).numpy(), [0.0, 0.0])

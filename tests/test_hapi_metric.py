@@ -1,0 +1,210 @@
+"""hapi Model.fit / paddle.metric tests.
+
+Mirrors the reference's hapi test strategy (python/paddle/tests/
+dist_hapi_mnist_dynamic.py, test_metrics.py) on synthetic data.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+from paddle_tpu.hapi.callbacks import EarlyStopping, ModelCheckpoint
+
+
+class BlobDataset(Dataset):
+    """Two gaussian blobs -> linearly separable 2-class problem."""
+
+    def __init__(self, n=256, d=16, seed=0):
+        rs = np.random.RandomState(seed)
+        half = n // 2
+        x0 = rs.randn(half, d).astype("float32") - 1.5
+        x1 = rs.randn(n - half, d).astype("float32") + 1.5
+        self.x = np.concatenate([x0, x1])
+        self.y = np.concatenate([np.zeros(half), np.ones(n - half)])
+        self.y = self.y.astype("int64")[:, None]
+        perm = rs.permutation(n)
+        self.x, self.y = self.x[perm], self.y[perm]
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _mlp(d=16, classes=2):
+    return nn.Sequential(nn.Linear(d, 32), nn.ReLU(),
+                         nn.Linear(32, classes))
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        m = Accuracy(topk=(1, 2))
+        pred = np.array([[0.1, 0.7, 0.2], [0.5, 0.4, 0.1]], "float32")
+        label = np.array([[1], [1]], "int64")
+        correct = m.compute(paddle.to_tensor(pred),
+                            paddle.to_tensor(label))
+        m.update(correct)
+        top1, top2 = m.accumulate()
+        assert abs(top1 - 0.5) < 1e-6   # only first sample top-1 right
+        assert abs(top2 - 1.0) < 1e-6   # both within top-2
+        assert m.name() == ["acc_top1", "acc_top2"]
+        m.reset()
+        assert m.accumulate() == [0.0, 0.0]
+
+    def test_precision_recall(self):
+        p, r = Precision(), Recall()
+        preds = np.array([0.9, 0.8, 0.2, 0.7], "float32")
+        labels = np.array([1, 0, 1, 1], "int64")
+        p.update(preds, labels)
+        r.update(preds, labels)
+        # predicted pos: {0.9, 0.8, 0.7} -> tp=2 fp=1; actual pos 3 -> fn=1
+        assert abs(p.accumulate() - 2 / 3) < 1e-6
+        assert abs(r.accumulate() - 2 / 3) < 1e-6
+
+    def test_auc_perfect_and_random(self):
+        m = Auc()
+        labels = np.array([1, 1, 0, 0], "int64")
+        m.update(np.array([0.9, 0.8, 0.2, 0.1], "float32"), labels)
+        assert abs(m.accumulate() - 1.0) < 1e-3
+        m.reset()
+        m.update(np.array([0.1, 0.2, 0.8, 0.9], "float32"), labels)
+        assert m.accumulate() < 0.01
+
+    def test_auc_two_column_preds(self):
+        m = Auc()
+        preds = np.array([[0.2, 0.8], [0.7, 0.3]], "float32")
+        m.update(preds, np.array([1, 0], "int64"))
+        assert abs(m.accumulate() - 1.0) < 1e-3
+
+
+class TestModelFit:
+    def test_fit_learns_and_evaluates(self):
+        paddle.seed(0)
+        model = paddle.Model(_mlp())
+        model.prepare(
+            optimizer=opt.Adam(learning_rate=1e-2,
+                               parameters=model.parameters()),
+            loss=nn.CrossEntropyLoss(),
+            metrics=Accuracy())
+        train = BlobDataset(256, seed=0)
+        test = BlobDataset(64, seed=1)
+        model.fit(train, epochs=3, batch_size=32, verbose=0)
+        res = model.evaluate(test, batch_size=32, verbose=0)
+        assert res["acc"] > 0.9, res
+        assert "loss" in res
+
+    def test_predict_stacked(self):
+        paddle.seed(0)
+        model = paddle.Model(_mlp())
+        model.prepare(loss=nn.CrossEntropyLoss())
+        test = BlobDataset(48, seed=2)
+        outs = model.predict(test, batch_size=16, stack_outputs=True,
+                             verbose=0)
+        assert len(outs) == 1
+        assert outs[0].shape == (48, 2)
+
+    def test_train_batch_returns_loss_and_metrics(self):
+        model = paddle.Model(_mlp())
+        model.prepare(
+            optimizer=opt.SGD(learning_rate=0.1,
+                              parameters=model.parameters()),
+            loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+        x = paddle.to_tensor(np.random.randn(8, 16).astype("float32"))
+        y = paddle.to_tensor(np.zeros((8, 1), "int64"))
+        (losses, metrics) = model.train_batch([x], [y])
+        assert np.isfinite(losses[0])
+        assert len(metrics) == 1
+
+    def test_save_load_roundtrip(self, tmp_path):
+        model = paddle.Model(_mlp())
+        model.prepare(
+            optimizer=opt.Adam(learning_rate=1e-3,
+                               parameters=model.parameters()),
+            loss=nn.CrossEntropyLoss())
+        path = str(tmp_path / "ckpt" / "model")
+        model.save(path)
+        assert os.path.exists(path + ".pdparams")
+        assert os.path.exists(path + ".pdopt")
+        w_before = model.network[0].weight.numpy().copy()
+        model.network[0].weight.set_value(
+            paddle.to_tensor(np.zeros_like(w_before)))
+        model.load(path)
+        np.testing.assert_allclose(model.network[0].weight.numpy(),
+                                   w_before)
+
+    def test_model_checkpoint_callback(self, tmp_path):
+        model = paddle.Model(_mlp())
+        model.prepare(
+            optimizer=opt.SGD(learning_rate=0.1,
+                              parameters=model.parameters()),
+            loss=nn.CrossEntropyLoss())
+        save_dir = str(tmp_path / "ckpts")
+        model.fit(BlobDataset(64), epochs=2, batch_size=32, verbose=0,
+                  save_dir=save_dir)
+        assert os.path.exists(os.path.join(save_dir, "0.pdparams"))
+        assert os.path.exists(os.path.join(save_dir, "final.pdparams"))
+
+    def test_early_stopping(self):
+        model = paddle.Model(_mlp())
+        model.prepare(
+            optimizer=opt.SGD(learning_rate=0.0,   # never improves
+                              parameters=model.parameters()),
+            loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+        es = EarlyStopping(monitor="loss", patience=0, verbose=0)
+        model.fit(BlobDataset(64), eval_data=BlobDataset(32, seed=3),
+                  epochs=10, batch_size=32, verbose=0, callbacks=[es])
+        assert model.stop_training
+
+    def test_lr_scheduler_steps_per_epoch(self):
+        from paddle_tpu.optimizer.lr import StepDecay
+        sched = StepDecay(learning_rate=0.1, step_size=1, gamma=0.5)
+        model = paddle.Model(_mlp())
+        model.prepare(
+            optimizer=opt.SGD(learning_rate=sched,
+                              parameters=model.parameters()),
+            loss=nn.CrossEntropyLoss())
+        model.fit(BlobDataset(64), epochs=3, batch_size=32, verbose=0)
+        # stepped once per epoch: 0.1 -> 0.05 -> 0.025 -> 0.0125
+        assert abs(sched() - 0.0125) < 1e-9
+
+    def test_early_stopping_restores_best_weights(self):
+        model = paddle.Model(_mlp())
+        model.prepare(
+            optimizer=opt.SGD(learning_rate=10.0,  # diverges after start
+                              parameters=model.parameters()),
+            loss=nn.CrossEntropyLoss())
+        es = EarlyStopping(monitor="loss", patience=1, verbose=0,
+                           save_best_model=True)
+        model.fit(BlobDataset(64), eval_data=BlobDataset(32, seed=3),
+                  epochs=6, batch_size=32, verbose=0, callbacks=[es])
+        assert es.best_weights is not None
+        # restored: current weights == best snapshot
+        w = model.network[0].weight.numpy()
+        np.testing.assert_allclose(
+            w, es.best_weights["0.weight"], rtol=1e-6)
+
+    def test_gradient_accumulation_matches_large_batch(self):
+        # two half-batches with accumulate_grad_batches=2 == one batch
+        x = np.random.RandomState(0).randn(8, 16).astype("float32")
+        y = np.zeros((8, 1), "int64")
+
+        def run(acc, bs):
+            paddle.seed(5)
+            m = paddle.Model(_mlp())
+            m.prepare(optimizer=opt.SGD(learning_rate=0.1,
+                                        parameters=m.parameters()),
+                      loss=nn.CrossEntropyLoss())
+            data = list(zip(x, y))
+            m.fit(data, epochs=1, batch_size=bs, verbose=0,
+                  shuffle=False, accumulate_grad_batches=acc)
+            return m.network[0].weight.numpy()
+
+        w_acc = run(2, 4)
+        w_big = run(1, 8)
+        np.testing.assert_allclose(w_acc, w_big, rtol=1e-4, atol=1e-6)
