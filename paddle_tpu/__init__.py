@@ -19,6 +19,23 @@ if _os.environ.get("PADDLE_TPU_FORCE_CPU_DEVICES"):
     import jax as _jx
     _jx.config.update("jax_platforms", "cpu")
 
+# Multi-process rendezvous must happen BEFORE any jax backend query (the
+# first device touch freezes the process-local backend). The launcher
+# (paddle_tpu.distributed.launch) sets this env; matching the reference's
+# import-time PADDLE_TRAINER_ID pickup in python/paddle/distributed/
+# parallel.py.
+if (_os.environ.get("PADDLE_MASTER")
+        and int(_os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1):
+    import jax as _jx2
+    # guard precisely against double-init; a rendezvous FAILURE must
+    # propagate (silently continuing single-host would train each rank
+    # independently with no gradient sync)
+    if not _jx2.distributed.is_initialized():
+        _jx2.distributed.initialize(
+            coordinator_address=_os.environ["PADDLE_MASTER"],
+            num_processes=int(_os.environ["PADDLE_TRAINERS_NUM"]),
+            process_id=int(_os.environ.get("PADDLE_TRAINER_ID", "0")))
+
 import jax as _jax  # noqa: E402
 
 # Paddle defaults integer tensors to int64 and supports float64; enable

@@ -55,11 +55,18 @@ class Place:
         return self.index
 
     def jax_device(self) -> jax.Device:
+        # LOCAL devices only: in a multi-process job jax.devices() spans
+        # all hosts and indexing it would hand back a non-addressable
+        # device (rank N putting its batch on rank 0's chip)
         if self.kind == "cpu":
-            return jax.devices("cpu")[0]
+            cpus = [d for d in jax.local_devices() if d.platform == "cpu"]
+            if not cpus:
+                cpus = jax.local_devices(backend="cpu")
+            return cpus[0]
         accel = _accelerator_devices()
         if not accel:
-            return jax.devices("cpu")[self.index % len(jax.devices("cpu"))]
+            cpus = [d for d in jax.local_devices() if d.platform == "cpu"]
+            return cpus[self.index % len(cpus)]
         return accel[self.index % len(accel)]
 
 
@@ -85,7 +92,7 @@ def CUDAPinnedPlace():
 
 
 def _accelerator_devices():
-    devs = jax.devices()
+    devs = jax.local_devices()
     if devs and devs[0].platform != "cpu":
         return devs
     return []

@@ -34,7 +34,7 @@ class ParallelEnv:
 
     @property
     def local_rank(self):
-        return self._rank
+        return int(os.getenv("PADDLE_LOCAL_RANK", str(self._rank)))
 
     @property
     def nranks(self):

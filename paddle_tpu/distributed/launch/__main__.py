@@ -1,0 +1,40 @@
+"""CLI: python -m paddle_tpu.distributed.launch [opts] script.py [args].
+
+Reference: python/paddle/distributed/launch/main.py:18 /
+__main__.py — same flag names where they still make sense on TPU
+(--nnodes, --nproc_per_node, --master, --log_dir); --devices and
+--gpus are accepted for compatibility and ignored (device assignment is
+PJRT's job on TPU hosts).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import launch
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch")
+    ap.add_argument("--nnodes", type=int, default=1)
+    ap.add_argument("--node_rank", type=int, default=0)
+    ap.add_argument("--nproc_per_node", type=int, default=1)
+    ap.add_argument("--master", default=None,
+                    help="coordinator host:port (default: auto local)")
+    ap.add_argument("--log_dir", default=None)
+    ap.add_argument("--devices", "--gpus", "--xpus", default=None,
+                    help="accepted for reference compatibility; ignored")
+    ap.add_argument("--job_id", default="default")
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    rc = launch(args.script, args.script_args,
+                nproc_per_node=args.nproc_per_node, nnodes=args.nnodes,
+                node_rank=args.node_rank, master=args.master,
+                log_dir=args.log_dir)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

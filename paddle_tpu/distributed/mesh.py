@@ -171,6 +171,26 @@ def _to_spec(placements, ndim, mesh):
     return PartitionSpec(*entries)
 
 
+def _mesh_put(val, sharding):
+    """device_put onto a (possibly multi-process) mesh sharding. When the
+    target spans processes and the source is process-local, route through
+    host memory: every process contributes its identical copy (the SPMD
+    invariant) — backends without cross-host eager transfers (CPU gloo)
+    cannot move local device buffers between hosts directly."""
+    if jax.process_count() > 1:
+        if isinstance(val, jax.Array):
+            sh = getattr(val, "sharding", None)
+            if getattr(sh, "mesh", None) == sharding.mesh:
+                return val if sh.spec == sharding.spec \
+                    else jax.device_put(val, sharding)
+            if not val.is_fully_addressable:
+                return jax.device_put(val, sharding)
+        host = np.asarray(val)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx])
+    return jax.device_put(val, sharding)
+
+
 def shard_tensor(x, mesh=None, placements=None, spec=None,
                  stop_gradient=None):
     """paddle.distributed.shard_tensor parity (reference:
@@ -183,7 +203,7 @@ def shard_tensor(x, mesh=None, placements=None, spec=None,
     if spec is None:
         spec = _to_spec(placements or [], x.ndim, mesh)
     sharding = NamedSharding(mesh.jax_mesh, spec)
-    new_val = jax.device_put(x._value, sharding)
+    new_val = _mesh_put(x._value, sharding)
     if isinstance(x, Tensor):
         x._rebind(new_val)
         if stop_gradient is not None:
@@ -270,7 +290,7 @@ def _harmonize_vals(vals):
     if all(on_mesh) or not any(on_mesh):
         return vals
     rep = NamedSharding(jm, PartitionSpec())
-    return tuple(v if ok else jax.device_put(v, rep)
+    return tuple(v if ok else _mesh_put(v, rep)
                  for v, ok in zip(vals, on_mesh))
 
 

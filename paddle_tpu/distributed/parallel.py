@@ -32,7 +32,8 @@ def init_parallel_env():
     if collective.is_initialized():
         return ParallelEnv()
     env = ParallelEnv()
-    if env.world_size > 1 and os.getenv("PADDLE_MASTER"):
+    if (env.world_size > 1 and os.getenv("PADDLE_MASTER")
+            and not jax.distributed.is_initialized()):
         jax.distributed.initialize(
             coordinator_address=os.getenv("PADDLE_MASTER"),
             num_processes=env.world_size, process_id=env.rank)
@@ -44,14 +45,30 @@ def init_parallel_env():
 
 def shard_batch(x, mesh=None, axis="dp", batch_dim=0):
     """Shard a host batch over the data axis — the loader-side half of
-    data parallelism (replaces per-rank DistributedBatchSampler feeds
-    when one controller loads the global batch)."""
+    data parallelism.
+
+    Single-host: x is the GLOBAL batch; one controller shards it.
+    Multi-host (jax.process_count() > 1): x is this process's LOCAL
+    shard (the per-rank DistributedBatchSampler feed) and is assembled
+    into a global array over the mesh — the TPU analogue of the
+    reference's per-trainer feed (test_dist_base.py trainer feeds)."""
     mesh = mesh or get_mesh()
     if mesh is None or axis not in mesh.dim_names \
             or mesh.get_dim_size(axis) == 1:
         return x
     entries = [None] * x.ndim
     entries[batch_dim] = axis
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        val = x._value if isinstance(x, Tensor) else np.asarray(x)
+        if isinstance(val, jax.Array) and not val.is_fully_addressable:
+            return x  # already a global array — idempotent
+        garr = multihost_utils.host_local_array_to_global_array(
+            np.asarray(val), mesh.jax_mesh, P(*entries))
+        if isinstance(x, Tensor):
+            x._rebind(garr)
+            return x
+        return Tensor(garr)
     return shard_tensor(x, mesh, spec=P(*entries))
 
 

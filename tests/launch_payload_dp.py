@@ -1,0 +1,64 @@
+"""Launcher payload: 2-process data-parallel SGD step, rank 0 writes the
+updated weight so the pytest harness can compare against the
+single-process result (reference test model: test_dist_base.py's
+trainer-vs-local loss comparison)."""
+import os
+import re
+import sys
+
+# one CPU device per process BEFORE jax/paddle import (strip any
+# inherited virtual-device flag, e.g. from the pytest conftest)
+os.environ["XLA_FLAGS"] = re.sub(
+    r"--xla_force_host_platform_device_count=\d+", "",
+    os.environ.get("XLA_FLAGS", "")).strip()
+os.environ["PADDLE_TPU_FORCE_CPU_DEVICES"] = "1"
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.optimizer as opt  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.io import DistributedBatchSampler  # noqa: E402
+
+out_path = sys.argv[1]
+
+env = dist.init_parallel_env()
+import jax  # noqa: E402
+assert env.world_size == 2, env.world_size
+assert jax.process_count() == 2
+assert jax.device_count() == 2
+
+# deterministic global data, identical on every rank
+xs = (np.arange(32, dtype="float32").reshape(8, 4) / 10.0) - 1.0
+ys = (xs.sum(1, keepdims=True) * 0.5 + 0.25).astype("float32")
+
+
+class DS:
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        return xs[i], ys[i]
+
+
+sampler = DistributedBatchSampler(DS(), batch_size=4, shuffle=False)
+idx = next(iter(sampler))
+xb_local, yb_local = xs[idx], ys[idx]
+
+paddle.seed(0)
+model = nn.Linear(4, 1)
+optimizer = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+
+xb = dist.shard_batch(paddle.to_tensor(xb_local))
+yb = dist.shard_batch(paddle.to_tensor(yb_local))
+loss = ((model(xb) - yb) ** 2).mean()
+loss.backward()
+optimizer.step()
+
+lv = float(loss)
+w = model.weight.numpy()
+b = model.bias.numpy()
+if env.rank == 0:
+    np.savez(out_path, w=w, b=b, loss=lv)
+print(f"rank {env.rank}: loss={lv:.6f} OK", flush=True)

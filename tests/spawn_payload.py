@@ -1,0 +1,17 @@
+"""Worker for distributed.spawn test (must be an importable module for
+the multiprocessing spawn context to pickle by reference).
+
+Platform env (CPU forcing) must be injected via spawn(envs=...) — by the
+time this function runs, paddle_tpu was already imported to unpickle the
+spawn target, and the distributed bootstrap happened at that import.
+"""
+import os
+
+
+def worker(out_dir):
+    import paddle_tpu.distributed as dist
+    env = dist.init_parallel_env()
+    import jax
+    with open(os.path.join(out_dir, f"rank{env.rank}.txt"), "w") as f:
+        f.write(f"{env.rank},{env.world_size},{jax.process_count()},"
+                f"{jax.device_count()}")
