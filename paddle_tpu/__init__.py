@@ -95,6 +95,7 @@ from .distributed.parallel import DataParallel  # noqa: E402,F401
 from .regularizer import L1Decay, L2Decay  # noqa: E402,F401
 from .nn.layer.layers import ParamAttr  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
+from . import profiler  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401
 from .hapi import Model  # noqa: E402,F401
 from .hapi import callbacks  # noqa: E402,F401

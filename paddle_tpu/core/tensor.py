@@ -52,6 +52,13 @@ _amp_hook = None
 # can mix fresh host tensors with mesh-sharded parameters
 _mesh_hook = None
 
+# set by paddle_tpu.profiler.Profiler.start() (None while no profiler is
+# live, so un-profiled programs skip the hook entirely): op_name ->
+# RecordEvent span or None. Spans measure host dispatch time; device time
+# comes from the XLA trace the profiler captures alongside.
+_profile_hook = None
+_NULL_SPAN = __import__("contextlib").nullcontext()
+
 
 def is_grad_enabled():
     return _tape.grad_enabled
@@ -142,14 +149,21 @@ class GradNode:
             merged = _mesh_hook(tuple(self.saved_inputs) + full_cts)
             self.saved_inputs = merged[:n_in]
             full_cts = merged[n_in:]
-        if self.op.bwd is not None:
-            from .dispatch import get_custom_bwd
-            fn = get_custom_bwd(self.op, self.attrs)
-            grads = fn(self.saved_inputs, self.saved_outputs, full_cts)
-            return [grads[i] for i in self.diff_in]
-        fn = get_vjp(self.op.fwd, self.attrs, self.diff_in, self.diff_out,
-                     self.single)
-        return list(fn(self.saved_inputs, full_cts))
+        def run():
+            if self.op.bwd is not None:
+                from .dispatch import get_custom_bwd
+                fn = get_custom_bwd(self.op, self.attrs)
+                grads = fn(self.saved_inputs, self.saved_outputs,
+                           full_cts)
+                return [grads[i] for i in self.diff_in]
+            fn = get_vjp(self.op.fwd, self.attrs, self.diff_in,
+                         self.diff_out, self.single)
+            return list(fn(self.saved_inputs, full_cts))
+
+        if _profile_hook is None:
+            return run()
+        with _profile_hook(f"{self.op.name}_grad") or _NULL_SPAN:
+            return run()
 
     def release(self):
         self.saved_inputs = None
@@ -404,7 +418,11 @@ def apply_op(op_name: str, *tensors, attrs: Optional[dict] = None,
     if _mesh_hook is not None:
         vals = _mesh_hook(vals)
     fn = get_jitted(op.fwd, attrs)
-    out = fn(*vals)
+    if _profile_hook is None:
+        out = fn(*vals)
+    else:
+        with _profile_hook(op.name) or _NULL_SPAN:
+            out = fn(*vals)
     single = not isinstance(out, (tuple, list))
     outs = (out,) if single else tuple(out)
 

@@ -1,0 +1,150 @@
+"""Profiler tests (reference test model: unittests/test_profiler.py,
+test_newprofiler.py — state scheduling, chrome trace export, summary)."""
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import profiler
+from paddle_tpu.profiler import (Profiler, ProfilerState, ProfilerTarget,
+                                 RecordEvent, make_scheduler,
+                                 export_chrome_tracing)
+
+
+def _work():
+    x = paddle.to_tensor(np.random.randn(16, 16).astype("float32"))
+    y = paddle.matmul(x, x)
+    return float(y.sum())
+
+
+class TestRecordEvent:
+    def test_spans_recorded_only_while_active(self):
+        p = Profiler(targets=[ProfilerTarget.CPU])
+        with RecordEvent("outside"):
+            pass  # no active profiler: dropped
+        with p:
+            with RecordEvent("user_span"):
+                _work()
+        names = [e[0] for e in p._all_events]
+        assert "user_span" in names
+        assert "outside" not in names
+
+    def test_op_dispatch_spans(self):
+        with Profiler(targets=[ProfilerTarget.CPU]) as p:
+            _work()
+        names = {e[0] for e in p._all_events}
+        assert any(n.startswith("op::") for n in names), names
+        assert any("matmul" in n for n in names), names
+
+
+class TestScheduler:
+    def test_make_scheduler_states(self):
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+        states = [sched(i) for i in range(6)]
+        assert states[0] == ProfilerState.CLOSED
+        assert states[1] == ProfilerState.READY
+        assert states[2] == ProfilerState.RECORD
+        assert states[3] == ProfilerState.RECORD_AND_RETURN
+        assert states[4] == ProfilerState.CLOSED  # repeat exhausted
+
+    def test_profiler_records_scheduled_window_only(self):
+        p = Profiler(targets=[ProfilerTarget.CPU], scheduler=(2, 4))
+        p.start()
+        counts = []
+        for _ in range(6):
+            before = len(p._events)
+            _work()
+            counts.append(len(p._events) - before)
+            p.step()
+        p.stop()
+        assert sum(counts[:2]) == 0      # steps 0-1 closed
+        assert sum(counts[2:4]) > 0      # steps 2-3 recorded
+        assert sum(counts[4:]) == 0      # stopped after window
+
+
+class TestExport:
+    def test_chrome_trace_openable(self, tmp_path):
+        with Profiler(targets=[ProfilerTarget.CPU]) as p:
+            with RecordEvent("step"):
+                _work()
+        path = str(tmp_path / "trace.json")
+        p.export(path)
+        with open(path) as f:
+            trace = json.load(f)
+        assert "traceEvents" in trace
+        evs = trace["traceEvents"]
+        assert len(evs) >= 2
+        for e in evs:
+            assert e["ph"] == "X"
+            assert e["dur"] >= 0
+            assert isinstance(e["ts"], float)
+        assert any(e["name"] == "step" for e in evs)
+
+    def test_on_trace_ready_handler(self, tmp_path):
+        d = str(tmp_path / "traces")
+        with Profiler(targets=[ProfilerTarget.CPU],
+                      on_trace_ready=export_chrome_tracing(d)) as p:
+            _work()
+        files = os.listdir(d)
+        assert len(files) == 1
+        assert files[0].endswith(".paddle_trace.json")
+        loaded = profiler.load_profiler_result(os.path.join(d, files[0]))
+        assert loaded["traceEvents"]
+
+    def test_summary_table(self):
+        with Profiler(targets=[ProfilerTarget.CPU]) as p:
+            for _ in range(3):
+                _work()
+        text = p.summary()
+        assert "Calls" in text
+        agg = p.aggregate()
+        mm = [v for k, v in agg.items() if "matmul" in k]
+        assert mm and mm[0]["calls"] >= 3
+
+    def test_step_info_timer_only(self):
+        p = Profiler(timer_only=True, targets=[ProfilerTarget.CPU])
+        p.start()
+        _work()
+        p.step(num_samples=16)
+        info = p.step_info()
+        p.stop()
+        assert "batch_cost" in info and "ips" in info
+        assert not p._events  # timer_only records no spans
+
+    def test_per_window_trace_files(self, tmp_path):
+        d = str(tmp_path / "windows")
+        sched = make_scheduler(closed=1, ready=0, record=1, repeat=2)
+        p = Profiler(targets=[ProfilerTarget.CPU], scheduler=sched,
+                     on_trace_ready=export_chrome_tracing(d))
+        p.start()
+        for _ in range(4):
+            _work()
+            p.step()
+        p.stop()
+        # two record windows -> two trace files (reference: one per
+        # RECORD_AND_RETURN boundary), events not duplicated across them
+        files = sorted(os.listdir(d))
+        assert len(files) == 2, files
+        n0 = len(json.load(open(os.path.join(d, files[0])))["traceEvents"])
+        n1 = len(json.load(open(os.path.join(d, files[1])))["traceEvents"])
+        assert n0 > 0 and n1 > 0
+
+    def test_restart_resets_state(self):
+        p = Profiler(targets=[ProfilerTarget.CPU])
+        with p:
+            _work()
+        first = len(p._all_events)
+        assert first > 0
+        with p:
+            _work()
+        # no duplication of run A into run B
+        assert len(p._all_events) <= first + 2
+
+    def test_timer_only_records_no_user_spans(self):
+        p = Profiler(timer_only=True, targets=[ProfilerTarget.CPU])
+        with p:
+            with RecordEvent("fwd"):
+                _work()
+        assert not p._events and not p._all_events
