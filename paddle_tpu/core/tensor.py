@@ -20,6 +20,7 @@ Design notes vs the reference:
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Any, Optional, Sequence
 
@@ -57,7 +58,7 @@ _mesh_hook = None
 # RecordEvent span or None. Spans measure host dispatch time; device time
 # comes from the XLA trace the profiler captures alongside.
 _profile_hook = None
-_NULL_SPAN = __import__("contextlib").nullcontext()
+_NULL_SPAN = contextlib.nullcontext()
 
 
 def is_grad_enabled():
@@ -160,9 +161,10 @@ class GradNode:
                          self.diff_out, self.single)
             return list(fn(self.saved_inputs, full_cts))
 
-        if _profile_hook is None:
+        hook = _profile_hook  # read once: a concurrent Profiler.stop()
+        if hook is None:      # may null the global mid-dispatch
             return run()
-        with _profile_hook(f"{self.op.name}_grad") or _NULL_SPAN:
+        with hook(f"{self.op.name}_grad") or _NULL_SPAN:
             return run()
 
     def release(self):
@@ -175,7 +177,7 @@ class Tensor:
 
     __slots__ = ("_value", "stop_gradient", "grad", "_grad_node", "_out_slot",
                  "name", "persistable", "is_leaf_", "_retain_grad", "_hooks",
-                 "__weakref__")
+                 "_grad_spec", "__weakref__")
 
     _iid = [0]
 
@@ -418,10 +420,11 @@ def apply_op(op_name: str, *tensors, attrs: Optional[dict] = None,
     if _mesh_hook is not None:
         vals = _mesh_hook(vals)
     fn = get_jitted(op.fwd, attrs)
-    if _profile_hook is None:
+    hook = _profile_hook  # read once (concurrent stop() nulls the global)
+    if hook is None:
         out = fn(*vals)
     else:
-        with _profile_hook(op.name) or _NULL_SPAN:
+        with hook(op.name) or _NULL_SPAN:
             out = fn(*vals)
     single = not isinstance(out, (tuple, list))
     outs = (out,) if single else tuple(out)
@@ -512,6 +515,13 @@ def run_backward(tensors: Sequence[Tensor], grad_tensors=None,
         if id(t) in wanted:
             collected[id(t)] = (collected[id(t)] + g) if id(t) in collected else g
         if accumulate_into_leaves and (as_leaf or t._retain_grad):
+            gs = getattr(t, "_grad_spec", None)
+            if gs is not None:
+                # ZeRO stage-2 contract (sharding.py): the leaf grad
+                # materializes SHARDED — each device keeps only its
+                # 1/n slice, the eager analogue of the reference's
+                # reduce-scatter (group_sharded_stage2.py:46)
+                g = gs(g)
             if t.grad is None:
                 t.grad = Tensor(g)
             else:

@@ -26,7 +26,8 @@ from ..core.tensor import Tensor
 from .mesh import get_mesh, shard_tensor
 
 __all__ = ["group_sharded_parallel", "save_group_sharded_model",
-           "shard_optimizer_states", "shard_parameters"]
+           "shard_optimizer_states", "shard_parameters",
+           "shard_gradients"]
 
 
 def _shard_axis_available(axis):
@@ -54,6 +55,25 @@ def shard_parameters(model, axis="sharding"):
     for p in model.parameters():
         spec = _spec_for(tuple(p.shape), axis)
         shard_tensor(p, spec=spec)
+    return model
+
+
+def shard_gradients(model, axis="sharding"):
+    """ZeRO stage-2: leaf gradients MATERIALIZE sharded over the axis —
+    the tape places each parameter grad onto its 1/n slice the moment it
+    is accumulated (core/tensor.py deposit), the eager analogue of the
+    reference's explicit reduce-scatter bookkeeping
+    (group_sharded_stage2.py:46). Per-device grad memory is
+    grad_bytes/n, verified by TestZeroMemoryScaling."""
+    if not _shard_axis_available(axis):
+        return model
+    mesh = get_mesh()
+    for p in model.parameters():
+        spec = _spec_for(tuple(p.shape), axis)
+        if spec == P():
+            continue
+        sh = NamedSharding(mesh.jax_mesh, spec)
+        p._grad_spec = (lambda g, _sh=sh: jax.device_put(g, _sh))
     return model
 
 
@@ -93,10 +113,13 @@ def group_sharded_parallel(model, optimizer, level, scaler=None,
     if offload:
         raise NotImplementedError(
             "CPU offload: planned (jax host_offload memories)")
+    # params must live on the same mesh the sharded states live on (the
+    # fused update consumes both in one program); stage 3 re-shards them
+    from .parallel import _place_model_on_mesh
+    _place_model_on_mesh(model)
     shard_optimizer_states(optimizer)
     if level in ("os_g", "p_g_os"):
-        # grads follow param sharding decisions made by XLA once states
-        # are sharded; stage-3 additionally shards the live params:
+        shard_gradients(model)
         if level == "p_g_os":
             shard_parameters(model)
     if scaler is not None:

@@ -16,6 +16,7 @@ from collections import OrderedDict
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding as _NamedSharding
 
 from ..core.tensor import Tensor, Parameter
 from ..core import dtype as dtypes
@@ -195,6 +196,16 @@ class Optimizer:
                                                  self._gstate, lr)
         self._gstate = new_g
         for p, nv, ns in zip(params, new_p, new_s):
+            # keep each param's pre-step MESH layout: XLA propagates the
+            # sharded ZeRO state layout to the update's outputs, but the
+            # live weight layout is a stage-3-only decision. Restoring it
+            # IS the ZeRO param all-gather (stages 1-2 re-replicate).
+            # Single-device params are left free to unify onto the mesh
+            # (mixed-placement models promote on first step).
+            old_sh = getattr(p._value, "sharding", None)
+            if isinstance(old_sh, _NamedSharding) and \
+                    getattr(nv, "sharding", None) != old_sh:
+                nv = jax.device_put(nv, old_sh)
             p._rebind(nv)
             self._accumulators[id(p)] = ns
 

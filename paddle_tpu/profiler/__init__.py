@@ -63,11 +63,14 @@ class SummaryView(Enum):
     UDFView = 8
 
 
-_state = threading.local()
+# process-global: ops and RecordEvent spans on ANY thread (dataloader
+# prefetch workers, etc.) record into the live profiler — events carry
+# their tid, and list.append is GIL-atomic
+_active: dict = {"profiler": None}
 
 
 def _active_profiler():
-    return getattr(_state, "profiler", None)
+    return _active["profiler"]
 
 
 def _now_ns():
@@ -194,7 +197,8 @@ class Profiler:
         self._all_events = []
         self._step = 0
         self._step_times = []
-        _state.profiler = self
+        self._device_trace_dir = None  # stale dir from a previous run
+        _active["profiler"] = self
         # the dispatch hook is installed only while a profiler is live so
         # un-profiled programs pay nothing on the op hot path
         from ..core import tensor as tensor_mod
@@ -209,7 +213,7 @@ class Profiler:
         self._recording = False
         self.current_state = ProfilerState.CLOSED
         if _active_profiler() is self:
-            _state.profiler = None
+            _active["profiler"] = None
             from ..core import tensor as tensor_mod
             tensor_mod._profile_hook = None
         self._flush_window()
@@ -335,7 +339,11 @@ class Profiler:
         profiler_statistic.py)."""
         unit = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}[time_unit]
         agg = self.aggregate()
-        rows = sorted(agg.items(), key=lambda kv: -kv[1]["total"])
+        sort_field = {
+            SortedKeys.CPUTotal: "total", SortedKeys.CPUAvg: "avg",
+            SortedKeys.CPUMax: "max", SortedKeys.CPUMin: "min",
+        }.get(sorted_by, "total")
+        rows = sorted(agg.items(), key=lambda kv: -kv[1][sort_field])
         lines = [f"{'Name':45s} {'Calls':>7s} {'Total(' + time_unit + ')':>12s}"
                  f" {'Avg(' + time_unit + ')':>12s} {'Max(' + time_unit + ')':>12s}"]
         lines.append("-" * 92)

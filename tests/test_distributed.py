@@ -613,3 +613,103 @@ class TestGates:
         y1 = moe(x).numpy()
         y2 = moe(x).numpy()
         np.testing.assert_allclose(y1, y2, rtol=1e-6)
+
+
+@pytest.fixture()
+def shard8_hcg():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"sharding_degree": 8}
+    fleet.init(is_collective=True, strategy=strategy)
+    return fleet.get_hybrid_communicate_group()
+
+
+def _per_device_nbytes(arr):
+    shards = arr.addressable_shards
+    sizes = {s.data.nbytes for s in shards}
+    assert len(sizes) == 1, "uneven shards"
+    return sizes.pop()
+
+
+class TestZeroMemoryScaling:
+    """VERDICT round-1 item 10: measure per-device live bytes across
+    ZeRO stages on the 8-device mesh and assert the ~1/n scaling the
+    reference achieves by explicit partitioning
+    (group_sharded_optimizer_stage2.py:53, stage3.py:61)."""
+
+    def _train_once(self, level):
+        model = nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
+                              nn.Linear(128, 64))
+        o = opt.Adam(learning_rate=1e-3,
+                     parameters=model.parameters())
+        out = dist.group_sharded_parallel(model, o, level)
+        model, o = out[0], out[1]
+        x = paddle.to_tensor(_randn(8, 64))
+        y = paddle.to_tensor(_randn(8, 64))
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        o.step()
+        return model, o, float(loss)
+
+    def test_stage1_optimizer_states_one_eighth(self, shard8_hcg):
+        model, o, loss = self._train_once("os")
+        assert np.isfinite(loss)
+        checked = 0
+        for st in o._accumulators.values():
+            for name, arr in st.items():
+                if arr.size < 8:
+                    continue  # beta-pow scalars stay replicated
+                assert _per_device_nbytes(arr) == arr.nbytes // 8, name
+                checked += 1
+        assert checked >= 4  # both moments for both weight matrices
+        # params NOT sharded at stage 1
+        for p in model.parameters():
+            assert _per_device_nbytes(p._value) == p._value.nbytes
+
+    def test_stage2_grads_one_eighth(self, shard8_hcg):
+        model, o, _ = self._train_once("os_g")
+        checked = 0
+        for p in model.parameters():
+            g = p.grad._value
+            if g.size < 8:
+                continue
+            spec = g.sharding.spec
+            assert any(ax == "sharding" for ax in spec if ax), spec
+            assert _per_device_nbytes(g) == g.nbytes // 8
+            checked += 1
+        assert checked >= 2
+
+    def test_stage3_params_one_eighth(self, shard8_hcg):
+        model, o, _ = self._train_once("p_g_os")
+        checked = 0
+        for p in model.parameters():
+            if p._value.size < 8:
+                continue
+            assert _per_device_nbytes(p._value) == p._value.nbytes // 8
+            checked += 1
+        assert checked >= 2
+
+    def test_per_device_total_shrinks_with_stage(self, shard8_hcg):
+        def total(level):
+            model, o, _ = self._train_once(level)
+            n = 0
+            for p in model.parameters():
+                n += _per_device_nbytes(p._value)
+                if p.grad is not None:
+                    n += _per_device_nbytes(p.grad._value)
+            for st in o._accumulators.values():
+                for arr in st.values():
+                    n += _per_device_nbytes(arr)
+            return n
+
+        t1, t2, t3 = total("os"), total("os_g"), total("p_g_os")
+        assert t2 < t1 * 0.8, (t1, t2)     # grads now 1/8
+        assert t3 < t2 * 0.7, (t2, t3)     # params too
+
+    def test_stage_parity_with_dense(self, shard8_hcg):
+        # numerics must not change with sharding level
+        losses = {}
+        for level in ("os", "os_g", "p_g_os"):
+            paddle.seed(3)
+            _, _, losses[level] = self._train_once(level)
+        assert abs(losses["os"] - losses["os_g"]) < 1e-5
+        assert abs(losses["os"] - losses["p_g_os"]) < 1e-5
