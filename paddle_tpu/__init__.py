@@ -96,6 +96,8 @@ from .regularizer import L1Decay, L2Decay  # noqa: E402,F401
 from .nn.layer.layers import ParamAttr  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
+from . import static  # noqa: E402,F401
+from .static import enable_static, disable_static  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401
 from .hapi import Model  # noqa: E402,F401
 from .hapi import callbacks  # noqa: E402,F401
@@ -119,22 +121,11 @@ def is_grad_enabled_():
 
 def in_dynamic_mode():
     from .jit.api import in_to_static_trace
-    return not in_to_static_trace()
+    return not (static.in_static_mode() or in_to_static_trace())
 
 
 def in_dygraph_mode():
     return in_dynamic_mode()
-
-
-def disable_static(place=None):
-    return None
-
-
-def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu has no ProgramDesc static mode; use paddle_tpu.jit."
-        "to_static, which compiles whole programs through XLA (the TPU-"
-        "native equivalent of the reference's static graph executor).")
 
 
 def get_flags(flags):

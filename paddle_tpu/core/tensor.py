@@ -60,6 +60,12 @@ _mesh_hook = None
 _profile_hook = None
 _NULL_SPAN = contextlib.nullcontext()
 
+# set by paddle_tpu.static.enable_static: records each eager op into the
+# current static Program (build-time execution doubles as shape
+# inference; tracers are excluded — ops inside a jitted body are interior
+# to an already-recorded node)
+_static_hook = None
+
 
 def is_grad_enabled():
     return _tape.grad_enabled
@@ -465,6 +471,9 @@ def apply_op(op_name: str, *tensors, attrs: Optional[dict] = None,
         else:
             for t in out_tensors:
                 t.stop_gradient = True
+
+    if _static_hook is not None and not traced:
+        _static_hook(op, attrs, tensors, out_tensors, single)
 
     return out_tensors[0] if single else out_tensors
 

@@ -39,6 +39,10 @@ def _infer_specs(layer, input_spec):
         elif isinstance(s, Tensor):
             specs.append(jax.ShapeDtypeStruct(tuple(s.shape),
                                               np.dtype(s._value.dtype)))
+        elif isinstance(s, jax.ShapeDtypeStruct):
+            # pre-built spec (possibly with symbolic dims for
+            # shape-polymorphic export; static.save_inference_model)
+            specs.append(s)
         else:
             raise TypeError(f"bad input_spec entry: {s!r}")
     return specs

@@ -211,6 +211,14 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from .. import static as static_mod
+        if static_mod.in_static_mode():
+            # static graph: register on the program; Executor.run builds
+            # the grad+update step (reference: optimizer ops appended to
+            # the ProgramDesc by _append_optimize_op)
+            static_mod.default_main_program().register_optimizer(
+                self, loss)
+            return None, None
         loss.backward()
         self.step()
         self.clear_grad()

@@ -1,0 +1,487 @@
+"""paddle.static compatibility layer: Program / Executor / feed-fetch.
+
+TPU-native replacement for the reference's declarative stack
+(python/paddle/fluid/framework.py:5249 Program, executor.py:911
+Executor/:1377 run, static/nn). The reference builds a ProgramDesc
+protobuf and interprets it op-by-op (InterpreterCore); here a Program
+RECORDS the op calls made while it is the current program (build-time
+code runs once, exactly like static graph construction), and
+Executor.run REPLAYS the recorded op DAG as ONE jitted XLA program per
+feed signature — the "one XLA computation per program" executor design
+(SURVEY.md §7), with feed/fetch by variable.
+
+Known v1 deltas from the reference, by design:
+- startup programs are no-ops: initializer ops already ran eagerly at
+  layer construction (parameters are born initialized).
+- buffer mutation across runs (BN running stats) is not written back.
+- gradient clipping configured on the optimizer is not yet applied on
+  the static path.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core.dispatch import OpDef
+from ..jit.api import InputSpec  # noqa: F401  (re-export, paddle parity)
+
+__all__ = ["Program", "program_guard", "data", "Executor",
+           "default_main_program", "default_startup_program",
+           "enable_static", "disable_static", "in_static_mode",
+           "InputSpec", "name_scope", "save_inference_model",
+           "load_inference_model", "global_scope", "cpu_places",
+           "device_places", "nn"]
+
+_state = {
+    "enabled": False,
+    "main": None,
+    "startup": None,
+}
+
+
+class _Node:
+    __slots__ = ("op", "attrs", "in_ids", "out_ids", "single")
+
+    def __init__(self, op, attrs, in_ids, out_ids, single):
+        self.op = op
+        self.attrs = attrs
+        self.in_ids = in_ids
+        self.out_ids = out_ids
+        self.single = single
+
+
+class Program:
+    """Recorded op DAG (reference: framework.py:5249 class Program —
+    desc/blocks replaced by the node list; random_seed/clone kept)."""
+
+    def __init__(self):
+        self._nodes: list[_Node] = []
+        self._tensors: dict[int, Tensor] = {}   # strong refs: build-time
+        self._feed_names: dict[str, int] = {}
+        self._feed_shapes: dict[str, list] = {}  # declared (None dims)
+        self._optimizer = None
+        self._loss_id = None
+        self._runner_cache: dict = {}
+        self._version = 0
+        self.random_seed = 0
+
+    # -- recording -----------------------------------------------------------
+    def _record(self, op, attrs, in_tensors, out_tensors, single):
+        # connectivity gate: record only ops reachable from the program
+        # (feeds, params, recorded outputs). Disconnected eager work —
+        # e.g. a metric computed between exe.run calls — must not grow
+        # the program (it would force a re-jit every step) nor execute
+        # dead nodes inside it.
+        if not any(id(t) in self._tensors for t in in_tensors):
+            return
+        in_ids = []
+        for t in in_tensors:
+            self._tensors.setdefault(id(t), t)
+            in_ids.append(id(t))
+        out_ids = []
+        for t in out_tensors:
+            self._tensors[id(t)] = t
+            out_ids.append(id(t))
+        self._nodes.append(_Node(op, dict(attrs), in_ids, out_ids,
+                                 single))
+        self._version += 1
+
+    def _register_feed(self, name, tensor):
+        self._feed_names[name] = id(tensor)
+        self._tensors[id(tensor)] = tensor
+        self._version += 1
+
+    def register_optimizer(self, optimizer, loss):
+        self._optimizer = optimizer
+        self._loss_id = id(loss)
+        self._version += 1
+
+    # -- structure queries ---------------------------------------------------
+    def _leaf_ids(self, feed_ids):
+        produced = set()
+        for n in self._nodes:
+            produced.update(n.out_ids)
+        feed = set(feed_ids)
+        leaves, seen = [], set()
+        for n in self._nodes:
+            for i in n.in_ids:
+                if i not in produced and i not in feed and i not in seen:
+                    seen.add(i)
+                    leaves.append(i)
+        return leaves
+
+    def _classify_leaves(self, feed_ids, trainable_ids=None):
+        """trainable_ids: explicit id set, or None -> every trainable
+        Parameter leaf (minimize() without parameters=, the canonical
+        static idiom: the program's parameters are implicit)."""
+        params, consts = [], []
+        for i in self._leaf_ids(feed_ids):
+            t = self._tensors[i]
+            if trainable_ids is None:
+                is_param = isinstance(t, Parameter) and t.trainable
+            else:
+                is_param = id(t) in trainable_ids
+            if is_param:
+                params.append(i)
+            else:
+                consts.append(i)
+        return params, consts
+
+    @staticmethod
+    def _run_nodes(nodes, env):
+        for n in nodes:
+            fn = n.op.fwd
+            out = (functools.partial(fn, **n.attrs) if n.attrs else fn)(
+                *[env[i] for i in n.in_ids])
+            if n.single:
+                env[n.out_ids[0]] = out
+            else:
+                for i, o in zip(n.out_ids, out):
+                    env[i] = o
+
+    # -- paddle API ----------------------------------------------------------
+    def clone(self, for_test=False):
+        p = Program()
+        p._nodes = list(self._nodes)
+        p._tensors = dict(self._tensors)
+        p._feed_names = dict(self._feed_names)
+        if not for_test:
+            p._optimizer = self._optimizer
+            p._loss_id = self._loss_id
+        return p
+
+    def global_block(self):
+        return self
+
+    @property
+    def blocks(self):
+        return [self]
+
+    def list_vars(self):
+        return list(self._tensors.values())
+
+    def __repr__(self):
+        return (f"Program(nodes={len(self._nodes)}, "
+                f"feeds={list(self._feed_names)})")
+
+
+def default_main_program() -> Program:
+    if _state["main"] is None:
+        _state["main"] = Program()
+    return _state["main"]
+
+
+def default_startup_program() -> Program:
+    if _state["startup"] is None:
+        _state["startup"] = Program()
+    return _state["startup"]
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    """reference: static.program_guard."""
+    prev_main, prev_start = _state["main"], _state["startup"]
+    _state["main"] = main_program
+    if startup_program is not None:
+        _state["startup"] = startup_program
+    try:
+        yield
+    finally:
+        _state["main"] = prev_main
+        _state["startup"] = prev_start
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+def _record_hook(op, attrs, in_tensors, out_tensors, single):
+    prog = _state["main"]
+    if prog is not None:
+        prog._record(op, attrs, in_tensors, out_tensors, single)
+
+
+def enable_static():
+    """paddle.enable_static parity: op calls now RECORD into the current
+    default main program (and still execute on placeholder values, which
+    is how shapes/params materialize at build time)."""
+    from ..core import tensor as tensor_mod
+    _state["enabled"] = True
+    tensor_mod._static_hook = _record_hook
+
+
+def disable_static(place=None):
+    from ..core import tensor as tensor_mod
+    _state["enabled"] = False
+    tensor_mod._static_hook = None
+
+
+def in_static_mode():
+    return _state["enabled"]
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """paddle.static.data parity: a named feed placeholder. Build-time
+    code sees a dummy tensor (None/-1 dims become 1); Executor.run feeds
+    the real value by name; save_inference_model re-reads the declared
+    shape so None dims export shape-polymorphic."""
+    from ..core import dtype as dtypes
+    declared = list(shape)
+    shape = [1 if (d is None or d < 0) else int(d) for d in shape]
+    np_dtype = dtypes.to_np_dtype(dtype)
+    t = Tensor(jnp.zeros(shape, np_dtype), stop_gradient=True, name=name)
+    prog = default_main_program()
+    prog._register_feed(name, t)
+    prog._feed_shapes[name] = declared
+    return t
+
+
+class Executor:
+    """reference: executor.py:911. run() compiles the recorded program
+    once per feed signature and executes the cached XLA program."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def close(self):
+        pass
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kwargs):
+        feed = feed or {}
+        if isinstance(program, _LoadedProgram):
+            return program._run(feed, return_numpy)
+        if program is None:
+            program = default_main_program()
+        if program is _state["startup"] or not program._nodes:
+            return []  # startup: params were initialized eagerly
+        fetch_list = fetch_list or []
+        fetch_ids = []
+        for f in fetch_list:
+            if isinstance(f, Tensor):
+                fetch_ids.append(id(f))
+            elif isinstance(f, str):
+                match = [id(t) for t in program._tensors.values()
+                         if t.name == f]
+                if not match:
+                    raise KeyError(f"fetch var {f!r} not in program")
+                fetch_ids.append(match[0])
+            else:
+                raise TypeError(f"bad fetch entry {f!r}")
+
+        feed_names = sorted(feed)
+        feed_ids = [program._feed_names[n] for n in feed_names]
+        feed_vals = [jnp.asarray(feed[n]) for n in feed_names]
+
+        if program._optimizer is not None:
+            outs = self._run_train(program, feed_names, feed_ids,
+                                   feed_vals, fetch_ids)
+        else:
+            outs = self._run_infer(program, feed_names, feed_ids,
+                                   feed_vals, fetch_ids)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    # -- inference path ------------------------------------------------------
+    def _run_infer(self, program, feed_names, feed_ids, feed_vals,
+                   fetch_ids):
+        key = ("infer", tuple(feed_names),
+               tuple((v.shape, str(v.dtype)) for v in feed_vals),
+               tuple(fetch_ids), program._version)
+        entry = program._runner_cache.get(key)
+        if entry is None:
+            param_ids, const_ids = program._classify_leaves(feed_ids,
+                                                            set())
+            leaf_ids = param_ids + const_ids
+
+            def pure(feed_vals, leaf_vals):
+                env = dict(zip(feed_ids, feed_vals))
+                env.update(zip(leaf_ids, leaf_vals))
+                Program._run_nodes(program._nodes, env)
+                return [env[i] for i in fetch_ids]
+
+            entry = (jax.jit(pure), leaf_ids)
+            program._runner_cache[key] = entry
+        fn, leaf_ids = entry
+        leaf_vals = [program._tensors[i]._value for i in leaf_ids]
+        return fn(feed_vals, leaf_vals)
+
+    # -- training path -------------------------------------------------------
+    def _run_train(self, program, feed_names, feed_ids, feed_vals,
+                   fetch_ids):
+        opt = program._optimizer
+        loss_id = program._loss_id
+        # explicit parameters= wins; otherwise every trainable Parameter
+        # leaf of the program (paddle's implicit-parameter semantics)
+        trainable = ({id(p) for p in opt._parameter_list
+                      if (p.trainable if isinstance(p, Parameter)
+                          else not p.stop_gradient)}
+                     if opt._parameter_list else None)
+        key = ("train", tuple(feed_names),
+               tuple((v.shape, str(v.dtype)) for v in feed_vals),
+               tuple(fetch_ids), program._version)
+        entry = program._runner_cache.get(key)
+        if entry is None:
+            param_ids, const_ids = program._classify_leaves(
+                feed_ids, trainable)
+            decay = opt._decay if not getattr(opt, "_decoupled", False) \
+                else 0.0
+            extras = opt._per_param_extra(
+                [program._tensors[i] for i in param_ids])
+
+            def step(feed_vals, p_vals, const_vals, states, gstate, lr):
+                def loss_of(pv):
+                    env = dict(zip(feed_ids, feed_vals))
+                    env.update(zip(param_ids, pv))
+                    env.update(zip(const_ids, const_vals))
+                    Program._run_nodes(program._nodes, env)
+                    return env[loss_id], [env[i] for i in fetch_ids]
+
+                (lossv, fetches), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(list(p_vals))
+                new_p, new_s = [], []
+                gstate = dict(gstate)
+                for i, (p, g, s) in enumerate(zip(p_vals, grads,
+                                                  states)):
+                    opt._cur_extra = extras[i] if extras is not None \
+                        else None
+                    if decay:
+                        g = g + decay * p
+                    np_, ns = opt._apply_rule(p, g, s, gstate, lr)
+                    new_p.append(np_)
+                    new_s.append(ns)
+                opt._cur_extra = None
+                gstate = opt._advance_global(gstate)
+                return fetches, new_p, new_s, gstate
+
+            entry = (jax.jit(step), param_ids, const_ids)
+            program._runner_cache[key] = entry
+        fn, param_ids, const_ids = entry
+        params = [program._tensors[i] for i in param_ids]
+        p_vals = [p._value for p in params]
+        const_vals = [program._tensors[i]._value for i in const_ids]
+        states = [opt._state_for(p) for p in params]
+        if not hasattr(opt, "_gstate"):
+            opt._gstate = {k: jnp.asarray(v) for k, v in
+                           opt._global_state_spec().items()}
+        lr = jnp.asarray(opt.get_lr(), dtype=jnp.float32)
+        fetches, new_p, new_s, new_g = fn(feed_vals, p_vals, const_vals,
+                                          states, opt._gstate, lr)
+        opt._gstate = new_g
+        for p, nv, ns in zip(params, new_p, new_s):
+            p._rebind(nv)
+            opt._accumulators[id(p)] = ns
+        return fetches
+
+
+def global_scope():
+    return default_main_program()
+
+
+def cpu_places(device_count=None):
+    from ..core.device import CPUPlace
+    return [CPUPlace()]
+
+
+def device_places(device_count=None):
+    from ..core.device import TPUPlace
+    import jax as _j
+    n = device_count or len(_j.local_devices())
+    return [TPUPlace(i) for i in range(n)]
+
+
+# -- inference model save/load ----------------------------------------------
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None, **kwargs):
+    """reference: static/io.py save_inference_model — exports the
+    inference slice of the program (params baked) as the jit.save
+    StableHLO artifact plus feed metadata."""
+    from ..jit import save_load
+    program = program or default_main_program()
+    feed_vars = list(feed_vars)
+    fetch_vars = list(fetch_vars)
+    feed_ids = [id(v) for v in feed_vars]
+    fetch_ids = [id(v) for v in fetch_vars]
+
+    # None dims declared in static.data export shape-polymorphic — the
+    # loaded model accepts any batch size, not the build placeholder's 1
+    n_poly = sum(
+        1 for v in feed_vars
+        for d in program._feed_shapes.get(v.name, []) if d is None or
+        (isinstance(d, int) and d < 0))
+    sym = iter(jax.export.symbolic_shape(
+        ", ".join(f"_b{i}" for i in range(n_poly)))) if n_poly else None
+    input_specs = []
+    for v in feed_vars:
+        declared = program._feed_shapes.get(v.name)
+        if declared and any(d is None or (isinstance(d, int) and d < 0)
+                            for d in declared):
+            dims = tuple(next(sym) if (d is None or d < 0) else int(d)
+                         for d in declared)
+            input_specs.append(jax.ShapeDtypeStruct(
+                dims, np.dtype(v._value.dtype)))
+        else:
+            input_specs.append(v)
+    param_ids, const_ids = program._classify_leaves(feed_ids)
+    leaf_ids = param_ids + const_ids
+    leaf_vals = [program._tensors[i]._value for i in leaf_ids]
+    nodes = program._nodes
+
+    def infer(*feeds):
+        env = {i: f._value for i, f in zip(feed_ids, feeds)}
+        env.update(zip(leaf_ids, leaf_vals))
+        Program._run_nodes(nodes, env)
+        return [Tensor(env[i]) for i in fetch_ids]
+
+    save_load.save(infer, path_prefix, input_spec=input_specs)
+    meta = {"feed_names": [v.name for v in feed_vars],
+            "n_fetch": len(fetch_vars)}
+    with open(str(path_prefix) + ".pdmeta.json", "w") as f:
+        json.dump(meta, f)
+    return None
+
+
+class _LoadedProgram:
+    def __init__(self, translated, feed_names):
+        self._layer = translated
+        self._feed_names = feed_names
+
+    def _run(self, feed, return_numpy=True):
+        vals = [Tensor(jnp.asarray(feed[n])) for n in self._feed_names]
+        outs = self._layer(*vals)
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        if return_numpy:
+            return [o.numpy() if isinstance(o, Tensor) else np.asarray(o)
+                    for o in outs]
+        return list(outs)
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    """reference: static/io.py load_inference_model -> [program,
+    feed_target_names, fetch_targets]."""
+    from ..jit import save_load
+    translated = save_load.load(str(path_prefix))
+    meta_path = str(path_prefix) + ".pdmeta.json"
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        feed_names = meta["feed_names"]
+        n_fetch = meta["n_fetch"]
+    else:
+        feed_names, n_fetch = [], 1
+    prog = _LoadedProgram(translated, feed_names)
+    return [prog, feed_names, list(range(n_fetch))]
+
+
+from . import nn  # noqa: E402,F401

@@ -1,0 +1,83 @@
+"""paddle.static.nn: static-graph layer builders.
+
+Reference: python/paddle/static/nn/__init__.py (fc, embedding,
+batch_norm, conv2d, ...) and static/nn/control_flow.py:874 (cond,
+while_loop, case, switch_case). Each builder creates parameters on
+first call and applies the functional op — under the recording Program
+this appends the same DAG the reference's LayerHelper.append_op would.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+# control flow: identical objects — under static recording their lax
+# lowering is captured as one program node
+from ..ops.control_flow import (cond, case, switch_case,  # noqa: F401
+                                while_loop)
+
+__all__ = ["fc", "embedding", "batch_norm", "conv2d", "cond", "case",
+           "switch_case", "while_loop", "static_pylayer"]
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """reference: static/nn/common.py fc."""
+    from ..nn.layer.common import Linear
+    from ..ops import manipulation
+    import paddle_tpu.nn.functional as F
+    in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+    layer = Linear(in_dim, size, weight_attr=weight_attr,
+                   bias_attr=bias_attr)
+    if len(x.shape) > num_flatten_dims + 1:
+        # -1 on the batch dim: the build-time placeholder batch (1) must
+        # not be baked into the program (feeds carry the real batch)
+        x = manipulation.reshape(
+            x, [-1] + list(x.shape[1:num_flatten_dims]) + [in_dim])
+    out = layer(x)
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    from ..nn.layer.common import Embedding
+    layer = Embedding(size[0], size[1], padding_idx=padding_idx,
+                      weight_attr=param_attr)
+    return layer(input)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test=False, **kwargs):
+    from ..nn.layer.norm import BatchNorm2D, BatchNorm1D
+    import paddle_tpu.nn.functional as F
+    ch = input.shape[1]
+    cls = BatchNorm2D if len(input.shape) == 4 else BatchNorm1D
+    layer = cls(ch, momentum=momentum, epsilon=epsilon)
+    if is_test:
+        layer.eval()
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, data_format="NCHW", **kwargs):
+    from ..nn.layer.conv import Conv2D
+    import paddle_tpu.nn.functional as F
+    layer = Conv2D(input.shape[1], num_filters, filter_size,
+                   stride=stride, padding=padding, dilation=dilation,
+                   groups=groups)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def static_pylayer(*args, **kwargs):
+    raise NotImplementedError(
+        "static_pylayer: use paddle_tpu.autograd.PyLayer in dynamic "
+        "mode; the recording Program captures it as one op")

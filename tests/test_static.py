@@ -1,0 +1,214 @@
+"""paddle.static compatibility tests.
+
+Reference test model: the fluid static-graph unittests
+(test_executor_and_use_program_cache, book/test_fit_a_line) — build a
+program once, run it many times with feed/fetch, train via
+optimizer.minimize appended to the program.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    """Each test gets fresh default programs and leaves dynamic mode on."""
+    main, startup = static.Program(), static.Program()
+    paddle.enable_static()
+    with static.program_guard(main, startup):
+        yield (main, startup)
+    paddle.disable_static()
+
+
+class TestProgramBuild:
+    def test_data_and_record(self, _static_mode):
+        main, _ = _static_mode
+        x = static.data("x", [None, 4], "float32")
+        y = x * 2.0 + 1.0
+        assert len(main._nodes) >= 1
+        assert "x" in main._feed_names
+
+    def test_program_guard_isolation(self, _static_mode):
+        main, _ = _static_mode
+        other = static.Program()
+        x = static.data("x", [None, 4], "float32")
+        with static.program_guard(other):
+            z = static.data("z", [2, 2], "float32")
+            _ = z + 1.0
+        assert "z" in other._feed_names
+        assert "z" not in main._feed_names
+        _ = x + 1.0  # back on main
+        assert len(main._nodes) >= 1
+
+
+class TestExecutorRun:
+    def test_feed_fetch_roundtrip(self, _static_mode):
+        x = static.data("x", [None, 4], "float32")
+        y = x * 3.0 + 1.0
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+        arr = np.arange(8, dtype="float32").reshape(2, 4)
+        out, = exe.run(feed={"x": arr}, fetch_list=[y])
+        np.testing.assert_allclose(out, arr * 3 + 1)
+        # different batch size: re-jit, same program
+        arr2 = np.ones((5, 4), "float32")
+        out2, = exe.run(feed={"x": arr2}, fetch_list=[y])
+        np.testing.assert_allclose(out2, arr2 * 3 + 1)
+
+    def test_layers_in_program(self, _static_mode):
+        paddle.seed(0)
+        x = static.data("x", [None, 8], "float32")
+        lin = nn.Linear(8, 3)
+        out = lin(x)
+        exe = static.Executor()
+        arr = np.random.RandomState(0).randn(4, 8).astype("float32")
+        got, = exe.run(feed={"x": arr}, fetch_list=[out])
+        want = arr @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_fetch_by_name(self, _static_mode):
+        x = static.data("x", [2, 2], "float32")
+        y = x + 5.0
+        y.name = "y_out"
+        exe = static.Executor()
+        out, = exe.run(feed={"x": np.zeros((2, 2), "float32")},
+                       fetch_list=["y_out"])
+        np.testing.assert_allclose(out, 5.0)
+
+
+class TestStaticTraining:
+    def test_fit_a_line(self, _static_mode):
+        """The reference's canonical static example (book/fit_a_line):
+        linear regression via sgd.minimize + exe.run loop."""
+        paddle.seed(0)
+        x = static.data("x", [None, 13], "float32")
+        y = static.data("y", [None, 1], "float32")
+        pred = static.nn.fc(x, size=1)
+        loss = ((pred - y) ** 2).mean()
+        # the canonical static idiom: no parameters= — minimize trains
+        # every trainable Parameter leaf of the program
+        sgd = opt.SGD(learning_rate=0.05)
+        sgd.minimize(loss)
+
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+        rs = np.random.RandomState(0)
+        true_w = rs.randn(13, 1).astype("float32")
+        losses = []
+        for i in range(60):
+            xb = rs.randn(16, 13).astype("float32")
+            yb = xb @ true_w
+            lv, = exe.run(feed={"x": xb, "y": yb}, fetch_list=[loss])
+            losses.append(float(lv))
+        assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
+
+    def test_adam_minimize_with_states(self, _static_mode):
+        paddle.seed(1)
+        x = static.data("x", [None, 6], "float32")
+        y = static.data("y", [None, 1], "float32")
+        lin = nn.Linear(6, 1)
+        loss = ((lin(x) - y) ** 2).mean()
+        adam = opt.Adam(learning_rate=0.05,
+                        parameters=lin.parameters())
+        adam.minimize(loss)
+        exe = static.Executor()
+        rs = np.random.RandomState(1)
+        w = rs.randn(6, 1).astype("float32")
+        first = last = None
+        for i in range(40):
+            xb = rs.randn(8, 6).astype("float32")
+            lv, = exe.run(feed={"x": xb, "y": xb @ w},
+                          fetch_list=[loss])
+            first = first if first is not None else float(lv)
+            last = float(lv)
+        assert last < first * 0.3
+        # adam moments materialized
+        assert len(adam._accumulators) == 2
+
+
+class TestStaticNN:
+    def test_fc_conv_bn(self, _static_mode):
+        paddle.seed(0)
+        img = static.data("img", [None, 3, 8, 8], "float32")
+        conv = static.nn.conv2d(img, num_filters=4, filter_size=3,
+                                padding=1, act="relu")
+        bn = static.nn.batch_norm(conv, is_test=True)
+        feat = static.nn.fc(bn, size=10, num_flatten_dims=1)
+        exe = static.Executor()
+        out, = exe.run(feed={"img": np.random.RandomState(0).randn(
+            2, 3, 8, 8).astype("float32")}, fetch_list=[feat])
+        assert out.shape == (2, 10)
+        assert np.isfinite(out).all()
+
+
+class TestSaveLoadInference:
+    def test_roundtrip(self, _static_mode, tmp_path):
+        paddle.seed(0)
+        x = static.data("x", [4, 8], "float32")
+        lin = nn.Linear(8, 2)
+        out = lin(x)
+        exe = static.Executor()
+        arr = np.random.RandomState(0).randn(4, 8).astype("float32")
+        want, = exe.run(feed={"x": arr}, fetch_list=[out])
+
+        prefix = str(tmp_path / "model" / "infer")
+        static.save_inference_model(prefix, [x], [out], exe)
+
+        paddle.disable_static()
+        prog, feed_names, fetch_targets = static.load_inference_model(
+            prefix, exe)
+        assert feed_names == ["x"]
+        got, = exe.run(prog, feed={"x": arr})
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        paddle.enable_static()
+
+    def test_polymorphic_batch_roundtrip(self, _static_mode, tmp_path):
+        # None batch dim -> shape-polymorphic export: load and run with
+        # a batch size never seen at save time
+        paddle.seed(0)
+        x = static.data("xp", [None, 8], "float32")
+        lin = nn.Linear(8, 2)
+        out = lin(x)
+        exe = static.Executor()
+        prefix = str(tmp_path / "poly" / "infer")
+        static.save_inference_model(prefix, [x], [out], exe)
+        paddle.disable_static()
+        prog, feed_names, _ = static.load_inference_model(prefix, exe)
+        arr = np.random.RandomState(1).randn(7, 8).astype("float32")
+        got, = exe.run(prog, feed={"xp": arr})
+        want = arr @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        paddle.enable_static()
+
+
+class TestRecordingHygiene:
+    def test_disconnected_eager_ops_not_recorded(self, _static_mode):
+        main, _ = _static_mode
+        x = static.data("x", [None, 4], "float32")
+        y = x * 2.0
+        n = len(main._nodes)
+        v = main._version
+        # eager side computation between runs: disconnected from the
+        # program -> not recorded, no version bump, no re-jit
+        t = paddle.to_tensor(np.ones((3, 3), "float32"))
+        _ = (t + 1.0).mean()
+        assert len(main._nodes) == n
+        assert main._version == v
+
+    def test_runner_cache_stable_across_runs(self, _static_mode):
+        main, _ = _static_mode
+        x = static.data("x", [None, 4], "float32")
+        y = x + 1.0
+        exe = static.Executor()
+        arr = np.zeros((2, 4), "float32")
+        exe.run(feed={"x": arr}, fetch_list=[y])
+        n_cache = len(main._runner_cache)
+        for _i in range(3):
+            t = paddle.to_tensor(np.ones((2, 2), "float32"))
+            _ = t * 2.0  # interleaved eager work
+            exe.run(feed={"x": arr}, fetch_list=[y])
+        assert len(main._runner_cache) == n_cache  # all cache hits
