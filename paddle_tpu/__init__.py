@@ -96,6 +96,9 @@ from .regularizer import L1Decay, L2Decay  # noqa: E402,F401
 from .nn.layer.layers import ParamAttr  # noqa: E402,F401
 from . import metric  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
+from . import distribution  # noqa: E402,F401
+from . import fft  # noqa: E402,F401
+from . import signal  # noqa: E402,F401
 from . import static  # noqa: E402,F401
 from .static import enable_static, disable_static  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401

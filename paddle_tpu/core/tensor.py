@@ -444,7 +444,9 @@ def apply_op(op_name: str, *tensors, attrs: Optional[dict] = None,
     if need_grad:
         diff_in = tuple(i for i, t in enumerate(tensors)
                         if not t.stop_gradient
-                        and dtypes.is_floating(np.dtype(t._value.dtype)))
+                        and (dtypes.is_floating(np.dtype(t._value.dtype))
+                             or dtypes.is_complex(
+                                 np.dtype(t._value.dtype))))
         diff_out = tuple(
             i for i, o in enumerate(outs)
             if dtypes.is_floating(np.dtype(o.dtype))

@@ -1,0 +1,700 @@
+"""paddle.distribution parity: probability distributions over Tensors.
+
+Reference: python/paddle/distribution/ (distribution.py Distribution
+base; normal/uniform/categorical/beta/dirichlet/multinomial/laplace/
+lognormal/gumbel.py; independent.py, transformed_distribution.py,
+transform.py, kl.py kl_divergence/register_kl). All densities are
+written with framework ops, so log_prob/entropy are differentiable on
+the eager tape and traceable under jit.to_static.
+"""
+from __future__ import annotations
+
+import math
+import numbers
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import register_op
+from ..core import random as random_mod
+from ..ops._helpers import apply_op
+from ..ops import creation, math as ops_math, manipulation
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical",
+           "Bernoulli", "Beta", "Dirichlet", "Multinomial", "Laplace",
+           "LogNormal", "Gumbel", "Independent",
+           "TransformedDistribution", "ExponentialFamily",
+           "kl_divergence", "register_kl", "Transform",
+           "AffineTransform", "ExpTransform", "SigmoidTransform",
+           "AbsTransform"]
+
+
+def _as_tensor(x, dtype="float32"):
+    if isinstance(x, Tensor):
+        return x
+    if isinstance(x, numbers.Number):
+        return creation.to_tensor(np.asarray(x, dtype))
+    return creation.to_tensor(np.asarray(x, dtype))
+
+
+register_op("dist_standard_gamma",
+            lambda key, alpha: jax.random.gamma(key, alpha))
+
+
+def _standard_gamma(alpha: Tensor) -> Tensor:
+    key = Tensor(random_mod.next_key())
+    return apply_op("dist_standard_gamma", key, alpha)
+
+
+class Distribution:
+    """Base class (reference: distribution/distribution.py)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return ops_math.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        return tuple(sample_shape) + self._batch_shape + \
+            self._event_shape
+
+
+class Normal(Distribution):
+    """reference: distribution/normal.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+        super().__init__(tuple(np.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape))))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale * self.scale
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def sample(self, shape=()):
+        from ..core.tensor import no_grad
+        with no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        eps = creation.randn(list(out_shape))
+        return self.loc + self.scale * eps
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        var = self.scale * self.scale
+        return (-((value - self.loc) ** 2) / (2.0 * var)
+                - ops_math.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + \
+            ops_math.log(self.scale)
+
+
+class Uniform(Distribution):
+    """reference: distribution/uniform.py."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _as_tensor(low)
+        self.high = _as_tensor(high)
+        super().__init__(tuple(np.broadcast_shapes(
+            tuple(self.low.shape), tuple(self.high.shape))))
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2.0
+
+    @property
+    def variance(self):
+        return (self.high - self.low) ** 2 / 12.0
+
+    def sample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        u = creation.rand(list(out_shape))
+        return self.low + (self.high - self.low) * u
+
+    rsample = sample
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        from ..ops import comparison
+        inside = ops_math.logical_and(
+            comparison.greater_equal(value, self.low),
+            comparison.less_than(value, self.high))
+        lp = -ops_math.log(self.high - self.low)
+        neg_inf = creation.full_like(value, -np.inf)
+        from ..ops.manipulation import where
+        return where(inside, lp + value * 0.0, neg_inf)
+
+    def entropy(self):
+        return ops_math.log(self.high - self.low)
+
+
+class Categorical(Distribution):
+    """reference: distribution/categorical.py (logits parameterized)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _as_tensor(logits)
+        super().__init__(tuple(self.logits.shape[:-1]))
+        self._n = self.logits.shape[-1]
+
+    def _log_pmf(self):
+        from ..nn.functional import log_softmax
+        return log_softmax(self.logits, axis=-1)
+
+    @property
+    def probs(self):
+        from ..nn.functional import softmax
+        return softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        n = int(np.prod(shape)) if shape else 1
+        out = creation.multinomial(self.probs, num_samples=n,
+                                   replacement=True)   # [..., n]
+        if not shape:
+            return manipulation.squeeze(out, axis=-1)
+        # paddle convention: sample dims lead
+        perm = [out.ndim - 1] + list(range(out.ndim - 1))
+        out = manipulation.transpose(out, perm)
+        return manipulation.reshape(
+            out, list(shape) + list(self._batch_shape))
+
+    def log_prob(self, value):
+        value = _as_tensor(value).astype("int64")
+        lp = self._log_pmf()
+        from ..ops.manipulation import take_along_axis
+        if value.ndim > lp.ndim - 1:
+            # values carry sample dims beyond the batch: broadcast the
+            # pmf alongside them
+            lp = manipulation.broadcast_to(
+                lp, list(value.shape) + [self._n])
+        idx = manipulation.unsqueeze(value, axis=-1)
+        out = take_along_axis(lp, idx, axis=-1, broadcast=False)
+        return manipulation.squeeze(out, axis=-1)
+
+    def probabilities(self):
+        return self.probs
+
+    def entropy(self):
+        lp = self._log_pmf()
+        return -ops_math.multiply(self.probs, lp).sum(axis=-1)
+
+
+class Bernoulli(Distribution):
+    """reference: distribution/bernoulli.py (probs parameterized)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _as_tensor(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        u = creation.rand(list(out_shape))
+        from ..ops.comparison import less_than
+        return less_than(u, self.probs).astype("float32")
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        eps = 1e-8
+        p = self.probs
+        return value * ops_math.log(p + eps) + \
+            (1.0 - value) * ops_math.log(1.0 - p + eps)
+
+    def entropy(self):
+        eps = 1e-8
+        p = self.probs
+        return -(p * ops_math.log(p + eps)
+                 + (1.0 - p) * ops_math.log(1.0 - p + eps))
+
+
+class Beta(Distribution):
+    """reference: distribution/beta.py — built on Dirichlet's gamma
+    sampler."""
+
+    def __init__(self, alpha, beta):
+        self.alpha = _as_tensor(alpha)
+        self.beta = _as_tensor(beta)
+        super().__init__(tuple(np.broadcast_shapes(
+            tuple(self.alpha.shape), tuple(self.beta.shape))))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s * s * (s + 1.0))
+
+    def sample(self, shape=()):
+        ga = _standard_gamma(manipulation.broadcast_to(
+            self.alpha, list(self._extend_shape(shape))))
+        gb = _standard_gamma(manipulation.broadcast_to(
+            self.beta, list(self._extend_shape(shape))))
+        return ga / (ga + gb)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        return ((self.alpha - 1.0) * ops_math.log(value)
+                + (self.beta - 1.0) * ops_math.log(1.0 - value)
+                - _lbeta(self.alpha, self.beta))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        s = a + b
+        return (_lbeta(a, b) - (a - 1.0) * ops_math.digamma(a)
+                - (b - 1.0) * ops_math.digamma(b)
+                + (s - 2.0) * ops_math.digamma(s))
+
+
+def _lbeta(a, b):
+    return ops_math.lgamma(a) + ops_math.lgamma(b) - \
+        ops_math.lgamma(a + b)
+
+
+class Dirichlet(Distribution):
+    """reference: distribution/dirichlet.py."""
+
+    def __init__(self, concentration):
+        self.concentration = _as_tensor(concentration)
+        super().__init__(tuple(self.concentration.shape[:-1]),
+                         tuple(self.concentration.shape[-1:]))
+
+    @property
+    def mean(self):
+        return self.concentration / self.concentration.sum(
+            axis=-1, keepdim=True)
+
+    @property
+    def variance(self):
+        c = self.concentration
+        c0 = c.sum(axis=-1, keepdim=True)
+        m = c / c0
+        return m * (1.0 - m) / (c0 + 1.0)
+
+    def sample(self, shape=()):
+        g = _standard_gamma(manipulation.broadcast_to(
+            self.concentration, list(self._extend_shape(shape))))
+        return g / g.sum(axis=-1, keepdim=True)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        c = self.concentration
+        return (((c - 1.0) * ops_math.log(value)).sum(axis=-1)
+                + ops_math.lgamma(c.sum(axis=-1))
+                - ops_math.lgamma(c).sum(axis=-1))
+
+    def entropy(self):
+        c = self.concentration
+        c0 = c.sum(axis=-1)
+        k = c.shape[-1]
+        return (ops_math.lgamma(c).sum(axis=-1)
+                - ops_math.lgamma(c0)
+                + (c0 - float(k)) * ops_math.digamma(c0)
+                - ((c - 1.0) * ops_math.digamma(c)).sum(axis=-1))
+
+
+class Multinomial(Distribution):
+    """reference: distribution/multinomial.py."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = _as_tensor(probs)
+        norm = self.probs.sum(axis=-1, keepdim=True)
+        self.probs = self.probs / norm
+        super().__init__(tuple(self.probs.shape[:-1]),
+                         tuple(self.probs.shape[-1:]))
+
+    @property
+    def mean(self):
+        return self.probs * float(self.total_count)
+
+    @property
+    def variance(self):
+        return float(self.total_count) * self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        draws = creation.multinomial(self.probs,
+                                     num_samples=self.total_count,
+                                     replacement=True)    # [..., N]
+        k = self.probs.shape[-1]
+        from ..nn.functional import one_hot
+        oh = one_hot(draws.astype("int64"), num_classes=k)
+        out = oh.sum(axis=-2)
+        if shape:
+            raise NotImplementedError(
+                "Multinomial.sample(shape) beyond () — draw in a loop")
+        return out
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        logits = ops_math.log(self.probs)
+        return (ops_math.lgamma(
+                    _as_tensor(float(self.total_count + 1)))
+                - ops_math.lgamma(value + 1.0).sum(axis=-1)
+                + (value * logits).sum(axis=-1))
+
+    def entropy(self):
+        raise NotImplementedError
+
+
+class Laplace(Distribution):
+    """reference: distribution/laplace.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+        super().__init__(tuple(np.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape))))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return 2.0 * self.scale * self.scale
+
+    @property
+    def stddev(self):
+        return math.sqrt(2.0) * self.scale
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        # epsilon guard: u = -0.5 exactly would give log(0) = -inf
+        u = creation.rand(list(out_shape)) * (1 - 1e-7) - 0.5 + 1e-10
+        sgn = ops_math.sign(u)
+        return self.loc - self.scale * sgn * ops_math.log(
+            1.0 - 2.0 * ops_math.abs(u))
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        return -ops_math.log(2.0 * self.scale) - \
+            ops_math.abs(value - self.loc) / self.scale
+
+    def entropy(self):
+        return 1.0 + ops_math.log(2.0 * self.scale)
+
+
+class LogNormal(Distribution):
+    """reference: distribution/lognormal.py."""
+
+    def __init__(self, loc, scale):
+        self._normal = Normal(loc, scale)
+        self.loc = self._normal.loc
+        self.scale = self._normal.scale
+        super().__init__(self._normal.batch_shape)
+
+    @property
+    def mean(self):
+        return ops_math.exp(self.loc + self.scale * self.scale / 2.0)
+
+    @property
+    def variance(self):
+        s2 = self.scale * self.scale
+        return (ops_math.exp(s2) - 1.0) * ops_math.exp(
+            2.0 * self.loc + s2)
+
+    def sample(self, shape=()):
+        return ops_math.exp(self._normal.sample(shape))
+
+    def rsample(self, shape=()):
+        return ops_math.exp(self._normal.rsample(shape))
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        lv = ops_math.log(value)
+        return self._normal.log_prob(lv) - lv
+
+    def entropy(self):
+        return self._normal.entropy() + self.loc
+
+
+class Gumbel(Distribution):
+    """reference: distribution/gumbel.py."""
+
+    _EULER = 0.57721566490153286060
+
+    def __init__(self, loc, scale):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+        super().__init__(tuple(np.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape))))
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * self._EULER
+
+    @property
+    def variance(self):
+        return (math.pi ** 2 / 6.0) * self.scale * self.scale
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(shape)
+        u = creation.rand(list(out_shape)) * (1 - 1e-7) + 1e-10
+        return self.loc - self.scale * ops_math.log(-ops_math.log(u))
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        z = (value - self.loc) / self.scale
+        return -(z + ops_math.exp(-z)) - ops_math.log(self.scale)
+
+    def entropy(self):
+        return ops_math.log(self.scale) + 1.0 + self._EULER
+
+
+class Independent(Distribution):
+    """reference: distribution/independent.py — reinterprets batch dims
+    as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self._r = int(reinterpreted_batch_rank)
+        b = base.batch_shape
+        super().__init__(b[:len(b) - self._r],
+                         b[len(b) - self._r:] + base.event_shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        for _ in range(self._r):
+            lp = lp.sum(axis=-1)
+        return lp
+
+    def entropy(self):
+        e = self.base.entropy()
+        for _ in range(self._r):
+            e = e.sum(axis=-1)
+        return e
+
+
+class Transform:
+    """reference: distribution/transform.py."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return ops_math.log(ops_math.abs(self.scale)) + x * 0.0
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return ops_math.exp(x)
+
+    def inverse(self, y):
+        return ops_math.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        from ..nn.functional import sigmoid
+        return sigmoid(x)
+
+    def inverse(self, y):
+        return ops_math.log(y) - ops_math.log(1.0 - y)
+
+    def forward_log_det_jacobian(self, x):
+        from ..nn.functional import softplus
+        return -softplus(-x) - softplus(x)
+
+
+class AbsTransform(Transform):
+    def forward(self, x):
+        return ops_math.abs(x)
+
+    def inverse(self, y):
+        return y
+
+
+class TransformedDistribution(Distribution):
+    """reference: distribution/transformed_distribution.py."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        lp = 0.0
+        y = value
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            lp = lp - t.forward_log_det_jacobian(x)
+            y = x
+        return self.base.log_prob(y) + lp
+
+
+class ExponentialFamily(Distribution):
+    """reference: distribution/exponential_family.py shell."""
+    pass
+
+
+# -- KL divergence -----------------------------------------------------------
+
+_KL_REGISTRY: dict = {}
+
+
+def register_kl(cls_p, cls_q):
+    """reference: distribution/kl.py register_kl decorator."""
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1.0 - ops_math.log(var_ratio))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    return ops_math.log((q.high - q.low) / (p.high - p.low))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    lp, lq = p._log_pmf(), q._log_pmf()
+    return (p.probs * (lp - lq)).sum(axis=-1)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    s1 = a1 + b1
+    return (_lbeta(a2, b2) - _lbeta(a1, b1)
+            + (a1 - a2) * ops_math.digamma(a1)
+            + (b1 - b2) * ops_math.digamma(b1)
+            + (a2 - a1 + b2 - b1) * ops_math.digamma(s1))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    cp, cq = p.concentration, q.concentration
+    sp = cp.sum(axis=-1)
+    return (ops_math.lgamma(sp)
+            - ops_math.lgamma(cq.sum(axis=-1))
+            - ops_math.lgamma(cp).sum(axis=-1)
+            + ops_math.lgamma(cq).sum(axis=-1)
+            + ((cp - cq) * (ops_math.digamma(cp)
+                            - manipulation.unsqueeze(
+                                ops_math.digamma(sp), axis=-1))
+               ).sum(axis=-1))

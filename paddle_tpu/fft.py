@@ -1,0 +1,119 @@
+"""paddle.fft parity over XLA's FFT.
+
+Reference: python/paddle/fft.py (fft_c2c/fft_r2c/fft_c2r over
+phi/kernels/funcs/fft.* — pocketfft/cuFFT). Here every transform is one
+registered op lowering to jnp.fft (XLA FFT HLO on TPU); all transforms
+are differentiable through the generic op vjp.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .core.dispatch import register_op
+from .ops._helpers import as_tensor, apply_op
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+           "fft2", "ifft2", "rfft2", "irfft2",
+           "fftn", "ifftn", "rfftn", "irfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+_1D = {"fft": jnp.fft.fft, "ifft": jnp.fft.ifft, "rfft": jnp.fft.rfft,
+       "irfft": jnp.fft.irfft, "hfft": jnp.fft.hfft,
+       "ihfft": jnp.fft.ihfft}
+_ND = {"fft2": jnp.fft.fft2, "ifft2": jnp.fft.ifft2,
+       "rfft2": jnp.fft.rfft2, "irfft2": jnp.fft.irfft2,
+       "fftn": jnp.fft.fftn, "ifftn": jnp.fft.ifftn,
+       "rfftn": jnp.fft.rfftn, "irfftn": jnp.fft.irfftn}
+
+for _name, _fn in _1D.items():
+    register_op(f"fft::{_name}",
+                (lambda f: lambda x, n=None, axis=-1, norm="backward":
+                 f(x, n=n, axis=axis, norm=norm))(_fn))
+for _name, _fn in _ND.items():
+    _default_axes = (-2, -1) if "2" in _name else None
+    register_op(f"fft::{_name}",
+                (lambda f, da: lambda x, s=None, axes=None,
+                 norm="backward": f(x, s=s, axes=da if axes is None
+                                    else axes, norm=norm))(
+                    _fn, _default_axes))
+
+register_op("fft::fftshift",
+            lambda x, axes=None: jnp.fft.fftshift(x, axes=axes))
+register_op("fft::ifftshift",
+            lambda x, axes=None: jnp.fft.ifftshift(x, axes=axes))
+
+
+def _norm(norm):
+    return norm if norm is not None else "backward"
+
+
+def _make_1d(name):
+    def f(x, n=None, axis=-1, norm="backward", name_=None):
+        return apply_op(f"fft::{name}", as_tensor(x),
+                        attrs=dict(n=None if n is None else int(n),
+                                   axis=int(axis), norm=_norm(norm)))
+    f.__name__ = name
+    f.__doc__ = f"paddle.fft.{name} (reference: python/paddle/fft.py)."
+    return f
+
+
+def _make_nd(name):
+    def f(x, s=None, axes=None, norm="backward", name_=None):
+        return apply_op(
+            f"fft::{name}", as_tensor(x),
+            attrs=dict(s=None if s is None else tuple(int(v) for v in s),
+                       axes=None if axes is None else
+                       tuple(int(a) for a in axes),
+                       norm=_norm(norm)))
+    f.__name__ = name
+    f.__doc__ = f"paddle.fft.{name} (reference: python/paddle/fft.py)."
+    return f
+
+
+fft = _make_1d("fft")
+ifft = _make_1d("ifft")
+rfft = _make_1d("rfft")
+irfft = _make_1d("irfft")
+hfft = _make_1d("hfft")
+ihfft = _make_1d("ihfft")
+fft2 = _make_nd("fft2")
+ifft2 = _make_nd("ifft2")
+rfft2 = _make_nd("rfft2")
+irfft2 = _make_nd("irfft2")
+fftn = _make_nd("fftn")
+ifftn = _make_nd("ifftn")
+rfftn = _make_nd("rfftn")
+irfftn = _make_nd("irfftn")
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op("fft::fftshift", as_tensor(x),
+                    attrs=dict(axes=None if axes is None
+                               else tuple(axes)))
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op("fft::ifftshift", as_tensor(x),
+                    attrs=dict(axes=None if axes is None
+                               else tuple(axes)))
+
+
+def _freq_dtype(dtype):
+    try:
+        return np.dtype(dtype)
+    except TypeError:
+        from .core import dtype as dtypes
+        return dtypes.to_np_dtype(dtype)
+
+
+def fftfreq(n, d=1.0, dtype="float32", name=None):
+    from .ops.creation import to_tensor
+    return to_tensor(np.fft.fftfreq(int(n), float(d)).astype(
+        _freq_dtype(dtype)))
+
+
+def rfftfreq(n, d=1.0, dtype="float32", name=None):
+    from .ops.creation import to_tensor
+    return to_tensor(np.fft.rfftfreq(int(n), float(d)).astype(
+        _freq_dtype(dtype)))

@@ -77,3 +77,4 @@ def is_empty(x, name=None):
 
 def is_tensor(x):
     return isinstance(x, Tensor)
+

@@ -144,20 +144,27 @@ class Optimizer:
         (e.g. per-param weight-decay masks). None entries -> no extra."""
         return None
 
+    def _apply_updates(self, params, grads, states, gstate, lr, extras):
+        """Pure per-param update sweep — the ONE implementation of the
+        update loop, shared by the eager fused step and the static
+        Executor's train runner."""
+        new_params, new_states = [], []
+        gstate = dict(gstate)
+        for i, (p, g, s) in enumerate(zip(params, grads, states)):
+            self._cur_extra = extras[i] if extras is not None else None
+            np_, ns = self._apply_rule(p, g, s, gstate, lr)
+            new_params.append(np_)
+            new_states.append(ns)
+        self._cur_extra = None
+        gstate = self._advance_global(gstate)
+        return new_params, new_states, gstate
+
     def _build_fused(self, n_params):
-        rule = self._apply_rule
         extras = self._per_param_extra(self._active_params())
 
         def fused(params, grads, states, gstate, lr):
-            new_params, new_states = [], []
-            gstate = dict(gstate)
-            for i, (p, g, s) in enumerate(zip(params, grads, states)):
-                self._cur_extra = extras[i] if extras is not None else None
-                np_, ns = rule(p, g, s, gstate, lr)
-                new_params.append(np_)
-                new_states.append(ns)
-            gstate = self._advance_global(gstate)
-            return new_params, new_states, gstate
+            return self._apply_updates(params, grads, states, gstate,
+                                       lr, extras)
 
         # Donate accumulators/global state (owned by this optimizer; the
         # public state_dict copies). Params are NOT donated: tape nodes

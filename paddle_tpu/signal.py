@@ -1,0 +1,126 @@
+"""paddle.signal parity: STFT / ISTFT.
+
+Reference: python/paddle/signal.py (stft/istft over frame + fft ops).
+One registered op each: framing is a gather, the transform is XLA FFT,
+overlap-add is a scatter-add — all fused by XLA in one program.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .core.dispatch import register_op
+from .core.tensor import Tensor
+from .ops._helpers import as_tensor, apply_op
+
+__all__ = ["stft", "istft"]
+
+
+def _frame(x, frame_length, hop_length):
+    """[..., T] -> [..., n_frames, frame_length]."""
+    n = x.shape[-1]
+    n_frames = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(n_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    return x[..., idx]
+
+
+def _stft_fwd(x, window, n_fft, hop_length, win_length, center, pad_mode,
+              normalized, onesided):
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    frames = _frame(x, n_fft, hop_length)        # [..., F, n_fft]
+    frames = frames * window
+    if onesided:
+        spec = jnp.fft.rfft(frames, axis=-1)
+    else:
+        spec = jnp.fft.fft(frames, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    # paddle layout: [..., n_freqs, n_frames]
+    return jnp.swapaxes(spec, -1, -2)
+
+
+def _istft_fwd(spec, window, n_fft, hop_length, win_length, center,
+               normalized, onesided, length, return_complex=False):
+    spec = jnp.swapaxes(spec, -1, -2)            # [..., F, n_freqs]
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    if onesided:
+        if return_complex:
+            raise ValueError(
+                "return_complex=True requires onesided=False (a onesided "
+                "spectrum only represents a real signal)")
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+    elif return_complex:
+        frames = jnp.fft.ifft(spec, axis=-1)
+    else:
+        frames = jnp.fft.ifft(spec, axis=-1).real
+    frames = frames * window
+    n_frames = frames.shape[-2]
+    out_len = n_fft + hop_length * (n_frames - 1)
+    starts = jnp.arange(n_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(n_fft)[None, :]   # [F, n_fft]
+    out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+    out = out.at[..., idx].add(frames)
+    # window envelope normalization (overlap-add correction)
+    env = jnp.zeros((out_len,), frames.dtype)
+    env = env.at[idx].add(jnp.broadcast_to(window * window,
+                                           (n_frames, n_fft)))
+    out = out / jnp.maximum(env, 1e-11)
+    if center:
+        out = out[..., n_fft // 2: out_len - n_fft // 2]
+    if length is not None:
+        out = out[..., :length]
+    return out
+
+
+register_op("signal_stft", _stft_fwd)
+register_op("signal_istft", _istft_fwd)
+
+
+def _window_tensor(window, win_length, n_fft, dtype=np.float32):
+    if window is None:
+        w = np.ones(win_length, dtype)
+    elif isinstance(window, Tensor):
+        w = np.asarray(window._value).astype(dtype)
+    else:
+        w = np.asarray(window, dtype)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        w = np.pad(w, (pad, n_fft - win_length - pad))
+    return w
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False,
+         onesided=True, name=None):
+    """reference: python/paddle/signal.py stft."""
+    x = as_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = _window_tensor(window, win_length, n_fft)
+    return apply_op(
+        "signal_stft", x, Tensor(jnp.asarray(w)),
+        attrs=dict(n_fft=int(n_fft), hop_length=int(hop_length),
+                   win_length=int(win_length), center=bool(center),
+                   pad_mode=pad_mode, normalized=bool(normalized),
+                   onesided=bool(onesided)))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """reference: python/paddle/signal.py istft."""
+    x = as_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = _window_tensor(window, win_length, n_fft)
+    return apply_op(
+        "signal_istft", x, Tensor(jnp.asarray(w)),
+        attrs=dict(n_fft=int(n_fft), hop_length=int(hop_length),
+                   win_length=int(win_length), center=bool(center),
+                   normalized=bool(normalized), onesided=bool(onesided),
+                   length=None if length is None else int(length),
+                   return_complex=bool(return_complex)))

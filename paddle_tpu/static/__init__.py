@@ -153,6 +153,7 @@ class Program:
         p._nodes = list(self._nodes)
         p._tensors = dict(self._tensors)
         p._feed_names = dict(self._feed_names)
+        p._feed_shapes = dict(self._feed_shapes)
         if not for_test:
             p._optimizer = self._optimizer
             p._loss_id = self._loss_id
@@ -349,19 +350,11 @@ class Executor:
 
                 (lossv, fetches), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(list(p_vals))
-                new_p, new_s = [], []
-                gstate = dict(gstate)
-                for i, (p, g, s) in enumerate(zip(p_vals, grads,
-                                                  states)):
-                    opt._cur_extra = extras[i] if extras is not None \
-                        else None
-                    if decay:
-                        g = g + decay * p
-                    np_, ns = opt._apply_rule(p, g, s, gstate, lr)
-                    new_p.append(np_)
-                    new_s.append(ns)
-                opt._cur_extra = None
-                gstate = opt._advance_global(gstate)
+                if decay:
+                    grads = [g + decay * p
+                             for p, g in zip(p_vals, grads)]
+                new_p, new_s, gstate = opt._apply_updates(
+                    p_vals, grads, states, gstate, lr, extras)
                 return fetches, new_p, new_s, gstate
 
             entry = (jax.jit(step), param_ids, const_ids)

@@ -1,0 +1,201 @@
+"""paddle.distribution / paddle.fft / paddle.signal tests.
+
+Reference model: unittests/distribution/test_distribution_*.py (moment
+and log_prob closed forms vs scipy-style references),
+test_fft.py (numpy parity), test_signal.py (stft/istft roundtrip).
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+from paddle_tpu import fft as pfft
+from paddle_tpu import signal as psignal
+
+
+class TestNormal:
+    def test_moments_logprob_entropy(self):
+        n = D.Normal(1.5, 2.0)
+        assert float(n.mean) == 1.5
+        assert abs(float(n.variance) - 4.0) < 1e-6
+        # closed-form log pdf
+        x = 0.5
+        want = -0.5 * ((x - 1.5) / 2.0) ** 2 - math.log(
+            2.0 * math.sqrt(2 * math.pi))
+        assert abs(float(n.log_prob(paddle.to_tensor(np.float32(x))))
+                   - want) < 1e-5
+        want_h = 0.5 * math.log(2 * math.pi * math.e * 4.0)
+        assert abs(float(n.entropy()) - want_h) < 1e-5
+
+    def test_rsample_reparameterized_grad(self):
+        paddle.seed(0)
+        loc = paddle.to_tensor(np.float32(0.0), stop_gradient=False)
+        n = D.Normal(loc, 1.0)
+        s = n.rsample([256])
+        s.mean().backward()
+        assert abs(float(loc.grad) - 1.0) < 1e-5  # d mean / d loc = 1
+
+    def test_sample_statistics(self):
+        paddle.seed(0)
+        n = D.Normal(3.0, 0.5)
+        s = n.sample([4000]).numpy()
+        assert abs(s.mean() - 3.0) < 0.05
+        assert abs(s.std() - 0.5) < 0.05
+
+    def test_kl_closed_form(self):
+        p, q = D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)
+        want = (math.log(2.0) + (1.0 + 1.0) / (2 * 4.0) - 0.5)
+        assert abs(float(D.kl_divergence(p, q)) - want) < 1e-5
+
+
+class TestUniformCategorical:
+    def test_uniform(self):
+        u = D.Uniform(2.0, 6.0)
+        assert float(u.mean) == 4.0
+        assert abs(float(u.entropy()) - math.log(4.0)) < 1e-6
+        inside = float(u.log_prob(paddle.to_tensor(np.float32(3.0))))
+        assert abs(inside + math.log(4.0)) < 1e-6
+        outside = float(u.log_prob(paddle.to_tensor(np.float32(7.0))))
+        assert outside == -np.inf
+
+    def test_categorical(self):
+        logits = paddle.to_tensor(
+            np.log(np.array([0.2, 0.3, 0.5], "float32")))
+        c = D.Categorical(logits)
+        lp = c.log_prob(paddle.to_tensor(np.array([2], "int64")))
+        assert abs(float(lp[0]) - math.log(0.5)) < 1e-5
+        want_h = -sum(p * math.log(p) for p in [0.2, 0.3, 0.5])
+        assert abs(float(c.entropy()) - want_h) < 1e-5
+        paddle.seed(0)
+        s = c.sample([2000]).numpy().ravel()
+        frac2 = (s == 2).mean()
+        assert abs(frac2 - 0.5) < 0.05
+
+
+class TestBetaDirichlet:
+    def test_beta_moments_and_sample(self):
+        b = D.Beta(2.0, 3.0)
+        assert abs(float(b.mean) - 0.4) < 1e-6
+        paddle.seed(0)
+        s = b.sample([3000]).numpy()
+        assert abs(s.mean() - 0.4) < 0.03
+        assert (s > 0).all() and (s < 1).all()
+        # log_prob at the mode: Beta(2,3) pdf(1/3) = 12*(1/3)*(2/3)^2
+        want = math.log(12 * (1 / 3) * (2 / 3) ** 2)
+        assert abs(float(b.log_prob(
+            paddle.to_tensor(np.float32(1 / 3)))) - want) < 1e-4
+
+    def test_dirichlet(self):
+        d = D.Dirichlet(paddle.to_tensor(
+            np.array([2.0, 3.0, 5.0], "float32")))
+        np.testing.assert_allclose(d.mean.numpy(), [0.2, 0.3, 0.5],
+                                   rtol=1e-5)
+        paddle.seed(0)
+        s = d.sample([2000]).numpy()
+        np.testing.assert_allclose(s.sum(-1), 1.0, atol=1e-5)
+        np.testing.assert_allclose(s.mean(0), [0.2, 0.3, 0.5], atol=0.03)
+        assert float(D.kl_divergence(d, d)) < 1e-5
+
+    def test_kl_beta(self):
+        p, q = D.Beta(2.0, 3.0), D.Beta(2.0, 3.0)
+        assert abs(float(D.kl_divergence(p, q))) < 1e-6
+
+
+class TestOtherDistributions:
+    def test_bernoulli(self):
+        b = D.Bernoulli(0.7)
+        assert abs(float(b.mean) - 0.7) < 1e-6
+        assert abs(float(b.variance) - 0.21) < 1e-6
+        lp1 = float(b.log_prob(paddle.to_tensor(np.float32(1.0))))
+        assert abs(lp1 - math.log(0.7)) < 1e-4
+
+    def test_laplace_lognormal_gumbel(self):
+        lap = D.Laplace(0.0, 1.0)
+        assert abs(float(lap.log_prob(
+            paddle.to_tensor(np.float32(0.0)))) + math.log(2.0)) < 1e-5
+        ln = D.LogNormal(0.0, 0.5)
+        assert abs(float(ln.mean) - math.exp(0.125)) < 1e-5
+        g = D.Gumbel(0.0, 1.0)
+        paddle.seed(0)
+        s = g.sample([3000]).numpy()
+        assert abs(s.mean() - 0.5772) < 0.1
+
+    def test_independent(self):
+        base = D.Normal(paddle.to_tensor(np.zeros(3, "float32")),
+                        paddle.to_tensor(np.ones(3, "float32")))
+        ind = D.Independent(base, 1)
+        x = paddle.to_tensor(np.zeros(3, "float32"))
+        want = 3 * float(base.log_prob(x).numpy()[0])
+        assert abs(float(ind.log_prob(x)) - want) < 1e-5
+
+    def test_transformed(self):
+        # exp(Normal) == LogNormal
+        td = D.TransformedDistribution(D.Normal(0.0, 0.5),
+                                       [D.ExpTransform()])
+        ln = D.LogNormal(0.0, 0.5)
+        x = paddle.to_tensor(np.float32(1.7))
+        assert abs(float(td.log_prob(x)) - float(ln.log_prob(x))) < 1e-5
+
+
+class TestFFT:
+    def test_fft_matches_numpy(self):
+        x = np.random.RandomState(0).randn(4, 16).astype("float32")
+        got = pfft.fft(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, np.fft.fft(x), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_rfft_irfft_roundtrip(self):
+        x = np.random.RandomState(1).randn(8, 32).astype("float32")
+        spec = pfft.rfft(paddle.to_tensor(x))
+        back = pfft.irfft(spec, n=32).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-4)
+
+    def test_fft2_and_shift(self):
+        x = np.random.RandomState(2).randn(4, 8).astype("float32")
+        got = pfft.fft2(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, np.fft.fft2(x), rtol=1e-4,
+                                   atol=1e-3)
+        sh = pfft.fftshift(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(sh, np.fft.fftshift(x))
+
+    def test_fftfreq(self):
+        np.testing.assert_allclose(pfft.fftfreq(8, 0.5).numpy(),
+                                   np.fft.fftfreq(8, 0.5))
+
+    def test_fft_grad(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(3).randn(16).astype("float32"),
+            stop_gradient=False)
+        spec = pfft.rfft(x)
+        (spec.abs() ** 2).sum().backward()
+        assert x.grad is not None
+        assert np.isfinite(x.grad.numpy()).all()
+
+
+class TestSignal:
+    def test_stft_shape_and_roundtrip(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 512).astype("float32")
+        n_fft, hop = 64, 16
+        window = np.hanning(n_fft).astype("float32")
+        spec = psignal.stft(paddle.to_tensor(x), n_fft,
+                            hop_length=hop,
+                            window=paddle.to_tensor(window))
+        assert spec.shape[0] == 2
+        assert spec.shape[1] == n_fft // 2 + 1
+        back = psignal.istft(spec, n_fft, hop_length=hop,
+                             window=paddle.to_tensor(window),
+                             length=512).numpy()
+        # roundtrip exact away from the edges
+        np.testing.assert_allclose(back[:, n_fft:-n_fft],
+                                   x[:, n_fft:-n_fft], atol=1e-3)
+
+    def test_stft_matches_manual_dft(self):
+        x = np.cos(2 * np.pi * 8 * np.arange(128) / 64).astype("float32")
+        spec = psignal.stft(paddle.to_tensor(x), 64, hop_length=64,
+                            center=False).numpy()
+        # pure 8-cycles-per-64-samples cosine: bin 8 dominates
+        mag = np.abs(spec[:, 0])
+        assert mag.argmax() == 8
