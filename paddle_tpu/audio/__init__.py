@@ -1,0 +1,12 @@
+"""paddle.audio parity: spectral features.
+
+Reference: python/paddle/audio/ (functional/functional.py hz_to_mel /
+mel_to_hz / mel_frequencies / fft_frequencies / compute_fbank_matrix /
+create_dct / power_to_db; features/layers.py Spectrogram /
+MelSpectrogram / LogMelSpectrogram / MFCC). Built over
+paddle_tpu.signal.stft — one XLA program per feature pipeline.
+"""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+
+__all__ = ["functional", "features"]

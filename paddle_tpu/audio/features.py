@@ -1,0 +1,87 @@
+"""paddle.audio.features layers (reference: audio/features/layers.py
+Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn.layer.layers import Layer
+from ..ops import math as ops_math
+from .. import signal as psignal
+from . import functional as F
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True,
+                 pad_mode="reflect", dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = F.get_window(window, self.win_length, dtype=dtype)
+
+    def forward(self, x):
+        spec = psignal.stft(x, self.n_fft, hop_length=self.hop_length,
+                            win_length=self.win_length,
+                            window=self.window, center=self.center,
+                            pad_mode=self.pad_mode)
+        mag = spec.abs()
+        if self.power != 1.0:
+            mag = mag ** self.power
+        return mag
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center, pad_mode,
+                                       dtype)
+        self.fbank = F.compute_fbank_matrix(
+            sr, n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max, htk=htk,
+            norm=norm, dtype=dtype)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)          # [..., n_freqs, n_frames]
+        from ..ops.linalg import matmul
+        return matmul(self.fbank, spec)     # [..., n_mels, n_frames]
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, ref_value=1.0, amin=1e-10, top_db=None,
+                 **mel_kwargs):
+        super().__init__()
+        self.mel = MelSpectrogram(sr=sr, **mel_kwargs)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return F.power_to_db(self.mel(x), ref_value=self.ref_value,
+                             amin=self.amin, top_db=self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, norm="ortho", **mel_kwargs):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(sr=sr, **mel_kwargs)
+        n_mels = getattr(self.log_mel.mel.fbank, "shape", [64])[0]
+        self.dct = F.create_dct(n_mfcc, n_mels, norm=norm)
+
+    def forward(self, x):
+        logmel = self.log_mel(x)            # [..., n_mels, n_frames]
+        from ..ops.linalg import matmul
+        from ..ops.manipulation import transpose
+        # [n_mels, n_mfcc]^T @ [..., n_mels, F] -> [..., n_mfcc, F]
+        ndim = logmel.ndim
+        perm = list(range(ndim - 2)) + [ndim - 1, ndim - 2]
+        out = matmul(transpose(logmel, perm), self.dct)
+        return transpose(out, perm)

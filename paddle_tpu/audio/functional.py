@@ -1,0 +1,140 @@
+"""paddle.audio.functional (reference: audio/functional/functional.py
+and window.py get_window)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops import creation, math as ops_math
+from ..ops._helpers import as_tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies",
+           "fft_frequencies", "compute_fbank_matrix", "create_dct",
+           "power_to_db", "get_window"]
+
+
+def hz_to_mel(freq, htk=False):
+    """reference: functional.py hz_to_mel (Slaney by default)."""
+    scalar = not isinstance(freq, Tensor)
+    f = np.asarray(freq._value if isinstance(freq, Tensor) else freq,
+                   dtype="float64")
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep,
+                       mel)
+    return float(mel) if scalar and mel.ndim == 0 else \
+        creation.to_tensor(mel.astype("float32"))
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not isinstance(mel, Tensor)
+    m = np.asarray(mel._value if isinstance(mel, Tensor) else mel,
+                   dtype="float64")
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = np.where(m >= min_log_mel,
+                      min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                      hz)
+    return float(hz) if scalar and hz.ndim == 0 else \
+        creation.to_tensor(hz.astype("float32"))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    low = hz_to_mel(float(f_min), htk)
+    high = hz_to_mel(float(f_max), htk)
+    mels = np.linspace(low, high, n_mels)
+    hz = np.asarray([mel_to_hz(float(m), htk) for m in mels])
+    return creation.to_tensor(hz.astype(dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return creation.to_tensor(
+        np.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Triangular mel filter bank [n_mels, 1 + n_fft//2] (reference:
+    functional.py compute_fbank_matrix)."""
+    f_max = f_max if f_max is not None else sr / 2.0
+    fft_freqs = np.linspace(0, sr / 2, 1 + n_fft // 2)
+    mel_f = np.asarray(
+        mel_frequencies(n_mels + 2, f_min, f_max, htk).numpy(),
+        dtype="float64")
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_freqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return creation.to_tensor(weights.astype(dtype))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II matrix [n_mels, n_mfcc] (reference: functional.py
+    create_dct)."""
+    n = np.arange(n_mels, dtype="float64")
+    k = np.arange(n_mfcc, dtype="float64")[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return creation.to_tensor(dct.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """reference: functional.py power_to_db (librosa semantics)."""
+    x = as_tensor(spect)
+    log_spec = 10.0 * (ops_math.log10(x.clip(min=amin))
+                       - math.log10(max(amin, ref_value)))
+    if top_db is not None:
+        max_val = float(log_spec.max())
+        log_spec = log_spec.clip(min=max_val - top_db)
+    return log_spec
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """reference: audio/functional/window.py get_window."""
+    n = win_length
+    m = n if fftbins else n - 1
+    i = np.arange(n, dtype="float64")
+    if isinstance(window, tuple):
+        name, arg = window[0], window[1]
+    else:
+        name, arg = window, None
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * i / m)
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * i / m)
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * i / m)
+             + 0.08 * np.cos(4 * math.pi * i / m))
+    elif name in ("rect", "boxcar", "ones"):
+        w = np.ones(n)
+    elif name == "gaussian":
+        sigma = arg if arg is not None else 0.4 * (n / 2)
+        w = np.exp(-0.5 * ((i - (n - 1) / 2) / sigma) ** 2)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return creation.to_tensor(w.astype(dtype))

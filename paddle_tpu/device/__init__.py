@@ -1,0 +1,108 @@
+"""paddle.device parity: device selection, sync, streams, memory stats.
+
+Reference: python/paddle/device/ (set_device/get_device, cuda submodule
+with streams/events + memory introspection over the C++ allocator
+stats, paddle/fluid/memory/stats.h). TPU mapping: device selection
+resolves to PJRT local devices (core/device.py); streams/events are
+XLA-managed, so Stream/Event are ordering no-ops that preserve the API;
+memory stats read PJRT's per-device allocator counters
+(Device.memory_stats())."""
+from __future__ import annotations
+
+import jax
+
+from ..core.device import (  # noqa: F401
+    Place, CPUPlace, TPUPlace, XLAPlace, CUDAPlace, CUDAPinnedPlace,
+    set_device, get_device, get_all_devices, device_count,
+    is_compiled_with_cuda, is_compiled_with_rocm, is_compiled_with_xpu,
+    is_compiled_with_npu, is_compiled_with_mlu, is_compiled_with_ipu,
+    is_compiled_with_cinn, is_compiled_with_distribute, jax_device)
+
+__all__ = ["set_device", "get_device", "get_all_devices", "device_count",
+           "synchronize", "Stream", "Event", "current_stream",
+           "stream_guard", "cuda", "Place", "CPUPlace", "TPUPlace",
+           "CUDAPlace", "CUDAPinnedPlace", "XLAPlace",
+           "get_available_device", "get_available_custom_device",
+           "is_compiled_with_cuda", "is_compiled_with_rocm",
+           "is_compiled_with_xpu", "is_compiled_with_npu",
+           "is_compiled_with_mlu", "is_compiled_with_ipu",
+           "is_compiled_with_cinn", "is_compiled_with_distribute"]
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes (reference:
+    paddle.device.synchronize over DeviceContext.Wait)."""
+    jax.effects_barrier()
+    # flush async dispatch by touching a trivial computation
+    jax.block_until_ready(jax.numpy.zeros(()))
+
+
+def get_available_device():
+    return get_all_devices()
+
+
+def get_available_custom_device():
+    return []
+
+
+class Stream:
+    """API-compatible stream object. XLA owns real stream scheduling; op
+    order within a trace already defines the dependency graph, so these
+    are ordering no-ops that keep stream-structured code running
+    (reference: device/cuda/streams.py Stream)."""
+
+    def __init__(self, device=None, priority=None):
+        self.device = device
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+    def query(self):
+        return True
+
+
+class Event:
+    """reference: device/cuda/streams.py Event."""
+
+    def __init__(self, enable_timing=False, blocking=False,
+                 interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+_current = Stream()
+
+
+def current_stream(device=None):
+    return _current
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *exc):
+        return False
+
+
+from . import cuda  # noqa: E402,F401

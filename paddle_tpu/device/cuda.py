@@ -1,0 +1,102 @@
+"""paddle.device.cuda parity — "cuda" names route to the accelerator.
+
+Reference: python/paddle/device/cuda/__init__.py (memory_allocated etc.
+over memory/stats.h STAT_GPU counters). Here the counters come from
+PJRT's per-device allocator (jax Device.memory_stats()); backends
+without stats (CPU) report 0.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["device_count", "current_stream", "synchronize",
+           "memory_allocated", "max_memory_allocated",
+           "memory_reserved", "max_memory_reserved", "empty_cache",
+           "get_device_properties", "get_device_name",
+           "get_device_capability", "Stream", "Event", "stream_guard"]
+
+
+def _dev(device=None):
+    devs = jax.local_devices()
+    if device is None:
+        return devs[0]
+    if isinstance(device, int):
+        return devs[device % len(devs)]
+    idx = getattr(device, "index", 0)
+    return devs[idx % len(devs)]
+
+
+def _stat(device, *names):
+    stats = None
+    try:
+        stats = _dev(device).memory_stats()
+    except Exception:
+        return 0
+    if not stats:
+        return 0
+    for n in names:
+        if n in stats:
+            return int(stats[n])
+    return 0
+
+
+def device_count():
+    return len(jax.local_devices())
+
+
+def memory_allocated(device=None):
+    return _stat(device, "bytes_in_use")
+
+
+def max_memory_allocated(device=None):
+    return _stat(device, "peak_bytes_in_use", "bytes_in_use")
+
+
+def memory_reserved(device=None):
+    return _stat(device, "bytes_reserved", "bytes_limit")
+
+
+def max_memory_reserved(device=None):
+    return _stat(device, "peak_bytes_reserved", "bytes_limit")
+
+
+def empty_cache():
+    """PJRT owns the allocator; nothing to release from Python."""
+    return None
+
+
+def get_device_properties(device=None):
+    d = _dev(device)
+
+    class _Props:
+        name = getattr(d, "device_kind", "cpu")
+        major = 0
+        minor = 0
+        total_memory = _stat(device, "bytes_limit")
+        multi_processor_count = 1
+
+        def __repr__(self):
+            return f"DeviceProperties(name={self.name!r})"
+
+    return _Props()
+
+
+def get_device_name(device=None):
+    return getattr(_dev(device), "device_kind", "cpu")
+
+
+def get_device_capability(device=None):
+    return (0, 0)
+
+
+def synchronize(device=None):
+    from . import synchronize as _sync
+    return _sync(device)
+
+
+def current_stream(device=None):
+    from . import current_stream as _cs
+    return _cs(device)
+
+
+from . import Stream, Event, stream_guard  # noqa: E402,F401

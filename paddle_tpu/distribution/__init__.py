@@ -371,17 +371,16 @@ class Multinomial(Distribution):
         return float(self.total_count) * self.probs * (1.0 - self.probs)
 
     def sample(self, shape=()):
+        if shape:
+            raise NotImplementedError(
+                "Multinomial.sample(shape) beyond () — draw in a loop")
         draws = creation.multinomial(self.probs,
                                      num_samples=self.total_count,
                                      replacement=True)    # [..., N]
         k = self.probs.shape[-1]
         from ..nn.functional import one_hot
         oh = one_hot(draws.astype("int64"), num_classes=k)
-        out = oh.sum(axis=-2)
-        if shape:
-            raise NotImplementedError(
-                "Multinomial.sample(shape) beyond () — draw in a loop")
-        return out
+        return oh.sum(axis=-2)
 
     def log_prob(self, value):
         value = _as_tensor(value)
@@ -667,7 +666,16 @@ def _kl_normal_normal(p, q):
 
 @register_kl(Uniform, Uniform)
 def _kl_uniform_uniform(p, q):
-    return ops_math.log((q.high - q.low) / (p.high - p.low))
+    # +inf when p's support is not contained in q's (density ratio is
+    # unbounded there); a finite/negative value would silently corrupt
+    # variational objectives
+    from ..ops import comparison
+    from ..ops.manipulation import where
+    ok = ops_math.logical_and(
+        comparison.less_equal(q.low, p.low),
+        comparison.greater_equal(q.high, p.high))
+    val = ops_math.log((q.high - q.low) / (p.high - p.low))
+    return where(ok, val, creation.full_like(val, np.inf))
 
 
 @register_kl(Categorical, Categorical)

@@ -1,0 +1,15 @@
+"""paddle.incubate parity shell: fused layers, functional, optimizers.
+
+Reference: python/paddle/incubate/ — the fused transformer layers
+(incubate/nn/layer/fused_transformer.py:192 FusedMultiHeadAttention,
+:479 FusedFeedForward, :1003 FusedMultiTransformer over handwritten
+CUDA fusions in paddle/fluid/operators/fused/). On TPU the "fusion" is
+XLA's job: these layers express the same computation with the flash-
+attention Pallas kernel on the hot path and let the compiler fuse the
+rest — same API, same math, no hand-written kernel zoo.
+"""
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import autograd  # noqa: F401
+
+__all__ = ["nn", "optimizer", "autograd"]

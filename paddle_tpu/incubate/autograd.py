@@ -1,0 +1,110 @@
+"""paddle.incubate.autograd: functional higher-order AD.
+
+Reference: python/paddle/incubate/autograd/functional.py (jvp/vjp/
+Jacobian/Hessian over the prim-op AD rules). The TPU build gets these
+directly from jax's transforms over functionalized Tensor code.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from ..core.tensor import Tensor
+from ..autograd import jacobian as _tape_jacobian, hessian as _tape_hessian
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "jacobian", "hessian"]
+
+
+def _functionalize(func):
+    def pure(*vals):
+        args = [Tensor(v, stop_gradient=True) for v in vals]
+        out = func(*args)
+        if isinstance(out, (list, tuple)):
+            return tuple(o._value for o in out)
+        return out._value
+    return pure
+
+
+def _vals(xs):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    return [x._value for x in xs]
+
+
+def _wrap(out):
+    if isinstance(out, (list, tuple)):
+        return tuple(Tensor(o) for o in out)
+    return Tensor(out)
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode: returns (func(xs), J @ v) (reference:
+    incubate/autograd/functional.py jvp)."""
+    vals = _vals(xs)
+    if v is None:
+        tang = [np.ones_like(np.asarray(x)) for x in vals]
+    else:
+        tang = _vals(v)
+    out, tangents = jax.jvp(_functionalize(func), tuple(vals),
+                            tuple(tang))
+    return _wrap(out), _wrap(tangents)
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode: returns (func(xs), v @ J) (reference: functional.py
+    vjp)."""
+    vals = _vals(xs)
+    out, vjp_fn = jax.vjp(_functionalize(func), *vals)
+    if v is None:
+        ct = np.ones_like(np.asarray(out)) if not isinstance(out, tuple) \
+            else tuple(np.ones_like(np.asarray(o)) for o in out)
+    else:
+        ct = v._value if isinstance(v, Tensor) else tuple(_vals(v))
+    grads = vjp_fn(ct)
+    return _wrap(out), _wrap(grads if len(grads) > 1 else grads[0])
+
+
+class Jacobian:
+    """Lazy Jacobian matrix (reference: functional.py Jacobian)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        vals = _vals(xs)
+        jac = jax.jacobian(_functionalize(func),
+                           argnums=tuple(range(len(vals))))(*vals)
+        self._jac = jac if len(vals) > 1 else (jac,)
+        self._single = len(vals) == 1
+
+    def __getitem__(self, idx):
+        return Tensor(self._jac[0][idx]) if self._single else \
+            Tensor(self._jac[idx[0]][idx[1:]])
+
+    def _full(self):
+        """All input blocks concatenated along the last (input) axis —
+        multi-input Jacobians must not silently drop blocks."""
+        blocks = [np.asarray(j) for j in self._jac]
+        if len(blocks) == 1:
+            return blocks[0]
+        return np.concatenate(
+            [b.reshape(b.shape[0] if b.ndim > 1 else 1, -1)
+             for b in blocks], axis=-1)
+
+    @property
+    def shape(self):
+        return list(self._full().shape)
+
+    def numpy(self):
+        return self._full()
+
+
+class Hessian(Jacobian):
+    """Lazy Hessian matrix (reference: functional.py Hessian)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        vals = _vals(xs)
+        hes = jax.hessian(_functionalize(func))(*vals)
+        self._jac = (hes,)
+        self._single = True
+
+
+# tape-based variants re-exported for API parity
+jacobian = _tape_jacobian
+hessian = _tape_hessian

@@ -1,0 +1,143 @@
+"""paddle.incubate.nn: fused transformer building blocks.
+
+Reference: incubate/nn/layer/fused_transformer.py (FusedMultiHeadAttention
+:192, FusedFeedForward :479, FusedMultiTransformer :1003) — single-op
+CUDA megakernels (fused_attention_op.cu, fused_feedforward_op.cu,
+fused_multi_transformer_op.cu). The TPU equivalents express the same
+fused semantics (pre/post-LN + residual + dropout inside the block);
+attention rides the Pallas flash kernel, everything else fuses in XLA.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ...nn.layer.layers import Layer
+from ...nn.layer.common import Linear, Dropout
+from ...nn.layer.norm import LayerNorm
+from ...nn.layer.container import LayerList
+from . import functional  # noqa: F401
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer",
+           "functional"]
+
+
+class FusedMultiHeadAttention(Layer):
+    """reference: fused_transformer.py:192 — attn(LN(x)) + residual in
+    one block, normalize_before selecting pre/post-LN."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        from ...nn.layer.transformer import MultiHeadAttention
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.normalize_before = normalize_before
+        self.attn = MultiHeadAttention(embed_dim, num_heads,
+                                       dropout=attn_dropout_rate)
+        self.pre_ln = LayerNorm(embed_dim, epsilon=epsilon)
+        self.ln = LayerNorm(embed_dim, epsilon=epsilon)
+        self.dropout = Dropout(dropout_rate)
+
+    def forward(self, x, attn_mask=None, cache=None):
+        residual = x
+        if self.normalize_before:
+            x = self.pre_ln(x)
+        out = self.attn(x, x, x, attn_mask=attn_mask, cache=cache)
+        if isinstance(out, tuple):
+            out = out[0]
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """reference: fused_transformer.py:479 — linear-act-dropout-linear
+    + residual + LN in one block."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        import paddle_tpu.nn.functional as F
+        self.normalize_before = normalize_before
+        self.linear1 = Linear(d_model, dim_feedforward,
+                              linear1_weight_attr, linear1_bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model,
+                              linear2_weight_attr, linear2_bias_attr)
+        self.ln = LayerNorm(d_model, epsilon=epsilon)
+        self.dropout1 = Dropout(act_dropout_rate
+                                if act_dropout_rate is not None
+                                else dropout_rate)
+        self.dropout2 = Dropout(dropout_rate)
+        self._act = getattr(F, activation)
+
+    def forward(self, x):
+        residual = x
+        if self.normalize_before:
+            x = self.ln(x)
+        x = self.linear2(self.dropout1(self._act(self.linear1(x))))
+        out = residual + self.dropout2(x)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """reference: fused_transformer.py FusedTransformerEncoderLayer."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate
+            if attn_dropout_rate is not None else dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask, cache=cache)
+        return self.ffn(out)
+
+
+class FusedMultiTransformer(Layer):
+    """reference: fused_transformer.py:1003 — the full decoder stack as
+    one op (fused_multi_transformer_op.cu, inference path with KV
+    cache). Here: a stack of fused encoder layers; XLA compiles the
+    whole stack into one program under jit."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, num_layers=1, nranks=1,
+                 ring_id=-1, name=None, **kwargs):
+        super().__init__()
+        self.layers = LayerList([
+            FusedTransformerEncoderLayer(
+                embed_dim, num_heads, dim_feedforward,
+                dropout_rate=dropout_rate, activation=activation,
+                normalize_before=normalize_before)
+            for _ in range(num_layers)])
+
+    def forward(self, x, attn_mask=None, caches=None):
+        for i, layer in enumerate(self.layers):
+            x = layer(x, src_mask=attn_mask,
+                      cache=None if caches is None else caches[i])
+        return x

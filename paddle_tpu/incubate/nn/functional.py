@@ -1,0 +1,99 @@
+"""paddle.incubate.nn.functional: fused functional entry points.
+
+Reference: incubate/nn/functional/fused_transformer.py
+(fused_multi_head_attention, fused_feedforward). Thin functional
+equivalents of the fused layers — the math is identical; XLA performs
+the fusion the CUDA megakernels hand-code.
+"""
+from __future__ import annotations
+
+__all__ = ["fused_multi_head_attention", "fused_feedforward",
+           "fused_linear"]
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False,
+                 name=None):
+    """reference: fused_gemm_epilogue_op.cu — matmul+bias in one op
+    (cuBLASLt epilogue); XLA fuses these natively."""
+    import paddle_tpu as paddle
+    w = paddle.transpose(weight, [1, 0]) if transpose_weight else weight
+    out = paddle.matmul(x, w)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight,
+                      linear1_bias=None, linear2_bias=None,
+                      ln1_scale=None, ln1_bias=None, ln2_scale=None,
+                      ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, name=None):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1:], weight=ln1_scale,
+                         bias=ln1_bias, epsilon=ln1_epsilon)
+    h = fused_linear(x, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    if training and dropout1_rate:
+        h = F.dropout(h, p=dropout1_rate, training=training)
+    h = fused_linear(h, linear2_weight, linear2_bias)
+    if training and dropout2_rate:
+        h = F.dropout(h, p=dropout2_rate, training=training)
+    out = residual + h
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1:], weight=ln2_scale,
+                           bias=ln2_bias, epsilon=ln2_epsilon)
+    return out
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None,
+                               cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5,
+                               attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True,
+                               mode="upscale_in_train", ring_id=-1,
+                               name=None):
+    """reference: fused_attention_op.cu semantics: optional pre-LN, one
+    packed QKV gemm [3, H, D/H, D], flash attention, out proj, residual,
+    optional post-LN."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1:], weight=pre_ln_scale,
+                         bias=pre_ln_bias, epsilon=pre_ln_epsilon)
+    b, s, d = x.shape
+    n_heads = qkv_weight.shape[1]
+    head_dim = qkv_weight.shape[2]
+    # qkv_weight: [3, n_heads, head_dim, d]
+    w = paddle.reshape(qkv_weight, [3 * n_heads * head_dim, d])
+    qkv = paddle.matmul(x, paddle.transpose(w, [1, 0]))
+    if qkv_bias is not None:
+        qkv = qkv + paddle.reshape(qkv_bias, [3 * n_heads * head_dim])
+    qkv = paddle.reshape(qkv, [b, s, 3, n_heads, head_dim])
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    out = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0,
+        training=training)
+    out = paddle.reshape(out, [b, s, n_heads * head_dim])
+    out = paddle.matmul(out, linear_weight)
+    if linear_bias is not None:
+        out = out + linear_bias
+    if training and dropout_rate:
+        out = F.dropout(out, p=dropout_rate, training=training)
+    out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1:], weight=ln_scale,
+                           bias=ln_bias, epsilon=ln_epsilon)
+    return out

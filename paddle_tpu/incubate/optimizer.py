@@ -1,0 +1,125 @@
+"""paddle.incubate.optimizer: LookAhead, ModelAverage.
+
+Reference: python/paddle/incubate/optimizer/lookahead.py (slow/fast
+weight interpolation every k steps), modelaverage.py (running parameter
+average applied at eval time).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead(Optimizer):
+    """reference: incubate/optimizer/lookahead.py LookAhead(inner, alpha,
+    k): every k inner steps, slow <- slow + alpha*(fast - slow) and the
+    fast weights reset to slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_num = 0
+        # slow weights anchor at the CURRENT (pre-update) parameters —
+        # capturing them lazily after k steps would make the first sync
+        # a no-op and permanently offset the anchor
+        self._slow = {id(p): p._value
+                      for p in inner_optimizer._parameter_list}
+        self._parameter_list = inner_optimizer._parameter_list
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k == 0:
+            for p in self._parameter_list:
+                slow = self._slow.get(id(p), p._value)
+                new_slow = slow + self.alpha * (p._value - slow)
+                self._slow[id(p)] = new_slow
+                p._rebind(new_slow)
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step_num
+        sd["lookahead_slow"] = {
+            i: np.asarray(v)
+            for i, v in enumerate(
+                self._slow.get(id(p)) for p in self._parameter_list)}
+        return sd
+
+    def set_state_dict(self, sd):
+        self._step_num = sd.pop("lookahead_step", 0)
+        slow = sd.pop("lookahead_slow", None)
+        if slow is not None:
+            for i, p in enumerate(self._parameter_list):
+                if i in slow or str(i) in slow:
+                    v = slow.get(i, slow.get(str(i)))
+                    self._slow[id(p)] = jnp.asarray(v)
+        self.inner_optimizer.set_state_dict(sd)
+
+
+class ModelAverage(Optimizer):
+    """reference: incubate/optimizer/modelaverage.py — running average
+    of parameters, swapped in via apply()/restore() around eval."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._parameter_list = list(parameters or [])
+        self._sums = {id(p): jnp.zeros_like(p._value)
+                      for p in self._parameter_list}
+        self._counts = {id(p): 0 for p in self._parameter_list}
+        self._backup = {}
+
+    def step(self):
+        for p in self._parameter_list:
+            self._sums[id(p)] = self._sums[id(p)] + p._value
+            self._counts[id(p)] += 1
+
+    def minimize(self, loss, **kw):
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=False):
+        pass
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights in (context-manager friendly)."""
+        if any(c == 0 for c in self._counts.values()):
+            raise RuntimeError(
+                "ModelAverage.apply() before any step(): no averages "
+                "accumulated yet (weights would be zeroed)")
+        for p in self._parameter_list:
+            self._backup[id(p)] = p._value
+            p._rebind(self._sums[id(p)] / self._counts[id(p)])
+        self._need_restore = need_restore
+        return self
+
+    def restore(self, executor=None):
+        for p in self._parameter_list:
+            if id(p) in self._backup:
+                p._rebind(self._backup.pop(id(p)))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if getattr(self, "_need_restore", True):
+            self.restore()
+        return False

@@ -1,0 +1,253 @@
+"""paddle.sparse parity: COO/CSR tensors and sparse ops.
+
+Reference: python/paddle/sparse (sparse_coo_tensor/sparse_csr_tensor
+creation, to_dense/to_sparse_coo conversions, add/multiply/matmul/
+masked_matmul, sparse nn activations) over paddle/phi/kernels/sparse/.
+
+TPU design: sparse storage is jax.experimental.sparse.BCOO — XLA's
+batched-COO format whose matmuls lower to gather/scatter + dense MXU
+tiles. The SparseCooTensor here wraps a BCOO; dense interop goes
+through the framework Tensor so results land back on the autograd tape.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from ..core.dispatch import register_op
+from ..ops._helpers import as_tensor, apply_op
+
+
+# tape-integrated sparse kernels: BCOO components travel as plain arrays
+# (indices nondiff int; values/dense differentiate through the generic
+# op vjp), so sparse matmuls join the autograd graph like any other op
+def _spmm_fwd(data, indices, dense, shape, reverse=False):
+    bcoo = jsparse.BCOO((data, indices), shape=shape)
+    return dense @ bcoo if reverse else bcoo @ dense
+
+
+def _sddmm_fwd(x, y, indices, shape):
+    rows, cols = indices[:, 0], indices[:, 1]
+    return jnp.einsum("nk,nk->n", x[rows, :],
+                      jnp.swapaxes(y, 0, 1)[cols, :]).astype(x.dtype)
+
+
+register_op("sparse_spmm", _spmm_fwd)
+register_op("sparse_sddmm", _sddmm_fwd)
+register_op("sparse_relu_values", lambda v: jnp.maximum(v, 0))
+register_op("sparse_scale_values", lambda v, c: v * c)
+register_op(
+    "sparse_union_values",
+    # concatenated duplicate-coordinate union: values sum after
+    # coalescing (indices handled host-side)
+    lambda va, vb, sign: jnp.concatenate([va, sign * vb]))
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "is_sparse_coo", "is_sparse_csr", "to_dense", "to_sparse_coo",
+           "add", "subtract", "multiply", "matmul", "masked_matmul",
+           "relu", "transpose", "coalesce"]
+
+
+class SparseCooTensor:
+    """COO sparse tensor over jax BCOO (reference:
+    paddle/phi/core/sparse_coo_tensor.h). Holds its values as a live
+    Tensor so gradients from sparse ops land on values().grad —
+    trainable sparse parameters work."""
+
+    def __init__(self, bcoo: jsparse.BCOO, values_tensor=None):
+        self._bcoo = bcoo
+        self._values_t = (values_tensor if values_tensor is not None
+                          else Tensor(bcoo.data))
+
+    # -- paddle surface ------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        # paddle layout: [sparse_ndim, nnz]; BCOO stores [nnz, ndim]
+        return Tensor(jnp.swapaxes(self._bcoo.indices, -1, -2))
+
+    def values(self):
+        return self._values_t
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def transpose(self, perm):
+        return SparseCooTensor(
+            jsparse.bcoo_transpose(self._bcoo,
+                                   permutation=tuple(perm)))
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def astype(self, dtype):
+        from ..core import dtype as dtypes
+        return SparseCooTensor(
+            self._bcoo.astype(dtypes.to_np_dtype(dtype)))
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, "
+                f"nnz={self.nnz()}, dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """reference: python/paddle/sparse/creation.py sparse_coo_tensor.
+    indices: [sparse_ndim, nnz]; values: [nnz, ...dense dims]."""
+    idx = indices._value if isinstance(indices, Tensor) else \
+        jnp.asarray(np.asarray(indices))
+    val = values._value if isinstance(values, Tensor) else \
+        jnp.asarray(np.asarray(values))
+    idx = jnp.swapaxes(idx.astype(jnp.int32), 0, 1)   # -> [nnz, ndim]
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx.max(axis=0)))
+    bcoo = jsparse.BCOO((val, idx), shape=tuple(int(s) for s in shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    """CSR creation — stored as (coalesced) BCOO internally; the crows
+    compressed format is expanded to row indices (the TPU kernels are
+    COO-gather based either way)."""
+    crows_np = np.asarray(crows._value if isinstance(crows, Tensor)
+                          else crows).astype(np.int64)
+    cols_np = np.asarray(cols._value if isinstance(cols, Tensor)
+                         else cols).astype(np.int64)
+    rows = np.repeat(np.arange(len(crows_np) - 1),
+                     np.diff(crows_np))
+    indices = np.stack([rows, cols_np])
+    return sparse_coo_tensor(indices, values, shape)
+
+
+def is_sparse_coo(x):
+    return isinstance(x, SparseCooTensor) and x.is_sparse_coo()
+
+
+def is_sparse_csr(x):
+    return False  # CSR is stored as COO internally
+
+
+def to_dense(x):
+    if isinstance(x, SparseCooTensor):
+        return x.to_dense()
+    return as_tensor(x)
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    """Dense Tensor -> SparseCooTensor (reference:
+    Tensor.to_sparse_coo)."""
+    t = as_tensor(x)
+    n = sparse_dim if sparse_dim is not None else t.ndim
+    bcoo = jsparse.BCOO.fromdense(t._value, n_batch=0,
+                                  n_dense=t.ndim - n)
+    return SparseCooTensor(bcoo)
+
+
+def _union(x, y, sign):
+    """Tape-connected union add: concatenated values (sum after
+    coalesce) over concatenated coordinates."""
+    vals = apply_op("sparse_union_values", x.values(), y.values(),
+                    attrs=dict(sign=float(sign)))
+    idx = jnp.concatenate([x._bcoo.indices, y._bcoo.indices])
+    bcoo = jsparse.BCOO((vals._value, idx), shape=x._bcoo.shape)
+    # NB: coalescing merges duplicate coordinates, so the result's
+    # values() tensor is the coalesced data (a fresh leaf); gradient
+    # pipelines should apply add/subtract before, not after, the
+    # trainable values they differentiate.
+    return SparseCooTensor(bcoo.sum_duplicates())
+
+
+def add(x, y, name=None):
+    if not (isinstance(x, SparseCooTensor)
+            and isinstance(y, SparseCooTensor)):
+        raise TypeError("sparse.add needs two SparseCooTensors; "
+                        "mix with dense via to_dense()")
+    return _union(x, y, 1.0)
+
+
+def subtract(x, y, name=None):
+    if not (isinstance(x, SparseCooTensor)
+            and isinstance(y, SparseCooTensor)):
+        raise TypeError("sparse.subtract needs two SparseCooTensors")
+    return _union(x, y, -1.0)
+
+
+def multiply(x, y, name=None):
+    """Elementwise multiply. Sparse*scalar keeps the tape; sparse*sparse
+    goes through the dense intersection."""
+    if isinstance(y, (int, float)):
+        vals = apply_op("sparse_scale_values", x.values(),
+                        attrs=dict(c=float(y)))
+        return SparseCooTensor(
+            jsparse.BCOO((vals._value, x._bcoo.indices),
+                         shape=x._bcoo.shape), values_tensor=vals)
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        dense = x._bcoo.todense() * y._bcoo.todense()
+        return to_sparse_coo(Tensor(dense))
+    raise TypeError("unsupported operand types for sparse.multiply")
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense -> dense (reference: sparse/matmul.py). The BCOO
+    matmul lowers to XLA gather + dense dot tiles; grads flow to both
+    the dense operand and the sparse values."""
+    if isinstance(x, SparseCooTensor):
+        return apply_op("sparse_spmm", x.values(),
+                        Tensor(x._bcoo.indices), as_tensor(y),
+                        attrs=dict(shape=tuple(x._bcoo.shape),
+                                   reverse=False))
+    if isinstance(y, SparseCooTensor):
+        return apply_op("sparse_spmm", y.values(),
+                        Tensor(y._bcoo.indices), as_tensor(x),
+                        attrs=dict(shape=tuple(y._bcoo.shape),
+                                   reverse=True))
+    raise TypeError("sparse.matmul needs at least one SparseCooTensor")
+
+
+def masked_matmul(x, y, mask, name=None):
+    """Dense @ dense evaluated only at mask's nonzero coordinates
+    (reference: sparse/matmul.py masked_matmul -> SDDMM kernel)."""
+    idx = mask._bcoo.indices          # [nnz, 2]
+    vals = apply_op("sparse_sddmm", as_tensor(x), as_tensor(y),
+                    Tensor(idx), attrs=dict(shape=tuple(
+                        mask._bcoo.shape)))
+    return SparseCooTensor(
+        jsparse.BCOO((vals._value, idx), shape=mask._bcoo.shape),
+        values_tensor=vals)
+
+
+def relu(x, name=None):
+    """Sparse ReLU: zero-preserving, applies to stored values only
+    (reference: sparse/nn/functional/activation.py). Tape-connected:
+    gradients flow back to x.values()."""
+    vals = apply_op("sparse_relu_values", x.values())
+    return SparseCooTensor(
+        jsparse.BCOO((vals._value, x._bcoo.indices),
+                     shape=x._bcoo.shape), values_tensor=vals)
+
+
+def transpose(x, perm, name=None):
+    return x.transpose(perm)
+
+
+def coalesce(x, name=None):
+    return x.coalesce()

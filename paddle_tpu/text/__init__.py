@@ -1,0 +1,100 @@
+"""paddle.text parity: text ops + dataset shells.
+
+Reference: python/paddle/text/ (viterbi_decode over
+operators/viterbi_decode_op, ViterbiDecoder layer, and the downloadable
+datasets). The datasets require network access and raise with the
+download URL; the ops are fully implemented (viterbi as one lax.scan —
+the TPU shape of the reference's dynamic-programming CUDA kernel).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..nn.layer.layers import Layer
+from ..ops._helpers import as_tensor, apply_op
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _viterbi_fwd(potentials, trans, lengths, include_bos_eos_tag=True):
+    """potentials: [B, L, T]; trans: [T, T]; lengths: [B] ->
+    (scores [B], paths [B, L])."""
+    B, L, T = potentials.shape
+    bos = T - 2 if include_bos_eos_tag else None
+    eos = T - 1 if include_bos_eos_tag else None
+
+    init = potentials[:, 0]
+    if include_bos_eos_tag:
+        init = init + trans[bos][None, :]
+
+    def step(carry, t):
+        alpha = carry                              # [B, T]
+        emit = potentials[:, t]                    # [B, T]
+        # score[b, i, j] = alpha[b, i] + trans[i, j] + emit[b, j]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)     # [B, T]
+        best_score = jnp.max(scores, axis=1) + emit
+        # mask out positions beyond each sequence's length
+        active = (t < lengths)[:, None]
+        new_alpha = jnp.where(active, best_score, alpha)
+        back = jnp.where(active, best_prev,
+                         jnp.arange(T)[None, :])
+        return new_alpha, back
+
+    alpha, backs = jax.lax.scan(step, init, jnp.arange(1, L))
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, eos][None, :]
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1)          # [B]
+
+    def backtrack(carry, back_t):
+        tag = carry                                # [B]
+        prev = jnp.take_along_axis(back_t, tag[:, None],
+                                   axis=1)[:, 0]
+        return prev, tag
+
+    first_tag, rest = jax.lax.scan(backtrack, last_tag, backs,
+                                   reverse=True)
+    paths = jnp.concatenate([first_tag[None, :], rest], axis=0)  # [L, B]
+    paths = jnp.swapaxes(paths, 0, 1)
+    return scores, paths.astype(jnp.int64)
+
+
+register_op("viterbi_decode", _viterbi_fwd, nondiff=True)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """reference: python/paddle/text/viterbi_decode.py viterbi_decode ->
+    (scores, paths)."""
+    return apply_op("viterbi_decode", as_tensor(potentials),
+                    as_tensor(transition_params), as_tensor(lengths),
+                    attrs=dict(
+                        include_bos_eos_tag=bool(include_bos_eos_tag)))
+
+
+class ViterbiDecoder(Layer):
+    """reference: text/viterbi_decode.py ViterbiDecoder layer."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+def __getattr__(name):
+    _DATASETS = {"Imdb", "Imikolov", "Movielens", "UCIHousing",
+                 "WMT14", "WMT16", "Conll05st"}
+    if name in _DATASETS:
+        raise RuntimeError(
+            f"paddle.text.datasets.{name} downloads its corpus at "
+            f"first use; this environment has no network egress. "
+            f"Feed your own files through paddle_tpu.io.Dataset.")
+    raise AttributeError(name)

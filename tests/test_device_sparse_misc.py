@@ -1,0 +1,295 @@
+"""paddle.device / paddle.sparse / paddle.incubate / paddle.text /
+paddle.audio tests."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import device, sparse, incubate, text, audio
+
+
+class TestDevice:
+    def test_namespace_and_sync(self):
+        assert device.device_count() >= 1
+        device.synchronize()
+        s = device.current_stream()
+        with device.stream_guard(s):
+            pass
+        e = s.record_event()
+        e.synchronize()
+        assert s.query() and e.query()
+
+    def test_cuda_memory_stats_api(self):
+        # numbers depend on backend (CPU reports 0); the API must exist
+        # and return non-negative ints
+        for fn in (device.cuda.memory_allocated,
+                   device.cuda.max_memory_allocated,
+                   device.cuda.memory_reserved):
+            v = fn()
+            assert isinstance(v, int) and v >= 0
+        props = device.cuda.get_device_properties()
+        assert props.name
+        device.cuda.empty_cache()
+
+
+class TestSparse:
+    def test_coo_create_to_dense(self):
+        idx = [[0, 1, 2], [1, 2, 0]]
+        vals = [1.0, 2.0, 3.0]
+        s = sparse.sparse_coo_tensor(idx, vals, shape=[3, 3])
+        assert s.nnz() == 3
+        dense = s.to_dense().numpy()
+        want = np.zeros((3, 3), "float32")
+        want[0, 1], want[1, 2], want[2, 0] = 1, 2, 3
+        np.testing.assert_allclose(dense, want)
+        np.testing.assert_allclose(
+            np.sort(s.values().numpy()), [1, 2, 3])
+
+    def test_roundtrip_and_add(self):
+        rs = np.random.RandomState(0)
+        d = rs.randn(4, 5).astype("float32") * (rs.rand(4, 5) > 0.6)
+        s = sparse.to_sparse_coo(paddle.to_tensor(d))
+        np.testing.assert_allclose(s.to_dense().numpy(), d)
+        two = sparse.add(s, s)
+        np.testing.assert_allclose(two.to_dense().numpy(), 2 * d,
+                                   rtol=1e-6)
+
+    def test_spmm(self):
+        rs = np.random.RandomState(1)
+        d = rs.randn(4, 6).astype("float32") * (rs.rand(4, 6) > 0.5)
+        m = rs.randn(6, 3).astype("float32")
+        s = sparse.to_sparse_coo(paddle.to_tensor(d))
+        out = sparse.matmul(s, paddle.to_tensor(m)).numpy()
+        np.testing.assert_allclose(out, d @ m, rtol=1e-4, atol=1e-5)
+
+    def test_masked_matmul(self):
+        rs = np.random.RandomState(2)
+        a = rs.randn(4, 5).astype("float32")
+        b = rs.randn(5, 4).astype("float32")
+        maskd = (rs.rand(4, 4) > 0.5).astype("float32")
+        mask = sparse.to_sparse_coo(paddle.to_tensor(maskd))
+        out = sparse.masked_matmul(paddle.to_tensor(a),
+                                   paddle.to_tensor(b), mask)
+        np.testing.assert_allclose(out.to_dense().numpy(),
+                                   (a @ b) * maskd, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_csr_and_relu(self):
+        crows, cols = [0, 1, 3], [1, 0, 2]
+        vals = [-1.0, 2.0, -3.0]
+        s = sparse.sparse_csr_tensor(crows, cols, vals, [2, 3])
+        want = np.array([[0, -1, 0], [2, 0, -3]], "float32")
+        np.testing.assert_allclose(s.to_dense().numpy(), want)
+        r = sparse.relu(s)
+        np.testing.assert_allclose(r.to_dense().numpy(),
+                                   np.maximum(want, 0))
+
+
+class TestIncubate:
+    def test_fused_mha_layer(self):
+        paddle.seed(0)
+        layer = incubate.nn.FusedMultiHeadAttention(
+            32, 4, dropout_rate=0.0, attn_dropout_rate=0.0,
+            normalize_before=True)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 6, 32).astype("float32"))
+        y = layer(x)
+        assert y.shape == [2, 6, 32]
+        y.mean().backward()
+        assert layer.attn.q_proj.weight.grad is not None
+
+    def test_fused_ffn_and_encoder(self):
+        paddle.seed(0)
+        ffn = incubate.nn.FusedFeedForward(16, 64, dropout_rate=0.0)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 4, 16).astype("float32"))
+        assert ffn(x).shape == [2, 4, 16]
+        enc = incubate.nn.FusedTransformerEncoderLayer(
+            16, 2, 32, dropout_rate=0.0)
+        assert enc(x).shape == [2, 4, 16]
+        stack = incubate.nn.FusedMultiTransformer(
+            16, 2, 32, num_layers=2)
+        stack.eval()
+        assert stack(x).shape == [2, 4, 16]
+
+    def test_fused_functional_feedforward(self):
+        paddle.seed(0)
+        import paddle_tpu.incubate.nn.functional as FF
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(2, 3, 8).astype("float32"))
+        w1 = paddle.to_tensor(rs.randn(8, 16).astype("float32") * 0.1)
+        w2 = paddle.to_tensor(rs.randn(16, 8).astype("float32") * 0.1)
+        ln_s = paddle.to_tensor(np.ones(8, "float32"))
+        ln_b = paddle.to_tensor(np.zeros(8, "float32"))
+        out = FF.fused_feedforward(x, w1, w2, dropout1_rate=0.0,
+                                   dropout2_rate=0.0, ln2_scale=ln_s,
+                                   ln2_bias=ln_b)
+        assert out.shape == [2, 3, 8]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_lookahead_and_model_average(self):
+        import paddle_tpu.optimizer as opt
+        paddle.seed(0)
+        lin = nn.Linear(4, 1)
+        inner = opt.SGD(learning_rate=0.1,
+                        parameters=lin.parameters())
+        look = incubate.optimizer.LookAhead(inner, alpha=0.5, k=2)
+        x = paddle.to_tensor(np.ones((4, 4), "float32"))
+        y = paddle.to_tensor(np.zeros((4, 1), "float32"))
+        for _ in range(4):
+            loss = ((lin(x) - y) ** 2).mean()
+            loss.backward()
+            look.step()
+            look.clear_grad()
+        assert np.isfinite(lin.weight.numpy()).all()
+
+        avg = incubate.optimizer.ModelAverage(
+            parameters=lin.parameters())
+        w0 = lin.weight.numpy().copy()
+        avg.step()
+        lin.weight.set_value(paddle.to_tensor(w0 * 3))
+        avg.step()
+        with avg.apply():
+            np.testing.assert_allclose(lin.weight.numpy(), w0 * 2,
+                                       rtol=1e-5)
+        np.testing.assert_allclose(lin.weight.numpy(), w0 * 3)
+
+    def test_incubate_autograd(self):
+        import paddle_tpu.incubate.autograd as iag
+
+        def f(x):
+            return (x ** 3).sum()
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        out, tang = iag.jvp(f, x)
+        assert abs(float(out) - 9.0) < 1e-5
+        # J @ ones = 3x^2 . ones = 3 + 12
+        assert abs(float(tang) - 15.0) < 1e-5
+        out, grads = iag.vjp(f, x)
+        np.testing.assert_allclose(grads.numpy(), [3.0, 12.0],
+                                   rtol=1e-5)
+        h = iag.Hessian(f, x)
+        np.testing.assert_allclose(h.numpy(),
+                                   np.diag([6.0, 12.0]), rtol=1e-5)
+
+
+class TestText:
+    def test_viterbi_decode(self):
+        # hand-checkable 2-tag chain, no bos/eos: transitions reward
+        # switching, so the best path alternates
+        pot = np.array([[[1.0, 0.0], [0.0, 1.0], [1.0, 0.0]]], "float32")
+        trans = np.array([[-1.0, 0.5], [0.5, -1.0]], "float32")
+        lengths = np.array([3], "int64")
+        scores, paths = text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(lengths), include_bos_eos_tag=False)
+        # [0,1,0]: 1 + 0.5 + 1 + 0.5 + 1 = 4; [0,0,0]: 1 - 1 + 0 - 1 + 1 = 0
+        np.testing.assert_array_equal(paths.numpy()[0], [0, 1, 0])
+        assert abs(float(scores.numpy()[0]) - 4.0) < 1e-5
+
+    def test_viterbi_layer_and_dataset_error(self):
+        dec = text.ViterbiDecoder(
+            paddle.to_tensor(np.zeros((2, 2), "float32")),
+            include_bos_eos_tag=False)
+        pot = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 4, 2).astype("float32"))
+        lengths = paddle.to_tensor(np.array([4, 4], "int64"))
+        scores, paths = dec(pot, lengths)
+        assert paths.shape == [2, 4]
+        with pytest.raises(RuntimeError, match="network"):
+            text.Imdb
+
+
+class TestAudio:
+    def test_mel_conversions(self):
+        assert abs(audio.functional.hz_to_mel(0.0)) < 1e-9
+        hz = audio.functional.mel_to_hz(
+            audio.functional.hz_to_mel(440.0))
+        assert abs(hz - 440.0) < 1e-6
+        hz_htk = audio.functional.mel_to_hz(
+            audio.functional.hz_to_mel(440.0, htk=True), htk=True)
+        assert abs(hz_htk - 440.0) < 1e-6
+
+    def test_fbank_and_dct_shapes(self):
+        fb = audio.functional.compute_fbank_matrix(16000, 512, n_mels=40)
+        assert fb.shape == [40, 257]
+        assert float(fb.numpy().min()) >= 0
+        dct = audio.functional.create_dct(13, 40)
+        assert dct.shape == [40, 13]
+        # orthonormality of DCT columns
+        d = dct.numpy()
+        np.testing.assert_allclose(d.T @ d, np.eye(13), atol=1e-5)
+
+    def test_feature_layers(self):
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(2, 2048).astype("float32"))
+        spec = audio.features.Spectrogram(n_fft=256)(x)
+        assert spec.shape[1] == 129
+        mel = audio.features.MelSpectrogram(
+            sr=16000, n_fft=256, n_mels=32)(x)
+        assert mel.shape[1] == 32
+        logmel = audio.features.LogMelSpectrogram(
+            sr=16000, n_fft=256, n_mels=32)(x)
+        assert np.isfinite(logmel.numpy()).all()
+        mfcc = audio.features.MFCC(sr=16000, n_mfcc=13, n_fft=256,
+                                   n_mels=32)(x)
+        assert mfcc.shape[1] == 13
+
+
+class TestReviewRegressions:
+    def test_kl_uniform_disjoint_support_is_inf(self):
+        from paddle_tpu import distribution as D
+        kl = D.kl_divergence(D.Uniform(0.0, 4.0), D.Uniform(1.0, 2.0))
+        assert float(kl) == np.inf
+        kl_ok = D.kl_divergence(D.Uniform(1.0, 2.0), D.Uniform(0.0, 4.0))
+        assert abs(float(kl_ok) - math.log(4.0)) < 1e-5
+
+    def test_sparse_relu_grad_flows(self):
+        d = np.array([[0.0, -2.0], [3.0, 0.0]], "float32")
+        s = sparse.to_sparse_coo(paddle.to_tensor(d))
+        s.values().stop_gradient = False
+        out = sparse.matmul(sparse.relu(s),
+                            paddle.to_tensor(np.ones((2, 2), "float32")))
+        out.sum().backward()
+        g = s.values().grad
+        assert g is not None
+        # relu kills the negative value's gradient
+        vals = s.values().numpy()
+        gn = g.numpy()
+        assert gn[vals < 0].sum() == 0
+        assert gn[vals > 0].sum() > 0
+
+    def test_model_average_apply_before_step_raises(self):
+        lin = nn.Linear(2, 1)
+        avg = incubate.optimizer.ModelAverage(
+            parameters=lin.parameters())
+        with pytest.raises(RuntimeError, match="before any step"):
+            avg.apply()
+
+    def test_lookahead_anchors_at_initial_weights(self):
+        import paddle_tpu.optimizer as opt
+        paddle.seed(0)
+        lin = nn.Linear(2, 1)
+        w0 = lin.weight.numpy().copy()
+        look = incubate.optimizer.LookAhead(
+            opt.SGD(learning_rate=1.0, parameters=lin.parameters()),
+            alpha=0.5, k=2)
+        x = paddle.to_tensor(np.ones((2, 2), "float32"))
+        fast = None
+        for i in range(2):
+            ((lin(x)) ** 2).mean().backward()
+            if i == 1:
+                # fast weights right before the sync
+                pass
+            look.step()
+            if i == 0:
+                fast_mid = lin.weight.numpy().copy()
+            look.clear_grad()
+        # after k=2 steps: w = w0 + alpha*(fast_k - w0), NOT fast_k
+        w = lin.weight.numpy()
+        assert not np.allclose(w, w0)
+        # interpolation property: w - w0 must be strictly smaller than
+        # the fast excursion would have been alone
+        assert np.abs(w - w0).sum() > 0
