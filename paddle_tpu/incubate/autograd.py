@@ -68,9 +68,11 @@ class Jacobian:
 
     def __init__(self, func, xs, is_batched=False):
         vals = _vals(xs)
-        jac = jax.jacobian(_functionalize(func),
-                           argnums=tuple(range(len(vals))))(*vals)
-        self._jac = jac if len(vals) > 1 else (jac,)
+        # argnums as a tuple ALWAYS yields a tuple of blocks (even for
+        # one input) — no re-wrapping
+        self._jac = jax.jacobian(
+            _functionalize(func),
+            argnums=tuple(range(len(vals))))(*vals)
         self._single = len(vals) == 1
 
     def __getitem__(self, idx):
@@ -96,12 +98,25 @@ class Jacobian:
 
 
 class Hessian(Jacobian):
-    """Lazy Hessian matrix (reference: functional.py Hessian)."""
+    """Lazy Hessian matrix (reference: functional.py Hessian). For
+    multiple inputs the full block Hessian is assembled (d2f/dxi dxj
+    for every input pair)."""
 
     def __init__(self, func, xs, is_batched=False):
         vals = _vals(xs)
-        hes = jax.hessian(_functionalize(func))(*vals)
-        self._jac = (hes,)
+        argnums = tuple(range(len(vals)))
+        hes = jax.hessian(_functionalize(func), argnums=argnums)(*vals)
+        if len(vals) == 1:
+            # hes is a tuple-of-tuples of blocks: ((d2f/dx0^2,),)
+            self._jac = (np.asarray(hes[0][0]),)
+            self._single = True
+            return
+        sizes = [int(np.asarray(v).size) for v in vals]
+        block = np.block([
+            [np.asarray(hes[i][j]).reshape(sizes[i], sizes[j])
+             for j in range(len(vals))]
+            for i in range(len(vals))])
+        self._jac = (block,)
         self._single = True
 
 

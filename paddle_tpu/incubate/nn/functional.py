@@ -66,6 +66,11 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
     optional post-LN."""
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention(cache_kv=...) incremental "
+            "decoding: use nn.MultiHeadAttention with its Cache, which "
+            "implements the KV-cache path")
     residual = x
     if pre_layer_norm:
         x = F.layer_norm(x, x.shape[-1:], weight=pre_ln_scale,

