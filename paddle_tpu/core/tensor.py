@@ -173,9 +173,92 @@ class GradNode:
         with hook(f"{self.op.name}_grad") or _NULL_SPAN:
             return run()
 
+    def apply_taped(self, cts):
+        """Like apply(), but the backward computation itself runs through
+        apply_op — the returned grads carry grad nodes, so a SECOND
+        backward differentiates through them (create_graph=True; the
+        reference's general_grad.h double-grad path).
+
+        Second-order connectivity to an input/output exists when its
+        live Tensor still holds the op-time value (the reference's
+        TensorWrapper version check); a rebound tensor degrades to a
+        constant with the saved value."""
+        full_cts = []
+        for ct, (shape, dt) in zip(cts, self.out_meta):
+            if ct is None:
+                full_cts.append(Tensor(jnp.zeros(shape, dt)))
+            else:
+                t = ct if isinstance(ct, Tensor) else Tensor(ct)
+                if np.dtype(t._value.dtype) != dt:
+                    t = t.astype(str(np.dtype(dt)))  # taped cast
+                full_cts.append(t)
+        # live input tensors for diff_in slots (tape connectivity);
+        # everything else becomes a constant with the saved value
+        live = {}
+        for k, i in enumerate(self.diff_in):
+            t = self.in_edges[k][2]
+            if t is not None and t._value is self.saved_inputs[i]:
+                live[i] = t
+        in_tensors = [
+            live.get(i, Tensor(v, stop_gradient=True))
+            for i, v in enumerate(self.saved_inputs)]
+        # saved outputs (custom-bwd ops) as inputs too: live when
+        # possible, so d(grad)/dx connectivity through outputs survives
+        out_tensors = []
+        if self.op.bwd is not None and self.saved_outputs is not None:
+            for slot, v in enumerate(self.saved_outputs):
+                ref = (self.out_refs[slot]
+                       if slot < len(self.out_refs) else None)
+                t = ref() if ref is not None else None
+                out_tensors.append(
+                    t if t is not None and t._value is v
+                    else Tensor(v, stop_gradient=True))
+        gradop = _get_gradop(self.op, self.attrs, self.diff_in,
+                             self.diff_out, self.single,
+                             len(in_tensors), len(out_tensors))
+        out = apply_op(gradop, *in_tensors, *out_tensors, *full_cts)
+        outs = out if isinstance(out, tuple) else (out,)
+        return list(outs)
+
     def release(self):
         self.saved_inputs = None
         self.saved_outputs = None
+
+
+_GRADOP_CACHE: dict = {}
+
+
+def _get_gradop(op, attrs, diff_in, diff_out, single, n_in, n_out):
+    """Shared gradop OpDef per op STRUCTURE (not per GradNode): the fwd
+    closure captures no node state, so get_jitted/get_vjp cache one
+    compiled executable per op signature instead of growing per
+    backward call (create_graph training loops stay O(1) in cache)."""
+    key = (id(op), _freeze(attrs), diff_in, diff_out, single, n_in,
+           n_out)
+    got = _GRADOP_CACHE.get(key)
+    if got is not None:
+        return got
+    frozen_attrs = dict(attrs)
+
+    def fwd(*vals):
+        in_vals = tuple(vals[:n_in])
+        out_vals = tuple(vals[n_in:n_in + n_out])
+        ct_vals = tuple(vals[n_in + n_out:])
+        if op.bwd is not None:
+            grads = op.bwd(dict(frozen_attrs), in_vals,
+                           out_vals if n_out else None, ct_vals)
+            # custom backwards may return None for inputs they treat as
+            # non-differentiable; a gradop output must be an array
+            return tuple(
+                grads[i] if grads[i] is not None
+                else jnp.zeros_like(in_vals[i]) for i in diff_in)
+        from .dispatch import _vjp_impl
+        return tuple(_vjp_impl(op.fwd, dict(frozen_attrs), diff_in,
+                               diff_out, single, in_vals, ct_vals))
+
+    got = OpDef(f"{op.name}_gradop", fwd)
+    _GRADOP_CACHE[key] = got
+    return got
 
 
 class Tensor:
@@ -494,11 +577,14 @@ def _accumulate(store: dict, node, slot, g):
 
 def run_backward(tensors: Sequence[Tensor], grad_tensors=None,
                  retain_graph=False, accumulate_into_leaves=True,
-                 inputs=None, no_grad_vars=None):
+                 inputs=None, no_grad_vars=None, create_graph=False):
     """Queue-based tape walk with per-node in-degrees.
 
     If `inputs` is given, returns grads for exactly those tensors (paddle.grad
     semantics) instead of accumulating into leaf ``.grad``.
+    create_graph: cotangents flow as tape-recorded Tensors (each node's
+    backward runs through apply_op), so the returned grads support a
+    second backward — eager double grad (reference: general_grad.h).
     """
     grad_tensors = grad_tensors or [None] * len(tensors)
     node_cts: dict[int, dict[int, Any]] = {}   # id(node) -> {slot: ct}
@@ -516,27 +602,29 @@ def run_backward(tensors: Sequence[Tensor], grad_tensors=None,
         """
         if t is None or id(t) in blocked:
             return
+        is_t = isinstance(g, Tensor)   # create_graph: grads are Tensors
         if t._hooks:
-            gt = Tensor(g)
+            gt = g if is_t else Tensor(g)
             for h in t._hooks:
                 r = h(gt)
                 if r is not None:
                     gt = r
-            g = gt._value
+            g = gt if is_t else gt._value
         if id(t) in wanted:
             collected[id(t)] = (collected[id(t)] + g) if id(t) in collected else g
         if accumulate_into_leaves and (as_leaf or t._retain_grad):
             gs = getattr(t, "_grad_spec", None)
-            if gs is not None:
+            if gs is not None and not is_t:
                 # ZeRO stage-2 contract (sharding.py): the leaf grad
                 # materializes SHARDED — each device keeps only its
                 # 1/n slice, the eager analogue of the reference's
                 # reduce-scatter (group_sharded_stage2.py:46)
                 g = gs(g)
             if t.grad is None:
-                t.grad = Tensor(g)
+                t.grad = g if is_t else Tensor(g)
             else:
-                t.grad = Tensor(t.grad._value + g)
+                t.grad = (t.grad + g) if is_t \
+                    else Tensor(t.grad._value + g)
 
     for t, g in zip(tensors, grad_tensors):
         if t.stop_gradient and t._grad_node is None:
@@ -550,6 +638,8 @@ def run_backward(tensors: Sequence[Tensor], grad_tensors=None,
             gv = jnp.ones_like(t._value)
         else:
             gv = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        if create_graph:
+            gv = g if isinstance(g, Tensor) else Tensor(gv)
         if t._grad_node is None:
             deposit(t, gv, as_leaf=True)
             continue
@@ -581,7 +671,8 @@ def run_backward(tensors: Sequence[Tensor], grad_tensors=None,
         cts_map = node_cts.pop(id(node), {})
         cts = [cts_map.get(slot) for slot in range(len(node.diff_out))]
         if any(ct is not None for ct in cts):
-            grads = node.apply(cts)
+            grads = (node.apply_taped(cts) if create_graph
+                     else node.apply(cts))
         else:
             grads = [None] * len(node.in_edges)
         # retained intermediate outputs receive their accumulated cotangent
@@ -605,8 +696,14 @@ def run_backward(tensors: Sequence[Tensor], grad_tensors=None,
             node.release()
 
     if inputs is not None:
-        return [Tensor(collected[id(t)]) if id(t) in collected else None
-                for t in inputs]
+        out = []
+        for t in inputs:
+            if id(t) not in collected:
+                out.append(None)
+            else:
+                g = collected[id(t)]
+                out.append(g if isinstance(g, Tensor) else Tensor(g))
+        return out
     return None
 
 
@@ -615,13 +712,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          no_grad_vars=None):
     """paddle.grad parity (python/paddle/autograd/__init__.py).
 
-    create_graph (double grad) is not supported in eager mode; use the
-    static path (jax.grad composition) for higher-order derivatives.
+    create_graph=True runs every node backward through the op dispatch,
+    so the returned grads are tape-recorded and support a second
+    backward (eager double grad; reference: fluid/eager/general_grad.h).
     """
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True is unsupported on the eager tape; compose "
-            "jax.grad via paddle_tpu.jit.to_static for higher-order AD.")
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
@@ -629,7 +723,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     retain = bool(retain_graph) if retain_graph is not None else create_graph
     res = run_backward(outputs, grad_outputs, retain_graph=retain,
                        accumulate_into_leaves=False, inputs=list(inputs),
-                       no_grad_vars=no_grad_vars)
+                       no_grad_vars=no_grad_vars,
+                       create_graph=create_graph)
     if not allow_unused:
         for t, g in zip(inputs, res):
             if g is None:

@@ -152,9 +152,15 @@ class Predictor:
 
     def __init__(self, config: Config):
         from ..jit import save_load
-        from ..static import load_inference_model
         self._config = config
         prefix = config.model_dir()
+        if prefix is not None and os.path.isdir(prefix):
+            # directory form: exactly one exported model inside
+            models = [f for f in os.listdir(prefix)
+                      if f.endswith(".pdmodel")]
+            if len(models) == 1:
+                prefix = os.path.join(prefix,
+                                      models[0][:-len(".pdmodel")])
         if prefix is None or not os.path.exists(prefix + ".pdmodel"):
             raise ValueError(
                 f"no exported model at {prefix!r} (expected "
@@ -203,6 +209,10 @@ class Predictor:
         return [f"output_{i}" for i in range(len(self._outputs) or 1)]
 
     def get_output_handle(self, name):
+        if not self._outputs:
+            raise RuntimeError(
+                "get_output_handle before run(): outputs exist only "
+                "after the program executes")
         i = int(name.rsplit("_", 1)[-1])
         h = _Handle(name)
         h._value = self._outputs[i]

@@ -154,5 +154,7 @@ def load(path, **configs):
     exported = jax.export.deserialize(blob)
     with open(base + ".pdiparams", "rb") as f:
         meta = pickle.load(f)
-    return TranslatedLayer(exported, meta["state_arrays"],
-                           meta["state_names"])
+    layer = TranslatedLayer(exported, meta["state_arrays"],
+                            meta["state_names"])
+    layer._n_inputs = meta.get("n_inputs", 1)
+    return layer

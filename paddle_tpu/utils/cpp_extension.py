@@ -1,0 +1,89 @@
+"""Custom-kernel extension API.
+
+Reference: python/paddle/utils/cpp_extension/ (setup()/load() ninja-JIT
+C++/CUDA op builds over paddle/phi/api/ext/op_meta_info.h). On TPU the
+out-of-tree kernel path is a PALLAS (or plain JAX) function registered
+into the same dispatch registry every built-in op uses: same autograd
+integration, same jit caching, usable inside to_static programs.
+
+    from paddle_tpu.utils.cpp_extension import CustomOp
+
+    op = CustomOp("my_scale", fwd=lambda x, c: x * c)   # pure jax/pallas
+    y = op(tensor, attrs=dict(c=2.0))
+
+C++ builds are not the extension mechanism here — XLA owns codegen; a
+C++ toolchain would bypass the compiler that makes TPU fast.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..core.dispatch import OpDef, register_op, get_op
+from ..core.tensor import apply_op
+
+__all__ = ["CustomOp", "register_custom_op", "custom_ops", "load",
+           "setup", "CppExtension", "CUDAExtension", "BuildExtension"]
+
+_CUSTOM_OPS: dict = {}
+
+
+class CustomOp:
+    """A user kernel in the op registry (reference analogue:
+    PD_BUILD_OP in paddle/phi/api/ext/op_meta_info.h).
+
+    fwd: pure function of jnp arrays (may be a pallas_call wrapper);
+    bwd: optional custom backward (attrs, inputs, outputs, cotangents)
+    -> input grads; otherwise autodiff uses jax.vjp of fwd."""
+
+    def __init__(self, name: str, fwd: Callable, bwd: Optional[Callable]
+                 = None, save_outputs: bool = False, nondiff=False):
+        self.name = name
+        self._opdef = OpDef(f"custom::{name}", fwd, bwd=bwd,
+                            save_outputs=save_outputs, nondiff=nondiff)
+        _CUSTOM_OPS[name] = self
+
+    def __call__(self, *tensors, attrs=None):
+        return apply_op(self._opdef, *tensors, attrs=attrs or {})
+
+
+def register_custom_op(name, fwd=None, bwd=None, **kwargs):
+    """Register (decorator-friendly) and return the CustomOp."""
+    def deco(f):
+        return CustomOp(name, f, bwd=bwd, **kwargs)
+    if fwd is not None:
+        return CustomOp(name, fwd, bwd=bwd, **kwargs)
+    return deco
+
+
+def custom_ops():
+    return dict(_CUSTOM_OPS)
+
+
+# -- reference-API compatibility shims ---------------------------------------
+
+def load(name=None, sources=None, **kwargs):
+    raise RuntimeError(
+        "cpp_extension.load(): C++/CUDA JIT builds are a GPU-stack "
+        "mechanism; on the TPU build register a Pallas/JAX kernel with "
+        "paddle_tpu.utils.cpp_extension.CustomOp instead (same op "
+        "registry, autograd, and jit integration).")
+
+
+def setup(**kwargs):
+    raise RuntimeError(
+        "cpp_extension.setup(): see CustomOp — TPU kernels are Pallas "
+        "functions, not compiled C++ extensions.")
+
+
+class CppExtension:
+    def __init__(self, *a, **kw):
+        raise RuntimeError("CppExtension: use CustomOp (Pallas) instead")
+
+
+class CUDAExtension(CppExtension):
+    pass
+
+
+class BuildExtension:
+    def __init__(self, *a, **kw):
+        raise RuntimeError("BuildExtension: use CustomOp (Pallas) instead")
