@@ -20,7 +20,9 @@ __all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
            "embedding", "one_hot", "pad", "zeropad2d", "interpolate",
            "upsample", "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
            "cosine_similarity", "bilinear", "label_smooth", "unfold", "fold",
-           "class_center_sample", "linear_bias"]
+           "class_center_sample", "linear_bias", "affine_grid",
+           "grid_sample", "sequence_mask", "temporal_shift",
+           "max_unpool2d"]
 
 
 # -- linear ------------------------------------------------------------------
@@ -486,3 +488,191 @@ def class_center_sample(label, num_classes, num_samples, group=None):
     raise NotImplementedError(
         "class_center_sample (PartialFC) lands with the distributed "
         "margin-loss work")
+
+
+def _affine_grid_fwd(theta, out_shape, align_corners):
+    n, c, h, w = out_shape
+    if align_corners:
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+    else:
+        ys = (jnp.arange(h) * 2 + 1) / h - 1.0
+        xs = (jnp.arange(w) * 2 + 1) / w - 1.0
+    gy = jnp.repeat(ys, w).reshape(h, w)
+    gx = jnp.tile(xs, (h, 1))
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)  # [hw, 3]
+    out = jnp.einsum("nij,pj->npi", theta, base)              # [n,hw,2]
+    return out.reshape(n, h, w, 2)
+
+
+register_op("affine_grid", _affine_grid_fwd)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """reference: nn/functional/vision.py affine_grid (affine_grid_op)."""
+    return apply_op("affine_grid", as_tensor(theta),
+                    attrs=dict(out_shape=tuple(int(s) for s in out_shape),
+                               align_corners=bool(align_corners)))
+
+
+def _grid_sample_fwd(x, grid, mode, padding_mode, align_corners):
+    """x: [N, C, H, W]; grid: [N, Ho, Wo, 2] in [-1, 1]."""
+    n, c, h, w = x.shape
+
+    def unnormalize(coord, size):
+        if align_corners:
+            return (coord + 1.0) / 2.0 * (size - 1)
+        return ((coord + 1.0) * size - 1.0) / 2.0
+
+    gx = unnormalize(grid[..., 0], w)      # [N, Ho, Wo]
+    gy = unnormalize(grid[..., 1], h)
+
+    def reflect(coord, size):
+        # mirror into [0, size-1] (align_corners) / [-0.5, size-0.5]
+        if align_corners:
+            span = 2 * (size - 1)
+            if span == 0:
+                return jnp.zeros_like(coord)
+            c = jnp.abs(coord) % span
+            return jnp.where(c > size - 1, span - c, c)
+        span = 2 * size
+        c = jnp.abs(coord + 0.5) % span
+        c = jnp.where(c > size, span - c, c) - 0.5
+        return jnp.clip(c, 0, size - 1)
+
+    if padding_mode == "reflection":
+        gx = reflect(gx, w)
+        gy = reflect(gy, h)
+
+    def sample_one(feat, yy, xx):
+        if mode == "nearest":
+            yi = jnp.clip(jnp.round(yy), 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(jnp.round(xx), 0, w - 1).astype(jnp.int32)
+            out = feat[:, yi, xi]
+            inb = ((yy >= -0.5) & (yy <= h - 0.5)
+                   & (xx >= -0.5) & (xx <= w - 0.5))
+        else:
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            ly, lx = yy - y0, xx - x0
+
+            def at(yi, xi):
+                v = feat[:, jnp.clip(yi, 0, h - 1).astype(jnp.int32),
+                         jnp.clip(xi, 0, w - 1).astype(jnp.int32)]
+                if padding_mode == "zeros":
+                    ok = ((yi >= 0) & (yi <= h - 1)
+                          & (xi >= 0) & (xi <= w - 1))
+                    v = v * ok.astype(v.dtype)
+                return v
+
+            out = (at(y0, x0) * (1 - ly) * (1 - lx)
+                   + at(y0, x0 + 1) * (1 - ly) * lx
+                   + at(y0 + 1, x0) * ly * (1 - lx)
+                   + at(y0 + 1, x0 + 1) * ly * lx)
+            inb = None
+        if mode == "nearest" and padding_mode == "zeros":
+            out = out * inb.astype(out.dtype)
+        return out
+
+    return jax.vmap(sample_one)(x, gy, gx)
+
+
+register_op("grid_sample", _grid_sample_fwd)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """reference: nn/functional/vision.py grid_sample (grid_sampler_op)."""
+    return apply_op("grid_sample", as_tensor(x), as_tensor(grid),
+                    attrs=dict(mode=mode, padding_mode=padding_mode,
+                               align_corners=bool(align_corners)))
+
+
+register_op(
+    "sequence_mask",
+    lambda lengths, maxlen, dtype_str: (
+        jnp.arange(maxlen) <
+        lengths[..., None]).astype(dtype_str),
+    nondiff=True)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """reference: nn/functional/sequence_mask (sequence LoD legacy made
+    static-shape: [B] lengths -> [B, maxlen] mask)."""
+    x = as_tensor(x)
+    if maxlen is None:
+        maxlen = int(np.asarray(x._value).max())
+    from ...core import dtype as dtypes
+    return apply_op("sequence_mask", x,
+                    attrs=dict(maxlen=int(maxlen),
+                               dtype_str=str(np.dtype(
+                                   dtypes.to_np_dtype(dtype)))))
+
+
+def _temporal_shift_fwd(x, seg_num, shift_ratio):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = jnp.concatenate([x[:, 1:, :fold],
+                            jnp.zeros_like(x[:, :1, :fold])], axis=1)
+    right = jnp.concatenate([jnp.zeros_like(x[:, :1, fold:2 * fold]),
+                             x[:, :-1, fold:2 * fold]], axis=1)
+    rest = x[:, :, 2 * fold:]
+    return jnp.concatenate([left, right, rest],
+                           axis=2).reshape(nt, c, h, w)
+
+
+register_op("temporal_shift", _temporal_shift_fwd)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """reference: nn/functional temporal_shift (temporal_shift_op, TSM)."""
+    x = as_tensor(x)
+    if data_format == "NHWC":
+        from ...ops.manipulation import transpose
+        out = apply_op("temporal_shift", transpose(x, [0, 3, 1, 2]),
+                       attrs=dict(seg_num=int(seg_num),
+                                  shift_ratio=float(shift_ratio)))
+        return transpose(out, [0, 2, 3, 1])
+    return apply_op("temporal_shift", x,
+                    attrs=dict(seg_num=int(seg_num),
+                               shift_ratio=float(shift_ratio)))
+
+
+def _max_unpool2d_fwd(x, indices, out_h, out_w):
+    n, c, h, w = x.shape
+    flat = x.reshape(n, c, -1)
+    idx = indices.reshape(n, c, -1).astype(jnp.int32)
+    out = jnp.zeros((n, c, out_h * out_w), x.dtype)
+    out = jax.vmap(jax.vmap(
+        lambda o, i, v: o.at[i].set(v)))(out, idx, flat)
+    return out.reshape(n, c, out_h, out_w)
+
+
+register_op("max_unpool2d", _max_unpool2d_fwd)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """reference: nn/functional max_unpool2d (unpool_op): scatter pooled
+    values back to their argmax positions."""
+    x = as_tensor(x)
+    if stride is None:
+        stride = kernel_size
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else (int(v[0]), int(v[1]))
+
+    if output_size is not None:
+        out_h, out_w = output_size[-2], output_size[-1]
+    else:
+        kh, kw = _pair(kernel_size)
+        sh, sw = _pair(stride)
+        ph, pw = _pair(padding)
+        out_h = (x.shape[2] - 1) * sh + kh - 2 * ph
+        out_w = (x.shape[3] - 1) * sw + kw - 2 * pw
+    return apply_op("max_unpool2d", x, as_tensor(indices),
+                    attrs=dict(out_h=int(out_h), out_w=int(out_w)))

@@ -794,3 +794,75 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
         pads = tuple(pads)
     return apply_op("pad", x, attrs=dict(paddings=pads, mode=mode,
                                          value=float(value)))
+
+
+# -- long-tail additions (reference: python/paddle/tensor/manipulation.py) --
+
+register_op("unflatten_op", lambda x, axis, sizes: jnp.reshape(
+    x, x.shape[:axis] + tuple(sizes) + x.shape[axis + 1:]))
+
+
+def unflatten(x, axis, shape, name=None):
+    """Split one dim into several (reference: manipulation.py unflatten)."""
+    x = as_tensor(x)
+    axis = axis % x.ndim
+    sizes = list(int(s) for s in shape)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = x.shape[axis] // known
+    return apply_op("unflatten_op", x,
+                    attrs=dict(axis=int(axis), sizes=tuple(sizes)))
+
+
+def _diagonal_scatter_fwd(x, y, offset, axis1, axis2):
+    # paddle's y layout puts the diagonal dim LAST; move axis1/axis2 to
+    # the back, scatter on the trailing pair, undo the permutation
+    perm = [d for d in range(x.ndim) if d not in (axis1, axis2)] \
+        + [axis1, axis2]
+    inv = np.argsort(perm)
+    xt = jnp.transpose(x, perm)                    # [..., n1, n2]
+    i = jnp.arange(y.shape[-1])
+    r = i - min(offset, 0)
+    c = i + max(offset, 0)
+    xt = xt.at[..., r, c].set(y)
+    return jnp.transpose(xt, inv)
+
+
+register_op("diagonal_scatter", _diagonal_scatter_fwd)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Write y onto x's diagonal (reference: manipulation.py
+    diagonal_scatter)."""
+    x = as_tensor(x)
+    return apply_op("diagonal_scatter", x, as_tensor(y),
+                    attrs=dict(offset=int(offset),
+                               axis1=int(axis1) % x.ndim,
+                               axis2=int(axis2) % x.ndim))
+
+
+def _index_fill_fwd(x, index, axis, value):
+    import builtins
+    idx = [builtins.slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(value)
+
+
+register_op("index_fill", _index_fill_fwd)
+
+
+def index_fill(x, index, axis, value, name=None):
+    """reference: manipulation.py index_fill."""
+    x = as_tensor(x)
+    return apply_op("index_fill", x, as_tensor(index),
+                    attrs=dict(axis=int(axis) % x.ndim,
+                               value=float(value)))
+
+
+def index_fill_(x, index, axis, value, name=None):
+    out = index_fill(x, index, axis, value)
+    x._rebind(out._value)
+    return x
+
+
+__all__ += ["unflatten", "diagonal_scatter", "index_fill", "index_fill_"]

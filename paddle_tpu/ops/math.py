@@ -264,3 +264,84 @@ __all__ += ["logit", "nan_to_num", "lerp", "addmm", "stanh", "multiplex"]
 maximum = getattr(_this, "maximum")
 minimum = getattr(_this, "minimum")
 add = getattr(_this, "add")
+
+
+# -- long-tail additions (reference: python/paddle/tensor/math.py) ----------
+
+register_op("cdist", lambda x, y, p: (
+    jnp.linalg.norm(x[..., :, None, :] - y[..., None, :, :],
+                    ord=p, axis=-1)))
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise p-norm distance (reference: tensor/math.py cdist)."""
+    return apply_op("cdist", as_tensor(x), as_tensor(y),
+                    attrs=dict(p=float(p)))
+
+
+register_op("trapezoid", lambda y, dx, axis: jnp.trapezoid(
+    y, dx=dx, axis=axis))
+register_op("trapezoid_x", lambda y, x, axis: jnp.trapezoid(
+    y, x=x, axis=axis))
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Trapezoidal integration (reference: tensor/math.py trapezoid)."""
+    if x is not None:
+        return apply_op("trapezoid_x", as_tensor(y), as_tensor(x),
+                        attrs=dict(axis=int(axis)))
+    return apply_op("trapezoid", as_tensor(y),
+                    attrs=dict(dx=1.0 if dx is None else float(dx),
+                               axis=int(axis)))
+
+
+register_op("renorm", lambda x, p, axis, max_norm: _renorm_impl(
+    x, p, axis, max_norm))
+
+
+def _renorm_impl(x, p, axis, max_norm):
+    dims = tuple(d for d in range(x.ndim) if d != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=dims, keepdims=True) ** (1 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Clamp each sub-tensor's p-norm along axis (reference:
+    tensor/math.py renorm)."""
+    return apply_op("renorm", as_tensor(x),
+                    attrs=dict(p=float(p), axis=int(axis),
+                               max_norm=float(max_norm)))
+
+
+register_op("sgn", lambda x: jnp.sign(x) if not jnp.iscomplexobj(x)
+            else jnp.where(x == 0, 0, x / jnp.abs(x)))
+
+
+def sgn(x, name=None):
+    """Complex-aware sign (reference: tensor/math.py sgn)."""
+    return apply_op("sgn", as_tensor(x))
+
+
+register_op("signbit", lambda x: jnp.signbit(x), nondiff=True)
+
+
+def signbit(x, name=None):
+    return apply_op("signbit", as_tensor(x))
+
+
+register_op("vander_op", lambda x, n, increasing: jnp.vander(
+    x, N=n, increasing=increasing))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    """Vandermonde matrix (reference: tensor/creation.py vander)."""
+    x = as_tensor(x)
+    if n is None:
+        n = x.shape[0]
+    return apply_op("vander_op", x,
+                    attrs=dict(n=int(n), increasing=bool(increasing)))
+
+
+__all__ += ["cdist", "trapezoid", "renorm", "sgn", "signbit", "vander"]

@@ -1,0 +1,346 @@
+"""paddle.vision.ops: detection operators.
+
+Reference: python/paddle/vision/ops.py over the CUDA detection ops in
+paddle/fluid/operators/detection/ (nms_op, roi_align_op, roi_pool_op,
+box_coder_op, yolo_box_op). TPU design: everything is expressed with
+static shapes — NMS is an IoU matrix plus a fori_loop greedy sweep
+(no dynamic output; a keep mask + count, sliced host-side), RoI ops
+vmap a fixed sampling grid per box (gathers + bilinear weights on the
+VPU, pooling reductions fused by XLA).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+from ..ops._helpers import as_tensor, apply_op
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box",
+           "RoIAlign", "RoIPool"]
+
+
+def _iou_matrix(boxes):
+    """[N, 4] xyxy -> [N, N] IoU."""
+    x1, y1, x2, y2 = (boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3])
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def _nms_fwd(boxes, scores, iou_threshold):
+    """Greedy NMS -> (keep mask over score-sorted order mapped back to
+    input order). Static shapes: fori_loop over N candidates."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    iou = _iou_matrix(b)
+
+    def body(i, keep):
+        # candidate i survives if no higher-scoring KEPT box overlaps it
+        over = (iou[i] > iou_threshold) & keep & \
+            (jnp.arange(n) < i)
+        ki = ~jnp.any(over)
+        return keep.at[i].set(ki)
+
+    keep_sorted = jax.lax.fori_loop(0, n, body,
+                                    jnp.ones((n,), dtype=bool))
+    keep = jnp.zeros((n,), dtype=bool).at[order].set(keep_sorted)
+    return keep
+
+
+register_op("vision_nms", _nms_fwd, nondiff=True)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """reference: vision/ops.py nms — returns kept indices sorted by
+    descending score (host-side slice of the static keep mask)."""
+    boxes = as_tensor(boxes)
+    n = boxes.shape[0]
+    if scores is None:
+        scores = Tensor(jnp.arange(n, 0, -1).astype(jnp.float32))
+    else:
+        scores = as_tensor(scores)
+    if category_idxs is not None:
+        # per-category NMS: offset boxes per category so categories
+        # never overlap (the standard batched-NMS trick)
+        cat = as_tensor(category_idxs)
+        offset = (cat.astype("float32") * 1e4).unsqueeze(-1)
+        shifted = boxes + offset
+    else:
+        shifted = boxes
+    keep = apply_op("vision_nms", shifted, scores,
+                    attrs=dict(iou_threshold=float(iou_threshold)))
+    keep_np = np.asarray(keep._value)
+    scores_np = np.asarray(scores._value)
+    idx = np.nonzero(keep_np)[0]
+    idx = idx[np.argsort(-scores_np[idx])]
+    if top_k is not None:
+        idx = idx[:top_k]
+    from ..ops.creation import to_tensor
+    return to_tensor(idx.astype("int64"))
+
+
+def _bilinear(feat, y, x):
+    """feat [C, H, W]; y/x sample coords -> [C, *coords.shape]."""
+    H, W = feat.shape[-2], feat.shape[-1]
+    y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    ly, lx = y - y0, x - x0
+    y0i, y1i = y0.astype(jnp.int32), y1.astype(jnp.int32)
+    x0i, x1i = x0.astype(jnp.int32), x1.astype(jnp.int32)
+    v00 = feat[:, y0i, x0i]
+    v01 = feat[:, y0i, x1i]
+    v10 = feat[:, y1i, x0i]
+    v11 = feat[:, y1i, x1i]
+    return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+            + v10 * ly * (1 - lx) + v11 * ly * lx)
+
+
+def _roi_align_fwd(x, boxes, boxes_num, output_size, spatial_scale,
+                   sampling_ratio, aligned):
+    """x: [N, C, H, W]; boxes: [R, 4]; boxes_num: [N] -> [R, C, oh, ow]."""
+    oh, ow = output_size
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+    # map each roi to its batch image (boxes are image-grouped)
+    batch_idx = jnp.searchsorted(jnp.cumsum(boxes_num),
+                                 jnp.arange(boxes.shape[0]),
+                                 side="right")
+
+    offset = 0.5 if aligned else 0.0
+
+    def one_roi(box, bi):
+        feat = x[bi]                       # [C, H, W]
+        x1, y1, x2, y2 = box * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1e-3)
+        rh = jnp.maximum(y2 - y1, 1e-3)
+        bin_h, bin_w = rh / oh, rw / ow
+        # sr x sr samples per bin
+        gy = (y1 + (jnp.arange(oh * sr) + 0.5) * bin_h / sr)  # [oh*sr]
+        gx = (x1 + (jnp.arange(ow * sr) + 0.5) * bin_w / sr)
+        yy = jnp.repeat(gy, ow * sr).reshape(oh * sr, ow * sr)
+        xx = jnp.tile(gx, (oh * sr, 1))
+        samples = _bilinear(feat, yy, xx)  # [C, oh*sr, ow*sr]
+        c = samples.shape[0]
+        return samples.reshape(c, oh, sr, ow, sr).mean(axis=(2, 4))
+
+    return jax.vmap(one_roi)(boxes, batch_idx)
+
+
+register_op("vision_roi_align", _roi_align_fwd)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """reference: vision/ops.py roi_align (detection/roi_align_op)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return apply_op("vision_roi_align", as_tensor(x), as_tensor(boxes),
+                    as_tensor(boxes_num),
+                    attrs=dict(output_size=tuple(output_size),
+                               spatial_scale=float(spatial_scale),
+                               sampling_ratio=int(sampling_ratio),
+                               aligned=bool(aligned)))
+
+
+def _roi_pool_fwd(x, boxes, boxes_num, output_size, spatial_scale):
+    oh, ow = output_size
+    batch_idx = jnp.searchsorted(jnp.cumsum(boxes_num),
+                                 jnp.arange(boxes.shape[0]),
+                                 side="right")
+    H, W = x.shape[-2], x.shape[-1]
+    ys = jnp.arange(H)
+    xs = jnp.arange(W)
+
+    def one_roi(box, bi):
+        feat = x[bi]
+        x1, y1, x2, y2 = jnp.round(box * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        # EXACT per-bin max: membership masks over the full plane (the
+        # reference kernel's floor/ceil bin boundaries), no sampling
+        ih = jnp.arange(oh)
+        iw = jnp.arange(ow)
+        hstart = jnp.floor(y1 + ih * rh / oh)
+        hend = jnp.ceil(y1 + (ih + 1) * rh / oh)
+        wstart = jnp.floor(x1 + iw * rw / ow)
+        wend = jnp.ceil(x1 + (iw + 1) * rw / ow)
+        mh = (ys[None, :] >= hstart[:, None]) & \
+             (ys[None, :] < hend[:, None])           # [oh, H]
+        mw = (xs[None, :] >= wstart[:, None]) & \
+             (xs[None, :] < wend[:, None])           # [ow, W]
+        m = mh[:, None, :, None] & mw[None, :, None, :]  # [oh,ow,H,W]
+        vals = jnp.where(m[None], feat[:, None, None, :, :], -jnp.inf)
+        out = jnp.max(vals, axis=(-2, -1))
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return jax.vmap(one_roi)(boxes, batch_idx)
+
+
+register_op("vision_roi_pool", _roi_pool_fwd)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """reference: vision/ops.py roi_pool (detection/roi_pool_op)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return apply_op("vision_roi_pool", as_tensor(x), as_tensor(boxes),
+                    as_tensor(boxes_num),
+                    attrs=dict(output_size=tuple(output_size),
+                               spatial_scale=float(spatial_scale)))
+
+
+def _box_coder_fwd(prior_box, prior_box_var, target_box, code_type,
+                   box_normalized, axis):
+    pw = prior_box[:, 2] - prior_box[:, 0] + (0 if box_normalized else 1)
+    ph = prior_box[:, 3] - prior_box[:, 1] + (0 if box_normalized else 1)
+    px = prior_box[:, 0] + pw * 0.5
+    py = prior_box[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tw = target_box[:, 2] - target_box[:, 0] + \
+            (0 if box_normalized else 1)
+        th = target_box[:, 3] - target_box[:, 1] + \
+            (0 if box_normalized else 1)
+        tx = target_box[:, 0] + tw * 0.5
+        ty = target_box[:, 1] + th * 0.5
+        out = jnp.stack([(tx[:, None] - px[None, :]) / pw[None, :],
+                         (ty[:, None] - py[None, :]) / ph[None, :],
+                         jnp.log(tw[:, None] / pw[None, :]),
+                         jnp.log(th[:, None] / ph[None, :])], axis=-1)
+        if prior_box_var is not None:
+            out = out / prior_box_var[None, :, :]
+        return out
+    # decode_center_size: target_box [N, M, 4] deltas; priors lie on
+    # `axis`, so the per-prior variance must broadcast along that axis
+    d = target_box
+    if prior_box_var is not None:
+        var_shape = (1, -1, 4) if axis == 0 else (-1, 1, 4)
+        d = d * prior_box_var.reshape(var_shape)
+    shape = [1, -1] if axis == 0 else [-1, 1]
+    pwr = pw.reshape(shape)
+    phr = ph.reshape(shape)
+    pxr = px.reshape(shape)
+    pyr = py.reshape(shape)
+    ox = d[..., 0] * pwr + pxr
+    oy = d[..., 1] * phr + pyr
+    ow = jnp.exp(d[..., 2]) * pwr
+    oh = jnp.exp(d[..., 3]) * phr
+    norm = 0 if box_normalized else 1
+    return jnp.stack([ox - ow / 2, oy - oh / 2,
+                      ox + ow / 2 - norm, oy + oh / 2 - norm], axis=-1)
+
+
+register_op("box_coder", _box_coder_fwd)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """reference: vision/ops.py box_coder (detection/box_coder_op)."""
+    pv = None if prior_box_var is None else as_tensor(prior_box_var)
+    if pv is None:
+        return apply_op(
+            "box_coder_novar", as_tensor(prior_box),
+            as_tensor(target_box),
+            attrs=dict(code_type=code_type,
+                       box_normalized=bool(box_normalized),
+                       axis=int(axis)))
+    return apply_op("box_coder", as_tensor(prior_box), pv,
+                    as_tensor(target_box),
+                    attrs=dict(code_type=code_type,
+                               box_normalized=bool(box_normalized),
+                               axis=int(axis)))
+
+
+register_op("box_coder_novar",
+            lambda prior_box, target_box, code_type, box_normalized,
+            axis: _box_coder_fwd(prior_box, None, target_box, code_type,
+                                 box_normalized, axis))
+
+
+def _yolo_box_fwd(x, img_size, anchors, class_num, conf_thresh,
+                  downsample_ratio, clip_bbox, scale_x_y):
+    """x: [N, na*(5+C), H, W] -> (boxes [N, na*H*W, 4],
+    scores [N, na*H*W, C])."""
+    n, _, h, w = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    gx = jnp.tile(jnp.arange(w, dtype=jnp.float32), (h, 1))
+    gy = jnp.repeat(jnp.arange(h, dtype=jnp.float32), w).reshape(h, w)
+    sig = jax.nn.sigmoid
+    alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+    bx = (sig(x[:, :, 0]) * alpha + beta + gx) / w
+    by = (sig(x[:, :, 1]) * alpha + beta + gy) / h
+    in_w = downsample_ratio * w
+    in_h = downsample_ratio * h
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / in_w
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / in_h
+    conf = sig(x[:, :, 4])
+    probs = sig(x[:, :, 5:]) * conf[:, :, None]
+    # to image scale
+    img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw / 2) * img_w
+    y1 = (by - bh / 2) * img_h
+    x2 = (bx + bw / 2) * img_w
+    y2 = (by + bh / 2) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+        x2 = jnp.clip(x2, 0, img_w - 1)
+        y2 = jnp.clip(y2, 0, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+    mask = (conf > conf_thresh).astype(probs.dtype)
+    scores = (probs * mask[:, :, None]).transpose(0, 1, 3, 4, 2) \
+        .reshape(n, -1, class_num)
+    return boxes, scores
+
+
+register_op("yolo_box", _yolo_box_fwd)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, name=None,
+             scale_x_y=1.0, iou_aware=False, iou_aware_factor=0.5):
+    """reference: vision/ops.py yolo_box (detection/yolo_box_op)."""
+    return apply_op("yolo_box", as_tensor(x), as_tensor(img_size),
+                    attrs=dict(anchors=tuple(anchors),
+                               class_num=int(class_num),
+                               conf_thresh=float(conf_thresh),
+                               downsample_ratio=int(downsample_ratio),
+                               clip_bbox=bool(clip_bbox),
+                               scale_x_y=float(scale_x_y)))
+
+
+class RoIAlign:
+    """Layer form (reference: vision/ops.py RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)

@@ -1,0 +1,286 @@
+"""vision.ops detection operators + long-tail tensor/functional ops.
+
+Reference test model: unittests/test_nms_op.py, test_roi_align_op.py,
+test_box_coder_op.py, test_yolo_box_op.py (numpy-reference checks) and
+the per-API tensor op tests.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops as vops
+
+
+class TestNMS:
+    def test_greedy_suppression(self):
+        boxes = np.array([[0, 0, 10, 10],
+                          [1, 1, 11, 11],     # overlaps box 0
+                          [20, 20, 30, 30],
+                          [21, 21, 31, 31]],  # overlaps box 2
+                         "float32")
+        scores = np.array([0.9, 0.8, 0.7, 0.95], "float32")
+        keep = vops.nms(paddle.to_tensor(boxes), 0.5,
+                        paddle.to_tensor(scores))
+        # box 3 beats box 2; box 0 beats box 1
+        assert set(keep.numpy().tolist()) == {0, 3}
+        # sorted by descending score
+        assert keep.numpy().tolist() == [3, 0]
+
+    def test_nms_categories(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], "float32")
+        scores = np.array([0.9, 0.8], "float32")
+        cats = np.array([0, 1], "int64")
+        keep = vops.nms(paddle.to_tensor(boxes), 0.5,
+                        paddle.to_tensor(scores),
+                        category_idxs=paddle.to_tensor(cats),
+                        categories=[0, 1])
+        assert len(keep.numpy()) == 2  # different categories both kept
+
+    def test_top_k(self):
+        boxes = np.array([[0, 0, 1, 1], [5, 5, 6, 6], [10, 10, 11, 11]],
+                         "float32")
+        keep = vops.nms(paddle.to_tensor(boxes), 0.5,
+                        paddle.to_tensor(
+                            np.array([0.3, 0.9, 0.5], "float32")),
+                        top_k=2)
+        assert keep.numpy().tolist() == [1, 2]
+
+
+class TestRoI:
+    def test_roi_align_uniform_feature(self):
+        # constant feature map: every roi bin must read that constant
+        x = np.full((1, 3, 16, 16), 5.0, "float32")
+        boxes = np.array([[2.0, 2.0, 10.0, 10.0]], "float32")
+        out = vops.roi_align(paddle.to_tensor(x),
+                             paddle.to_tensor(boxes),
+                             paddle.to_tensor(np.array([1], "int32")),
+                             output_size=4)
+        assert out.shape == [1, 3, 4, 4]
+        np.testing.assert_allclose(out.numpy(), 5.0, rtol=1e-5)
+
+    def test_roi_align_gradient(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(1, 2, 8, 8).astype("float32"),
+            stop_gradient=False)
+        boxes = paddle.to_tensor(np.array([[1.0, 1.0, 6.0, 6.0]],
+                                          "float32"))
+        out = vops.roi_align(x, boxes,
+                             paddle.to_tensor(np.array([1], "int32")),
+                             output_size=2)
+        out.sum().backward()
+        assert x.grad is not None
+        assert float(np.abs(x.grad.numpy()).sum()) > 0
+
+    def test_roi_pool_max(self):
+        x = np.zeros((1, 1, 8, 8), "float32")
+        x[0, 0, 3, 3] = 9.0
+        out = vops.roi_pool(paddle.to_tensor(x),
+                            paddle.to_tensor(
+                                np.array([[0.0, 0.0, 7.0, 7.0]],
+                                         "float32")),
+                            paddle.to_tensor(np.array([1], "int32")),
+                            output_size=1)
+        assert abs(float(out.numpy()[0, 0, 0, 0]) - 9.0) < 1e-5
+
+
+class TestBoxCoderYolo:
+    def test_box_coder_roundtrip(self):
+        rs = np.random.RandomState(0)
+        prior = np.abs(rs.randn(5, 4)).astype("float32")
+        prior[:, 2:] = prior[:, :2] + np.abs(rs.randn(5, 2)) + 1.0
+        target = np.abs(rs.randn(3, 4)).astype("float32")
+        target[:, 2:] = target[:, :2] + np.abs(rs.randn(3, 2)) + 1.0
+        var = np.ones((5, 4), "float32")
+        enc = vops.box_coder(paddle.to_tensor(prior),
+                             paddle.to_tensor(var),
+                             paddle.to_tensor(target),
+                             code_type="encode_center_size")
+        assert enc.shape == [3, 5, 4]
+        dec = vops.box_coder(paddle.to_tensor(prior),
+                             paddle.to_tensor(var), enc,
+                             code_type="decode_center_size", axis=0)
+        # decoding its own encoding recovers each target against every
+        # prior; check prior-0 column
+        np.testing.assert_allclose(dec.numpy()[:, 0], target, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_yolo_box_shapes(self):
+        n, na, c, h, w = 2, 3, 4, 5, 5
+        x = np.random.RandomState(0).randn(
+            n, na * (5 + c), h, w).astype("float32")
+        img = np.full((n, 2), 320, "int32")
+        boxes, scores = vops.yolo_box(
+            paddle.to_tensor(x), paddle.to_tensor(img),
+            anchors=[10, 13, 16, 30, 33, 23], class_num=c)
+        assert boxes.shape == [n, na * h * w, 4]
+        assert scores.shape == [n, na * h * w, c]
+        b = boxes.numpy()
+        assert (b >= 0).all() and (b <= 319).all()  # clipped
+
+
+class TestFunctionalLongTail:
+    def test_affine_grid_identity(self):
+        theta = np.zeros((1, 2, 3), "float32")
+        theta[0, 0, 0] = 1.0
+        theta[0, 1, 1] = 1.0
+        grid = F.affine_grid(paddle.to_tensor(theta), [1, 1, 4, 4])
+        assert grid.shape == [1, 4, 4, 2]
+        g = grid.numpy()
+        np.testing.assert_allclose(g[0, 0, 0], [-1, -1], atol=1e-6)
+        np.testing.assert_allclose(g[0, -1, -1], [1, 1], atol=1e-6)
+
+    def test_grid_sample_identity(self):
+        x = np.random.RandomState(0).randn(1, 2, 6, 6).astype("float32")
+        theta = np.zeros((1, 2, 3), "float32")
+        theta[0, 0, 0] = 1.0
+        theta[0, 1, 1] = 1.0
+        grid = F.affine_grid(paddle.to_tensor(theta), [1, 2, 6, 6])
+        out = F.grid_sample(paddle.to_tensor(x), grid)
+        np.testing.assert_allclose(out.numpy(), x, rtol=1e-4, atol=1e-5)
+
+    def test_sequence_mask(self):
+        m = F.sequence_mask(paddle.to_tensor(np.array([1, 3], "int64")),
+                            maxlen=4)
+        np.testing.assert_array_equal(
+            m.numpy(), [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+    def test_temporal_shift(self):
+        x = np.arange(2 * 4 * 2 * 2, dtype="float32").reshape(2, 4, 2, 2)
+        out = F.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                               shift_ratio=0.25)
+        assert out.shape == [2, 4, 2, 2]
+        o = out.numpy()
+        # first fold channel shifts left: frame0 gets frame1's channel 0
+        np.testing.assert_allclose(o[0, 0], x[1, 0])
+        np.testing.assert_allclose(o[1, 0], 0.0)  # pad
+
+    def test_max_unpool2d(self):
+        x = np.array([[[[5.0]]]], "float32")
+        idx = np.array([[[[3]]]], "int64")  # position 3 of 2x2
+        out = F.max_unpool2d(paddle.to_tensor(x), paddle.to_tensor(idx),
+                             kernel_size=2)
+        np.testing.assert_allclose(
+            out.numpy(), [[[[0, 0], [0, 5.0]]]])
+
+
+class TestTensorLongTail:
+    def test_cdist(self):
+        a = np.random.RandomState(0).randn(3, 4).astype("float32")
+        b = np.random.RandomState(1).randn(5, 4).astype("float32")
+        d = paddle.cdist(paddle.to_tensor(a), paddle.to_tensor(b))
+        want = np.linalg.norm(a[:, None] - b[None, :], axis=-1)
+        np.testing.assert_allclose(d.numpy(), want, rtol=1e-5)
+
+    def test_trapezoid_vander_renorm(self):
+        y = np.array([1.0, 2.0, 3.0], "float32")
+        assert abs(float(paddle.trapezoid(paddle.to_tensor(y))) - 4.0) \
+            < 1e-6
+        v = paddle.vander(paddle.to_tensor(y), n=3)
+        np.testing.assert_allclose(v.numpy(), np.vander(y, 3), rtol=1e-5)
+        x = np.ones((2, 3), "float32") * 3.0
+        r = paddle.renorm(paddle.to_tensor(x), p=2.0, axis=0,
+                          max_norm=1.0)
+        np.testing.assert_allclose(
+            np.linalg.norm(r.numpy(), axis=1), 1.0, rtol=1e-4)
+
+    def test_index_fill_diagonal_scatter_unflatten(self):
+        x = paddle.to_tensor(np.zeros((3, 4), "float32"))
+        out = paddle.index_fill(x, paddle.to_tensor(
+            np.array([0, 2], "int64")), 0, 7.0)
+        assert (out.numpy()[[0, 2]] == 7.0).all()
+        assert (out.numpy()[1] == 0.0).all()
+
+        m = paddle.to_tensor(np.zeros((3, 3), "float32"))
+        d = paddle.diagonal_scatter(m, paddle.to_tensor(
+            np.array([1.0, 2.0, 3.0], "float32")))
+        np.testing.assert_allclose(np.diag(d.numpy()), [1, 2, 3])
+
+        u = paddle.unflatten(paddle.to_tensor(
+            np.arange(12, dtype="float32")), 0, [3, -1])
+        assert u.shape == [3, 4]
+
+    def test_sgn_signbit(self):
+        x = paddle.to_tensor(np.array([-2.0, 0.0, 5.0], "float32"))
+        np.testing.assert_allclose(paddle.sgn(x).numpy(), [-1, 0, 1])
+        np.testing.assert_array_equal(paddle.signbit(x).numpy(),
+                                      [True, False, False])
+        z = paddle.to_tensor(np.array([3 + 4j], "complex64"))
+        s = paddle.sgn(z).numpy()
+        np.testing.assert_allclose(s, [0.6 + 0.8j], rtol=1e-5)
+
+
+class TestReviewRegressions:
+    def test_roi_pool_exact_max_even_coords(self):
+        # the max lives at an even coordinate a sampling grid would skip
+        x = np.zeros((1, 1, 8, 8), "float32")
+        x[0, 0, 2, 2] = 9.0
+        out = vops.roi_pool(paddle.to_tensor(x),
+                            paddle.to_tensor(
+                                np.array([[0.0, 0.0, 7.0, 7.0]],
+                                         "float32")),
+                            paddle.to_tensor(np.array([1], "int32")),
+                            output_size=1)
+        assert abs(float(out.numpy()[0, 0, 0, 0]) - 9.0) < 1e-5
+
+    def test_grid_sample_reflection(self):
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        # coordinate beyond -1: reflection samples the mirrored interior
+        grid = np.full((1, 1, 1, 2), -1.5, "float32")
+        out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                            padding_mode="reflection",
+                            align_corners=True)
+        # x=-1.5 -> unnorm -0.75 -> reflect 0.75; same for y
+        want = (x[0, 0, 0, 0] * 0.25 * 0.25 + x[0, 0, 0, 1] * 0.25 * 0.75
+                + x[0, 0, 1, 0] * 0.75 * 0.25
+                + x[0, 0, 1, 1] * 0.75 * 0.75)
+        assert abs(float(out.numpy()[0, 0, 0, 0]) - want) < 1e-4
+
+    def test_sequence_mask_multidim(self):
+        lengths = np.array([[1, 2], [3, 0]], "int64")
+        m = F.sequence_mask(paddle.to_tensor(lengths), maxlen=4)
+        assert m.shape == [2, 2, 4]
+        np.testing.assert_array_equal(m.numpy()[1, 0], [1, 1, 1, 0])
+
+    def test_temporal_shift_nhwc(self):
+        x = np.arange(2 * 2 * 2 * 4, dtype="float32").reshape(2, 2, 2, 4)
+        out_nhwc = F.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                                    data_format="NHWC")
+        want = F.temporal_shift(
+            paddle.to_tensor(x.transpose(0, 3, 1, 2)),
+            seg_num=2).numpy().transpose(0, 2, 3, 1)
+        np.testing.assert_allclose(out_nhwc.numpy(), want)
+
+    def test_max_unpool2d_nonsquare(self):
+        x = np.ones((1, 1, 2, 3), "float32")
+        idx = np.arange(6, dtype="int64").reshape(1, 1, 2, 3)
+        out = F.max_unpool2d(paddle.to_tensor(x), paddle.to_tensor(idx),
+                             kernel_size=(2, 4))
+        assert out.shape == [1, 1, 4, 12]
+
+    def test_diagonal_scatter_3d(self):
+        x = paddle.to_tensor(np.zeros((2, 2, 3), "float32"))
+        # diagonal over axes (0, 1): paddle y layout [3, 2] (diag last)
+        y = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0],
+                                       [5.0, 6.0]], "float32"))
+        out = paddle.diagonal_scatter(x, y, axis1=0, axis2=1)
+        o = out.numpy()
+        np.testing.assert_allclose(o[0, 0], [1, 3, 5])
+        np.testing.assert_allclose(o[1, 1], [2, 4, 6])
+        np.testing.assert_allclose(o[0, 1], 0.0)
+
+    def test_box_coder_decode_axis1_var(self):
+        prior = np.array([[0, 0, 4, 4], [1, 1, 5, 5]], "float32")
+        var = np.full((2, 4), 0.5, "float32")
+        deltas = np.zeros((2, 3, 4), "float32")  # priors on axis 1? no:
+        # axis=1 -> priors on axis 0 of the output grid: [N=2, M=3, 4]
+        out = vops.box_coder(paddle.to_tensor(prior),
+                             paddle.to_tensor(var),
+                             paddle.to_tensor(deltas),
+                             code_type="decode_center_size", axis=1)
+        assert out.shape == [2, 3, 4]
+        # zero deltas decode back to the prior boxes regardless of var
+        np.testing.assert_allclose(out.numpy()[0, 0], prior[0],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(out.numpy()[1, 2], prior[1],
+                                   rtol=1e-5)
