@@ -106,6 +106,7 @@ from . import text  # noqa: E402,F401
 from . import audio  # noqa: E402,F401
 from . import inference  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
+from . import geometric  # noqa: E402,F401
 from . import static  # noqa: E402,F401
 from .static import enable_static, disable_static  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401

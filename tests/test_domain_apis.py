@@ -199,3 +199,80 @@ class TestSignal:
         # pure 8-cycles-per-64-samples cosine: bin 8 dominates
         mag = np.abs(spec[:, 0])
         assert mag.argmax() == 8
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        from paddle_tpu import geometric as G
+        data = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0],
+                                          [5.0, 6.0]], "float32"))
+        ids = paddle.to_tensor(np.array([0, 0, 1], "int64"))
+        np.testing.assert_allclose(G.segment_sum(data, ids).numpy(),
+                                   [[4, 6], [5, 6]])
+        np.testing.assert_allclose(G.segment_mean(data, ids).numpy(),
+                                   [[2, 3], [5, 6]])
+        np.testing.assert_allclose(G.segment_max(data, ids).numpy(),
+                                   [[3, 4], [5, 6]])
+        np.testing.assert_allclose(G.segment_min(data, ids).numpy(),
+                                   [[1, 2], [5, 6]])
+
+    def test_send_u_recv_gcn_step(self):
+        from paddle_tpu import geometric as G
+        # 3-node graph: 0->1, 1->2, 2->1
+        x = paddle.to_tensor(np.array([[1.0], [10.0], [100.0]],
+                                      "float32"), stop_gradient=False)
+        src = paddle.to_tensor(np.array([0, 1, 2], "int64"))
+        dst = paddle.to_tensor(np.array([1, 2, 1], "int64"))
+        out = G.send_u_recv(x, src, dst, reduce_op="sum")
+        np.testing.assert_allclose(out.numpy(), [[0], [101], [10]])
+        out.sum().backward()
+        # every node's feature flowed to exactly one destination
+        np.testing.assert_allclose(x.grad.numpy(), [[1], [1], [1]])
+
+    def test_send_ue_recv_and_uv(self):
+        from paddle_tpu import geometric as G
+        x = paddle.to_tensor(np.array([[1.0], [2.0]], "float32"))
+        e = paddle.to_tensor(np.array([[10.0], [20.0]], "float32"))
+        src = paddle.to_tensor(np.array([0, 1], "int64"))
+        dst = paddle.to_tensor(np.array([1, 0], "int64"))
+        out = G.send_ue_recv(x, e, src, dst, message_op="mul",
+                             reduce_op="sum")
+        np.testing.assert_allclose(out.numpy(), [[40], [10]])
+        uv = G.send_uv(x, x, src, dst, message_op="add")
+        np.testing.assert_allclose(uv.numpy(), [[3], [3]])
+
+    def test_mean_max_empty_segment(self):
+        from paddle_tpu import geometric as G
+        data = paddle.to_tensor(np.ones((2, 2), "float32"))
+        ids = paddle.to_tensor(np.array([0, 0], "int64"))
+        out = G.send_u_recv(data, paddle.to_tensor(
+            np.array([0, 1], "int64")), paddle.to_tensor(
+            np.array([2, 2], "int64")), reduce_op="max", out_size=3)
+        # segments 0,1 empty -> 0 (not -inf)
+        np.testing.assert_allclose(out.numpy()[0], 0.0)
+
+    def test_segment_num_segments_and_inf_max(self):
+        from paddle_tpu import geometric as G
+        import paddle_tpu.jit as jit
+        data = paddle.to_tensor(np.array([[np.inf], [1.0]], "float32"))
+        ids = paddle.to_tensor(np.array([0, 1], "int64"))
+        out = G.segment_max(data, ids)
+        assert out.numpy()[0, 0] == np.inf  # real inf max survives
+        out3 = G.segment_sum(data, ids, num_segments=3)
+        assert out3.shape == [3, 1]
+
+        @jit.to_static
+        def f(d, i):
+            return G.segment_sum(d, i, num_segments=2)
+
+        got = f(paddle.to_tensor(np.ones((4, 2), "float32")),
+                paddle.to_tensor(np.array([0, 0, 1, 1], "int64")))
+        np.testing.assert_allclose(got.numpy(), 2.0)
+
+        @jit.to_static
+        def g(d, i):
+            return G.segment_sum(d, i)  # no count under trace -> error
+
+        with pytest.raises(ValueError, match="num_segments"):
+            g(paddle.to_tensor(np.ones((2, 2), "float32")),
+              paddle.to_tensor(np.array([0, 1], "int64")))
