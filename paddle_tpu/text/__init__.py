@@ -16,7 +16,9 @@ from ..core.dispatch import register_op
 from ..nn.layer.layers import Layer
 from ..ops._helpers import as_tensor, apply_op
 
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
+from .tokenizer import FasterTokenizer  # noqa: E402
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "FasterTokenizer"]
 
 
 def _viterbi_fwd(potentials, trans, lengths, include_bos_eos_tag=True):

@@ -1,0 +1,207 @@
+/* Native wordpiece tokenizer core.
+ *
+ * TPU-native counterpart of the reference's faster_tokenizer string op
+ * (paddle/fluid/operators/string/faster_tokenizer_op.*, utf8proc-based
+ * BERT tokenizer running as a C++ op). On TPU the tokenizer stays on
+ * the HOST feeding path — the win is native-speed preprocessing while
+ * the chip runs the previous batch, so this is a plain C core exposed
+ * through ctypes (no pybind11 in this toolchain).
+ *
+ * Scope: BERT basic+wordpiece tokenization over a caller-provided
+ * vocab. ASCII lowercasing only (unicode category handling stays in
+ * Python where needed); bytes in, ids out.
+ *
+ * Build: cc -O2 -shared -fPIC _fast_tokenizer.c -o _fast_tokenizer.so
+ * (driven by paddle_tpu/text/_native.py, cached under
+ * ~/.cache/paddle_tpu, invalidated by source mtime).
+ */
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* ---- open-addressing string hash table (vocab: token -> id) ---- */
+
+typedef struct {
+    char **keys;
+    int32_t *vals;
+    size_t cap;      /* power of two */
+    size_t n;
+} vocab_t;
+
+static uint64_t hash_str(const char *s, size_t len) {
+    uint64_t h = 1469598103934665603ULL; /* FNV-1a */
+    for (size_t i = 0; i < len; i++) {
+        h ^= (unsigned char)s[i];
+        h *= 1099511628211ULL;
+    }
+    return h;
+}
+
+vocab_t *vocab_new(size_t hint) {
+    vocab_t *v = (vocab_t *)calloc(1, sizeof(vocab_t));
+    if (!v) return NULL;
+    v->cap = 16;
+    while (v->cap < hint * 2) v->cap <<= 1;
+    v->keys = (char **)calloc(v->cap, sizeof(char *));
+    v->vals = (int32_t *)calloc(v->cap, sizeof(int32_t));
+    if (!v->keys || !v->vals) { free(v->keys); free(v->vals); free(v); return NULL; }
+    return v;
+}
+
+void vocab_free(vocab_t *v) {
+    if (!v) return;
+    for (size_t i = 0; i < v->cap; i++) free(v->keys[i]);
+    free(v->keys);
+    free(v->vals);
+    free(v);
+}
+
+void vocab_put(vocab_t *v, const char *key, int32_t id) {
+    if (!v) return;
+    size_t mask = v->cap - 1;
+    size_t i = hash_str(key, strlen(key)) & mask;
+    while (v->keys[i]) {
+        if (strcmp(v->keys[i], key) == 0) { v->vals[i] = id; return; }
+        i = (i + 1) & mask;
+    }
+    v->keys[i] = strdup(key);
+    v->vals[i] = id;
+    v->n++;
+}
+
+static int32_t vocab_get_n(const vocab_t *v, const char *key, size_t len) {
+    size_t mask = v->cap - 1;
+    size_t i = hash_str(key, len) & mask;
+    while (v->keys[i]) {
+        if (strncmp(v->keys[i], key, len) == 0 && v->keys[i][len] == '\0')
+            return v->vals[i];
+        i = (i + 1) & mask;
+    }
+    return -1;
+}
+
+int32_t vocab_get(const vocab_t *v, const char *key) {
+    if (!v) return -1;
+    return vocab_get_n(v, key, strlen(key));
+}
+
+/* ---- basic tokenization helpers (ASCII fast paths) ---- */
+
+static int is_ws(unsigned char c) {
+    return c == ' ' || c == '\t' || c == '\n' || c == '\r';
+}
+
+static int is_punct(unsigned char c) {
+    /* ASCII punctuation ranges, matching BasicTokenizer._is_punctuation */
+    return (c >= 33 && c <= 47) || (c >= 58 && c <= 64) ||
+           (c >= 91 && c <= 96) || (c >= 123 && c <= 126);
+}
+
+/* ---- wordpiece over one whitespace-split word ----
+ * Greedy longest-match; continuation pieces looked up as "##suffix".
+ * Returns number of ids appended, or appends unk_id once on failure. */
+static int wordpiece(const vocab_t *v, const char *word, size_t len,
+                     int32_t unk_id, size_t max_chars,
+                     int32_t *out, int out_cap) {
+    if (len > max_chars) {
+        if (out_cap < 1) return 0;
+        out[0] = unk_id;
+        return 1;
+    }
+    char buf[512 + 2];
+    int n = 0;
+    size_t start = 0;
+    while (start < len) {
+        size_t end = len;
+        int32_t cur = -1;
+        while (end > start) {
+            size_t plen = end - start;
+            if (plen + 2 < sizeof(buf)) {
+                const char *piece;
+                size_t piece_len;
+                if (start > 0) {
+                    buf[0] = '#'; buf[1] = '#';
+                    memcpy(buf + 2, word + start, plen);
+                    piece = buf;
+                    piece_len = plen + 2;
+                } else {
+                    piece = word + start;
+                    piece_len = plen;
+                }
+                cur = vocab_get_n(v, piece, piece_len);
+                if (cur >= 0) break;
+            }
+            end--;
+        }
+        if (cur < 0) {          /* un-tokenizable word -> single [UNK] */
+            if (out_cap < 1) return 0;
+            out[0] = unk_id;
+            return 1;
+        }
+        if (n >= out_cap) return n;
+        out[n++] = cur;
+        start = end;
+    }
+    return n;
+}
+
+/* ---- full encode: basic split (+lowercase, punct isolation) then
+ * wordpiece per word. Returns id count written to `out`. ---- */
+int tokenizer_encode(const vocab_t *v, const char *text, int text_len,
+                     int do_lower, int32_t unk_id,
+                     int32_t *out, int out_cap) {
+    char *norm = (char *)malloc((size_t)text_len * 3 + 2);
+    if (!norm) return 0;
+    /* pass 1: lowercase + isolate punctuation with spaces */
+    int m = 0;
+    for (int i = 0; i < text_len; i++) {
+        unsigned char c = (unsigned char)text[i];
+        if (c < 0x20 && !is_ws(c)) continue;       /* strip controls */
+        if (is_punct(c)) {
+            norm[m++] = ' ';
+            norm[m++] = (char)c;
+            norm[m++] = ' ';
+        } else if (do_lower && c >= 'A' && c <= 'Z') {
+            norm[m++] = (char)(c + 32);
+        } else {
+            norm[m++] = (char)c;
+        }
+    }
+    norm[m] = '\0';
+    /* pass 2: whitespace split -> wordpiece */
+    int n = 0;
+    int i = 0;
+    while (i < m && n < out_cap) {
+        while (i < m && is_ws((unsigned char)norm[i])) i++;
+        int start = i;
+        while (i < m && !is_ws((unsigned char)norm[i])) i++;
+        if (i > start) {
+            n += wordpiece(v, norm + start, (size_t)(i - start), unk_id,
+                           200, out + n, out_cap - n);
+        }
+    }
+    free(norm);
+    return n;
+}
+
+/* batch encode: texts as one blob with offsets; per-row padding to
+ * max_len with pad_id; returns actual lengths in `lens`. */
+void tokenizer_encode_batch(const vocab_t *v, const char *blob,
+                            const int64_t *offsets, int n_texts,
+                            int do_lower, int32_t unk_id, int32_t pad_id,
+                            int32_t cls_id, int32_t sep_id, int max_len,
+                            int32_t *out, int32_t *lens) {
+    for (int t = 0; t < n_texts; t++) {
+        const char *text = blob + offsets[t];
+        int text_len = (int)(offsets[t + 1] - offsets[t]);
+        int32_t *row = out + (size_t)t * max_len;
+        int n = 0;
+        if (cls_id >= 0 && n < max_len) row[n++] = cls_id;
+        n += tokenizer_encode(v, text, text_len, do_lower, unk_id,
+                              row + n,
+                              max_len - n - (sep_id >= 0 ? 1 : 0));
+        if (sep_id >= 0 && n < max_len) row[n++] = sep_id;
+        lens[t] = n;
+        for (; n < max_len; n++) row[n] = pad_id;
+    }
+}

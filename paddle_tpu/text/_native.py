@@ -1,0 +1,86 @@
+"""Build + bind the native tokenizer core (ctypes, no pybind11).
+
+Compiles _fast_tokenizer.c with the system compiler on first use and
+caches the .so next to the source (invalidated by source mtime). Import
+never fails: callers check `available()` and fall back to the pure-
+Python path.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "_fast_tokenizer.c")
+# cache in a user-writable dir (read-only site-packages installs can't
+# take a .so next to the source; binaries also stay out of the repo)
+_CACHE = os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu")
+_SO = os.path.join(_CACHE, "_fast_tokenizer.so")
+
+_lib = None
+_err: str | None = None
+
+
+def _build():
+    try:
+        os.makedirs(_CACHE, exist_ok=True)
+    except OSError as e:
+        return str(e)
+    for cc in ("cc", "gcc", "clang"):
+        try:
+            r = subprocess.run(
+                [cc, "-O2", "-shared", "-fPIC", _SRC, "-o", _SO],
+                capture_output=True, text=True, timeout=120)
+            if r.returncode == 0:
+                return None
+            err = r.stderr
+        except (OSError, subprocess.TimeoutExpired) as e:
+            err = str(e)
+    return err
+
+
+def _load():
+    global _lib, _err
+    if _lib is not None or _err is not None:
+        return _lib
+    try:
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            err = _build()
+            if err is not None:
+                _err = err
+                return None
+        lib = ctypes.CDLL(_SO)
+        lib.vocab_new.restype = ctypes.c_void_p
+        lib.vocab_new.argtypes = [ctypes.c_size_t]
+        lib.vocab_free.argtypes = [ctypes.c_void_p]
+        lib.vocab_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int32]
+        lib.vocab_get.restype = ctypes.c_int32
+        lib.vocab_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.tokenizer_encode.restype = ctypes.c_int
+        lib.tokenizer_encode.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+        lib.tokenizer_encode_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32)]
+        _lib = lib
+    except OSError as e:
+        _err = str(e)
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_error():
+    _load()
+    return _err

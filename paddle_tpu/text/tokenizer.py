@@ -1,0 +1,166 @@
+"""FasterTokenizer: BERT basic+wordpiece tokenization.
+
+Reference: the faster_tokenizer string op
+(paddle/fluid/operators/string/faster_tokenizer_op.*, SURVEY.md §2.5
+"string/") — a NATIVE tokenizer in the serving path. Here the native
+core is C (paddle_tpu/text/_fast_tokenizer.c, bound via ctypes — the
+host-side feeding path is where native code still pays on TPU), with a
+pure-Python fallback of identical semantics when no compiler is
+available.
+
+ASCII scope note: lowercasing and punctuation isolation cover ASCII;
+non-ASCII bytes pass through to wordpiece matching (UTF-8 byte-exact
+vocab lookups still work).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import _native
+
+__all__ = ["FasterTokenizer"]
+
+_PUNCT = set(range(33, 48)) | set(range(58, 65)) | set(range(91, 97)) \
+    | set(range(123, 127))
+
+
+class FasterTokenizer:
+    """vocab: dict token->id, or a path to a one-token-per-line file.
+
+    __call__(texts, max_seq_len) -> (input_ids [B, L] int32,
+    seq_lens [B] int32), with [CLS]/[SEP] framing when present in the
+    vocab (reference op semantics)."""
+
+    def __init__(self, vocab, do_lower_case=True, unk_token="[UNK]",
+                 pad_token="[PAD]", cls_token="[CLS]",
+                 sep_token="[SEP]"):
+        if isinstance(vocab, str):
+            with open(vocab) as f:
+                vocab = {line.rstrip("\r\n"): i
+                         for i, line in enumerate(f)}
+        self.vocab = dict(vocab)
+        # byte-keyed mirror: the fallback must match the C core's
+        # byte-exact lookups (no mid-multibyte false matches via lossy
+        # decode)
+        self._vocab_bytes = {k.encode("utf-8"): v
+                             for k, v in self.vocab.items()}
+        self.do_lower_case = bool(do_lower_case)
+        self.unk_id = self.vocab.get(unk_token, 0)
+        self.pad_id = self.vocab.get(pad_token, 0)
+        self.cls_id = self.vocab.get(cls_token, -1)
+        self.sep_id = self.vocab.get(sep_token, -1)
+        self._native_vocab = None
+        if _native.available():
+            lib = _native._load()
+            self._lib = lib
+            handle = lib.vocab_new(len(self.vocab))
+            if handle:   # NULL on allocation failure -> Python path
+                self._native_vocab = handle
+                for tok, i in self.vocab.items():
+                    lib.vocab_put(self._native_vocab,
+                                  tok.encode("utf-8"), int(i))
+
+    def __del__(self):
+        if getattr(self, "_native_vocab", None):
+            try:
+                self._lib.vocab_free(self._native_vocab)
+            except Exception:
+                pass
+
+    @property
+    def uses_native(self):
+        return self._native_vocab is not None
+
+    # -- pure-Python reference path (same semantics as the C core) ----------
+    def _py_encode(self, text, out_cap):
+        norm = []
+        for ch in text:
+            o = ord(ch)
+            if o < 0x20 and ch not in "\t\n\r":
+                continue
+            if o in _PUNCT:
+                norm.append(f" {ch} ")
+            elif self.do_lower_case and "A" <= ch <= "Z":
+                norm.append(ch.lower())
+            else:
+                norm.append(ch)
+        ids = []
+        for word in "".join(norm).split():
+            b = word.encode("utf-8")
+            if len(b) > 200:
+                ids.append(self.unk_id)
+                continue
+            start, piece_ids = 0, []
+            ok = True
+            while start < len(b):
+                end = len(b)
+                cur = None
+                while end > start:
+                    piece = b[start:end]
+                    if start > 0:
+                        piece = b"##" + piece
+                    if piece in self._vocab_bytes:
+                        cur = self._vocab_bytes[piece]
+                        break
+                    end -= 1
+                if cur is None:
+                    ok = False
+                    break
+                piece_ids.append(cur)
+                start = end
+            ids.extend(piece_ids if ok else [self.unk_id])
+            if len(ids) >= out_cap:
+                return ids[:out_cap]
+        return ids
+
+    # -- public API ----------------------------------------------------------
+    def encode(self, text, max_seq_len=None):
+        """Single text -> list of ids (no CLS/SEP framing)."""
+        cap = max_seq_len if max_seq_len is not None else 1 << 16
+        if self._native_vocab is not None:
+            import ctypes
+            buf = (ctypes.c_int32 * cap)()
+            raw = text.encode("utf-8")
+            n = self._lib.tokenizer_encode(
+                self._native_vocab, raw, len(raw),
+                int(self.do_lower_case), self.unk_id, buf, cap)
+            return list(buf[:n])
+        return self._py_encode(text, cap)
+
+    def __call__(self, texts, max_seq_len=128):
+        """Batch encode with CLS/SEP framing and padding -> Tensors."""
+        if isinstance(texts, str):
+            texts = [texts]
+        b = len(texts)
+        if self._native_vocab is not None:
+            import ctypes
+            raws = [t.encode("utf-8") for t in texts]
+            blob = b"".join(raws)
+            offsets = np.zeros(b + 1, np.int64)
+            np.cumsum([len(r) for r in raws], out=offsets[1:])
+            ids = np.zeros((b, max_seq_len), np.int32)
+            lens = np.zeros((b,), np.int32)
+            self._lib.tokenizer_encode_batch(
+                self._native_vocab, blob,
+                offsets.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_int64)), b,
+                int(self.do_lower_case), self.unk_id, self.pad_id,
+                self.cls_id, self.sep_id, max_seq_len,
+                ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        else:
+            ids = np.full((b, max_seq_len), self.pad_id, np.int32)
+            lens = np.zeros((b,), np.int32)
+            for t, text in enumerate(texts):
+                row = []
+                if self.cls_id >= 0:
+                    row.append(self.cls_id)
+                room = max_seq_len - len(row) - (1 if self.sep_id >= 0
+                                                 else 0)
+                row += self._py_encode(text, room)
+                if self.sep_id >= 0:
+                    row.append(self.sep_id)
+                lens[t] = len(row)
+                ids[t, :len(row)] = row
+        from ..ops.creation import to_tensor
+        return to_tensor(ids), to_tensor(lens)

@@ -293,3 +293,69 @@ class TestReviewRegressions:
         # interpolation property: w - w0 must be strictly smaller than
         # the fast excursion would have been alone
         assert np.abs(w - w0).sum() > 0
+
+
+class TestFasterTokenizer:
+    VOCAB = {"[PAD]": 0, "[UNK]": 1, "[CLS]": 2, "[SEP]": 3,
+             "the": 4, "quick": 5, "brown": 6, "fox": 7, "jump": 8,
+             "##ed": 9, "##s": 10, "over": 11, ",": 12, ".": 13,
+             "un": 14, "##believ": 15, "##able": 16}
+
+    def test_native_core_builds(self):
+        from paddle_tpu.text import _native
+        assert _native.available(), _native.build_error()
+
+    def test_wordpiece_and_framing(self):
+        from paddle_tpu.text import FasterTokenizer
+        tok = FasterTokenizer(self.VOCAB)
+        assert tok.uses_native
+        ids = tok.encode("The quick brown fox jumped.")
+        assert ids == [4, 5, 6, 7, 8, 9, 13]
+        batch, lens = tok(["the fox jumps,", "unbelievable"],
+                          max_seq_len=10)
+        b = batch.numpy()
+        assert b[0].tolist()[:int(lens.numpy()[0])] == \
+            [2, 4, 7, 8, 10, 12, 3]
+        assert b[1].tolist()[:int(lens.numpy()[1])] == \
+            [2, 14, 15, 16, 3]
+        assert (b[1][int(lens.numpy()[1]):] == 0).all()  # padded
+
+    def test_unknown_word(self):
+        from paddle_tpu.text import FasterTokenizer
+        tok = FasterTokenizer(self.VOCAB)
+        assert tok.encode("zzz") == [1]  # [UNK]
+
+    def test_native_matches_python_fallback(self):
+        from paddle_tpu.text import FasterTokenizer
+        tok = FasterTokenizer(self.VOCAB)
+        texts = ["The QUICK brown fox,", "unbelievable jumps.",
+                 "zzz over the fox", "  , .  "]
+        for t in texts:
+            native = tok.encode(t)
+            python = tok._py_encode(t, 1 << 16)
+            assert native == python, (t, native, python)
+
+    def test_truncation(self):
+        from paddle_tpu.text import FasterTokenizer
+        tok = FasterTokenizer(self.VOCAB)
+        batch, lens = tok(["the " * 50], max_seq_len=8)
+        assert int(lens.numpy()[0]) == 8
+        row = batch.numpy()[0].tolist()
+        assert row[0] == 2 and row[-1] == 3  # CLS ... SEP kept
+
+    def test_multibyte_parity_with_python(self):
+        from paddle_tpu.text import FasterTokenizer
+        vocab = dict(self.VOCAB)
+        vocab["fox"] = 7
+        vocab["##é"] = 20
+        vocab["café"] = 21
+        tok = FasterTokenizer(vocab)
+        for t in ["foxé", "café", "caféé", "ñandú"]:
+            assert tok.encode(t) == tok._py_encode(t, 1 << 16), t
+
+    def test_crlf_vocab_file(self, tmp_path):
+        from paddle_tpu.text import FasterTokenizer
+        p = tmp_path / "vocab.txt"
+        p.write_bytes(b"[PAD]\r\n[UNK]\r\nthe\r\nfox\r\n")
+        tok = FasterTokenizer(str(p))
+        assert tok.encode("the fox") == [2, 3]
