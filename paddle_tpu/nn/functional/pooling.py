@@ -123,7 +123,7 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                      data_format, ceil_mode=ceil_mode)
     if return_mask:
         return out, _pool_mask(x, out, 2, kernel_size, stride, padding,
-                               data_format)
+                               data_format, ceil_mode=ceil_mode)
     return out
 
 
@@ -163,13 +163,70 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                       divisor=divisor_override)
 
 
-def _pool_mask(x, out, n, kernel_size, stride, padding, data_format):
-    """argmax indices for return_mask=True (flat spatial index, paddle-style)."""
-    from .. import functional as F  # lazy; avoids cycles
+def _mask2d_fwd(x, kh, kw, sh, sw, ph, pw, ceil_mode):
+    """Windowed argmax: flat H*W index of each pooled max (the paddle
+    mask convention consumed by max_unpool2d)."""
+    n, c, h, w = x.shape
+
+    def geom(size, k, s, p):
+        """(out, pad_hi) with EXACTLY _pool_impl's ceil_mode rule."""
+        total = size + 2 * p
+        if ceil_mode:
+            out = -(-(total - k) // s) + 1
+            if (out - 1) * s >= size + p:
+                out -= 1
+            pad_hi = p + max((out - 1) * s + k - total, 0)
+        else:
+            out = (total - k) // s + 1
+            pad_hi = p
+        return out, pad_hi
+
+    oh, ph_hi = geom(h, kh, sh, ph)
+    ow, pw_hi = geom(w, kw, sw, pw)
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph_hi), (pw, pw_hi)),
+                 constant_values=-jnp.inf)
+    hy = jnp.arange(oh) * sh
+    wx = jnp.arange(ow) * sw
+    # [oh, kh] / [ow, kw] gather grids -> [n, c, oh, kh, ow, kw]
+    win = xp[:, :, hy[:, None] + jnp.arange(kh)[None, :], :]
+    win = win[:, :, :, :, wx[:, None] + jnp.arange(kw)[None, :]]
+    win = win.reshape(n, c, oh, kh, ow, kw).transpose(0, 1, 2, 4, 3, 5)
+    flat = win.reshape(n, c, oh, ow, kh * kw)
+    arg = jnp.argmax(flat, axis=-1)                    # [n, c, oh, ow]
+    dy, dx = arg // kw, arg % kw
+    gy = hy[None, None, :, None] + dy - ph             # unpadded coords
+    gx = wx[None, None, None, :] + dx - pw
+    return (gy * w + gx).astype(jnp.int64)
+
+
+register_op("max_pool2d_mask", _mask2d_fwd, nondiff=True)
+
+
+def _pool_mask(x, out, n, kernel_size, stride, padding, data_format,
+               ceil_mode=False):
+    """argmax indices for return_mask=True (flat spatial index, the
+    paddle mask convention; reference: max_pool2d_with_index kernel)."""
+    if n != 2 or not data_format.startswith("NC"):
+        raise NotImplementedError(
+            "return_mask=True: 2-D NCHW only on the TPU backend")
     x = as_tensor(x)
-    # brute force: recompute with one-hot window positions; used rarely.
-    raise NotImplementedError(
-        "return_mask=True is not yet supported on the TPU backend")
+    if stride is None:
+        stride = kernel_size
+    kh, kw = _norm_tuple(kernel_size, 2, "kernel_size")
+    sh, sw = _norm_tuple(stride, 2, "stride")
+    if isinstance(padding, (list, tuple)) and len(padding) > 2:
+        raise NotImplementedError(
+            "return_mask=True with asymmetric padding")
+    ph, pw = _norm_tuple(padding, 2, "padding")
+    # the mask must use the SAME output geometry as the pooled values
+    mask = apply_op("max_pool2d_mask", x,
+                    attrs=dict(kh=kh, kw=kw, sh=sh, sw=sw, ph=ph,
+                               pw=pw, ceil_mode=bool(ceil_mode)))
+    if list(mask.shape) != list(out.shape):
+        raise NotImplementedError(
+            f"return_mask geometry mismatch {mask.shape} vs "
+            f"{out.shape}; report this configuration")
+    return mask
 
 
 # -- adaptive pooling --------------------------------------------------------

@@ -11,9 +11,9 @@
  * vocab. ASCII lowercasing only (unicode category handling stays in
  * Python where needed); bytes in, ids out.
  *
- * Build: cc -O2 -shared -fPIC _fast_tokenizer.c -o _fast_tokenizer.so
+ * Build: cc -O2 -shared -fPIC _fast_tokenizer.c -o <hash>.so
  * (driven by paddle_tpu/text/_native.py, cached under
- * ~/.cache/paddle_tpu, invalidated by source mtime).
+ * ~/.cache/paddle_tpu keyed by source hash).
  */
 #include <stdint.h>
 #include <stdlib.h>
@@ -109,6 +109,7 @@ static int wordpiece(const vocab_t *v, const char *word, size_t len,
         return 1;
     }
     char buf[512 + 2];
+    int32_t pieces[256];    /* max_chars <= 200 -> at most 200 pieces */
     int n = 0;
     size_t start = 0;
     while (start < len) {
@@ -138,10 +139,15 @@ static int wordpiece(const vocab_t *v, const char *word, size_t len,
             out[0] = unk_id;
             return 1;
         }
-        if (n >= out_cap) return n;
-        out[n++] = cur;
+        if (n < (int)(sizeof(pieces) / sizeof(pieces[0])))
+            pieces[n] = cur;
+        n++;
         start = end;
     }
+    /* tokenizability decided on the WHOLE word; truncate only now
+     * (matches the Python fallback's decide-then-truncate order) */
+    if (n > out_cap) n = out_cap;
+    memcpy(out, pieces, (size_t)n * sizeof(int32_t));
     return n;
 }
 

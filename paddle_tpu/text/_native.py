@@ -1,43 +1,63 @@
 """Build + bind the native tokenizer core (ctypes, no pybind11).
 
 Compiles _fast_tokenizer.c with the system compiler on first use and
-caches the .so next to the source (invalidated by source mtime). Import
-never fails: callers check `available()` and fall back to the pure-
-Python path.
+caches the .so under ~/.cache/paddle_tpu, keyed by the source hash
+(atomic publish, safe for concurrent builders). Import never fails:
+callers check `available()` and fall back to the pure-Python path.
 """
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import sys
+import tempfile
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "_fast_tokenizer.c")
 # cache in a user-writable dir (read-only site-packages installs can't
-# take a .so next to the source; binaries also stay out of the repo)
+# take a .so next to the source; binaries also stay out of the repo).
+# The filename is keyed by the SOURCE HASH so different checkouts/
+# versions sharing the cache dir never load each other's binaries.
 _CACHE = os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu")
-_SO = os.path.join(_CACHE, "_fast_tokenizer.so")
+
+
+def _so_path():
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    return os.path.join(_CACHE, f"_fast_tokenizer_{digest}.so")
 
 _lib = None
 _err: str | None = None
 
 
-def _build():
+def _build(so_path):
     try:
         os.makedirs(_CACHE, exist_ok=True)
     except OSError as e:
         return str(e)
+    # build to a private temp file, then atomically publish: concurrent
+    # first-use builders (pytest-xdist workers) never load a half-
+    # written binary
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_CACHE)
+    os.close(fd)
+    err = "no compiler found"
     for cc in ("cc", "gcc", "clang"):
         try:
             r = subprocess.run(
-                [cc, "-O2", "-shared", "-fPIC", _SRC, "-o", _SO],
+                [cc, "-O2", "-shared", "-fPIC", _SRC, "-o", tmp],
                 capture_output=True, text=True, timeout=120)
             if r.returncode == 0:
+                os.replace(tmp, so_path)
                 return None
             err = r.stderr
         except (OSError, subprocess.TimeoutExpired) as e:
             err = str(e)
+    try:
+        os.unlink(tmp)
+    except OSError:
+        pass
     return err
 
 
@@ -46,13 +66,13 @@ def _load():
     if _lib is not None or _err is not None:
         return _lib
     try:
-        if (not os.path.exists(_SO)
-                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-            err = _build()
+        so = _so_path()
+        if not os.path.exists(so):
+            err = _build(so)
             if err is not None:
                 _err = err
                 return None
-        lib = ctypes.CDLL(_SO)
+        lib = ctypes.CDLL(so)
         lib.vocab_new.restype = ctypes.c_void_p
         lib.vocab_new.argtypes = [ctypes.c_size_t]
         lib.vocab_free.argtypes = [ctypes.c_void_p]

@@ -14,6 +14,8 @@ vocab lookups still work).
 """
 from __future__ import annotations
 
+import re
+
 import numpy as np
 
 from . import _native
@@ -85,7 +87,12 @@ class FasterTokenizer:
             else:
                 norm.append(ch)
         ids = []
-        for word in "".join(norm).split():
+        # split ONLY on the C core's whitespace set (str.split() would
+        # also split on unicode whitespace the C core treats as word
+        # bytes — the parity contract is byte-exact)
+        for word in re.split(r"[ \t\r\n]+", "".join(norm)):
+            if not word:
+                continue
             b = word.encode("utf-8")
             if len(b) > 200:
                 ids.append(self.unk_id)

@@ -359,3 +359,21 @@ class TestFasterTokenizer:
         p.write_bytes(b"[PAD]\r\n[UNK]\r\nthe\r\nfox\r\n")
         tok = FasterTokenizer(str(p))
         assert tok.encode("the fox") == [2, 3]
+
+    def test_unicode_whitespace_parity(self):
+        from paddle_tpu.text import FasterTokenizer
+        tok = FasterTokenizer(self.VOCAB)
+        # no-break space is NOT a separator in either path (the C core's
+        # whitespace set is the contract)
+        t = "the fox"
+        assert tok.encode(t) == tok._py_encode(t, 1 << 16)
+        assert tok.encode(t) == [1]  # one un-tokenizable word -> [UNK]
+
+    def test_truncation_parity_mid_word(self):
+        from paddle_tpu.text import FasterTokenizer
+        tok = FasterTokenizer(self.VOCAB)
+        # 'jumpzz' starts with a known piece but is un-tokenizable as a
+        # whole; with capacity 2 both paths must yield [the:4, UNK:1]
+        t = "the jumpzz"
+        assert tok.encode(t, max_seq_len=2) == \
+            tok._py_encode(t, 2) == [4, 1]

@@ -439,3 +439,21 @@ class TestLayerMechanics:
         out = clip([(p, g)])
         norm = np.linalg.norm(out[0][1].numpy())
         np.testing.assert_allclose(norm, 1.0, rtol=1e-4)
+
+
+class TestMaxPoolMask:
+    def test_return_mask_unpool_roundtrip(self):
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 3, 8, 8).astype("float32")
+        out, mask = F.max_pool2d(paddle.to_tensor(x), kernel_size=2,
+                                 return_mask=True)
+        restored = F.max_unpool2d(out, mask, kernel_size=2)
+        r, o, m = restored.numpy(), out.numpy(), mask.numpy()
+        flat = r.reshape(2, 3, -1)
+        np.testing.assert_allclose(
+            np.take_along_axis(flat, m.reshape(2, 3, -1), axis=-1),
+            o.reshape(2, 3, -1))
+        # pooled values are the true window maxima
+        win = x.reshape(2, 3, 4, 2, 4, 2).transpose(0, 1, 2, 4, 3, 5)
+        np.testing.assert_allclose(o, win.reshape(2, 3, 4, 4, 4).max(-1))
