@@ -183,20 +183,26 @@ def _mask2d_fwd(x, kh, kw, sh, sw, ph, pw, ceil_mode):
 
     oh, ph_hi = geom(h, kh, sh, ph)
     ow, pw_hi = geom(w, kw, sw, pw)
-    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph_hi), (pw, pw_hi)),
-                 constant_values=-jnp.inf)
-    hy = jnp.arange(oh) * sh
-    wx = jnp.arange(ow) * sw
-    # [oh, kh] / [ow, kw] gather grids -> [n, c, oh, kh, ow, kw]
-    win = xp[:, :, hy[:, None] + jnp.arange(kh)[None, :], :]
-    win = win[:, :, :, :, wx[:, None] + jnp.arange(kw)[None, :]]
-    win = win.reshape(n, c, oh, kh, ow, kw).transpose(0, 1, 2, 4, 3, 5)
-    flat = win.reshape(n, c, oh, ow, kh * kw)
-    arg = jnp.argmax(flat, axis=-1)                    # [n, c, oh, ow]
-    dy, dx = arg // kw, arg % kw
-    gy = hy[None, None, :, None] + dy - ph             # unpadded coords
-    gx = wx[None, None, None, :] + dx - pw
-    return (gy * w + gx).astype(jnp.int64)
+    # variadic reduce_window over (value, flat index) pairs — the same
+    # windowing HLO the pool compiles to, O(input) memory (a gather
+    # formulation would materialize a kh*kw-times-larger intermediate)
+    idx = jnp.broadcast_to(
+        (jnp.arange(h)[:, None] * w
+         + jnp.arange(w)[None, :]).astype(jnp.int32), (n, c, h, w))
+    pads = ((0, 0), (0, 0), (ph, ph_hi), (pw, pw_hi))
+
+    def comp(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av  # first-max wins ties (argmax convention)
+        return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+
+    _, arg = lax.reduce_window(
+        (x.astype(jnp.float32), idx),
+        (jnp.float32(-jnp.inf), jnp.int32(-1)), comp,
+        (1, 1, kh, kw), (1, 1, sh, sw), pads)
+    assert arg.shape[-2:] == (oh, ow), (arg.shape, oh, ow)
+    return arg.astype(jnp.int64)
 
 
 register_op("max_pool2d_mask", _mask2d_fwd, nondiff=True)
@@ -214,9 +220,24 @@ def _pool_mask(x, out, n, kernel_size, stride, padding, data_format,
         stride = kernel_size
     kh, kw = _norm_tuple(kernel_size, 2, "kernel_size")
     sh, sw = _norm_tuple(stride, 2, "stride")
-    if isinstance(padding, (list, tuple)) and len(padding) > 2:
-        raise NotImplementedError(
-            "return_mask=True with asymmetric padding")
+    # accept every symmetric form _pool_impl accepts (int, [ph, pw],
+    # nested symmetric pairs); asymmetric pads raise cleanly
+    if isinstance(padding, (list, tuple)):
+        flat = []
+        for p_ in padding:
+            if isinstance(p_, (list, tuple)):
+                if p_[0] != p_[1]:
+                    raise NotImplementedError(
+                        "return_mask=True with asymmetric padding")
+                flat.append(int(p_[0]))
+            else:
+                flat.append(int(p_))
+        if len(flat) == 4:  # [top, bottom, left, right]
+            if flat[0] != flat[1] or flat[2] != flat[3]:
+                raise NotImplementedError(
+                    "return_mask=True with asymmetric padding")
+            flat = [flat[0], flat[2]]
+        padding = flat
     ph, pw = _norm_tuple(padding, 2, "padding")
     # the mask must use the SAME output geometry as the pooled values
     mask = apply_op("max_pool2d_mask", x,

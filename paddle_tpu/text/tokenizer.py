@@ -75,6 +75,8 @@ class FasterTokenizer:
 
     # -- pure-Python reference path (same semantics as the C core) ----------
     def _py_encode(self, text, out_cap):
+        if out_cap <= 0:
+            return []
         norm = []
         for ch in text:
             o = ord(ch)
@@ -162,11 +164,13 @@ class FasterTokenizer:
                 row = []
                 if self.cls_id >= 0:
                     row.append(self.cls_id)
-                room = max_seq_len - len(row) - (1 if self.sep_id >= 0
-                                                 else 0)
+                room = max(max_seq_len - len(row)
+                           - (1 if self.sep_id >= 0 else 0), 0)
                 row += self._py_encode(text, room)
-                if self.sep_id >= 0:
+                # C core rule: SEP appended only when space remains
+                if self.sep_id >= 0 and len(row) < max_seq_len:
                     row.append(self.sep_id)
+                row = row[:max_seq_len]
                 lens[t] = len(row)
                 ids[t, :len(row)] = row
         from ..ops.creation import to_tensor

@@ -377,3 +377,14 @@ class TestFasterTokenizer:
         t = "the jumpzz"
         assert tok.encode(t, max_seq_len=2) == \
             tok._py_encode(t, 2) == [4, 1]
+
+    def test_framing_parity_tiny_max_seq_len(self):
+        from paddle_tpu.text import FasterTokenizer
+        tok = FasterTokenizer(self.VOCAB)
+        fallback = FasterTokenizer(self.VOCAB)
+        fallback._native_vocab = None
+        for msl in (1, 2, 3, 8):
+            a, la = tok(["the fox jumps"], max_seq_len=msl)
+            b, lb = fallback(["the fox jumps"], max_seq_len=msl)
+            np.testing.assert_array_equal(a.numpy(), b.numpy())
+            np.testing.assert_array_equal(la.numpy(), lb.numpy())

@@ -457,3 +457,17 @@ class TestMaxPoolMask:
         # pooled values are the true window maxima
         win = x.reshape(2, 3, 4, 2, 4, 2).transpose(0, 1, 2, 4, 3, 5)
         np.testing.assert_allclose(o, win.reshape(2, 3, 4, 4, 4).max(-1))
+
+    def test_return_mask_nested_padding_and_ceil(self):
+        import paddle_tpu.nn.functional as F
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(1, 2, 7, 7).astype("float32"))
+        out, mask = F.max_pool2d(x, 2, padding=[[1, 1], [1, 1]],
+                                 return_mask=True)
+        assert out.shape == list(mask.shape)
+        out, mask = F.max_pool2d(x, 2, stride=2, ceil_mode=True,
+                                 return_mask=True)
+        assert out.shape == list(mask.shape) == [1, 2, 4, 4]
+        with pytest.raises(NotImplementedError):
+            F.max_pool2d(x, 2, padding=[[1, 0], [1, 1]],
+                         return_mask=True)
