@@ -27,6 +27,7 @@ from .parallel import (  # noqa: F401
 from .sharding import (  # noqa: F401
     group_sharded_parallel, save_group_sharded_model)
 from .ring_attention import ring_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401
 from . import shard_ops  # noqa: F401
 from . import fleet  # noqa: F401
 from .moe import MoELayer  # noqa: F401

@@ -120,9 +120,12 @@ def ring_attention(query, key, value, causal=False, mesh=None,
     seq_spec = PartitionSpec(None, axis_name, None, None)
     for t in (query, key, value):
         shard_tensor(t, pm, spec=seq_spec)
-    key_ = (id(jmesh), axis_name, bool(causal))
+    key_ = (id(jmesh), axis_name, bool(causal),
+            None if scale is None else float(scale))
     op = _ring_ops.get(key_)
     if op is None:
+        if len(_ring_ops) > 8:  # bound mesh-pinning closure cache
+            _ring_ops.clear()
         def fwd(q, k, v, _m=jmesh, _ax=axis_name, _c=causal):
             return ring_attention_sharded(q, k, v, _m, _ax, _c, scale)
         op = OpDef(f"ring_attention::{axis_name}", fwd)
