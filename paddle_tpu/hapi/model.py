@@ -56,6 +56,7 @@ class Model:
         self._loss = None
         self._metrics = []
         self.stop_training = False
+        self._compiled_step = None  # jit fast path (no-metrics fit)
 
     # -- setup ---------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -65,6 +66,7 @@ class Model:
                                      or callable(loss)):
             raise TypeError("loss must be a Layer or callable")
         self._loss = loss
+        self._compiled_step = None  # new optimizer/loss: recompile
         self._metrics = _to_list(metrics)
         for m in self._metrics:
             if not isinstance(m, Metric):
@@ -87,10 +89,36 @@ class Model:
                     loss_scale=1.0):
         """One optimization step; returns (loss, metrics-results) when
         metrics are configured, else the loss float. loss_scale divides
-        the loss before backward (gradient accumulation averaging)."""
+        the loss before backward (gradient accumulation averaging).
+
+        Without metrics and without gradient accumulation the whole
+        step (forward+backward+update) runs as ONE compiled XLA program
+        (jit.trainer.CompiledTrainStep) — the TPU-idiomatic fit loop;
+        metrics need the live outputs, so they keep the eager path."""
         self.network.train()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
+        no_pending_grads = all(
+            p.grad is None for p in self.network.parameters())
+        if (not self._metrics and update and loss_scale == 1.0
+                and self._optimizer is not None and no_pending_grads):
+            # input arity is baked into the compiled split: rebuild when
+            # it changes
+            if (self._compiled_step is not None
+                    and self._compiled_n_in != len(inputs)):
+                self._compiled_step = None
+            if self._compiled_step is None:
+                from ..jit import compile_train_step
+                n_in = len(inputs)
+
+                def loss_fn(*batch):
+                    outs = self._forward(list(batch[:n_in]))
+                    return self._compute_loss(outs, list(batch[n_in:]))
+
+                self._compiled_step = compile_train_step(
+                    loss_fn, self.network, self._optimizer)
+                self._compiled_n_in = n_in
+            return [float(self._compiled_step(*inputs, *labels))]
         outputs = self._forward(inputs)
         loss = self._compute_loss(outputs, labels)
         lv = float(loss)
@@ -323,6 +351,9 @@ class Model:
         from ..framework import io as fio
         state = fio.load(path + ".pdparams")
         self.network.set_state_dict(state)
+        # the compiled step caches optimizer accumulators at build time;
+        # a checkpoint load must force a rebuild with the fresh state
+        self._compiled_step = None
         if not reset_optimizer and self._optimizer is not None and \
                 os.path.exists(path + ".pdopt"):
             self._optimizer.set_state_dict(fio.load(path + ".pdopt"))

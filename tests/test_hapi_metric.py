@@ -208,3 +208,67 @@ class TestModelFit:
         w_acc = run(2, 4)
         w_big = run(1, 8)
         np.testing.assert_allclose(w_acc, w_big, rtol=1e-4, atol=1e-6)
+
+    def test_compiled_fast_path_matches_eager(self):
+        # no metrics -> fit runs as one compiled XLA program per step;
+        # numerics must match the eager (metrics-attached) path
+        def run(with_metrics):
+            paddle.seed(7)
+            m = paddle.Model(_mlp())
+            m.prepare(optimizer=opt.SGD(learning_rate=0.1,
+                                        parameters=m.parameters()),
+                      loss=nn.CrossEntropyLoss(),
+                      metrics=Accuracy() if with_metrics else None)
+            m.fit(BlobDataset(64, seed=5), epochs=2, batch_size=32,
+                  verbose=0, shuffle=False)
+            return m.network[0].weight.numpy()
+
+        w_compiled = run(False)
+        w_eager = run(True)
+        np.testing.assert_allclose(w_compiled, w_eager, rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_compiled_path_engaged(self):
+        paddle.seed(0)
+        m = paddle.Model(_mlp())
+        m.prepare(optimizer=opt.SGD(learning_rate=0.1,
+                                    parameters=m.parameters()),
+                  loss=nn.CrossEntropyLoss())
+        m.fit(BlobDataset(64), epochs=1, batch_size=32, verbose=0)
+        assert m._compiled_step is not None
+        # metrics path must NOT compile
+        m2 = paddle.Model(_mlp())
+        m2.prepare(optimizer=opt.SGD(learning_rate=0.1,
+                                     parameters=m2.parameters()),
+                   loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+        m2.fit(BlobDataset(64), epochs=1, batch_size=32, verbose=0)
+        assert m2._compiled_step is None
+
+    def test_compiled_step_invalidation(self):
+        paddle.seed(0)
+        m = paddle.Model(_mlp())
+        m.prepare(optimizer=opt.SGD(learning_rate=0.1,
+                                    parameters=m.parameters()),
+                  loss=nn.CrossEntropyLoss())
+        m.fit(BlobDataset(64), epochs=1, batch_size=32, verbose=0)
+        first = m._compiled_step
+        assert first is not None
+        # re-prepare with a new optimizer: stale step must not survive
+        m.prepare(optimizer=opt.SGD(learning_rate=0.01,
+                                    parameters=m.parameters()),
+                  loss=nn.CrossEntropyLoss())
+        assert m._compiled_step is None
+        m.fit(BlobDataset(64), epochs=1, batch_size=32, verbose=0)
+        assert m._compiled_step is not first
+
+    def test_manual_accumulation_stays_eager(self):
+        paddle.seed(0)
+        m = paddle.Model(_mlp())
+        m.prepare(optimizer=opt.SGD(learning_rate=0.1,
+                                    parameters=m.parameters()),
+                  loss=nn.CrossEntropyLoss())
+        x = paddle.to_tensor(np.random.randn(8, 16).astype("float32"))
+        y = paddle.to_tensor(np.zeros((8, 1), "int64"))
+        m.train_batch([x], [y], update=False)   # eager, grads pending
+        m.train_batch([x], [y])                 # must NOT drop them
+        assert m._compiled_step is None          # stayed eager
