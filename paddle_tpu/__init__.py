@@ -112,6 +112,45 @@ from .static import enable_static, disable_static  # noqa: E402,F401
 from . import hapi  # noqa: E402,F401
 from .hapi import Model  # noqa: E402,F401
 from .hapi import callbacks  # noqa: E402,F401
+from .hapi.summary import summary, flops  # noqa: E402,F401
+
+
+def iinfo(dtype):
+    import numpy as _np
+    from .core import dtype as _dt
+    return _np.iinfo(_dt.to_np_dtype(dtype))
+
+
+def finfo(dtype):
+    import numpy as _np
+    from .core import dtype as _dt
+    return _np.finfo(_dt.to_np_dtype(dtype))
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader combinator (reference: python/paddle/reader)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+class LazyGuard:
+    """reference: paddle.LazyGuard defers parameter initialization to
+    first use; here parameters are jax arrays whose real allocation is
+    already lazy under PJRT, so the guard is scope-only."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
 
 bool = bool_  # paddle.bool
 

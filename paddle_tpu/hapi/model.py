@@ -98,10 +98,12 @@ class Model:
         self.network.train()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
-        no_pending_grads = all(
-            p.grad is None for p in self.network.parameters())
         if (not self._metrics and update and loss_scale == 1.0
-                and self._optimizer is not None and no_pending_grads):
+                and self._optimizer is not None
+                # last (O(n_params) scan): eagerly accumulated grads
+                # must not be dropped by the compiled step
+                and all(p.grad is None
+                        for p in self.network.parameters())):
             # input arity is baked into the compiled split: rebuild when
             # it changes
             if (self._compiled_step is not None
@@ -363,14 +365,11 @@ class Model:
         return self.network.parameters()
 
     def summary(self, input_size=None, dtype=None):
-        n_params = 0
-        rows = []
-        for name, p in self.network.named_parameters():
-            n = int(np.prod(p.shape))
-            n_params += n
-            rows.append(f"  {name:40s} {str(p.shape):20s} {n}")
-        text = "\n".join(
-            ["-" * 75] + rows + ["-" * 75,
-                                 f"Total params: {n_params}"])
-        print(text)
-        return {"total_params": n_params}
+        """Delegates to hapi.summary (one implementation; reference:
+        Model.summary -> hapi/model_summary.py)."""
+        from .summary import summary as _summary
+        if input_size is None and self._inputs:
+            input_size = tuple(tuple(s.shape) for s in self._inputs) \
+                if len(self._inputs) > 1 else tuple(self._inputs[0].shape)
+        return _summary(self.network, input_size,
+                        dtypes=None if dtype is None else [dtype])

@@ -1,0 +1,122 @@
+"""paddle.summary / paddle.flops (reference: hapi/model_summary.py,
+hapi/dynamic_flops.py).
+
+flops() is TPU-native: instead of per-layer hook arithmetic, the model
+forward is lowered through XLA and the compiler's own cost model is
+read back — the number the hardware will actually run.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["summary", "flops"]
+
+
+def _example_inputs(input_size, dtypes=None):
+    import jax.numpy as jnp
+    if isinstance(input_size, tuple) and input_size and \
+            isinstance(input_size[0], (tuple, list)):
+        sizes = list(input_size)
+    else:
+        sizes = [input_size]
+    dtypes = dtypes or ["float32"] * len(sizes)
+    outs = []
+    for shape, dt in zip(sizes, dtypes):
+        shape = [1 if (d is None or d == -1) else int(d) for d in shape]
+        outs.append(Tensor(jnp.zeros(shape, np.dtype(dt))))
+    return outs
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Layer-wise summary table (reference: hapi/model_summary.py
+    summary). Returns {'total_params', 'trainable_params'}."""
+    rows = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(lyr, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (list, tuple)) \
+                else outputs
+            shape = list(out.shape) if hasattr(out, "shape") else "?"
+            n = sum(int(np.prod(p.shape)) for p in lyr.parameters(
+                include_sublayers=False)) if hasattr(
+                    lyr, "parameters") else 0
+            rows.append((name, type(lyr).__name__, shape, n))
+        return hook
+
+    for name, layer in net.named_sublayers():
+        try:
+            hooks.append(layer.register_forward_post_hook(
+                make_hook(name, layer)))
+        except Exception:
+            pass
+    try:
+        if input is not None:
+            net(*(input if isinstance(input, (list, tuple))
+                  else [input]))
+        elif input_size is not None:
+            net(*_example_inputs(input_size, dtypes))
+    finally:
+        for h in hooks:
+            try:
+                h.remove()
+            except Exception:
+                pass
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+    lines = [f"{'Layer (type)':<38}{'Output Shape':<24}{'Param #':>12}",
+             "=" * 74]
+    for name, typ, shape, n in rows:
+        lines.append(f"{name + ' (' + typ + ')':<38}"
+                     f"{str(shape):<24}{n:>12,}")
+    lines += ["=" * 74,
+              f"Total params: {total:,}",
+              f"Trainable params: {trainable:,}",
+              f"Non-trainable params: {total - trainable:,}"]
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size=None, inputs=None, custom_ops=None,
+          print_detail=False):
+    """Forward FLOPs from XLA's cost model (reference:
+    hapi/dynamic_flops.py flops — hook-based estimates there; the
+    compiler's own count here)."""
+    import jax
+    ins = (inputs if inputs is not None
+           else _example_inputs(input_size))
+    ins = ins if isinstance(ins, (list, tuple)) else [ins]
+    params = [p for p in net.parameters()]
+    vals = [p._value for p in params]
+
+    def pure(pvals, *xs):
+        originals = [p._value for p in params]
+        try:
+            for p, v in zip(params, pvals):
+                p._value = v
+            out = net(*[Tensor(x) for x in xs])
+            out = out[0] if isinstance(out, (list, tuple)) else out
+            return out._value
+        finally:
+            for p, v in zip(params, originals):
+                p._value = v
+
+    was_training = getattr(net, "training", False)
+    net.eval()
+    try:
+        compiled = jax.jit(pure).lower(
+            vals, *[t._value for t in ins]).compile()
+    finally:
+        if was_training:
+            net.train()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, list) else (ca or {})
+    total = int(ca.get("flops", 0))
+    if print_detail:
+        print(f"FLOPs (XLA cost model, forward): {total:,}")
+        print(f"bytes accessed: {int(ca.get('bytes accessed', 0)):,}")
+    return total

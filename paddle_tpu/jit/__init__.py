@@ -1,6 +1,6 @@
 """paddle.jit parity (reference: python/paddle/jit/__init__.py)."""
 from .api import (  # noqa: F401
     to_static, not_to_static, InputSpec, StaticFunction,
-    in_to_static_trace, ignore_module)
+    in_to_static_trace, ignore_module, enable_to_static)
 from .save_load import save, load, TranslatedLayer  # noqa: F401
 from .trainer import compile_train_step, CompiledTrainStep  # noqa: F401

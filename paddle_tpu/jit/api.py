@@ -38,7 +38,16 @@ from ..core.dispatch import OpDef
 from ..core.tensor import Tensor, Parameter, apply_op
 
 __all__ = ["to_static", "not_to_static", "InputSpec", "StaticFunction",
-           "in_to_static_trace", "ignore_module"]
+           "in_to_static_trace", "ignore_module", "enable_to_static"]
+
+_TO_STATIC_ENABLED = {"on": True}
+
+
+def enable_to_static(enable=True):
+    """paddle.jit.enable_to_static parity: globally disable to_static
+    (decorated functions run their original eager Python — the standard
+    debugging switch)."""
+    _TO_STATIC_ENABLED["on"] = bool(enable)
 
 
 class _TraceState(threading.local):
@@ -198,6 +207,8 @@ class StaticFunction:
         return pure
 
     def __call__(self, *args, **kwargs):
+        if not _TO_STATIC_ENABLED["on"]:
+            return self._fn(*args, **kwargs)  # debugging switch
         params, buffers = self._collect_state()
         arg_tensors: list[Tensor] = []
         arg_spec = _flatten(list(args), arg_tensors)

@@ -42,8 +42,13 @@ class CompiledTrainStep:
         self.state_tensors = self.params + self.buffers
         self.n_params = len(self.params)
         self.states = [dict(optimizer._state_for(p)) for p in self.params]
-        self.gstate = {k: jnp.asarray(v) for k, v in
-                       optimizer._global_state_spec().items()}
+        # live global state (beta-pow counters etc.) when the optimizer
+        # already has one — a rebuild mid-training (or after a
+        # checkpoint load) must not reset bias correction to step 0
+        live_g = getattr(optimizer, "_gstate", None)
+        self.gstate = (dict(live_g) if live_g else
+                       {k: jnp.asarray(v) for k, v in
+                        optimizer._global_state_spec().items()})
         clip = optimizer._grad_clip
         self._clip_norm = getattr(clip, "clip_norm", None) \
             if clip is not None else None

@@ -7,3 +7,43 @@ def try_import(name):
         return importlib.import_module(name)
     except ImportError:
         return None
+
+
+from . import unique_name  # noqa: E402,F401
+from . import cpp_extension  # noqa: E402,F401
+
+
+def deprecated(update_to="", since="", reason="", level=1):
+    """reference: python/paddle/utils/deprecated.py — warns once per
+    call site and forwards."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f"; use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            with warnings.catch_warnings():
+                # default filters hide DeprecationWarning outside
+                # __main__; the reference forces visibility
+                warnings.simplefilter("always", DeprecationWarning)
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def download(url, path=None, md5sum=None):
+    raise RuntimeError(
+        "paddle_tpu.utils.download: this environment has no network "
+        "egress; place files locally and load them directly.")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    download(url)

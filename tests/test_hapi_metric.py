@@ -272,3 +272,73 @@ class TestModelFit:
         m.train_batch([x], [y], update=False)   # eager, grads pending
         m.train_batch([x], [y])                 # must NOT drop them
         assert m._compiled_step is None          # stayed eager
+
+
+class TestSummaryFlops:
+    def test_summary_counts(self, capsys):
+        net = _mlp()
+        res = paddle.summary(net, (1, 16))
+        want = 16 * 32 + 32 + 32 * 2 + 2
+        assert res["total_params"] == want
+        assert res["trainable_params"] == want
+        out = capsys.readouterr().out
+        assert "Total params" in out and "Linear" in out
+
+    def test_flops_from_xla(self):
+        net = _mlp()
+        n = paddle.flops(net, (1, 16))
+        # at least the two matmuls: 2*1*16*32 + 2*1*32*2
+        assert n >= 2 * 16 * 32
+
+    def test_misc_apis(self):
+        assert paddle.iinfo("int32").max == 2**31 - 1
+        assert paddle.finfo("float32").eps > 0
+        r = paddle.batch(lambda: iter(range(5)), 2)
+        assert list(r()) == [[0, 1], [2, 3], [4]]
+        with paddle.LazyGuard():
+            lin = nn.Linear(4, 4)
+        assert lin.weight.shape == [4, 4]
+
+    def test_enable_to_static_switch(self):
+        from paddle_tpu import jit
+        calls = {"n": 0}
+
+        @jit.to_static
+        def f(x):
+            calls["n"] += 1
+            return x * 2.0
+
+        x = paddle.to_tensor(np.float32(3.0))
+        jit.enable_to_static(False)
+        try:
+            assert float(f(x)) == 6.0
+        finally:
+            jit.enable_to_static(True)
+        assert float(f(x)) == 6.0
+
+    def test_unique_name_guard(self):
+        from paddle_tpu.utils import unique_name
+        a = unique_name.generate("w")
+        with unique_name.guard("scope_"):
+            b = unique_name.generate("w")
+            assert b.startswith("scope_")
+        c = unique_name.generate("w")
+        assert a != c and not c.startswith("scope_")
+
+    def test_compiled_rebuild_preserves_adam_gstate(self, tmp_path):
+        # checkpoint resume must not reset beta-pow bias correction
+        paddle.seed(0)
+        m = paddle.Model(_mlp())
+        m.prepare(optimizer=opt.Adam(learning_rate=1e-3,
+                                     parameters=m.parameters()),
+                  loss=nn.CrossEntropyLoss())
+        m.fit(BlobDataset(64), epochs=1, batch_size=16, verbose=0)
+        b1 = float(np.asarray(m._optimizer._gstate["beta1_pow"]))
+        assert b1 < 0.9  # several steps happened
+        path = str(tmp_path / "resume" / "m")
+        m.save(path)
+        m.load(path)
+        m.fit(BlobDataset(64), epochs=1, batch_size=16, verbose=0)
+        b2 = float(np.asarray(m._optimizer._gstate["beta1_pow"]))
+        # continued decaying from b1, not reset to 0.9^k
+        assert b2 < b1
