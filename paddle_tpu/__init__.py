@@ -59,6 +59,9 @@ def set_matmul_precision(level: str):
 
 
 from .version import __version__  # noqa: E402
+# seed FLAGS_* from the environment at import (and wire env-activated
+# debug hooks like FLAGS_check_nan_inf)
+from .utils import flags as _flags_boot  # noqa: E402
 
 from .core.dtype import (  # noqa: E402,F401
     dtype, float16, bfloat16, float32, float64, int8, int16, int32, int64,

@@ -66,6 +66,10 @@ _NULL_SPAN = contextlib.nullcontext()
 # to an already-recorded node)
 _static_hook = None
 
+# set by utils.flags when FLAGS_check_nan_inf is on: scans each eager
+# op's float outputs and raises on the first non-finite value
+_nan_check_hook = None
+
 
 def is_grad_enabled():
     return _tape.grad_enabled
@@ -167,11 +171,20 @@ class GradNode:
                          self.diff_out, self.single)
             return list(fn(self.saved_inputs, full_cts))
 
+        def run_checked():
+            grads = run()
+            if _nan_check_hook is not None:
+                # backward scan too: nan losses usually appear in grads
+                # first (reference: eager/nan_inf_utils.cc grad checks)
+                _nan_check_hook(f"{self.op.name}_grad",
+                                [g for g in grads if g is not None])
+            return grads
+
         hook = _profile_hook  # read once: a concurrent Profiler.stop()
         if hook is None:      # may null the global mid-dispatch
-            return run()
+            return run_checked()
         with hook(f"{self.op.name}_grad") or _NULL_SPAN:
-            return run()
+            return run_checked()
 
     def apply_taped(self, cts):
         """Like apply(), but the backward computation itself runs through
@@ -559,6 +572,9 @@ def apply_op(op_name: str, *tensors, attrs: Optional[dict] = None,
 
     if _static_hook is not None and not traced:
         _static_hook(op, attrs, tensors, out_tensors, single)
+
+    if _nan_check_hook is not None and not traced:
+        _nan_check_hook(op.name, outs)
 
     return out_tensors[0] if single else out_tensors
 

@@ -371,5 +371,9 @@ class Model:
         if input_size is None and self._inputs:
             input_size = tuple(tuple(s.shape) for s in self._inputs) \
                 if len(self._inputs) > 1 else tuple(self._inputs[0].shape)
+        n_inputs = (len(input_size) if isinstance(input_size, tuple)
+                    and input_size and isinstance(input_size[0],
+                                                  (tuple, list)) else 1)
         return _summary(self.network, input_size,
-                        dtypes=None if dtype is None else [dtype])
+                        dtypes=None if dtype is None
+                        else [dtype] * n_inputs)

@@ -26,6 +26,16 @@ def _bootstrap():
     for k, v in os.environ.items():
         if k.startswith("FLAGS_"):
             _FLAGS[k] = _parse(v)
+    if _FLAGS.get("FLAGS_check_nan_inf"):
+        # env-var activation (FLAGS_check_nan_inf=1 python train.py)
+        # must wire the hook exactly like set_flags does
+        _wire_nan_check()
+
+
+def _wire_nan_check():
+    from ..core import tensor as tensor_mod
+    tensor_mod._nan_check_hook = (
+        _check_nan_inf if _FLAGS.get("FLAGS_check_nan_inf") else None)
 
 
 def _parse(v: str):
@@ -54,6 +64,29 @@ def get_flags(flags):
 def set_flags(flags: dict):
     for k, v in flags.items():
         _FLAGS[k] = v
+    if "FLAGS_check_nan_inf" in flags:
+        # wire the debug scanner into the op dispatch (reference:
+        # framework/details/nan_inf_utils_detail.* hooked at
+        # operator.cc:1601 and eager/nan_inf_utils.cc)
+        _wire_nan_check()
+
+
+def _check_nan_inf(op_name, outs):
+    """Raise on the FIRST op producing a non-finite value — the
+    reference's per-op output scan, eager only (a device sync per op:
+    strictly a debugging mode)."""
+    import numpy as np
+    import jax.numpy as jnp
+    for i, o in enumerate(outs):
+        if not jnp.issubdtype(o.dtype, jnp.floating):
+            continue
+        if not bool(jnp.isfinite(o).all()):
+            arr = np.asarray(o)
+            raise FloatingPointError(
+                f"Operator {op_name} output {i} contains "
+                f"{int(np.isnan(arr).sum())} nan / "
+                f"{int(np.isinf(arr).sum())} inf values "
+                f"(shape {list(arr.shape)}); FLAGS_check_nan_inf is on")
 
 
 def get_flag(name, default=None):

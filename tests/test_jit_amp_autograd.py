@@ -325,3 +325,52 @@ class TestRound1ReviewFixes:
         assert lin.weight.grad is not None
         assert np.all(np.isfinite(
             lin.weight.grad.numpy().astype(np.float32)))
+
+
+class TestNanInfFlag:
+    def test_check_nan_inf_raises_with_op_name(self):
+        import paddle_tpu as paddle
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = paddle.to_tensor(np.array([1.0, 0.0], "float32"))
+            with pytest.raises(FloatingPointError, match="divide"):
+                y = x / paddle.to_tensor(np.array([1.0, 0.0], "float32"))
+            # log of a negative -> nan
+            with pytest.raises(FloatingPointError, match="nan"):
+                paddle.log(paddle.to_tensor(np.array([-1.0], "float32")))
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+        # off again: non-finite values pass through silently (0/0 = nan)
+        y = x / paddle.to_tensor(np.array([1.0, 0.0], "float32"))
+        assert np.isnan(y.numpy()[1])
+
+    def test_check_nan_inf_covers_backward(self):
+        import paddle_tpu as paddle
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            # forward finite (sqrt(0) = 0) but d/dx sqrt at 0 = inf
+            x = paddle.to_tensor(np.array([0.0, 4.0], "float32"),
+                                 stop_gradient=False)
+            y = paddle.sqrt(x)
+            with pytest.raises(FloatingPointError, match="_grad"):
+                y.sum().backward()
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_env_var_wires_hook(self):
+        import subprocess, sys
+        code = (
+            "import numpy as np\n"
+            "import paddle_tpu as paddle\n"
+            "x = paddle.to_tensor(np.array([1.0], 'float32'))\n"
+            "try:\n"
+            "    y = x / paddle.to_tensor(np.array([0.0], 'float32'))\n"
+            "    print('NO RAISE')\n"
+            "except FloatingPointError:\n"
+            "    print('RAISED')\n")
+        r = subprocess.run([sys.executable, "-c", code],
+                           env={**__import__('os').environ,
+                                "FLAGS_check_nan_inf": "1",
+                                "PADDLE_TPU_FORCE_CPU_DEVICES": "1"},
+                           capture_output=True, text=True, timeout=240)
+        assert "RAISED" in r.stdout, (r.stdout, r.stderr[-500:])
