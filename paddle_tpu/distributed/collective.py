@@ -4,19 +4,34 @@ TPU-native replacement for paddle.distributed collectives (reference:
 python/paddle/distributed/collective.py, communication/*, C++
 ProcessGroupNCCL at distributed/collective/ProcessGroupNCCL.cc:169).
 
-Execution model: ONE controller process drives the whole mesh (GSPMD).
-There are no per-rank processes holding divergent tensors, so the eager
-collectives here implement the "all ranks hold this tensor" semantics —
-the exact behavior of the reference when every rank calls the collective
-with equal values (which is what its own unit tests assert,
-unittests/collective/collective_allreduce_api.py). Genuinely divergent
-per-device data lives in SHARDED arrays, where collectives are expressed
-in-program: use `paddle_tpu.distributed.shard_ops` (psum/all_gather/
-all_to_all/ppermute over named mesh axes) inside shard_map/jit — those
-lower to XLA collectives on ICI, replacing the c_* op zoo
-(operators/collective/, 160 files).
+Two regimes:
+
+* **Multi-process** (launcher jobs, ``get_world_size() > 1``): each rank
+  is a real OS process holding its own — possibly divergent — tensors.
+  Eager collectives here are REAL: values move between processes over
+  the JAX coordinator's key-value store (the same gRPC service that
+  rendezvouses ``jax.distributed.initialize``), with true ranks, true
+  point-to-point send/recv, and numpy-exact reduction semantics. This is
+  the eager path reference users hit when they all-reduce a per-rank
+  loss or metric (ProcessGroup.h:52). It is a host-side transport — the
+  right tool for control-plane values; bulk gradient traffic belongs in
+  the compiled program (below).
+
+* **Single controller** (the common TPU case): one process drives the
+  whole mesh via GSPMD; there are no per-rank processes holding
+  divergent tensors, so eager collectives implement the "all ranks hold
+  this tensor" semantics — the exact behavior of the reference when
+  every rank calls with equal values (what its own unit tests assert,
+  unittests/collective/collective_allreduce_api.py). Genuinely divergent
+  per-device data lives in SHARDED arrays, where collectives are
+  expressed in-program: use `paddle_tpu.distributed.shard_ops`
+  (psum/all_gather/all_to_all/ppermute over named mesh axes) inside
+  shard_map/jit — those lower to XLA collectives on ICI, replacing the
+  c_* op zoo (operators/collective/, 160 files).
 """
 from __future__ import annotations
+
+import pickle
 
 import numpy as np
 import jax
@@ -42,40 +57,169 @@ class ReduceOp:
     AVG = 4
 
 
+_REDUCERS = {
+    ReduceOp.SUM: lambda vs: sum(vs[1:], vs[0]),
+    ReduceOp.MAX: lambda vs: np.maximum.reduce(vs),
+    ReduceOp.MIN: lambda vs: np.minimum.reduce(vs),
+    ReduceOp.PROD: lambda vs: np.multiply.reduce(vs),
+    ReduceOp.AVG: lambda vs: sum(vs[1:], vs[0]) / len(vs),
+}
+
 _groups: dict = {}
 _group_counter = [0]
 _initialized = [False]
 
+_STORE_TIMEOUT_MS = 120_000
+
+
+def _multi_process():
+    return get_world_size() > 1
+
+
+def _store_client():
+    """The coordinator KV-store client — the rendezvous service started
+    by jax.distributed.initialize (init_parallel_env bootstraps it)."""
+    from jax._src.distributed import global_state
+    client = global_state.client
+    if client is None:
+        raise RuntimeError(
+            "multi-process collectives need the coordinator: call "
+            "paddle.distributed.init_parallel_env() first (the launcher "
+            "sets PADDLE_MASTER; package init then rendezvouses)")
+    return client
+
+
+def _to_numpy(tensor):
+    val = tensor._value if isinstance(tensor, Tensor) else tensor
+    if isinstance(val, jax.Array) and not val.is_fully_addressable:
+        raise ValueError(
+            "eager collectives act on process-local tensors; this array "
+            "is a global sharded array — use distributed.shard_ops "
+            "inside the compiled program instead")
+    return np.asarray(jax.device_get(val))
+
+
+def _rebind(tensor, value):
+    tensor._rebind(jnp.asarray(value))
+    return tensor
+
+
+_epoch = [0]
+
+
+class _Exchange:
+    """One round of SYMMETRIC value exchange over the KV store.
+
+    Keys are ``ptc/{epoch}/{gid}/{seq}/{rank}``; ``seq`` increments per
+    group so rounds never collide, and ``epoch`` bumps on
+    destroy_process_group so a re-init never reads a stale key. Every
+    round is symmetric — each member writes exactly one key and blocks
+    until it has read every member's key — which makes the cleanup
+    invariant sound: a rank starting round ``seq`` has completed round
+    ``seq-1``, which required every member to have written its
+    ``seq-1`` key, which (rounds being ordered per rank) required every
+    member to have finished reading all of round ``seq-2``. So each
+    rank deletes its own ``seq-2`` key at the start of each round,
+    bounding coordinator memory for long jobs."""
+
+    def __init__(self, group):
+        self.client = _store_client()
+        self.group = group
+        self.seq = group._seq
+        group._seq += 1
+
+    def _key(self, rank, seq=None):
+        return (f"ptc/{_epoch[0]}/{self.group.id}/"
+                f"{self.seq if seq is None else seq}/{rank}")
+
+    def cleanup(self):
+        if self.seq >= 2:
+            try:
+                self.client.key_value_delete(
+                    self._key(self.group.rank, self.seq - 2))
+            except Exception:
+                pass
+
+    def gather_all(self, value):
+        """Everyone contributes; returns [rank0_value, ..., rankN-1].
+        The own-rank slot is filled locally (no read-back round-trip)."""
+        self.cleanup()
+        me = self.group.rank
+        self.client.key_value_set_bytes(self._key(me), pickle.dumps(value))
+        return [value if r == me else
+                pickle.loads(self.client.blocking_key_value_get_bytes(
+                    self._key(r), _STORE_TIMEOUT_MS))
+                for r in range(self.group.nranks)]
+
+    def from_rank(self, value, src):
+        """Symmetric round where only ``src``'s contribution matters:
+        non-src members contribute None (every member still writes and
+        reads every key, keeping the cleanup invariant) and the src
+        payload never transits for the src itself."""
+        if not 0 <= src < self.group.nranks:
+            raise ValueError(
+                f"src/dst rank is not a member of {self.group!r}")
+        return self.gather_all(
+            value if self.group.rank == src else None)[src]
+
 
 class Group:
-    """A communication group. Binds to a mesh axis when axis_name given;
-    otherwise a trivial (world) group."""
+    """A communication group. In multi-process jobs it spans real ranks
+    (``ranks`` defaults to the world). In the single-controller regime
+    it binds to a mesh axis when axis_name given; otherwise world."""
 
     def __init__(self, gid=0, axis_name=None, mesh=None, ranks=None):
         self.id = gid
         self.axis_name = axis_name
         self.mesh = mesh
         self._ranks = ranks
+        self._seq = 0
+        self._barrier_seq = 0
+        self._p2p_seq = {}
 
     @property
     def nranks(self):
-        if self.axis_name is not None and self.mesh is not None:
-            return self.mesh.get_dim_size(self.axis_name)
         if self._ranks:
             return len(self._ranks)
+        if self.axis_name is not None and self.mesh is not None:
+            # axis-bound groups size to the mesh axis in EVERY regime —
+            # their collectives are mesh-semantics, not process-spanning
+            return self.mesh.get_dim_size(self.axis_name)
+        if _multi_process():
+            return get_world_size()
         return 1
+
+    @property
+    def spans_processes(self):
+        """True when this group's eager collectives move data between OS
+        processes (the KV-store path). Axis-bound groups never do: they
+        describe device-mesh axes inside the GSPMD program."""
+        return _multi_process() and self.axis_name is None
 
     world_size = nranks
 
     @property
     def rank(self):
-        return 0
+        """True rank of this process within the group (-1 if not a
+        member) — reference Group.rank semantics, not a constant."""
+        world_rank = ParallelEnv().rank
+        if self._ranks:
+            try:
+                return self._ranks.index(world_rank)
+            except ValueError:
+                return -1
+        return world_rank
 
     @property
     def ranks(self):
         return self._ranks or list(range(self.nranks))
 
     def get_group_rank(self, rank):
+        if self._ranks:
+            try:
+                return self._ranks.index(rank)
+            except ValueError:
+                return -1
         return rank
 
     @property
@@ -84,7 +228,7 @@ class Group:
 
     def __repr__(self):
         return (f"Group(id={self.id}, axis={self.axis_name}, "
-                f"nranks={self.nranks})")
+                f"nranks={self.nranks}, rank={self.rank})")
 
 
 def _default_group():
@@ -93,8 +237,8 @@ def _default_group():
     return _groups[0]
 
 
-def _nranks(group):
-    return (group or _default_group()).nranks
+def _group(group):
+    return group if group is not None else _default_group()
 
 
 def is_initialized():
@@ -127,15 +271,24 @@ def destroy_process_group(group=None):
     if group is None:
         _groups.clear()
         _initialized[0] = False
+        _epoch[0] += 1  # re-init must never read this epoch's keys
     else:
         _groups.pop(group.id, None)
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
-    """In-place; "every rank holds `tensor`" semantics (see module doc)."""
-    n = _nranks(group)
+    """In-place. Multi-process: true divergent-value reduction across
+    ranks. Single controller: "every rank holds `tensor`" semantics
+    (see module doc)."""
+    g = _group(group)
+    n = g.nranks
     if n == 1:
         return tensor
+    if g.spans_processes:
+        if g.rank < 0:  # not a member: reference no-op semantics
+            return tensor
+        vals = _Exchange(g).gather_all(_to_numpy(tensor))
+        return _rebind(tensor, _REDUCERS[op](vals))
     if op == ReduceOp.SUM:
         tensor._rebind(tensor._value * n)
     elif op == ReduceOp.PROD:
@@ -145,42 +298,104 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
-    n = _nranks(group)
-    for _ in range(n):
+    g = _group(group)
+    if g.spans_processes and g.nranks > 1:
+        if g.rank < 0:
+            return tensor_list
+        vals = _Exchange(g).gather_all(_to_numpy(tensor))
+        tensor_list.extend(Tensor(jnp.asarray(v)) for v in vals)
+        return tensor_list
+    for _ in range(g.nranks):
         tensor_list.append(Tensor(tensor._value))
     return tensor_list
 
 
 def all_gather_object(object_list, obj, group=None):
-    n = _nranks(group)
-    for _ in range(n):
+    g = _group(group)
+    if g.spans_processes and g.nranks > 1:
+        if g.rank < 0:
+            return object_list
+        object_list.extend(_Exchange(g).gather_all(obj))
+        return object_list
+    for _ in range(g.nranks):
         object_list.append(obj)
     return object_list
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Result lands on global rank ``dst`` (converted to its group
+    rank, reference semantics); other ranks' tensors are left unchanged
+    (reference leaves them unspecified)."""
+    g = _group(group)
+    if g.spans_processes and g.nranks > 1:
+        if g.rank < 0:
+            return tensor
+        gdst = g.get_group_rank(dst)
+        if gdst < 0:
+            raise ValueError(f"dst rank {dst} is not a member of {g!r}")
+        vals = _Exchange(g).gather_all(_to_numpy(tensor))
+        if g.rank == gdst:
+            _rebind(tensor, _REDUCERS[op](vals))
+        return tensor
     return all_reduce(tensor, op=op, group=group)
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
+    g = _group(group)
+    if g.spans_processes and g.nranks > 1:
+        if g.rank < 0:
+            return tensor
+        val = _Exchange(g).from_rank(_to_numpy(tensor),
+                                     g.get_group_rank(src))
+        _rebind(tensor, val)
     return tensor
 
 
 def broadcast_object_list(object_list, src=0, group=None):
+    g = _group(group)
+    if g.spans_processes and g.nranks > 1:
+        if g.rank < 0:
+            return object_list
+        got = _Exchange(g).from_rank(list(object_list),
+                                     g.get_group_rank(src))
+        object_list[:] = got
     return object_list
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = _group(group)
+    if g.spans_processes and g.nranks > 1:
+        if g.rank < 0:
+            return tensor
+        gsrc = g.get_group_rank(src)
+        if g.rank == gsrc and not tensor_list:
+            raise ValueError("scatter src must pass tensor_list")
+        mine = [_to_numpy(t) for t in tensor_list] if tensor_list else None
+        parts = _Exchange(g).from_rank(mine, gsrc)
+        _rebind(tensor, parts[g.rank])
+        return tensor
     if tensor_list:
-        tensor._rebind(tensor_list[0]._value)
+        tensor._rebind(tensor_list[g.rank if g.rank >= 0 else 0]._value)
     return tensor
 
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None,
              sync_op=True):
-    """Equal-rank semantics: rank 0 receives every rank's chunk 0."""
-    outs = [Tensor(in_tensor_list[0]._value)
-            for _ in range(len(in_tensor_list))]
+    """Rank r's output j = rank j's input r."""
+    g = _group(group)
+    if g.spans_processes and g.nranks > 1:
+        if g.rank < 0:
+            return out_tensor_list if out_tensor_list is not None else []
+        all_lists = _Exchange(g).gather_all(
+            [_to_numpy(t) for t in in_tensor_list])
+        outs = [Tensor(jnp.asarray(all_lists[j][g.rank]))
+                for j in range(g.nranks)]
+    else:
+        # equal-value premise: every rank holds this same in_tensor_list,
+        # so rank r receives in_tensor_list[r] from each of the n peers
+        r = max(g.rank, 0)
+        outs = [Tensor(in_tensor_list[r]._value)
+                for _ in range(len(in_tensor_list))]
     if out_tensor_list is None:
         return outs
     if len(out_tensor_list) == 0:
@@ -193,13 +408,29 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None,
 
 def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
-    n = _nranks(group)
-    if n == 1:
+    g = _group(group)
+    n = g.nranks
+    if g.spans_processes and n > 1:
+        if g.rank < 0:
+            return out_tensor if out_tensor is not None else in_tensor
+        mine = _to_numpy(in_tensor)
+        if in_split_sizes:
+            bounds = np.cumsum(in_split_sizes)[:-1]
+            chunks = np.split(mine, bounds, axis=0)
+        else:
+            chunks = np.split(mine, n, axis=0)
+        all_chunks = _Exchange(g).gather_all(
+            [np.ascontiguousarray(c) for c in chunks])
+        val = jnp.asarray(np.concatenate(
+            [all_chunks[j][g.rank] for j in range(n)], axis=0))
+    elif n == 1:
         val = in_tensor._value
     else:
-        first = in_tensor._value.shape[0] // n
-        chunk0 = in_tensor._value[:first]
-        val = jnp.concatenate([chunk0] * n, axis=0)
+        # equal-value premise: output = own chunk r repeated from n peers
+        r = max(g.rank, 0)
+        sz = in_tensor._value.shape[0] // n
+        chunk = in_tensor._value[r * sz:(r + 1) * sz]
+        val = jnp.concatenate([chunk] * n, axis=0)
     if out_tensor is not None:
         out_tensor._rebind(val)
         return out_tensor
@@ -208,18 +439,62 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
 
 def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
                    sync_op=True):
-    n = _nranks(group)
+    """Rank r's result = reduce over ranks j of rank j's chunk r."""
+    g = _group(group)
+    n = g.nranks
+    if g.spans_processes and n > 1:
+        if g.rank < 0:
+            return tensor
+        if tensor_list is not None:
+            chunks = [_to_numpy(t) for t in tensor_list]
+        else:
+            chunks = np.split(_to_numpy(tensor), n, axis=0)
+        all_chunks = _Exchange(g).gather_all(
+            [np.ascontiguousarray(c) for c in chunks])
+        r = g.rank
+        return _rebind(tensor,
+                       _REDUCERS[op]([all_chunks[j][r] for j in range(n)]))
+    r = max(g.rank, 0)
     if tensor_list:
-        src = tensor_list[0]._value
+        src = tensor_list[r]._value
     else:
-        src = tensor._value[:tensor._value.shape[0] // max(n, 1)]
-    if op == ReduceOp.SUM and n > 1:
-        src = src * n
+        sz = tensor._value.shape[0] // max(n, 1)
+        src = tensor._value[r * sz:(r + 1) * sz]
+    # equal-value premise: n peers each contribute this same chunk
+    if n > 1:
+        if op in (ReduceOp.SUM,):
+            src = src * n
+        elif op == ReduceOp.PROD:
+            src = src ** n
+        # MAX/MIN/AVG over equal values are identity
     tensor._rebind(src)
     return tensor
 
 
+def _p2p_key(group, src, dst):
+    """src/dst are GROUP ranks; sender and receiver each advance the
+    same per-(src,dst) counter, so matched send/recv pairs agree."""
+    seq = group._p2p_seq.get((src, dst), 0)
+    group._p2p_seq[(src, dst)] = seq + 1
+    return f"ptp/{_epoch[0]}/{group.id}/{src}-{dst}/{seq}"
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
+    """True point-to-point send in multi-process jobs (matched by a
+    recv with src=this rank; dst is a global rank, reference
+    semantics). Single-controller: no peer process exists — use
+    distributed.shard_ops.ppermute inside a compiled program for
+    on-mesh p2p (the replacement for partial_send/recv, reference:
+    operators/collective/partial_send_op.cc)."""
+    g = _group(group)
+    if g.spans_processes:
+        gdst = g.get_group_rank(dst)
+        if gdst < 0:
+            raise ValueError(f"dst rank {dst} is not a member of {g!r}")
+        client = _store_client()
+        client.key_value_set_bytes(_p2p_key(g, g.rank, gdst),
+                                   pickle.dumps(_to_numpy(tensor)))
+        return tensor
     raise NotImplementedError(
         "cross-rank p2p does not exist in the single-controller GSPMD "
         "regime; use distributed.shard_ops.ppermute inside a compiled "
@@ -228,15 +503,31 @@ def send(tensor, dst=0, group=None, sync_op=True):
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
+    g = _group(group)
+    if g.spans_processes:
+        gsrc = g.get_group_rank(src)
+        if gsrc < 0:
+            raise ValueError(f"src rank {src} is not a member of {g!r}")
+        client = _store_client()
+        key = _p2p_key(g, gsrc, g.rank)
+        val = pickle.loads(
+            client.blocking_key_value_get_bytes(key, _STORE_TIMEOUT_MS))
+        try:  # single reader: safe to free the slot immediately
+            client.key_value_delete(key)
+        except Exception:
+            pass
+        return _rebind(tensor, val)
     return send(tensor, src, group, sync_op)
 
 
 def isend(tensor, dst=0, group=None):
-    return send(tensor, dst, group)
+    send(tensor, dst, group)
+    return _Done()
 
 
 def irecv(tensor, src=0, group=None):
-    return recv(tensor, src, group)
+    recv(tensor, src, group)
+    return _Done()
 
 
 class _Done:
@@ -248,6 +539,19 @@ class _Done:
 
 
 def barrier(group=None):
+    g = _group(group)
+    if g.spans_processes:
+        if g.rank < 0:
+            return _Done()
+        client = _store_client()
+        # own counter: barriers create no KV keys, and sharing _seq
+        # would break the exchange seq-2 cleanup invariant
+        seq = g._barrier_seq
+        g._barrier_seq += 1
+        client.wait_at_barrier(
+            f"ptb/{_epoch[0]}/{g.id}/{seq}", _STORE_TIMEOUT_MS,
+            g.ranks if g._ranks else None)
+        return _Done()
     jax.effects_barrier()
     return _Done()
 

@@ -51,6 +51,21 @@ class TestLauncher:
         np.testing.assert_allclose(got["b"], model.bias.numpy(),
                                    rtol=1e-5, atol=1e-6)
 
+    def test_eager_collectives_divergent_values(self, tmp_path):
+        """Every eager collective primitive with DIVERGENT per-rank
+        tensors must match numpy (VERDICT r2 item 1; reference
+        semantics: distributed/collective.py:174, ProcessGroup.h:52).
+        Assertions live in the payload; both ranks verify."""
+        out = str(tmp_path / "ok.npz")
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--log_dir", str(tmp_path),
+             "tests/launch_payload_collectives.py", out],
+            cwd=REPO, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, (proc.stdout[-3000:],
+                                      proc.stderr[-3000:])
+        assert np.load(out)["ok"] == 1
+
     def test_launcher_propagates_failure(self, tmp_path):
         bad = tmp_path / "bad.py"
         bad.write_text("import sys; sys.exit(3)\n")
