@@ -630,12 +630,22 @@ def run_backward(tensors: Sequence[Tensor], grad_tensors=None,
             collected[id(t)] = (collected[id(t)] + g) if id(t) in collected else g
         if accumulate_into_leaves and (as_leaf or t._retain_grad):
             gs = getattr(t, "_grad_spec", None)
-            if gs is not None and not is_t:
+            if gs is not None:
                 # ZeRO stage-2 contract (sharding.py): the leaf grad
                 # materializes SHARDED — each device keeps only its
                 # 1/n slice, the eager analogue of the reference's
-                # reduce-scatter (group_sharded_stage2.py:46)
-                g = gs(g)
+                # reduce-scatter (group_sharded_stage2.py:46). Under
+                # create_graph the grad arrives as a Tensor: reshard
+                # its value in place so the memory guarantee holds.
+                if is_t:
+                    # fresh Tensor (the caller may alias g); keep the
+                    # grad node so higher-order backward still works
+                    ng = Tensor(gs(g._value))
+                    ng.stop_gradient = g.stop_gradient
+                    ng._grad_node = g._grad_node
+                    g = ng
+                else:
+                    g = gs(g)
             if t.grad is None:
                 t.grad = g if is_t else Tensor(g)
             else:

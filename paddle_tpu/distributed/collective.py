@@ -190,6 +190,15 @@ class Group:
         return 1
 
     @property
+    def equal_value_rank(self):
+        """Rank used by the equal-value (single-controller semantics)
+        paths. Axis-bound groups in multi-process jobs have no
+        process<->axis-position mapping, so clamp to 0 — the historic
+        single-controller view — rather than indexing by world rank."""
+        r = self.rank
+        return r if 0 <= r < self.nranks else 0
+
+    @property
     def spans_processes(self):
         """True when this group's eager collectives move data between OS
         processes (the KV-store path). Axis-bound groups never do: they
@@ -274,6 +283,11 @@ def destroy_process_group(group=None):
         _epoch[0] += 1  # re-init must never read this epoch's keys
     else:
         _groups.pop(group.id, None)
+        if group.id == 0:
+            # the default group is recreated with seq 0 on next use;
+            # a fresh epoch keeps its keys from colliding with this
+            # incarnation's undeleted tail
+            _epoch[0] += 1
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -375,7 +389,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         _rebind(tensor, parts[g.rank])
         return tensor
     if tensor_list:
-        tensor._rebind(tensor_list[g.rank if g.rank >= 0 else 0]._value)
+        tensor._rebind(tensor_list[g.equal_value_rank]._value)
     return tensor
 
 
@@ -393,7 +407,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None,
     else:
         # equal-value premise: every rank holds this same in_tensor_list,
         # so rank r receives in_tensor_list[r] from each of the n peers
-        r = max(g.rank, 0)
+        r = g.equal_value_rank
         outs = [Tensor(in_tensor_list[r]._value)
                 for _ in range(len(in_tensor_list))]
     if out_tensor_list is None:
@@ -427,7 +441,7 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
         val = in_tensor._value
     else:
         # equal-value premise: output = own chunk r repeated from n peers
-        r = max(g.rank, 0)
+        r = g.equal_value_rank
         sz = in_tensor._value.shape[0] // n
         chunk = in_tensor._value[r * sz:(r + 1) * sz]
         val = jnp.concatenate([chunk] * n, axis=0)
@@ -454,7 +468,7 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
         r = g.rank
         return _rebind(tensor,
                        _REDUCERS[op]([all_chunks[j][r] for j in range(n)]))
-    r = max(g.rank, 0)
+    r = g.equal_value_rank
     if tensor_list:
         src = tensor_list[r]._value
     else:

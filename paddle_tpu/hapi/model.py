@@ -181,6 +181,7 @@ class Model:
             metrics=self._metrics_name())
         self.stop_training = False
         cbks.on_begin("train")
+        logs = {}  # epochs=0 still reaches cbks.on_end
         for epoch in range(epochs):
             if self.stop_training:
                 break

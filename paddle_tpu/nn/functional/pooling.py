@@ -194,7 +194,10 @@ def _mask2d_fwd(x, kh, kw, sh, sw, ph, pw, ceil_mode):
     def comp(a, b):
         av, ai = a
         bv, bi = b
-        take_b = bv > av  # first-max wins ties (argmax convention)
+        # order-independent comparator: XLA does not guarantee the
+        # reduce_window combine order, so break value ties on the lower
+        # flat index (the reference first-max convention)
+        take_b = (bv > av) | ((bv == av) & (bi < ai))
         return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
 
     _, arg = lax.reduce_window(

@@ -56,8 +56,32 @@ void vocab_free(vocab_t *v) {
     free(v);
 }
 
+static int vocab_grow(vocab_t *v) {
+    size_t newcap = v->cap << 1;
+    char **keys = (char **)calloc(newcap, sizeof(char *));
+    int32_t *vals = (int32_t *)calloc(newcap, sizeof(int32_t));
+    if (!keys || !vals) { free(keys); free(vals); return -1; }
+    size_t mask = newcap - 1;
+    for (size_t i = 0; i < v->cap; i++) {
+        if (!v->keys[i]) continue;
+        size_t j = hash_str(v->keys[i], strlen(v->keys[i])) & mask;
+        while (keys[j]) j = (j + 1) & mask;
+        keys[j] = v->keys[i];
+        vals[j] = v->vals[i];
+    }
+    free(v->keys);
+    free(v->vals);
+    v->keys = keys;
+    v->vals = vals;
+    v->cap = newcap;
+    return 0;
+}
+
 void vocab_put(vocab_t *v, const char *key, int32_t id) {
     if (!v) return;
+    /* keep load factor < 1/2 regardless of the caller's vocab_new hint:
+       the open-addressing probe loops must never meet a full table */
+    if (v->n >= v->cap / 2 && vocab_grow(v) != 0) return;
     size_t mask = v->cap - 1;
     size_t i = hash_str(key, strlen(key)) & mask;
     while (v->keys[i]) {

@@ -65,6 +65,9 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     descending score (host-side slice of the static keep mask)."""
     boxes = as_tensor(boxes)
     n = boxes.shape[0]
+    if n == 0:
+        from ..ops.creation import to_tensor
+        return to_tensor(np.zeros((0,), "int64"))
     if scores is None:
         scores = Tensor(jnp.arange(n, 0, -1).astype(jnp.float32))
     else:
@@ -73,7 +76,11 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         # per-category NMS: offset boxes per category so categories
         # never overlap (the standard batched-NMS trick)
         cat = as_tensor(category_idxs)
-        offset = (cat.astype("float32") * 1e4).unsqueeze(-1)
+        # derive the stride from the data (torchvision batched_nms
+        # trick): a fixed constant can still let large-coordinate boxes
+        # overlap across categories
+        span = Tensor(jnp.max(boxes._value) + 1.0)
+        offset = (cat.astype("float32") * span).unsqueeze(-1)
         shifted = boxes + offset
     else:
         shifted = boxes

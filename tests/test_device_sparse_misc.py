@@ -325,6 +325,23 @@ class TestFasterTokenizer:
         tok = FasterTokenizer(self.VOCAB)
         assert tok.encode("zzz") == [1]  # [UNK]
 
+    def test_vocab_grows_past_hint(self):
+        """vocab_put must keep load factor < 1/2 by growing the table —
+        inserting far more keys than the vocab_new hint must neither
+        spin nor lose entries (ADVICE r2 medium)."""
+        import ctypes
+        from paddle_tpu.text import _native
+        lib = _native._load()
+        v = lib.vocab_new(2)  # cap 16; insert 200 keys
+        try:
+            for i in range(200):
+                lib.vocab_put(v, f"tok{i}".encode(), i)
+            for i in range(200):
+                assert lib.vocab_get(v, f"tok{i}".encode()) == i
+            assert lib.vocab_get(v, b"absent") == -1
+        finally:
+            lib.vocab_free(v)
+
     def test_native_matches_python_fallback(self):
         from paddle_tpu.text import FasterTokenizer
         tok = FasterTokenizer(self.VOCAB)
