@@ -1,0 +1,96 @@
+"""Peak-memory comparison: AD-transposed GPipe vs manual 1F1B.
+
+Runs each schedule's full train step in a FRESH subprocess on the
+8-device virtual CPU mesh and records peak RSS (ru_maxrss). The 1F1B
+scan keeps only an S-slot activation ring per stage, while the
+transposed GPipe scan saves residuals for all M+S-1 ticks — at M >> S
+the difference dominates the process peak.
+
+Usage: python scripts/pp_memory_bench.py            # prints one JSON line
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+
+PAYLOAD = r"""
+import os, re, resource, sys
+os.environ["XLA_FLAGS"] = (re.sub(
+    r"--xla_force_host_platform_device_count=\d+", "",
+    os.environ.get("XLA_FLAGS", "")).strip()
+    + " --xla_force_host_platform_device_count=8").strip()
+os.environ["PADDLE_TPU_FORCE_CPU_DEVICES"] = "8"
+schedule = sys.argv[1]
+
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.meta_parallel import PipelineLayer
+
+S, M, D, B = 4, 16, 256, 32
+strategy = fleet.DistributedStrategy()
+strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                           "pp_degree": S, "sharding_degree": 1,
+                           "sep_degree": 1}
+fleet.init(is_collective=True, strategy=strategy)
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(D, 4 * D)
+        self.fc2 = nn.Linear(4 * D, D)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x))) + x
+
+
+paddle.seed(0)
+pp = PipelineLayer([nn.Linear(D, D)] + [Block() for _ in range(8)]
+                   + [nn.Linear(D, D)],
+                   num_stages=S, loss_fn=nn.MSELoss())
+x = paddle.to_tensor(np.random.RandomState(0)
+                     .randn(B, 64, D).astype("float32"))
+y = paddle.to_tensor(np.random.RandomState(1)
+                     .randn(B, 64, D).astype("float32"))
+
+for _ in range(2):  # compile + steady-state execute
+    if schedule == "1f1b":
+        loss = pp.train_step_1f1b(x, y, num_microbatches=M)
+    else:
+        out = pp(x, num_microbatches=M)
+        loss = F.mse_loss(out, y)
+        loss.backward()
+    for p in pp.parameters():
+        p.clear_gradient()
+lv = float(loss)
+peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+print(json.dumps({"schedule": schedule, "loss": lv,
+                  "peak_rss_mb": peak_kb / 1024.0}))
+""".replace("json.dumps", "__import__('json').dumps")
+
+
+def run(schedule):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", PAYLOAD, schedule],
+        capture_output=True, text=True, timeout=900, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if proc.returncode != 0:
+        raise RuntimeError(proc.stderr[-3000:])
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+if __name__ == "__main__":
+    gpipe = run("gpipe")
+    f1b = run("1f1b")
+    print(json.dumps({
+        "gpipe_peak_rss_mb": round(gpipe["peak_rss_mb"], 1),
+        "f1b_peak_rss_mb": round(f1b["peak_rss_mb"], 1),
+        "ratio": round(f1b["peak_rss_mb"] / gpipe["peak_rss_mb"], 3),
+        "gpipe_loss": gpipe["loss"], "f1b_loss": f1b["loss"],
+    }))
