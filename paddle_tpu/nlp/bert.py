@@ -92,8 +92,10 @@ class BertForSequenceClassification(nn.Layer):
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
         self.classifier = nn.Linear(config.hidden_size, num_classes)
 
-    def forward(self, input_ids, token_type_ids=None, labels=None):
-        _, pooled = self.bert(input_ids, token_type_ids)
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                              attention_mask)
         logits = self.classifier(self.dropout(pooled))
         if labels is not None:
             return F.cross_entropy(logits, labels)

@@ -57,23 +57,82 @@ def _sdpa_ref(q, k, v, mask, causal, scale, dropout_p, key):
     return jnp.einsum("bhlm,bmhd->blhd", probs, v)
 
 
-def _sdpa_fwd(q, k, v, causal, scale, dropout_p):
-    if _use_pallas(q.shape[1], q.shape[3]) and dropout_p == 0.0:
+def _mask_to_kernel_operands(mask, B, H, Lq, Lk):
+    """Map a paddle attn_mask onto the kernel's operands, or None if
+    unsupported. Returns (bias, kvec): bias [Bb, Hb, Lq, Lk] additive
+    f32 streamed block-wise, kvec [B, Lk] additive f32 — the O(L)
+    padding-mask fast path (the BERT finetune shape [B, 1, 1, Lk])."""
+    if mask.ndim != 4:
+        return None
+    mb, mh, ml, mk = mask.shape
+    if mb not in (1, B) or mh not in (1, H) or ml not in (1, Lq) \
+            or mk != Lk:
+        return None
+    if mask.dtype == jnp.bool_:
+        add = jnp.where(mask, jnp.float32(0.0), jnp.float32(-1e30))
+    else:
+        add = mask.astype(jnp.float32)
+    if ml == 1 and mh == 1:
+        kv = add.reshape(mb, mk)
+        if mb == 1 and B > 1:
+            kv = jnp.broadcast_to(kv, (B, mk))
+        return ("kvec", kv)
+    if ml != Lq:
+        # per-head key masks ([*, H, 1, Lk]): the bias operand streams
+        # blocks along Lq, and a singleton Lq would be zero-PADDED, not
+        # broadcast — route to the XLA reference instead
+        return None
+    return ("bias", add)
+
+
+def _sdpa_impl(q, k, v, mask, key, causal, scale, dropout_p,
+               mask_trainable=False):
+    """Unified route: Pallas flash kernel whenever the device/head-dim
+    support it — including padding masks, additive bias, and dropout
+    (in-kernel position-hash mask) — else the XLA reference. A
+    TRAINABLE mask needs real bias gradients, which the kernel does not
+    produce — that case stays on the reference path."""
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    if _use_pallas(Lq, D) and not (mask_trainable and mask is not None):
         from ...ops.pallas.flash_attention import flash_attention_blhd
-        return flash_attention_blhd(q, k, v, causal=causal, scale=scale)
-    return _sdpa_ref(q, k, v, None, causal, scale, dropout_p, None)
+        bias = kvec = None
+        ok = True
+        if mask is not None:
+            mapped = _mask_to_kernel_operands(mask, B, H, Lq, Lk)
+            if mapped is None:
+                ok = False
+            elif mapped[0] == "kvec":
+                kvec = mapped[1]
+            else:
+                bias = mapped[1]
+        if ok:
+            seeds = None
+            if dropout_p > 0.0 and key is not None:
+                seeds = jax.lax.bitcast_convert_type(
+                    key.reshape(-1)[:2], jnp.int32)
+            return flash_attention_blhd(
+                q, k, v, bias, kvec, seeds, causal=causal, scale=scale,
+                dropout_p=float(dropout_p) if seeds is not None else 0.0)
+    return _sdpa_ref(q, k, v, mask, causal, scale, dropout_p, key)
 
 
-register_op("sdpa", _sdpa_fwd)
+register_op("sdpa",
+            lambda q, k, v, causal, scale, dropout_p:
+            _sdpa_impl(q, k, v, None, None, causal, scale, dropout_p))
 register_op("sdpa_mask",
-            lambda q, k, v, mask, causal, scale, dropout_p:
-            _sdpa_ref(q, k, v, mask, causal, scale, dropout_p, None))
+            lambda q, k, v, mask, causal, scale, dropout_p,
+            mask_trainable=False:
+            _sdpa_impl(q, k, v, mask, None, causal, scale, dropout_p,
+                       mask_trainable))
 register_op("sdpa_dropout",
             lambda q, k, v, key, causal, scale, dropout_p:
-            _sdpa_ref(q, k, v, None, causal, scale, dropout_p, key))
+            _sdpa_impl(q, k, v, None, key, causal, scale, dropout_p))
 register_op("sdpa_mask_dropout",
-            lambda q, k, v, mask, key, causal, scale, dropout_p:
-            _sdpa_ref(q, k, v, mask, causal, scale, dropout_p, key))
+            lambda q, k, v, mask, key, causal, scale, dropout_p,
+            mask_trainable=False:
+            _sdpa_impl(q, k, v, mask, key, causal, scale, dropout_p,
+                       mask_trainable))
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
@@ -90,20 +149,43 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         rk = Tensor(random_mod.next_key())
         return apply_op("sdpa_dropout", q, k, v, rk, attrs=attrs)
     m = as_tensor(attn_mask)
+    attrs["mask_trainable"] = not m.stop_gradient
     if p == 0.0:
         return apply_op("sdpa_mask", q, k, v, m, attrs=attrs)
     rk = Tensor(random_mod.next_key())
     return apply_op("sdpa_mask_dropout", q, k, v, m, rk, attrs=attrs)
 
 
+def _softmax_probs(q, k, causal, scale):
+    logits = jnp.einsum("blhd,bmhd->bhlm", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    if causal:
+        L, M = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((L, M), dtype=bool), M - L)
+        logits = jnp.where(cm, logits, -1e30)
+    return jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+
+
+register_op("sdpa_probs",
+            lambda q, k, causal, scale:
+            _softmax_probs(q, k, causal, scale), nondiff=True)
+
+
 def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, fixed_seed_offset=None,
                     rng_name="", training=True, name=None):
-    """paddle.nn.functional.flash_attention parity; returns (out, None)."""
+    """paddle.nn.functional.flash_attention parity. return_softmax=True
+    materializes the [B, H, L, L] softmax via the reference path (the
+    kernel never forms it — that is the point of flash attention), so
+    use it for debugging only."""
     out = scaled_dot_product_attention(query, key, value, None, dropout,
                                        causal, training)
     if return_softmax:
-        return out, None
+        q, k = as_tensor(query), as_tensor(key)
+        scale = 1.0 / math.sqrt(q.shape[-1])
+        probs = apply_op("sdpa_probs", q, k,
+                         attrs=dict(causal=bool(causal), scale=scale))
+        return out, probs
     return out, None
 
 

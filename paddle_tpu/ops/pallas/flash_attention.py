@@ -1,10 +1,26 @@
-"""Flash attention (Pallas, TPU) — fused forward AND backward.
+"""Flash attention (Pallas, TPU) — fused forward AND backward, with
+additive bias / key-padding masks and in-kernel dropout.
 
 TPU-native replacement for the reference's fused FMHA CUDA
 (paddle/fluid/operators/fused/fused_attention_op.cu, fmha_ref.h — whose
-grad kernel is fused too). Online softmax over K/V blocks: running
+grad kernel is fused too; mask+dropout semantics per fmha_ref.h's
+softmax-then-dropout). Online softmax over K/V blocks: running
 (m, l, acc) scratch in VMEM, one MXU dot per (q-block, k-block) pair, no
 [L, L] logits materialized in HBM.
+
+Mask operands (both optional, combinable with causal):
+  * ``bias``  — additive float bias [Bb, Hb, Lq, Lk] with Bb in {1, B}
+    and Hb in {1, H}; streamed block-by-block (never materialized at
+    [B, H, L, L] in HBM unless the caller already did).
+  * ``kvec``  — additive per-key vector [B, Lk]: the padding-mask fast
+    path (BERT finetune); O(L) HBM traffic.
+
+Dropout (softmax-then-dropout, normalizer uses the UNDROPPED row sum,
+matching the reference) uses a position-keyed counter hash: the keep
+decision for (bh, q_pos, k_pos) depends only on the seed and the
+position, so forward and the two backward kernels — whose grids
+iterate in different orders — regenerate identical masks by
+construction, and the plain-jnp hash doubles as the test oracle.
 
 Forward stores per-row logsumexp; backward is two Pallas kernels
 (structure mirrors jax.experimental.pallas.ops.tpu.flash_attention
@@ -50,9 +66,92 @@ _NEG_INF = -1e30
 _LANES = 128
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-               *, scale, causal, block_q, block_k, q_len, kv_len):
+def _fmix32(h):
+    """murmur3 finalizer: full-avalanche 32-bit mix (VPU int ops only —
+    runs identically under Mosaic, interpret mode, and plain jnp)."""
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def dropout_keep(seed0, seed1, bh, q_pos, k_pos, thresh):
+    """Position-keyed keep mask: True where the attention weight at
+    (bh, q_pos, k_pos) survives dropout. Pure jnp — the same function
+    is the kernel's mask generator and the test oracle."""
+    hq = _fmix32(jnp.uint32(seed0)
+                 + q_pos.astype(jnp.uint32) * jnp.uint32(2654435761))
+    hk = _fmix32(jnp.uint32(seed1)
+                 + k_pos.astype(jnp.uint32) * jnp.uint32(0x27D4EB2F))
+    h = _fmix32(hq + hk
+                + jnp.uint32(bh) * jnp.uint32(0x9E3779B9))
+    return h >= jnp.uint32(thresh)
+
+
+def _drop_thresh(p):
+    """uint32 threshold: hash < thresh <=> dropped (prob p)."""
+    return min(int(p * 4294967296.0), 4294967295)
+
+
+def _block_keep(seed_ref, bh_id, qb, kb, block_q, block_k, thresh):
+    """Keep-mask for the (qb, kb) block — THE single definition of the
+    position arithmetic all three kernels share (fwd/bwd mask identity
+    by construction)."""
+    q_pos = qb * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return dropout_keep(seed_ref[0], seed_ref[1], bh_id, q_pos, k_pos,
+                        thresh)
+
+
+def _biased_logits(q_ref, k_ref, R, scale32, prec):
+    """Scaled q k^T for the current block, plus the optional streamed
+    additive bias / key-vector operands."""
+    s = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=prec) * scale32      # [bq, bk]
+    if R.bias is not None:
+        s = s + R.bias[0, 0].astype(jnp.float32)
+    if R.kvec is not None:
+        s = s + R.kvec[0].astype(jnp.float32)
+    return s
+
+
+class _Refs:
+    """Positional-ref parser shared by the three kernels."""
+
+    def __init__(self, refs, *, drop, has_bias, has_kvec, n_main):
+        i = 0
+        self.seed = None
+        if drop:
+            self.seed = refs[0]
+            i = 1
+        self.main = refs[i:i + n_main]
+        i += n_main
+        self.bias = None
+        if has_bias:
+            self.bias = refs[i]
+            i += 1
+        self.kvec = None
+        if has_kvec:
+            self.kvec = refs[i]
+            i += 1
+        self.rest = refs[i:]
+
+
+def _fa_kernel(*refs, scale, causal, block_q, block_k, q_len, kv_len,
+               drop_thresh, inv_keep, has_bias, has_kvec):
+    drop = drop_thresh is not None
+    R = _Refs(refs, drop=drop, has_bias=has_bias, has_kvec=has_kvec,
+              n_main=3)
+    q_ref, k_ref, v_ref = R.main
+    o_ref, lse_ref, m_ref, l_ref, acc_ref = R.rest
     prec = _prec(q_ref.dtype)
+    bh_id = pl.program_id(0)
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     n_kv = pl.num_programs(2)
@@ -98,20 +197,24 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
+        # normalizer tracks the FULL softmax sum (dropout applies after
+        # the softmax in the reference, so l never sees the mask)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        p_eff = p
+        if drop:
+            keep = _block_keep(R.seed, bh_id, qi, kj, block_q, block_k,
+                               drop_thresh)
+            p_eff = jnp.where(keep, p * jnp.float32(inv_keep), 0.0)
         v = v_ref[0]
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            p_eff.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=prec)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
     def _logits():
-        return jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=prec) * scale32      # [bq, bk]
+        return _biased_logits(q_ref, k_ref, R, scale32, prec)
 
     @pl.when(no_mask)
     def _compute_fast():
@@ -137,10 +240,15 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref,
-                  acc_ref, *, scale, causal, block_q, block_k, q_len,
-                  kv_len):
+def _fa_dq_kernel(*refs, scale, causal, block_q, block_k, q_len,
+                  kv_len, drop_thresh, inv_keep, has_bias, has_kvec):
+    drop = drop_thresh is not None
+    R = _Refs(refs, drop=drop, has_bias=has_bias, has_kvec=has_kvec,
+              n_main=6)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref = R.main
+    dq_ref, acc_ref = R.rest
     prec = _prec(q_ref.dtype)
+    bh_id = pl.program_id(0)
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     n_kv = pl.num_programs(2)
@@ -179,6 +287,10 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=prec)                # [bq, bk]
+        if drop:
+            keep = _block_keep(R.seed, bh_id, qi, kj, block_q, block_k,
+                               drop_thresh)
+            dp = jnp.where(keep, dp * jnp.float32(inv_keep), 0.0)
         ds = p * (dp - di) * scale32
         acc_ref[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -186,10 +298,7 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref,
             precision=prec)
 
     def _logits():
-        return jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=prec) * scale32      # [bq, bk]
+        return _biased_logits(q_ref, k_ref, R, scale32, prec)
 
     @pl.when(no_mask)
     def _compute_fast():
@@ -212,10 +321,15 @@ def _fa_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref,
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _fa_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, di_ref,
-                   dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                   block_q, block_k, q_len, kv_len):
+def _fa_dkv_kernel(*refs, scale, causal, block_q, block_k, q_len,
+                   kv_len, drop_thresh, inv_keep, has_bias, has_kvec):
+    drop = drop_thresh is not None
+    R = _Refs(refs, drop=drop, has_bias=has_bias, has_kvec=has_kvec,
+              n_main=6)
+    k_ref, v_ref, q_ref, do_ref, lse_ref, di_ref = R.main
+    dk_ref, dv_ref, dk_acc, dv_acc = R.rest
     prec = _prec(q_ref.dtype)
+    bh_id = pl.program_id(0)
     ki = pl.program_id(1)
     qj = pl.program_id(2)
     n_q = pl.num_programs(2)
@@ -251,14 +365,22 @@ def _fa_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, di_ref,
         lse = lse_ref[:, :, :1][0]         # [bq, 1]
         di = di_ref[:, :, :1][0]           # [bq, 1]
         p = jnp.exp(s - lse)    # masked s = -1e30 underflows to p = 0
+        if drop:
+            keep = _block_keep(R.seed, bh_id, qj, ki, block_q, block_k,
+                               drop_thresh)
+            p_eff = jnp.where(keep, p * jnp.float32(inv_keep), 0.0)
+        else:
+            p_eff = p
         dv_acc[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            p_eff.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=prec)                # [bk, d]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=prec)                # [bq, bk]
+        if drop:
+            dp = jnp.where(keep, dp * jnp.float32(inv_keep), 0.0)
         ds = p * (dp - di) * scale32
         dk_acc[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -266,10 +388,7 @@ def _fa_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, di_ref,
             precision=prec)                # [bk, d]
 
     def _logits():
-        return jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=prec) * scale32      # [bq, bk]
+        return _biased_logits(q_ref, k_ref, R, scale32, prec)
 
     @pl.when(no_mask)
     def _compute_fast():
@@ -303,7 +422,45 @@ def _pad_to(x, axis, mult):
     return jnp.pad(x, pads)
 
 
-def _flash_fwd_bhld(q, k, v, causal, scale, block_q, block_k):
+def _mask_specs(bias, kvec, h, block_q, block_k, transpose=False):
+    """(padded operands, in_specs) for the optional mask inputs.
+    transpose=True is the dkv grid, where program_id(1) walks k-blocks
+    and program_id(2) walks q-blocks."""
+    ops, specs = [], []
+    if bias is not None:
+        Bb, Hb = bias.shape[0], bias.shape[1]
+        bp = _pad_to(_pad_to(bias, 2, block_q), 3, block_k)
+
+        def bias_idx(b, i, j):
+            bi = 0 if Bb == 1 else b // h
+            hi = 0 if Hb == 1 else b % h
+            return ((bi, hi, j, i) if transpose else (bi, hi, i, j))
+        ops.append(bp)
+        specs.append(pl.BlockSpec((1, 1, block_q, block_k), bias_idx))
+    if kvec is not None:
+        B = kvec.shape[0]
+        # [B, 1, Lk]: Mosaic needs the last-two block dims (sublane,
+        # lane) to divide (8, 128) or equal the array dims — a middle
+        # singleton satisfies the sublane rule
+        kp = _pad_to(kvec, 1, block_k)[:, None, :]
+
+        def kvec_idx(b, i, j):
+            bi = 0 if B == 1 else b // h
+            return ((bi, 0, i) if transpose else (bi, 0, j))
+        ops.append(kp)
+        specs.append(pl.BlockSpec((1, 1, block_k), kvec_idx))
+    return ops, specs
+
+
+def _seed_ops(seeds, drop):
+    if not drop:
+        return [], []
+    return ([jnp.asarray(seeds, jnp.int32)],
+            [pl.BlockSpec(memory_space=pltpu.SMEM)])
+
+
+def _flash_fwd_bhld(q, k, v, bias, kvec, seeds, h, causal, scale,
+                    dropout_p, block_q, block_k):
     """q: [BH, Lq, D], k/v: [BH, Lk, D] -> ([BH, Lq, D], lse)."""
     bh, lq, d = q.shape
     lk = k.shape[1]
@@ -314,21 +471,27 @@ def _flash_fwd_bhld(q, k, v, causal, scale, block_q, block_k):
     vp = _pad_to(v, 1, block_k)
     n_q = qp.shape[1] // block_q
     n_k = kp.shape[1] // block_k
+    drop = dropout_p > 0.0
 
     kernel = functools.partial(
         _fa_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, q_len=lq, kv_len=lk)
+        block_k=block_k, q_len=lq, kv_len=lk,
+        drop_thresh=_drop_thresh(dropout_p) if drop else None,
+        inv_keep=1.0 / (1.0 - dropout_p) if drop else 1.0,
+        has_bias=bias is not None, has_kvec=kvec is not None)
+    seed_ops, seed_specs = _seed_ops(seeds, drop)
+    mask_ops, mask_specs = _mask_specs(bias, kvec, h, block_q, block_k)
     # Mosaic rejects i64 index arithmetic; trace the kernel in 32-bit
     # mode regardless of the global jax_enable_x64 (paddle int64 parity)
     with jax.enable_x64(False):
         out, lse = pl.pallas_call(
             kernel,
             grid=(bh, n_q, n_k),
-            in_specs=[
+            in_specs=seed_specs + [
                 pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
                 pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
                 pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            ],
+            ] + mask_specs,
             out_specs=[
                 pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
                 pl.BlockSpec((1, block_q, _LANES),
@@ -347,11 +510,12 @@ def _flash_fwd_bhld(q, k, v, causal, scale, block_q, block_k):
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
             interpret=_INTERPRET,
-        )(qp, kp, vp)
+        )(*seed_ops, qp, kp, vp, *mask_ops)
     return out[:, :lq], lse
 
 
-def _flash_bwd_bhld(q, k, v, o, lse, do, causal, scale, block_q, block_k):
+def _flash_bwd_bhld(q, k, v, o, lse, do, bias, kvec, seeds, h, causal,
+                    scale, dropout_p, block_q, block_k):
     """All [BH, L, D] (lse [BH, Lqp, 128]) -> (dq, dk, dv)."""
     bh, lq, d = q.shape
     lk = k.shape[1]
@@ -364,6 +528,13 @@ def _flash_bwd_bhld(q, k, v, o, lse, do, causal, scale, block_q, block_k):
     lqp, lkp = qp.shape[1], kp.shape[1]
     n_q, n_k = lqp // block_q, lkp // block_k
     offset = lk - lq
+    drop = dropout_p > 0.0
+    statics = dict(
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        q_len=lq, kv_len=lk,
+        drop_thresh=_drop_thresh(dropout_p) if drop else None,
+        inv_keep=1.0 / (1.0 - dropout_p) if drop else 1.0,
+        has_bias=bias is not None, has_kvec=kvec is not None)
 
     di = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
                  axis=-1)                                    # [bh, lq]
@@ -383,14 +554,17 @@ def _flash_bwd_bhld(q, k, v, o, lse, do, causal, scale, block_q, block_k):
             return (b, j, 0)
     kvspec = pl.BlockSpec((1, block_k, d), kv_idx)
 
-    dq_kernel = functools.partial(
-        _fa_dq_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, q_len=lq, kv_len=lk)
+    seed_ops, seed_specs = _seed_ops(seeds, drop)
+    mask_ops, mask_specs = _mask_specs(bias, kvec, h, block_q, block_k)
+
+    dq_kernel = functools.partial(_fa_dq_kernel, **statics)
     with jax.enable_x64(False):
         dq = pl.pallas_call(
             dq_kernel,
             grid=(bh, n_q, n_k),
-            in_specs=[qspec, kvspec, kvspec, qspec, lmspec, lmspec],
+            in_specs=seed_specs
+            + [qspec, kvspec, kvspec, qspec, lmspec, lmspec]
+            + mask_specs,
             out_specs=pl.BlockSpec((1, block_q, d),
                                    lambda b, i, j: (b, i, 0)),
             out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
@@ -398,7 +572,7 @@ def _flash_bwd_bhld(q, k, v, o, lse, do, causal, scale, block_q, block_k):
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
             interpret=_INTERPRET,
-        )(qp, kp, vp, dop, lse, di)
+        )(*seed_ops, qp, kp, vp, dop, lse, di, *mask_ops)
 
     # dkv grid: (bh, n_k, n_q) — q is the sequential (accumulated) axis
     kspec2 = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
@@ -415,15 +589,17 @@ def _flash_bwd_bhld(q, k, v, o, lse, do, causal, scale, block_q, block_k):
     qspec2 = pl.BlockSpec((1, block_q, d), q_idx)
     lmspec2 = pl.BlockSpec((1, block_q, _LANES),
                            lambda b, i, j: q_idx(b, i, j))
+    mask_ops2, mask_specs2 = _mask_specs(bias, kvec, h, block_q,
+                                         block_k, transpose=True)
 
-    dkv_kernel = functools.partial(
-        _fa_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, q_len=lq, kv_len=lk)
+    dkv_kernel = functools.partial(_fa_dkv_kernel, **statics)
     with jax.enable_x64(False):
         dk, dv = pl.pallas_call(
             dkv_kernel,
             grid=(bh, n_k, n_q),
-            in_specs=[kspec2, kspec2, qspec2, qspec2, lmspec2, lmspec2],
+            in_specs=seed_specs
+            + [kspec2, kspec2, qspec2, qspec2, lmspec2, lmspec2]
+            + mask_specs2,
             out_specs=[
                 pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
                 pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
@@ -439,7 +615,7 @@ def _flash_bwd_bhld(q, k, v, o, lse, do, causal, scale, block_q, block_k):
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("parallel", "parallel", "arbitrary")),
             interpret=_INTERPRET,
-        )(kp, vp, qp, dop, lse, di)
+        )(*seed_ops, kp, vp, qp, dop, lse, di, *mask_ops2)
 
     return dq[:, :lq], dk[:, :lk], dv[:, :lk]
 
@@ -464,34 +640,48 @@ def _from_bhld(x, b, h):
     return x.reshape(b, h, l, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention_blhd(q, k, v, causal=False, scale=None,
-                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
-    """Flash attention over [batch, seq, heads, head_dim] inputs."""
-    return _fa_fwd(q, k, v, causal, scale, block_q, block_k)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def flash_attention_blhd(q, k, v, bias=None, kvec=None, seeds=None,
+                         causal=False, scale=None, dropout_p=0.0,
+                         block_q=DEFAULT_BLOCK_Q,
+                         block_k=DEFAULT_BLOCK_K):
+    """Flash attention over [batch, seq, heads, head_dim] inputs.
+
+    bias: optional additive [Bb, Hb, Lq, Lk] (Bb in {1,B}, Hb in {1,H});
+    kvec: optional additive per-key vector [B, Lk] (padding masks);
+    seeds: int32[2] dropout seed (required when dropout_p > 0)."""
+    return _fa_fwd(q, k, v, bias, kvec, seeds, causal, scale,
+                   dropout_p, block_q, block_k)[0]
 
 
-def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
+def _fa_fwd(q, k, v, bias, kvec, seeds, causal, scale, dropout_p,
+            block_q, block_k):
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     b, lq, h, d = q.shape
-    out, lse = _flash_fwd_bhld(_to_bhld(q), _to_bhld(k), _to_bhld(v),
-                               causal, scale, block_q, block_k)
+    out, lse = _flash_fwd_bhld(
+        _to_bhld(q), _to_bhld(k), _to_bhld(v), bias, kvec, seeds, h,
+        causal, scale, dropout_p, block_q, block_k)
     out = _from_bhld(out, b, h)
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, bias, kvec, seeds, out, lse)
 
 
-def _fa_bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v, o, lse = res
+def _fa_bwd(causal, scale, dropout_p, block_q, block_k, res, g):
+    q, k, v, bias, kvec, seeds, o, lse = res
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     b, lq, h, d = q.shape
     dq, dk, dv = _flash_bwd_bhld(
         _to_bhld(q), _to_bhld(k), _to_bhld(v), _to_bhld(o), lse,
-        _to_bhld(g), causal, scale, block_q, block_k)
+        _to_bhld(g), bias, kvec, seeds, h, causal, scale, dropout_p,
+        block_q, block_k)
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    dkvec = None if kvec is None else jnp.zeros_like(kvec)
+    dseeds = None if seeds is None else jnp.zeros_like(seeds)
     return (_from_bhld(dq, b, h).astype(q.dtype),
             _from_bhld(dk, b, h).astype(k.dtype),
-            _from_bhld(dv, b, h).astype(v.dtype))
+            _from_bhld(dv, b, h).astype(v.dtype),
+            dbias, dkvec, dseeds)
 
 
 flash_attention_blhd.defvjp(_fa_fwd, _fa_bwd)
