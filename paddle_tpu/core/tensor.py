@@ -65,6 +65,7 @@ _NULL_SPAN = contextlib.nullcontext()
 # inference; tracers are excluded — ops inside a jitted body are interior
 # to an already-recorded node)
 _static_hook = None
+_rebind_hook = None
 
 # set by utils.flags when FLAGS_check_nan_inf is on: scans each eager
 # op's float outputs and raises on the first non-finite value
@@ -429,7 +430,16 @@ class Tensor:
 
     # -- mutation (functional under the hood) ------------------------------
     def _rebind(self, new_value):
-        """In-place ops rebind; the old buffer stays valid for the tape."""
+        """In-place ops rebind; the old buffer stays valid for the tape.
+
+        Under static-graph recording, a rebind whose new value is the
+        output of a recorded op is a BUFFER MUTATION (BN running stats,
+        spectral-norm power iteration): the hook functionalizes it into
+        a program write-back and suppresses the eager mutation (the
+        build-time placeholder value must not pollute the live buffer).
+        """
+        if _rebind_hook is not None and _rebind_hook(self, new_value):
+            return self
         self._value = new_value
         return self
 

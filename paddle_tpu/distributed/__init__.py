@@ -31,6 +31,8 @@ from .ulysses import ulysses_attention  # noqa: F401
 from . import shard_ops  # noqa: F401
 from . import fleet  # noqa: F401
 from .moe import MoELayer  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import shard_op, Engine, to_distributed  # noqa: F401
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):

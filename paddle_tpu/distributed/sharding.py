@@ -77,26 +77,86 @@ def shard_gradients(model, axis="sharding"):
     return model
 
 
-def shard_optimizer_states(optimizer, axis="sharding"):
+def _offload_supported():
+    """pinned_host memory-kind round-trips through jit on TPU/GPU PJRT;
+    the CPU backend hard-aborts on host-kind executable inputs."""
+    try:
+        return jax.devices()[0].platform in ("tpu", "gpu")
+    except Exception:
+        return False
+
+
+def shard_optimizer_states(optimizer, axis="sharding", offload=False):
     """Annotate accumulator specs so states materialize sharded: wraps
     _accumulator_specs to device_put each initial state with a sharded
     layout; the fused update keeps layouts, so optimizer memory is
-    state_bytes/n per device."""
-    if not _shard_axis_available(axis):
+    state_bytes/n per device.
+
+    offload=True additionally places the states in HOST memory
+    (memory_kind="pinned_host") and wraps the update rule with
+    host->device / device->host transfers inside the compiled step — the
+    TPU-native form of the reference's CPU offload
+    (group_sharded_stage3.py:61 offload=True: states live on CPU, are
+    fetched for the update, and written back). XLA schedules the
+    transfers asynchronously; device memory holds no optimizer state
+    between steps."""
+    mesh_ok = _shard_axis_available(axis)
+    use_host = bool(offload) and _offload_supported()
+    if offload and not use_host:
+        import warnings
+        warnings.warn(
+            "optimizer-state offload needs a TPU/GPU backend with "
+            "pinned_host memory support; states stay in device memory "
+            "(sharding annotations still apply)")
+    if not mesh_ok and not use_host:
         return optimizer
-    mesh = get_mesh()
+    mesh = get_mesh() if mesh_ok else None
+    jax_mesh = mesh.jax_mesh if mesh is not None else None
+    dev0 = jax.devices()[0]
+
+    def _sharding(shape, kind):
+        if jax_mesh is not None:
+            spec = _spec_for(tuple(shape), axis)
+            return NamedSharding(jax_mesh, spec, memory_kind=kind)
+        from jax.sharding import SingleDeviceSharding
+        return SingleDeviceSharding(dev0, memory_kind=kind)
+
     orig = optimizer._accumulator_specs
 
     def sharded_specs(p):
         specs = orig(p)
-        out = {}
-        for name, arr in specs.items():
-            spec = _spec_for(tuple(arr.shape), axis)
-            sh = NamedSharding(mesh.jax_mesh, spec)
-            out[name] = jax.device_put(arr, sh)
-        return out
+        kind = "pinned_host" if use_host else "device"
+        return {name: jax.device_put(arr, _sharding(arr.shape, kind))
+                for name, arr in specs.items()}
 
     optimizer._accumulator_specs = sharded_specs
+
+    if use_host:
+        orig_rule = optimizer._apply_rule
+
+        def offload_rule(p, g, s, gstate, lr):
+            # host->device INSIDE the compiled step (XLA schedules the
+            # fetch); the device->host write-back happens eagerly after
+            # the step via _offload_put — returning host-memory outputs
+            # from the entry computation trips AOT layout checks. The new
+            # param is pinned to device memory explicitly: with donated
+            # host states, XLA's memory-kind inference otherwise leaks
+            # pinned_host onto the weight output.
+            s_dev = {k: jax.device_put(v, _sharding(v.shape, "device"))
+                     for k, v in s.items()}
+            new_p, ns = orig_rule(p, g, s_dev, gstate, lr)
+            new_p = jax.device_put(new_p, _sharding(new_p.shape,
+                                                    "device"))
+            return new_p, ns
+
+        def offload_put(state_dict):
+            return {k: jax.device_put(v, _sharding(v.shape,
+                                                   "pinned_host"))
+                    for k, v in state_dict.items()}
+
+        optimizer._apply_rule = offload_rule
+        optimizer._offload_put = offload_put
+        optimizer._offload = True
     return optimizer
 
 
@@ -110,14 +170,11 @@ def group_sharded_parallel(model, optimizer, level, scaler=None,
     """
     if level not in ("os", "os_g", "p_g_os"):
         raise ValueError(f"level must be os|os_g|p_g_os, got {level}")
-    if offload:
-        raise NotImplementedError(
-            "CPU offload: planned (jax host_offload memories)")
     # params must live on the same mesh the sharded states live on (the
     # fused update consumes both in one program); stage 3 re-shards them
     from .parallel import _place_model_on_mesh
     _place_model_on_mesh(model)
-    shard_optimizer_states(optimizer)
+    shard_optimizer_states(optimizer, offload=offload)
     if level in ("os_g", "p_g_os"):
         shard_gradients(model)
         if level == "p_g_os":

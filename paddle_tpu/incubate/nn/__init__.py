@@ -52,11 +52,15 @@ class FusedMultiHeadAttention(Layer):
         if self.normalize_before:
             x = self.pre_ln(x)
         out = self.attn(x, x, x, attn_mask=attn_mask, cache=cache)
+        new_cache = None
         if isinstance(out, tuple):
+            new_cache = out[-1] if cache is not None else None
             out = out[0]
         out = residual + self.dropout(out)
         if not self.normalize_before:
             out = self.ln(out)
+        if new_cache is not None:
+            return out, new_cache
         return out
 
 
@@ -115,6 +119,9 @@ class FusedTransformerEncoderLayer(Layer):
 
     def forward(self, src, src_mask=None, cache=None):
         out = self.fused_attn(src, attn_mask=src_mask, cache=cache)
+        if isinstance(out, tuple):
+            h, new_cache = out
+            return self.ffn(h), new_cache
         return self.ffn(out)
 
 
@@ -136,8 +143,23 @@ class FusedMultiTransformer(Layer):
                 normalize_before=normalize_before)
             for _ in range(num_layers)])
 
+    def gen_decode_caches(self, batch_size, max_len, dtype=None):
+        """Static max-length per-layer KV caches — the in-place cache_kv
+        buffers of the reference op (fused_multi_transformer_op.cu)."""
+        return [lyr.fused_attn.attn.gen_decode_cache(batch_size, max_len,
+                                                     dtype=dtype)
+                for lyr in self.layers]
+
     def forward(self, x, attn_mask=None, caches=None):
+        new_caches = [] if caches is not None else None
         for i, layer in enumerate(self.layers):
-            x = layer(x, src_mask=attn_mask,
-                      cache=None if caches is None else caches[i])
+            out = layer(x, src_mask=attn_mask,
+                        cache=None if caches is None else caches[i])
+            if caches is not None:
+                x, c = out
+                new_caches.append(c)
+            else:
+                x = out
+        if caches is not None:
+            return x, new_caches
         return x

@@ -280,25 +280,34 @@ def get_worker_info():
     return getattr(_worker_info, "info", None)
 
 
-def default_collate_fn(batch):
-    """Stack samples into batch arrays (reference:
-    fluid/dataloader/collate.py default_collate_fn)."""
+def _collate_with(batch, stack_tensors, stack_arrays, recurse):
+    """Shared recursion of the two collates: leaf conversion differs
+    (device tensors for the in-process path, host numpy in workers)."""
     sample = batch[0]
     if isinstance(sample, Tensor):
-        import jax.numpy as jnp
-        return to_tensor(jnp.stack([s._value for s in batch]))
+        return stack_tensors(batch)
     if isinstance(sample, np.ndarray):
-        return to_tensor(np.stack(batch))
+        return stack_arrays(np.stack(batch))
     if isinstance(sample, (int, float, np.integer, np.floating)):
-        return to_tensor(np.asarray(batch))
+        return stack_arrays(np.asarray(batch))
     if isinstance(sample, (str, bytes)):
         return list(batch)
     if isinstance(sample, dict):
-        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+        return {k: recurse([s[k] for s in batch]) for k in sample}
     if isinstance(sample, (list, tuple)):
-        return type(sample)(default_collate_fn(list(items))
+        return type(sample)(recurse(list(items))
                             for items in zip(*batch))
     return list(batch)
+
+
+def default_collate_fn(batch):
+    """Stack samples into batch arrays (reference:
+    fluid/dataloader/collate.py default_collate_fn)."""
+    import jax.numpy as jnp
+    return _collate_with(
+        batch,
+        lambda b: to_tensor(jnp.stack([s._value for s in b])),
+        to_tensor, default_collate_fn)
 
 
 def default_convert_fn(batch):
@@ -309,10 +318,109 @@ def default_convert_fn(batch):
     return batch
 
 
+def _np_collate(batch):
+    """default_collate_fn producing host numpy (worker-process side —
+    Tensor leaves don't reach workers: _dataset_is_fork_safe routes
+    Tensor-yielding datasets to the thread pool)."""
+    return _collate_with(
+        batch,
+        lambda b: np.stack([np.asarray(s._value) for s in b]),
+        lambda a: a, _np_collate)
+
+
+_SHM_MIN_BYTES = 1 << 16  # inline-pickle small arrays; shm the big ones
+
+
+def _pack_payload(obj, use_shm, shm_names):
+    """Structure -> picklable spec with ndarray leaves moved to POSIX
+    shared memory (the TPU-side analogue of the reference's
+    core.LoDTensor._share_memory worker protocol,
+    fluid/dataloader/worker.py)."""
+    if isinstance(obj, np.ndarray):
+        if use_shm and obj.nbytes >= _SHM_MIN_BYTES:
+            from multiprocessing import shared_memory
+            shm = shared_memory.SharedMemory(create=True,
+                                             size=obj.nbytes)
+            np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)[...] = obj
+            name = shm.name
+            shm.close()
+            shm_names.append(name)
+            return ("shm", name, obj.shape, obj.dtype.str)
+        return ("raw", obj)
+    if isinstance(obj, dict):
+        return ("dict", {k: _pack_payload(v, use_shm, shm_names)
+                         for k, v in obj.items()})
+    if isinstance(obj, (list, tuple)):
+        return ("seq", type(obj).__name__,
+                [_pack_payload(v, use_shm, shm_names) for v in obj])
+    return ("obj", obj)
+
+
+def _unpack_payload(spec, to_device):
+    tag = spec[0]
+    if tag == "shm":
+        from multiprocessing import shared_memory
+        _, name, shape, dtype = spec
+        shm = shared_memory.SharedMemory(name=name)
+        try:
+            arr = np.ndarray(shape, np.dtype(dtype),
+                             buffer=shm.buf).copy()
+        finally:
+            shm.close()
+            shm.unlink()
+        return to_tensor(arr) if to_device else arr
+    if tag == "raw":
+        return to_tensor(spec[1]) if to_device else spec[1]
+    if tag == "dict":
+        return {k: _unpack_payload(v, to_device)
+                for k, v in spec[1].items()}
+    if tag == "seq":
+        seq = [_unpack_payload(v, to_device) for v in spec[2]]
+        return tuple(seq) if spec[1] == "tuple" else seq
+    return spec[1]
+
+
+def _mp_worker_main(dataset, collate_in_worker, index_q, result_q, wid,
+                    num_workers, worker_init_fn, use_shm):
+    """Worker-process loop: fetch indices, collate to numpy, ship via
+    shared memory. Runs with inherited (forked) dataset state; never
+    touches JAX (custom collate_fns run in the parent)."""
+    _worker_info.info = _WorkerInfo(wid, num_workers, dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(wid)
+    while True:
+        item = index_q.get()
+        if item is None:
+            return
+        i, indices = item
+        shm_names: list = []
+        try:
+            samples = [dataset[idx] for idx in indices]
+            batch = _np_collate(samples) if collate_in_worker \
+                else samples
+            payload = _pack_payload(batch, use_shm, shm_names)
+            result_q.put((i, payload, None))
+        except Exception as e:  # exceptions must survive pickling
+            for name in shm_names:
+                try:
+                    from multiprocessing import shared_memory
+                    s = shared_memory.SharedMemory(name=name)
+                    s.close()
+                    s.unlink()
+                except Exception:
+                    pass
+            result_q.put((i, None,
+                          RuntimeError(f"DataLoader worker {wid}: "
+                                       f"{type(e).__name__}: {e}")))
+
+
 class DataLoader:
-    """reference: python/paddle/fluid/reader.py:312. num_workers>0 uses a
-    thread pool (samples are numpy; the GIL is released inside
-    device_put/compute, which is where TPU feeding time actually goes)."""
+    """reference: python/paddle/fluid/reader.py:312. num_workers>0 runs
+    map-style datasets in WORKER PROCESSES with shared-memory ndarray
+    passing (fluid/dataloader/worker.py semantics) — Python-side decode/
+    augment pipelines scale past the GIL; the parent stages batches onto
+    the device. use_shared_memory=False (or iterable datasets) falls back
+    to the thread pool, where device_put/compute release the GIL."""
 
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -323,9 +431,12 @@ class DataLoader:
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
+        self._user_collate = collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 1)
         self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -378,7 +489,134 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self._fetch(indices)
             return
+        if self.use_shared_memory and self._mp_supported():
+            yield from self._iter_multiprocess()
+            return
         yield from self._iter_threaded()
+
+    @staticmethod
+    def _mp_supported():
+        import multiprocessing as mp
+        return "fork" in mp.get_all_start_methods()
+
+    def _dataset_is_fork_safe(self):
+        """Samples must be JAX-free: a forked child touching the
+        inherited PJRT client (jax.Array indexing / device fetch) can
+        deadlock. Probe one sample in the parent; Tensor leaves route
+        the loader to the thread pool instead."""
+        try:
+            sample = self.dataset[0]
+        except Exception:
+            return True  # let the worker surface the real error
+
+        def has_tensor(obj):
+            if isinstance(obj, Tensor):
+                return True
+            if isinstance(obj, dict):
+                return any(has_tensor(v) for v in obj.values())
+            if isinstance(obj, (list, tuple)):
+                return any(has_tensor(v) for v in obj)
+            return False
+
+        return not has_tensor(sample)
+
+    def _iter_multiprocess(self):
+        """Process-pool path: fork workers (dataset state inherited),
+        indices out over a queue, batches back via shared memory, emitted
+        in order with a bounded in-flight window."""
+        import multiprocessing as mp
+        if not self._dataset_is_fork_safe():
+            yield from self._iter_threaded()
+            return
+        ctx = mp.get_context("fork")
+        batches = list(self.batch_sampler)
+        n_batches = len(batches)
+        if n_batches == 0:
+            return
+        nw = min(self.num_workers, n_batches)
+        index_q = ctx.Queue()
+        result_q = ctx.Queue()
+        procs = [
+            ctx.Process(
+                target=_mp_worker_main,
+                args=(self.dataset, self._user_collate is None, index_q,
+                      result_q, wid, nw, self.worker_init_fn,
+                      True),
+                daemon=True)
+            for wid in range(nw)]
+        for p in procs:
+            p.start()
+        max_ahead = nw * self.prefetch_factor
+        dispatched = 0
+        try:
+            while dispatched < min(max_ahead, n_batches):
+                index_q.put((dispatched, batches[dispatched]))
+                dispatched += 1
+            pending: dict[int, tuple] = {}
+            import queue as _queue
+            deadline = None
+            for i in range(n_batches):
+                while i not in pending:
+                    # poll so a dead worker (OOM-kill, native segfault)
+                    # raises instead of hanging the parent forever
+                    try:
+                        j, payload, err = result_q.get(timeout=2.0)
+                    except _queue.Empty:
+                        dead = [w for w, p in enumerate(procs)
+                                if not p.is_alive()
+                                and p.exitcode not in (0, None)]
+                        if dead:
+                            raise RuntimeError(
+                                f"DataLoader worker(s) {dead} exited "
+                                f"abnormally (exitcodes "
+                                f"{[procs[w].exitcode for w in dead]})")
+                        if self.timeout:
+                            import time as _time
+                            if deadline is None:
+                                deadline = _time.monotonic() + \
+                                    self.timeout
+                            elif _time.monotonic() > deadline:
+                                raise RuntimeError(
+                                    f"DataLoader timed out after "
+                                    f"{self.timeout}s waiting for "
+                                    f"batch {i}")
+                        continue
+                    deadline = None
+                    pending[j] = (payload, err)
+                payload, err = pending.pop(i)
+                if dispatched < n_batches:
+                    index_q.put((dispatched, batches[dispatched]))
+                    dispatched += 1
+                if err is not None:
+                    raise err
+                if self._user_collate is None:
+                    yield _unpack_payload(payload, to_device=True)
+                else:
+                    samples = _unpack_payload(payload, to_device=False)
+                    yield self.collate_fn(samples)
+        finally:
+            for _ in procs:
+                index_q.put(None)
+            for p in procs:
+                p.join(timeout=2.0)
+                if p.is_alive():
+                    p.terminate()
+            # drain any landed-but-unconsumed shm segments: both the
+            # reorder buffer and anything still queued
+            for payload, _err in pending.values():
+                if payload is not None:
+                    try:
+                        _unpack_payload(payload, to_device=False)
+                    except Exception:
+                        pass
+            pending.clear()
+            try:
+                while True:
+                    _, payload, err = result_q.get_nowait()
+                    if payload is not None:
+                        _unpack_payload(payload, to_device=False)
+            except Exception:
+                pass
 
     def _iter_threaded(self):
         work_q: queue.Queue = queue.Queue()

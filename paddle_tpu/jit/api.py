@@ -17,10 +17,12 @@ Key mechanics:
 - The compiled call is recorded on the eager tape as ONE op: backward
   runs the jax.vjp of the whole program (compiled+cached), so
   `loss.backward()` and optimizers work unchanged.
-- Python control flow is traced (unrolled/functionalized). Data-dependent
-  control flow must use paddle_tpu.ops.cond / while_loop, which lower to
-  lax.cond / lax.while_loop — the AST-transformer machinery of the
-  reference is unnecessary under tracing.
+- Python control flow is traced (unrolled/functionalized). Tensor-
+  predicated `if`/`while` are rewritten by a thin AST pass
+  (jit/dy2static.py) into `ops.cond`/`ops.while_loop` calls that lower
+  to lax.cond / lax.while_loop — reference user code with data-dependent
+  branches compiles unmodified; `ops.cond`/`while_loop` remain available
+  for explicit use.
 """
 from __future__ import annotations
 
@@ -92,7 +94,8 @@ class StaticFunction:
 
     def __init__(self, fn, input_spec=None, build_strategy=None,
                  full_graph=True, backend=None):
-        self._fn = fn
+        from .dy2static import convert_control_flow
+        self._fn = convert_control_flow(fn)
         self._input_spec = input_spec
         self._layer = None  # bound Layer instance, if method
         functools.update_wrapper(self, fn)

@@ -1,0 +1,364 @@
+"""dy2static: AST conversion of tensor-predicated Python control flow.
+
+TPU-native counterpart of the reference's dy2static transformer stack
+(/root/reference/python/paddle/jit/dy2static/program_translator.py:272,
+ifelse_transformer.py / loop_transformer.py, convert_operators.py).
+Trace-based `to_static` handles everything EXCEPT native Python
+`if`/`while` on Tensor conditions (a tracer has no bool). This pass
+rewrites exactly those statements into calls of the existing
+`ops.cond` / `ops.while_loop` via runtime dispatchers that keep plain
+Python semantics when the predicate is not a Tensor:
+
+    if x.sum() > 0:            (out,) = __pt_ifelse(x.sum() > 0,
+        y = x * 2        ->                         _true, _false, (y,))
+    else:
+        y = x - 1
+
+The reference's transformer suite is ~13k LoC because it must build
+ProgramDesc sub-blocks; under tracing the branches stay ordinary Python
+functions, so the whole pass is variable-capture analysis:
+- outputs  = names assigned in either branch (simple targets)
+- params   = outputs already bound before the statement
+- anything else is read through the closure unchanged.
+
+Statements that cannot be functionalized keep their original form:
+break/continue/return/yield inside the body, assignments to names
+that are neither pre-bound nor assigned in both branches, del/global/
+nonlocal. Those still work eagerly; under tracing they raise the
+standard tracer-bool error.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+
+__all__ = ["convert_control_flow", "cfg_helpers"]
+
+_TRUE = "__pt_true_{n}"
+_FALSE = "__pt_false_{n}"
+_WCOND = "__pt_wcond_{n}"
+_WBODY = "__pt_wbody_{n}"
+_IFELSE = "__pt_ifelse"
+_WHILE = "__pt_while"
+
+
+# -- runtime dispatchers ------------------------------------------------------
+
+def _dispatch_ifelse(pred, true_fn, false_fn, args):
+    from ..core.tensor import Tensor
+    if isinstance(pred, Tensor):
+        from ..ops import control_flow
+        return control_flow.cond(pred, true_fn, false_fn,
+                                 operands=tuple(args))
+    return true_fn(*args) if pred else false_fn(*args)
+
+
+def _dispatch_while(cond_fn, body_fn, args):
+    from ..core.tensor import Tensor
+    vars_ = list(args)
+    first = cond_fn(*vars_)
+    if isinstance(first, Tensor):
+        from ..ops import control_flow
+        return tuple(control_flow.while_loop(cond_fn, body_fn, vars_))
+    while bool(first):
+        out = body_fn(*vars_)
+        vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+        first = cond_fn(*vars_)
+    return tuple(vars_)
+
+
+cfg_helpers = {_IFELSE: _dispatch_ifelse, _WHILE: _dispatch_while}
+
+
+# -- analysis helpers ---------------------------------------------------------
+
+def _assigned_names(nodes):
+    """Simple-Name assignment targets in a statement list (recursing into
+    nested compound statements but NOT nested function/class defs)."""
+    names: set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_ClassDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+        def visit_Name(self, node):
+            if isinstance(node.ctx, ast.Store):
+                names.add(node.id)
+
+    for n in nodes:
+        V().visit(n)
+    return names
+
+
+def _has_unsupported(nodes):
+    """Control transfers / scope statements the functionalization cannot
+    express."""
+    found = []
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+        visit_ClassDef = visit_FunctionDef
+        visit_Lambda = visit_FunctionDef
+
+        def generic_visit(self, node):
+            if isinstance(node, (ast.Return, ast.Break, ast.Continue,
+                                 ast.Yield, ast.YieldFrom, ast.Global,
+                                 ast.Nonlocal, ast.Delete)):
+                found.append(node)
+            ast.NodeVisitor.generic_visit(self, node)
+
+    for n in nodes:
+        V().visit(n)
+    return bool(found)
+
+
+def _returns_cleanly(stmts):
+    """Block ends with a top-level `return` and everything before it is
+    free of control transfers — convertible as a returning branch."""
+    return (bool(stmts) and isinstance(stmts[-1], ast.Return)
+            and not _has_unsupported(stmts[:-1]))
+
+
+def _make_fn(name, params, body, returns):
+    """def name(params): body; return (returns,)"""
+    ret = ast.Return(value=ast.Tuple(
+        elts=[ast.Name(id=o, ctx=ast.Load()) for o in returns],
+        ctx=ast.Load()))
+    args = ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=p) for p in params],
+        vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+        defaults=[])
+    return ast.FunctionDef(name=name, args=args,
+                           body=(body or [ast.Pass()]) + [ret],
+                           decorator_list=[], returns=None,
+                           type_params=[])
+
+
+def _call_helper(helper, head_args, params):
+    return ast.Call(
+        func=ast.Name(id=helper, ctx=ast.Load()),
+        args=head_args + [ast.Tuple(
+            elts=[ast.Name(id=p, ctx=ast.Load()) for p in params],
+            ctx=ast.Load())],
+        keywords=[])
+
+
+def _unpack_assign(outs, value):
+    target = ast.Tuple(elts=[ast.Name(id=o, ctx=ast.Store())
+                             for o in outs], ctx=ast.Store())
+    return ast.Assign(targets=[target], value=value)
+
+
+class _Converter:
+    def __init__(self):
+        self.n = 0
+        self.changed = False
+
+    def transform_function(self, fndef: ast.FunctionDef):
+        bound = {a.arg for a in fndef.args.args +
+                 fndef.args.posonlyargs + fndef.args.kwonlyargs}
+        for extra in (fndef.args.vararg, fndef.args.kwarg):
+            if extra is not None:
+                bound.add(extra.arg)
+        fndef.body = self._block(fndef.body, bound, top=True)
+        return fndef
+
+    def _block(self, stmts, bound, top=False):
+        out = []
+        i = 0
+        while i < len(stmts):
+            st = stmts[i]
+            # `if c: return A` + trailing code ending in return: absorb
+            # the tail as the else branch (both paths then return, so
+            # nothing follows the converted statement)
+            if isinstance(st, ast.If) and not st.orelse and \
+                    _returns_cleanly(st.body):
+                rest = stmts[i + 1:]
+                if rest and _returns_cleanly(rest):
+                    st = ast.If(test=st.test, body=st.body, orelse=rest)
+                    res = self._stmt(st, bound)
+                    out.extend(res if isinstance(res, list) else [res])
+                    return out
+                if not rest and top:
+                    # ONLY at the function-body level is the implicit
+                    # fall-through `return None` — in a nested block the
+                    # enclosing scope's code still runs after it
+                    st = ast.If(test=st.test, body=st.body,
+                                orelse=[ast.Return(
+                                    value=ast.Constant(value=None))])
+                    res = self._stmt(st, bound)
+                    out.extend(res if isinstance(res, list) else [res])
+                    return out
+            res = self._stmt(st, bound)
+            out.extend(res if isinstance(res, list) else [res])
+            bound |= _assigned_names([st])
+            i += 1
+        return out
+
+    def _stmt(self, st, bound):
+        if isinstance(st, ast.If):
+            return self._if(st, bound)
+        if isinstance(st, ast.While):
+            return self._while(st, bound)
+        # recurse into other compound statements' blocks
+        if isinstance(st, (ast.For, ast.With, ast.Try)):
+            for field in ("body", "orelse", "finalbody"):
+                blk = getattr(st, field, None)
+                if blk:
+                    setattr(st, field, self._block(blk, set(bound)))
+            if isinstance(st, ast.Try):
+                for h in st.handlers:
+                    h.body = self._block(h.body, set(bound))
+        return st
+
+    def _if(self, node: ast.If, bound):
+        node.body = self._block(node.body, set(bound))
+        node.orelse = self._block(node.orelse, set(bound))
+        if _has_unsupported(node.body) or _has_unsupported(node.orelse):
+            # return-style: both branches end in `return <expr>` and are
+            # otherwise clean — convert to `return dispatch(...)` (the
+            # reference's ReturnTransformer case)
+            if node.orelse and _returns_cleanly(node.body) and \
+                    _returns_cleanly(node.orelse):
+                return self._if_returns(node, bound)
+            return node
+        wt = _assigned_names(node.body)
+        wf = _assigned_names(node.orelse)
+        outs = sorted(wt | wf)
+        if not outs:
+            return node  # side-effect-only branches: nothing to thread
+        for o in outs:
+            if o not in bound and not (o in wt and o in wf):
+                return node  # may be undefined on one path: keep python
+        params = [o for o in outs if o in bound]
+        i = self.n
+        self.n += 1
+        tfn = _make_fn(_TRUE.format(n=i), params, node.body, outs)
+        ffn = _make_fn(_FALSE.format(n=i), params, node.orelse, outs)
+        call = _call_helper(
+            _IFELSE,
+            [node.test,
+             ast.Name(id=tfn.name, ctx=ast.Load()),
+             ast.Name(id=ffn.name, ctx=ast.Load())], params)
+        self.changed = True
+        return [tfn, ffn, _unpack_assign(outs, call)]
+
+    def _if_returns(self, node: ast.If, bound):
+        """Both branches return: branch functions keep their Return, the
+        If becomes `return __pt_ifelse(test, t, f, (params,))`."""
+        wt = _assigned_names(node.body)
+        wf = _assigned_names(node.orelse)
+        params = sorted((wt | wf) & bound)
+        i = self.n
+        self.n += 1
+
+        def branch(name, body):
+            args = ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=p) for p in params],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[])
+            return ast.FunctionDef(name=name, args=args, body=body,
+                                   decorator_list=[], returns=None,
+                                   type_params=[])
+
+        tfn = branch(_TRUE.format(n=i), node.body)
+        ffn = branch(_FALSE.format(n=i), node.orelse)
+        call = _call_helper(
+            _IFELSE,
+            [node.test,
+             ast.Name(id=tfn.name, ctx=ast.Load()),
+             ast.Name(id=ffn.name, ctx=ast.Load())], params)
+        self.changed = True
+        return [tfn, ffn, ast.Return(value=call)]
+
+    def _while(self, node: ast.While, bound):
+        node.body = self._block(node.body, set(bound))
+        if node.orelse or _has_unsupported(node.body):
+            return node
+        carried = sorted(_assigned_names(node.body))
+        if not carried or any(c not in bound for c in carried):
+            return node
+        i = self.n
+        self.n += 1
+        cfn = _make_fn(_WCOND.format(n=i), carried, [], [])
+        cfn.body = [ast.Return(value=node.test)]
+        bfn = _make_fn(_WBODY.format(n=i), carried, node.body, carried)
+        call = _call_helper(
+            _WHILE,
+            [ast.Name(id=cfn.name, ctx=ast.Load()),
+             ast.Name(id=bfn.name, ctx=ast.Load())], carried)
+        self.changed = True
+        return [cfn, bfn, _unpack_assign(carried, call)]
+
+
+def convert_control_flow(fn):
+    """Return fn with tensor-predicated if/while functionalized; fn
+    unchanged when nothing applies (or source is unavailable)."""
+    if inspect.ismethod(fn):
+        conv = convert_control_flow(fn.__func__)
+        return conv.__get__(fn.__self__) if conv is not fn.__func__ \
+            else fn
+    if not inspect.isfunction(fn):
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fndef = tree.body[0]
+    if not isinstance(fndef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fndef.decorator_list = []  # do not re-apply @to_static et al.
+    conv = _Converter()
+    conv.transform_function(fndef)
+    if not conv.changed:
+        return fn
+
+    freevars = fn.__code__.co_freevars
+    module = ast.Module(body=[fndef], type_ignores=[])
+    if freevars:
+        factory = ast.FunctionDef(
+            name="__pt_factory",
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=v) for v in freevars], vararg=None,
+                kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[]),
+            body=[fndef, ast.Return(value=ast.Name(id=fndef.name,
+                                                   ctx=ast.Load()))],
+            decorator_list=[], returns=None, type_params=[])
+        module = ast.Module(body=[factory], type_ignores=[])
+    ast.fix_missing_locations(module)
+    try:
+        code = compile(module, filename=f"<dy2static {fn.__qualname__}>",
+                       mode="exec")
+    except (ValueError, SyntaxError):
+        return fn
+    # exec against the REAL module globals (late-bound names defined or
+    # monkeypatched after decoration must stay visible); the two
+    # dispatchers use reserved __pt_* names
+    ns = fn.__globals__
+    for k, v in cfg_helpers.items():
+        ns.setdefault(k, v)
+    local: dict = {}
+    exec(code, ns, local)
+    if freevars:
+        try:
+            cells = [c.cell_contents for c in (fn.__closure__ or ())]
+        except ValueError:
+            return fn  # empty cell (fwd-referenced closure): keep python
+        new_fn = local["__pt_factory"](*cells)
+    else:
+        new_fn = local[fndef.name]
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    new_fn.__qualname__ = fn.__qualname__
+    new_fn.__wrapped_original__ = fn
+    return new_fn

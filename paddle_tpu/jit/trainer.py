@@ -105,7 +105,13 @@ class CompiledTrainStep:
             g2 = advance(g2)
             return loss, new_params, list(new_bufs), new_states, g2
 
-        donate_args = (0, 1, 2, 3) if donate else ()
+        # ZeRO offload: donated pinned_host state buffers trip
+        # unimplemented hbm-to-hbm DMAs in the TPU AOT path — keep
+        # params/buffers donated but not the host-resident states
+        if getattr(optimizer, "_offload", False):
+            donate_args = (0, 1) if donate else ()
+        else:
+            donate_args = (0, 1, 2, 3) if donate else ()
         self._step = jax.jit(step, donate_argnums=donate_args)
         self._target_mesh = self._harmonize_placements()
 
@@ -181,6 +187,9 @@ class CompiledTrainStep:
             p._rebind(v)
         for b, v in zip(self.buffers, new_b):
             b._rebind(v)
+        off = getattr(self.optimizer, "_offload_put", None)
+        if off is not None:  # ZeRO offload: states back to host memory
+            new_s = [off(s) for s in new_s]
         self.states = new_s
         self.gstate = new_g
         # keep the eager optimizer's view coherent for state_dict()

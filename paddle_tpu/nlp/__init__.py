@@ -10,3 +10,5 @@ from .gpt import (GPTConfig, GPTModel, GPTForCausalLM,  # noqa: F401
                   GPTForCausalLMPipe)
 from .bert import BertConfig, BertModel  # noqa: F401
 from .llama import LlamaConfig, LlamaModel, LlamaForCausalLM  # noqa: F401
+from .generation import (DecodeCache, init_decode_caches,  # noqa: F401
+                         update_and_attend, CompiledGenerator)

@@ -1,0 +1,230 @@
+"""Compiled autoregressive generation with a static in-place KV cache.
+
+TPU-native replacement for the reference's inference workhorse — the
+fused decoder layer with in-place KV cache
+(/root/reference/paddle/fluid/operators/fused/fused_multi_transformer_op.cu)
+plus PaddleNLP's Python GenerationMixin decode loop. The reference runs
+one CUDA megakernel per layer per token from an eager Python loop; here
+the ENTIRE generation — prefill and the token loop — is ONE XLA program:
+
+- The KV cache is a static max-length buffer per layer, written in place
+  with `lax.dynamic_update_slice` (XLA aliases the buffer across loop
+  iterations, so the update is a true in-place write on device).
+- The token loop is a `lax.while_loop` that early-exits as soon as every
+  row has emitted `eos_token_id` — no per-token host round trip, no
+  recompile, static shapes throughout.
+- Sampling (greedy / temperature / top-k) runs on device with threefry
+  keys split inside the loop.
+
+Attention over the static cache masks positions `> pos + i` (a windowed
+causal mask), which makes prefill and decode the same code path: prefill
+is a length-L write at pos 0, decode a length-1 write at pos L+i.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+from ..core import dtype as dtypes
+from ..ops._helpers import apply_op, as_tensor
+
+__all__ = ["DecodeCache", "init_decode_caches", "update_and_attend",
+           "CompiledGenerator"]
+
+
+class DecodeCache:
+    """Static max-length KV cache for one attention layer.
+
+    k/v: [B, max_len, n_kv_heads, head_dim] Tensors; pos: scalar int32
+    Tensor — the number of valid positions already written. Unlike the
+    eager `MultiHeadAttention.Cache` (which grows by concat and forces a
+    recompile per step), the buffers here never change shape.
+    """
+
+    __slots__ = ("k", "v", "pos")
+
+    def __init__(self, k, v, pos):
+        self.k = k
+        self.v = v
+        self.pos = pos
+
+
+def _kv_update_fwd(buf, upd, pos):
+    z = jnp.zeros((), jnp.int32)
+    starts = [z, pos.astype(jnp.int32).reshape(())] + \
+        [z] * (buf.ndim - 2)
+    return jax.lax.dynamic_update_slice(buf, upd.astype(buf.dtype),
+                                        starts)
+
+
+register_op("kv_cache_update", _kv_update_fwd)
+
+
+def _window_mask_fwd(pos, l, lmax):
+    """Bool mask [1, 1, l, lmax]: key j visible to query i iff
+    j <= pos + i (causal within the valid window of a static cache)."""
+    i = jnp.arange(l, dtype=jnp.int32)[:, None]
+    j = jnp.arange(lmax, dtype=jnp.int32)[None, :]
+    return (j <= (i + pos.astype(jnp.int32)))[None, None]
+
+
+register_op("window_causal_mask", _window_mask_fwd, nondiff=True)
+
+
+def init_decode_caches(n_layers, batch_size, max_len, n_kv_heads,
+                       head_dim, dtype=None):
+    """Fresh zeroed caches (list of DecodeCache, one per layer)."""
+    if dtype is None:
+        dtype = dtypes.get_default_dtype().np_dtype
+    caches = []
+    for _ in range(n_layers):
+        k = Tensor(jnp.zeros((batch_size, max_len, n_kv_heads, head_dim),
+                             dtype), stop_gradient=True)
+        v = Tensor(jnp.zeros((batch_size, max_len, n_kv_heads, head_dim),
+                             dtype), stop_gradient=True)
+        caches.append(DecodeCache(k, v, Tensor(jnp.zeros((), jnp.int32),
+                                               stop_gradient=True)))
+    return caches
+
+
+def update_and_attend(q, k_new, v_new, cache: DecodeCache,
+                      dropout_p=0.0, training=False):
+    """Write k_new/v_new at cache.pos, attend q over the valid prefix.
+
+    q: [B, l, H, D]; k_new/v_new: [B, l, H_kv, D] (GQA repeat handled
+    here when H > H_kv). Returns (out [B, l, H, D], advanced cache).
+    """
+    from ..nn import functional as F
+    from ..ops import manipulation
+    k_buf = apply_op("kv_cache_update", cache.k, k_new, cache.pos)
+    v_buf = apply_op("kv_cache_update", cache.v, v_new, cache.pos)
+    l, lmax = q.shape[1], k_buf.shape[1]
+    mask = apply_op("window_causal_mask", cache.pos,
+                    attrs=dict(l=int(l), lmax=int(lmax)))
+    kf, vf = k_buf, v_buf
+    n_rep = q.shape[2] // k_buf.shape[2]
+    if n_rep > 1:
+        kf = manipulation.repeat_interleave(k_buf, n_rep, axis=2)
+        vf = manipulation.repeat_interleave(v_buf, n_rep, axis=2)
+    out = F.scaled_dot_product_attention(
+        q, kf, vf, attn_mask=mask, dropout_p=dropout_p, is_causal=False,
+        training=training)
+    return out, DecodeCache(k_buf, v_buf, cache.pos + l)
+
+
+class CompiledGenerator:
+    """One-XLA-program generate() for a causal LM.
+
+    `model(input_ids, caches=[DecodeCache...])` must return
+    `(logits, new_caches)`; `cache_spec` is
+    (n_layers, n_kv_heads, head_dim). One trace per
+    (batch, prompt_len, max_new_tokens) signature, cached.
+    """
+
+    def __init__(self, model, cache_spec, temperature=1.0, top_k=None,
+                 eos_token_id=None, pad_token_id=0):
+        self.model = model
+        self.n_layers, self.n_kv, self.head_dim = cache_spec
+        self.temperature = float(temperature)
+        self.top_k = top_k
+        self.eos_token_id = eos_token_id
+        self.pad_token_id = int(pad_token_id)
+        params = list(model.parameters())
+        buffers = [b for _, b in model.named_buffers()]
+        self.state_tensors = params + buffers
+        self._traces = {}
+
+    def _sample(self, logits, key):
+        if self.temperature != 1.0:
+            logits = logits / self.temperature
+        if self.top_k:
+            vals, _ = jax.lax.top_k(logits, int(self.top_k))
+            logits = jnp.where(logits < vals[:, -1:], -1e30, logits)
+            return jax.random.categorical(key, logits, axis=-1)
+        return jnp.argmax(logits, axis=-1)
+
+    def _build(self, batch, prompt_len, max_new):
+        model = self.model
+        state_tensors = self.state_tensors
+        max_len = prompt_len + max_new
+        eos = self.eos_token_id
+        pad = self.pad_token_id
+        fp = next((t._value.dtype for t in state_tensors
+                   if jnp.issubdtype(t._value.dtype, jnp.floating)),
+                  dtypes.get_default_dtype().np_dtype)
+
+        def gen(state_vals, prompt, key):
+            originals = [t._value for t in state_tensors]
+            try:
+                for t, v in zip(state_tensors, state_vals):
+                    t._value = v
+                caches = init_decode_caches(
+                    self.n_layers, batch, max_len, self.n_kv,
+                    self.head_dim, dtype=fp)
+                logits_t, caches = model(Tensor(prompt), caches=caches)
+                last = logits_t._value[:, -1, :].astype(jnp.float32)
+                ck = tuple(c.k._value for c in caches)
+                cv = tuple(c.v._value for c in caches)
+                out0 = jnp.full((batch, max_new), pad, prompt.dtype)
+                done0 = jnp.zeros((batch,), bool)
+
+                def cond(carry):
+                    i, _, _, _, _, _, done = carry
+                    return (i < max_new) & ~jnp.all(done)
+
+                def body(carry):
+                    i, last, ck, cv, out, key, done = carry
+                    key, sub = jax.random.split(key)
+                    nxt = self._sample(last, sub).astype(out.dtype)
+                    nxt = jnp.where(done, jnp.asarray(pad, out.dtype),
+                                    nxt)
+                    out = jax.lax.dynamic_update_slice(
+                        out, nxt[:, None], (jnp.int32(0), i))
+                    if eos is not None:
+                        done = done | (nxt == eos)
+                    pos = prompt_len + i
+                    caches = [DecodeCache(Tensor(k), Tensor(v),
+                                          Tensor(pos))
+                              for k, v in zip(ck, cv)]
+                    lg, caches = model(Tensor(nxt[:, None]),
+                                       caches=caches)
+                    last = lg._value[:, -1, :].astype(jnp.float32)
+                    ck = tuple(c.k._value for c in caches)
+                    cv = tuple(c.v._value for c in caches)
+                    return (i + jnp.int32(1), last, ck, cv, out, key,
+                            done)
+
+                final = jax.lax.while_loop(
+                    cond, body,
+                    (jnp.int32(0), last, ck, cv, out0, key, done0))
+                return final[4]
+            finally:
+                for t, v in zip(state_tensors, originals):
+                    t._value = v
+
+        return jax.jit(gen)
+
+    def __call__(self, input_ids, max_new_tokens=16):
+        from ..core import random as random_mod
+        ids = as_tensor(input_ids)
+        batch, prompt_len = int(ids.shape[0]), int(ids.shape[1])
+        sig = (batch, prompt_len, int(max_new_tokens))
+        fn = self._traces.get(sig)
+        if fn is None:
+            fn = self._build(*sig)
+            self._traces[sig] = fn
+        was_training = getattr(self.model, "training", False)
+        self.model.eval()
+        try:
+            state_vals = [t._value for t in self.state_tensors]
+            key = random_mod.next_key()
+            new_tokens = fn(state_vals, ids._value, key)
+        finally:
+            if was_training:
+                self.model.train()
+        from ..ops import manipulation
+        return manipulation.concat(
+            [ids, Tensor(new_tokens, stop_gradient=True)], axis=1)

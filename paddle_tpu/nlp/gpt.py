@@ -100,6 +100,12 @@ class GPTAttention(nn.Layer):
         qkv = manipulation.reshape(qkv, [b, l, self.num_heads,
                                          3 * self.head_dim])
         q, k, v = manipulation.split(qkv, 3, axis=-1)
+        from .generation import DecodeCache, update_and_attend
+        if isinstance(cache, DecodeCache):
+            out, new_cache = update_and_attend(q, k, v, cache,
+                                               training=False)
+            out = manipulation.reshape(out, [b, l, h])
+            return self.out_proj(out), new_cache
         if cache is not None:
             k = manipulation.concat([cache[0], k], axis=1)
             v = manipulation.concat([cache[1], v], axis=1)
@@ -191,8 +197,13 @@ class GPTEmbeddings(nn.Layer):
         from ..ops import creation
         l = input_ids.shape[1]
         if position_ids is None:
-            position_ids = creation.arange(offset, offset + l,
-                                           dtype="int64")
+            if isinstance(offset, Tensor):
+                # traced offset (static-cache decode): arange(l) + pos
+                position_ids = creation.arange(0, l, dtype="int64") + \
+                    offset.astype("int64")
+            else:
+                position_ids = creation.arange(offset, offset + l,
+                                               dtype="int64")
         x = self.word_embeddings(input_ids) + \
             self.position_embeddings(position_ids)
         return self.dropout(x)
@@ -210,7 +221,11 @@ class GPTModel(nn.Layer):
                                  epsilon=config.layer_norm_epsilon)
 
     def forward(self, input_ids, position_ids=None, caches=None):
-        offset = caches[0][0].shape[1] if caches else 0
+        from .generation import DecodeCache
+        if caches and isinstance(caches[0], DecodeCache):
+            offset = caches[0].pos
+        else:
+            offset = caches[0][0].shape[1] if caches else 0
         x = self.embeddings(input_ids, position_ids, offset=offset)
         new_caches = [] if caches is not None else None
         for i, layer in enumerate(self.layers):
@@ -264,9 +279,35 @@ class GPTForCausalLM(nn.Layer):
             caches.append((k, Tensor(k._value)))
         return caches
 
+    def _decode_cache_spec(self):
+        cfg = self.config
+        return (cfg.num_hidden_layers, cfg.num_attention_heads,
+                cfg.hidden_size // cfg.num_attention_heads)
+
     def generate(self, input_ids, max_new_tokens=16, temperature=1.0,
-                 top_k=None):
-        """Greedy/sampled decoding with KV cache."""
+                 top_k=None, eos_token_id=None, pad_token_id=0,
+                 use_compiled=True):
+        """Autoregressive decoding with KV cache.
+
+        Default path: one compiled XLA program (static cache +
+        lax.while_loop — see nlp/generation.py). use_compiled=False
+        keeps the eager per-token loop (growing concat caches) for
+        debugging."""
+        if use_compiled:
+            from .generation import CompiledGenerator
+            key = (float(temperature), top_k, eos_token_id,
+                   int(pad_token_id))
+            gens = getattr(self, "_compiled_generators", None)
+            if gens is None:
+                gens = self._compiled_generators = {}
+            gen = gens.get(key)
+            if gen is None:
+                gen = CompiledGenerator(
+                    self, self._decode_cache_spec(),
+                    temperature=temperature, top_k=top_k,
+                    eos_token_id=eos_token_id, pad_token_id=pad_token_id)
+                gens[key] = gen
+            return gen(input_ids, max_new_tokens)
         from ..ops import manipulation, creation
         import jax
         from ..core import random as random_mod

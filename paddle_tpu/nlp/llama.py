@@ -64,7 +64,29 @@ def _rope_fwd(x, offset, theta):
 register_op("rope", _rope_fwd)
 
 
+def _rope_dyn_fwd(x, offset, theta):
+    """Rope with a TRACED position offset (static-cache decode): the
+    offset is a scalar int32 array, not a Python int attr."""
+    b, l, h, d = x.shape
+    pos = offset.astype(jnp.float32) + jnp.arange(l, dtype=jnp.float32)
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = jnp.outer(pos, inv)
+    cos = jnp.cos(freqs)[None, :, None, :]
+    sin = jnp.sin(freqs)[None, :, None, :]
+    x1 = x[..., 0::2]
+    x2 = x[..., 1::2]
+    o1 = x1 * cos - x2 * sin
+    o2 = x2 * cos + x1 * sin
+    return jnp.stack([o1, o2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+register_op("rope_dyn", _rope_dyn_fwd)
+
+
 def apply_rotary(x, offset=0, theta=10000.0):
+    if isinstance(offset, Tensor):
+        return apply_op("rope_dyn", as_tensor(x), offset,
+                        attrs=dict(theta=float(theta)))
     return apply_op("rope", as_tensor(x),
                     attrs=dict(offset=int(offset), theta=float(theta)))
 
@@ -95,6 +117,15 @@ class LlamaAttention(nn.Layer):
                                  [b, l, self.n_kv, self.head_dim])
         v = manipulation.reshape(self.v_proj(x),
                                  [b, l, self.n_kv, self.head_dim])
+        from .generation import DecodeCache, update_and_attend
+        if isinstance(cache, DecodeCache):
+            q = apply_rotary(q, cache.pos, self.theta)
+            k = apply_rotary(k, cache.pos, self.theta)
+            out, new_cache = update_and_attend(q, k, v, cache,
+                                               training=False)
+            out = manipulation.reshape(
+                out, [b, l, self.n_heads * self.head_dim])
+            return self.o_proj(out), new_cache
         offset = cache[0].shape[1] if cache is not None else 0
         q = apply_rotary(q, offset, self.theta)
         k = apply_rotary(k, offset, self.theta)
@@ -218,3 +249,27 @@ class LlamaForCausalLM(nn.Layer):
         if labels is not None:
             return F.cross_entropy(logits, labels)
         return logits
+
+    def _decode_cache_spec(self):
+        cfg = self.config
+        return (cfg.num_hidden_layers, cfg.num_key_value_heads,
+                cfg.hidden_size // cfg.num_attention_heads)
+
+    def generate(self, input_ids, max_new_tokens=16, temperature=1.0,
+                 top_k=None, eos_token_id=None, pad_token_id=0):
+        """Compiled autoregressive decoding (one XLA program: static KV
+        cache + lax.while_loop with EOS early exit — nlp/generation.py)."""
+        from .generation import CompiledGenerator
+        key = (float(temperature), top_k, eos_token_id,
+               int(pad_token_id))
+        gens = getattr(self, "_compiled_generators", None)
+        if gens is None:
+            gens = self._compiled_generators = {}
+        gen = gens.get(key)
+        if gen is None:
+            gen = CompiledGenerator(
+                self, self._decode_cache_spec(), temperature=temperature,
+                top_k=top_k, eos_token_id=eos_token_id,
+                pad_token_id=pad_token_id)
+            gens[key] = gen
+        return gen(input_ids, max_new_tokens)

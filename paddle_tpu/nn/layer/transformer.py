@@ -86,12 +86,34 @@ class MultiHeadAttention(Layer):
         return self.Cache(self._reshape_heads(self.k_proj(key)),
                           self._reshape_heads(self.v_proj(value)))
 
+    def gen_decode_cache(self, batch_size, max_len, dtype=None):
+        """Static max-length KV cache for compiled decoding (the
+        reference's fused_multi_transformer in-place cache_kv — see
+        nlp/generation.py DecodeCache)."""
+        from ...nlp.generation import init_decode_caches
+        return init_decode_caches(1, batch_size, max_len, self.num_heads,
+                                  self.head_dim, dtype=dtype)[0]
+
     def forward(self, query, key=None, value=None, attn_mask=None,
                 cache=None):
         from ...ops import manipulation
         key = query if key is None else key
         value = query if value is None else value
         q = self._reshape_heads(self.q_proj(query))
+        from ...nlp.generation import DecodeCache, update_and_attend
+        if isinstance(cache, DecodeCache):
+            k = self._reshape_heads(self.k_proj(key))
+            v = self._reshape_heads(self.v_proj(value))
+            out, new_cache = update_and_attend(
+                q, k, v, cache, dropout_p=self.dropout,
+                training=self.training)
+            out = manipulation.reshape(out, [0, 0, self.embed_dim])
+            out = self.out_proj(out)
+            outs = [out]
+            if self.need_weights:
+                outs.append(None)
+            outs.append(new_cache)
+            return tuple(outs)
         if isinstance(cache, MultiHeadAttention.StaticCache):
             k, v = cache.k, cache.v
         else:

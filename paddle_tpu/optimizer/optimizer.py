@@ -169,7 +169,11 @@ class Optimizer:
         # Donate accumulators/global state (owned by this optimizer; the
         # public state_dict copies). Params are NOT donated: tape nodes
         # under retain_graph and user-held references may alias them.
-        return jax.jit(fused, donate_argnums=(2, 3))
+        # ZeRO offload: donating pinned_host buffers trips unimplemented
+        # hbm-to-hbm DMAs in the TPU AOT path — skip donation there (the
+        # states live in host RAM; device memory is unaffected).
+        donate = () if getattr(self, "_offload", False) else (2, 3)
+        return jax.jit(fused, donate_argnums=donate)
 
     def _advance_global(self, gstate):
         return gstate
@@ -214,6 +218,8 @@ class Optimizer:
                     getattr(nv, "sharding", None) != old_sh:
                 nv = jax.device_put(nv, old_sh)
             p._rebind(nv)
+            if getattr(self, "_offload_put", None) is not None:
+                ns = self._offload_put(ns)  # ZeRO offload: states->host
             self._accumulators[id(p)] = ns
 
     def minimize(self, loss, startup_program=None, parameters=None,

@@ -10,10 +10,15 @@ Executor.run REPLAYS the recorded op DAG as ONE jitted XLA program per
 feed signature — the "one XLA computation per program" executor design
 (SURVEY.md §7), with feed/fetch by variable.
 
+Buffer mutations (BN running stats, spectral-norm u/v) are
+functionalized: a build-time `buffer._rebind(out)` is captured as a
+program write-back, fetched with every run and rebound onto the live
+buffer — so train-then-infer BN uses fresh statistics (reference BN
+variable semantics, python/paddle/nn/layer/norm.py).
+
 Known v1 deltas from the reference, by design:
 - startup programs are no-ops: initializer ops already ran eagerly at
   layer construction (parameters are born initialized).
-- buffer mutation across runs (BN running stats) is not written back.
 - gradient clipping configured on the optimizer is not yet applied on
   the static path.
 """
@@ -72,6 +77,13 @@ class Program:
         self._runner_cache: dict = {}
         self._version = 0
         self.random_seed = 0
+        # functionalized buffer mutations (BN running stats, spectral
+        # norm u/v): value-object id -> (producing out id, strong ref to
+        # the value — keeping it alive prevents id() reuse from falsely
+        # matching an unrelated array), and buffer tensor id -> out id
+        # to write back after each run
+        self._value_to_out: dict[int, tuple] = {}
+        self._leaf_alias: dict[int, int] = {}
 
     # -- recording -----------------------------------------------------------
     def _record(self, op, attrs, in_tensors, out_tensors, single):
@@ -85,14 +97,30 @@ class Program:
         in_ids = []
         for t in in_tensors:
             self._tensors.setdefault(id(t), t)
-            in_ids.append(id(t))
+            # a mutated buffer reads its latest functionalized value
+            in_ids.append(self._leaf_alias.get(id(t), id(t)))
         out_ids = []
         for t in out_tensors:
             self._tensors[id(t)] = t
             out_ids.append(id(t))
+            self._value_to_out[id(t._value)] = (id(t), t._value)
         self._nodes.append(_Node(op, dict(attrs), in_ids, out_ids,
                                  single))
         self._version += 1
+
+    def _record_mutation(self, tensor, new_value):
+        """A build-time `buffer._rebind(out._value)` becomes a program
+        write-back: Executor.run fetches the out and rebinds the buffer
+        (the mechanism jit/api.py uses for compiled buffer updates).
+        Returns True when captured (the eager mutation is suppressed so
+        placeholder values never pollute live buffers)."""
+        entry = self._value_to_out.get(id(new_value))
+        if entry is None or entry[1] is not new_value \
+                or id(tensor) not in self._tensors:
+            return False
+        self._leaf_alias[id(tensor)] = entry[0]
+        self._version += 1
+        return True
 
     def _register_feed(self, name, tensor):
         self._feed_names[name] = id(tensor)
@@ -154,6 +182,8 @@ class Program:
         p._tensors = dict(self._tensors)
         p._feed_names = dict(self._feed_names)
         p._feed_shapes = dict(self._feed_shapes)
+        p._value_to_out = dict(self._value_to_out)
+        p._leaf_alias = dict(self._leaf_alias)
         if not for_test:
             p._optimizer = self._optimizer
             p._loss_id = self._loss_id
@@ -211,6 +241,13 @@ def _record_hook(op, attrs, in_tensors, out_tensors, single):
         prog._record(op, attrs, in_tensors, out_tensors, single)
 
 
+def _rebind_hook(tensor, new_value):
+    prog = _state["main"]
+    if prog is None or not _state["enabled"]:
+        return False
+    return prog._record_mutation(tensor, new_value)
+
+
 def enable_static():
     """paddle.enable_static parity: op calls now RECORD into the current
     default main program (and still execute on placeholder values, which
@@ -218,12 +255,14 @@ def enable_static():
     from ..core import tensor as tensor_mod
     _state["enabled"] = True
     tensor_mod._static_hook = _record_hook
+    tensor_mod._rebind_hook = _rebind_hook
 
 
 def disable_static(place=None):
     from ..core import tensor as tensor_mod
     _state["enabled"] = False
     tensor_mod._static_hook = None
+    tensor_mod._rebind_hook = None
 
 
 def in_static_mode():
@@ -296,6 +335,7 @@ class Executor:
     # -- inference path ------------------------------------------------------
     def _run_infer(self, program, feed_names, feed_ids, feed_vals,
                    fetch_ids):
+        fetch_ids = [program._leaf_alias.get(i, i) for i in fetch_ids]
         key = ("infer", tuple(feed_names),
                tuple((v.shape, str(v.dtype)) for v in feed_vals),
                tuple(fetch_ids), program._version)
@@ -304,18 +344,23 @@ class Executor:
             param_ids, const_ids = program._classify_leaves(feed_ids,
                                                             set())
             leaf_ids = param_ids + const_ids
+            wb = sorted(program._leaf_alias.items())
 
             def pure(feed_vals, leaf_vals):
                 env = dict(zip(feed_ids, feed_vals))
                 env.update(zip(leaf_ids, leaf_vals))
                 Program._run_nodes(program._nodes, env)
-                return [env[i] for i in fetch_ids]
+                return ([env[i] for i in fetch_ids],
+                        [env[o] for _, o in wb])
 
-            entry = (jax.jit(pure), leaf_ids)
+            entry = (jax.jit(pure), leaf_ids, wb)
             program._runner_cache[key] = entry
-        fn, leaf_ids = entry
+        fn, leaf_ids, wb = entry
         leaf_vals = [program._tensors[i]._value for i in leaf_ids]
-        return fn(feed_vals, leaf_vals)
+        outs, wb_vals = fn(feed_vals, leaf_vals)
+        for (bid, _), v in zip(wb, wb_vals):
+            program._tensors[bid]._value = v
+        return outs
 
     # -- training path -------------------------------------------------------
     def _run_train(self, program, feed_names, feed_ids, feed_vals,
@@ -328,6 +373,7 @@ class Executor:
                       if (p.trainable if isinstance(p, Parameter)
                           else not p.stop_gradient)}
                      if opt._parameter_list else None)
+        fetch_ids = [program._leaf_alias.get(i, i) for i in fetch_ids]
         key = ("train", tuple(feed_names),
                tuple((v.shape, str(v.dtype)) for v in feed_vals),
                tuple(fetch_ids), program._version)
@@ -339,6 +385,7 @@ class Executor:
                 else 0.0
             extras = opt._per_param_extra(
                 [program._tensors[i] for i in param_ids])
+            wb = sorted(program._leaf_alias.items())
 
             def step(feed_vals, p_vals, const_vals, states, gstate, lr):
                 def loss_of(pv):
@@ -346,20 +393,21 @@ class Executor:
                     env.update(zip(param_ids, pv))
                     env.update(zip(const_ids, const_vals))
                     Program._run_nodes(program._nodes, env)
-                    return env[loss_id], [env[i] for i in fetch_ids]
+                    return env[loss_id], ([env[i] for i in fetch_ids],
+                                          [env[o] for _, o in wb])
 
-                (lossv, fetches), grads = jax.value_and_grad(
+                (lossv, (fetches, wb_vals)), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(list(p_vals))
                 if decay:
                     grads = [g + decay * p
                              for p, g in zip(p_vals, grads)]
                 new_p, new_s, gstate = opt._apply_updates(
                     p_vals, grads, states, gstate, lr, extras)
-                return fetches, new_p, new_s, gstate
+                return fetches, wb_vals, new_p, new_s, gstate
 
-            entry = (jax.jit(step), param_ids, const_ids)
+            entry = (jax.jit(step), param_ids, const_ids, wb)
             program._runner_cache[key] = entry
-        fn, param_ids, const_ids = entry
+        fn, param_ids, const_ids, wb = entry
         params = [program._tensors[i] for i in param_ids]
         p_vals = [p._value for p in params]
         const_vals = [program._tensors[i]._value for i in const_ids]
@@ -368,12 +416,15 @@ class Executor:
             opt._gstate = {k: jnp.asarray(v) for k, v in
                            opt._global_state_spec().items()}
         lr = jnp.asarray(opt.get_lr(), dtype=jnp.float32)
-        fetches, new_p, new_s, new_g = fn(feed_vals, p_vals, const_vals,
-                                          states, opt._gstate, lr)
+        fetches, wb_vals, new_p, new_s, new_g = fn(
+            feed_vals, p_vals, const_vals, states, opt._gstate, lr)
         opt._gstate = new_g
+        off = getattr(opt, "_offload_put", None)
         for p, nv, ns in zip(params, new_p, new_s):
             p._rebind(nv)
-            opt._accumulators[id(p)] = ns
+            opt._accumulators[id(p)] = off(ns) if off is not None else ns
+        for (bid, _), v in zip(wb, wb_vals):
+            program._tensors[bid]._value = v
         return fetches
 
 

@@ -1,0 +1,92 @@
+"""ResNet50 training throughput (BASELINE config #2: imgs/sec/chip MFU).
+
+Whole train step (forward+backward+SGD-momentum, bf16 compute) compiled
+into one donated-buffer XLA program, ImageNet-shaped synthetic batches.
+Prints one JSON line. Reference model:
+/root/reference/python/paddle/vision/models/resnet.py:435 resnet50.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "default")
+
+_PEAK_FLOPS = {
+    "v5p": 459e12, "v5e": 197e12, "v5 lite": 197e12, "v5lite": 197e12,
+    "v4": 275e12, "v6": 918e12, "v3": 123e12, "v2": 45e12,
+}
+
+
+def _peak(kind):
+    kind = (kind or "").lower()
+    for k, v in _PEAK_FLOPS.items():
+        if k in kind:
+            return v
+    return None
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import jit
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.set_matmul_precision("default")
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        batch, iters, warmup, img = 64, 20, 3, 224
+    else:
+        batch, iters, warmup, img = 4, 3, 1, 64
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    model.to(dtype="bfloat16")
+    sgd = opt.Momentum(learning_rate=0.1, momentum=0.9,
+                       parameters=model.parameters(),
+                       weight_decay=1e-4)
+    step = jit.compile_train_step(
+        lambda x, y: F.cross_entropy(model(x), y), model, sgd)
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(
+        rng.randn(batch, 3, img, img).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 1000, (batch,)))
+
+    for _ in range(warmup):
+        loss = step(x, y)
+    float(loss)
+
+    best_dt = float("inf")
+    for _ in range(3 if on_tpu else 1):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = step(x, y)
+        float(loss)
+        best_dt = min(best_dt, time.perf_counter() - t0)
+
+    imgs_per_sec = batch * iters / best_dt
+    # ResNet50 fwd ~4.1 GFLOPs @224 (train ~3x)
+    flops_per_img = 3 * 4.1e9 * (img / 224.0) ** 2
+    peak = _peak(getattr(dev, "device_kind", ""))
+    mfu = imgs_per_sec * flops_per_img / peak if peak else 0.0
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": f"imgs/s ({'tpu' if on_tpu else 'cpu-smoke'}, "
+                f"bs{batch}x{img}px, bf16, mfu={mfu:.3f})",
+        "vs_baseline": 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

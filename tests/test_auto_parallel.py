@@ -1,0 +1,182 @@
+"""Semi-automatic parallel API: shard_op + Engine.
+
+Reference: /root/reference/python/paddle/distributed/auto_parallel/
+engine.py:59 (Engine), interface.py:28 (shard_tensor) / :108 (shard_op).
+The acceptance bar from the round-2 review: a model annotated ONLY with
+shard_tensor (no mp_layers rewrite) trains with loss identical to the
+manual TP path on the 8-device mesh.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+from jax.sharding import PartitionSpec as P
+
+
+class _SerialMLP(nn.Layer):
+    def __init__(self, d_in, d_hidden, d_out):
+        super().__init__()
+        self.fc1 = nn.Linear(d_in, d_hidden)
+        self.fc2 = nn.Linear(d_hidden, d_out)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x), approximate=True))
+
+
+class _ManualTPMLP(nn.Layer):
+    """The mp_layers rewrite the Engine is supposed to make unnecessary."""
+
+    def __init__(self, d_in, d_hidden, d_out):
+        super().__init__()
+        self.fc1 = fleet.ColumnParallelLinear(d_in, d_hidden,
+                                              has_bias=True,
+                                              gather_output=False)
+        self.fc2 = fleet.RowParallelLinear(d_hidden, d_out,
+                                           has_bias=True,
+                                           input_is_parallel=True)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x), approximate=True))
+
+
+def _copy_params(src, dst):
+    for (_, ps), (_, pd) in zip(src.named_parameters(),
+                                dst.named_parameters()):
+        pd.set_value(np.asarray(ps._value))
+
+
+def _batches(n, bs, d_in, d_out, seed=0):
+    rs = np.random.RandomState(seed)
+    w = rs.randn(d_in, d_out).astype("float32")
+    out = []
+    for _ in range(n):
+        x = rs.randn(bs, d_in).astype("float32")
+        out.append((x, (x @ w).astype("float32")))
+    return out
+
+
+def _mse(pred, y):
+    return ((pred - y) ** 2).mean()
+
+
+class TestEngineVsManualTP:
+    def test_loss_identical_to_manual_tp(self):
+        d_in, d_h, d_out, bs = 16, 32, 8, 8
+        data = _batches(5, bs, d_in, d_out)
+
+        # -- manual TP reference run
+        dist.auto_mesh(dp=2, mp=4)
+        paddle.seed(0)
+        manual = _ManualTPMLP(d_in, d_h, d_out)
+        serial = _SerialMLP(d_in, d_h, d_out)
+        _copy_params(manual, serial)  # identical starting weights
+        from paddle_tpu.jit.trainer import compile_train_step
+        sgd_m = opt.SGD(learning_rate=0.1,
+                        parameters=manual.parameters())
+        step = compile_train_step(
+            lambda x, y: _mse(manual(x), y), manual, sgd_m)
+        manual_losses = []
+        for x, y in data:
+            xb = dist.shard_batch(paddle.to_tensor(x))
+            yb = dist.shard_batch(paddle.to_tensor(y))
+            manual_losses.append(float(step(xb, yb)))
+
+        # -- semi-auto: serial model + shard_tensor annotations + Engine
+        dist.auto_mesh(dp=2, mp=4)
+        dist.shard_tensor(serial.fc1.weight, spec=P(None, "mp"))
+        dist.shard_tensor(serial.fc1.bias, spec=P("mp"))
+        dist.shard_tensor(serial.fc2.weight, spec=P("mp", None))
+        sgd_s = opt.SGD(learning_rate=0.1,
+                        parameters=serial.parameters())
+        engine = dist.Engine(model=serial, loss=_mse, optimizer=sgd_s)
+        hist = engine.fit(data, epochs=1, verbose=0)
+
+        np.testing.assert_allclose(hist["loss"], manual_losses,
+                                   rtol=2e-5, atol=2e-6)
+        # the annotation actually sharded the weight over mp
+        sh = serial.fc1.weight._value.sharding
+        assert sh.spec == P(None, "mp")
+
+    def test_engine_evaluate_and_predict(self):
+        d_in, d_h, d_out, bs = 8, 16, 4, 8
+        data = _batches(3, bs, d_in, d_out, seed=3)
+        dist.auto_mesh(dp=2, mp=4)
+        paddle.seed(1)
+        model = _SerialMLP(d_in, d_h, d_out)
+        dist.shard_tensor(model.fc1.weight, spec=P(None, "mp"))
+        engine = dist.Engine(model=model, loss=_mse,
+                             optimizer=opt.SGD(
+                                 learning_rate=0.05,
+                                 parameters=model.parameters()))
+        engine.fit(data, epochs=1, verbose=0)
+        ev = engine.evaluate(data, verbose=0)
+        assert ev["loss"] is not None and np.isfinite(ev["loss"])
+        preds = engine.predict([(x,) for x, _ in data])
+        assert len(preds) == 3
+        assert preds[0].shape == (bs, d_out)
+
+    def test_engine_gpt_block_annotated_only(self):
+        """A GPT decoder layer with only weight annotations trains under
+        the Engine and the loss decreases — no fleet rewrite involved."""
+        from paddle_tpu.nlp import GPTConfig
+        from paddle_tpu.nlp.gpt import GPTDecoderLayer
+        dist.auto_mesh(dp=2, mp=4)
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32,
+                        num_hidden_layers=1, num_attention_heads=4,
+                        intermediate_size=64,
+                        max_position_embeddings=64,
+                        hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+        # built OUTSIDE fleet: plain Linear projections
+        blk = GPTDecoderLayer(cfg)
+        dist.shard_tensor(blk.attn.qkv_proj.weight, spec=P(None, "mp"))
+        dist.shard_tensor(blk.attn.out_proj.weight, spec=P("mp", None))
+        dist.shard_tensor(blk.mlp.fc1.weight, spec=P(None, "mp"))
+        dist.shard_tensor(blk.mlp.fc2.weight, spec=P("mp", None))
+
+        rs = np.random.RandomState(0)
+        data = [(rs.randn(4, 16, 32).astype("float32"),
+                 rs.randn(4, 16, 32).astype("float32"))
+                for _ in range(6)]
+        engine = dist.Engine(model=blk, loss=_mse,
+                             optimizer=opt.Adam(
+                                 learning_rate=1e-2,
+                                 parameters=blk.parameters()))
+        hist = engine.fit(data, epochs=1, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0]
+
+
+class TestShardOp:
+    def test_shard_op_constrains_output(self):
+        dist.auto_mesh(dp=2, mp=4)
+
+        def mm(a, b):
+            return a @ b
+
+        sharded_mm = dist.shard_op(
+            mm, out_placements=[[dist.Replicate(), dist.Shard(1)]])
+        a = paddle.to_tensor(np.random.RandomState(0).randn(
+            8, 16).astype("float32"))
+        b = paddle.to_tensor(np.random.RandomState(1).randn(
+            16, 8).astype("float32"))
+        out = sharded_mm(a, b)
+        np.testing.assert_allclose(out.numpy(),
+                                   a.numpy() @ b.numpy(), rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_shard_op_noop_without_mesh(self):
+        from paddle_tpu.distributed.mesh import set_mesh
+        set_mesh(None)
+        try:
+            f = dist.shard_op(lambda x: x * 2,
+                              out_placements=[[dist.Shard(0)]])
+            x = paddle.to_tensor(np.ones((4, 2), "float32"))
+            np.testing.assert_allclose(f(x).numpy(), 2 * np.ones((4, 2)))
+        finally:
+            set_mesh(None)

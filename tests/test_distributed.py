@@ -963,3 +963,59 @@ class TestUlyssesAttention:
         want = self._dense(q, k, v, True)
         np.testing.assert_allclose(out.numpy(), want.numpy(),
                                    rtol=2e-3, atol=2e-3)
+
+
+class TestZeroOffload:
+    """VERDICT round-2 item 9: group_sharded_parallel(offload=True).
+    pinned_host memory kinds need a TPU/GPU backend (the CPU PJRT
+    backend aborts on host-kind executable inputs), so on the CPU mesh
+    the call must degrade gracefully — sharding still applies, a warning
+    fires, training proceeds. scripts/offload_check.py measures the
+    device-memory drop on the real chip (recorded in BASELINE.md)."""
+
+    def test_offload_graceful_on_cpu_and_training_works(self, shard8_hcg):
+        import warnings as _w
+        model = nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
+                              nn.Linear(128, 64))
+        o = opt.Adam(learning_rate=1e-3, parameters=model.parameters())
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            model, o = dist.group_sharded_parallel(model, o, "os",
+                                                   offload=True)
+        assert any("offload" in str(r.message) for r in rec)
+        x = paddle.to_tensor(_randn(8, 64))
+        y = paddle.to_tensor(_randn(8, 64))
+        losses = []
+        for _ in range(3):
+            loss = ((model(x) - y) ** 2).mean()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+        # states still sharded 1/8 despite the offload fallback
+        checked = 0
+        for st in o._accumulators.values():
+            for name, arr in st.items():
+                if arr.size < 8:
+                    continue
+                assert _per_device_nbytes(arr) == arr.nbytes // 8
+                checked += 1
+        assert checked >= 4
+
+    @pytest.mark.skipif(
+        __import__("jax").devices()[0].platform not in ("tpu", "gpu"),
+        reason="pinned_host memory kind needs TPU/GPU PJRT")
+    def test_offload_states_in_host_memory(self):
+        model = nn.Sequential(nn.Linear(32, 64), nn.ReLU(),
+                              nn.Linear(64, 32))
+        o = opt.Adam(learning_rate=1e-3, parameters=model.parameters())
+        model, o = dist.group_sharded_parallel(model, o, "os",
+                                               offload=True)
+        x = paddle.to_tensor(_randn(4, 32))
+        loss = (model(x) ** 2).mean()
+        loss.backward()
+        o.step()
+        kinds = {getattr(v.sharding, "memory_kind", None)
+                 for s in o._accumulators.values() for v in s.values()}
+        assert kinds == {"pinned_host"}

@@ -212,3 +212,68 @@ class TestRecordingHygiene:
             _ = t * 2.0  # interleaved eager work
             exe.run(feed={"x": arr}, fetch_list=[y])
         assert len(main._runner_cache) == n_cache  # all cache hits
+
+
+class TestBufferWriteBack:
+    """BN running stats must advance across Executor.run calls and feed
+    the eval program — the reference's BN variable semantics
+    (python/paddle/nn/layer/norm.py running_mean/running_variance)."""
+
+    def test_bn_stats_match_eager_train_then_infer(self, _static_mode):
+        paddle.seed(0)
+        batches = [np.random.RandomState(i).randn(8, 3).astype("float32")
+                   * (1.0 + i) + i for i in range(3)]
+
+        # -- static: train program (records the stat update), then eval
+        x = static.data("x", [None, 3], "float32")
+        bn_s = nn.BatchNorm1D(3)
+        y = bn_s(x)
+        loss = (y ** 2).mean()
+        sgd = opt.SGD(learning_rate=0.0, parameters=bn_s.parameters())
+        sgd.minimize(loss)
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+        for b in batches:
+            exe.run(feed={"x": b}, fetch_list=[loss])
+
+        eval_prog = static.Program()
+        bn_s.eval()
+        with static.program_guard(eval_prog):
+            xe = static.data("xe", [None, 3], "float32")
+            ye = bn_s(xe)
+        out_s, = exe.run(eval_prog, feed={"xe": batches[0]},
+                         fetch_list=[ye])
+
+        # -- eager oracle: identical init (mean=0, var=1, w=1, b=0)
+        paddle.disable_static()
+        bn_e = nn.BatchNorm1D(3)
+        bn_e.train()
+        for b in batches:
+            bn_e(paddle.to_tensor(b))
+        bn_e.eval()
+        out_e = bn_e(paddle.to_tensor(batches[0])).numpy()
+
+        np.testing.assert_allclose(bn_s._mean.numpy(),
+                                   bn_e._mean.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(bn_s._variance.numpy(),
+                                   bn_e._variance.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(out_s, out_e, rtol=1e-5, atol=1e-5)
+        # stats actually moved (the silent-staleness regression guard)
+        assert not np.allclose(bn_s._mean.numpy(), np.zeros(3))
+
+    def test_infer_only_program_stats_also_advance(self, _static_mode):
+        """No optimizer attached: the _run_infer path must write back
+        too (train-mode BN forward without minimize)."""
+        x = static.data("x", [None, 3], "float32")
+        bn = nn.BatchNorm1D(3)
+        y = bn(x)  # training=True branch recorded
+        exe = static.Executor()
+        b = np.random.RandomState(0).randn(16, 3).astype("float32") + 5.0
+        exe.run(feed={"x": b}, fetch_list=[y])
+        m1 = bn._mean.numpy().copy()
+        exe.run(feed={"x": b}, fetch_list=[y])
+        m2 = bn._mean.numpy()
+        assert not np.allclose(m1, np.zeros(3))
+        assert not np.allclose(m1, m2)  # second run advances further
