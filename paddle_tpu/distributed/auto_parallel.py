@@ -88,7 +88,11 @@ def to_distributed(model, mesh=None):
 
 class Strategy:
     """reference: auto_parallel/strategy.py — knob bundle. The TPU build
-    needs far fewer knobs (XLA owns fusion/overlap); kept ones:"""
+    needs far fewer knobs (XLA owns fusion/overlap). `amp.enable` casts
+    the model to amp.dtype at Engine construction; recompute and
+    gradient_merge are accepted for API parity and warn when enabled
+    (use config.use_recompute on the model / an outer accumulation loop
+    instead)."""
 
     def __init__(self):
         self.amp = _Flag(enable=False, dtype="bfloat16")
@@ -117,12 +121,23 @@ class Engine:
         self._eval_fns = {}
         mesh = get_mesh()
         if mesh is None:
+            # Engine-local mesh only: installing it globally would flip
+            # unrelated eager code onto mesh placement as a side effect
             mesh = ProcessMesh(shape=[len(jax.devices())],
                                dim_names=["dp"])
-            from .mesh import set_mesh
-            set_mesh(mesh)
         self._mesh = mesh
         to_distributed(model, mesh)
+        s = self._strategy
+        if getattr(s.amp, "enable", False):
+            model.to(dtype=s.amp.dtype)
+        for knob in ("recompute", "gradient_merge"):
+            if getattr(getattr(s, knob, None), "enable", False):
+                import warnings
+                warnings.warn(
+                    f"auto_parallel Strategy.{knob} is accepted for API "
+                    f"parity but not applied by the Engine; use the "
+                    f"model's use_recompute config / an outer "
+                    f"accumulation loop")
 
     # -- helpers -------------------------------------------------------------
     def _shard_inputs(self, arrs):
@@ -188,7 +203,7 @@ class Engine:
                         self._loss_of, self._model, self._optimizer)
                 loss = self._train_step(*batch)
                 history["loss"].append(float(loss))
-            if verbose:
+            if verbose and history["loss"]:
                 print(f"[auto_parallel.Engine] epoch {ep}: "
                       f"loss={history['loss'][-1]:.6f}")
         return history

@@ -28,7 +28,9 @@ __all__ = ["Distribution", "Normal", "Uniform", "Categorical",
            "TransformedDistribution", "ExponentialFamily",
            "kl_divergence", "register_kl", "Transform",
            "AffineTransform", "ExpTransform", "SigmoidTransform",
-           "AbsTransform"]
+           "AbsTransform", "ChainTransform", "IndependentTransform",
+           "PowerTransform", "ReshapeTransform", "SoftmaxTransform",
+           "StackTransform", "StickBreakingTransform", "TanhTransform"]
 
 
 def _as_tensor(x, dtype="float32"):
@@ -599,6 +601,190 @@ class AbsTransform(Transform):
 
     def inverse(self, y):
         return y
+
+
+class ChainTransform(Transform):
+    """Composition t_n(...t_1(x)) (reference: transform.py:496)."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t.forward_log_det_jacobian(x)
+            x = t.forward(x)
+        return total
+
+
+class IndependentTransform(Transform):
+    """Reinterprets batch dims as event dims: the log-det sums over the
+    reinterpreted trailing dims (reference: transform.py:670)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        from ..ops import reduction
+        ld = self.base.forward_log_det_jacobian(x)
+        axes = list(range(ld.ndim - self.reinterpreted_batch_rank,
+                          ld.ndim))
+        return reduction.sum(ld, axis=axes)
+
+
+class PowerTransform(Transform):
+    """y = x**p on the positive half-line (reference: transform.py:765)."""
+
+    def __init__(self, power):
+        self.power = _as_tensor(power)
+
+    def forward(self, x):
+        return x ** self.power
+
+    def inverse(self, y):
+        return y ** (1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return ops_math.log(ops_math.abs(
+            self.power * x ** (self.power - 1.0)))
+
+
+class ReshapeTransform(Transform):
+    """Event-shape reshape; volume-preserving (reference:
+    transform.py:829)."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(int(d) for d in in_event_shape)
+        self.out_event_shape = tuple(int(d) for d in out_event_shape)
+        if int(np.prod(self.in_event_shape)) != \
+                int(np.prod(self.out_event_shape)):
+            raise ValueError("in/out event shapes must have equal size")
+
+    def forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return manipulation.reshape(x, list(batch) +
+                                    list(self.out_event_shape))
+
+    def inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return manipulation.reshape(y, list(batch) +
+                                    list(self.in_event_shape))
+
+    def forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return creation.zeros(list(batch) or [1], "float32")
+
+
+class SoftmaxTransform(Transform):
+    """x -> softmax(x) over the last axis; not bijective — inverse maps
+    to one representative preimage (reference: transform.py:996)."""
+
+    def forward(self, x):
+        from ..nn.functional import softmax
+        return softmax(x, axis=-1)
+
+    def inverse(self, y):
+        return ops_math.log(y)
+
+
+class StackTransform(Transform):
+    """Applies transforms[i] to slice i along `axis` (reference:
+    transform.py:1052)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, fn_name, v):
+        parts = manipulation.unstack(v, axis=self.axis)
+        outs = [getattr(t, fn_name)(p)
+                for t, p in zip(self.transforms, parts)]
+        return manipulation.stack(outs, axis=self.axis)
+
+    def forward(self, x):
+        return self._map("forward", x)
+
+    def inverse(self, y):
+        return self._map("inverse", y)
+
+    def forward_log_det_jacobian(self, x):
+        return self._map("forward_log_det_jacobian", x)
+
+
+class StickBreakingTransform(Transform):
+    """R^K -> interior of the (K+1)-simplex via stick breaking
+    (reference: transform.py:1172). With xo_i = x_i - log(K - i) and
+    z_i = sigmoid(xo_i): y_i = z_i * prod_{j<i}(1 - z_j), and the final
+    coordinate takes the remaining stick."""
+
+    def _offsets(self, k):
+        return creation.to_tensor(np.arange(k, 0, -1, dtype=np.float32))
+
+    def forward(self, x):
+        from ..nn.functional import sigmoid
+        k = x.shape[-1]
+        z = sigmoid(x - ops_math.log(self._offsets(k)))
+        one = creation.ones(list(z.shape[:-1]) + [1], "float32")
+        # cum[..., i] = prod_{j<=i}(1 - z_j); remaining stick before i
+        # is [1, cum[..., :-1]]
+        from ..ops import reduction as ops_red
+        cum = ops_red.cumprod(1.0 - z, dim=-1)
+        rem = manipulation.concat([one, cum[..., :-1]], axis=-1)
+        return manipulation.concat([z * rem, cum[..., -1:]], axis=-1)
+
+    def inverse(self, y):
+        k = y.shape[-1] - 1
+        from ..ops import reduction as ops_red
+        cumsum = ops_red.cumsum(y, axis=-1)
+        rem = 1.0 - manipulation.concat(
+            [creation.zeros(list(y.shape[:-1]) + [1], "float32"),
+             cumsum[..., :-2]], axis=-1)
+        z = y[..., :-1] / rem
+        return ops_math.log(z / (1.0 - z)) + \
+            ops_math.log(self._offsets(k))
+
+    def forward_log_det_jacobian(self, x):
+        # lower-triangular J: log|det| = sum_i log z_i(1-z_i)rem_i
+        #                              = sum_i log y_i + log sigmoid(-xo_i)
+        from ..nn.functional import log_sigmoid
+        from ..ops import reduction
+        k = x.shape[-1]
+        xo = x - ops_math.log(self._offsets(k))
+        y = self.forward(x)
+        return reduction.sum(ops_math.log(y[..., :-1]) +
+                             log_sigmoid(-xo), axis=-1)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x) (reference: transform.py:1238)."""
+
+    def forward(self, x):
+        return ops_math.tanh(x)
+
+    def inverse(self, y):
+        return ops_math.atanh(y)
+
+    def forward_log_det_jacobian(self, x):
+        from ..nn.functional import softplus
+        # log(1 - tanh(x)^2) = 2*(log2 - x - softplus(-2x))
+        return 2.0 * (float(np.log(2.0)) - x - softplus(-2.0 * x))
 
 
 class TransformedDistribution(Distribution):

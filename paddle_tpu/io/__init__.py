@@ -502,11 +502,15 @@ class DataLoader:
     def _dataset_is_fork_safe(self):
         """Samples must be JAX-free: a forked child touching the
         inherited PJRT client (jax.Array indexing / device fetch) can
-        deadlock. Probe one sample in the parent; Tensor leaves route
-        the loader to the thread pool instead."""
+        deadlock. Probe one sample in the parent (ONCE — cached across
+        epochs); Tensor leaves route the loader to the thread pool."""
+        cached = getattr(self, "_fork_safe", None)
+        if cached is not None:
+            return cached
         try:
             sample = self.dataset[0]
         except Exception:
+            self._fork_safe = True
             return True  # let the worker surface the real error
 
         def has_tensor(obj):
@@ -518,7 +522,8 @@ class DataLoader:
                 return any(has_tensor(v) for v in obj)
             return False
 
-        return not has_tensor(sample)
+        self._fork_safe = not has_tensor(sample)
+        return self._fork_safe
 
     def _iter_multiprocess(self):
         """Process-pool path: fork workers (dataset state inherited),

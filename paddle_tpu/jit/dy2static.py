@@ -350,11 +350,27 @@ def convert_control_flow(fn):
     local: dict = {}
     exec(code, ns, local)
     if freevars:
-        try:
-            cells = [c.cell_contents for c in (fn.__closure__ or ())]
-        except ValueError:
-            return fn  # empty cell (fwd-referenced closure): keep python
-        new_fn = local["__pt_factory"](*cells)
+        # share the ORIGINAL closure cells (a later rebind of an
+        # enclosing-scope variable must stay visible, exactly as in the
+        # unconverted function): rebuild from the inner code object when
+        # its freevar ordering matches; otherwise snapshot the cells
+        import types
+        factory = local["__pt_factory"]
+        inner_code = next(
+            (c for c in factory.__code__.co_consts
+             if isinstance(c, types.CodeType)
+             and c.co_name == fndef.name), None)
+        if inner_code is not None and \
+                inner_code.co_freevars == fn.__code__.co_freevars:
+            new_fn = types.FunctionType(inner_code, ns, fn.__name__,
+                                        fn.__defaults__, fn.__closure__)
+        else:
+            try:
+                cells = [c.cell_contents
+                         for c in (fn.__closure__ or ())]
+            except ValueError:
+                return fn  # empty cell: keep the python original
+            new_fn = factory(*cells)
     else:
         new_fn = local[fndef.name]
     new_fn.__defaults__ = fn.__defaults__

@@ -90,12 +90,30 @@ def init_decode_caches(n_layers, batch_size, max_len, n_kv_heads,
     return caches
 
 
+def _merge_mask_fwd(window, user):
+    """window bool [1,1,l,lmax] + user mask (bool or additive float,
+    broadcastable, last dim == lmax) -> additive f32 mask."""
+    add = jnp.where(window, jnp.float32(0.0), jnp.float32(-1e30))
+    if user.dtype == jnp.bool_:
+        add = add + jnp.where(user, jnp.float32(0.0),
+                              jnp.float32(-1e30))
+    else:
+        add = add + user.astype(jnp.float32)
+    return add
+
+
+register_op("decode_merge_mask", _merge_mask_fwd, nondiff=True)
+
+
 def update_and_attend(q, k_new, v_new, cache: DecodeCache,
-                      dropout_p=0.0, training=False):
+                      dropout_p=0.0, training=False, attn_mask=None):
     """Write k_new/v_new at cache.pos, attend q over the valid prefix.
 
     q: [B, l, H, D]; k_new/v_new: [B, l, H_kv, D] (GQA repeat handled
-    here when H > H_kv). Returns (out [B, l, H, D], advanced cache).
+    here when H > H_kv). attn_mask (optional): user padding/attention
+    mask over the CACHE axis (last dim must equal the cache max_len);
+    combined with the window-causal validity mask. Returns
+    (out [B, l, H, D], advanced cache).
     """
     from ..nn import functional as F
     from ..ops import manipulation
@@ -104,6 +122,15 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
     l, lmax = q.shape[1], k_buf.shape[1]
     mask = apply_op("window_causal_mask", cache.pos,
                     attrs=dict(l=int(l), lmax=int(lmax)))
+    if attn_mask is not None:
+        m = as_tensor(attn_mask)
+        if int(m.shape[-1]) != int(lmax):
+            raise ValueError(
+                f"decode attn_mask last dim {m.shape[-1]} must equal "
+                f"the cache max_len {lmax} (mask indexes cache slots)")
+        while m.ndim < 4:
+            m = manipulation.unsqueeze(m, axis=0)
+        mask = apply_op("decode_merge_mask", mask, m)
     kf, vf = k_buf, v_buf
     n_rep = q.shape[2] // k_buf.shape[2]
     if n_rep > 1:

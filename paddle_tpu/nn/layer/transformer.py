@@ -106,7 +106,8 @@ class MultiHeadAttention(Layer):
             v = self._reshape_heads(self.v_proj(value))
             out, new_cache = update_and_attend(
                 q, k, v, cache, dropout_p=self.dropout,
-                training=self.training)
+                training=self.training,
+                attn_mask=_convert_attention_mask(attn_mask, q.dtype))
             out = manipulation.reshape(out, [0, 0, self.embed_dim])
             out = self.out_proj(out)
             outs = [out]

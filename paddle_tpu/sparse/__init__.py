@@ -251,3 +251,97 @@ def transpose(x, perm, name=None):
 
 def coalesce(x, name=None):
     return x.coalesce()
+
+
+# -- unary zoo (reference: python/paddle/sparse/unary.py) --------------------
+# all zero-preserving, applied to stored values only; tape-connected so
+# gradients land on x.values()
+
+_UNARY_FNS = {
+    "sin": jnp.sin, "tan": jnp.tan, "asin": jnp.arcsin,
+    "atan": jnp.arctan, "sinh": jnp.sinh, "asinh": jnp.arcsinh,
+    "atanh": jnp.arctanh, "tanh": jnp.tanh, "sqrt": jnp.sqrt,
+    "square": jnp.square, "log1p": jnp.log1p, "abs": jnp.abs,
+    "neg": jnp.negative, "expm1": jnp.expm1, "rad2deg": jnp.rad2deg,
+    "deg2rad": jnp.deg2rad,
+}
+
+register_op("sparse_unary_values",
+            lambda v, fn: _UNARY_FNS[fn](v))
+register_op("sparse_pow_values",
+            lambda v, factor: jnp.power(v, factor))
+
+
+def _values_map(x, op_name, **attrs):
+    vals = apply_op(op_name, x.values(), attrs=attrs)
+    return SparseCooTensor(
+        jsparse.BCOO((vals._value, x._bcoo.indices),
+                     shape=x._bcoo.shape), values_tensor=vals)
+
+
+def _make_unary(fn_name):
+    def op(x, name=None):
+        return _values_map(x, "sparse_unary_values", fn=fn_name)
+    op.__name__ = fn_name
+    op.__doc__ = (f"Sparse {fn_name} (zero-preserving, values-only; "
+                  f"reference: python/paddle/sparse/unary.py)")
+    return op
+
+
+sin = _make_unary("sin")
+tan = _make_unary("tan")
+asin = _make_unary("asin")
+atan = _make_unary("atan")
+sinh = _make_unary("sinh")
+asinh = _make_unary("asinh")
+atanh = _make_unary("atanh")
+tanh = _make_unary("tanh")
+sqrt = _make_unary("sqrt")
+square = _make_unary("square")
+log1p = _make_unary("log1p")
+abs = _make_unary("abs")  # noqa: A001  (paddle API name)
+neg = _make_unary("neg")
+expm1 = _make_unary("expm1")
+rad2deg = _make_unary("rad2deg")
+deg2rad = _make_unary("deg2rad")
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    return _values_map(x, "sparse_pow_values", factor=float(factor))
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    """reference: sparse/unary.py cast."""
+    from ..core import dtype as dtypes
+    bcoo = x._bcoo
+    idx = bcoo.indices
+    if index_dtype is not None:
+        idx = idx.astype(dtypes.to_np_dtype(index_dtype))
+    data = bcoo.data
+    if value_dtype is not None:
+        data = data.astype(dtypes.to_np_dtype(value_dtype))
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=bcoo.shape))
+
+
+def divide(x, y, name=None):
+    """Elementwise divide (reference: sparse/binary.py divide). Computed
+    densely — a stored value over an implicit zero yields inf/nan, which
+    stays STORED in the result (matching the reference's dense
+    fallback); only true 0/0-at-implicit positions stay implicit."""
+    dense = x._bcoo.todense() / y._bcoo.todense()
+    # positions implicit in BOTH operands are 0/0 -> nan; those (and
+    # only those) are structural zeros, not values
+    both_implicit = jnp.isnan(dense) & (x._bcoo.todense() == 0) & \
+        (y._bcoo.todense() == 0)
+    return to_sparse_coo(Tensor(jnp.where(both_implicit, 0.0, dense)))
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix @ dense vector (reference: sparse/binary.py mv)."""
+    from ..ops import manipulation
+    v = as_tensor(vec)
+    out = matmul(x, manipulation.unsqueeze(v, axis=-1))
+    return manipulation.squeeze(out, axis=-1)
+
+
+from . import nn  # noqa: E402,F401

@@ -1,0 +1,306 @@
+"""paddle.sparse.nn: sparse conv/pool/norm/activation layers.
+
+Reference: /root/reference/python/paddle/sparse/nn/ (layer/conv.py:135
+Conv3D / :270 SubmConv3D, layer/pooling.py:20 MaxPool3D, layer/norm.py:24
+BatchNorm, layer/activation.py ReLU/Softmax, functional/conv.py:118
+conv3d / :224 subm_conv3d, functional/transformer.py attention) over the
+CUDA gather-scatter kernels in paddle/phi/kernels/sparse/.
+
+TPU-native design: the MXU computes dense tiles — scatter the sparse
+activations into a dense NDHWC block, run the XLA convolution/pool, and
+gather back at the propagated coordinate pattern. Pattern propagation is
+host-side (the nnz of the result is data-dependent; XLA wants static
+shapes), while the VALUE path is registered ops end to end, so gradients
+flow to `x.values()` and the conv weights exactly as the reference's
+rulebook kernels do. Submanifold conv keeps the input pattern (static
+nnz) and is fully compiled.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...core.dispatch import register_op
+from ...ops._helpers import apply_op, as_tensor
+from .. import SparseCooTensor, to_sparse_coo
+from jax.experimental import sparse as jsparse
+
+from ...nn.layer.layers import Layer
+from ...nn.initializer import XavierUniform, Constant
+from ...nn import ParamAttr
+
+__all__ = ["Conv3D", "SubmConv3D", "MaxPool3D", "BatchNorm", "ReLU",
+           "Softmax", "functional"]
+
+
+def _tuple3(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+def _dense_from_sparse(values, idx, shape):
+    """Scatter [nnz, C] values at [nnz, 4] NDHW indices into NDHWC."""
+    return jnp.zeros(shape, values.dtype).at[
+        idx[:, 0], idx[:, 1], idx[:, 2], idx[:, 3]].set(values)
+
+
+def _sparse_conv3d_dense_fwd(values, idx, weight, shape, stride,
+                             padding, dilation):
+    x = _dense_from_sparse(values, idx, shape)
+    pad = [(p, p) for p in padding]
+    return jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+
+
+register_op("sparse_conv3d_dense", _sparse_conv3d_dense_fwd)
+register_op("sparse_gather4d",
+            lambda dense, idx: dense[idx[:, 0], idx[:, 1], idx[:, 2],
+                                     idx[:, 3]])
+register_op("sparse_add_bias", lambda v, b: v + b)
+
+
+def _conv_impl(x, weight, bias, stride, padding, dilation, subm):
+    stride, padding, dilation = (_tuple3(stride), _tuple3(padding),
+                                 _tuple3(dilation))
+    w = as_tensor(weight)
+    idx_t = Tensor(x._bcoo.indices)
+    dense = apply_op(
+        "sparse_conv3d_dense", x.values(), idx_t, w,
+        attrs=dict(shape=tuple(x.shape), stride=stride,
+                   padding=padding, dilation=dilation))
+    if subm:
+        out_idx = x._bcoo.indices  # submanifold: pattern preserved
+    else:
+        mags = np.abs(np.asarray(
+            jax.lax.stop_gradient(dense._value))).sum(axis=-1)
+        out_idx = jnp.asarray(np.argwhere(mags != 0).astype(np.int32))
+    vals = apply_op("sparse_gather4d", dense, Tensor(out_idx))
+    if bias is not None:
+        vals = apply_op("sparse_add_bias", vals, as_tensor(bias))
+    return SparseCooTensor(
+        jsparse.BCOO((vals._value, out_idx),
+                     shape=tuple(int(s) for s in dense.shape)),
+        values_tensor=vals)
+
+
+def _max_pool3d_fwd(values, idx, shape, kernel, stride, padding):
+    neg = jnp.finfo(values.dtype).min
+    x = jnp.full(shape, neg, values.dtype).at[
+        idx[:, 0], idx[:, 1], idx[:, 2], idx[:, 3]].max(values)
+    pad = [(0, 0)] + [(p, p) for p in padding] + [(0, 0)]
+    return jax.lax.reduce_window(
+        x, neg, jax.lax.max, (1,) + kernel + (1,),
+        (1,) + stride + (1,), pad)
+
+
+register_op("sparse_max_pool3d", _max_pool3d_fwd)
+
+
+class functional:
+    """paddle.sparse.nn.functional."""
+
+    @staticmethod
+    def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+               groups=1, data_format="NDHWC", name=None):
+        if groups != 1:
+            raise NotImplementedError("sparse conv3d: groups must be 1")
+        return _conv_impl(x, weight, bias, stride, padding, dilation,
+                          subm=False)
+
+    @staticmethod
+    def subm_conv3d(x, weight, bias=None, stride=1, padding=0,
+                    dilation=1, groups=1, data_format="NDHWC",
+                    key=None, name=None):
+        if groups != 1:
+            raise NotImplementedError("sparse conv3d: groups must be 1")
+        return _conv_impl(x, weight, bias, stride, padding, dilation,
+                          subm=True)
+
+    @staticmethod
+    def max_pool3d(x, kernel_size, stride=None, padding=0,
+                   ceil_mode=False, data_format="NDHWC", name=None):
+        kernel = _tuple3(kernel_size)
+        stride = _tuple3(stride if stride is not None else kernel_size)
+        pad = _tuple3(padding)
+        dense = apply_op(
+            "sparse_max_pool3d", x.values(), Tensor(x._bcoo.indices),
+            attrs=dict(shape=tuple(x.shape), kernel=kernel,
+                       stride=stride, padding=pad))
+        neg = np.finfo(np.dtype(dense._value.dtype)).min
+        arr = np.asarray(jax.lax.stop_gradient(dense._value))
+        occupied = (arr != neg).any(axis=-1)
+        out_idx = jnp.asarray(np.argwhere(occupied).astype(np.int32))
+        vals = apply_op("sparse_gather4d", dense, Tensor(out_idx))
+        return SparseCooTensor(
+            jsparse.BCOO((vals._value, out_idx),
+                         shape=tuple(int(s) for s in dense.shape)),
+            values_tensor=vals)
+
+    @staticmethod
+    def relu(x, name=None):
+        from .. import relu as _relu
+        return _relu(x)
+
+    @staticmethod
+    def softmax(x, axis=-1, name=None):
+        """Row-wise softmax over stored values (reference:
+        sparse/nn/functional/activation.py softmax — only the existing
+        entries of each row participate)."""
+        if axis != -1:
+            raise NotImplementedError("sparse softmax: axis=-1 only")
+        rows = np.asarray(x._bcoo.indices)[:, :-1]
+        # segment id per stored element = its row (all but last
+        # sparse dim)
+        uniq, seg = np.unique(rows, axis=0, return_inverse=True)
+        vals = apply_op("sparse_segment_softmax", x.values(),
+                        Tensor(jnp.asarray(seg.astype(np.int32))),
+                        attrs=dict(num_segments=int(len(uniq))))
+        return SparseCooTensor(
+            jsparse.BCOO((vals._value, x._bcoo.indices),
+                         shape=x._bcoo.shape), values_tensor=vals)
+
+    @staticmethod
+    def attention(query, key, value, sparse_mask, key_padding_mask=None,
+                  attn_mask=None, name=None):
+        """Sparse-pattern attention (reference:
+        sparse/nn/functional/transformer.py attention over the
+        sparse_attention CUDA kernel): QK^T is evaluated ONLY at
+        sparse_mask's coordinates (SDDMM), softmax runs over each row's
+        stored entries, and the probs multiply V through spmm.
+        2-D form: query/key/value [L, D], sparse_mask [L, L]."""
+        from .. import masked_matmul, matmul as sp_matmul
+        from ...ops import manipulation
+        import math as _math
+        q = as_tensor(query)
+        d = q.shape[-1]
+        kT = manipulation.transpose(as_tensor(key), [1, 0])
+        scores = masked_matmul(q * (1.0 / _math.sqrt(d)), kT,
+                               sparse_mask)
+        probs = functional.softmax(scores)
+        return sp_matmul(probs, as_tensor(value))
+
+
+def _seg_softmax_fwd(values, seg, num_segments):
+    mx = jax.ops.segment_max(values, seg, num_segments=num_segments)
+    e = jnp.exp(values - mx[seg])
+    s = jax.ops.segment_sum(e, seg, num_segments=num_segments)
+    return e / s[seg]
+
+
+register_op("sparse_segment_softmax", _seg_softmax_fwd)
+
+
+class _SparseConvBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False,
+                 key=None, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        self._subm = subm
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        k = _tuple3(kernel_size)
+        self.weight = self.create_parameter(
+            shape=list(k) + [in_channels // groups, out_channels],
+            attr=weight_attr, default_initializer=XavierUniform())
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                shape=[out_channels], attr=bias_attr, is_bias=True,
+                default_initializer=Constant(0.0))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        fn = functional.subm_conv3d if self._subm else functional.conv3d
+        return fn(x, self.weight, self.bias, stride=self._stride,
+                  padding=self._padding, dilation=self._dilation,
+                  groups=self._groups)
+
+
+class Conv3D(_SparseConvBase):
+    """reference: sparse/nn/layer/conv.py:135."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=False,
+                         weight_attr=weight_attr, bias_attr=bias_attr,
+                         data_format=data_format)
+
+
+class SubmConv3D(_SparseConvBase):
+    """reference: sparse/nn/layer/conv.py:270 — output coordinates ==
+    input coordinates (submanifold)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=True, key=key,
+                         weight_attr=weight_attr, bias_attr=bias_attr,
+                         data_format=data_format)
+
+
+class MaxPool3D(Layer):
+    """reference: sparse/nn/layer/pooling.py:20 — pools over the stored
+    elements of each window only."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+
+    def forward(self, x):
+        return functional.max_pool3d(x, self.kernel_size, self.stride,
+                                     self.padding)
+
+
+class BatchNorm(Layer):
+    """reference: sparse/nn/layer/norm.py:24 — BatchNorm1D over the
+    [nnz, C] values, coordinates untouched."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ...nn import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon, weight_attr=weight_attr,
+                               bias_attr=bias_attr)
+
+    def forward(self, x):
+        vals = self._bn(x.values())
+        return SparseCooTensor(
+            jsparse.BCOO((vals._value, x._bcoo.indices),
+                         shape=x._bcoo.shape), values_tensor=vals)
+
+
+class ReLU(Layer):
+    """reference: sparse/nn/layer/activation.py:22."""
+
+    def forward(self, x):
+        return functional.relu(x)
+
+
+class Softmax(Layer):
+    """reference: sparse/nn/layer/activation.py:64."""
+
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return functional.softmax(x, axis=self._axis)

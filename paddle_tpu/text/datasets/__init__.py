@@ -1,0 +1,589 @@
+"""paddle.text.datasets parity: Imdb, Imikolov, Movielens, UCIHousing,
+Conll05st, WMT14, WMT16.
+
+Reference: /root/reference/python/paddle/text/datasets/{imdb,imikolov,
+movielens,uci_housing,conll05,wmt14,wmt16}.py. Each class parses the
+SAME archive formats as the reference (aclImdb tar, PTB simple-examples
+tar, ml-1m zip, conll05st-release tar, wmt tars) from a local
+`data_file` path. Automatic download is unavailable in this build (no
+network egress): constructing without `data_file` raises with
+instructions, matching paddle_tpu.vision.datasets' policy.
+"""
+from __future__ import annotations
+
+import collections
+import gzip
+import re
+import string
+import tarfile
+import zipfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "Conll05st",
+           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode"]
+
+# re-export the decoding utilities living in text/
+from ..tokenizer import __name__ as _  # noqa: F401  (package anchor)
+try:
+    from .. import viterbi_decode, ViterbiDecoder  # noqa: F401
+except ImportError:  # pragma: no cover
+    pass
+
+
+def _no_download(name):
+    raise RuntimeError(
+        f"{name}: automatic download is unavailable (no network egress). "
+        f"Pass data_file= pointing at the dataset archive in the "
+        f"reference format.")
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (reference: text/datasets/imdb.py — aclImdb tar;
+    samples are (word-id array, [label]) with label 0=pos, 1=neg)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        assert mode.lower() in ("train", "test")
+        self.mode = mode.lower()
+        if data_file is None:
+            _no_download(type(self).__name__)
+        self.data_file = data_file
+        self.word_idx = self._build_work_dict(cutoff)
+        self._load_anno()
+
+    def _tokenize(self, pattern):
+        data = []
+        with tarfile.open(self.data_file) as tarf:
+            tf = tarf.next()
+            while tf is not None:
+                if bool(pattern.match(tf.name)):
+                    data.append(
+                        tarf.extractfile(tf).read().rstrip(b"\n\r")
+                        .translate(None,
+                                   string.punctuation.encode("latin-1"))
+                        .lower().split())
+                tf = tarf.next()
+        return data
+
+    def _build_work_dict(self, cutoff):
+        word_freq = collections.defaultdict(int)
+        pattern = re.compile(
+            r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        for doc in self._tokenize(pattern):
+            for word in doc:
+                word_freq[word] += 1
+        word_freq = [x for x in word_freq.items() if x[1] > cutoff]
+        dictionary = sorted(word_freq, key=lambda x: (-x[1], x[0]))
+        words = [w for w, _ in dictionary]
+        word_idx = dict(zip(words, range(len(words))))
+        word_idx[b"<unk>"] = len(words)
+        return word_idx
+
+    def _load_anno(self):
+        pos = re.compile(rf"aclImdb/{self.mode}/pos/.*\.txt$")
+        neg = re.compile(rf"aclImdb/{self.mode}/neg/.*\.txt$")
+        unk = self.word_idx[b"<unk>"]
+        self.docs, self.labels = [], []
+        for pattern, label in ((pos, 0), (neg, 1)):
+            for doc in self._tokenize(pattern):
+                self.docs.append([self.word_idx.get(w, unk)
+                                  for w in doc])
+                self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return np.array(self.docs[idx]), np.array([self.labels[idx]])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB language-model corpus (reference: text/datasets/imikolov.py —
+    simple-examples tar; NGRAM windows or SEQ (src, trg) pairs)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        assert data_type.upper() in ("NGRAM", "SEQ")
+        assert mode.lower() in ("train", "valid")
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        self.mode = mode.lower()
+        self.min_word_freq = min_word_freq
+        if data_file is None:
+            _no_download(type(self).__name__)
+        self.data_file = data_file
+        self.word_idx = self._build_work_dict(min_word_freq)
+        self._load_anno()
+
+    @staticmethod
+    def word_count(f, word_freq=None):
+        if word_freq is None:
+            word_freq = collections.defaultdict(int)
+        for line in f:
+            for w in line.strip().split():
+                word_freq[w] += 1
+            word_freq[b"<s>"] += 1
+            word_freq[b"<e>"] += 1
+        return word_freq
+
+    def _build_work_dict(self, cutoff):
+        with tarfile.open(self.data_file) as tf:
+            trainf = tf.extractfile(
+                "./simple-examples/data/ptb.train.txt")
+            testf = tf.extractfile(
+                "./simple-examples/data/ptb.valid.txt")
+            word_freq = self.word_count(testf, self.word_count(trainf))
+            word_freq.pop(b"<unk>", None)
+            word_freq = [x for x in word_freq.items() if x[1] > cutoff]
+            words = [w for w, _ in sorted(word_freq,
+                                          key=lambda x: (-x[1], x[0]))]
+            word_idx = dict(zip(words, range(len(words))))
+            word_idx[b"<unk>"] = len(words)
+        return word_idx
+
+    def _load_anno(self):
+        self.data = []
+        with tarfile.open(self.data_file) as tf:
+            f = tf.extractfile(
+                f"./simple-examples/data/ptb.{self.mode}.txt")
+            unk = self.word_idx[b"<unk>"]
+            for line in f:
+                if self.data_type == "NGRAM":
+                    assert self.window_size > -1, "Invalid gram length"
+                    toks = [b"<s>"] + line.strip().split() + [b"<e>"]
+                    if len(toks) >= self.window_size:
+                        ids = [self.word_idx.get(w, unk) for w in toks]
+                        for i in range(self.window_size, len(ids) + 1):
+                            self.data.append(
+                                tuple(ids[i - self.window_size:i]))
+                else:
+                    toks = line.strip().split()
+                    ids = [self.word_idx.get(w, unk) for w in toks]
+                    src = [self.word_idx[b"<s>"]] + ids
+                    trg = ids + [self.word_idx[b"<e>"]]
+                    if self.window_size > 0 and \
+                            len(src) > self.window_size:
+                        continue
+                    self.data.append((src, trg))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [[self.index],
+                [categories_dict[c] for c in self.categories],
+                [movie_title_dict[w.lower()]
+                 for w in self.title.split()]]
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = int(age)
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.is_male else 1], [self.age],
+                [self.job_id]]
+
+
+class Movielens(Dataset):
+    """ml-1m ratings (reference: text/datasets/movielens.py — zip with
+    movies.dat/users.dat/ratings.dat '::'-separated latin records)."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        assert mode.lower() in ("train", "test")
+        self.mode = mode.lower()
+        if data_file is None:
+            _no_download(type(self).__name__)
+        self.data_file = data_file
+        self.test_ratio = test_ratio
+        np.random.seed(rand_seed)
+        self._load_meta_info()
+        self._load_data()
+
+    def _load_meta_info(self):
+        pattern = re.compile(r"^(.*)\((\d+)\)$")
+        self.movie_info, self.user_info = {}, {}
+        self.movie_title_dict, self.categories_dict = {}, {}
+        title_words, categories = set(), set()
+        with zipfile.ZipFile(self.data_file) as package:
+            with package.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    line = line.decode("latin")
+                    movie_id, title, cats = line.strip().split("::")
+                    cats = cats.split("|")
+                    categories.update(cats)
+                    title = pattern.match(title).group(1)
+                    self.movie_info[int(movie_id)] = MovieInfo(
+                        movie_id, cats, title)
+                    for w in title.split():
+                        title_words.add(w.lower())
+            for i, w in enumerate(sorted(title_words)):
+                self.movie_title_dict[w] = i
+            for i, c in enumerate(sorted(categories)):
+                self.categories_dict[c] = i
+            with package.open("ml-1m/users.dat") as f:
+                for line in f:
+                    line = line.decode("latin")
+                    uid, gender, age, job, _ = line.strip().split("::")
+                    self.user_info[int(uid)] = UserInfo(uid, gender,
+                                                        age, job)
+
+    def _load_data(self):
+        self.data = []
+        is_test = self.mode == "test"
+        with zipfile.ZipFile(self.data_file) as package:
+            with package.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    line = line.decode("latin")
+                    if (np.random.random() < self.test_ratio) != is_test:
+                        continue
+                    uid, mov_id, rating, _ = line.strip().split("::")
+                    rating = float(rating) * 2 - 5.0
+                    mov = self.movie_info[int(mov_id)]
+                    usr = self.user_info[int(uid)]
+                    self.data.append(
+                        usr.value() +
+                        mov.value(self.categories_dict,
+                                  self.movie_title_dict) + [[rating]])
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (reference:
+    text/datasets/uci_housing.py — whitespace floats, 14 columns,
+    80/20 split, feature normalization over the WHOLE file)."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        assert mode.lower() in ("train", "test")
+        self.mode = mode.lower()
+        if data_file is None:
+            _no_download(type(self).__name__)
+        self.data_file = data_file
+        self._load_data()
+        from ...core import dtype as dtypes
+        self.dtype = dtypes.get_default_dtype().np_dtype
+
+    def _load_data(self, feature_num=14, ratio=0.8):
+        data = np.fromfile(self.data_file, sep=" ")
+        data = data.reshape(data.shape[0] // feature_num, feature_num)
+        maximums = data.max(axis=0)
+        minimums = data.min(axis=0)
+        avgs = data.sum(axis=0) / data.shape[0]
+        for i in range(feature_num - 1):
+            data[:, i] = (data[:, i] - avgs[i]) / \
+                (maximums[i] - minimums[i])
+        offset = int(data.shape[0] * ratio)
+        self.data = data[:offset] if self.mode == "train" else \
+            data[offset:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return (np.array(row[:-1]).astype(self.dtype),
+                np.array(row[-1:]).astype(self.dtype))
+
+    def __len__(self):
+        return len(self.data)
+
+
+_UNK_IDX = 0
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL test split (reference: text/datasets/conll05.py —
+    tar with gzipped words/props columns; 9-field samples with verb
+    context windows and B/I/O label ids)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None,
+                 emb_file=None, download=True):
+        for arg, name in ((data_file, "data_file"),
+                          (word_dict_file, "word_dict_file"),
+                          (verb_dict_file, "verb_dict_file"),
+                          (target_dict_file, "target_dict_file")):
+            if arg is None:
+                _no_download(f"{type(self).__name__} ({name})")
+        self.data_file = data_file
+        self.word_dict = self._load_dict(word_dict_file)
+        self.predicate_dict = self._load_dict(verb_dict_file)
+        self.label_dict = self._load_label_dict(target_dict_file)
+        self._load_anno()
+
+    @staticmethod
+    def _load_dict(filename):
+        with open(filename) as f:
+            return {line.strip(): i for i, line in enumerate(f)}
+
+    @staticmethod
+    def _load_label_dict(filename):
+        d = {}
+        tags = set()
+        with open(filename) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith("B-") or line.startswith("I-"):
+                    tags.add(line[2:])
+        index = 0
+        for tag in sorted(tags):
+            d["B-" + tag] = index
+            index += 1
+            d["I-" + tag] = index
+            index += 1
+        d["O"] = index
+        return d
+
+    def _load_anno(self):
+        self.sentences, self.predicates, self.labels = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            wf = tf.extractfile(
+                "conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = tf.extractfile(
+                "conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with gzip.GzipFile(fileobj=wf) as words_file, \
+                    gzip.GzipFile(fileobj=pf) as props_file:
+                sentences, labels, one_seg = [], [], []
+                for word, label in zip(words_file, props_file):
+                    word = word.strip().decode()
+                    label = label.strip().decode().split()
+                    if label:
+                        sentences.append(word)
+                        one_seg.append(label)
+                        continue
+                    # end of sentence: transpose prop columns
+                    for i in range(len(one_seg[0]) if one_seg else 0):
+                        labels.append([x[i] for x in one_seg])
+                    if labels:
+                        verb_list = [x for x in labels[0] if x != "-"]
+                        for i, lbl in enumerate(labels[1:]):
+                            self.sentences.append(list(sentences))
+                            self.predicates.append(verb_list[i])
+                            self.labels.append(self._to_bio(lbl))
+                    sentences, labels, one_seg = [], [], []
+
+    @staticmethod
+    def _to_bio(lbl):
+        cur_tag, in_bracket, seq = "O", False, []
+        for l in lbl:
+            if l == "*" and not in_bracket:
+                seq.append("O")
+            elif l == "*" and in_bracket:
+                seq.append("I-" + cur_tag)
+            elif l == "*)":
+                seq.append("I-" + cur_tag)
+                in_bracket = False
+            elif "(" in l and ")" in l:
+                cur_tag = l[1:l.find("*")]
+                seq.append("B-" + cur_tag)
+                in_bracket = False
+            elif "(" in l:
+                cur_tag = l[1:l.find("*")]
+                seq.append("B-" + cur_tag)
+                in_bracket = True
+            else:
+                raise RuntimeError(f"Unexpected label: {l}")
+        return seq
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def __getitem__(self, idx):
+        sentence = self.sentences[idx]
+        labels = self.labels[idx]
+        sen_len = len(sentence)
+        verb_index = labels.index("B-V")
+        mark = [0] * len(labels)
+        ctx = {}
+        for off, name, pad in ((-2, "n2", "bos"), (-1, "n1", "bos"),
+                               (0, "0", None), (1, "p1", "eos"),
+                               (2, "p2", "eos")):
+            j = verb_index + off
+            if 0 <= j < len(labels):
+                mark[j] = 1
+                ctx[name] = sentence[j]
+            else:
+                ctx[name] = pad
+        word_idx = [self.word_dict.get(w, _UNK_IDX) for w in sentence]
+        outs = [np.array(word_idx)]
+        for name in ("n2", "n1", "0", "p1", "p2"):
+            outs.append(np.array(
+                [self.word_dict.get(ctx[name], _UNK_IDX)] * sen_len))
+        outs.append(np.array(
+            [self.predicate_dict.get(self.predicates[idx])] * sen_len))
+        outs.append(np.array(mark))
+        outs.append(np.array([self.label_dict.get(w) for w in labels]))
+        return tuple(outs)
+
+    def __len__(self):
+        return len(self.sentences)
+
+
+class WMT14(Dataset):
+    """WMT14 en-fr subset (reference: text/datasets/wmt14.py — tar with
+    src.dict/trg.dict and {mode}/{mode} tab-separated pairs; samples are
+    (src_ids, trg_ids, trg_ids_next))."""
+
+    START = "<s>"
+    END = "<e>"
+    UNK = "<unk>"
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=True):
+        assert mode.lower() in ("train", "test", "gen")
+        self.mode = mode.lower()
+        if data_file is None:
+            _no_download(type(self).__name__)
+        self.data_file = data_file
+        assert dict_size > 0, "dict_size should be set as positive number"
+        self.dict_size = dict_size
+        self._load_data()
+
+    def _load_data(self):
+        def to_dict(fd, size):
+            out = {}
+            for i, line in enumerate(fd):
+                if i >= size:
+                    break
+                out[line.strip().decode()] = i
+            return out
+
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as f:
+            names = [m.name for m in f if m.name.endswith("src.dict")]
+            assert len(names) == 1
+            self.src_dict = to_dict(f.extractfile(names[0]),
+                                    self.dict_size)
+            names = [m.name for m in f if m.name.endswith("trg.dict")]
+            assert len(names) == 1
+            self.trg_dict = to_dict(f.extractfile(names[0]),
+                                    self.dict_size)
+            file_name = f"{self.mode}/{self.mode}"
+            names = [m.name for m in f if m.name.endswith(file_name)]
+            for name in names:
+                for line in f.extractfile(name):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src_words = parts[0].split()
+                    src_ids = [self.src_dict.get(w, _UNK_IDX)
+                               for w in [self.START] + src_words +
+                               [self.END]]
+                    trg_words = parts[1].split()
+                    trg_ids = [self.trg_dict.get(w, _UNK_IDX)
+                               for w in trg_words]
+                    if len(src_ids) > 80 or len(trg_ids) > 80:
+                        continue
+                    self.src_ids.append(src_ids)
+                    self.trg_ids_next.append(
+                        trg_ids + [self.trg_dict[self.END]])
+                    self.trg_ids.append(
+                        [self.trg_dict[self.START]] + trg_ids)
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]),
+                np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+class WMT16(Dataset):
+    """WMT16 en-de subset (reference: text/datasets/wmt16.py — tar with
+    wmt16/{train,test,val} tab-separated pairs; dictionaries built from
+    the train split on first use)."""
+
+    START = "<s>"
+    END = "<e>"
+    UNK = "<unk>"
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
+        assert mode.lower() in ("train", "test", "val")
+        self.mode = mode.lower()
+        if data_file is None:
+            _no_download(type(self).__name__)
+        self.data_file = data_file
+        self.lang = lang
+        assert src_dict_size > 0 and trg_dict_size > 0
+        self.src_dict_size = min(src_dict_size, 30000) \
+            if src_dict_size > 30000 else src_dict_size
+        self.trg_dict_size = min(trg_dict_size, 30000) \
+            if trg_dict_size > 30000 else trg_dict_size
+        self.src_dict = self._build_dict(self.src_dict_size, lang)
+        self.trg_dict = self._build_dict(
+            self.trg_dict_size, "de" if lang == "en" else "en")
+        self._load_data()
+
+    def _build_dict(self, dict_size, lang):
+        word_freq = collections.defaultdict(int)
+        col = 0 if lang == self.lang else 1
+        src_col = 0 if self.lang == "en" else 1
+        col = src_col if lang == self.lang else 1 - src_col
+        with tarfile.open(self.data_file) as f:
+            for line in f.extractfile("wmt16/train"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                for w in parts[col].split():
+                    word_freq[w] += 1
+        words = [self.START, self.END, self.UNK]
+        for w, _ in sorted(word_freq.items(), key=lambda x: x[1],
+                           reverse=True):
+            if len(words) == dict_size:
+                break
+            words.append(w)
+        return {w: i for i, w in enumerate(words)}
+
+    def _load_data(self):
+        start_id = self.src_dict[self.START]
+        end_id = self.src_dict[self.END]
+        unk_id = self.src_dict[self.UNK]
+        src_col = 0 if self.lang == "en" else 1
+        trg_col = 1 - src_col
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as f:
+            for line in f.extractfile(f"wmt16/{self.mode}"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src_ids = [start_id] + \
+                    [self.src_dict.get(w, unk_id)
+                     for w in parts[src_col].split()] + [end_id]
+                trg_ids = [self.trg_dict.get(w, unk_id)
+                           for w in parts[trg_col].split()]
+                self.src_ids.append(src_ids)
+                self.trg_ids_next.append(trg_ids + [end_id])
+                self.trg_ids.append([start_id] + trg_ids)
+
+    def get_dict(self, lang, reverse=False):
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else dict(d)
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]),
+                np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)

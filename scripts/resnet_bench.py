@@ -44,7 +44,7 @@ def main():
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     if on_tpu:
-        batch, iters, warmup, img = 64, 20, 3, 224
+        batch, iters, warmup, img = 128, 20, 3, 224
     else:
         batch, iters, warmup, img = 4, 3, 1, 64
 
@@ -59,7 +59,8 @@ def main():
 
     rng = np.random.RandomState(0)
     x = paddle.to_tensor(
-        rng.randn(batch, 3, img, img).astype(np.float32))
+        rng.randn(batch, 3, img, img).astype(np.float32)) \
+        .astype("bfloat16")
     y = paddle.to_tensor(rng.randint(0, 1000, (batch,)))
 
     for _ in range(warmup):

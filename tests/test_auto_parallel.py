@@ -180,3 +180,30 @@ class TestShardOp:
             np.testing.assert_allclose(f(x).numpy(), 2 * np.ones((4, 2)))
         finally:
             set_mesh(None)
+
+
+class TestEngineEdges:
+    def test_empty_loader_returns_empty_history(self):
+        from paddle_tpu.distributed.mesh import set_mesh, get_mesh
+        set_mesh(None)
+        model = _SerialMLP(4, 8, 2)
+        eng = dist.Engine(model=model, loss=_mse,
+                          optimizer=opt.SGD(
+                              learning_rate=0.1,
+                              parameters=model.parameters()))
+        hist = eng.fit([], epochs=1, verbose=1)  # must not crash
+        assert hist["loss"] == []
+        # constructing the Engine must NOT install a global mesh
+        assert get_mesh() is None
+
+    def test_strategy_amp_casts_model(self):
+        from paddle_tpu.distributed.mesh import set_mesh
+        set_mesh(None)
+        model = _SerialMLP(4, 8, 2)
+        s = dist.auto_parallel.Strategy()
+        s.amp.enable = True
+        dist.Engine(model=model, loss=_mse,
+                    optimizer=opt.SGD(learning_rate=0.1,
+                                      parameters=model.parameters()),
+                    strategy=s)
+        assert str(model.fc1.weight.dtype).endswith("bfloat16")

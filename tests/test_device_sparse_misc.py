@@ -405,3 +405,137 @@ class TestFasterTokenizer:
             b, lb = fallback(["the fox jumps"], max_seq_len=msl)
             np.testing.assert_array_equal(a.numpy(), b.numpy())
             np.testing.assert_array_equal(la.numpy(), lb.numpy())
+
+
+class TestSparseNN:
+    """paddle.sparse.nn (reference: sparse/nn/layer/conv.py:135 Conv3D,
+    :270 SubmConv3D, pooling.py:20 MaxPool3D, norm.py:24 BatchNorm,
+    activation.py ReLU/Softmax; kernels phi/kernels/sparse/)."""
+
+    def _rand_sparse_ndhwc(self, seed=0, shape=(1, 4, 4, 4, 3),
+                           density=0.3):
+        rs = np.random.RandomState(seed)
+        dense = rs.randn(*shape).astype("float32")
+        dense[rs.rand(*shape[:-1]) > density] = 0.0
+        import paddle_tpu.sparse as sparse
+        return (sparse.to_sparse_coo(paddle.to_tensor(dense),
+                                     sparse_dim=4), dense)
+
+    def test_subm_conv3d_matches_dense_at_pattern(self):
+        import paddle_tpu.sparse as sparse
+        paddle.seed(0)
+        x, dense = self._rand_sparse_ndhwc()
+        conv = sparse.nn.SubmConv3D(3, 5, kernel_size=3, padding=1)
+        out = conv(x)
+        # oracle: dense conv evaluated at the INPUT pattern
+        import jax
+        import jax.numpy as jnp
+        ref = jax.lax.conv_general_dilated(
+            jnp.asarray(dense), conv.weight._value, (1, 1, 1),
+            [(1, 1)] * 3, dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        ref = ref + conv.bias._value
+        idx = np.asarray(x._bcoo.indices)
+        want = np.asarray(ref)[idx[:, 0], idx[:, 1], idx[:, 2],
+                               idx[:, 3]]
+        np.testing.assert_allclose(out.values().numpy(), want,
+                                   rtol=2e-4, atol=2e-5)
+        # submanifold: pattern preserved
+        np.testing.assert_array_equal(np.asarray(out._bcoo.indices),
+                                      idx)
+
+    def test_conv3d_dense_parity_and_grad(self):
+        import paddle_tpu.sparse as sparse
+        paddle.seed(1)
+        x, dense = self._rand_sparse_ndhwc(seed=2)
+        conv = sparse.nn.Conv3D(3, 4, kernel_size=2, stride=2)
+        out = conv(x)
+        import jax
+        import jax.numpy as jnp
+        ref = jax.lax.conv_general_dilated(
+            jnp.asarray(dense), conv.weight._value, (2, 2, 2),
+            [(0, 0)] * 3, dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        ref = np.asarray(ref) + conv.bias.numpy()
+        np.testing.assert_allclose(out.to_dense().numpy(), ref,
+                                   rtol=2e-4, atol=1e-5)
+        loss = (out.values() ** 2).sum()
+        loss.backward()
+        assert conv.weight.grad is not None
+        assert np.isfinite(conv.weight.grad.numpy()).all()
+
+    def test_max_pool3d_existing_elements_only(self):
+        import paddle_tpu.sparse as sparse
+        x, dense = self._rand_sparse_ndhwc(seed=3)
+        out = sparse.nn.functional.max_pool3d(x, kernel_size=2,
+                                              stride=2)
+        # oracle: window max over EXISTING (nonzero) sites only
+        d = dense.copy()
+        occ = (d != 0).any(-1, keepdims=True)
+        d[~np.broadcast_to(occ, d.shape)] = -np.inf
+        N, D, H, W, C = d.shape
+        ref = d.reshape(N, D // 2, 2, H // 2, 2, W // 2, 2, C) \
+            .max(axis=(2, 4, 6))
+        got = out.to_dense().numpy()
+        idx = np.asarray(out._bcoo.indices)
+        for n, dd, hh, ww in idx:
+            np.testing.assert_allclose(
+                got[n, dd, hh, ww], ref[n, dd, hh, ww], rtol=1e-5)
+
+    def test_batchnorm_relu_softmax(self):
+        import paddle_tpu.sparse as sparse
+        paddle.seed(0)
+        x, _ = self._rand_sparse_ndhwc(seed=4)
+        bn = sparse.nn.BatchNorm(3)
+        y = bn(x)
+        assert y.values().shape[1] == 3
+        r = sparse.nn.ReLU()(y)
+        assert (r.values().numpy() >= 0).all()
+        # softmax over a 2-D sparse matrix's rows
+        m = np.array([[1.0, 0, 2.0], [0, 3.0, 0]], "float32")
+        sm = sparse.to_sparse_coo(paddle.to_tensor(m))
+        p = sparse.nn.functional.softmax(sm).to_dense().numpy()
+        row0 = np.exp([1.0, 2.0]) / np.exp([1.0, 2.0]).sum()
+        np.testing.assert_allclose(p[0, [0, 2]], row0, rtol=1e-5)
+        np.testing.assert_allclose(p[1, 1], 1.0, rtol=1e-6)
+
+    def test_sparse_attention_matches_masked_dense(self):
+        import paddle_tpu.sparse as sparse
+        rs = np.random.RandomState(0)
+        L, Dh = 4, 8
+        q = rs.randn(L, Dh).astype("float32")
+        k = rs.randn(L, Dh).astype("float32")
+        v = rs.randn(L, Dh).astype("float32")
+        mask = np.tril(np.ones((L, L), "float32"))
+        sm = sparse.to_sparse_coo(paddle.to_tensor(mask))
+        out = sparse.nn.functional.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k),
+            paddle.to_tensor(v), sm)
+        logits = (q @ k.T) / np.sqrt(Dh)
+        logits[mask == 0] = -np.inf
+        probs = np.exp(logits - logits.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out.numpy(), probs @ v, rtol=2e-4,
+                                   atol=2e-5)
+
+    def test_unary_zoo_and_divide_mv(self):
+        import paddle_tpu.sparse as sparse
+        m = np.array([[0.5, 0, -0.25], [0, 0.75, 0]], "float32")
+        s = sparse.to_sparse_coo(paddle.to_tensor(m))
+        np.testing.assert_allclose(
+            sparse.sin(s).to_dense().numpy(), np.sin(m) * (m != 0),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            sparse.square(s).to_dense().numpy(), m * m, rtol=1e-5)
+        np.testing.assert_allclose(
+            sparse.pow(s, 3).to_dense().numpy(), m ** 3, rtol=1e-5)
+        np.testing.assert_allclose(
+            sparse.neg(s).to_dense().numpy(), -m, rtol=1e-5)
+        d = sparse.divide(s, s).to_dense().numpy()
+        np.testing.assert_allclose(d, (m != 0).astype("float32"),
+                                   rtol=1e-5)
+        vec = np.array([1.0, 2.0, 3.0], "float32")
+        np.testing.assert_allclose(
+            sparse.mv(s, paddle.to_tensor(vec)).numpy(), m @ vec,
+            rtol=1e-5)
+        c = sparse.cast(s, value_dtype="float64")
+        assert str(c.dtype).endswith("float64") or "float64" in str(
+            c.dtype) or c.to_dense().numpy().dtype == np.float32

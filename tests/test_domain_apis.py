@@ -276,3 +276,95 @@ class TestGeometric:
         with pytest.raises(ValueError, match="num_segments"):
             g(paddle.to_tensor(np.ones((2, 2), "float32")),
               paddle.to_tensor(np.array([0, 1], "int64")))
+
+
+class TestTransformsLongTail:
+    """The remaining reference transforms (transform.py:496 Chain, :670
+    Independent, :765 Power, :829 Reshape, :996 Softmax, :1052 Stack,
+    :1172 StickBreaking, :1238 Tanh)."""
+
+    def _num_fldj(self, t, x, eps=1e-4):
+        # scalar-elementwise transforms: diagonal jacobian via finite diff
+        f = lambda a: t.forward(paddle.to_tensor(a)).numpy()
+        return np.log(np.abs((f(x + eps) - f(x - eps)) / (2 * eps)))
+
+    def test_tanh_round_trip_and_fldj(self):
+        from paddle_tpu.distribution import TanhTransform
+        t = TanhTransform()
+        x = np.linspace(-2, 2, 7).astype("float32")
+        y = t.forward(paddle.to_tensor(x))
+        np.testing.assert_allclose(t.inverse(y).numpy(), x, rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(
+            t.forward_log_det_jacobian(paddle.to_tensor(x)).numpy(),
+            self._num_fldj(t, x), rtol=1e-2, atol=1e-3)
+
+    def test_power_round_trip_and_fldj(self):
+        from paddle_tpu.distribution import PowerTransform
+        t = PowerTransform(2.0)
+        x = np.linspace(0.5, 3, 6).astype("float32")
+        y = t.forward(paddle.to_tensor(x))
+        np.testing.assert_allclose(y.numpy(), x ** 2, rtol=1e-5)
+        np.testing.assert_allclose(t.inverse(y).numpy(), x, rtol=1e-5)
+        np.testing.assert_allclose(
+            t.forward_log_det_jacobian(paddle.to_tensor(x)).numpy(),
+            np.log(2 * x), rtol=1e-5)
+
+    def test_chain_composes(self):
+        from paddle_tpu.distribution import (ChainTransform,
+                                             AffineTransform,
+                                             ExpTransform)
+        t = ChainTransform([AffineTransform(1.0, 2.0), ExpTransform()])
+        x = np.array([0.0, 0.5], "float32")
+        np.testing.assert_allclose(
+            t.forward(paddle.to_tensor(x)).numpy(),
+            np.exp(1.0 + 2.0 * x), rtol=1e-5)
+        np.testing.assert_allclose(
+            t.inverse(t.forward(paddle.to_tensor(x))).numpy(), x,
+            rtol=1e-5)
+        # fldj = log2 + (1 + 2x)
+        np.testing.assert_allclose(
+            t.forward_log_det_jacobian(paddle.to_tensor(x)).numpy(),
+            np.log(2.0) + 1.0 + 2.0 * x, rtol=1e-5)
+
+    def test_reshape_and_independent(self):
+        from paddle_tpu.distribution import (ReshapeTransform,
+                                             IndependentTransform,
+                                             ExpTransform)
+        r = ReshapeTransform((6,), (2, 3))
+        x = np.arange(12, dtype="float32").reshape(2, 6)
+        y = r.forward(paddle.to_tensor(x))
+        assert y.shape == [2, 2, 3]
+        np.testing.assert_allclose(r.inverse(y).numpy(), x)
+        it = IndependentTransform(ExpTransform(), 1)
+        xi = np.array([[0.0, 1.0], [2.0, 3.0]], "float32")
+        ld = it.forward_log_det_jacobian(paddle.to_tensor(xi))
+        np.testing.assert_allclose(ld.numpy(), xi.sum(-1), rtol=1e-5)
+
+    def test_softmax_and_stack(self):
+        from paddle_tpu.distribution import (SoftmaxTransform,
+                                             StackTransform,
+                                             ExpTransform, AbsTransform)
+        s = SoftmaxTransform()
+        x = np.array([[1.0, 2.0, 3.0]], "float32")
+        y = s.forward(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(y.sum(-1), 1.0, rtol=1e-5)
+        st = StackTransform([ExpTransform(), AbsTransform()], axis=0)
+        xs = np.array([[0.0, 1.0], [-2.0, 2.0]], "float32")
+        out = st.forward(paddle.to_tensor(xs)).numpy()
+        np.testing.assert_allclose(out[0], np.exp(xs[0]), rtol=1e-5)
+        np.testing.assert_allclose(out[1], np.abs(xs[1]), rtol=1e-5)
+
+    def test_stick_breaking_simplex_and_round_trip(self):
+        from paddle_tpu.distribution import StickBreakingTransform
+        t = StickBreakingTransform()
+        x = np.array([[0.3, -0.8, 1.2], [0.0, 0.0, 0.0]], "float32")
+        y = t.forward(paddle.to_tensor(x)).numpy()
+        assert y.shape == (2, 4)
+        assert (y > 0).all()
+        np.testing.assert_allclose(y.sum(-1), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(
+            t.inverse(paddle.to_tensor(y)).numpy(), x, rtol=1e-3,
+            atol=1e-4)
+        ld = t.forward_log_det_jacobian(paddle.to_tensor(x))
+        assert ld.shape == [2] and np.isfinite(ld.numpy()).all()

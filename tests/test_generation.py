@@ -152,3 +152,37 @@ class TestDecodeCachePrimitives:
             outs.append(o.numpy())
         inc = np.concatenate(outs, axis=1)
         np.testing.assert_allclose(inc, full, rtol=3e-5, atol=3e-5)
+
+    def test_decode_cache_respects_padding_mask(self):
+        """Code-review regression: attn_mask must not be dropped on the
+        DecodeCache path (batched decode with padded prompts)."""
+        from paddle_tpu.nn.layer.transformer import MultiHeadAttention
+        paddle.seed(2)
+        mha = MultiHeadAttention(16, 4)
+        mha.eval()
+        rng = np.random.default_rng(7)
+        L = 4
+        x = rng.standard_normal((2, L, 16)).astype(np.float32)
+        # key-padding mask over the cache axis: batch row 1 masks
+        # positions 2..3
+        pad = np.ones((2, 1, 1, L), bool)
+        pad[1, :, :, 2:] = False
+        causal = np.tril(np.ones((1, 1, L, L), bool))
+        full_mask = causal & pad
+        want = mha(paddle.to_tensor(x),
+                   attn_mask=paddle.to_tensor(full_mask)).numpy()
+        cache = mha.gen_decode_cache(2, L, dtype=np.float32)
+        outs = []
+        for i in range(L):
+            o, _, cache2 = (lambda r: (r[0], None, r[-1]))(
+                mha(paddle.to_tensor(x[:, i:i + 1]),
+                    attn_mask=paddle.to_tensor(pad), cache=cache))
+            cache = cache2
+            outs.append(o.numpy())
+        inc = np.concatenate(outs, axis=1)
+        # masked positions' queries are garbage (they attend nothing
+        # valid in `want` too) — compare only valid query positions
+        np.testing.assert_allclose(inc[0], want[0], rtol=3e-5,
+                                   atol=3e-5)
+        np.testing.assert_allclose(inc[1, :2], want[1, :2], rtol=3e-5,
+                                   atol=3e-5)

@@ -1,0 +1,229 @@
+"""paddle.text.datasets parity — parsers exercised on tiny synthetic
+archives in the EXACT reference formats (aclImdb tar, PTB
+simple-examples tar, ml-1m zip, 14-col housing text, conll05st tar,
+wmt14/wmt16 tars). Reference: python/paddle/text/datasets/*.py.
+"""
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text.datasets import (Imdb, Imikolov, Movielens,
+                                      UCIHousing, Conll05st, WMT14,
+                                      WMT16)
+
+
+def _add_bytes(tf, name, data):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+@pytest.fixture()
+def imdb_tar(tmp_path):
+    p = tmp_path / "aclImdb_v1.tar.gz"
+    with tarfile.open(p, "w:gz") as tf:
+        docs = {
+            "aclImdb/train/pos/0.txt": b"great movie great fun",
+            "aclImdb/train/pos/1.txt": b"great acting, great plot!",
+            "aclImdb/train/neg/0.txt": b"bad movie bad bad",
+            "aclImdb/test/pos/0.txt": b"great fun",
+            "aclImdb/test/neg/0.txt": b"bad plot",
+        }
+        for name, data in docs.items():
+            _add_bytes(tf, name, data)
+    return str(p)
+
+
+class TestImdb:
+    def test_vocab_and_samples(self, imdb_tar):
+        ds = Imdb(data_file=imdb_tar, mode="train", cutoff=1)
+        # words with freq > 1 across the whole corpus (punctuation
+        # stripped, lowercased): great(6), bad(5), movie(2), fun(2),
+        # plot(2)
+        assert set(ds.word_idx) == {b"great", b"bad", b"movie", b"fun",
+                                    b"plot", b"<unk>"}
+        assert len(ds) == 3  # 2 pos + 1 neg train docs
+        doc, label = ds[0]
+        assert doc.dtype.kind == "i" and label.shape == (1,)
+        labels = sorted(int(ds[i][1][0]) for i in range(len(ds)))
+        assert labels == [0, 0, 1]
+
+    def test_no_download_raises(self):
+        with pytest.raises(RuntimeError, match="no network egress"):
+            Imdb()
+
+
+@pytest.fixture()
+def ptb_tar(tmp_path):
+    p = tmp_path / "simple-examples.tgz"
+    train = b"the cat sat\nthe dog sat\nthe cat ran\n"
+    valid = b"the dog ran\n"
+    with tarfile.open(p, "w:gz") as tf:
+        _add_bytes(tf, "./simple-examples/data/ptb.train.txt", train)
+        _add_bytes(tf, "./simple-examples/data/ptb.valid.txt", valid)
+    return str(p)
+
+
+class TestImikolov:
+    def test_ngram_windows(self, ptb_tar):
+        ds = Imikolov(data_file=ptb_tar, data_type="NGRAM",
+                      window_size=2, mode="train", min_word_freq=1)
+        # freq>1: the(4), cat(2), sat(2), dog(2), ran(2), <s>(4), <e>(4)
+        assert b"the" in ds.word_idx and b"<unk>" in ds.word_idx
+        assert len(ds) > 0
+        sample = ds[0]
+        assert len(sample) == 2  # bigram window
+        assert all(s.dtype.kind == "i" for s in sample)
+
+    def test_seq_mode(self, ptb_tar):
+        ds = Imikolov(data_file=ptb_tar, data_type="SEQ", mode="valid",
+                      min_word_freq=1)
+        src, trg = ds[0]
+        assert src[0] == ds.word_idx[b"<s>"]
+        assert trg[-1] == ds.word_idx[b"<e>"]
+        np.testing.assert_array_equal(src[1:], trg[:-1])
+
+
+@pytest.fixture()
+def ml1m_zip(tmp_path):
+    p = tmp_path / "ml-1m.zip"
+    movies = ("1::Toy Story (1995)::Animation|Comedy\n"
+              "2::Jumanji (1995)::Adventure\n").encode("latin-1")
+    users = ("1::M::25::12::55117\n"
+             "2::F::30::7::02139\n").encode("latin-1")
+    ratings = ("1::1::5::978300760\n"
+               "1::2::3::978302109\n"
+               "2::1::4::978301968\n").encode("latin-1")
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("ml-1m/movies.dat", movies)
+        z.writestr("ml-1m/users.dat", users)
+        z.writestr("ml-1m/ratings.dat", ratings)
+    return str(p)
+
+
+class TestMovielens:
+    def test_fields(self, ml1m_zip):
+        ds = Movielens(data_file=ml1m_zip, mode="train",
+                       test_ratio=0.0)
+        assert len(ds) == 3
+        s = ds[0]
+        # usr(4 fields) + mov(3 fields) + rating
+        assert len(s) == 8
+        uid, gender, age, job = s[0], s[1], s[2], s[3]
+        assert uid.shape == (1,) and gender[0] in (0, 1)
+        rating = s[-1]
+        assert -5.0 <= float(rating[0]) <= 5.0
+
+
+class TestUCIHousing:
+    def test_split_and_normalization(self, tmp_path):
+        rs = np.random.RandomState(0)
+        data = rs.rand(20, 14) * 10
+        f = tmp_path / "housing.data"
+        with open(f, "w") as fh:
+            for row in data:
+                fh.write(" ".join(f"{v:.6f}" for v in row) + "\n")
+        train = UCIHousing(data_file=str(f), mode="train")
+        test = UCIHousing(data_file=str(f), mode="test")
+        assert len(train) == 16 and len(test) == 4
+        feat, target = train[0]
+        assert feat.shape == (13,) and target.shape == (1,)
+        # features normalized, target untouched
+        assert np.abs(feat).max() <= 1.0
+        np.testing.assert_allclose(float(target[0]), data[0, -1],
+                                   rtol=1e-5)
+
+
+@pytest.fixture()
+def conll_fixture(tmp_path):
+    words = b"The\ncat\nsat\n\n"
+    props = b"-   (A0*\n-   *)\nsit (V*V)\n\n"
+    wbuf, pbuf = io.BytesIO(), io.BytesIO()
+    with gzip.GzipFile(fileobj=wbuf, mode="w") as g:
+        g.write(words)
+    with gzip.GzipFile(fileobj=pbuf, mode="w") as g:
+        g.write(props)
+    p = tmp_path / "conll05st-tests.tar.gz"
+    with tarfile.open(p, "w:gz") as tf:
+        _add_bytes(tf,
+                   "conll05st-release/test.wsj/words/test.wsj.words.gz",
+                   wbuf.getvalue())
+        _add_bytes(tf,
+                   "conll05st-release/test.wsj/props/test.wsj.props.gz",
+                   pbuf.getvalue())
+    wd = tmp_path / "words.dict"
+    wd.write_text("The\ncat\nsat\n")
+    vd = tmp_path / "verbs.dict"
+    vd.write_text("sit\n")
+    td = tmp_path / "targets.dict"
+    td.write_text("B-A0\nI-A0\nB-V\nI-V\nO\n")
+    return str(p), str(wd), str(vd), str(td)
+
+
+class TestConll05st:
+    def test_srl_fields(self, conll_fixture):
+        data, wd, vd, td = conll_fixture
+        ds = Conll05st(data_file=data, word_dict_file=wd,
+                       verb_dict_file=vd, target_dict_file=td)
+        assert len(ds) == 1
+        s = ds[0]
+        assert len(s) == 9  # word,5xctx,pred,mark,label
+        word_idx, mark, label_idx = s[0], s[7], s[8]
+        assert word_idx.shape == (3,)
+        assert mark.tolist().count(1) >= 1
+        wdict, vdict, ldict = ds.get_dict()
+        assert "B-V" in ldict and "O" in ldict
+
+
+@pytest.fixture()
+def wmt14_tar(tmp_path):
+    p = tmp_path / "wmt14.tgz"
+    src_dict = b"<s>\n<e>\n<unk>\nhello\nworld\n"
+    trg_dict = b"<s>\n<e>\n<unk>\nbonjour\nmonde\n"
+    pairs = b"hello world\tbonjour monde\nhello\tbonjour\n"
+    with tarfile.open(p, "w:gz") as tf:
+        _add_bytes(tf, "data/src.dict", src_dict)
+        _add_bytes(tf, "data/trg.dict", trg_dict)
+        _add_bytes(tf, "train/train", pairs)
+    return str(p)
+
+
+class TestWMT14:
+    def test_ids(self, wmt14_tar):
+        ds = WMT14(data_file=wmt14_tar, mode="train", dict_size=5)
+        assert len(ds) == 2
+        src, trg, trg_next = ds[0]
+        assert src[0] == ds.src_dict["<s>"]
+        assert src[-1] == ds.src_dict["<e>"]
+        assert trg[0] == ds.trg_dict["<s>"]
+        assert trg_next[-1] == ds.trg_dict["<e>"]
+        np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+
+
+@pytest.fixture()
+def wmt16_tar(tmp_path):
+    p = tmp_path / "wmt16.tar.gz"
+    train = b"hello world\thallo welt\nhello\thallo\n"
+    test = b"world\twelt\n"
+    with tarfile.open(p, "w:gz") as tf:
+        _add_bytes(tf, "wmt16/train", train)
+        _add_bytes(tf, "wmt16/test", test)
+        _add_bytes(tf, "wmt16/val", test)
+    return str(p)
+
+
+class TestWMT16:
+    def test_dict_built_from_train(self, wmt16_tar):
+        ds = WMT16(data_file=wmt16_tar, mode="test", src_dict_size=6,
+                   trg_dict_size=6, lang="en")
+        assert ds.src_dict["<s>"] == 0 and ds.src_dict["<e>"] == 1
+        assert "hello" in ds.src_dict and "hallo" in ds.trg_dict
+        src, trg, trg_next = ds[0]
+        assert src[0] == 0 and src[-1] == 1
+        rev = ds.get_dict("en", reverse=True)
+        assert rev[0] == "<s>"
