@@ -52,7 +52,7 @@ def main():
                         max_position_embeddings=1024,
                         hidden_dropout_prob=0.0,
                         attention_probs_dropout_prob=0.0)
-        batch, seqlen, iters, warmup = 8, 1024, 20, 3
+        batch, seqlen, iters, warmup = 16, 1024, 20, 3
     else:  # CPU smoke numbers
         cfg = GPTConfig(vocab_size=2048, hidden_size=256,
                         num_hidden_layers=4, num_attention_heads=8,

@@ -11,5 +11,6 @@ rest — same API, same math, no hand-written kernel zoo.
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import autograd  # noqa: F401
+from . import autotune  # noqa: F401
 
 __all__ = ["nn", "optimizer", "autograd"]

@@ -1,0 +1,91 @@
+"""paddle.incubate.autotune parity: runtime kernel autotuning.
+
+Reference: python/paddle/incubate/autotune.py set_config over
+phi/kernels/autotune/ (cached conv-algo search + switch_autotune.cc)
+and imperative/layout_autotune.cc.
+
+TPU mapping:
+- kernel: REAL — the Pallas flash-attention kernel's (block_q, block_k)
+  tiling is swept per input signature on its first eager call and the
+  winner is cached (the analogue of the reference's per-shape conv-algo
+  cache). Compiled programs reuse whatever the cache holds at trace
+  time.
+- layout: accepted, no-op — XLA's layout assignment already picks
+  MXU-friendly layouts (the reference flips NCHW/NHWC for tensor cores
+  by hand).
+- dataloader: accepted, no-op — worker-count tuning is a host-side CPU
+  heuristic; set num_workers explicitly.
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["set_config", "get_config", "kernel_blocks_for"]
+
+_config = {
+    "kernel": {"enable": False, "tuning_range": [1, 3]},
+    "layout": {"enable": False},
+    "dataloader": {"enable": False},
+}
+_kernel_cache: dict = {}
+
+
+def set_config(config=None):
+    """reference: incubate/autotune.py:24 set_config(config=None).
+    config: dict (or path to a json file) with optional "kernel",
+    "layout", "dataloader" sections; None enables everything."""
+    global _config
+    if config is None:
+        for sec in _config.values():
+            sec["enable"] = True
+        return
+    if isinstance(config, str):
+        import json
+        with open(config) as f:
+            config = json.load(f)
+    for key in ("kernel", "layout", "dataloader"):
+        if key in config:
+            _config[key].update(config[key])
+
+
+def get_config():
+    return {k: dict(v) for k, v in _config.items()}
+
+
+def _candidates(lq, lk):
+    """Tiling sweep, capped at the padded sequence lengths."""
+    cands = [(256, 512), (512, 512), (512, 1024), (1024, 1024),
+             (256, 1024)]
+    out = []
+    for bq, bk in cands:
+        pair = (min(bq, max(128, -(-lq // 128) * 128)),
+                min(bk, max(128, -(-lk // 128) * 128)))
+        if pair not in out:
+            out.append(pair)
+    return out
+
+
+def kernel_blocks_for(sig, measure=None):
+    """Best (block_q, block_k) for an attention signature, or None when
+    autotune is off / nothing cached. `measure(bq, bk) -> seconds`
+    runs one timed call; only eager callers pass it (a traced call
+    cannot time, it just reuses the cache)."""
+    if not _config["kernel"]["enable"]:
+        return None
+    if sig in _kernel_cache or measure is None:
+        # a failed sweep caches None — fail over once, don't re-sweep
+        return _kernel_cache.get(sig)
+    lq, lk = sig[1], sig[2]
+    reps = max(1, int(_config["kernel"].get("tuning_range",
+                                            [1, 3])[-1]) - 1)
+    best, best_dt = None, float("inf")
+    for bq, bk in _candidates(lq, lk):
+        try:
+            measure(bq, bk)  # compile + warm
+            dt = min(measure(bq, bk) for _ in range(reps))
+        except Exception:
+            continue
+        if dt < best_dt:
+            best, best_dt = (bq, bk), dt
+    _kernel_cache[sig] = best
+    return best

@@ -86,16 +86,17 @@ def _mask_to_kernel_operands(mask, B, H, Lq, Lk):
 
 
 def _sdpa_impl(q, k, v, mask, key, causal, scale, dropout_p,
-               mask_trainable=False):
+               mask_trainable=False, block_q=None, block_k=None):
     """Unified route: Pallas flash kernel whenever the device/head-dim
     support it — including padding masks, additive bias, and dropout
     (in-kernel position-hash mask) — else the XLA reference. A
     TRAINABLE mask needs real bias gradients, which the kernel does not
-    produce — that case stays on the reference path."""
+    produce — that case stays on the reference path. block_q/block_k
+    override the kernel tiling (set by incubate.autotune)."""
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
     if _use_pallas(Lq, D) and not (mask_trainable and mask is not None):
-        from ...ops.pallas.flash_attention import flash_attention_blhd
+        from ...ops.pallas import flash_attention as fa
         bias = kvec = None
         ok = True
         if mask is not None:
@@ -111,28 +112,65 @@ def _sdpa_impl(q, k, v, mask, key, causal, scale, dropout_p,
             if dropout_p > 0.0 and key is not None:
                 seeds = jax.lax.bitcast_convert_type(
                     key.reshape(-1)[:2], jnp.int32)
-            return flash_attention_blhd(
+            return fa.flash_attention_blhd(
                 q, k, v, bias, kvec, seeds, causal=causal, scale=scale,
-                dropout_p=float(dropout_p) if seeds is not None else 0.0)
+                dropout_p=float(dropout_p) if seeds is not None else 0.0,
+                block_q=block_q or fa.DEFAULT_BLOCK_Q,
+                block_k=block_k or fa.DEFAULT_BLOCK_K)
     return _sdpa_ref(q, k, v, mask, causal, scale, dropout_p, key)
 
 
 register_op("sdpa",
-            lambda q, k, v, causal, scale, dropout_p:
-            _sdpa_impl(q, k, v, None, None, causal, scale, dropout_p))
+            lambda q, k, v, causal, scale, dropout_p, block_q=None,
+            block_k=None:
+            _sdpa_impl(q, k, v, None, None, causal, scale, dropout_p,
+                       block_q=block_q, block_k=block_k))
 register_op("sdpa_mask",
             lambda q, k, v, mask, causal, scale, dropout_p,
-            mask_trainable=False:
+            mask_trainable=False, block_q=None, block_k=None:
             _sdpa_impl(q, k, v, mask, None, causal, scale, dropout_p,
-                       mask_trainable))
+                       mask_trainable, block_q=block_q,
+                       block_k=block_k))
 register_op("sdpa_dropout",
-            lambda q, k, v, key, causal, scale, dropout_p:
-            _sdpa_impl(q, k, v, None, key, causal, scale, dropout_p))
+            lambda q, k, v, key, causal, scale, dropout_p, block_q=None,
+            block_k=None:
+            _sdpa_impl(q, k, v, None, key, causal, scale, dropout_p,
+                       block_q=block_q, block_k=block_k))
 register_op("sdpa_mask_dropout",
             lambda q, k, v, mask, key, causal, scale, dropout_p,
-            mask_trainable=False:
+            mask_trainable=False, block_q=None, block_k=None:
             _sdpa_impl(q, k, v, mask, key, causal, scale, dropout_p,
-                       mask_trainable))
+                       mask_trainable, block_q=block_q,
+                       block_k=block_k))
+
+
+def _autotuned_blocks(q, k, attrs):
+    """Consult the incubate.autotune kernel cache for this signature;
+    on an eager call with an empty cache, run the timing sweep (a
+    traced call only reuses whatever the cache holds)."""
+    from ...incubate import autotune as at
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    if not _use_pallas(Lq, D):
+        return None
+    sig = (B, Lq, Lk, H, D, str(q._value.dtype), attrs["causal"])
+    # never sweep while a static Program records (the timing calls would
+    # be captured as dead program nodes) or under a trace
+    from ...static import in_static_mode
+    eager = not isinstance(q._value, jax.core.Tracer) and \
+        not in_static_mode()
+
+    def measure(bq, bk):
+        import time
+        a = dict(attrs, block_q=bq, block_k=bk)
+        out = apply_op("sdpa", q, k, k, attrs=a)  # v=k: same shapes
+        out._value.block_until_ready()
+        t0 = time.perf_counter()
+        out = apply_op("sdpa", q, k, k, attrs=a)
+        out._value.block_until_ready()
+        return time.perf_counter() - t0
+
+    return at.kernel_blocks_for(sig, measure if eager else None)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
@@ -143,6 +181,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     scale = 1.0 / math.sqrt(q.shape[-1])
     p = float(dropout_p) if training else 0.0
     attrs = dict(causal=bool(is_causal), scale=scale, dropout_p=p)
+    blocks = _autotuned_blocks(q, k, attrs)
+    if blocks is not None:
+        attrs["block_q"], attrs["block_k"] = blocks
     if attn_mask is None and p == 0.0:
         return apply_op("sdpa", q, k, v, attrs=attrs)
     if attn_mask is None:
@@ -189,7 +230,92 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
-def sparse_attention(*args, **kwargs):
-    raise NotImplementedError(
-        "block-sparse attention: planned as a Pallas kernel "
-        "(reference: python/paddle/nn/functional/sparse_attention.py)")
+def _sparse_attention_fwd(q, k, v, rows, cols, kpm, am, scale):
+    """CSR-pattern attention: scores computed ONLY at (rows, cols)
+    coordinates, softmax over each query row's stored entries, scatter
+    back through V. q/k/v [B,H,L,D]; rows/cols [B,H,nnz] int32;
+    kpm [B,L] additive or None; am [L,L] additive or None."""
+    B, H, L, D = q.shape
+    nnz = rows.shape[-1]
+    qg = jnp.take_along_axis(q, rows[..., None], axis=2)   # [B,H,nnz,D]
+    kg = jnp.take_along_axis(k, cols[..., None], axis=2)
+    vg = jnp.take_along_axis(v, cols[..., None], axis=2)
+    s = (qg.astype(jnp.float32) * kg.astype(jnp.float32)).sum(-1) * scale
+    if kpm is not None:
+        s = s + jnp.take_along_axis(
+            jnp.broadcast_to(kpm[:, None, :].astype(jnp.float32),
+                             (B, H, L)), cols, axis=2)
+    if am is not None:
+        s = s + am.astype(jnp.float32)[rows, cols]
+    # segment softmax per (b, h, query-row)
+    bh = jnp.arange(B * H, dtype=jnp.int32).reshape(B, H, 1)
+    seg = (bh * L + rows).reshape(-1)
+    flat = s.reshape(-1)
+    n_seg = B * H * L
+    mx = jax.ops.segment_max(flat, seg, num_segments=n_seg)
+    e = jnp.exp(flat - mx[seg])
+    z = jax.ops.segment_sum(e, seg, num_segments=n_seg)
+    probs = (e / jnp.maximum(z[seg], 1e-30)).astype(q.dtype)
+    weighted = probs.reshape(B, H, nnz, 1) * vg
+    out = jnp.zeros_like(q)
+    b_idx = jnp.arange(B).reshape(B, 1, 1)
+    h_idx = jnp.arange(H).reshape(1, H, 1)
+    bb = jnp.broadcast_to(b_idx, (B, H, nnz))
+    hh = jnp.broadcast_to(h_idx, (B, H, nnz))
+    return out.at[bb, hh, rows].add(weighted)
+
+
+from ...core.dispatch import OpDef  # noqa: E402
+
+register_op("sparse_attention", _sparse_attention_fwd)
+# module-level OpDefs: a fresh lambda per call would defeat the jit cache
+_SPARSE_ATTN_OPS = {
+    "kpm": OpDef("sparse_attention_kpm",
+                 lambda q, k, v, r, c, m, scale:
+                 _sparse_attention_fwd(q, k, v, r, c, m, None, scale)),
+    "am": OpDef("sparse_attention_am",
+                lambda q, k, v, r, c, m, scale:
+                _sparse_attention_fwd(q, k, v, r, c, None, m, scale)),
+    "plain": OpDef("sparse_attention_plain",
+                   lambda q, k, v, r, c, scale:
+                   _sparse_attention_fwd(q, k, v, r, c, None, None,
+                                         scale)),
+}
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """paddle.nn.functional.sparse_attention parity (reference:
+    python/paddle/nn/functional/sparse_attention.py over the CUDA 11.3
+    block-sparse kernel). The attention matrix is evaluated only at the
+    CSR pattern's coordinates — an SDDMM + row-segment softmax + SpMM
+    pipeline on TPU. offset [B,H,L+1] int32, columns [B,H,nnz] int32."""
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    B, H, L, D = q.shape
+    off = np.asarray(sparse_csr_offset._value
+                     if isinstance(sparse_csr_offset, Tensor)
+                     else sparse_csr_offset).astype(np.int64)
+    cols = as_tensor(sparse_csr_columns).astype("int32")
+    counts = np.diff(off, axis=-1)                       # [B,H,L]
+    nnz = int(counts.sum(axis=-1).max())
+    if not (counts.sum(axis=-1) == nnz).all():
+        raise ValueError("sparse_attention: every (batch, head) must "
+                         "hold the same nnz (fixed CSR columns width)")
+    rows = np.repeat(
+        np.tile(np.arange(L, dtype=np.int32), B * H),
+        counts.reshape(-1)).reshape(B, H, nnz)
+    scale = 1.0 / math.sqrt(D)
+    args = [q, k, v, Tensor(jnp.asarray(rows)), cols]
+    attrs = dict(scale=scale)
+    if key_padding_mask is not None and attn_mask is not None:
+        return apply_op("sparse_attention", *args,
+                        as_tensor(key_padding_mask),
+                        as_tensor(attn_mask), attrs=attrs)
+    if key_padding_mask is not None:
+        return apply_op(_SPARSE_ATTN_OPS["kpm"], *args,
+                        as_tensor(key_padding_mask), attrs=attrs)
+    if attn_mask is not None:
+        return apply_op(_SPARSE_ATTN_OPS["am"], *args,
+                        as_tensor(attn_mask), attrs=attrs)
+    return apply_op(_SPARSE_ATTN_OPS["plain"], *args, attrs=attrs)

@@ -310,17 +310,28 @@ def pow(x, factor, name=None):  # noqa: A001
     return _values_map(x, "sparse_pow_values", factor=float(factor))
 
 
+register_op("sparse_cast_values",
+            lambda v, dt: v.astype(dt))
+
+
 def cast(x, index_dtype=None, value_dtype=None, name=None):
-    """reference: sparse/unary.py cast."""
+    """reference: sparse/unary.py cast. The value cast is a registered
+    op, so it stays differentiable (grads reach x.values()) like the
+    rest of the unary zoo."""
     from ..core import dtype as dtypes
-    bcoo = x._bcoo
-    idx = bcoo.indices
+    idx = x._bcoo.indices
     if index_dtype is not None:
         idx = idx.astype(dtypes.to_np_dtype(index_dtype))
-    data = bcoo.data
     if value_dtype is not None:
-        data = data.astype(dtypes.to_np_dtype(value_dtype))
-    return SparseCooTensor(jsparse.BCOO((data, idx), shape=bcoo.shape))
+        vals = apply_op("sparse_cast_values", x.values(),
+                        attrs=dict(dt=np.dtype(
+                            dtypes.to_np_dtype(value_dtype)).name))
+        return SparseCooTensor(
+            jsparse.BCOO((vals._value, idx), shape=x._bcoo.shape),
+            values_tensor=vals)
+    return SparseCooTensor(jsparse.BCOO((x._bcoo.data, idx),
+                                        shape=x._bcoo.shape),
+                           values_tensor=x._values_t)
 
 
 def divide(x, y, name=None):

@@ -76,9 +76,21 @@ def _conv_impl(x, weight, bias, stride, padding, dilation, subm):
     if subm:
         out_idx = x._bcoo.indices  # submanifold: pattern preserved
     else:
-        mags = np.abs(np.asarray(
-            jax.lax.stop_gradient(dense._value))).sum(axis=-1)
-        out_idx = jnp.asarray(np.argwhere(mags != 0).astype(np.int32))
+        # pattern from GEOMETRY (which output sites any input coordinate
+        # reaches), not from values — an exactly-zero windowed sum or a
+        # zero-initialized weight must still produce a stored site (the
+        # reference rulebook semantics)
+        idx_np = np.asarray(x._bcoo.indices)
+        n, d_, h_, w_ = (int(s) for s in x.shape[:4])
+        occ = np.zeros((n, d_, h_, w_, 1), np.float32)
+        occ[idx_np[:, 0], idx_np[:, 1], idx_np[:, 2], idx_np[:, 3]] = 1.0
+        kshape = tuple(int(s) for s in w.shape[:3])
+        ones = np.ones(kshape + (1, 1), np.float32)
+        reach = np.asarray(jax.lax.conv_general_dilated(
+            jnp.asarray(occ), jnp.asarray(ones), stride,
+            [(p, p) for p in padding], rhs_dilation=dilation,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC")))[..., 0]
+        out_idx = jnp.asarray(np.argwhere(reach > 0).astype(np.int32))
     vals = apply_op("sparse_gather4d", dense, Tensor(out_idx))
     if bias is not None:
         vals = apply_op("sparse_add_bias", vals, as_tensor(bias))

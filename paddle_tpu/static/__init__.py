@@ -63,6 +63,58 @@ class _Node:
         self.single = single
 
 
+def _jsonable(obj):
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, np.dtype):
+        return str(obj)
+    return obj
+
+
+class _OpView:
+    """Read-only OpDesc facade (reference: framework OpDesc bindings)."""
+
+    __slots__ = ("_node", "_prog")
+
+    def __init__(self, node, prog):
+        self._node = node
+        self._prog = prog
+
+    @property
+    def type(self):
+        return self._node.op.name
+
+    def attr(self, name):
+        return self._node.attrs.get(name)
+
+    def all_attrs(self):
+        return dict(self._node.attrs)
+
+    def _names(self, ids):
+        out = []
+        for i in ids:
+            t = self._prog._tensors.get(i)
+            out.append(t.name if t is not None and t.name else str(i))
+        return out
+
+    @property
+    def input_arg_names(self):
+        return self._names(self._node.in_ids)
+
+    @property
+    def output_arg_names(self):
+        return self._names(self._node.out_ids)
+
+    def __repr__(self):
+        return f"OpView({self.type})"
+
+
 class Program:
     """Recorded op DAG (reference: framework.py:5249 class Program —
     desc/blocks replaced by the node list; random_seed/clone kept)."""
@@ -196,8 +248,118 @@ class Program:
     def blocks(self):
         return [self]
 
+    @property
+    def ops(self):
+        """Op views for program inspection (reference:
+        program.global_block().ops over OpDesc): each has .type,
+        .attr(name)/.all_attrs(), .input_arg_names/.output_arg_names."""
+        return [_OpView(n, self) for n in self._nodes]
+
     def list_vars(self):
         return list(self._tensors.values())
+
+    # -- prune / serialization (reference: framework/prune.cc,
+    #    ProgramDesc serialize_to_string) --------------------------------
+    def _clone_with_nodes(self, nodes):
+        p = self.clone()
+        p._nodes = list(nodes)
+        p._runner_cache = {}
+        p._version += 1
+        return p
+
+    def prune(self, targets):
+        """Dead-op elimination: keep only ops on which the target
+        tensors depend (reference: framework/prune.cc Prune). targets:
+        Tensors (or names)."""
+        keep_ids = set()
+        for t in targets:
+            if isinstance(t, Tensor):
+                keep_ids.add(self._leaf_alias.get(id(t), id(t)))
+            else:
+                keep_ids.update(id(v) for v in self._tensors.values()
+                                if v.name == t)
+        needed = set(keep_ids)
+        kept = []
+        for n in reversed(self._nodes):
+            if any(o in needed for o in n.out_ids):
+                kept.append(n)
+                needed.update(n.in_ids)
+        return self._clone_with_nodes(reversed(kept))
+
+    def serialize(self, path):
+        """Persist the recorded program: op list (registry names +
+        attrs + tensor-id wiring) as JSON, leaf tensor values as npz.
+        Ops must be registry-registered (custom OpDef instances from
+        to_static cannot round-trip — export those via jit.save)."""
+        import json as _json
+        from ..core.dispatch import _OPS
+        for n in self._nodes:
+            if _OPS.get(n.op.name) is not n.op:
+                raise ValueError(
+                    f"cannot serialize non-registry op {n.op.name!r}; "
+                    f"use paddle.jit.save for traced programs")
+        feed_ids = list(self._feed_names.values())
+        leaf_ids = self._leaf_ids(feed_ids)
+        meta = {
+            "nodes": [{"op": n.op.name, "attrs": _jsonable(n.attrs),
+                       "in": n.in_ids, "out": n.out_ids,
+                       "single": n.single} for n in self._nodes],
+            "feeds": {k: v for k, v in self._feed_names.items()},
+            "feed_shapes": self._feed_shapes,
+            "leaf_ids": leaf_ids,
+            "names": {i: t.name for i, t in self._tensors.items()
+                      if t.name},
+        }
+        with open(str(path) + ".program.json", "w") as f:
+            _json.dump(meta, f)
+        np.savez(str(path) + ".program.npz",
+                 **{str(i): np.asarray(self._tensors[i]._value)
+                    for i in leaf_ids})
+
+    @staticmethod
+    def deserialize(path):
+        """Rebuild a Program serialized by .serialize(). Tensor ids are
+        remapped to fresh placeholder Tensors."""
+        import json as _json
+        from ..core.dispatch import get_op
+        with open(str(path) + ".program.json") as f:
+            meta = _json.load(f)
+        leaves = np.load(str(path) + ".program.npz")
+        p = Program()
+        id_map: dict[int, Tensor] = {}
+
+        def tensor_for(old_id, is_leaf):
+            old_id = int(old_id)
+            if old_id not in id_map:
+                if is_leaf and str(old_id) in leaves:
+                    t = Tensor(jnp.asarray(leaves[str(old_id)]),
+                               stop_gradient=True)
+                else:
+                    t = Tensor(jnp.zeros((), np.float32),
+                               stop_gradient=True)
+                t.name = meta["names"].get(str(old_id))
+                id_map[old_id] = t
+            return id_map[old_id]
+
+        for old in meta["leaf_ids"]:
+            tensor_for(old, True)
+        for name, old in meta["feeds"].items():
+            t = tensor_for(old, False)
+            p._register_feed(name, t)
+        p._feed_shapes = dict(meta["feed_shapes"])
+        for nd in meta["nodes"]:
+            for i in nd["in"]:
+                tensor_for(i, True)
+            for o in nd["out"]:
+                tensor_for(o, False)
+            p._nodes.append(_Node(
+                get_op(nd["op"]), dict(nd["attrs"]),
+                [id(id_map[int(i)]) for i in nd["in"]],
+                [id(id_map[int(o)]) for o in nd["out"]], nd["single"]))
+        for t in id_map.values():
+            p._tensors.setdefault(id(t), t)
+        p._version += 1
+        return p
 
     def __repr__(self):
         return (f"Program(nodes={len(self._nodes)}, "

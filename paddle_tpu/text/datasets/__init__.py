@@ -446,6 +446,7 @@ class WMT14(Dataset):
     START = "<s>"
     END = "<e>"
     UNK = "<unk>"
+    UNK_IDX = 2  # reference wmt14.py:37 — dicts start <s>,<e>,<unk>
 
     def __init__(self, data_file=None, mode="train", dict_size=-1,
                  download=True):
@@ -485,11 +486,11 @@ class WMT14(Dataset):
                     if len(parts) != 2:
                         continue
                     src_words = parts[0].split()
-                    src_ids = [self.src_dict.get(w, _UNK_IDX)
+                    src_ids = [self.src_dict.get(w, self.UNK_IDX)
                                for w in [self.START] + src_words +
                                [self.END]]
                     trg_words = parts[1].split()
-                    trg_ids = [self.trg_dict.get(w, _UNK_IDX)
+                    trg_ids = [self.trg_dict.get(w, self.UNK_IDX)
                                for w in trg_words]
                     if len(src_ids) > 80 or len(trg_ids) > 80:
                         continue
@@ -526,10 +527,8 @@ class WMT16(Dataset):
         self.data_file = data_file
         self.lang = lang
         assert src_dict_size > 0 and trg_dict_size > 0
-        self.src_dict_size = min(src_dict_size, 30000) \
-            if src_dict_size > 30000 else src_dict_size
-        self.trg_dict_size = min(trg_dict_size, 30000) \
-            if trg_dict_size > 30000 else trg_dict_size
+        self.src_dict_size = min(src_dict_size, 30000)
+        self.trg_dict_size = min(trg_dict_size, 30000)
         self.src_dict = self._build_dict(self.src_dict_size, lang)
         self.trg_dict = self._build_dict(
             self.trg_dict_size, "de" if lang == "en" else "en")
@@ -537,7 +536,6 @@ class WMT16(Dataset):
 
     def _build_dict(self, dict_size, lang):
         word_freq = collections.defaultdict(int)
-        col = 0 if lang == self.lang else 1
         src_col = 0 if self.lang == "en" else 1
         col = src_col if lang == self.lang else 1 - src_col
         with tarfile.open(self.data_file) as f:

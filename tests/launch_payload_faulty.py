@@ -1,0 +1,45 @@
+"""Fault-injection payload: a 2-process collective job where rank 1
+KILLS ITSELF (SIGKILL — no cleanup, the crash profile of an OOM or
+hardware fault) partway through the first attempt. The elastic wrapper
+must relaunch the whole pod with a fresh coordinator; the second attempt
+runs the collective to completion on both ranks.
+
+Reference scenario: fleet/elastic/manager.py fault watch + relaunch
+(tests there inject faults by killing pods)."""
+import os
+import re
+import signal
+import sys
+
+os.environ["XLA_FLAGS"] = re.sub(
+    r"--xla_force_host_platform_device_count=\d+", "",
+    os.environ.get("XLA_FLAGS", "")).strip()
+os.environ["PADDLE_TPU_FORCE_CPU_DEVICES"] = "1"
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+
+out_dir = sys.argv[1]
+attempt = int(os.environ.get("PADDLE_ELASTIC_RESTART", "0"))
+
+env = dist.init_parallel_env()
+rank = env.rank
+
+# both ranks do one real collective before the fault
+t = paddle.to_tensor(np.array([float(rank + 1)], "float32"))
+dist.all_reduce(t)
+assert float(t.numpy()[0]) == 3.0, t.numpy()
+
+if attempt == 0 and rank == 1:
+    os.kill(os.getpid(), signal.SIGKILL)  # die mid-job, no cleanup
+
+# second collective: on attempt 0 rank 0 hangs/errors here (peer is
+# dead) and the launcher tears the pod down; on attempt 1 it completes
+t2 = paddle.to_tensor(np.array([10.0 * (rank + 1)], "float32"))
+dist.all_reduce(t2)
+assert float(t2.numpy()[0]) == 30.0, t2.numpy()
+
+with open(os.path.join(out_dir, f"done_rank{rank}_a{attempt}"), "w") as f:
+    f.write("ok")

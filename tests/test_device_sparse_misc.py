@@ -539,3 +539,30 @@ class TestSparseNN:
         c = sparse.cast(s, value_dtype="float64")
         assert str(c.dtype).endswith("float64") or "float64" in str(
             c.dtype) or c.to_dense().numpy().dtype == np.float32
+
+    def test_conv3d_pattern_is_geometric_not_value_based(self):
+        """Zero-initialized weights + nonzero bias must still populate
+        every geometrically-reached site (code-review regression)."""
+        import paddle_tpu.sparse as sparse
+        import paddle_tpu.nn as nn
+        x, dense = self._rand_sparse_ndhwc(seed=6)
+        conv = sparse.nn.Conv3D(
+            3, 2, kernel_size=3, padding=1,
+            weight_attr=nn.ParamAttr(
+                initializer=nn.initializer.Constant(0.0)))
+        conv.bias.set_value(np.array([1.5, -2.5], "float32"))
+        out = conv(x)
+        assert out.nnz() > 0
+        vals = out.values().numpy()
+        np.testing.assert_allclose(
+            vals, np.tile([1.5, -2.5], (vals.shape[0], 1)), rtol=1e-6)
+
+    def test_cast_keeps_gradient(self):
+        import paddle_tpu.sparse as sparse
+        m = np.array([[1.0, 0.0], [0.0, 2.0]], "float32")
+        s = sparse.to_sparse_coo(paddle.to_tensor(m))
+        s.values().stop_gradient = False
+        c = sparse.cast(s, value_dtype="float32")
+        (c.values() * 3.0).sum().backward()
+        assert s.values().grad is not None
+        np.testing.assert_allclose(s.values().grad.numpy(), [3.0, 3.0])

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 import paddle_tpu  # noqa: F401  (device/x64 init)
+import paddle_tpu as paddle
 from paddle_tpu.ops.pallas import flash_attention as fa
 
 
@@ -201,3 +202,135 @@ class TestSdpaRouting:
         assert sm is not None and sm.shape == [2, 2, 16, 16]
         np.testing.assert_allclose(
             np.asarray(sm.numpy().sum(-1)), 1.0, rtol=1e-5)
+
+
+class TestSparseAttention:
+    """paddle.nn.functional.sparse_attention (reference:
+    python/paddle/nn/functional/sparse_attention.py — CSR-pattern
+    block-sparse attention, the CUDA 11.3 kernel's API)."""
+
+    def _csr_causal(self, B, H, L):
+        """Causal pattern as fixed-width CSR (every (b,h) same nnz)."""
+        rows = [i for i in range(L) for _ in range(i + 1)]
+        cols = [j for i in range(L) for j in range(i + 1)]
+        counts = [i + 1 for i in range(L)]
+        offset = np.concatenate([[0], np.cumsum(counts)]).astype("int32")
+        off = np.broadcast_to(offset, (B, H, L + 1)).copy()
+        col = np.broadcast_to(np.asarray(cols, "int32"),
+                              (B, H, len(cols))).copy()
+        return off, col, np.asarray(rows), np.asarray(cols)
+
+    def test_matches_dense_causal_softmax(self):
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(0)
+        B, H, L, D = 2, 3, 6, 8
+        q = rs.randn(B, H, L, D).astype("float32")
+        k = rs.randn(B, H, L, D).astype("float32")
+        v = rs.randn(B, H, L, D).astype("float32")
+        off, col, rows, cols = self._csr_causal(B, H, L)
+        out = F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k),
+            paddle.to_tensor(v), paddle.to_tensor(off),
+            paddle.to_tensor(col)).numpy()
+        logits = np.einsum("bhld,bhmd->bhlm", q, k) / np.sqrt(D)
+        mask = np.tril(np.ones((L, L), bool))
+        logits = np.where(mask, logits, -np.inf)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = np.einsum("bhlm,bhmd->bhld", p, v)
+        np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-5)
+
+    def test_key_padding_mask(self):
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(1)
+        B, H, L, D = 1, 2, 4, 4
+        q = rs.randn(B, H, L, D).astype("float32")
+        k = rs.randn(B, H, L, D).astype("float32")
+        v = rs.randn(B, H, L, D).astype("float32")
+        off, col, _, _ = self._csr_causal(B, H, L)
+        kpm = np.zeros((B, L), "float32")
+        kpm[:, 3] = -1e30  # key 3 masked out
+        out = F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k),
+            paddle.to_tensor(v), paddle.to_tensor(off),
+            paddle.to_tensor(col),
+            key_padding_mask=paddle.to_tensor(kpm)).numpy()
+        logits = np.einsum("bhld,bhmd->bhlm", q, k) / np.sqrt(D)
+        mask = np.tril(np.ones((L, L), bool))
+        logits = np.where(mask, logits, -np.inf)
+        logits[..., 3] = np.where(mask[:, 3], -1e30,
+                                  -np.inf)[None, None]
+        # row 3's only unmasked key... all keys up to 3 valid except 3
+        logits2 = np.einsum("bhld,bhmd->bhlm", q, k) / np.sqrt(D)
+        logits2 = np.where(mask, logits2, -np.inf) + kpm[:, None, None, :]
+        p = np.exp(logits2 - logits2.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = np.einsum("bhlm,bhmd->bhld", p, v)
+        np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-5)
+
+    def test_gradients_flow(self):
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(2)
+        B, H, L, D = 1, 1, 4, 4
+        q = paddle.to_tensor(rs.randn(B, H, L, D).astype("float32"),
+                             stop_gradient=False)
+        k = paddle.to_tensor(rs.randn(B, H, L, D).astype("float32"),
+                             stop_gradient=False)
+        v = paddle.to_tensor(rs.randn(B, H, L, D).astype("float32"),
+                             stop_gradient=False)
+        off, col, _, _ = self._csr_causal(B, H, L)
+        out = F.sparse_attention(q, k, v, paddle.to_tensor(off),
+                                 paddle.to_tensor(col))
+        out.sum().backward()
+        for t in (q, k, v):
+            assert t.grad is not None
+            assert np.isfinite(t.grad.numpy()).all()
+
+
+class TestKernelAutotune:
+    """incubate.autotune.set_config kernel tuning (reference:
+    python/paddle/incubate/autotune.py:24 over
+    phi/kernels/autotune/switch_autotune.cc) — per-signature
+    (block_q, block_k) sweep for the Pallas flash kernel."""
+
+    def test_config_roundtrip_and_cache(self):
+        from paddle_tpu.incubate import autotune as at
+        at.set_config({"kernel": {"enable": True,
+                                  "tuning_range": [1, 2]}})
+        cfg = at.get_config()
+        assert cfg["kernel"]["enable"] is True
+        calls = []
+
+        def measure(bq, bk):
+            calls.append((bq, bk))
+            return 0.01 if (bq, bk) == (256, 512) else 0.02
+
+        sig = (2, 1024, 1024, 4, 64, "bfloat16", True)
+        best = at.kernel_blocks_for(sig, measure)
+        assert best == (256, 512)
+        n = len(calls)
+        # cached: no re-measurement
+        assert at.kernel_blocks_for(sig, measure) == (256, 512)
+        assert len(calls) == n
+        # disabled -> None
+        at.set_config({"kernel": {"enable": False}})
+        assert at.kernel_blocks_for(sig, measure) is None
+
+    def test_sdpa_path_with_explicit_blocks_matches_default(self):
+        """block attrs thread through the sdpa ops without changing
+        numerics (CPU falls back to the reference path regardless)."""
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.ops._helpers import apply_op, as_tensor
+        rs = np.random.RandomState(0)
+        q = rs.randn(1, 8, 2, 16).astype("float32")
+        want = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q),
+            paddle.to_tensor(q), is_causal=True,
+            training=False).numpy()
+        got = apply_op("sdpa", as_tensor(paddle.to_tensor(q)),
+                       as_tensor(paddle.to_tensor(q)),
+                       as_tensor(paddle.to_tensor(q)),
+                       attrs=dict(causal=True, scale=0.25,
+                                  dropout_p=0.0, block_q=256,
+                                  block_k=512)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

@@ -204,6 +204,15 @@ class TestWMT14:
         assert trg_next[-1] == ds.trg_dict["<e>"]
         np.testing.assert_array_equal(trg[1:], trg_next[:-1])
 
+    def test_oov_maps_to_unk_not_start(self, wmt14_tar):
+        """code-review regression: reference UNK_IDX is 2 (<unk>)."""
+        ds = WMT14(data_file=wmt14_tar, mode="train", dict_size=4)
+        # dict_size=4 drops 'world'/'monde' -> OOV must be id 2
+        src, trg, _ = ds[0]
+        assert ds.src_dict["<unk>"] == 2
+        assert 2 in src.tolist()
+        assert 0 not in src.tolist()[1:-1]  # no spurious <s> ids
+
 
 @pytest.fixture()
 def wmt16_tar(tmp_path):
