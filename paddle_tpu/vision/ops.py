@@ -10,15 +10,19 @@ VPU, pooling reductions fused by XLA).
 """
 from __future__ import annotations
 
+import math
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core.dispatch import register_op
+from ..core.dispatch import register_op, OpDef
 from ..core.tensor import Tensor
 from ..ops._helpers import as_tensor, apply_op
 
 __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box",
+           "prior_box", "distribute_fpn_proposals", "iou_similarity",
+           "box_clip", "matrix_nms", "generate_proposals",
            "RoIAlign", "RoIPool"]
 
 
@@ -351,3 +355,319 @@ class RoIPool:
     def __call__(self, x, boxes, boxes_num):
         return roi_pool(x, boxes, boxes_num, self.output_size,
                         self.spatial_scale)
+
+
+# -- detection long tail ------------------------------------------------------
+
+def _prior_box_fwd(feat_h, feat_w, img_h, img_w, min_sizes, max_sizes,
+                   aspect_ratios, variance, flip, clip, steps, offset,
+                   min_max_aspect_ratios_order):
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    step_w = steps[0] if steps[0] > 0 else img_w / feat_w
+    step_h = steps[1] if steps[1] > 0 else img_h / feat_h
+    # box (w, h) per prior, reference order: per min_size, aspect
+    # ratios (ar=1 first), then the max_size box — or caffe order
+    dims = []
+    for s, ms in enumerate(min_sizes):
+        block = []
+        for ar in ars:
+            block.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+        mx = []
+        if max_sizes:
+            m = max_sizes[s]
+            mx.append((math.sqrt(ms * m), math.sqrt(ms * m)))
+        if min_max_aspect_ratios_order:
+            dims.extend([block[0]] + mx + block[1:])
+        else:
+            dims.extend(block + mx)
+    wh = jnp.asarray(dims, jnp.float32)                    # [P, 2]
+    cx = (jnp.arange(feat_w, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(feat_h, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)                        # [H, W]
+    centers = jnp.stack([cxg, cyg], -1)[:, :, None, :]     # [H, W, 1, 2]
+    half = wh[None, None] / 2.0                            # [1, 1, P, 2]
+    boxes = jnp.concatenate([centers - half, centers + half], axis=-1)
+    boxes = boxes / jnp.asarray([img_w, img_h, img_w, img_h],
+                                jnp.float32)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                           boxes.shape)
+    return boxes, var
+
+
+register_op("vision_prior_box",
+            lambda feat, img, **kw: _prior_box_fwd(
+                feat.shape[2], feat.shape[3], img.shape[2],
+                img.shape[3], **kw), nondiff=True)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=[1.0], variance=[0.1, 0.1, 0.2, 0.2],
+              flip=False, clip=False, steps=[0.0, 0.0], offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes (reference: vision/ops.py:471 prior_box over
+    detection/prior_box_op.h). Returns (boxes, variances), each
+    [H, W, num_priors, 4]."""
+    def _l(v):
+        return [float(x) for x in (v if isinstance(v, (list, tuple))
+                                   else [v])]
+    return apply_op(
+        "vision_prior_box", as_tensor(input), as_tensor(image),
+        attrs=dict(min_sizes=tuple(_l(min_sizes)),
+                   max_sizes=tuple(_l(max_sizes or [])),
+                   aspect_ratios=tuple(_l(aspect_ratios)),
+                   variance=tuple(_l(variance)), flip=bool(flip),
+                   clip=bool(clip), steps=tuple(_l(steps)),
+                   offset=float(offset),
+                   min_max_aspect_ratios_order=bool(
+                       min_max_aspect_ratios_order)))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level,
+                             refer_level, refer_scale,
+                             pixel_offset=False, rois_num=None,
+                             name=None):
+    """FPN level assignment (reference: vision/ops.py:1282 over
+    detection/distribute_fpn_proposals_op). Level counts are data-
+    dependent, so this is a HOST-side metadata op (the design rule that
+    replaces LoD): returns (multi_rois list, restore_ind, and
+    rois_num_per_level list when rois_num is given)."""
+    from ..ops.creation import to_tensor
+    rois = np.asarray(as_tensor(fpn_rois)._value)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 0.0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi_rois, order = [], []
+    for level in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == level)[0]
+        multi_rois.append(to_tensor(rois[idx].astype(rois.dtype)))
+        order.append(idx)
+    order = np.concatenate(order) if order else np.zeros(0, np.int64)
+    restore = np.empty((len(rois), 1), np.int32)
+    restore[order, 0] = np.arange(len(rois), dtype=np.int32)
+    restore_t = to_tensor(restore)
+    if rois_num is not None:
+        nums = np.asarray(as_tensor(rois_num)._value).astype(np.int64)
+        starts = np.concatenate([[0], np.cumsum(nums)])
+        img_of = np.zeros(len(rois), np.int64)
+        for b in range(len(nums)):
+            img_of[starts[b]:starts[b + 1]] = b
+        per_level = []
+        for level in range(min_level, max_level + 1):
+            cnt = np.asarray([
+                int(((lvl == level) & (img_of == b)).sum())
+                for b in range(len(nums))], dtype=np.int32)
+            per_level.append(to_tensor(cnt))
+        return multi_rois, restore_t, per_level
+    return multi_rois, restore_t, None
+
+
+def _iou_similarity_fwd(a, b, box_normalized):
+    off = 0.0 if box_normalized else 1.0
+    area_a = (a[:, 2] - a[:, 0] + off) * (a[:, 3] - a[:, 1] + off)
+    area_b = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    whs = jnp.clip(rb - lt + off, 0.0)
+    inter = whs[..., 0] * whs[..., 1]
+    return inter / jnp.maximum(
+        area_a[:, None] + area_b[None, :] - inter, 1e-10)
+
+
+register_op("vision_iou_similarity", _iou_similarity_fwd)
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """Pairwise IoU [N, M] (reference:
+    detection/iou_similarity_op.cc)."""
+    return apply_op("vision_iou_similarity", as_tensor(x), as_tensor(y),
+                    attrs=dict(box_normalized=bool(box_normalized)))
+
+
+def _box_clip_fwd(boxes, im_row):
+    h = im_row[0] / im_row[2] - 1.0
+    w = im_row[1] / im_row[2] - 1.0
+    return jnp.stack([
+        jnp.clip(boxes[..., 0], 0.0, w),
+        jnp.clip(boxes[..., 1], 0.0, h),
+        jnp.clip(boxes[..., 2], 0.0, w),
+        jnp.clip(boxes[..., 3], 0.0, h)], axis=-1)
+
+
+register_op("vision_box_clip", _box_clip_fwd)
+
+
+def box_clip(input, im_info, rois_num=None, name=None):
+    """Clip boxes to their image's boundaries (reference:
+    detection/box_clip_op.cc — im_info rows are (height, width, scale),
+    one row per image). Multi-image batches pass rois_num [B] to group
+    boxes per image (the LoD the reference op reads)."""
+    from ..ops import manipulation
+    boxes = as_tensor(input)
+    info = as_tensor(im_info)
+    n_img = int(info.shape[0])
+    if n_img == 1:
+        return apply_op("vision_box_clip", boxes, info[0])
+    if rois_num is None:
+        raise ValueError(
+            "box_clip with multiple im_info rows needs rois_num [B] to "
+            "assign boxes to images")
+    nums = np.asarray(as_tensor(rois_num)._value).astype(np.int64)
+    parts, start = [], 0
+    for b in range(n_img):
+        end = start + int(nums[b])
+        parts.append(apply_op("vision_box_clip", boxes[start:end],
+                              info[b]))
+        start = end
+    return manipulation.concat(parts, axis=0)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (reference: vision/ops.py:2422 over
+    detection/matrix_nms_op.cc — a CPU-only op in the reference too;
+    the decay math runs host-side). bboxes [N, M, 4], scores [N, C, M].
+    Returns (out [R, 6], rois_num?, index?) with rows
+    (label, decayed_score, x1, y1, x2, y2)."""
+    from ..ops.creation import to_tensor
+    boxes_np = np.asarray(as_tensor(bboxes)._value)
+    scores_np = np.asarray(as_tensor(scores)._value)
+    N, C, M = scores_np.shape
+    off = 0.0 if normalized else 1.0
+
+    def iou(a, b):
+        area_a = (a[2] - a[0] + off) * (a[3] - a[1] + off)
+        area_b = (b[2] - b[0] + off) * (b[3] - b[1] + off)
+        iw = min(a[2], b[2]) - max(a[0], b[0]) + off
+        ih = min(a[3], b[3]) - max(a[1], b[1]) + off
+        inter = max(iw, 0.0) * max(ih, 0.0)
+        return inter / max(area_a + area_b - inter, 1e-10)
+
+    all_rows, all_idx, rois_num = [], [], []
+    for n in range(N):
+        rows = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            sc = scores_np[n, c]
+            cand = np.nonzero(sc > score_threshold)[0]
+            cand = cand[np.argsort(-sc[cand])]
+            if nms_top_k > -1:
+                cand = cand[:nms_top_k]
+            m = len(cand)
+            ious = np.zeros((m, m), np.float64)
+            for i in range(m):
+                for j in range(i):
+                    ious[i, j] = iou(boxes_np[n, cand[i]],
+                                     boxes_np[n, cand[j]])
+            # iou_max[j]: candidate j's own max overlap with ITS
+            # predecessors — the compensation term of the Matrix NMS
+            # decay (reference matrix_nms_op.cc Decay/GaussianDecay)
+            iou_max = np.zeros(m, np.float64)
+            for j in range(1, m):
+                iou_max[j] = ious[j, :j].max()
+            decayed = []
+            for i, bi in enumerate(cand):
+                decay = 1.0
+                for j in range(i):
+                    v = ious[i, j]
+                    comp = iou_max[j]
+                    if use_gaussian:
+                        decay = min(decay, math.exp(
+                            -(v * v - comp * comp) / gaussian_sigma))
+                    else:
+                        decay = min(decay, (1.0 - v) /
+                                    max(1.0 - comp, 1e-10))
+                s = sc[bi] * decay
+                if s > post_threshold:
+                    decayed.append((s, c, bi))
+            rows.extend(decayed)
+        rows.sort(key=lambda r: -r[0])
+        if keep_top_k > -1:
+            rows = rows[:keep_top_k]
+        for s, c, bi in rows:
+            all_rows.append([float(c), float(s)] +
+                            boxes_np[n, bi].tolist())
+            all_idx.append(n * M + bi)
+        rois_num.append(len(rows))
+    out = to_tensor(np.asarray(all_rows, np.float32).reshape(-1, 6))
+    outs = [out]
+    if return_rois_num:
+        outs.append(to_tensor(np.asarray(rois_num, np.int32)))
+    if return_index:
+        outs.append(to_tensor(np.asarray(all_idx, np.int64)
+                              .reshape(-1, 1)))
+    return tuple(outs) if len(outs) > 1 else out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors,
+                       variances, pre_nms_top_n=6000,
+                       post_nms_top_n=1000, nms_thresh=0.5,
+                       min_size=0.1, eta=1.0, pixel_offset=False,
+                       return_rois_num=False, name=None):
+    """RPN proposal generation (reference: vision/ops.py:2233 over
+    detection/generate_proposals_v2_op): decode anchors with deltas,
+    clip, filter by min_size, NMS, keep post_nms_top_n. Output counts
+    are data-dependent -> host-side composition of the jitted pieces.
+    scores [N, A, H, W]; bbox_deltas [N, 4A, H, W]; anchors
+    [H, W, A, 4]; variances like anchors."""
+    from ..ops.creation import to_tensor
+    scores_np = np.asarray(as_tensor(scores)._value)
+    deltas_np = np.asarray(as_tensor(bbox_deltas)._value)
+    img = np.asarray(as_tensor(img_size)._value)
+    anc = np.asarray(as_tensor(anchors)._value).reshape(-1, 4)
+    var = np.asarray(as_tensor(variances)._value).reshape(-1, 4)
+    N, A = scores_np.shape[0], scores_np.shape[1]
+    off = 1.0 if pixel_offset else 0.0
+    rois_out, num_out, score_out = [], [], []
+    for n in range(N):
+        sc = scores_np[n].transpose(1, 2, 0).reshape(-1)
+        dl = deltas_np[n].reshape(A, 4, *scores_np.shape[2:]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-sc)[:pre_nms_top_n]
+        sc, dl, an, vr = sc[order], dl[order], anc[order], var[order]
+        aw = an[:, 2] - an[:, 0] + off
+        ah = an[:, 3] - an[:, 1] + off
+        acx = an[:, 0] + aw / 2.0
+        acy = an[:, 1] + ah / 2.0
+        cx = vr[:, 0] * dl[:, 0] * aw + acx
+        cy = vr[:, 1] * dl[:, 1] * ah + acy
+        bbox_clip = math.log(1000.0 / 16.0)  # reference kBBoxClipDefault
+        w = np.exp(np.minimum(vr[:, 2] * dl[:, 2], bbox_clip)) * aw
+        h = np.exp(np.minimum(vr[:, 3] * dl[:, 3], bbox_clip)) * ah
+        boxes = np.stack([cx - w / 2.0, cy - h / 2.0,
+                          cx + w / 2.0 - off, cy + h / 2.0 - off], -1)
+        ih, iw = float(img[n, 0]), float(img[n, 1])
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        ms = max(float(min_size), 1.0)  # reference FilterBoxes floor
+        keep = ((boxes[:, 2] - boxes[:, 0] + off >= ms) &
+                (boxes[:, 3] - boxes[:, 1] + off >= ms))
+        boxes, sc = boxes[keep], sc[keep]
+        if len(boxes):
+            kept = nms(to_tensor(boxes.astype(np.float32)),
+                       iou_threshold=nms_thresh,
+                       scores=to_tensor(sc.astype(np.float32)),
+                       top_k=post_nms_top_n).numpy()
+        else:
+            kept = np.zeros(0, np.int64)
+        rois_out.append(boxes[kept])
+        score_out.append(sc[kept])
+        num_out.append(len(kept))
+    rois = to_tensor(np.concatenate(rois_out).astype(np.float32)
+                     .reshape(-1, 4))
+    rscores = to_tensor(np.concatenate(score_out).astype(np.float32))
+    if return_rois_num:
+        return rois, rscores, to_tensor(np.asarray(num_out, np.int32))
+    return rois, rscores

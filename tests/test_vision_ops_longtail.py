@@ -284,3 +284,149 @@ class TestReviewRegressions:
                                    rtol=1e-5)
         np.testing.assert_allclose(out.numpy()[1, 2], prior[1],
                                    rtol=1e-5)
+
+
+class TestDetectionLongTail:
+    """prior_box / distribute_fpn_proposals / iou_similarity / box_clip /
+    matrix_nms / generate_proposals (reference:
+    paddle/fluid/operators/detection/, python/paddle/vision/ops.py)."""
+
+    def test_prior_box_shapes_and_geometry(self):
+        from paddle_tpu.vision import ops as vops
+        feat = paddle.to_tensor(np.zeros((1, 3, 4, 6), "float32"))
+        img = paddle.to_tensor(np.zeros((1, 3, 8, 12), "float32"))
+        boxes, var = vops.prior_box(feat, img, min_sizes=[2.0, 4.0],
+                                    aspect_ratios=[1.0, 2.0],
+                                    flip=True, clip=True)
+        # priors per position: per min_size -> ar{1,2,0.5} = 3 -> 6
+        assert boxes.shape == [4, 6, 6, 4]
+        assert var.shape == [4, 6, 6, 4]
+        b = boxes.numpy()
+        assert (b >= 0).all() and (b <= 1).all()  # clipped
+        # center of cell (0,0): offset 0.5 * step (12/6=2, 8/4=2) = (1,1)
+        ms = 2.0
+        np.testing.assert_allclose(
+            b[0, 0, 0], [(1 - ms / 2) / 12, (1 - ms / 2) / 8,
+                         (1 + ms / 2) / 12, (1 + ms / 2) / 8],
+            rtol=1e-5)
+        np.testing.assert_allclose(var.numpy()[0, 0, 0],
+                                   [0.1, 0.1, 0.2, 0.2], rtol=1e-6)
+
+    def test_distribute_fpn_proposals_levels_and_restore(self):
+        from paddle_tpu.vision import ops as vops
+        rois = np.array([[0, 0, 10, 10],      # scale 10  -> low level
+                         [0, 0, 224, 224],    # scale 224 -> refer level
+                         [0, 0, 500, 500],    # scale 500 -> higher
+                         [0, 0, 30, 30]], "float32")
+        multi, restore, per_level = vops.distribute_fpn_proposals(
+            paddle.to_tensor(rois), min_level=2, max_level=5,
+            refer_level=4, refer_scale=224,
+            rois_num=paddle.to_tensor(np.array([4], "int32")))
+        assert len(multi) == 4 and len(per_level) == 4
+        total = sum(m.shape[0] for m in multi)
+        assert total == 4
+        # restore index is a permutation
+        r = restore.numpy().reshape(-1)
+        assert sorted(r.tolist()) == [0, 1, 2, 3]
+        # concat(multi)[restore] == original order
+        cat = np.concatenate([m.numpy() for m in multi])
+        np.testing.assert_allclose(cat[r], rois)
+        assert sum(int(p.numpy()[0]) for p in per_level) == 4
+
+    def test_iou_similarity_and_box_clip(self):
+        from paddle_tpu.vision import ops as vops
+        a = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], "float32")
+        b = np.array([[0, 0, 10, 10]], "float32")
+        iou = vops.iou_similarity(paddle.to_tensor(a),
+                                  paddle.to_tensor(b)).numpy()
+        np.testing.assert_allclose(iou[0, 0], 1.0, rtol=1e-5)
+        np.testing.assert_allclose(iou[1, 0], 25.0 / 175.0, rtol=1e-4)
+        boxes = np.array([[-5, -5, 50, 50]], "float32")
+        im_info = np.array([[20.0, 30.0, 1.0]], "float32")
+        clipped = vops.box_clip(paddle.to_tensor(boxes),
+                                paddle.to_tensor(im_info)).numpy()
+        np.testing.assert_allclose(clipped[0], [0, 0, 29, 19],
+                                   rtol=1e-5)
+
+    def test_matrix_nms_decays_overlaps(self):
+        from paddle_tpu.vision import ops as vops
+        bboxes = np.array([[[0, 0, 10, 10], [0, 0, 10.5, 10.5],
+                            [20, 20, 30, 30]]], "float32")
+        scores = np.array([[[0.9, 0.8, 0.7]]], "float32")  # 1 class
+        out, rois_num, index = vops.matrix_nms(
+            paddle.to_tensor(bboxes), paddle.to_tensor(scores),
+            score_threshold=0.1, post_threshold=0.0, nms_top_k=10,
+            keep_top_k=10, background_label=-1, return_index=True)
+        o = out.numpy()
+        assert o.shape[1] == 6
+        assert int(rois_num.numpy()[0]) == 3
+        # top box keeps its score; the overlapping one is decayed below
+        np.testing.assert_allclose(o[0, 1], 0.9, rtol=1e-5)
+        decayed = o[np.argsort(-o[:, 1])][1:]
+        box2_row = [r for r in o if abs(r[1] - 0.7) < 0.05]
+        assert len(box2_row) == 1  # far box not decayed
+        overlap_rows = [r for r in o if r[1] < 0.6]
+        assert len(overlap_rows) == 1  # heavy overlap decayed hard
+
+    def test_generate_proposals_end_to_end(self):
+        from paddle_tpu.vision import ops as vops
+        rs = np.random.RandomState(0)
+        H = W = 4
+        A = 3
+        scores = rs.rand(1, A, H, W).astype("float32")
+        deltas = (rs.randn(1, 4 * A, H, W) * 0.1).astype("float32")
+        base = np.array([[0, 0, 16, 16], [0, 0, 32, 32],
+                         [0, 0, 48, 48]], "float32")
+        anchors = np.zeros((H, W, A, 4), "float32")
+        for y in range(H):
+            for x in range(W):
+                shift = np.array([x * 16, y * 16, x * 16, y * 16],
+                                 "float32")
+                anchors[y, x] = base + shift
+        variances = np.ones_like(anchors)
+        rois, rscores, num = vops.generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(np.array([[64.0, 64.0]], "float32")),
+            paddle.to_tensor(anchors), paddle.to_tensor(variances),
+            pre_nms_top_n=20, post_nms_top_n=5, nms_thresh=0.5,
+            min_size=1.0, return_rois_num=True)
+        r = rois.numpy()
+        assert r.shape[0] == int(num.numpy()[0]) <= 5
+        assert (r[:, 0] >= 0).all() and (r[:, 2] <= 64).all()
+        s = rscores.numpy()
+        assert (np.diff(s) <= 1e-6).all()  # sorted descending
+
+    def test_matrix_nms_chained_overlap_compensation(self):
+        """Code-review regression: decay must compensate with each
+        predecessor's OWN iou_max (reference Decay semantics) — C
+        overlapping only B (which was itself decayed by A) keeps its
+        score."""
+        from paddle_tpu.vision import ops as vops
+        bboxes = np.array([[[0, 0, 10, 10], [0, 5, 10, 15],
+                            [0, 10, 10, 20]]], "float32")
+        scores = np.array([[[0.9, 0.8, 0.7]]], "float32")
+        out, _ = vops.matrix_nms(
+            paddle.to_tensor(bboxes), paddle.to_tensor(scores),
+            score_threshold=0.1, post_threshold=0.0, nms_top_k=10,
+            keep_top_k=10, background_label=-1)
+        o = out.numpy()
+        c_row = o[np.isclose(o[:, 4], 10.0) & np.isclose(o[:, 5], 20.0)]
+        # IoU(C,A)=0; IoU(C,B)=1/3 with iou_max[B]=1/3 ->
+        # decay = (1-1/3)/(1-1/3) = 1 -> C keeps 0.7
+        np.testing.assert_allclose(c_row[0, 1], 0.7, rtol=1e-5)
+
+    def test_box_clip_per_image(self):
+        from paddle_tpu.vision import ops as vops
+        boxes = np.array([[-5, -5, 500, 500],
+                          [-5, -5, 500, 500]], "float32")
+        im_info = np.array([[100, 100, 1.0], [300, 400, 1.0]],
+                           "float32")
+        out = vops.box_clip(paddle.to_tensor(boxes),
+                            paddle.to_tensor(im_info),
+                            rois_num=paddle.to_tensor(
+                                np.array([1, 1], "int32"))).numpy()
+        np.testing.assert_allclose(out[0], [0, 0, 99, 99])
+        np.testing.assert_allclose(out[1], [0, 0, 399, 299])
+        with pytest.raises(ValueError, match="rois_num"):
+            vops.box_clip(paddle.to_tensor(boxes),
+                          paddle.to_tensor(im_info))
