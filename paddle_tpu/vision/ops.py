@@ -16,7 +16,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core.dispatch import register_op, OpDef
+from ..core.dispatch import register_op
 from ..core.tensor import Tensor
 from ..ops._helpers import as_tensor, apply_op
 
@@ -522,6 +522,10 @@ def box_clip(input, im_info, rois_num=None, name=None):
             "box_clip with multiple im_info rows needs rois_num [B] to "
             "assign boxes to images")
     nums = np.asarray(as_tensor(rois_num)._value).astype(np.int64)
+    if int(nums.sum()) != int(boxes.shape[0]):
+        raise ValueError(
+            f"box_clip: sum(rois_num)={int(nums.sum())} must equal the "
+            f"box count {int(boxes.shape[0])}")
     parts, start = [], 0
     for b in range(n_img):
         end = start + int(nums[b])
@@ -546,14 +550,6 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold,
     N, C, M = scores_np.shape
     off = 0.0 if normalized else 1.0
 
-    def iou(a, b):
-        area_a = (a[2] - a[0] + off) * (a[3] - a[1] + off)
-        area_b = (b[2] - b[0] + off) * (b[3] - b[1] + off)
-        iw = min(a[2], b[2]) - max(a[0], b[0]) + off
-        ih = min(a[3], b[3]) - max(a[1], b[1]) + off
-        inter = max(iw, 0.0) * max(ih, 0.0)
-        return inter / max(area_a + area_b - inter, 1e-10)
-
     all_rows, all_idx, rois_num = [], [], []
     for n in range(N):
         rows = []
@@ -566,33 +562,35 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold,
             if nms_top_k > -1:
                 cand = cand[:nms_top_k]
             m = len(cand)
-            ious = np.zeros((m, m), np.float64)
-            for i in range(m):
-                for j in range(i):
-                    ious[i, j] = iou(boxes_np[n, cand[i]],
-                                     boxes_np[n, cand[j]])
+            b = boxes_np[n, cand].astype(np.float64)      # [m, 4]
+            area = (b[:, 2] - b[:, 0] + off) * \
+                (b[:, 3] - b[:, 1] + off)
+            lt = np.maximum(b[:, None, :2], b[None, :, :2])
+            rb = np.minimum(b[:, None, 2:], b[None, :, 2:])
+            wh = np.clip(rb - lt + off, 0.0, None)
+            inter = wh[..., 0] * wh[..., 1]
+            ious = inter / np.maximum(
+                area[:, None] + area[None, :] - inter, 1e-10)
+            ious = np.tril(ious, k=-1)                    # j < i only
             # iou_max[j]: candidate j's own max overlap with ITS
             # predecessors — the compensation term of the Matrix NMS
-            # decay (reference matrix_nms_op.cc Decay/GaussianDecay)
-            iou_max = np.zeros(m, np.float64)
-            for j in range(1, m):
-                iou_max[j] = ious[j, :j].max()
-            decayed = []
-            for i, bi in enumerate(cand):
-                decay = 1.0
-                for j in range(i):
-                    v = ious[i, j]
-                    comp = iou_max[j]
-                    if use_gaussian:
-                        decay = min(decay, math.exp(
-                            -(v * v - comp * comp) / gaussian_sigma))
-                    else:
-                        decay = min(decay, (1.0 - v) /
-                                    max(1.0 - comp, 1e-10))
-                s = sc[bi] * decay
-                if s > post_threshold:
-                    decayed.append((s, c, bi))
-            rows.extend(decayed)
+            # decay (reference matrix_nms_op.cc Decay/GaussianDecay).
+            # ious is strictly lower-triangular, so the row max IS the
+            # max over predecessors.
+            iou_max = ious.max(axis=1) if m else np.zeros(0)
+            if use_gaussian:
+                dmat = np.exp(-(ious ** 2 - iou_max[None, :] ** 2) /
+                              gaussian_sigma)
+            else:
+                dmat = (1.0 - ious) / np.maximum(
+                    1.0 - iou_max[None, :], 1e-10)
+            mask = np.tril(np.ones((m, m), bool), k=-1)
+            dmat = np.where(mask, dmat, 1.0)
+            decay = dmat.min(axis=1) if m else np.ones(0)
+            svals = sc[cand] * decay
+            for s_, bi in zip(svals, cand):
+                if s_ > post_threshold:
+                    rows.append((float(s_), c, bi))
         rows.sort(key=lambda r: -r[0])
         if keep_top_k > -1:
             rows = rows[:keep_top_k]
@@ -635,7 +633,9 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors,
         sc = scores_np[n].transpose(1, 2, 0).reshape(-1)
         dl = deltas_np[n].reshape(A, 4, *scores_np.shape[2:]) \
             .transpose(2, 3, 0, 1).reshape(-1, 4)
-        order = np.argsort(-sc)[:pre_nms_top_n]
+        order = np.argsort(-sc)
+        if pre_nms_top_n > 0:
+            order = order[:pre_nms_top_n]
         sc, dl, an, vr = sc[order], dl[order], anc[order], var[order]
         aw = an[:, 2] - an[:, 0] + off
         ah = an[:, 3] - an[:, 1] + off
@@ -655,7 +655,35 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors,
         keep = ((boxes[:, 2] - boxes[:, 0] + off >= ms) &
                 (boxes[:, 3] - boxes[:, 1] + off >= ms))
         boxes, sc = boxes[keep], sc[keep]
-        if len(boxes):
+        if len(boxes) and eta < 1.0:
+            # adaptive NMS (reference NMS with eta: the threshold
+            # decays by eta after each kept box while > 0.5)
+            order2 = np.argsort(-sc)
+            kept_list = []
+            thresh = nms_thresh
+            for i in order2:
+                ok = True
+                for j in kept_list:
+                    iw = min(boxes[i, 2], boxes[j, 2]) - \
+                        max(boxes[i, 0], boxes[j, 0]) + off
+                    ih = min(boxes[i, 3], boxes[j, 3]) - \
+                        max(boxes[i, 1], boxes[j, 1]) + off
+                    inter = max(iw, 0.0) * max(ih, 0.0)
+                    ai = (boxes[i, 2] - boxes[i, 0] + off) * \
+                        (boxes[i, 3] - boxes[i, 1] + off)
+                    aj = (boxes[j, 2] - boxes[j, 0] + off) * \
+                        (boxes[j, 3] - boxes[j, 1] + off)
+                    if inter / max(ai + aj - inter, 1e-10) > thresh:
+                        ok = False
+                        break
+                if ok:
+                    kept_list.append(i)
+                    if len(kept_list) >= post_nms_top_n:
+                        break
+                    if thresh > 0.5:
+                        thresh *= eta
+            kept = np.asarray(kept_list, np.int64)
+        elif len(boxes):
             kept = nms(to_tensor(boxes.astype(np.float32)),
                        iou_threshold=nms_thresh,
                        scores=to_tensor(sc.astype(np.float32)),

@@ -430,3 +430,45 @@ class TestDetectionLongTail:
         with pytest.raises(ValueError, match="rois_num"):
             vops.box_clip(paddle.to_tensor(boxes),
                           paddle.to_tensor(im_info))
+
+    def test_generate_proposals_keep_all_and_eta(self):
+        """pre_nms_top_n<=0 keeps all anchors; eta<1 runs adaptive NMS
+        (code-review regressions)."""
+        from paddle_tpu.vision import ops as vops
+        rs = np.random.RandomState(1)
+        H = W = 2
+        A = 2
+        scores = rs.rand(1, A, H, W).astype("float32")
+        deltas = np.zeros((1, 4 * A, H, W), "float32")
+        base = np.array([[0, 0, 8, 8], [0, 0, 16, 16]], "float32")
+        anchors = np.zeros((H, W, A, 4), "float32")
+        for y in range(H):
+            for x in range(W):
+                anchors[y, x] = base + np.array(
+                    [x * 8, y * 8, x * 8, y * 8], "float32")
+        var = np.ones_like(anchors)
+        rois, rscores = vops.generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(np.array([[32.0, 32.0]], "float32")),
+            paddle.to_tensor(anchors), paddle.to_tensor(var),
+            pre_nms_top_n=0, post_nms_top_n=100, nms_thresh=0.99,
+            min_size=1.0)
+        assert rois.shape[0] == H * W * A  # nothing dropped pre-NMS
+        rois2, _ = vops.generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(np.array([[32.0, 32.0]], "float32")),
+            paddle.to_tensor(anchors), paddle.to_tensor(var),
+            pre_nms_top_n=0, post_nms_top_n=100, nms_thresh=0.9,
+            min_size=1.0, eta=0.6)
+        # adaptive threshold decays below overlaps -> fewer kept
+        assert rois2.shape[0] <= rois.shape[0]
+
+    def test_box_clip_count_mismatch_raises(self):
+        from paddle_tpu.vision import ops as vops
+        boxes = np.zeros((5, 4), "float32")
+        im_info = np.array([[10, 10, 1.0], [10, 10, 1.0]], "float32")
+        with pytest.raises(ValueError, match="sum\\(rois_num\\)"):
+            vops.box_clip(paddle.to_tensor(boxes),
+                          paddle.to_tensor(im_info),
+                          rois_num=paddle.to_tensor(
+                              np.array([2, 2], "int32")))
