@@ -3,4 +3,10 @@ from .lenet import LeNet  # noqa: F401
 from .resnet import *  # noqa: F401,F403
 from .vgg import *  # noqa: F401,F403
 from .mobilenet import *  # noqa: F401,F403
+from .mobilenetv3 import *  # noqa: F401,F403
 from .alexnet import *  # noqa: F401,F403
+from .densenet import *  # noqa: F401,F403
+from .squeezenet import *  # noqa: F401,F403
+from .shufflenetv2 import *  # noqa: F401,F403
+from .googlenet import *  # noqa: F401,F403
+from .inceptionv3 import *  # noqa: F401,F403

@@ -1,0 +1,98 @@
+"""GoogLeNet / Inception v1 (reference:
+python/paddle/vision/models/googlenet.py — Inception blocks with two
+auxiliary heads)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+def _conv_relu(in_ch, out_ch, k, stride=1, padding=0):
+    return nn.Sequential(
+        nn.Conv2D(in_ch, out_ch, k, stride=stride, padding=padding),
+        nn.ReLU())
+
+
+class _Inception(nn.Layer):
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = _conv_relu(in_ch, c1, 1)
+        self.b2 = nn.Sequential(_conv_relu(in_ch, c3r, 1),
+                                _conv_relu(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(_conv_relu(in_ch, c5r, 1),
+                                _conv_relu(c5r, c5, 5, padding=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                _conv_relu(in_ch, proj, 1))
+
+    def forward(self, x):
+        import paddle_tpu.ops.manipulation as man
+        return man.concat([self.b1(x), self.b2(x), self.b3(x),
+                           self.b4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """reference: vision/models/googlenet.py GoogLeNet. Returns
+    (main, aux1, aux2) logits like the reference."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _conv_relu(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            _conv_relu(64, 64, 1), _conv_relu(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.inc3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.inc3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.inc4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inc4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.inc4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.inc4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.inc4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.inc5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.inc5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.pool5 = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.4)
+            self.fc = nn.Linear(1024, num_classes)
+            # auxiliary heads (train-time deep supervision)
+            self.aux_pool = nn.AdaptiveAvgPool2D(4)
+            self.aux1_conv = _conv_relu(512, 128, 1)
+            self.aux1_fc1 = nn.Linear(128 * 16, 1024)
+            self.aux1_fc2 = nn.Linear(1024, num_classes)
+            self.aux2_conv = _conv_relu(528, 128, 1)
+            self.aux2_fc1 = nn.Linear(128 * 16, 1024)
+            self.aux2_fc2 = nn.Linear(1024, num_classes)
+            self.relu = nn.ReLU()
+            self.aux_dropout = nn.Dropout(0.7)
+
+    def _aux(self, x, conv, fc1, fc2):
+        x = conv(self.aux_pool(x)).flatten(1)
+        x = self.aux_dropout(self.relu(fc1(x)))
+        return fc2(x)
+
+    def forward(self, x):
+        x = self.pool3(self.inc3b(self.inc3a(self.stem(x))))
+        x = self.inc4a(x)
+        aux1 = self._aux(x, self.aux1_conv, self.aux1_fc1,
+                         self.aux1_fc2) if self.num_classes > 0 else None
+        x = self.inc4d(self.inc4c(self.inc4b(x)))
+        aux2 = self._aux(x, self.aux2_conv, self.aux2_fc1,
+                         self.aux2_fc2) if self.num_classes > 0 else None
+        x = self.inc5b(self.inc5a(self.pool4(self.inc4e(x))))
+        if self.with_pool:
+            x = self.pool5(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        return x, aux1, aux2
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights: no network egress")
+    return GoogLeNet(**kwargs)

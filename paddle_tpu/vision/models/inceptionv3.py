@@ -1,0 +1,143 @@
+"""Inception v3 (reference: python/paddle/vision/models/inceptionv3.py
+— InceptionA/B/C/D/E stacks, 299x299 input)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+def _cbn(in_ch, out_ch, k, stride=1, padding=0):
+    return nn.Sequential(
+        nn.Conv2D(in_ch, out_ch, k, stride=stride, padding=padding,
+                  bias_attr=False),
+        nn.BatchNorm2D(out_ch), nn.ReLU())
+
+
+def _cat(parts):
+    import paddle_tpu.ops.manipulation as man
+    return man.concat(parts, axis=1)
+
+
+class _IncA(nn.Layer):
+    def __init__(self, in_ch, pool_ch):
+        super().__init__()
+        self.b1 = _cbn(in_ch, 64, 1)
+        self.b5 = nn.Sequential(_cbn(in_ch, 48, 1),
+                                _cbn(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_cbn(in_ch, 64, 1),
+                                _cbn(64, 96, 3, padding=1),
+                                _cbn(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _cbn(in_ch, pool_ch, 1))
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)])
+
+
+class _IncB(nn.Layer):  # grid reduction 35->17
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = _cbn(in_ch, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_cbn(in_ch, 64, 1),
+                                 _cbn(64, 96, 3, padding=1),
+                                 _cbn(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _cat([self.b3(x), self.b3d(x), self.pool(x)])
+
+
+class _IncC(nn.Layer):
+    def __init__(self, in_ch, c7):
+        super().__init__()
+        self.b1 = _cbn(in_ch, 192, 1)
+        self.b7 = nn.Sequential(
+            _cbn(in_ch, c7, 1), _cbn(c7, c7, (1, 7), padding=(0, 3)),
+            _cbn(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            _cbn(in_ch, c7, 1), _cbn(c7, c7, (7, 1), padding=(3, 0)),
+            _cbn(c7, c7, (1, 7), padding=(0, 3)),
+            _cbn(c7, c7, (7, 1), padding=(3, 0)),
+            _cbn(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _cbn(in_ch, 192, 1))
+
+    def forward(self, x):
+        return _cat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)])
+
+
+class _IncD(nn.Layer):  # grid reduction 17->8
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = nn.Sequential(_cbn(in_ch, 192, 1),
+                                _cbn(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _cbn(in_ch, 192, 1), _cbn(192, 192, (1, 7), padding=(0, 3)),
+            _cbn(192, 192, (7, 1), padding=(3, 0)),
+            _cbn(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _cat([self.b3(x), self.b7(x), self.pool(x)])
+
+
+class _IncE(nn.Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b1 = _cbn(in_ch, 320, 1)
+        self.b3_stem = _cbn(in_ch, 384, 1)
+        self.b3_a = _cbn(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _cbn(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = nn.Sequential(_cbn(in_ch, 448, 1),
+                                      _cbn(448, 384, 3, padding=1))
+        self.b3d_a = _cbn(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = _cbn(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _cbn(in_ch, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return _cat([self.b1(x), self.b3_a(s), self.b3_b(s),
+                     self.b3d_a(d), self.b3d_b(d), self.bp(x)])
+
+
+class InceptionV3(nn.Layer):
+    """reference: vision/models/inceptionv3.py InceptionV3."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            _cbn(3, 32, 3, stride=2), _cbn(32, 32, 3),
+            _cbn(32, 64, 3, padding=1), nn.MaxPool2D(3, stride=2),
+            _cbn(64, 80, 1), _cbn(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.blocks = nn.Sequential(
+            _IncA(192, 32), _IncA(256, 64), _IncA(288, 64),
+            _IncB(288),
+            _IncC(768, 128), _IncC(768, 160), _IncC(768, 160),
+            _IncC(768, 192),
+            _IncD(768),
+            _IncE(1280), _IncE(2048))
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights: no network egress")
+    return InceptionV3(**kwargs)

@@ -1,0 +1,139 @@
+"""MobileNetV3 (reference: python/paddle/vision/models/mobilenetv3.py —
+small/large variants with SE blocks and hardswish)."""
+from __future__ import annotations
+
+from ... import nn
+from .mobilenet import _make_divisible
+
+__all__ = ["MobileNetV3Small", "MobileNetV3Large",
+           "mobilenet_v3_small", "mobilenet_v3_large"]
+
+# (kernel, expand, out, use_se, act, stride)
+_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hswish", 2), (3, 200, 80, False, "hswish", 1),
+    (3, 184, 80, False, "hswish", 1), (3, 184, 80, False, "hswish", 1),
+    (3, 480, 112, True, "hswish", 1), (3, 672, 112, True, "hswish", 1),
+    (5, 672, 160, True, "hswish", 2), (5, 960, 160, True, "hswish", 1),
+    (5, 960, 160, True, "hswish", 1),
+]
+_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hswish", 2),
+    (5, 240, 40, True, "hswish", 1), (5, 240, 40, True, "hswish", 1),
+    (5, 120, 48, True, "hswish", 1), (5, 144, 48, True, "hswish", 1),
+    (5, 288, 96, True, "hswish", 2), (5, 576, 96, True, "hswish", 1),
+    (5, 576, 96, True, "hswish", 1),
+]
+
+
+def _act(name):
+    return nn.Hardswish() if name == "hswish" else nn.ReLU()
+
+
+class _SE(nn.Layer):
+    def __init__(self, ch, reduction=4):
+        super().__init__()
+        mid = _make_divisible(ch // reduction)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, mid, 1)
+        self.fc2 = nn.Conv2D(mid, ch, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _InvertedResidualV3(nn.Layer):
+    def __init__(self, in_ch, k, exp, out_ch, use_se, act, stride):
+        super().__init__()
+        self.use_res = stride == 1 and in_ch == out_ch
+        layers = []
+        if exp != in_ch:
+            layers += [nn.Conv2D(in_ch, exp, 1, bias_attr=False),
+                       nn.BatchNorm2D(exp), _act(act)]
+        layers += [nn.Conv2D(exp, exp, k, stride=stride,
+                             padding=k // 2, groups=exp,
+                             bias_attr=False),
+                   nn.BatchNorm2D(exp), _act(act)]
+        if use_se:
+            layers.append(_SE(exp))
+        layers += [nn.Conv2D(exp, out_ch, 1, bias_attr=False),
+                   nn.BatchNorm2D(out_ch)]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        y = self.block(x)
+        return x + y if self.use_res else y
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_exp, last_ch, scale=1.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        first = _make_divisible(16 * scale)
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, first, 3, stride=2, padding=1,
+                      bias_attr=False),
+            nn.BatchNorm2D(first), nn.Hardswish())
+        blocks = []
+        in_ch = first
+        for k, exp, out, se, act, stride in cfg:
+            e = _make_divisible(exp * scale)
+            o = _make_divisible(out * scale)
+            blocks.append(_InvertedResidualV3(in_ch, k, e, o, se, act,
+                                              stride))
+            in_ch = o
+        self.blocks = nn.Sequential(*blocks)
+        lexp = _make_divisible(last_exp * scale)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(in_ch, lexp, 1, bias_attr=False),
+            nn.BatchNorm2D(lexp), nn.Hardswish())
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(lexp, last_ch), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_ch, num_classes))
+
+    def forward(self, x):
+        x = self.conv_last(self.blocks(self.conv1(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    """reference: vision/models/mobilenetv3.py MobileNetV3Small."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, 576, 1024, scale, num_classes,
+                         with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    """reference: vision/models/mobilenetv3.py MobileNetV3Large."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, 960, 1280, scale, num_classes,
+                         with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights: no network egress")
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights: no network egress")
+    return MobileNetV3Large(scale=scale, **kwargs)

@@ -1,0 +1,79 @@
+"""SqueezeNet (reference: python/paddle/vision/models/squeezenet.py —
+SqueezeNet v1.0/v1.1 with Fire modules)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class _Fire(nn.Layer):
+    def __init__(self, in_ch, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_ch, squeeze, 1)
+        self.expand1 = nn.Conv2D(squeeze, e1, 1)
+        self.expand3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        import paddle_tpu.ops.manipulation as man
+        x = self.relu(self.squeeze(x))
+        return man.concat([self.relu(self.expand1(x)),
+                           self.relu(self.expand3(x))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """reference: vision/models/squeezenet.py SqueezeNet."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            stem = [nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                    nn.MaxPool2D(3, stride=2)]
+            fires = [_Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                     _Fire(128, 32, 128, 128),
+                     nn.MaxPool2D(3, stride=2),
+                     _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                     _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                     nn.MaxPool2D(3, stride=2),
+                     _Fire(512, 64, 256, 256)]
+        elif version == "1.1":
+            stem = [nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                    nn.MaxPool2D(3, stride=2)]
+            fires = [_Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                     nn.MaxPool2D(3, stride=2),
+                     _Fire(128, 32, 128, 128),
+                     _Fire(256, 32, 128, 128),
+                     nn.MaxPool2D(3, stride=2),
+                     _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                     _Fire(384, 64, 256, 256),
+                     _Fire(512, 64, 256, 256)]
+        else:
+            raise ValueError(f"unsupported version {version!r}")
+        self.features = nn.Sequential(*(stem + fires))
+        self.dropout = nn.Dropout(0.5)
+        self.final_conv = nn.Conv2D(512, num_classes, 1)
+        self.relu = nn.ReLU()
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self.features(x)
+        x = self.relu(self.final_conv(self.dropout(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        return x.flatten(1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights: no network egress")
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    if pretrained:
+        raise RuntimeError("pretrained weights: no network egress")
+    return SqueezeNet("1.1", **kwargs)

@@ -657,37 +657,38 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors,
         boxes, sc = boxes[keep], sc[keep]
         if len(boxes) and eta < 1.0:
             # adaptive NMS (reference NMS with eta: the threshold
-            # decays by eta after each kept box while > 0.5)
+            # decays by eta after each kept box while > 0.5);
+            # vectorized per-candidate IoU row against the kept set
             order2 = np.argsort(-sc)
+            bx = boxes.astype(np.float64)
+            area = (bx[:, 2] - bx[:, 0] + off) * \
+                (bx[:, 3] - bx[:, 1] + off)
             kept_list = []
             thresh = nms_thresh
             for i in order2:
-                ok = True
-                for j in kept_list:
-                    iw = min(boxes[i, 2], boxes[j, 2]) - \
-                        max(boxes[i, 0], boxes[j, 0]) + off
-                    ih = min(boxes[i, 3], boxes[j, 3]) - \
-                        max(boxes[i, 1], boxes[j, 1]) + off
-                    inter = max(iw, 0.0) * max(ih, 0.0)
-                    ai = (boxes[i, 2] - boxes[i, 0] + off) * \
-                        (boxes[i, 3] - boxes[i, 1] + off)
-                    aj = (boxes[j, 2] - boxes[j, 0] + off) * \
-                        (boxes[j, 3] - boxes[j, 1] + off)
-                    if inter / max(ai + aj - inter, 1e-10) > thresh:
-                        ok = False
-                        break
-                if ok:
-                    kept_list.append(i)
-                    if len(kept_list) >= post_nms_top_n:
-                        break
-                    if thresh > 0.5:
-                        thresh *= eta
+                if kept_list:
+                    kb = bx[kept_list]
+                    lt = np.maximum(bx[i, :2], kb[:, :2])
+                    rb = np.minimum(bx[i, 2:], kb[:, 2:])
+                    wh2 = np.clip(rb - lt + off, 0.0, None)
+                    inter = wh2[:, 0] * wh2[:, 1]
+                    iou_row = inter / np.maximum(
+                        area[i] + area[kept_list] - inter, 1e-10)
+                    if (iou_row > thresh).any():
+                        continue
+                kept_list.append(i)
+                if post_nms_top_n > 0 and \
+                        len(kept_list) >= post_nms_top_n:
+                    break
+                if thresh > 0.5:
+                    thresh *= eta
             kept = np.asarray(kept_list, np.int64)
         elif len(boxes):
             kept = nms(to_tensor(boxes.astype(np.float32)),
                        iou_threshold=nms_thresh,
                        scores=to_tensor(sc.astype(np.float32)),
-                       top_k=post_nms_top_n).numpy()
+                       top_k=post_nms_top_n
+                       if post_nms_top_n > 0 else None).numpy()
         else:
             kept = np.zeros(0, np.int64)
         rois_out.append(boxes[kept])

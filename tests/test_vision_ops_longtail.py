@@ -472,3 +472,85 @@ class TestDetectionLongTail:
                           paddle.to_tensor(im_info),
                           rois_num=paddle.to_tensor(
                               np.array([2, 2], "int32")))
+
+
+class TestNewModelFamilies:
+    """DenseNet/SqueezeNet/ShuffleNetV2/GoogLeNet/InceptionV3/
+    MobileNetV3 (reference: python/paddle/vision/models/)."""
+
+    def _smoke(self, model, size=64, out_shape=None):
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(2, 3, size, size)
+                             .astype("float32"))
+        model.eval()
+        out = model(x)
+        if isinstance(out, tuple):
+            out = out[0]
+        assert out.shape == (out_shape or [2, 10])
+        assert np.isfinite(out.numpy()).all()
+        return out
+
+    def test_densenet121(self):
+        from paddle_tpu.vision.models import densenet121
+        paddle.seed(0)
+        self._smoke(densenet121(num_classes=10))
+
+    def test_squeezenet(self):
+        from paddle_tpu.vision.models import squeezenet1_0, \
+            squeezenet1_1
+        paddle.seed(0)
+        self._smoke(squeezenet1_0(num_classes=10), size=96)
+        self._smoke(squeezenet1_1(num_classes=10), size=96)
+
+    def test_shufflenet(self):
+        from paddle_tpu.vision.models import shufflenet_v2_x0_25, \
+            shufflenet_v2_swish
+        paddle.seed(0)
+        self._smoke(shufflenet_v2_x0_25(num_classes=10))
+        self._smoke(shufflenet_v2_swish(num_classes=10))
+
+    def test_googlenet_aux_heads(self):
+        from paddle_tpu.vision.models import googlenet
+        paddle.seed(0)
+        m = googlenet(num_classes=10)
+        m.eval()
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(2, 3, 64, 64).astype("float32"))
+        main, aux1, aux2 = m(x)
+        assert main.shape == [2, 10]
+        assert aux1.shape == [2, 10] and aux2.shape == [2, 10]
+
+    def test_mobilenet_v3(self):
+        from paddle_tpu.vision.models import mobilenet_v3_small
+        paddle.seed(0)
+        self._smoke(mobilenet_v3_small(num_classes=10))
+
+    def test_inception_v3(self):
+        from paddle_tpu.vision.models import inception_v3
+        paddle.seed(0)
+        self._smoke(inception_v3(num_classes=10), size=299)
+
+    def test_densenet_trains(self):
+        from paddle_tpu.vision.models import densenet121
+        import paddle_tpu.optimizer as opt
+        import paddle_tpu.nn.functional as F
+        paddle.seed(0)
+        m = densenet121(num_classes=4)
+        sgd = opt.Momentum(learning_rate=0.05, momentum=0.9,
+                           parameters=m.parameters())
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(4, 3, 32, 32).astype("float32"))
+        y = paddle.to_tensor(np.arange(4) % 4)
+        losses = []
+        for _ in range(3):
+            loss = F.cross_entropy(m(x), y)
+            loss.backward()
+            sgd.step()
+            sgd.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_pretrained_raises_no_egress(self):
+        from paddle_tpu.vision.models import densenet121
+        with pytest.raises(RuntimeError, match="egress"):
+            densenet121(pretrained=True)
