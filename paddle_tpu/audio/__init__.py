@@ -8,5 +8,9 @@ paddle_tpu.signal.stft — one XLA program per feature pipeline.
 """
 from . import functional  # noqa: F401
 from . import features  # noqa: F401
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
+from .backends import load, save, info  # noqa: F401
 
-__all__ = ["functional", "features"]
+__all__ = ["functional", "features", "backends", "datasets", "load",
+           "save", "info"]

@@ -1,0 +1,122 @@
+"""paddle.audio.backends: WAV I/O (load/save/info).
+
+Reference: python/paddle/audio/backends/wave_backend.py — the stdlib
+`wave`-module backend (PCM16 WAV only); init_backend.py backend
+selection. TPU build ships the wave backend only (soundfile is not in
+the image), so `list_available_backends() == ["wave_backend"]`.
+"""
+from __future__ import annotations
+
+import wave
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ["info", "load", "save", "list_available_backends",
+           "get_current_backend", "set_backend", "AudioInfo"]
+
+AudioInfo = namedtuple("AudioInfo", ["sample_rate", "num_frames",
+                                     "num_channels", "bits_per_sample",
+                                     "encoding"])
+
+
+def list_available_backends():
+    """reference: backends/init_backend.py:37."""
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    """reference: backends/init_backend.py:93."""
+    return "wave_backend"
+
+
+def set_backend(backend_name):
+    """reference: backends/init_backend.py:135."""
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            f"backend {backend_name!r} unavailable: only the stdlib "
+            f"wave backend (PCM16 WAV) ships in this build")
+
+
+def info(filepath):
+    """reference: backends/wave_backend.py:37 — (sample_rate,
+    num_frames, num_channels, bits_per_sample, encoding)."""
+    own = not hasattr(filepath, "read")
+    file_obj = open(filepath, "rb") if own else filepath
+    try:
+        f = wave.open(file_obj)
+    except wave.Error:
+        if own:
+            file_obj.close()
+        raise NotImplementedError(
+            "wave backend supports PCM16 WAV files only")
+    try:
+        return AudioInfo(f.getframerate(), f.getnframes(),
+                         f.getnchannels(), f.getsampwidth() * 8,
+                         "PCM_S")
+    finally:
+        if own:
+            file_obj.close()
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """reference: backends/wave_backend.py:89 — returns
+    (waveform Tensor, sample_rate); float32 in (-1, 1) when normalize
+    else raw int16 values; (channels, time) when channels_first."""
+    from ..core.tensor import to_tensor
+    own = not hasattr(filepath, "read")
+    file_obj = open(filepath, "rb") if own else filepath
+    try:
+        f = wave.open(file_obj)
+    except wave.Error:
+        if own:
+            file_obj.close()
+        raise NotImplementedError(
+            "wave backend supports PCM16 WAV files only")
+    if f.getsampwidth() != 2:
+        if own:
+            file_obj.close()
+        raise NotImplementedError(
+            f"wave backend supports PCM16 only, got "
+            f"{f.getsampwidth() * 8}-bit samples")
+    channels = f.getnchannels()
+    sample_rate = f.getframerate()
+    frames = f.getnframes()
+    raw = f.readframes(frames)
+    if own:
+        file_obj.close()
+    audio = np.frombuffer(raw, dtype=np.int16).astype(np.float32)
+    if normalize:
+        audio = audio / (2 ** 15)
+    waveform = audio.reshape(frames, channels)
+    if num_frames != -1:
+        waveform = waveform[frame_offset:frame_offset + num_frames, :]
+    elif frame_offset:
+        waveform = waveform[frame_offset:, :]
+    if channels_first:
+        waveform = waveform.T
+    return to_tensor(np.ascontiguousarray(waveform)), sample_rate
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding=None, bits_per_sample=16):
+    """reference: backends/wave_backend.py:168 — PCM16 WAV only."""
+    if bits_per_sample not in (None, 16):
+        raise NotImplementedError("wave backend saves PCM16 only")
+    from ..core.tensor import Tensor
+    arr = np.asarray(src._value if isinstance(src, Tensor) else src)
+    if channels_first:
+        arr = arr.T                      # -> (time, channels)
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    if arr.dtype.kind == "f":
+        arr = np.clip(arr, -1.0, 1.0 - 1.0 / (2 ** 15))
+        arr = (arr * (2 ** 15)).astype(np.int16)
+    else:
+        arr = arr.astype(np.int16)
+    with wave.open(str(filepath), "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(arr).tobytes())

@@ -1,0 +1,163 @@
+"""paddle.audio.datasets: ESC50 and TESS audio-classification sets.
+
+Reference: python/paddle/audio/datasets/{dataset.py,esc50.py,tess.py}.
+Same directory layouts as the reference's extracted archives
+(ESC-50-master/{meta/esc50.csv,audio/*.wav}; TESS_Toronto_emotional_
+speech_set_data/<emotion dirs>/*.wav), loaded from a local `data_dir`;
+automatic download raises (no network egress).
+"""
+from __future__ import annotations
+
+import collections
+import csv
+import os
+
+import numpy as np
+
+from ..io import Dataset
+from . import backends, features
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]
+
+_FEAT_FUNCS = {
+    "raw": None,
+    "spectrogram": lambda **kw: features.Spectrogram(**kw),
+    "melspectrogram": lambda **kw: features.MelSpectrogram(**kw),
+    "logmelspectrogram": lambda **kw: features.LogMelSpectrogram(**kw),
+    "mfcc": lambda **kw: features.MFCC(**kw),
+}
+
+
+def _no_download(name):
+    raise RuntimeError(
+        f"{name}: automatic download is unavailable (no network "
+        f"egress). Pass data_dir= pointing at the extracted archive in "
+        f"the reference layout.")
+
+
+class AudioClassificationDataset(Dataset):
+    """reference: audio/datasets/dataset.py:29 — records are
+    {'feat', 'label'} pairs; feat_type selects the feature pipeline."""
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **kwargs):
+        super().__init__()
+        if feat_type not in _FEAT_FUNCS:
+            raise RuntimeError(
+                f"Unknown feat_type: {feat_type}, it must be one in "
+                f"{list(_FEAT_FUNCS)}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = kwargs
+        self._extractor = None  # built once on first fetch
+
+    def _convert_to_record(self, idx):
+        from ..ops import manipulation
+        file, label = self.files[idx], self.labels[idx]
+        waveform, sample_rate = backends.load(file)
+        self.sample_rate = sample_rate
+        if waveform.ndim == 2:
+            waveform = manipulation.squeeze(waveform, axis=0)
+        feat_func = _FEAT_FUNCS[self.feat_type]
+        if feat_func is not None:
+            if self._extractor is None:
+                kw = dict(self.feat_config)
+                if self.feat_type != "spectrogram":
+                    kw.setdefault("sr", self.sample_rate)
+                self._extractor = feat_func(**kw)
+            feat = self._extractor(
+                manipulation.unsqueeze(waveform, axis=0))
+            feat = manipulation.squeeze(feat, axis=0)
+        else:
+            feat = waveform
+        return np.asarray(feat._value), np.asarray(label, np.int64)
+
+    def __getitem__(self, idx):
+        return self._convert_to_record(idx)
+
+    def __len__(self):
+        return len(self.files)
+
+
+class ESC50(AudioClassificationDataset):
+    """reference: audio/datasets/esc50.py:26 — 2000 clips / 50 classes,
+    5 official folds; `mode='dev'` takes all folds but split_fold,
+    `mode='test'` takes split_fold."""
+
+    meta = os.path.join("ESC-50-master", "meta", "esc50.csv")
+    audio_path = os.path.join("ESC-50-master", "audio")
+    meta_info = collections.namedtuple(
+        "META_INFO", ("filename", "fold", "target", "category",
+                      "esc10", "src_file", "take"))
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 data_dir=None, archive=None, **kwargs):
+        if data_dir is None:
+            _no_download(type(self).__name__)
+        self.data_dir = data_dir
+        files, labels = self._get_data(mode, split)
+        super().__init__(files, labels, feat_type, **kwargs)
+
+    def _get_meta_info(self):
+        ret = []
+        with open(os.path.join(self.data_dir, self.meta)) as rf:
+            for i, line in enumerate(csv.reader(rf)):
+                if i == 0:
+                    continue
+                ret.append(self.meta_info(*line))
+        return ret
+
+    def _get_data(self, mode, split):
+        files, labels = [], []
+        for info in self._get_meta_info():
+            take = (int(info.fold) != split if mode in ("train", "dev")
+                    else int(info.fold) == split)
+            if take:
+                files.append(os.path.join(self.data_dir,
+                                          self.audio_path,
+                                          info.filename))
+                labels.append(int(info.target))
+        return files, labels
+
+
+class TESS(AudioClassificationDataset):
+    """reference: audio/datasets/tess.py:26 — 2800 clips / 7 emotions,
+    split by (n_folds, split) on a per-emotion round-robin."""
+
+    archive_dir = "TESS_Toronto_emotional_speech_set_data"
+    label_list = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                  "sad"]
+
+    def __init__(self, mode="train", n_folds=5, split=1,
+                 feat_type="raw", data_dir=None, archive=None,
+                 **kwargs):
+        if not 1 <= split <= n_folds:
+            raise ValueError(
+                f"split must be in [1, {n_folds}], got {split}")
+        if data_dir is None:
+            _no_download(type(self).__name__)
+        self.data_dir = data_dir
+        files, labels = self._get_data(mode, n_folds, split)
+        super().__init__(files, labels, feat_type, **kwargs)
+
+    def _get_data(self, mode, n_folds, split):
+        wavs = []
+        root = os.path.join(self.data_dir, self.archive_dir)
+        for base, _, names in sorted(os.walk(root)):
+            for name in sorted(names):
+                if name.endswith(".wav"):
+                    wavs.append(os.path.join(base, name))
+        files, labels = [], []
+        for i, path in enumerate(wavs):
+            fold = i % n_folds + 1
+            take = (fold != split if mode in ("train", "dev")
+                    else fold == split)
+            if take:
+                # OAF_word_emotion.wav -> emotion
+                emotion = os.path.splitext(
+                    os.path.basename(path))[0].split("_")[-1].lower()
+                files.append(path)
+                labels.append(self.label_list.index(emotion))
+        return files, labels

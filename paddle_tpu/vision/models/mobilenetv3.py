@@ -90,6 +90,8 @@ class _MobileNetV3(nn.Layer):
             in_ch = o
         self.blocks = nn.Sequential(*blocks)
         lexp = _make_divisible(last_exp * scale)
+        last_ch = _make_divisible(last_ch * scale, 8)  # reference:
+        # mobilenetv3.py last_channel = _make_divisible(1024|1280 * scale, 8)
         self.conv_last = nn.Sequential(
             nn.Conv2D(in_ch, lexp, 1, bias_attr=False),
             nn.BatchNorm2D(lexp), nn.Hardswish())

@@ -368,3 +368,81 @@ class TestTransformsLongTail:
             atol=1e-4)
         ld = t.forward_log_det_jacobian(paddle.to_tensor(x))
         assert ld.shape == [2] and np.isfinite(ld.numpy()).all()
+
+
+class TestAudioBackendsDatasets:
+    """paddle.audio.backends (wave PCM16 load/save/info) and
+    paddle.audio.datasets (ESC50/TESS layouts). Reference:
+    audio/backends/wave_backend.py, audio/datasets/{esc50,tess}.py."""
+
+    def _write_wav(self, path, sr=16000, n=1600, channels=1):
+        import wave as _wave
+        t = np.linspace(0, 1, n).astype(np.float32)
+        sig = (0.25 * np.sin(2 * np.pi * 440 * t) *
+               (2 ** 15)).astype(np.int16)
+        if channels == 2:
+            sig = np.stack([sig, sig], -1).reshape(-1)
+        with _wave.open(str(path), "wb") as f:
+            f.setnchannels(channels)
+            f.setsampwidth(2)
+            f.setframerate(sr)
+            f.writeframes(sig.tobytes())
+
+    def test_wave_roundtrip(self, tmp_path):
+        import paddle_tpu.audio as audio
+        p = tmp_path / "a.wav"
+        sr = 8000
+        wav = paddle.to_tensor(
+            (np.sin(np.linspace(0, 20, 800)) * 0.3)
+            .astype("float32")[None, :])
+        audio.save(str(p), wav, sr)
+        meta = audio.info(str(p))
+        assert meta.sample_rate == sr and meta.num_channels == 1
+        assert meta.bits_per_sample == 16 and meta.num_frames == 800
+        got, sr2 = audio.load(str(p))
+        assert sr2 == sr and got.shape == [1, 800]
+        np.testing.assert_allclose(got.numpy(), wav.numpy(), atol=1e-3)
+        assert audio.backends.list_available_backends() == \
+            ["wave_backend"]
+        with pytest.raises(NotImplementedError):
+            audio.backends.set_backend("soundfile")
+
+    def test_esc50_layout(self, tmp_path):
+        from paddle_tpu.audio.datasets import ESC50
+        root = tmp_path
+        audio_dir = root / "ESC-50-master" / "audio"
+        meta_dir = root / "ESC-50-master" / "meta"
+        audio_dir.mkdir(parents=True)
+        meta_dir.mkdir(parents=True)
+        rows = ["filename,fold,target,category,esc10,src_file,take"]
+        for i in range(4):
+            name = f"1-{i}-A-{i % 2}.wav"
+            self._write_wav(audio_dir / name, n=400)
+            rows.append(f"{name},{i % 2 + 1},{i % 2},cat,False,x,A")
+        (meta_dir / "esc50.csv").write_text("\n".join(rows) + "\n")
+        train = ESC50(mode="train", split=1, data_dir=str(root))
+        test = ESC50(mode="test", split=1, data_dir=str(root))
+        assert len(train) + len(test) == 4
+        feat, label = train[0]
+        assert feat.shape == (400,) and label in (0, 1)
+        with pytest.raises(RuntimeError, match="no network egress"):
+            ESC50()
+
+    def test_tess_layout_and_mfcc_feat(self, tmp_path):
+        from paddle_tpu.audio.datasets import TESS
+        root = tmp_path / "TESS_Toronto_emotional_speech_set_data"
+        for emo in ("angry", "happy"):
+            d = root / f"OAF_{emo}"
+            d.mkdir(parents=True)
+            for i in range(3):
+                self._write_wav(d / f"OAF_w{i}_{emo}.wav", n=512)
+        ds = TESS(mode="train", n_folds=3, split=1,
+                  data_dir=str(tmp_path))
+        assert len(ds) == 4  # 6 clips, fold 1 held out
+        feat, label = ds[0]
+        assert label in (0, 3)  # angry / happy
+        mf = TESS(mode="test", n_folds=3, split=1,
+                  data_dir=str(tmp_path), feat_type="mfcc",
+                  n_mfcc=13, n_fft=256)
+        feat2, _ = mf[0]
+        assert feat2.shape[0] == 13
