@@ -38,18 +38,34 @@ def set_backend(backend_name):
             f"wave backend (PCM16 WAV) ships in this build")
 
 
-def info(filepath):
-    """reference: backends/wave_backend.py:37 — (sample_rate,
-    num_frames, num_channels, bits_per_sample, encoding)."""
+def _open_wave(filepath, require_pcm16=False):
+    """Shared open path: returns (wave_reader, file_obj, own). Caller
+    closes file_obj only when own is True (caller-supplied handles stay
+    open)."""
     own = not hasattr(filepath, "read")
     file_obj = open(filepath, "rb") if own else filepath
     try:
         f = wave.open(file_obj)
+        if require_pcm16 and f.getsampwidth() != 2:
+            raise NotImplementedError(
+                f"wave backend supports PCM16 only, got "
+                f"{f.getsampwidth() * 8}-bit samples")
     except wave.Error:
         if own:
             file_obj.close()
         raise NotImplementedError(
             "wave backend supports PCM16 WAV files only")
+    except NotImplementedError:
+        if own:
+            file_obj.close()
+        raise
+    return f, file_obj, own
+
+
+def info(filepath):
+    """reference: backends/wave_backend.py:37 — (sample_rate,
+    num_frames, num_channels, bits_per_sample, encoding)."""
+    f, file_obj, own = _open_wave(filepath)
     try:
         return AudioInfo(f.getframerate(), f.getnframes(),
                          f.getnchannels(), f.getsampwidth() * 8,
@@ -65,21 +81,7 @@ def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
     (waveform Tensor, sample_rate); float32 in (-1, 1) when normalize
     else raw int16 values; (channels, time) when channels_first."""
     from ..core.tensor import to_tensor
-    own = not hasattr(filepath, "read")
-    file_obj = open(filepath, "rb") if own else filepath
-    try:
-        f = wave.open(file_obj)
-    except wave.Error:
-        if own:
-            file_obj.close()
-        raise NotImplementedError(
-            "wave backend supports PCM16 WAV files only")
-    if f.getsampwidth() != 2:
-        if own:
-            file_obj.close()
-        raise NotImplementedError(
-            f"wave backend supports PCM16 only, got "
-            f"{f.getsampwidth() * 8}-bit samples")
+    f, file_obj, own = _open_wave(filepath, require_pcm16=True)
     channels = f.getnchannels()
     sample_rate = f.getframerate()
     frames = f.getnframes()

@@ -54,6 +54,57 @@ def _dispatch_ifelse(pred, true_fn, false_fn, args):
     return true_fn(*args) if pred else false_fn(*args)
 
 
+def _dispatch_for_range(start, stop, step, body_fn, args,
+                        target_default=None):
+    """for <target> in range(start, stop, step): functionalized. Python
+    ints run the real for loop; Tensor bounds lower to while_loop.
+    Returns (last_target_value, *carried); on an EMPTY range the target
+    keeps `target_default` (its pre-loop binding), matching Python."""
+    from ..core.tensor import Tensor
+    if not any(isinstance(v, Tensor) for v in (start, stop, step)):
+        vars_ = list(args)
+        i = target_default
+        for i in range(start, stop, step):
+            out = body_fn(i, *vars_)
+            vars_ = list(out) if isinstance(out, (list, tuple)) \
+                else [out]
+        return (i,) + tuple(vars_)
+    from ..ops import control_flow
+    from ..ops.creation import to_tensor
+    import numpy as _np
+
+    def _t(v):
+        return v if isinstance(v, Tensor) else \
+            to_tensor(_np.asarray(v, _np.int64))
+
+    start, stop = _t(start), _t(stop)
+    step_is_pos = not isinstance(step, Tensor) and step > 0
+    step_is_neg = not isinstance(step, Tensor) and step < 0
+    step = _t(step)
+    last0 = _t(target_default) if isinstance(
+        target_default, (int, Tensor)) else start - step
+
+    if step_is_pos:
+        def cond_fn(i, last, *vs):
+            return i < stop
+    elif step_is_neg:
+        def cond_fn(i, last, *vs):
+            return i > stop
+    else:
+        def cond_fn(i, last, *vs):
+            return ((step > 0) & (i < stop)) | \
+                ((step < 0) & (i > stop))
+
+    def loop_body(i, last, *vs):
+        out = body_fn(i, *vs)
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        return [i + step, i] + out
+
+    final = control_flow.while_loop(cond_fn, loop_body,
+                                    [start, last0] + list(args))
+    return (final[1],) + tuple(final[2:])
+
+
 def _dispatch_while(cond_fn, body_fn, args):
     from ..core.tensor import Tensor
     vars_ = list(args)
@@ -68,7 +119,10 @@ def _dispatch_while(cond_fn, body_fn, args):
     return tuple(vars_)
 
 
-cfg_helpers = {_IFELSE: _dispatch_ifelse, _WHILE: _dispatch_while}
+_FORRANGE = "__pt_forrange"
+
+cfg_helpers = {_IFELSE: _dispatch_ifelse, _WHILE: _dispatch_while,
+               _FORRANGE: _dispatch_for_range}
 
 
 # -- analysis helpers ---------------------------------------------------------
@@ -208,6 +262,10 @@ class _Converter:
             return self._if(st, bound)
         if isinstance(st, ast.While):
             return self._while(st, bound)
+        if isinstance(st, ast.For):
+            converted = self._for_range(st, bound)
+            if converted is not None:
+                return converted
         # recurse into other compound statements' blocks
         if isinstance(st, (ast.For, ast.With, ast.Try)):
             for field in ("body", "orelse", "finalbody"):
@@ -278,6 +336,52 @@ class _Converter:
              ast.Name(id=ffn.name, ctx=ast.Load())], params)
         self.changed = True
         return [tfn, ffn, ast.Return(value=call)]
+
+    def _for_range(self, node: ast.For, bound):
+        """`for <name> in range(...)` -> __pt_forrange dispatch (the
+        reference's loop_transformer for-range case). Returns None to
+        keep the original statement."""
+        it = node.iter
+        if not (isinstance(it, ast.Call)
+                and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3):
+            return None
+        if not isinstance(node.target, ast.Name) or node.orelse:
+            return None
+        # eligibility checks on the RAW body — bailing after conversion
+        # would hand an already-converted body to the generic recursion
+        if _has_unsupported(node.body):
+            return None
+        carried = sorted(_assigned_names(node.body) -
+                         {node.target.id})
+        if not carried or any(c not in bound for c in carried):
+            # side-effect-only bodies cannot be functionalized (under
+            # tracing the body would run once); keep python semantics
+            return None
+        node.body = self._block(node.body, set(bound))
+        a = it.args
+        start = a[0] if len(a) > 1 else ast.Constant(value=0)
+        stop = a[1] if len(a) > 1 else a[0]
+        step = a[2] if len(a) > 2 else ast.Constant(value=1)
+        i = self.n
+        self.n += 1
+        bfn = _make_fn(_WBODY.format(n=i), [node.target.id] + carried,
+                       node.body, carried)
+        tdefault = (ast.Name(id=node.target.id, ctx=ast.Load())
+                    if node.target.id in bound
+                    else ast.Constant(value=None))
+        call = ast.Call(
+            func=ast.Name(id=_FORRANGE, ctx=ast.Load()),
+            args=[start, stop, step,
+                  ast.Name(id=bfn.name, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Name(id=c, ctx=ast.Load())
+                                  for c in carried], ctx=ast.Load()),
+                  tdefault],
+            keywords=[])
+        assign = _unpack_assign([node.target.id] + carried, call)
+        self.changed = True
+        return [bfn, assign]
 
     def _while(self, node: ast.While, bound):
         node.body = self._block(node.body, set(bound))

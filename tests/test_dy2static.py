@@ -252,3 +252,100 @@ class TestReturnStyleIf:
         assert g(5, flag=False) == 6
         assert g(5, flag=True) == 10
         assert g(0, flag=True) == 1
+
+
+class TestForRangeConversion:
+    def test_tensor_bound_for_range(self):
+        @jit.to_static
+        def f(x, n):
+            s = paddle.zeros_like(x)
+            for i in range(n):
+                s = s + x
+            return s
+
+        x = np.array([1.0, 2.0], "float32")
+        n = paddle.to_tensor(np.asarray(4))
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(x), n).numpy(), x * 4)
+
+    def test_python_bound_for_range_unchanged(self):
+        @jit.to_static
+        def f(x):
+            y = x
+            for i in range(3):
+                y = y + float(i)
+            return y
+
+        x = np.zeros(2, "float32")
+        np.testing.assert_allclose(f(paddle.to_tensor(x)).numpy(),
+                                   x + 3.0)
+
+    def test_loop_var_visible_after(self):
+        from paddle_tpu.jit.dy2static import convert_control_flow
+
+        def f(x):
+            acc = x
+            for i in range(2, 8, 2):
+                acc = acc + i
+            return acc, i
+
+        g = convert_control_flow(f)
+        out, last = g(paddle.to_tensor(np.zeros(1, "float32")))
+        np.testing.assert_allclose(out.numpy(), [12.0])  # 2+4+6
+        assert int(last) == 6
+
+    def test_for_with_start_stop_step_tensor(self):
+        @jit.to_static
+        def f(x, n):
+            s = paddle.zeros_like(x)
+            for i in range(1, n, 2):
+                s = s + x * float(1.0)
+            return s
+
+        x = np.array([1.0], "float32")
+        got = f(paddle.to_tensor(x), paddle.to_tensor(np.asarray(6)))
+        np.testing.assert_allclose(got.numpy(), x * 3)  # i = 1,3,5
+
+    def test_for_over_list_untouched(self):
+        from paddle_tpu.jit.dy2static import convert_control_flow
+
+        def f(x):
+            for v in [1.0, 2.0]:
+                x = x + v
+            return x
+
+        g = convert_control_flow(f)
+        np.testing.assert_allclose(
+            g(paddle.to_tensor(np.zeros(1, "float32"))).numpy(), [3.0])
+
+    def test_empty_range_preserves_prebound_target(self):
+        """code-review regression: empty range must leave the target's
+        prior binding intact (python semantics)."""
+        from paddle_tpu.jit.dy2static import convert_control_flow
+
+        def f(x):
+            i = 5
+            acc = x
+            for i in range(0):
+                acc = acc + 1.0
+            return acc * i
+
+        g = convert_control_flow(f)
+        np.testing.assert_allclose(
+            g(paddle.to_tensor(np.ones(1, "float32"))).numpy(), [5.0])
+
+    def test_side_effect_only_body_stays_python(self):
+        """code-review regression: a body with no carried assignments
+        (only side effects) must NOT be functionalized — under tracing
+        it would run once."""
+        from paddle_tpu.jit.dy2static import convert_control_flow
+
+        def f(x, n):
+            outs = []
+            for i in range(n):
+                outs.append(x)
+            return len(outs)
+
+        g = convert_control_flow(f)
+        # python int bound: works, appends 3 times
+        assert g(paddle.to_tensor(np.ones(1, "float32")), 3) == 3
