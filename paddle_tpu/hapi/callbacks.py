@@ -323,3 +323,103 @@ class EarlyStopping(Callback):
         if (self.save_best_model and self.best_weights is not None
                 and self.model is not None):
             self.model.network.set_state_dict(self.best_weights)
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the optimizer learning rate when a monitored metric
+    plateaus (reference: callbacks.py:1169 ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="auto", min_delta=1e-4, cooldown=0,
+                 min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        if factor >= 1.0:
+            raise ValueError("factor should be < 1.0")
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.cooldown_counter = 0
+        self.wait = 0
+        if mode not in ("auto", "min", "max"):
+            mode = "auto"
+        if mode == "min" or (mode == "auto" and "acc" not in monitor):
+            self.monitor_op = lambda a, b: np.less(a, b - min_delta)
+            self.best = np.inf
+        else:
+            self.monitor_op = lambda a, b: np.greater(a, b + min_delta)
+            self.best = -np.inf
+
+    def _in_cooldown(self):
+        return self.cooldown_counter > 0
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        current = logs.get(self.monitor)
+        if current is None:
+            return
+        if isinstance(current, (list, tuple, np.ndarray)):
+            current = float(np.asarray(current).reshape(-1)[0])
+        if self._in_cooldown():
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self.monitor_op(current, self.best):
+            self.best = current
+            self.wait = 0
+        elif not self._in_cooldown():
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = getattr(self.model, "_optimizer", None)
+                if opt is None:
+                    return
+                old_lr = float(opt.get_lr())
+                new_lr = max(old_lr * self.factor, self.min_lr)
+                if old_lr - new_lr > 1e-12:
+                    opt.set_lr(new_lr)
+                    if self.verbose:
+                        print(f"Epoch {epoch}: ReduceLROnPlateau "
+                              f"reducing learning rate to {new_lr}.")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (reference: callbacks.py:880 VisualDL).
+    The visualdl package is not in this image, so scalars append to
+    `<log_dir>/scalars.jsonl` — same call sites and tags; point any
+    scalar viewer at the jsonl."""
+
+    def __init__(self, log_dir="./log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._step = {"train": 0, "eval": 0}
+
+    def _write(self, mode, logs):
+        import json
+        import os
+        logs = logs or {}
+        os.makedirs(self.log_dir, exist_ok=True)
+        path = os.path.join(self.log_dir, "scalars.jsonl")
+        with open(path, "a") as f:
+            for k in logs:
+                if k in ("batch_size", "steps", "num_samples"):
+                    continue
+                v = logs[k]
+                if isinstance(v, (list, tuple, np.ndarray)):
+                    v = float(np.asarray(v).reshape(-1)[0])
+                f.write(json.dumps({"tag": f"{mode}/{k}",
+                                    "step": self._step[mode],
+                                    "value": float(v)}) + "\n")
+        self._step[mode] += 1
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._write("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs)
+
+
+__all__ += ["ReduceLROnPlateau", "VisualDL"]

@@ -3,6 +3,7 @@ transforms/). Operate on HWC uint8/float numpy (or PIL if installed);
 ToTensor produces CHW float32 scaled to [0,1] like the reference."""
 from __future__ import annotations
 
+import math
 import numbers
 import random as pyrandom
 
@@ -272,3 +273,285 @@ class Pad(BaseTransform):
 
     def _apply_image(self, img):
         return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class ContrastTransform(BaseTransform):
+    """reference: transforms.py:831 — blend with the grayscale mean."""
+
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _as_hwc(img)
+        img = _as_hwc(img)
+        dtype = img.dtype
+        # reference draws from [max(0, 1-value), 1+value] — never negative
+        alpha = pyrandom.uniform(max(0.0, 1 - self.value),
+                                 1 + self.value)
+        f = img.astype(np.float32)
+        mean = _grayscale_np(f).mean()
+        out = np.clip(f * alpha + mean * (1 - alpha), 0,
+                      255 if np.issubdtype(dtype, np.integer) else None)
+        return out.astype(dtype)
+
+
+class SaturationTransform(BaseTransform):
+    """reference: transforms.py:876 — blend with per-pixel grayscale."""
+
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _as_hwc(img)
+        img = _as_hwc(img)
+        dtype = img.dtype
+        alpha = pyrandom.uniform(max(0.0, 1 - self.value),
+                                 1 + self.value)
+        f = img.astype(np.float32)
+        gray = _grayscale_np(f)
+        out = np.clip(f * alpha + gray * (1 - alpha), 0,
+                      255 if np.issubdtype(dtype, np.integer) else None)
+        return out.astype(dtype)
+
+
+class HueTransform(BaseTransform):
+    """reference: transforms.py:919 — rotate hue in HSV space;
+    value in [0, 0.5]."""
+
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _as_hwc(img)
+        img = _as_hwc(img)
+        if img.shape[-1] == 1:
+            return img  # L-mode images pass through (PIL semantics)
+        dtype = img.dtype
+        shift = pyrandom.uniform(-self.value, self.value)
+        f = img.astype(np.float32)
+        scale = 255.0 if np.issubdtype(dtype, np.integer) else 1.0
+        h, s, v = _rgb_to_hsv_np(f / scale)
+        h = (h + shift) % 1.0
+        out = _hsv_to_rgb_np(h, s, v) * scale
+        return np.clip(out, 0, scale if scale > 1 else None) \
+            .astype(dtype)
+
+
+class ColorJitter(BaseTransform):
+    """reference: transforms.py:964 — random order of brightness/
+    contrast/saturation/hue jitters."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = list(range(4))
+        pyrandom.shuffle(order)
+        for i in order:
+            img = self.transforms[i]._apply_image(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    """reference: transforms.py:1676 — ITU-R 601-2 luma transform."""
+
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        dtype = img.dtype
+        gray = _grayscale_np(img.astype(np.float32))
+        out = np.repeat(gray, self.num_output_channels, axis=-1)
+        return out.astype(dtype)
+
+
+class RandomRotation(BaseTransform):
+    """reference: transforms.py:1441 — rotate by a random angle
+    (nearest-neighbor inverse mapping, constant fill)."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.expand = expand
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = pyrandom.uniform(*self.degrees)
+        img = _as_hwc(img)
+        out_shape = None
+        if self.expand:
+            h, w = img.shape[:2]
+            a = math.radians(angle)
+            nw = int(round(abs(w * math.cos(a)) + abs(h * math.sin(a))))
+            nh = int(round(abs(h * math.cos(a)) + abs(w * math.sin(a))))
+            out_shape = (nh, nw)
+        return _affine_np(img, angle=angle, fill=self.fill,
+                          out_shape=out_shape)
+
+
+class RandomAffine(BaseTransform):
+    """reference: transforms.py:1277 — rotation + translate + scale +
+    shear with nearest-neighbor inverse mapping."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None,
+                 keys=None):
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.translate = translate
+        self.scale_range = scale
+        self.shear = shear
+        self.fill = fill
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        angle = pyrandom.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = pyrandom.uniform(-self.translate[0],
+                                  self.translate[0]) * w
+            ty = pyrandom.uniform(-self.translate[1],
+                                  self.translate[1]) * h
+        sc = 1.0
+        if self.scale_range is not None:
+            sc = pyrandom.uniform(*self.scale_range)
+        shx = shy = 0.0
+        if self.shear is not None:
+            shr = self.shear if isinstance(self.shear, (list, tuple)) \
+                else (-abs(self.shear), abs(self.shear))
+            shx = pyrandom.uniform(shr[0], shr[1])
+            if len(shr) == 4:  # [min_x, max_x, min_y, max_y]
+                shy = pyrandom.uniform(shr[2], shr[3])
+        return _affine_np(img, angle=angle, translate=(tx, ty),
+                          scale=sc, shear=(shx, shy), fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """reference: transforms.py:1723 — erase a random rectangle."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        img = _as_hwc(img).copy()
+        if pyrandom.random() > self.prob:
+            return img
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = pyrandom.uniform(*self.scale) * area
+            ar = math.exp(pyrandom.uniform(math.log(self.ratio[0]),
+                                           math.log(self.ratio[1])))
+            eh = int(round(math.sqrt(target * ar)))
+            ew = int(round(math.sqrt(target / ar)))
+            if eh < h and ew < w and eh > 0 and ew > 0:
+                top = pyrandom.randint(0, h - eh)
+                left = pyrandom.randint(0, w - ew)
+                img[top:top + eh, left:left + ew] = self.value
+                break
+        return img
+
+
+def _grayscale_np(f):
+    """ITU-R 601-2 luma, keepdims (f float HWC)."""
+    if f.shape[-1] == 1:
+        return f
+    return (0.299 * f[..., 0:1] + 0.587 * f[..., 1:2]
+            + 0.114 * f[..., 2:3])
+
+
+def _rgb_to_hsv_np(rgb):
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    mx = np.max(rgb, axis=-1)
+    mn = np.min(rgb, axis=-1)
+    diff = mx - mn + 1e-12
+    h = np.zeros_like(mx)
+    m = mx == r
+    h[m] = ((g - b)[m] / diff[m]) % 6
+    m = mx == g
+    h[m] = (b - r)[m] / diff[m] + 2
+    m = mx == b
+    h[m] = (r - g)[m] / diff[m] + 4
+    h = h / 6.0
+    s = np.where(mx > 0, (mx - mn) / (mx + 1e-12), 0.0)
+    return h, s, mx
+
+
+def _hsv_to_rgb_np(h, s, v):
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(np.int32) % 6
+    out = np.zeros(h.shape + (3,), np.float32)
+    for idx, (rr, gg, bb) in enumerate([(v, t, p), (q, v, p), (p, v, t),
+                                        (p, q, v), (t, p, v),
+                                        (v, p, q)]):
+        m = i == idx
+        out[m, 0] = rr[m]
+        out[m, 1] = gg[m]
+        out[m, 2] = bb[m]
+    return out
+
+
+def _affine_np(img, angle=0.0, translate=(0.0, 0.0), scale=1.0,
+               shear=0.0, fill=0, out_shape=None):
+    """Inverse-mapped nearest-neighbor affine about the image center;
+    out_shape (oh, ow) renders onto an expanded/shrunk canvas whose
+    center maps to the source center (RandomRotation expand=True)."""
+    h, w = img.shape[:2]
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    # PIL/paddle convention: positive angle = counter-clockwise; image
+    # y axis points down, so negate for the math-convention matrix
+    a = -math.radians(angle)
+    if isinstance(shear, (list, tuple)):
+        shx, shy = (math.radians(shear[0]), math.radians(shear[1]))
+    else:
+        shx, shy = math.radians(shear), 0.0
+    # forward matrix M = T(center) R S Sh T(-center) + translate
+    m00 = (math.cos(a) - math.sin(a) * math.tan(shy)) * scale
+    m01 = (-math.sin(a + shx) / max(math.cos(shx), 1e-9)) * scale
+    m10 = (math.sin(a) + math.cos(a) * math.tan(shy)) * scale
+    m11 = (math.cos(a + shx) / max(math.cos(shx), 1e-9)) * scale
+    det = m00 * m11 - m01 * m10
+    if abs(det) < 1e-12:
+        return img
+    i00, i01 = m11 / det, -m01 / det
+    i10, i11 = -m10 / det, m00 / det
+    oh, ow = out_shape if out_shape is not None else (h, w)
+    ocy, ocx = (oh - 1) / 2.0, (ow - 1) / 2.0
+    ys, xs = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+    dx = xs - ocx - translate[0]
+    dy = ys - ocy - translate[1]
+    sx = i00 * dx + i01 * dy + cx
+    sy = i10 * dx + i11 * dy + cy
+    sxr = np.round(sx).astype(np.int64)
+    syr = np.round(sy).astype(np.int64)
+    valid = (sxr >= 0) & (sxr < w) & (syr >= 0) & (syr < h)
+    out = np.full((oh, ow) + img.shape[2:], fill, img.dtype)
+    out[valid] = img[syr[valid], sxr[valid]]
+    return out
+
+
+__all__ += ["ContrastTransform", "SaturationTransform", "HueTransform",
+            "ColorJitter", "Grayscale", "RandomRotation", "RandomAffine",
+            "RandomErasing"]

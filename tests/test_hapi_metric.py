@@ -342,3 +342,49 @@ class TestSummaryFlops:
         b2 = float(np.asarray(m._optimizer._gstate["beta1_pow"]))
         # continued decaying from b1, not reset to 0.9^k
         assert b2 < b1
+
+
+class TestCallbacksLongTail:
+    """ReduceLROnPlateau + VisualDL (reference: hapi/callbacks.py:1169,
+    :880)."""
+
+    def test_reduce_lr_on_plateau(self):
+        from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+
+        class FakeModel:
+            pass
+
+        lin = nn.Linear(2, 1)
+        sgd = opt.SGD(learning_rate=1.0, parameters=lin.parameters())
+        m = FakeModel()
+        m._optimizer = sgd
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=2,
+                               verbose=0)
+        cb.set_model(m) if hasattr(cb, "set_model") else \
+            setattr(cb, "model", m)
+        cb.on_epoch_end(0, {"loss": 1.0})  # sets best
+        cb.on_epoch_end(1, {"loss": 1.0})  # wait=1
+        assert abs(float(sgd.get_lr()) - 1.0) < 1e-9  # not yet
+        cb.on_epoch_end(2, {"loss": 1.0})  # wait=2 -> lr halves
+        assert abs(float(sgd.get_lr()) - 0.5) < 1e-9
+        cb.on_epoch_end(3, {"loss": 0.1})  # improvement resets wait
+        cb.on_epoch_end(4, {"loss": 0.1})
+        assert abs(float(sgd.get_lr()) - 0.5) < 1e-9
+
+    def test_visualdl_writes_jsonl(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import VisualDL
+        import json
+
+        class FakeModel:
+            pass
+
+        cb = VisualDL(log_dir=str(tmp_path))
+        setattr(cb, "model", FakeModel())
+        cb.on_epoch_end(0, {"loss": 0.5, "acc": 0.9})
+        cb.on_eval_end({"loss": 0.4})
+        lines = [json.loads(ln) for ln in
+                 (tmp_path / "scalars.jsonl").read_text().splitlines()]
+        tags = {ln["tag"] for ln in lines}
+        assert "train/loss" in tags and "eval/loss" in tags

@@ -554,3 +554,86 @@ class TestNewModelFamilies:
         from paddle_tpu.vision.models import densenet121
         with pytest.raises(RuntimeError, match="egress"):
             densenet121(pretrained=True)
+
+
+class TestTransformsLongTail:
+    """ColorJitter/Grayscale/RandomRotation/RandomAffine/RandomErasing +
+    contrast/saturation/hue (reference: vision/transforms/transforms.py
+    :831-:1790)."""
+
+    def _img(self):
+        rs = np.random.RandomState(0)
+        return (rs.rand(16, 16, 3) * 255).astype("uint8")
+
+    def test_grayscale(self):
+        from paddle_tpu.vision import transforms as T
+        g1 = T.Grayscale(1)(self._img())
+        g3 = T.Grayscale(3)(self._img())
+        assert g1.shape == (16, 16, 1) and g3.shape == (16, 16, 3)
+        np.testing.assert_array_equal(g3[..., 0], g3[..., 1])
+
+    def test_color_jitter_runs_and_preserves_shape_dtype(self):
+        from paddle_tpu.vision import transforms as T
+        import random as pyrandom
+        pyrandom.seed(0)
+        out = T.ColorJitter(0.4, 0.4, 0.4, 0.2)(self._img())
+        assert out.shape == (16, 16, 3) and out.dtype == np.uint8
+
+    def test_hue_identity_at_zero(self):
+        from paddle_tpu.vision import transforms as T
+        img = self._img()
+        np.testing.assert_array_equal(T.HueTransform(0.0)(img), img)
+        out = T.HueTransform(0.3)(img)
+        assert out.shape == img.shape
+
+    def test_rotation_90_matches_rot90(self):
+        from paddle_tpu.vision.transforms import RandomRotation
+        img = self._img()
+        t = RandomRotation((90, 90))
+        out = t._apply_image(img)
+        # nearest-neighbor rotation by exactly 90 degrees == rot90
+        np.testing.assert_array_equal(out, np.rot90(img, k=1, axes=(0, 1)))
+
+    def test_random_affine_translate_only(self):
+        from paddle_tpu.vision.transforms import RandomAffine
+        img = self._img()
+        t = RandomAffine(degrees=(0, 0))
+        out = t._apply_image(img)
+        np.testing.assert_array_equal(out, img)  # identity affine
+
+    def test_random_erasing(self):
+        from paddle_tpu.vision.transforms import RandomErasing
+        import random as pyrandom
+        pyrandom.seed(3)
+        img = np.full((20, 20, 3), 200, "uint8")
+        out = RandomErasing(prob=1.0, value=0)(img)
+        assert (out == 0).any()
+        assert out.shape == img.shape
+
+    def test_hue_and_jitter_pass_grayscale_through(self):
+        """code-review regression: L-mode images must not crash hue."""
+        from paddle_tpu.vision import transforms as T
+        gray = (np.random.RandomState(0).rand(8, 8) * 255) \
+            .astype("uint8")
+        out = T.HueTransform(0.3)(gray)
+        assert out.shape == (8, 8, 1)
+        out2 = T.ColorJitter(0.4, 0.4, 0.4, 0.2)(gray)
+        assert out2.shape == (8, 8, 1)
+
+    def test_rotation_expand_grows_canvas(self):
+        from paddle_tpu.vision.transforms import RandomRotation
+        img = np.full((10, 20, 3), 255, "uint8")
+        out = RandomRotation((90, 90), expand=True)._apply_image(img)
+        assert out.shape[0] == 20 and out.shape[1] == 10
+
+    def test_affine_y_shear_applied(self):
+        from paddle_tpu.vision.transforms import RandomAffine
+        import random as pyrandom
+        pyrandom.seed(0)
+        img = np.zeros((21, 21, 1), "uint8")
+        img[10, :, 0] = 255  # horizontal line
+        t = RandomAffine(degrees=(0, 0), shear=[0, 0, 30, 30])
+        out = t._apply_image(img)
+        # y-shear tilts the horizontal line: multiple rows now hold it
+        rows = np.nonzero(out[..., 0].sum(axis=1))[0]
+        assert len(rows) > 1
