@@ -375,6 +375,15 @@ class ReduceLROnPlateau(Callback):
                 opt = getattr(self.model, "_optimizer", None)
                 if opt is None:
                     return
+                if getattr(opt, "_lr_scheduler", None) is not None:
+                    import warnings
+                    warnings.warn(
+                        "ReduceLROnPlateau: optimizer is driven by an "
+                        "LRScheduler; skipping the plateau reduction "
+                        "(use one or the other)")
+                    self.cooldown_counter = self.cooldown
+                    self.wait = 0
+                    return
                 old_lr = float(opt.get_lr())
                 new_lr = max(old_lr * self.factor, self.min_lr)
                 if old_lr - new_lr > 1e-12:
@@ -398,8 +407,7 @@ class VisualDL(Callback):
         self._step = {"train": 0, "eval": 0}
 
     def _write(self, mode, logs):
-        import json
-        import os
+        import json  # lightweight; os is module-level
         logs = logs or {}
         os.makedirs(self.log_dir, exist_ok=True)
         path = os.path.join(self.log_dir, "scalars.jsonl")

@@ -81,3 +81,193 @@ def static_pylayer(*args, **kwargs):
     raise NotImplementedError(
         "static_pylayer: use paddle_tpu.autograd.PyLayer in dynamic "
         "mode; the recording Program captures it as one op")
+
+
+def _channel_dim(shape, data_format):
+    """Channel count honoring the layout (NCHW-family vs NHWC-family)."""
+    return shape[-1] if data_format.endswith("C") else shape[1]
+
+
+def conv2d_transpose(input, num_filters, filter_size=None,
+                     output_size=None, stride=1, padding=0, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     act=None, data_format="NCHW", name=None):
+    """reference: static/nn/common.py conv2d_transpose."""
+    from ..nn.layer.conv import Conv2DTranspose
+    import paddle_tpu.nn.functional as F
+    in_ch = _channel_dim(input.shape, data_format)
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError(
+                "conv2d_transpose needs filter_size or output_size")
+        osz = output_size if isinstance(output_size, (list, tuple)) \
+            else (output_size, output_size)
+        st = stride if isinstance(stride, (list, tuple)) \
+            else (stride, stride)
+        pd = padding if isinstance(padding, (list, tuple)) \
+            else (padding, padding)
+        spatial = input.shape[2:4] if data_format == "NCHW" \
+            else input.shape[1:3]
+        filter_size = tuple(
+            osz[i] + 2 * pd[i] - (spatial[i] - 1) * st[i]
+            for i in range(2))
+    layer = Conv2DTranspose(
+        in_ch, num_filters, filter_size, stride=stride,
+        padding=padding, dilation=dilation, groups=groups,
+        weight_attr=param_attr, bias_attr=bias_attr,
+        data_format=data_format)
+    out = layer(input, output_size=output_size)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, data_format="NCDHW", name=None):
+    """reference: static/nn/common.py conv3d."""
+    from ..nn.layer.conv import Conv3D
+    import paddle_tpu.nn.functional as F
+    layer = Conv3D(_channel_dim(input.shape, data_format), num_filters,
+                   filter_size,
+                   stride=stride, padding=padding, dilation=dilation,
+                   groups=groups, weight_attr=param_attr,
+                   bias_attr=bias_attr, data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def conv3d_transpose(input, num_filters, filter_size=None,
+                     output_size=None, stride=1, padding=0, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     act=None, data_format="NCDHW", name=None):
+    """reference: static/nn/common.py conv3d_transpose."""
+    from ..nn.layer.conv import Conv3DTranspose
+    import paddle_tpu.nn.functional as F
+    if filter_size is None:
+        raise ValueError("conv3d_transpose needs filter_size (derive-"
+                         "from-output_size is 2d-only here)")
+    layer = Conv3DTranspose(
+        _channel_dim(input.shape, data_format), num_filters,
+        filter_size, stride=stride, padding=padding, dilation=dilation,
+        groups=groups, weight_attr=param_attr, bias_attr=bias_attr,
+        data_format=data_format)
+    out = layer(input, output_size=output_size)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """reference: static/nn/common.py layer_norm."""
+    from ..nn.layer.norm import LayerNorm
+    import paddle_tpu.nn.functional as F
+    shape = list(input.shape[begin_norm_axis:])
+    layer = LayerNorm(shape, epsilon=epsilon,
+                      weight_attr=param_attr if scale else False,
+                      bias_attr=bias_attr if shift else False)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    """reference: static/nn/common.py group_norm."""
+    from ..nn.layer.norm import GroupNorm
+    import paddle_tpu.nn.functional as F
+    layer = GroupNorm(groups, _channel_dim(input.shape, data_layout),
+                      epsilon=epsilon,
+                      weight_attr=param_attr, bias_attr=bias_attr)
+    out = layer(input)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    """reference: static/nn/common.py instance_norm."""
+    from ..nn.layer.norm import InstanceNorm2D
+    layer = InstanceNorm2D(input.shape[1], epsilon=epsilon,
+                           weight_attr=param_attr, bias_attr=bias_attr)
+    return layer(input)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW",
+          name=None):
+    """reference: static/nn/common.py prelu (mode: all|channel|element)."""
+    from ..nn.layer.activation import PReLU
+    if mode == "all":
+        num = 1
+    elif mode == "channel":
+        num = _channel_dim(x.shape, data_format)
+    else:
+        # element mode: one slope per element — F.prelu broadcasts on
+        # the channel axis only, so compute it directly
+        from ..core.tensor import Parameter
+        from ..nn import ParamAttr
+        from ..nn.initializer import Constant
+        import jax.numpy as jnp
+        from ..ops import manipulation
+        shape = list(x.shape[1:])
+        init = Constant(0.25)
+        w = Parameter(jnp.full(shape, 0.25, jnp.float32))
+        from ..ops.manipulation import where
+        from ..ops import comparison
+        return where(comparison.greater_than(x, 0.0), x, x * w)
+    layer = PReLU(num_parameters=num, weight_attr=param_attr,
+                  data_format=data_format)
+    return layer(x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference: static/nn/common.py spectral_norm — returns the
+    spectrally-normalized weight."""
+    from ..nn.layer.norm import SpectralNorm
+    layer = SpectralNorm(weight.shape, dim=dim, power_iters=power_iters,
+                         epsilon=eps)
+    return layer(weight)
+
+
+def bilinear_tensor_product(x, y, size, act=None, param_attr=None,
+                            bias_attr=None, name=None):
+    """reference: static/nn/common.py bilinear_tensor_product."""
+    from ..nn.layer.common import Bilinear
+    import paddle_tpu.nn.functional as F
+    layer = Bilinear(x.shape[-1], y.shape[-1], size,
+                     weight_attr=param_attr, bias_attr=bias_attr)
+    out = layer(x, y)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference: static/nn/common.py py_func. Under the recording
+    Program eager execution IS the build, so the python callable runs
+    directly; gradients flow only when func is built from framework
+    ops (a numpy func is non-differentiable, as in the reference)."""
+    if isinstance(x, (list, tuple)):
+        return func(*x)
+    return func(x)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              enable_scale_and_shift=False, name=None, **kwargs):
+    """reference: static/nn/common.py data_norm — normalization by
+    accumulated batch statistics (PS-style); the TPU build folds it to
+    batch_norm with use_global_stats semantics."""
+    return batch_norm(input, act=act, epsilon=epsilon,
+                      param_attr=param_attr)
+
+
+__all__ += ["conv2d_transpose", "conv3d", "conv3d_transpose",
+            "layer_norm", "group_norm", "instance_norm", "prelu",
+            "spectral_norm", "bilinear_tensor_product", "py_func",
+            "data_norm"]

@@ -381,10 +381,14 @@ class RandomRotation(BaseTransform):
 
     def __init__(self, degrees, interpolation="nearest", expand=False,
                  center=None, fill=0, keys=None):
+        if interpolation != "nearest":
+            raise NotImplementedError(
+                "RandomRotation: nearest interpolation only")
         if isinstance(degrees, (int, float)):
             degrees = (-abs(degrees), abs(degrees))
         self.degrees = degrees
         self.expand = expand
+        self.center = center
         self.fill = fill
 
     def _apply_image(self, img):
@@ -398,7 +402,7 @@ class RandomRotation(BaseTransform):
             nh = int(round(abs(h * math.cos(a)) + abs(w * math.sin(a))))
             out_shape = (nh, nw)
         return _affine_np(img, angle=angle, fill=self.fill,
-                          out_shape=out_shape)
+                          out_shape=out_shape, center=self.center)
 
 
 class RandomAffine(BaseTransform):
@@ -408,8 +412,12 @@ class RandomAffine(BaseTransform):
     def __init__(self, degrees, translate=None, scale=None, shear=None,
                  interpolation="nearest", fill=0, center=None,
                  keys=None):
+        if interpolation != "nearest":
+            raise NotImplementedError(
+                "RandomAffine: nearest interpolation only")
         if isinstance(degrees, (int, float)):
             degrees = (-abs(degrees), abs(degrees))
+        self.center = center
         self.degrees = degrees
         self.translate = translate
         self.scale_range = scale
@@ -437,7 +445,8 @@ class RandomAffine(BaseTransform):
             if len(shr) == 4:  # [min_x, max_x, min_y, max_y]
                 shy = pyrandom.uniform(shr[2], shr[3])
         return _affine_np(img, angle=angle, translate=(tx, ty),
-                          scale=sc, shear=(shx, shy), fill=self.fill)
+                          scale=sc, shear=(shx, shy), fill=self.fill,
+                          center=self.center)
 
 
 class RandomErasing(BaseTransform):
@@ -514,12 +523,16 @@ def _hsv_to_rgb_np(h, s, v):
 
 
 def _affine_np(img, angle=0.0, translate=(0.0, 0.0), scale=1.0,
-               shear=0.0, fill=0, out_shape=None):
-    """Inverse-mapped nearest-neighbor affine about the image center;
-    out_shape (oh, ow) renders onto an expanded/shrunk canvas whose
-    center maps to the source center (RandomRotation expand=True)."""
+               shear=0.0, fill=0, out_shape=None, center=None):
+    """Inverse-mapped nearest-neighbor affine about `center` (default:
+    image center); out_shape (oh, ow) renders onto an expanded/shrunk
+    canvas whose center maps to the source center (RandomRotation
+    expand=True)."""
     h, w = img.shape[:2]
-    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    if center is not None:
+        cx, cy = float(center[0]), float(center[1])
+    else:
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
     # PIL/paddle convention: positive angle = counter-clockwise; image
     # y axis points down, so negate for the math-convention matrix
     a = -math.radians(angle)
@@ -538,7 +551,10 @@ def _affine_np(img, angle=0.0, translate=(0.0, 0.0), scale=1.0,
     i00, i01 = m11 / det, -m01 / det
     i10, i11 = -m10 / det, m00 / det
     oh, ow = out_shape if out_shape is not None else (h, w)
-    ocy, ocx = (oh - 1) / 2.0, (ow - 1) / 2.0
+    if center is not None and out_shape is None:
+        ocy, ocx = cy, cx
+    else:
+        ocy, ocx = (oh - 1) / 2.0, (ow - 1) / 2.0
     ys, xs = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
     dx = xs - ocx - translate[0]
     dy = ys - ocy - translate[1]

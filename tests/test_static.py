@@ -328,3 +328,62 @@ class TestProgramIntrospection:
         got_t = loaded._tensors[loaded._nodes[-1].out_ids[-1]]
         got, = exe.run(loaded, feed={"x": arr}, fetch_list=[got_t])
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestStaticNNLongTail:
+    """static.nn builders beyond fc/conv2d/batch_norm (reference:
+    static/nn/__init__.py __all__)."""
+
+    def test_norms_and_convs(self, _static_mode):
+        paddle.seed(0)
+        img = static.data("img", [None, 4, 8, 8], "float32")
+        y = static.nn.conv2d_transpose(img, 4, 3, stride=2, padding=1)
+        y = static.nn.group_norm(y, groups=2)
+        y = static.nn.layer_norm(y, begin_norm_axis=1)
+        y = static.nn.instance_norm(y)
+        y = static.nn.prelu(y, mode="channel")
+        exe = static.Executor()
+        out, = exe.run(feed={"img": np.random.RandomState(0).randn(
+            2, 4, 8, 8).astype("float32")}, fetch_list=[y])
+        assert out.shape[0] == 2 and np.isfinite(out).all()
+
+    def test_conv3d_and_bilinear(self, _static_mode):
+        paddle.seed(1)
+        vol = static.data("vol", [None, 2, 4, 4, 4], "float32")
+        y3 = static.nn.conv3d(vol, 3, 3, padding=1)
+        a = static.data("a", [None, 5], "float32")
+        b = static.data("b", [None, 4], "float32")
+        z = static.nn.bilinear_tensor_product(a, b, 6)
+        exe = static.Executor()
+        rs = np.random.RandomState(1)
+        o1, o2 = exe.run(
+            feed={"vol": rs.randn(2, 2, 4, 4, 4).astype("float32"),
+                  "a": rs.randn(2, 5).astype("float32"),
+                  "b": rs.randn(2, 4).astype("float32")},
+            fetch_list=[y3, z])
+        assert o1.shape == (2, 3, 4, 4, 4)
+        assert o2.shape == (2, 6)
+
+    def test_py_func_and_spectral_norm(self, _static_mode):
+        x = static.data("x", [None, 3], "float32")
+        doubled = static.nn.py_func(lambda t: t * 2.0, x, None)
+        w = paddle.to_tensor(np.random.RandomState(0).randn(
+            4, 3).astype("float32"))
+        wn = static.nn.spectral_norm(w, power_iters=2)
+        exe = static.Executor()
+        out, = exe.run(feed={"x": np.ones((2, 3), "float32")},
+                       fetch_list=[doubled])
+        np.testing.assert_allclose(out, 2 * np.ones((2, 3)))
+        # spectral norm of the returned weight ~ 1
+        s = np.linalg.svd(wn.numpy(), compute_uv=False)[0]
+        assert s < 2.0
+
+    def test_prelu_element_mode(self, _static_mode):
+        """code-review regression: mode='element' must apply a per-
+        element slope, not a broadcast-incompatible channel weight."""
+        x = static.data("x", [None, 2, 3, 3], "float32")
+        y = static.nn.prelu(x, mode="element")
+        exe = static.Executor()
+        arr = -np.ones((2, 2, 3, 3), "float32")
+        out, = exe.run(feed={"x": arr}, fetch_list=[y])
+        np.testing.assert_allclose(out, arr * 0.25, rtol=1e-6)
