@@ -80,7 +80,7 @@ def get_op(name) -> OpDef:
 
 def get_jitted(fn: Callable, attrs: dict[str, Any]):
     """Compiled forward executable for (fn, attrs), cached."""
-    key = (fn, _freeze(attrs))
+    key = fn if not attrs else (fn, _freeze(attrs))
     got = _JIT_CACHE.get(key)
     if got is None:
         with _LOCK:

@@ -12,10 +12,43 @@ from __future__ import annotations
 
 import threading
 
+import numpy as np
 import jax
 
 __all__ = ["Generator", "default_generator", "seed", "get_rng_state",
            "set_rng_state", "next_key", "manual_seed"]
+
+
+def _threefry2x32(k0, k1, x0, x1):
+    """Host-side threefry-2x32 (bit-identical to jax._src.prng).
+
+    Lets the stateful Generator mint per-step keys without an eager
+    device round-trip — on a tunneled TPU each eager op costs a network
+    hop, which dominated the compiled-train-step dispatch path.
+    """
+    rot = (13, 15, 26, 6, 17, 29, 16, 24)
+    M = 0xFFFFFFFF
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (32 - r))) & M
+
+    k0, k1, x0, x1 = int(k0), int(k1), int(x0), int(x1)
+    ks = (k0, k1, k0 ^ k1 ^ 0x1BD11BDA)
+    x0 = (x0 + ks[0]) & M
+    x1 = (x1 + ks[1]) & M
+    for r in range(5):
+        for j in range(4):
+            x0 = (x0 + x1) & M
+            x1 = rotl(x1, rot[(0 if r % 2 == 0 else 4) + j])
+            x1 = x0 ^ x1
+        x0 = (x0 + ks[(r + 1) % 3]) & M
+        x1 = (x1 + ks[(r + 2) % 3] + r + 1) & M
+    return np.uint32(x0), np.uint32(x1)
+
+
+def _host_fold_in(k0, k1, i):
+    """numpy twin of jax.random.fold_in on a threefry key (key ⊕ i)."""
+    return _threefry2x32(k0, k1, np.uint32(0), np.uint32(i))
 
 
 class Generator:
@@ -35,12 +68,22 @@ class Generator:
     def initial_seed(self) -> int:
         return self._seed
 
-    def next_key(self):
-        """A fresh threefry key; deterministic given (seed, draw index)."""
+    def next_key_host(self):
+        """A fresh key as a host numpy uint32[2]; bit-identical to
+        jax.random.fold_in(PRNGKey(seed), i) but with zero device work —
+        for callers that feed the key straight into a jitted program
+        (PRNGKey(s) packs to [s>>32, s&0xffffffff])."""
         with self._lock:
             i = self._count
             self._count += 1
-        return jax.random.fold_in(jax.random.PRNGKey(self._seed), i)
+        k0, k1 = (self._seed >> 32) & 0xFFFFFFFF, self._seed & 0xFFFFFFFF
+        return np.asarray(_host_fold_in(k0, k1, i), dtype=np.uint32)
+
+    def next_key(self):
+        """A fresh threefry key on device; deterministic given
+        (seed, draw index). One host->device transfer — the fold itself
+        happens host-side (see next_key_host)."""
+        return jax.numpy.asarray(self.next_key_host())
 
     def get_state(self):
         return (self._seed, self._count)
@@ -111,6 +154,15 @@ def next_key():
         _trace_rng.counters[-1] += 1
         return jax.random.fold_in(_trace_rng.stack[-1], i)
     return default_generator.next_key()
+
+
+def next_key_host():
+    """Host-side key mint for compiled-step callers (no device op)."""
+    if _trace_rng.stack:
+        i = _trace_rng.counters[-1]
+        _trace_rng.counters[-1] += 1
+        return jax.random.fold_in(_trace_rng.stack[-1], i)
+    return default_generator.next_key_host()
 
 
 def get_rng_state():

@@ -116,8 +116,11 @@ def enable_grad(fn=None):
     return _GradCtx(True)
 
 
+_Tracer = jax.core.Tracer
+
+
 def _is_tracer(v):
-    return isinstance(v, jax.core.Tracer)
+    return isinstance(v, _Tracer)
 
 
 class GradNode:
@@ -525,25 +528,44 @@ def apply_op(op_name: str, *tensors, attrs: Optional[dict] = None,
     forward executable -> wrap outputs -> create GradNode if required.
     """
     op = op_name if isinstance(op_name, OpDef) else get_op(op_name)
-    attrs = attrs or {}
     vals = tuple(t._value for t in tensors)
     if _amp_hook is not None:
         vals = _amp_hook(op.name, vals)
     if _mesh_hook is not None:
         vals = _mesh_hook(vals)
-    fn = get_jitted(op.fwd, attrs)
-    hook = _profile_hook  # read once (concurrent stop() nulls the global)
-    if hook is None:
-        out = fn(*vals)
+    traced = False
+    for v in vals:
+        if isinstance(v, _Tracer):
+            traced = True
+            break
+    if traced:
+        # under an outer trace (compiled train step, to_static, vmap...)
+        # inline the raw op fn into the enclosing jaxpr: no nested-pjit
+        # boundaries for XLA, no jit-cache lookup on the Python hot path
+        out = op.fwd(*vals, **attrs) if attrs else op.fwd(*vals)
     else:
-        with hook(op.name) or _NULL_SPAN:
+        fn = get_jitted(op.fwd, attrs)
+        hook = _profile_hook  # read once (concurrent stop() nulls global)
+        if hook is None:
             out = fn(*vals)
+        else:
+            with hook(op.name) or _NULL_SPAN:
+                out = fn(*vals)
     single = not isinstance(out, (tuple, list))
     outs = (out,) if single else tuple(out)
 
-    traced = any(_is_tracer(v) for v in vals) or any(_is_tracer(v) for v in outs)
-    need_grad = (_tape.grad_enabled and not traced and not op.nondiff
-                 and any(not t.stop_gradient for t in tensors))
+    if not traced:
+        for v in outs:
+            if isinstance(v, _Tracer):
+                traced = True
+                break
+    need_grad = False
+    if _tape.grad_enabled and not traced and not op.nondiff:
+        for t in tensors:
+            if not t.stop_gradient:
+                need_grad = True
+                break
+    attrs = attrs or {}
 
     out_tensors = tuple(Tensor(o, stop_gradient=not need_grad) for o in outs)
 

@@ -137,6 +137,10 @@ def set_mesh(mesh):
     if isinstance(mesh, Mesh):
         mesh = ProcessMesh(mesh)
     _state.mesh = mesh
+    if mesh is not None:
+        # sticky install: programs that never touch a mesh never pay the
+        # per-op hook (get_mesh + sharding inspection) on eager dispatch
+        _install_mesh_hook()
 
 
 def get_mesh() -> Optional[ProcessMesh]:
@@ -297,6 +301,3 @@ def _harmonize_vals(vals):
 def _install_mesh_hook():
     from ..core import tensor as tensor_mod
     tensor_mod._mesh_hook = _harmonize_vals
-
-
-_install_mesh_hook()

@@ -21,11 +21,30 @@ functions, so the whole pass is variable-capture analysis:
 - params   = outputs already bound before the statement
 - anything else is read through the closure unchanged.
 
-Statements that cannot be functionalized keep their original form:
-break/continue/return/yield inside the body, assignments to names
-that are neither pre-bound nor assigned in both branches, del/global/
-nonlocal. Those still work eagerly; under tracing they raise the
-standard tracer-bool error.
+Control transfers (reference break_continue_transformer.py:1,
+return_transformer.py:1, early_return_transformer.py:1) are
+functionalized with carried bool flags:
+
+    while c:              __brk = False
+        ...               while __pt_and(__pt_not(__brk), c):
+        if p: break   ->      ...
+        ...                   (__brk,) = __pt_ifelse(p, set_true, id, ...)
+                              if __pt_not(__brk): ...rest...
+
+`continue` sets a per-iteration flag that guards the remainder of the
+body; a mid-loop `return X` sets the break flag plus a return flag and
+a site index — X itself is re-evaluated AFTER the loop from the exited
+carry state (guards guarantee the carried names still hold their values
+from the breaking iteration), which avoids carrying a value whose
+shape/dtype is unknown before the first iteration. Early-return chains
+at function level (`if c: return a` ... `return b`) absorb the tail as
+the else branch recursively.
+
+Statements that still cannot be functionalized keep their original
+form: yield, del/global/nonlocal, transfers inside with/try blocks,
+assignments to names that are neither pre-bound nor assigned in both
+branches. Those work eagerly; under tracing they raise the standard
+tracer-bool error.
 """
 from __future__ import annotations
 

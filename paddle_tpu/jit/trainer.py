@@ -176,8 +176,10 @@ class CompiledTrainStep:
         batch_vals = [self._place_batch(
             b._value if isinstance(b, Tensor) else jnp.asarray(b))
             for b in batch]
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        key = random_mod.next_key()
+        # host-side scalars/keys: jit transfers them with the call; an
+        # eager jnp.asarray here would cost a tunnel round-trip per step
+        lr = np.float32(self.optimizer.get_lr())
+        key = random_mod.next_key_host()
         p_vals = [p._value for p in self.params]
         b_vals = [b._value for b in self.buffers]
         loss, new_p, new_b, new_s, new_g = self._step(

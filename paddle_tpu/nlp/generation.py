@@ -142,6 +142,24 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
     return out, DecodeCache(k_buf, v_buf, cache.pos + l)
 
 
+def _top_p_filter(logits, p):
+    """Nucleus filter: keep the smallest prefix of the sorted vocab whose
+    probability mass reaches p; mask the rest to -1e30.
+
+    The reference exposes top-p via PaddleNLP's TopPProcess (and the
+    top_p_sampling fused op); here it is a sorted-cumsum mask that XLA
+    fuses into the sampling step — no host round trip per token.
+    """
+    sorted_desc = -jnp.sort(-logits, axis=-1)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # exclusive cumsum < p: the first token is always kept
+    keep = (cum - probs) < p
+    thresh = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                     keepdims=True)
+    return jnp.where(logits < thresh, jnp.float32(-1e30), logits)
+
+
 class CompiledGenerator:
     """One-XLA-program generate() for a causal LM.
 
@@ -149,14 +167,35 @@ class CompiledGenerator:
     `(logits, new_caches)`; `cache_spec` is
     (n_layers, n_kv_heads, head_dim). One trace per
     (batch, prompt_len, max_new_tokens) signature, cached.
+
+    decode_strategy:
+      - None (default): argmax, or temperature/top-k/top-p sampling as
+        soon as any of top_k/top_p is set (legacy behavior)
+      - "greedy": argmax
+      - "sampling": categorical over temperature/top-k/top-p logits
+      - "beam_search": compiled beam search (see _build_beam) — the TPU
+        form of the reference beam-search op
+        (/root/reference/paddle/fluid/operators/math/beam_search.cu:1)
     """
 
     def __init__(self, model, cache_spec, temperature=1.0, top_k=None,
-                 eos_token_id=None, pad_token_id=0):
+                 eos_token_id=None, pad_token_id=0, top_p=None,
+                 decode_strategy=None, num_beams=4, length_penalty=0.0):
         self.model = model
         self.n_layers, self.n_kv, self.head_dim = cache_spec
         self.temperature = float(temperature)
         self.top_k = top_k
+        self.top_p = top_p
+        if decode_strategy == "greedy_search":  # reference spelling
+            decode_strategy = "greedy"
+        if decode_strategy not in (None, "greedy", "sampling",
+                                   "beam_search"):
+            raise ValueError(
+                f"unknown decode_strategy {decode_strategy!r}; expected "
+                "'greedy'/'greedy_search', 'sampling' or 'beam_search'")
+        self.decode_strategy = decode_strategy
+        self.num_beams = int(num_beams)
+        self.length_penalty = float(length_penalty)
         self.eos_token_id = eos_token_id
         self.pad_token_id = int(pad_token_id)
         params = list(model.parameters())
@@ -165,11 +204,18 @@ class CompiledGenerator:
         self._traces = {}
 
     def _sample(self, logits, key):
+        strat = self.decode_strategy
+        if strat == "greedy":
+            return jnp.argmax(logits, axis=-1)
         if self.temperature != 1.0:
             logits = logits / self.temperature
+        stochastic = (strat == "sampling") or self.top_k or self.top_p
         if self.top_k:
             vals, _ = jax.lax.top_k(logits, int(self.top_k))
             logits = jnp.where(logits < vals[:, -1:], -1e30, logits)
+        if self.top_p:
+            logits = _top_p_filter(logits, float(self.top_p))
+        if stochastic:
             return jax.random.categorical(key, logits, axis=-1)
         return jnp.argmax(logits, axis=-1)
 
@@ -234,24 +280,152 @@ class CompiledGenerator:
 
         return jax.jit(gen)
 
-    def __call__(self, input_ids, max_new_tokens=16):
+    def _build_beam(self, batch, prompt_len, max_new):
+        """Beam search as ONE XLA program.
+
+        All beam state is static-shaped: scores [B,K], tokens
+        [B,K,max_new], KV caches carried at batch B*K and reordered each
+        step with a flat gather (the in-place analogue of the reference
+        kernel's parent-idx chase, beam_search.cu:1). Finished beams emit
+        pad with frozen score. Final selection normalizes cumulative
+        log-prob by gen_len**length_penalty (0.0 = pure sum, the
+        reference default).
+        """
+        model = self.model
+        state_tensors = self.state_tensors
+        K = self.num_beams
+        max_len = prompt_len + max_new
+        eos = self.eos_token_id
+        pad = self.pad_token_id
+        lp = self.length_penalty
+        fp = next((t._value.dtype for t in state_tensors
+                   if jnp.issubdtype(t._value.dtype, jnp.floating)),
+                  dtypes.get_default_dtype().np_dtype)
+
+        def gen(state_vals, prompt, key):
+            del key  # beam search is deterministic
+            originals = [t._value for t in state_tensors]
+            try:
+                for t, v in zip(state_tensors, state_vals):
+                    t._value = v
+                BK = batch * K
+                # every beam starts from the same prompt: prefill at B*K
+                prompt_k = jnp.repeat(prompt, K, axis=0)  # [B*K, L]
+                caches = init_decode_caches(
+                    self.n_layers, BK, max_len, self.n_kv,
+                    self.head_dim, dtype=fp)
+                logits_t, caches = model(Tensor(prompt_k), caches=caches)
+                last = logits_t._value[:, -1, :].astype(jnp.float32)
+                V = last.shape[-1]
+                ck = tuple(c.k._value for c in caches)
+                cv = tuple(c.v._value for c in caches)
+                # beam 0 live, beams 1..K-1 muted so step 1 spreads over
+                # the top-K tokens of the (identical) distributions
+                scores0 = jnp.tile(
+                    jnp.asarray([0.0] + [-1e30] * (K - 1), jnp.float32),
+                    (batch, 1))
+                tokens0 = jnp.full((batch, K, max_new), pad,
+                                   prompt.dtype)
+                done0 = jnp.zeros((batch, K), bool)
+                len0 = jnp.zeros((batch, K), jnp.int32)
+                # one-hot-ish row for finished beams: pad with logp 0,
+                # everything else impossible
+                pad_row = jnp.full((V,), -jnp.inf, jnp.float32) \
+                    .at[pad].set(0.0)
+
+                def cond(carry):
+                    i = carry[0]
+                    done = carry[6]
+                    return (i < max_new) & ~jnp.all(done)
+
+                def body(carry):
+                    (i, last, ck, cv, tokens, scores, done, lens) = carry
+                    logp = jax.nn.log_softmax(
+                        last.reshape(batch, K, V), axis=-1)
+                    logp = jnp.where(done[:, :, None], pad_row[None, None],
+                                     logp)
+                    total = scores[:, :, None] + logp  # [B,K,V]
+                    top_val, top_idx = jax.lax.top_k(
+                        total.reshape(batch, K * V), K)  # [B,K]
+                    beam_src = top_idx // V            # parent beam
+                    tok = (top_idx % V).astype(tokens.dtype)
+                    # reorder per-beam state by parent
+                    take = lambda a: jnp.take_along_axis(a, beam_src,
+                                                         axis=1)
+                    tokens = jnp.take_along_axis(
+                        tokens, beam_src[:, :, None], axis=1)
+                    done = take(done)
+                    lens = take(lens)
+                    tokens = jax.lax.dynamic_update_slice(
+                        tokens, tok[:, :, None],
+                        (jnp.int32(0), jnp.int32(0), i))
+                    lens = lens + (~done).astype(jnp.int32)
+                    if eos is not None:
+                        done = done | (tok == eos)
+                    scores = top_val
+                    # flat gather reorders the KV caches to parent beams
+                    flat = (jnp.arange(batch, dtype=jnp.int32)[:, None]
+                            * K + beam_src).reshape(-1)
+                    ck = tuple(jnp.take(k, flat, axis=0) for k in ck)
+                    cv = tuple(jnp.take(v, flat, axis=0) for v in cv)
+                    pos = prompt_len + i
+                    caches = [DecodeCache(Tensor(k), Tensor(v),
+                                          Tensor(pos))
+                              for k, v in zip(ck, cv)]
+                    lg, caches = model(Tensor(tok.reshape(BK, 1)),
+                                       caches=caches)
+                    last = lg._value[:, -1, :].astype(jnp.float32)
+                    ck = tuple(c.k._value for c in caches)
+                    cv = tuple(c.v._value for c in caches)
+                    return (i + jnp.int32(1), last, ck, cv, tokens,
+                            scores, done, lens)
+
+                final = jax.lax.while_loop(
+                    cond, body,
+                    (jnp.int32(0), last, ck, cv, tokens0, scores0,
+                     done0, len0))
+                tokens, scores, lens = final[4], final[5], final[7]
+                norm = scores / jnp.maximum(
+                    lens.astype(jnp.float32), 1.0) ** lp
+                best = jnp.argmax(norm, axis=1)  # [B]
+                out = jnp.take_along_axis(
+                    tokens, best[:, None, None], axis=1)[:, 0]
+                best_score = jnp.take_along_axis(
+                    norm, best[:, None], axis=1)[:, 0]
+                return out, best_score
+            finally:
+                for t, v in zip(state_tensors, originals):
+                    t._value = v
+
+        return jax.jit(gen)
+
+    def __call__(self, input_ids, max_new_tokens=16,
+                 return_scores=False):
         from ..core import random as random_mod
         ids = as_tensor(input_ids)
         batch, prompt_len = int(ids.shape[0]), int(ids.shape[1])
-        sig = (batch, prompt_len, int(max_new_tokens))
+        beam = self.decode_strategy == "beam_search"
+        sig = (batch, prompt_len, int(max_new_tokens), beam)
         fn = self._traces.get(sig)
         if fn is None:
-            fn = self._build(*sig)
+            fn = (self._build_beam if beam else self._build)(*sig[:3])
             self._traces[sig] = fn
+        if return_scores and not beam:
+            raise ValueError("return_scores is only available with "
+                             "decode_strategy='beam_search'")
         was_training = getattr(self.model, "training", False)
         self.model.eval()
         try:
             state_vals = [t._value for t in self.state_tensors]
-            key = random_mod.next_key()
-            new_tokens = fn(state_vals, ids._value, key)
+            key = random_mod.next_key_host()
+            res = fn(state_vals, ids._value, key)
         finally:
             if was_training:
                 self.model.train()
+        new_tokens, scores = res if beam else (res, None)
         from ..ops import manipulation
-        return manipulation.concat(
+        out = manipulation.concat(
             [ids, Tensor(new_tokens, stop_gradient=True)], axis=1)
+        if return_scores:
+            return out, Tensor(scores, stop_gradient=True)
+        return out

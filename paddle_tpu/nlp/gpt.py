@@ -285,8 +285,9 @@ class GPTForCausalLM(nn.Layer):
                 cfg.hidden_size // cfg.num_attention_heads)
 
     def generate(self, input_ids, max_new_tokens=16, temperature=1.0,
-                 top_k=None, eos_token_id=None, pad_token_id=0,
-                 use_compiled=True):
+                 top_k=None, top_p=None, eos_token_id=None,
+                 pad_token_id=0, decode_strategy=None, num_beams=4,
+                 length_penalty=0.0, use_compiled=True):
         """Autoregressive decoding with KV cache.
 
         Default path: one compiled XLA program (static cache +
@@ -295,8 +296,9 @@ class GPTForCausalLM(nn.Layer):
         debugging."""
         if use_compiled:
             from .generation import CompiledGenerator
-            key = (float(temperature), top_k, eos_token_id,
-                   int(pad_token_id))
+            key = (float(temperature), top_k, top_p, eos_token_id,
+                   int(pad_token_id), decode_strategy, int(num_beams),
+                   float(length_penalty))
             gens = getattr(self, "_compiled_generators", None)
             if gens is None:
                 gens = self._compiled_generators = {}
@@ -304,8 +306,10 @@ class GPTForCausalLM(nn.Layer):
             if gen is None:
                 gen = CompiledGenerator(
                     self, self._decode_cache_spec(),
-                    temperature=temperature, top_k=top_k,
-                    eos_token_id=eos_token_id, pad_token_id=pad_token_id)
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    eos_token_id=eos_token_id, pad_token_id=pad_token_id,
+                    decode_strategy=decode_strategy, num_beams=num_beams,
+                    length_penalty=length_penalty)
                 gens[key] = gen
             return gen(input_ids, max_new_tokens)
         from ..ops import manipulation, creation

@@ -60,12 +60,15 @@ def _norm_padding(padding, n, data_format):
 
 
 def _dim_numbers(n, channel_last):
+    # weights stay OIHW in both layouts (state_dict parity with the
+    # reference); the rhs spec tells XLA, which folds any transpose into
+    # its own layout assignment
     if n == 1:
-        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+        return ("NWC", "OIW", "NWC") if channel_last else ("NCW", "OIW", "NCW")
     if n == 2:
-        return (("NHWC", "HWIO", "NHWC") if channel_last
+        return (("NHWC", "OIHW", "NHWC") if channel_last
                 else ("NCHW", "OIHW", "NCHW"))
-    return (("NDHWC", "DHWIO", "NDHWC") if channel_last
+    return (("NDHWC", "OIDHW", "NDHWC") if channel_last
             else ("NCDHW", "OIDHW", "NCDHW"))
 
 

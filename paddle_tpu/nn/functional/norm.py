@@ -42,8 +42,13 @@ def _bn_train_fwd(x, mean_buf, var_buf, weight, bias, momentum, epsilon,
         return y, mean_buf, var_buf
     axes = _bn_stats_axes(x.ndim, c_axis)
     xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+    # one-pass stats: mean and E[x^2] reduce over a single read of x (XLA
+    # fuses both into the producing conv's epilogue); jnp.var would re-read
+    # x after mean materializes — a full extra activation pass per BN.
+    # E[x^2]-mean^2 can dip negative under cancellation: clamp at 0
     mean = jnp.mean(xf, axis=axes)
-    var = jnp.var(xf, axis=axes)
+    var = jnp.maximum(
+        jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean), 0.0)
     y = _bn_apply(x, mean, var, weight, bias, epsilon, c_axis)
     new_mean = momentum * mean_buf + (1.0 - momentum) * mean.astype(mean_buf.dtype)
     new_var = momentum * var_buf + (1.0 - momentum) * var.astype(var_buf.dtype)
@@ -123,7 +128,9 @@ def _ln_fwd(x, w, b, n_norm_axes, epsilon):
     dt = x.dtype
     xf = x.astype(jnp.float32) if dt in (jnp.bfloat16, jnp.float16) else x
     mean = jnp.mean(xf, axis=axes, keepdims=True)
-    var = jnp.var(xf, axis=axes, keepdims=True)
+    var = jnp.maximum(
+        jnp.mean(jnp.square(xf), axis=axes, keepdims=True)
+        - jnp.square(mean), 0.0)
     y = (xf - mean) * jax.lax.rsqrt(var + epsilon)
     if w is not None:
         y = y * w.astype(y.dtype)
@@ -180,7 +187,9 @@ def _in_fwd(x, w, b, epsilon, c_axis):
     dt = x.dtype
     xf = x.astype(jnp.float32) if dt in (jnp.bfloat16, jnp.float16) else x
     mean = jnp.mean(xf, axis=axes, keepdims=True)
-    var = jnp.var(xf, axis=axes, keepdims=True)
+    var = jnp.maximum(
+        jnp.mean(jnp.square(xf), axis=axes, keepdims=True)
+        - jnp.square(mean), 0.0)
     y = (xf - mean) * jax.lax.rsqrt(var + epsilon)
     if w is not None:
         y = y * _bcast(w.astype(y.dtype), x.ndim, c_axis)
@@ -215,7 +224,9 @@ def _gn_fwd(x, w, b, groups, epsilon, channel_last):
         gs = xf.reshape(x.shape[:-1] + (groups, c // groups))
         axes = tuple(range(1, x.ndim - 1)) + (x.ndim,)
         mean = jnp.mean(gs, axis=axes, keepdims=True)
-        var = jnp.var(gs, axis=axes, keepdims=True)
+        var = jnp.maximum(
+            jnp.mean(jnp.square(gs), axis=axes, keepdims=True)
+            - jnp.square(mean), 0.0)
         y = ((gs - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x.shape)
         if w is not None:
             y = y * w.astype(y.dtype)
@@ -226,7 +237,9 @@ def _gn_fwd(x, w, b, groups, epsilon, channel_last):
         gs = xf.reshape((x.shape[0], groups, c // groups) + x.shape[2:])
         axes = tuple(range(2, gs.ndim))
         mean = jnp.mean(gs, axis=axes, keepdims=True)
-        var = jnp.var(gs, axis=axes, keepdims=True)
+        var = jnp.maximum(
+            jnp.mean(jnp.square(gs), axis=axes, keepdims=True)
+            - jnp.square(mean), 0.0)
         y = ((gs - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x.shape)
         if w is not None:
             y = y * _bcast(w.astype(y.dtype), x.ndim, 1)

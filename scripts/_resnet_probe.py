@@ -1,0 +1,148 @@
+"""Dev probe: pure-JAX ResNet50 train step, NCHW vs NHWC, bf16.
+
+Bounds the framework's reachable imgs/s before plumbing layout through
+the model zoo. Not part of the bench suite.
+"""
+import time
+import sys
+import jax
+import jax.numpy as jnp
+from jax import lax
+import numpy as np
+
+FMT = sys.argv[1] if len(sys.argv) > 1 else "NHWC"
+B = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+BN_MODE = sys.argv[3] if len(sys.argv) > 3 else "f32"  # f32|fold|ghost
+CL = FMT == "NHWC"
+
+rng = np.random.RandomState(0)
+
+
+def mk_conv(ic, oc, k):
+    shape = (k, k, ic, oc) if CL else (oc, ic, k, k)
+    fan = ic * k * k
+    return jnp.asarray(rng.randn(*shape) * (2.0 / fan) ** 0.5, jnp.bfloat16)
+
+
+def conv(x, w, stride=1):
+    dn = lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        ("NHWC", "HWIO", "NHWC") if CL else ("NCHW", "OIHW", "NCHW"))
+    return lax.conv_general_dilated(x, w, (stride, stride), "SAME",
+                                    dimension_numbers=dn)
+
+
+def bn(x, scale, bias):
+    # train-mode BN: stats over batch+spatial, computed in f32
+    ax = (0, 1, 2) if CL else (0, 2, 3)
+    shp = (1, 1, 1, -1) if CL else (1, -1, 1, 1)
+    if BN_MODE == "ghost":
+        # stats from 1/4 of the batch (ceiling probe for stats-pass cost)
+        xs = x[: x.shape[0] // 4].astype(jnp.float32)
+        m = jnp.mean(xs, ax, keepdims=True)
+        v = jnp.mean(jnp.square(xs), ax, keepdims=True) - jnp.square(m)
+    else:
+        xf = x.astype(jnp.float32)
+        m = jnp.mean(xf, ax, keepdims=True)
+        v = jnp.mean(jnp.square(xf), ax, keepdims=True) - jnp.square(m)
+    if BN_MODE in ("fold", "ghost"):
+        # fold to per-channel a,b; elementwise pass stays bf16
+        rstd = lax.rsqrt(v + 1e-5)
+        a = (scale.reshape(shp) * rstd).astype(jnp.bfloat16)
+        b = (bias.reshape(shp) - scale.reshape(shp) * m * rstd).astype(
+            jnp.bfloat16)
+        return x * a + b
+    y = (x.astype(jnp.float32) - m) * lax.rsqrt(v + 1e-5)
+    y = y * scale.reshape(shp) + bias.reshape(shp)
+    return y.astype(jnp.bfloat16)
+
+
+def mk_bn(c):
+    return (jnp.ones((c,), jnp.float32), jnp.zeros((c,), jnp.float32))
+
+
+LAYERS = [3, 4, 6, 3]
+PLANES = [64, 128, 256, 512]
+
+
+def init_params():
+    params = {"conv1": mk_conv(3, 64, 7), "bn1": mk_bn(64)}
+    inplanes = 64
+    for li, (n, p) in enumerate(zip(LAYERS, PLANES)):
+        for bi in range(n):
+            stride = 2 if (bi == 0 and li > 0) else 1
+            width = p
+            blk = {
+                "c1": mk_conv(inplanes, width, 1), "b1": mk_bn(width),
+                "c2": mk_conv(width, width, 3), "b2": mk_bn(width),
+                "c3": mk_conv(width, p * 4, 1), "b3": mk_bn(p * 4),
+            }
+            if bi == 0:
+                blk["cd"] = mk_conv(inplanes, p * 4, 1)
+                blk["bd"] = mk_bn(p * 4)
+            params[f"l{li}b{bi}"] = blk
+            inplanes = p * 4
+    params["fc"] = jnp.asarray(rng.randn(2048, 1000) * 0.01, jnp.bfloat16)
+    return params
+
+
+def forward(params, x):
+    x = bn(conv(x, params["conv1"], 2), *params["bn1"])
+    x = jax.nn.relu(x)
+    # maxpool 3x3 s2
+    if CL:
+        x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+    else:
+        x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 1, 3, 3),
+                              (1, 1, 2, 2), "SAME")
+    for li, (n, p) in enumerate(zip(LAYERS, PLANES)):
+        for bi in range(n):
+            blk = params[f"l{li}b{bi}"]
+            stride = 2 if (bi == 0 and li > 0) else 1
+            ident = x
+            o = jax.nn.relu(bn(conv(x, blk["c1"]), *blk["b1"]))
+            o = jax.nn.relu(bn(conv(o, blk["c2"], stride), *blk["b2"]))
+            o = bn(conv(o, blk["c3"]), *blk["b3"])
+            if "cd" in blk:
+                ident = bn(conv(x, blk["cd"], stride), *blk["bd"])
+            x = jax.nn.relu(o + ident)
+    ax = (1, 2) if CL else (2, 3)
+    x = jnp.mean(x.astype(jnp.float32), ax).astype(jnp.bfloat16)
+    return x @ params["fc"]
+
+
+def loss_fn(params, x, y):
+    logits = forward(params, x).astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, -1)
+    return jnp.mean(lse - jnp.take_along_axis(logits, y[:, None], 1)[:, 0])
+
+
+@jax.jit
+def train_step(params, mom, x, y):
+    loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+    new_p = jax.tree.map(lambda p, g, m: p - 0.1 * (0.9 * m + g).astype(p.dtype),
+                         params, grads, mom)
+    new_m = jax.tree.map(lambda g, m: 0.9 * m + g, grads, mom)
+    return new_p, new_m, loss
+
+
+params = init_params()
+mom = jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+shape = (B, 224, 224, 3) if CL else (B, 3, 224, 224)
+x = jnp.asarray(rng.randn(*shape), jnp.bfloat16)
+y = jnp.asarray(rng.randint(0, 1000, (B,)))
+
+params, mom, loss = train_step(params, mom, x, y)
+print("warm loss", float(loss))
+ITERS = 20
+best = 1e9
+for _ in range(3):
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        params, mom, loss = train_step(params, mom, x, y)
+    float(loss)
+    best = min(best, time.perf_counter() - t0)
+ips = B * ITERS / best
+mfu = ips * 3 * 4.1e9 / 197e12
+print(f"{FMT} bs{B} bn={BN_MODE}: {ips:.0f} imgs/s  MFU {mfu:.3f}")

@@ -1,0 +1,67 @@
+"""Dev aid: device-time breakdown of the framework ResNet50 train step."""
+import glob
+import gzip
+import json
+import re
+import collections
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+import paddle_tpu.nn.functional as F
+from paddle_tpu import jit
+from paddle_tpu.vision.models import resnet50
+
+paddle.set_matmul_precision("default")
+paddle.seed(0)
+model = resnet50(num_classes=1000, data_format="NHWC")
+model.to(dtype="bfloat16")
+sgd = opt.Momentum(learning_rate=0.1, momentum=0.9,
+                   parameters=model.parameters(), weight_decay=1e-4)
+step = jit.compile_train_step(lambda x, y: F.cross_entropy(model(x), y),
+                              model, sgd)
+rng = np.random.RandomState(0)
+x = paddle.to_tensor(rng.randn(128, 224, 224, 3).astype(np.float32)) \
+    .astype("bfloat16")
+y = paddle.to_tensor(rng.randint(0, 1000, (128,)))
+for _ in range(3):
+    loss = step(x, y)
+float(loss)
+
+tmp = tempfile.mkdtemp()
+import jax.profiler
+N = 5
+with jax.profiler.trace(tmp):
+    for _ in range(N):
+        loss = step(x, y)
+    float(loss)
+
+tr = glob.glob(f"{tmp}/plugins/profile/*/*.trace.json.gz")[0]
+d = json.load(gzip.open(tr))
+evs = d["traceEvents"]
+names = {}
+for e in evs:
+    if e.get("ph") == "M" and e.get("name") == "process_name":
+        names[e["pid"]] = e["args"]["name"]
+agg = collections.Counter()
+cnt = collections.Counter()
+tb = tt = 0
+for e in evs:
+    if e.get("ph") == "X" and "TPU" in names.get(e.get("pid"), "") \
+            and not e["name"].startswith("jit_") \
+            and not re.fullmatch(r"\d+", e["name"]):
+        a = e.get("args") or {}
+        cat = re.sub(r"[.\d]+$", "", e["name"])
+        agg[cat] += e.get("dur", 0)
+        cnt[cat] += 1
+        tb += int(a.get("bytes_accessed", 0))
+        tt += e.get("dur", 0)
+print(f"DEVICE {tt/N/1e3:.2f} ms/step   {tb/N/1e9:.2f} GB/step")
+for nm, us in agg.most_common(10):
+    print(f"  {us/N/1e3:8.2f} ms/step x{cnt[nm]//N:5d}  {nm}")

@@ -49,7 +49,9 @@ def main():
         batch, iters, warmup, img = 4, 3, 1, 64
 
     paddle.seed(0)
-    model = resnet50(num_classes=1000)
+    # NHWC end-to-end: keeps BN bias-grad reductions fusable into the
+    # conv fusions (NCHW layouts leave them as standalone HBM passes)
+    model = resnet50(num_classes=1000, data_format="NHWC")
     model.to(dtype="bfloat16")
     sgd = opt.Momentum(learning_rate=0.1, momentum=0.9,
                        parameters=model.parameters(),
@@ -59,7 +61,7 @@ def main():
 
     rng = np.random.RandomState(0)
     x = paddle.to_tensor(
-        rng.randn(batch, 3, img, img).astype(np.float32)) \
+        rng.randn(batch, img, img, 3).astype(np.float32)) \
         .astype("bfloat16")
     y = paddle.to_tensor(rng.randint(0, 1000, (batch,)))
 
