@@ -116,6 +116,8 @@ from . import hapi  # noqa: E402,F401
 from .hapi import Model  # noqa: E402,F401
 from .hapi import callbacks  # noqa: E402,F401
 from .hapi.summary import summary, flops  # noqa: E402,F401
+from . import hub  # noqa: E402,F401
+from . import onnx  # noqa: E402,F401
 
 
 def iinfo(dtype):

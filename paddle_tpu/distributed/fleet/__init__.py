@@ -17,6 +17,7 @@ from .mp_layers import (  # noqa: F401
 from ..env import ParallelEnv
 
 __all__ = ["init", "shutdown", "DistributedStrategy",
+           "LocalSGDOptimizer",
            "HybridCommunicateGroup",
            "CommunicateTopology", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",
@@ -48,8 +49,9 @@ class DistributedStrategy:
         self.lars = False
         self.dgc = False
         self.gradient_merge = False
-        self.gradient_merge_configs = {}
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1}
         self.find_unused_parameters = False
         self.fuse_all_reduce_ops = True
         self.fuse_grad_size_in_MB = 32
@@ -59,6 +61,41 @@ class DistributedStrategy:
 
     def __repr__(self):
         return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+class LocalSGDOptimizer:
+    """LocalSGD (reference fleet/meta_optimizers/localsgd_optimizer.py:1,
+    arXiv:1808.07217): every rank trains on its own shard for k steps,
+    then parameters are averaged across data-parallel ranks with one
+    all_reduce per parameter. Between syncs there is NO per-step grad
+    all-reduce — that is the point (k× less communication; the sync
+    rides the eager multi-process collective, so this is the
+    launch/multi-process data-parallel form, not the in-program GSPMD
+    form where params cannot diverge)."""
+
+    def __init__(self, inner, k_steps=1):
+        self._inner = inner
+        self._k = max(int(k_steps), 1)
+        self._local_steps = 0
+
+    def step(self):
+        self._inner.step()
+        self._local_steps += 1
+        if self._local_steps % self._k == 0:
+            self.sync_params()
+
+    def sync_params(self):
+        from .. import collective as coll
+        world = ParallelEnv().world_size
+        if world <= 1:
+            return
+        from ...ops import math as _m
+        for p in self._inner._parameter_list:
+            coll.all_reduce(p)
+            p.set_value(_m.scale(p, 1.0 / world))
+
+    def __getattr__(self, name):  # delegate the rest of the surface
+        return getattr(self._inner, name)
 
 
 class _Fleet:
@@ -132,9 +169,23 @@ class _Fleet:
         """reference: fleet/fleet.py distributed_optimizer →
         HybridParallelOptimizer. Grad averaging across dp is implicit in
         the global-batch loss; sharding-stage optimizer states are
-        annotated in group_sharded. The optimizer returns unchanged but
-        tagged with the hcg for API parity."""
+        annotated in group_sharded. Strategy knobs consumed here:
+        gradient_merge tags the optimizer so compile_train_step scans
+        k micro-batches per update (distributed_strategy.proto:81);
+        localsgd wraps step() with periodic cross-rank parameter
+        averaging (fleet/meta_optimizers/localsgd_optimizer.py:1)."""
         optimizer._hcg = self._hcg
+        strategy = strategy or self._strategy
+        if strategy is not None and strategy.gradient_merge:
+            optimizer._gradient_merge_k = int(
+                strategy.gradient_merge_configs.get("k_steps", 1))
+            optimizer._gradient_merge_avg = bool(
+                strategy.gradient_merge_configs.get("avg", True))
+        if strategy is not None and strategy.localsgd:
+            optimizer = LocalSGDOptimizer(
+                optimizer,
+                k_steps=int(getattr(strategy, "localsgd_configs",
+                                    {}).get("k_steps", 1)))
         return optimizer
 
 

@@ -1,0 +1,85 @@
+"""paddle.hub: hubconf.py entrypoint loading.
+
+Reference: python/paddle/hapi/hub.py (list/help/load over a repo dir
+containing `hubconf.py`, sources github/gitee/local). The `local`
+source is implemented in full — a directory with a hubconf exposing
+callables and an optional `dependencies` list. The network sources are
+gated: this environment has no egress, and a TPU pod's workers should
+load models from mounted storage anyway.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = []
+
+VAR_DEPENDENCY = "dependencies"
+MODULE_HUBCONF = "hubconf.py"
+
+
+def _check_source(source):
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(
+            f"unknown source {source!r}: expected github/gitee/local")
+    if source != "local":
+        raise NotImplementedError(
+            f"hub source {source!r} needs network access, which the "
+            "TPU build gates off; clone the repo yourself and use "
+            "source='local' with its path")
+
+
+def _import_hubconf(repo_dir):
+    repo_dir = os.path.expanduser(repo_dir)
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {MODULE_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.path.remove(repo_dir)
+    deps = getattr(module, VAR_DEPENDENCY, None)
+    if deps:
+        missing = [d for d in deps
+                   if importlib.util.find_spec(d) is None]
+        if missing:
+            raise RuntimeError(
+                f"hubconf dependencies not installed: {missing}")
+    return module
+
+
+def _entrypoints(module):
+    return [k for k, v in vars(module).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def list(repo_dir, source="github", force_reload=False):
+    """Entrypoint names exposed by the repo's hubconf."""
+    _check_source(source)
+    return _entrypoints(_import_hubconf(repo_dir))
+
+
+def help(repo_dir, model, source="github", force_reload=False):
+    """The docstring of one hubconf entrypoint."""
+    _check_source(source)
+    module = _import_hubconf(repo_dir)
+    entry = getattr(module, model, None)
+    if entry is None or not callable(entry):
+        raise RuntimeError(f"Cannot find callable {model} in hubconf")
+    return entry.__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False,
+         **kwargs):
+    """Call a hubconf entrypoint (usually returns a constructed
+    Layer)."""
+    _check_source(source)
+    module = _import_hubconf(repo_dir)
+    entry = getattr(module, model, None)
+    if entry is None or not callable(entry):
+        raise RuntimeError(f"Cannot find callable {model} in hubconf")
+    return entry(**kwargs)

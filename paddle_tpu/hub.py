@@ -1,0 +1,6 @@
+"""paddle.hub parity (reference: python/paddle/hub.py:1)."""
+from .hapi.hub import help  # noqa: F401
+from .hapi.hub import list  # noqa: F401
+from .hapi.hub import load  # noqa: F401
+
+__all__ = ["list", "help", "load"]

@@ -64,12 +64,35 @@ _WHILE = "__pt_while"
 
 # -- runtime dispatchers ------------------------------------------------------
 
+def _tensorize(v):
+    """Python scalar -> Tensor for the lax control-flow paths: a plain
+    bool/int left in the carry would be flattened into the STATIC spec
+    (a baked constant), so e.g. a break flag would never update and the
+    compiled while would not terminate."""
+    from ..core.tensor import Tensor
+    if isinstance(v, Tensor):
+        return v
+    from ..ops.creation import to_tensor
+    import numpy as _np
+    return to_tensor(_np.asarray(v))
+
+
+def _tensorized_fn(fn):
+    def g(*a):
+        out = fn(*a)
+        if isinstance(out, (tuple, list)):
+            return tuple(_tensorize(o) for o in out)
+        return _tensorize(out)
+    return g
+
+
 def _dispatch_ifelse(pred, true_fn, false_fn, args):
     from ..core.tensor import Tensor
     if isinstance(pred, Tensor):
         from ..ops import control_flow
-        return control_flow.cond(pred, true_fn, false_fn,
-                                 operands=tuple(args))
+        return control_flow.cond(
+            pred, _tensorized_fn(true_fn), _tensorized_fn(false_fn),
+            operands=tuple(_tensorize(a) for a in args))
     return true_fn(*args) if pred else false_fn(*args)
 
 
@@ -128,20 +151,102 @@ def _dispatch_while(cond_fn, body_fn, args):
     from ..core.tensor import Tensor
     vars_ = list(args)
     first = cond_fn(*vars_)
-    if isinstance(first, Tensor):
-        from ..ops import control_flow
-        return tuple(control_flow.while_loop(cond_fn, body_fn, vars_))
-    while bool(first):
+    while not isinstance(first, Tensor):
+        # python predicate: run the real loop. The predicate can TURN
+        # tensor mid-loop (e.g. a python range whose break flag is
+        # tensor-valued after the first body run) — fall through to the
+        # compiled while from the current state when it does.
+        if not bool(first):
+            return tuple(vars_)
         out = body_fn(*vars_)
         vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
         first = cond_fn(*vars_)
-    return tuple(vars_)
+    from ..ops import control_flow
+    vars_ = [_tensorize(v) for v in vars_]
+    return tuple(control_flow.while_loop(
+        cond_fn, _tensorized_fn(body_fn), vars_))
 
 
 _FORRANGE = "__pt_forrange"
 
+
+def _pt_not(x):
+    from ..core.tensor import Tensor
+    if isinstance(x, Tensor):
+        from ..ops import math as _m
+        return _m.logical_not(x)
+    return not x
+
+
+def _pt_or(a, b):
+    from ..core.tensor import Tensor
+    if isinstance(a, Tensor) or isinstance(b, Tensor):
+        from ..ops import math as _m
+        return _m.logical_or(as_tensor_bool(a), as_tensor_bool(b))
+    return a or b
+
+
+def _pt_and(a, b):
+    from ..core.tensor import Tensor
+    if isinstance(a, Tensor) or isinstance(b, Tensor):
+        from ..ops import math as _m
+        return _m.logical_and(as_tensor_bool(a), as_tensor_bool(b))
+    return a and b
+
+
+def as_tensor_bool(v):
+    from ..core.tensor import Tensor
+    if isinstance(v, Tensor):
+        return v
+    from ..ops.creation import to_tensor
+    import numpy as _np
+    return to_tensor(_np.asarray(bool(v)))
+
+
+def _pt_guard_test(brk, test_thunk):
+    """Loop predicate under a break flag, with Python's short-circuit:
+    after `break` fired, the original test must NOT be re-evaluated
+    (it may rely on state the loop no longer maintains, e.g.
+    `while q[0] > 0: ... break` on a now-empty list). Tensor flags
+    evaluate both sides — safe, the traced test is pure."""
+    from ..core.tensor import Tensor
+    if isinstance(brk, Tensor):
+        from ..ops import math as _m
+        return _m.logical_and(_m.logical_not(brk),
+                              as_tensor_bool(test_thunk()))
+    if brk:
+        return False
+    return test_thunk()
+
+
+def _pt_forcond(i, stop, step):
+    """range-style continuation test with sign handling for Tensor step."""
+    from ..core.tensor import Tensor
+    if not any(isinstance(v, Tensor) for v in (i, stop, step)):
+        return i < stop if step > 0 else i > stop
+    from ..ops import math as _m
+    i, stop, step = (v if isinstance(v, Tensor) else as_tensor_int(v)
+                     for v in (i, stop, step))
+    return _m.logical_or(_m.logical_and(step > 0, i < stop),
+                         _m.logical_and(step < 0, i > stop))
+
+
+def as_tensor_int(v):
+    from ..ops.creation import to_tensor
+    import numpy as _np
+    return to_tensor(_np.asarray(v, _np.int64))
+
+
+_NOT = "__pt_not"
+_OR = "__pt_or"
+_AND = "__pt_and"
+_FORCOND = "__pt_forcond"
+_GUARDTEST = "__pt_guardtest"
+
 cfg_helpers = {_IFELSE: _dispatch_ifelse, _WHILE: _dispatch_while,
-               _FORRANGE: _dispatch_for_range}
+               _FORRANGE: _dispatch_for_range, _NOT: _pt_not,
+               _OR: _pt_or, _AND: _pt_and, _FORCOND: _pt_forcond,
+               _GUARDTEST: _pt_guard_test}
 
 
 # -- analysis helpers ---------------------------------------------------------
@@ -194,10 +299,142 @@ def _has_unsupported(nodes):
 
 
 def _returns_cleanly(stmts):
-    """Block ends with a top-level `return` and everything before it is
-    free of control transfers — convertible as a returning branch."""
-    return (bool(stmts) and isinstance(stmts[-1], ast.Return)
-            and not _has_unsupported(stmts[:-1]))
+    """Block always returns and is convertible: last statement is a
+    `return` (or an if whose branches both qualify), and everything
+    before it is free of control transfers EXCEPT absorbable early
+    `if c: return ...` statements — `_block` folds those into nested
+    else-branches, and even unconverted they remain valid Python."""
+    if not stmts:
+        return False
+    *init, last = stmts
+    for st in init:
+        if isinstance(st, ast.If) and not st.orelse and \
+                _returns_cleanly(st.body):
+            continue
+        if _has_unsupported([st]):
+            return False
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If) and last.orelse:
+        return _returns_cleanly(last.body) and _returns_cleanly(last.orelse)
+    return False
+
+
+# -- loop control-transfer functionalization ---------------------------------
+
+class _CannotGuard(Exception):
+    """Transfer in a position the guard rewrite cannot express
+    (inside with/try, etc.) — keep the original Python loop."""
+
+
+class _TransferScan(ast.NodeVisitor):
+    """Which transfers does this loop body contain at loop level (i.e.
+    not inside a nested loop, which owns its own break/continue)?"""
+
+    def __init__(self):
+        self.has_break = self.has_continue = self.has_return = False
+        self.in_guarded = False  # transfer under with/try
+
+    def _skip(self, node):
+        pass
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _skip
+    visit_ClassDef = visit_Lambda = _skip
+    visit_For = visit_AsyncFor = visit_While = _skip
+
+    def visit_Break(self, node):
+        self.has_break = True
+
+    def visit_Continue(self, node):
+        self.has_continue = True
+
+    def visit_Return(self, node):
+        self.has_return = True
+
+    def visit_With(self, node):
+        sub = _scan_transfers(node.body)
+        if sub.has_break or sub.has_continue or sub.has_return:
+            self.in_guarded = True
+
+    visit_AsyncWith = visit_With
+
+    def visit_Try(self, node):
+        blocks = node.body + node.orelse + node.finalbody + \
+            [s for h in node.handlers for s in h.body]
+        sub = _scan_transfers(blocks)
+        if sub.has_break or sub.has_continue or sub.has_return:
+            self.in_guarded = True
+
+
+def _scan_transfers(stmts):
+    sc = _TransferScan()
+    for s in stmts:
+        sc.visit(s)
+    return sc
+
+
+def _name(n, ctx=ast.Load):
+    return ast.Name(id=n, ctx=ctx())
+
+def _assign(n, value):
+    return ast.Assign(targets=[_name(n, ast.Store)], value=value)
+
+
+def _call(fn_name, *args):
+    return ast.Call(func=_name(fn_name), args=list(args), keywords=[])
+
+
+class _GuardRewriter:
+    """Rewrite one loop body: break/continue/return -> flag sets, with
+    the remainder after any flag-setting statement guarded by
+    `if __pt_not(__pt_or(flags...)):` (reference
+    break_continue_transformer.py:1 scheme). Return sites record a site
+    index; their value expressions are re-emitted after the loop."""
+
+    def __init__(self, brk, cont, ret, retidx):
+        self.brk, self.cont, self.ret, self.retidx = brk, cont, ret, retidx
+        self.sites: list = []  # return value expressions
+
+    def _flags_or(self):
+        names = [f for f in (self.brk, self.cont) if f is not None]
+        test = _name(names[0])
+        for f in names[1:]:
+            test = _call(_OR, test, _name(f))
+        return test
+
+    def rewrite(self, stmts):
+        out = []
+        for idx, st in enumerate(stmts):
+            rest = stmts[idx + 1:]
+            if isinstance(st, ast.Break):
+                out.append(_assign(self.brk, ast.Constant(value=True)))
+                return out  # rest is unreachable
+            if isinstance(st, ast.Continue):
+                out.append(_assign(self.cont, ast.Constant(value=True)))
+                return out
+            if isinstance(st, ast.Return):
+                k = len(self.sites)
+                self.sites.append(st.value or ast.Constant(value=None))
+                out.append(_assign(self.brk, ast.Constant(value=True)))
+                out.append(_assign(self.ret, ast.Constant(value=True)))
+                out.append(_assign(self.retidx, ast.Constant(value=k)))
+                return out
+            sub = _scan_transfers([st])
+            if sub.in_guarded:
+                raise _CannotGuard()
+            if sub.has_break or sub.has_continue or sub.has_return:
+                if not isinstance(st, ast.If):
+                    raise _CannotGuard()  # transfer under for/with/try
+                st = ast.If(test=st.test, body=self.rewrite(st.body),
+                            orelse=self.rewrite(st.orelse))
+                out.append(st)
+                if rest:
+                    out.append(ast.If(
+                        test=_call(_NOT, self._flags_or()),
+                        body=self.rewrite(rest), orelse=[]))
+                return out
+            out.append(st)
+        return out
 
 
 def _make_fn(name, params, body, returns):
@@ -246,15 +483,15 @@ class _Converter:
 
     def _block(self, stmts, bound, top=False):
         out = []
-        i = 0
-        while i < len(stmts):
-            st = stmts[i]
+        work = list(stmts)
+        while work:
+            st = work.pop(0)
             # `if c: return A` + trailing code ending in return: absorb
             # the tail as the else branch (both paths then return, so
             # nothing follows the converted statement)
             if isinstance(st, ast.If) and not st.orelse and \
                     _returns_cleanly(st.body):
-                rest = stmts[i + 1:]
+                rest = list(work)
                 if rest and _returns_cleanly(rest):
                     st = ast.If(test=st.test, body=st.body, orelse=rest)
                     res = self._stmt(st, bound)
@@ -271,9 +508,14 @@ class _Converter:
                     out.extend(res if isinstance(res, list) else [res])
                     return out
             res = self._stmt(st, bound)
+            if isinstance(res, tuple) and res and res[0] == "requeue":
+                # loop lowering produced fresh statements (flag inits,
+                # a transfer-free while, a post-loop return chain) that
+                # themselves need conversion against the real tail
+                work[:0] = res[1]
+                continue
             out.extend(res if isinstance(res, list) else [res])
             bound |= _assigned_names([st])
-            i += 1
         return out
 
     def _stmt(self, st, bound):
@@ -368,6 +610,12 @@ class _Converter:
             return None
         if not isinstance(node.target, ast.Name) or node.orelse:
             return None
+        scan = _scan_transfers(node.body)
+        if (scan.has_break or scan.has_continue or scan.has_return) \
+                and not scan.in_guarded:
+            lowered = self._for_to_while(node, scan)
+            if lowered is not None:
+                return lowered
         # eligibility checks on the RAW body — bailing after conversion
         # would hand an already-converted body to the generic recursion
         if _has_unsupported(node.body):
@@ -402,7 +650,47 @@ class _Converter:
         self.changed = True
         return [bfn, assign]
 
+    def _for_to_while(self, node: ast.For, scan):
+        """`for t in range(...)` whose body has break/continue/return:
+        lower to an explicit while (iterator increment FIRST so continue
+        cannot skip it), then requeue — the while conversion applies its
+        transfer machinery. Deviation from Python worth noting: the
+        target is pre-bound to `start` so an empty range leaves it at
+        start rather than unbound."""
+        a = node.iter.args
+        start = a[0] if len(a) > 1 else ast.Constant(value=0)
+        stop = a[1] if len(a) > 1 else a[0]
+        step = a[2] if len(a) > 2 else ast.Constant(value=1)
+        i = self.n
+        self.n += 1
+        itn, stopn, stepn = (f"__pt_it{i}", f"__pt_stop{i}",
+                             f"__pt_step{i}")
+        pre = [_assign(itn, start), _assign(stopn, stop),
+               _assign(stepn, step),
+               _assign(node.target.id, _name(itn))]
+        if isinstance(step, ast.Constant) and isinstance(step.value, int) \
+                and step.value != 0:
+            op = ast.Lt() if step.value > 0 else ast.Gt()
+            test = ast.Compare(left=_name(itn), ops=[op],
+                               comparators=[_name(stopn)])
+        else:
+            test = _call(_FORCOND, _name(itn), _name(stopn),
+                         _name(stepn))
+        body = [_assign(node.target.id, _name(itn)),
+                _assign(itn, ast.BinOp(left=_name(itn), op=ast.Add(),
+                                       right=_name(stepn)))] + node.body
+        w = ast.While(test=test, body=body, orelse=[])
+        self.changed = True
+        return ("requeue", pre + [w])
+
     def _while(self, node: ast.While, bound):
+        if not node.orelse:
+            scan = _scan_transfers(node.body)
+            if (scan.has_break or scan.has_continue or scan.has_return) \
+                    and not scan.in_guarded:
+                res = self._transfers_to_flags(node, bound, scan)
+                if res is not None:
+                    return res
         node.body = self._block(node.body, set(bound))
         if node.orelse or _has_unsupported(node.body):
             return node
@@ -420,6 +708,54 @@ class _Converter:
              ast.Name(id=bfn.name, ctx=ast.Load())], carried)
         self.changed = True
         return [cfn, bfn, _unpack_assign(carried, call)]
+
+    def _transfers_to_flags(self, node: ast.While, bound, scan):
+        """break/continue/return in a while body -> carried flags + a
+        transfer-free while (requeued so the standard conversion and the
+        post-loop return chain see the real surrounding block)."""
+        i = self.n
+        self.n += 1
+        brk = f"__pt_brk{i}"  # break and return both stop the loop
+        cont = f"__pt_cont{i}" if scan.has_continue else None
+        ret = f"__pt_ret{i}" if scan.has_return else None
+        retidx = f"__pt_retix{i}" if scan.has_return else None
+        rw = _GuardRewriter(brk, cont, ret, retidx)
+        try:
+            new_body = rw.rewrite(node.body)
+        except _CannotGuard:
+            return None
+        pre = [_assign(brk, ast.Constant(value=False))]
+        if cont:
+            pre.append(_assign(cont, ast.Constant(value=False)))
+        if ret:
+            pre.append(_assign(ret, ast.Constant(value=False)))
+            pre.append(_assign(retidx, ast.Constant(value=0)))
+        body = ([_assign(cont, ast.Constant(value=False))] if cont
+                else []) + new_body
+        # thunked test: __pt_guardtest short-circuits so the original
+        # predicate is never re-evaluated once break/return fired
+        thunk = ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                               kwonlyargs=[], kw_defaults=[],
+                               kwarg=None, defaults=[]),
+            body=node.test)
+        test = _call(_GUARDTEST, _name(brk), thunk)
+        new_while = ast.While(test=test, body=body, orelse=[])
+        post = []
+        if ret:
+            def chain(k):
+                if k == len(rw.sites) - 1:
+                    return [ast.Return(value=rw.sites[k])]
+                return [ast.If(
+                    test=ast.Compare(
+                        left=_name(retidx), ops=[ast.Eq()],
+                        comparators=[ast.Constant(value=k)]),
+                    body=[ast.Return(value=rw.sites[k])],
+                    orelse=chain(k + 1))]
+            post.append(ast.If(test=_name(ret), body=chain(0),
+                               orelse=[]))
+        self.changed = True
+        return ("requeue", pre + [new_while] + post)
 
 
 def convert_control_flow(fn):

@@ -31,10 +31,20 @@ class CompiledTrainStep:
     new_states, new_gstate)."""
 
     def __init__(self, loss_fn, model, optimizer, donate=True,
-                 in_shardings=None):
+                 in_shardings=None, accumulate_steps=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
+        # gradient merge (reference distributed_strategy.proto:81
+        # GradientMergeConfig): k micro-batches scanned INSIDE the one
+        # compiled step, optimizer applied once on the averaged grads.
+        # Explicit arg wins; else the fleet strategy tag on the optimizer
+        self.accumulate_steps = int(
+            accumulate_steps
+            if accumulate_steps is not None
+            else getattr(optimizer, "_gradient_merge_k", 1) or 1)
+        self.accumulate_avg = bool(
+            getattr(optimizer, "_gradient_merge_avg", True))
         self.params = [p for p in model.parameters()
                        if (p.trainable if isinstance(p, Parameter)
                            else not p.stop_gradient)]
@@ -49,9 +59,7 @@ class CompiledTrainStep:
         self.gstate = (dict(live_g) if live_g else
                        {k: jnp.asarray(v) for k, v in
                         optimizer._global_state_spec().items()})
-        clip = optimizer._grad_clip
-        self._clip_norm = getattr(clip, "clip_norm", None) \
-            if clip is not None else None
+        self._grad_clip = optimizer._grad_clip
         decay = optimizer._decay if not getattr(optimizer, "_decoupled",
                                                 False) else 0.0
         extras = optimizer._per_param_extra(self.params)
@@ -62,16 +70,18 @@ class CompiledTrainStep:
         state_tensors = self.state_tensors
         loss_fn_ = loss_fn
 
+        accum = self.accumulate_steps
+
         def step(param_vals, buffer_vals, states, gstate, lr, key,
                  *batch_vals):
-            def loss_of(pvals):
+            def loss_of(pvals, bufs, mb_vals, mb_key):
                 originals = [t._value for t in state_tensors]
-                random_mod.push_trace_key(key)
+                random_mod.push_trace_key(mb_key)
                 try:
                     for t, v in zip(state_tensors,
-                                    list(pvals) + list(buffer_vals)):
+                                    list(pvals) + list(bufs)):
                         t._value = v
-                    batch = [Tensor(b) for b in batch_vals]
+                    batch = [Tensor(b) for b in mb_vals]
                     out = loss_fn_(*batch)
                     loss_val = out._value if isinstance(out, Tensor) \
                         else out
@@ -83,15 +93,59 @@ class CompiledTrainStep:
                     for t, v in zip(state_tensors, originals):
                         t._value = v
 
-            (loss, new_bufs), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(list(param_vals))
-            if self._clip_norm is not None:
-                gnorm = jnp.sqrt(sum(
-                    jnp.sum(jnp.square(g.astype(jnp.float32)))
-                    for g in grads))
-                scale = self._clip_norm / jnp.maximum(gnorm,
-                                                      self._clip_norm)
-                grads = [g * scale.astype(g.dtype) for g in grads]
+            if accum > 1:
+                # micro-batch scan: leading batch dim splits into
+                # (accum, per_micro); f32 grad accumulators; one
+                # optimizer application on the merged grads. Positional
+                # batch args must lead with the batch dim; 0-d scalars
+                # are broadcast to every micro-batch unchanged
+                split = []
+                for ai, b in enumerate(batch_vals):
+                    if b.ndim == 0:
+                        split.append(None)
+                        continue
+                    if b.shape[0] % accum:
+                        raise ValueError(
+                            f"batch arg {ai}: leading dim {b.shape[0]} "
+                            f"not divisible by accumulate_steps={accum}")
+                    split.append(b.reshape(
+                        (accum, b.shape[0] // accum) + b.shape[1:]))
+
+                def micro(carry, xs):
+                    acc, bufs = carry
+                    idx, mb = xs
+                    full = [b if s is None else m
+                            for b, s, m in zip(batch_vals, split, mb)]
+                    mb_key = jax.random.fold_in(key, idx)
+                    (l, nb), g = jax.value_and_grad(
+                        loss_of, has_aux=True)(
+                            list(param_vals), bufs, full, mb_key)
+                    acc = [a + gi.astype(jnp.float32)
+                           for a, gi in zip(acc, g)]
+                    return (acc, nb), l
+
+                acc0 = [jnp.zeros(p.shape, jnp.float32)
+                        for p in param_vals]
+                mb_xs = [jnp.zeros((accum,)) if s is None else s
+                         for s in split]
+                (gsum, new_bufs), losses = jax.lax.scan(
+                    micro, (acc0, tuple(buffer_vals)),
+                    (jnp.arange(accum), mb_xs))
+                # avg=True (default): mean over micro-batches == the
+                # full-batch grad; avg=False keeps the reference's sum
+                # semantics (GradientMergeConfig.avg)
+                denom = accum if self.accumulate_avg else 1
+                grads = [(g / denom).astype(p.dtype)
+                         for g, p in zip(gsum, param_vals)]
+                loss = jnp.mean(losses)
+            else:
+                (loss, new_bufs), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(
+                        list(param_vals), list(buffer_vals),
+                        list(batch_vals), key)
+            if self._grad_clip is not None:
+                from ..nn.clip import apply_grad_clip_values
+                grads = apply_grad_clip_values(self._grad_clip, grads)
             new_params, new_states = [], []
             g2 = dict(gstate)
             for i, (p, g, s) in enumerate(zip(param_vals, grads, states)):
@@ -214,7 +268,13 @@ class CompiledTrainStep:
                                 lr, key, *batch_vals)
 
 
-def compile_train_step(loss_fn, model, optimizer, donate=True):
+def compile_train_step(loss_fn, model, optimizer, donate=True,
+                       accumulate_steps=None):
     """loss_fn(*batch_tensors) -> scalar loss Tensor, closing over
-    `model`. Returns a callable: step(*batch) -> loss."""
-    return CompiledTrainStep(loss_fn, model, optimizer, donate=donate)
+    `model`. Returns a callable: step(*batch) -> loss.
+
+    accumulate_steps=k scans k micro-batches (leading batch dim split
+    k ways) inside the one compiled program — gradient merge, reference
+    distributed_strategy.proto:81."""
+    return CompiledTrainStep(loss_fn, model, optimizer, donate=donate,
+                             accumulate_steps=accumulate_steps)

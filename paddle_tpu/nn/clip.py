@@ -121,3 +121,32 @@ def clip_grad_value_(parameters, clip_value):
 GradientClipByValue = ClipGradByValue
 GradientClipByNorm = ClipGradByNorm
 GradientClipByGlobalNorm = ClipGradByGlobalNorm
+
+
+def apply_grad_clip_values(clip, grads):
+    """Raw jnp-array form of the clip classes for the compiled paths
+    (jit.trainer / static Executor), semantics identical to
+    _dygraph_clip. Each class gets ITS OWN formula — duck-typing on
+    `clip_norm` would silently turn per-parameter ClipGradByNorm into
+    global-norm clipping."""
+    if clip is None:
+        return grads
+    if isinstance(clip, ClipGradByValue):
+        return [jnp.clip(g, clip.min, clip.max).astype(g.dtype)
+                for g in grads]
+    if isinstance(clip, ClipGradByNorm):
+        out = []
+        for g in grads:
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(
+                clip.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append(g * scale.astype(g.dtype))
+        return out
+    if isinstance(clip, ClipGradByGlobalNorm):
+        gnorm = jnp.sqrt(sum(
+            jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads))
+        scale = clip.clip_norm / jnp.maximum(gnorm, clip.clip_norm)
+        return [g * scale.astype(g.dtype) for g in grads]
+    raise NotImplementedError(
+        f"grad_clip {type(clip).__name__} is not supported on the "
+        "compiled train-step path")

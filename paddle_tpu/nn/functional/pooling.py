@@ -299,6 +299,46 @@ def _adaptive_max_fwd(x, out_sizes, channel_last, n):
     return out
 
 
+def _adaptive_max_with_index_fwd(x, out_sizes):
+    """(pooled values, flat-spatial argmax) per adaptive bin in ONE
+    traversal (paddle mask convention, as max_pool2d_mask: int64
+    row-major index over the INPUT plane, first-max on ties — reference
+    max_pool_with_index adaptive path). NC-leading layout; bin count is
+    small and static, so a python loop of slices traces to a handful of
+    fused argmax kernels."""
+    import itertools
+    spatial = x.shape[2:]
+    n_sp = len(spatial)
+    edges = [[((i * in_s) // out_s, -(-((i + 1) * in_s) // out_s))
+              for i in range(out_s)]
+             for in_s, out_s in zip(spatial, out_sizes)]
+    flat_strides = [int(np.prod(spatial[ax + 1:], dtype=np.int64))
+                    for ax in range(n_sp)]
+    cols = []
+    vals = []
+    for combo in itertools.product(*[range(o) for o in out_sizes]):
+        sl = (slice(None), slice(None)) + tuple(
+            slice(*edges[ax][combo[ax]]) for ax in range(n_sp))
+        win = x[sl]
+        wshape = win.shape[2:]
+        flat = win.reshape(win.shape[0], win.shape[1], -1)
+        a = jnp.argmax(flat, -1)
+        vals.append(jnp.take_along_axis(flat, a[..., None], -1)[..., 0])
+        idx = jnp.zeros_like(a)
+        rem = a
+        for ax in range(n_sp):
+            wsz = int(np.prod(wshape[ax + 1:], dtype=np.int64))
+            coord = rem // wsz
+            rem = rem % wsz
+            lo = edges[ax][combo[ax]][0]
+            idx = idx + (coord + lo) * flat_strides[ax]
+        cols.append(idx)
+    out_shape = x.shape[:2] + tuple(out_sizes)
+    out = jnp.stack(vals, axis=-1).reshape(out_shape)
+    mask = jnp.stack(cols, axis=-1).reshape(out_shape)
+    return out, mask.astype(jnp.int64)
+
+
 for _n in (1, 2, 3):
     def _make_ad(n):
         def avg(x, out_sizes, channel_last):
@@ -310,6 +350,9 @@ for _n in (1, 2, 3):
     _a, _m = _make_ad(_n)
     register_op(f"adaptive_avg_pool{_n}d", _a)
     register_op(f"adaptive_max_pool{_n}d", _m)
+
+register_op("adaptive_max_pool_with_index",
+            _adaptive_max_with_index_fwd)
 
 
 def _adaptive_impl(op, n, x, output_size, data_format):
@@ -338,19 +381,33 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
                           data_format)
 
 
+def _adaptive_max_with_mask(x, n, output_size):
+    x = as_tensor(x)
+    spatial = x.shape[2:2 + n]
+    if isinstance(output_size, (int, np.integer)):
+        output_size = (int(output_size),) * n
+    out_sizes = tuple(spatial[i] if output_size[i] is None
+                      else int(output_size[i]) for i in range(n))
+    return apply_op("adaptive_max_pool_with_index", x,
+                    attrs=dict(out_sizes=out_sizes))
+
+
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
     if return_mask:
-        raise NotImplementedError("return_mask unsupported on TPU backend")
-    return _adaptive_impl("adaptive_max_pool1d", 1, x, output_size, "NCW")
+        return _adaptive_max_with_mask(x, 1, output_size)
+    return _adaptive_impl("adaptive_max_pool1d", 1, x, output_size,
+                          "NCW")
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
     if return_mask:
-        raise NotImplementedError("return_mask unsupported on TPU backend")
-    return _adaptive_impl("adaptive_max_pool2d", 2, x, output_size, "NCHW")
+        return _adaptive_max_with_mask(x, 2, output_size)
+    return _adaptive_impl("adaptive_max_pool2d", 2, x, output_size,
+                          "NCHW")
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     if return_mask:
-        raise NotImplementedError("return_mask unsupported on TPU backend")
-    return _adaptive_impl("adaptive_max_pool3d", 3, x, output_size, "NCDHW")
+        return _adaptive_max_with_mask(x, 3, output_size)
+    return _adaptive_impl("adaptive_max_pool3d", 3, x, output_size,
+                          "NCDHW")

@@ -1,0 +1,36 @@
+"""paddle.onnx parity surface (reference: python/paddle/onnx/export.py:22).
+
+The reference delegates to the external `paddle2onnx` package. This
+build has neither `paddle2onnx` nor `onnx` installed (and no network to
+fetch them), so the API exists but is dependency-gated with the
+documented alternative: `paddle.jit.save` produces a portable StableHLO
+artifact — the exchange format of the XLA ecosystem — reloadable from
+Python (`paddle.jit.load`, `paddle.inference`) or any StableHLO
+consumer (IREE, XLA AOT).
+"""
+from __future__ import annotations
+
+import importlib.util
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export `layer` to ONNX at `path`.onnx (reference signature).
+
+    Requires the optional `paddle2onnx`/`onnx` dependencies; without
+    them this raises with the StableHLO alternative spelled out.
+    """
+    missing = [m for m in ("onnx",)
+               if importlib.util.find_spec(m) is None]
+    if missing:
+        raise NotImplementedError(
+            f"paddle.onnx.export needs the optional {missing} "
+            "package(s), which are not installed in this TPU build "
+            "(no network egress). Portable alternative: "
+            "paddle.jit.save(layer, path, input_spec) exports a "
+            "StableHLO artifact loadable via paddle.jit.load / "
+            "paddle.inference or any StableHLO consumer.")
+    raise NotImplementedError(
+        "StableHLO->ONNX conversion is not implemented; use the "
+        "StableHLO artifact from paddle.jit.save directly.")

@@ -17,10 +17,10 @@ buffer — so train-then-infer BN uses fresh statistics (reference BN
 variable semantics, python/paddle/nn/layer/norm.py).
 
 Known v1 deltas from the reference, by design:
-- startup programs are no-ops: initializer ops already ran eagerly at
-  layer construction (parameters are born initialized).
-- gradient clipping configured on the optimizer is not yet applied on
-  the static path.
+- startup programs are no-ops on FIRST run: initializer ops already ran
+  eagerly at layer construction (parameters are born initialized). A
+  repeat run — the re-initialization idiom — warns loudly instead of
+  silently doing nothing.
 """
 from __future__ import annotations
 
@@ -465,7 +465,23 @@ class Executor:
         if program is None:
             program = default_main_program()
         if program is _state["startup"] or not program._nodes:
-            return []  # startup: params were initialized eagerly
+            # startup: params were initialized eagerly at construction.
+            # A SECOND run of the startup program is the
+            # re-initialization idiom — that we cannot honor (no
+            # initializer ops are recorded), so reject loudly rather
+            # than silently diverge from the reference
+            if program is _state["startup"]:
+                if getattr(program, "_startup_ran", False):
+                    import warnings
+                    warnings.warn(
+                        "re-running the startup program does NOT "
+                        "re-initialize parameters in paddle_tpu (they "
+                        "are initialized eagerly at Layer "
+                        "construction); rebuild the layers to "
+                        "re-initialize",
+                        RuntimeWarning, stacklevel=2)
+                program._startup_ran = True
+            return []
         fetch_list = fetch_list or []
         fetch_ids = []
         for f in fetch_list:
@@ -545,6 +561,7 @@ class Executor:
                 feed_ids, trainable)
             decay = opt._decay if not getattr(opt, "_decoupled", False) \
                 else 0.0
+            clip = getattr(opt, "_grad_clip", None)
             extras = opt._per_param_extra(
                 [program._tensors[i] for i in param_ids])
             wb = sorted(program._leaf_alias.items())
@@ -560,6 +577,11 @@ class Executor:
 
                 (lossv, (fetches, wb_vals)), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(list(p_vals))
+                if clip is not None:
+                    # per-class clip semantics, same order (clip then
+                    # decay) as the dygraph CompiledTrainStep
+                    from ..nn.clip import apply_grad_clip_values
+                    grads = apply_grad_clip_values(clip, grads)
                 if decay:
                     grads = [g + decay * p
                              for p, g in zip(p_vals, grads)]

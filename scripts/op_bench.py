@@ -1,0 +1,186 @@
+"""Op micro-benchmark harness (reference:
+/root/reference/paddle/fluid/operators/benchmark/op_tester.cc:1 +
+tools/ci_op_benchmark.sh:1 — config-driven single-op timing feeding a
+CI regression gate; see scripts/op_bench_check.py for the gate).
+
+For each op: `host_us` (eager dispatch cost, async — the Python->
+device-queue path that SURVEY §3.1 flags) and `wall_us` (pipelined
+wall time per op incl. device execution, measured over a chained loop
+with one host sync at the end). Writes a JSON report and prints one
+summary line.
+
+Usage:
+  python scripts/op_bench.py [--out op_bench.json] [--iters 200]
+  python scripts/op_bench_check.py old.json new.json   # the gate
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _cases():
+    """(name, build() -> (fn, args)) for the hot ops. Shapes sized so
+    device work is measurable but dispatch still dominates on CPU."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import ops
+
+    rng = np.random.RandomState(0)
+
+    def t(*shape, dtype="float32"):
+        if dtype == "int64":
+            return paddle.to_tensor(
+                rng.randint(0, 100, shape).astype(np.int64))
+        if dtype == "bool":
+            return paddle.to_tensor(rng.rand(*shape) > 0.5)
+        return paddle.to_tensor(rng.randn(*shape).astype(dtype))
+
+    M = (256, 256)
+    cases = {
+        "add": lambda: (paddle.add, (t(*M), t(*M))),
+        "multiply": lambda: (paddle.multiply, (t(*M), t(*M))),
+        "scale": lambda: (lambda x: paddle.scale(x, 1.01), (t(*M),)),
+        "exp": lambda: (paddle.exp, (t(*M),)),
+        "tanh": lambda: (paddle.tanh, (t(*M),)),
+        "relu": lambda: (F.relu, (t(*M),)),
+        "gelu": lambda: (F.gelu, (t(*M),)),
+        "sigmoid": lambda: (F.sigmoid, (t(*M),)),
+        "sqrt": lambda: (paddle.sqrt, (t(*M) * 0 + 2.0,)),
+        "pow": lambda: (lambda x: paddle.pow(x, 2.0), (t(*M),)),
+        "maximum": lambda: (paddle.maximum, (t(*M), t(*M))),
+        "where": lambda: (paddle.where,
+                          (t(*M, dtype="bool"), t(*M), t(*M))),
+        "cast": lambda: (lambda x: x.astype("bfloat16"), (t(*M),)),
+        "matmul": lambda: (paddle.matmul, (t(256, 256), t(256, 256))),
+        "matmul_batched": lambda: (paddle.matmul,
+                                   (t(8, 128, 64), t(8, 64, 128))),
+        "conv2d": lambda: (
+            lambda x, w: F.conv2d(x, w, padding=1),
+            (t(8, 16, 32, 32), t(32, 16, 3, 3))),
+        "softmax": lambda: (F.softmax, (t(64, 1024),)),
+        "log_softmax": lambda: (F.log_softmax, (t(64, 1024),)),
+        "cross_entropy": lambda: (
+            F.cross_entropy, (t(64, 100), t(64, dtype="int64") % 100)),
+        "layer_norm": lambda: (
+            lambda x, w, b: F.layer_norm(x, 256, w, b),
+            (t(64, 256), t(256), t(256))),
+        "batch_norm_infer": lambda: (
+            lambda x, m, v, w, b: F.batch_norm(x, m, v, w, b),
+            (t(8, 16, 32, 32), t(16), t(16) * 0 + 1.0, t(16), t(16))),
+        "dropout_eval": lambda: (
+            lambda x: F.dropout(x, 0.5, training=False), (t(*M),)),
+        "reduce_sum": lambda: (paddle.sum, (t(*M),)),
+        "reduce_mean_axis": lambda: (
+            lambda x: paddle.mean(x, axis=1), (t(*M),)),
+        "argmax": lambda: (lambda x: paddle.argmax(x, -1), (t(*M),)),
+        "cumsum": lambda: (lambda x: paddle.cumsum(x, -1), (t(*M),)),
+        "topk": lambda: (lambda x: paddle.topk(x, 8), (t(64, 1024),)),
+        "sort": lambda: (lambda x: paddle.sort(x, -1), (t(64, 256),)),
+        "transpose": lambda: (
+            lambda x: paddle.transpose(x, [1, 0]), (t(*M),)),
+        "reshape": lambda: (
+            lambda x: paddle.reshape(x, [64, 1024]), (t(*M),)),
+        "concat": lambda: (
+            lambda a, b: paddle.concat([a, b], axis=0),
+            (t(*M), t(*M))),
+        "split": lambda: (
+            lambda x: paddle.split(x, 2, axis=1), (t(*M),)),
+        "gather": lambda: (
+            lambda x, i: paddle.gather(x, i),
+            (t(*M), t(64, dtype="int64") % 256)),
+        "index_select": lambda: (
+            lambda x, i: paddle.index_select(x, i),
+            (t(*M), t(64, dtype="int64") % 256)),
+        "embedding": lambda: (
+            lambda i, w: F.embedding(i, w),
+            (t(64, 32, dtype="int64") % 1000, t(1000, 64))),
+        "one_hot": lambda: (
+            lambda i: F.one_hot(i % 64, 64),
+            (t(64, dtype="int64"),)),
+        "clip": lambda: (
+            lambda x: paddle.clip(x, -1.0, 1.0), (t(*M),)),
+        "tril": lambda: (paddle.tril, (t(*M),)),
+        "masked_fill": lambda: (
+            lambda x, m: paddle.masked_fill(x, m, 0.0),
+            (t(*M), t(*M, dtype="bool"))),
+        "squeeze_unsqueeze": lambda: (
+            lambda x: paddle.unsqueeze(paddle.squeeze(x, 0), 0),
+            (t(1, *M),)),
+    }
+    return cases
+
+
+def _sync(v):
+    out = v
+    while isinstance(out, (tuple, list)):
+        out = out[0]
+    np.asarray(out.numpy()).ravel()[:1]
+
+
+def bench_op(fn, args, iters):
+    out = fn(*args)  # warm (jit compile)
+    _sync(out)
+    # host dispatch: async loop, no sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    host_us = (time.perf_counter() - t0) / iters * 1e6
+    _sync(out)
+    # pipelined wall: loop + one final sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out)
+    wall_us = (time.perf_counter() - t0) / iters * 1e6
+    return round(host_us, 2), round(wall_us, 2)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--ops", default=None,
+                    help="comma-separated subset of op names")
+    args = ap.parse_args()
+
+    import paddle_tpu  # noqa: F401  (applies device config before jax init)
+    import jax
+    platform = jax.devices()[0].platform
+    cases = _cases()
+    if args.ops:
+        want = set(args.ops.split(","))
+        cases = {k: v for k, v in cases.items() if k in want}
+
+    report = {"platform": platform, "iters": args.iters, "ops": {}}
+    for name, build in cases.items():
+        fn, fargs = build()
+        host_us, wall_us = bench_op(fn, fargs, args.iters)
+        report["ops"][name] = {"host_us": host_us, "wall_us": wall_us}
+        print(f"{name:22s} host {host_us:8.1f} us  wall "
+              f"{wall_us:8.1f} us", file=sys.stderr)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+    med = float(np.median([v["host_us"]
+                           for v in report["ops"].values()]))
+    print(json.dumps({
+        "metric": "op_dispatch_median_us",
+        "value": round(med, 2),
+        "unit": f"us/op ({platform}, {len(report['ops'])} ops, "
+                "eager host dispatch)",
+        "vs_baseline": 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Op-benchmark regression gate (reference:
+/root/reference/tools/check_op_benchmark_result.py:1 +
+tools/ci_op_benchmark.sh:1 — per-PR diff of op timings against a
+baseline run, failing on regressions).
+
+Usage: python scripts/op_bench_check.py baseline.json new.json
+       [--threshold 1.4] [--metric host_us]
+
+Exit 0 when no op regressed beyond threshold x baseline; exit 1 with a
+table of offenders otherwise. New/removed ops are reported but do not
+fail the gate.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("baseline")
+    ap.add_argument("new")
+    ap.add_argument("--threshold", type=float, default=1.4,
+                    help="fail when new > threshold * baseline")
+    ap.add_argument("--metric", default="host_us",
+                    choices=["host_us", "wall_us"])
+    args = ap.parse_args()
+
+    with open(args.baseline) as f:
+        base = json.load(f)
+    with open(args.new) as f:
+        new = json.load(f)
+
+    if base.get("platform") != new.get("platform"):
+        print(f"WARNING: platform changed "
+              f"{base.get('platform')} -> {new.get('platform')}; "
+              "timings are not comparable", file=sys.stderr)
+
+    bad = []
+    for name, b in sorted(base["ops"].items()):
+        n = new["ops"].get(name)
+        if n is None:
+            print(f"removed: {name}", file=sys.stderr)
+            continue
+        bv, nv = b[args.metric], n[args.metric]
+        ratio = nv / bv if bv else float("inf")
+        if ratio > args.threshold:
+            bad.append((name, bv, nv, ratio))
+    for name in sorted(set(new["ops"]) - set(base["ops"])):
+        print(f"new op (no baseline): {name}", file=sys.stderr)
+
+    if bad:
+        print(f"{len(bad)} op(s) regressed beyond "
+              f"{args.threshold:.2f}x on {args.metric}:")
+        for name, bv, nv, r in sorted(bad, key=lambda x: -x[3]):
+            print(f"  {name:22s} {bv:9.1f} -> {nv:9.1f} us "
+                  f"({r:.2f}x)")
+        sys.exit(1)
+    print(f"op benchmark gate OK ({len(base['ops'])} ops, "
+          f"threshold {args.threshold:.2f}x on {args.metric})")
+
+
+if __name__ == "__main__":
+    main()

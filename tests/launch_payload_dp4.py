@@ -1,0 +1,59 @@
+"""Launcher payload for the two-node simulated test: 4 ranks spread
+over nnodes=2 x nproc_per_node=2 on one box (reference pattern:
+test_dist_base.py:900 crafts multi-node env on localhost). One dp=4
+SGD step over a 16-sample global batch; rank 0 writes the result."""
+import os
+import re
+import sys
+
+os.environ["XLA_FLAGS"] = re.sub(
+    r"--xla_force_host_platform_device_count=\d+", "",
+    os.environ.get("XLA_FLAGS", "")).strip()
+os.environ["PADDLE_TPU_FORCE_CPU_DEVICES"] = "1"
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.optimizer as opt  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+
+out_path = sys.argv[1]
+
+env = dist.init_parallel_env()
+import jax  # noqa: E402
+assert env.world_size == 4, env.world_size
+assert jax.process_count() == 4
+# the node plumbing must be visible in the injected env
+assert os.environ["PADDLE_NNODES"] == "2"
+assert os.environ["PADDLE_NODE_RANK"] in ("0", "1")
+assert int(os.environ["PADDLE_TRAINER_ID"]) == \
+    int(os.environ["PADDLE_NODE_RANK"]) * 2 + \
+    int(os.environ["PADDLE_LOCAL_RANK"])
+
+xs = (np.arange(64, dtype="float32").reshape(16, 4) / 20.0) - 1.0
+ys = (xs.sum(1, keepdims=True) * 0.5 + 0.25).astype("float32")
+
+paddle.seed(0)
+model = nn.Linear(4, 1)
+optimizer = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+
+# contiguous per-rank shard of the global batch (order-invariant loss)
+shard = slice(env.rank * 4, env.rank * 4 + 4)
+pred = model(paddle.to_tensor(xs[shard]))
+local = ((pred - paddle.to_tensor(ys[shard])) ** 2).mean()
+local.backward()
+
+# dp grad averaging across ranks (divergent shards -> real all_reduce)
+for p in model.parameters():
+    dist.all_reduce(p.grad)
+    p.grad.set_value(p.grad / env.world_size)
+optimizer.step()
+
+losses: list = []
+dist.all_gather_object(losses, float(local))
+if env.rank == 0:
+    # mean of per-shard mean losses == global mean loss (equal shards)
+    np.savez(out_path, loss=np.mean(losses),
+             w=model.weight.numpy(), b=model.bias.numpy())
+print(f"rank {env.rank} done", flush=True)

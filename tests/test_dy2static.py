@@ -349,3 +349,163 @@ class TestForRangeConversion:
         g = convert_control_flow(f)
         # python int bound: works, appends 3 times
         assert g(paddle.to_tensor(np.ones(1, "float32")), 3) == 3
+
+
+class TestControlTransfers:
+    """break/continue/mid-loop-return functionalization (reference
+    break_continue_transformer.py, return_transformer.py). Success under
+    to_static with tensor predicates implies conversion: an unconverted
+    transfer would raise the tracer-bool error."""
+
+    def test_while_tensor_break(self):
+        def f(x):
+            s = x * 0.0
+            i = paddle.to_tensor(np.int64(0))
+            while i < 10:
+                s = s + x
+                if s.sum() > 5.0:
+                    break
+                i = i + 1
+            return s
+
+        x = np.array([1.0, 1.0], "float32")
+        want = f(paddle.to_tensor(x)).numpy()      # eager
+        got = jit.to_static(f)(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, want)
+        assert want.sum() > 5.0 and want.sum() <= 7.0 + 1e-6
+
+    def test_while_tensor_continue(self):
+        def f(x):
+            s = x * 0.0
+            i = paddle.to_tensor(np.int64(0))
+            while i < 6:
+                i = i + 1
+                if paddle.mod(i, 2) == 0:
+                    continue
+                s = s + x * i.astype("float32")
+            return s
+
+        x = np.array([1.0], "float32")
+        want = f(paddle.to_tensor(x)).numpy()   # 1+3+5 = 9
+        got = jit.to_static(f)(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, want)
+        np.testing.assert_allclose(want, [9.0])
+
+    def test_for_range_break(self):
+        def f(x):
+            s = x * 0.0
+            for i in range(8):
+                s = s + x
+                if s.sum() > 3.0:
+                    break
+            return s
+
+        x = np.array([1.0], "float32")
+        want = f(paddle.to_tensor(x)).numpy()   # 4 adds
+        got = jit.to_static(f)(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, want)
+        np.testing.assert_allclose(want, [4.0])
+
+    def test_for_range_continue(self):
+        def f(x):
+            s = x * 0.0
+            for i in range(6):
+                if i % 2 == 0:
+                    continue
+                s = s + x * float(i)
+            return s
+
+        x = np.array([2.0], "float32")
+        want = f(paddle.to_tensor(x)).numpy()   # (1+3+5)*2 = 18
+        got = jit.to_static(f)(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, want)
+        np.testing.assert_allclose(want, [18.0])
+
+    def test_mid_loop_return(self):
+        def f(x):
+            s = x * 0.0
+            i = paddle.to_tensor(np.int64(0))
+            while i < 10:
+                s = s + x
+                if s.sum() > 4.0:
+                    return s * 100.0
+                i = i + 1
+            return s
+
+        # early-exit case
+        x = np.array([2.0], "float32")
+        want = f(paddle.to_tensor(x)).numpy()   # 3 adds -> 6 -> *100
+        got = jit.to_static(f)(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, want)
+        np.testing.assert_allclose(want, [600.0])
+        # loop-runs-dry case through the same compiled fn
+        x2 = np.array([0.1], "float32")
+        want2 = f(paddle.to_tensor(x2)).numpy()
+        got2 = jit.to_static(f)(paddle.to_tensor(x2)).numpy()
+        np.testing.assert_allclose(got2, want2, rtol=1e-6)
+
+    def test_two_return_sites_in_loop(self):
+        def f(x):
+            s = x * 0.0
+            i = paddle.to_tensor(np.int64(0))
+            while i < 10:
+                s = s + x
+                if s.sum() > 6.0:
+                    return s + 1000.0
+                if s.sum() > 3.0:
+                    return s - 1000.0
+                i = i + 1
+            return s
+
+        for v, expect in [(2.5, [5.0 - 1000.0]), (4.0, [4.0 - 1000.0])]:
+            x = np.array([v], "float32")
+            want = f(paddle.to_tensor(x)).numpy()
+            got = jit.to_static(f)(paddle.to_tensor(x)).numpy()
+            np.testing.assert_allclose(got, want)
+            np.testing.assert_allclose(want, expect)
+
+    def test_early_return_chain(self):
+        @jit.to_static
+        def f(x):
+            if x.sum() > 10.0:
+                return x * 100.0
+            if x.sum() > 0.0:
+                return x * 10.0
+            return x
+
+        for v, scale in [(6.0, 100.0), (1.0, 10.0), (-1.0, 1.0)]:
+            x = np.full(2, v, "float32")
+            np.testing.assert_allclose(
+                f(paddle.to_tensor(x)).numpy(), x * scale)
+
+    def test_python_break_still_python(self):
+        # non-tensor predicates keep exact Python semantics eagerly
+        def f(x):
+            s = 0.0
+            for i in range(10):
+                if i == 3:
+                    break
+                s = s + float(i)
+            return paddle.to_tensor(np.float32(s)) + x
+
+        x = paddle.to_tensor(np.float32(0.0))
+        assert float(f(x)) == 3.0  # 0+1+2
+        assert float(jit.to_static(f)(x)) == 3.0
+
+    def test_break_does_not_reevaluate_predicate(self):
+        # code-review regression: after break, Python guarantees the
+        # loop test is NOT re-evaluated; `q[0]` on the emptied list
+        # would raise if it were
+        def f(x):
+            q = [1.0, 2.0, 3.0]
+            s = x * 0.0
+            while q[0] > 0:
+                s = s + q.pop(0)
+                if not q:
+                    break
+            return s
+
+        x = paddle.to_tensor(np.zeros(1, "float32"))
+        assert float(f(x)) == 6.0
+        g = jit.to_static(f)
+        assert float(g(x)) == 6.0

@@ -108,3 +108,50 @@ class TestLauncher:
         assert (tmp_path / "done_rank1_a1").exists()
         # attempt 0 died before completing
         assert not (tmp_path / "done_rank1_a0").exists()
+
+
+def test_two_node_simulated_launch(tmp_path):
+    """nnodes=2 simulated on one box: two launcher invocations
+    (node_rank 0/1) sharing one --master, 2 procs each -> a dp=4 world.
+    Asserts the master/node_rank plumbing end-to-end and numeric parity
+    with a single-process step on the union batch (reference pattern:
+    test_dist_base.py:900)."""
+    from paddle_tpu.distributed.launch import find_free_port
+    out = str(tmp_path / "out.npz")
+    master = f"127.0.0.1:{find_free_port()}"
+    nodes = []
+    for node_rank in range(2):
+        nodes.append(subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nnodes", "2", "--node_rank", str(node_rank),
+             "--master", master, "--nproc_per_node", "2",
+             "--log_dir", str(tmp_path / f"node{node_rank}"),
+             "tests/launch_payload_dp4.py", out],
+            cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outs = []
+    for p in nodes:
+        stdout, _ = p.communicate(timeout=300)
+        outs.append(stdout)
+        assert p.returncode == 0, stdout[-3000:]
+
+    got = np.load(out)
+    # single-process reference on the full 16-sample batch
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+    xs = (np.arange(64, dtype="float32").reshape(16, 4) / 20.0) - 1.0
+    ys = (xs.sum(1, keepdims=True) * 0.5 + 0.25).astype("float32")
+    paddle.seed(0)
+    model = nn.Linear(4, 1)
+    optimizer = opt.SGD(learning_rate=0.1,
+                        parameters=model.parameters())
+    loss = ((model(paddle.to_tensor(xs)) - paddle.to_tensor(ys)) ** 2
+            ).mean()
+    loss.backward()
+    optimizer.step()
+    np.testing.assert_allclose(got["loss"], float(loss), rtol=1e-5)
+    np.testing.assert_allclose(got["w"], model.weight.numpy(),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got["b"], model.bias.numpy(),
+                               rtol=1e-5, atol=1e-6)

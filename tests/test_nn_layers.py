@@ -471,3 +471,127 @@ class TestMaxPoolMask:
         with pytest.raises(NotImplementedError):
             F.max_pool2d(x, 2, padding=[[1, 0], [1, 1]],
                          return_mask=True)
+
+
+class TestAdaptiveMaxPoolMask:
+    def _ref_mask2d(self, x, oh, ow):
+        n, c, H, W = x.shape
+        out = np.zeros((n, c, oh, ow), np.int64)
+        for i in range(oh):
+            lo_h, hi_h = (i * H) // oh, -(-((i + 1) * H) // oh)
+            for j in range(ow):
+                lo_w, hi_w = (j * W) // ow, -(-((j + 1) * W) // ow)
+                win = x[:, :, lo_h:hi_h, lo_w:hi_w].reshape(n, c, -1)
+                a = win.argmax(-1)
+                ww = hi_w - lo_w
+                out[:, :, i, j] = (a // ww + lo_h) * W + (a % ww + lo_w)
+        return out
+
+    def test_adaptive_max_pool2d_mask_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 7, 10).astype("float32")
+        out, mask = F.adaptive_max_pool2d(paddle.to_tensor(x), (3, 4),
+                                          return_mask=True)
+        want = self._ref_mask2d(x, 3, 4)
+        np.testing.assert_array_equal(mask.numpy(), want)
+        # mask indexes recover the pooled values
+        flat = x.reshape(2, 3, -1)
+        np.testing.assert_allclose(
+            np.take_along_axis(flat, mask.numpy().reshape(2, 3, -1),
+                               -1).reshape(out.shape),
+            out.numpy(), rtol=1e-6)
+
+    def test_adaptive_max_pool1d_mask(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 4, 9).astype("float32")
+        out, mask = F.adaptive_max_pool1d(paddle.to_tensor(x), 4,
+                                          return_mask=True)
+        flat = x.reshape(2, 4, -1)
+        np.testing.assert_allclose(
+            np.take_along_axis(flat, mask.numpy().reshape(2, 4, -1),
+                               -1).reshape(out.shape),
+            out.numpy(), rtol=1e-6)
+
+
+class TestRNNTLoss:
+    def _ref_rnnt(self, logits, labels, t_len, u_len, blank):
+        # independent numpy DP over the alignment lattice
+        lp = logits - logits.max(-1, keepdims=True)
+        lp = lp - np.log(np.exp(lp).sum(-1, keepdims=True))
+        T, U1, V = lp.shape
+        alpha = np.full((t_len, u_len + 1), -np.inf)
+        alpha[0, 0] = 0.0
+        for t in range(t_len):
+            for u in range(u_len + 1):
+                cands = []
+                if t > 0:
+                    cands.append(alpha[t - 1, u] + lp[t - 1, u, blank])
+                if u > 0:
+                    cands.append(alpha[t, u - 1]
+                                 + lp[t, u - 1, labels[u - 1]])
+                if cands:
+                    m = max(cands)
+                    alpha[t, u] = m + np.log(
+                        sum(np.exp(c - m) for c in cands))
+        return -(alpha[t_len - 1, u_len]
+                 + lp[t_len - 1, u_len, blank])
+
+    def test_matches_numpy_lattice(self):
+        rng = np.random.RandomState(0)
+        B, T, U, V = 3, 6, 4, 8
+        logits = rng.randn(B, T, U + 1, V).astype("float32")
+        labels = rng.randint(1, V, (B, U)).astype("int64")
+        t_lens = np.array([6, 5, 4], "int64")
+        u_lens = np.array([4, 3, 2], "int64")
+        got = F.rnnt_loss(paddle.to_tensor(logits),
+                          paddle.to_tensor(labels),
+                          paddle.to_tensor(t_lens),
+                          paddle.to_tensor(u_lens),
+                          blank=0, fastemit_lambda=0.0,
+                          reduction="none").numpy()
+        want = [self._ref_rnnt(logits[b], labels[b], int(t_lens[b]),
+                               int(u_lens[b]), 0) for b in range(B)]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_grad_flows_and_mean_reduction(self):
+        rng = np.random.RandomState(1)
+        logits = paddle.to_tensor(
+            rng.randn(2, 4, 3, 5).astype("float32"))
+        logits.stop_gradient = False
+        loss = F.rnnt_loss(logits,
+                           paddle.to_tensor(
+                               rng.randint(1, 5, (2, 2)).astype("int64")),
+                           paddle.to_tensor(np.array([4, 3], "int64")),
+                           paddle.to_tensor(np.array([2, 1], "int64")))
+        loss.backward()
+        g = logits.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+class TestHubOnnx:
+    def test_hub_local_list_help_load(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "dependencies = ['numpy']\n"
+            "def tiny_model(scale=2.0):\n"
+            "    'builds a tiny model'\n"
+            "    return ('model', scale)\n")
+        import paddle_tpu.hub as hub
+        assert hub.list(str(tmp_path), source="local") == ["tiny_model"]
+        assert "tiny" in hub.help(str(tmp_path), "tiny_model",
+                                  source="local")
+        assert hub.load(str(tmp_path), "tiny_model", source="local",
+                        scale=3.0) == ("model", 3.0)
+
+    def test_hub_network_sources_gated(self, tmp_path):
+        import paddle_tpu.hub as hub
+        with pytest.raises(NotImplementedError):
+            hub.list("PaddlePaddle/PaddleClas", source="github")
+        with pytest.raises(ValueError):
+            hub.list(str(tmp_path), source="bitbucket")
+
+    def test_onnx_export_gated_with_alternative(self):
+        import paddle_tpu as paddle
+        m = paddle.nn.Linear(2, 2)
+        with pytest.raises(NotImplementedError) as ei:
+            paddle.onnx.export(m, "/tmp/m")
+        assert "StableHLO" in str(ei.value)

@@ -387,3 +387,103 @@ class TestStaticNNLongTail:
         arr = -np.ones((2, 2, 3, 3), "float32")
         out, = exe.run(feed={"x": arr}, fetch_list=[y])
         np.testing.assert_allclose(out, arr * 0.25, rtol=1e-6)
+
+
+class TestStaticGradClip:
+    def test_static_clip_matches_dygraph(self):
+        """ClipGradByGlobalNorm on the optimizer must bite on the
+        Program/Executor path exactly as on the compiled dygraph step
+        (was an admitted v1 delta; reference python/paddle/nn/clip.py)."""
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.optimizer as opt
+
+        rng = np.random.RandomState(0)
+        x = (rng.randn(8, 4) * 50).astype(np.float32)  # big grads
+        y = (rng.randn(8, 2) * 50).astype(np.float32)
+
+        def build():
+            paddle.seed(11)
+            m = nn.Linear(4, 2)
+            o = opt.SGD(learning_rate=0.1, parameters=m.parameters(),
+                        grad_clip=nn.ClipGradByGlobalNorm(0.5))
+            return m, o
+
+        # dygraph compiled step
+        from paddle_tpu import jit
+        m1, o1 = build()
+        step = jit.compile_train_step(
+            lambda a, b: F.mse_loss(m1(a), b), m1, o1)
+        step(paddle.to_tensor(x), paddle.to_tensor(y))
+
+        # static program
+        m2, o2 = build()
+        prog = static.Program()
+        with static.program_guard(prog):
+            xin = static.data("x", shape=[None, 4], dtype="float32")
+            yin = static.data("y", shape=[None, 2], dtype="float32")
+            loss = F.mse_loss(m2(xin), yin)
+            o2.minimize(loss)
+        exe = static.Executor()
+        exe.run(prog, feed={"x": x, "y": y}, fetch_list=[loss])
+
+        for p1, p2 in zip(m1.parameters(), m2.parameters()):
+            np.testing.assert_allclose(p1.numpy(), p2.numpy(),
+                                       rtol=2e-5, atol=2e-6)
+        # and clipping actually bit: unclipped run diverges
+        m3, _ = build()
+        o3 = opt.SGD(learning_rate=0.1, parameters=m3.parameters())
+        step3 = jit.compile_train_step(
+            lambda a, b: F.mse_loss(m3(a), b), m3, o3)
+        step3(paddle.to_tensor(x), paddle.to_tensor(y))
+        diff = max(np.abs(p1.numpy() - p3.numpy()).max()
+                   for p1, p3 in zip(m1.parameters(), m3.parameters()))
+        assert diff > 1e-3
+
+    def test_startup_rerun_warns(self):
+        import warnings
+        exe = static.Executor()
+        sp = static.default_startup_program()
+        exe.run(sp)  # first: silent no-op
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            exe.run(sp)
+        assert any("re-initialize" in str(x.message) for x in w)
+
+    def test_static_per_param_and_value_clip_match_eager(self):
+        """ClipGradByNorm (per-parameter) and ClipGradByValue must keep
+        their OWN semantics on the static path — not be duck-typed into
+        global-norm clipping (code-review regression)."""
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.optimizer as opt
+
+        rng = np.random.RandomState(2)
+        x = (rng.randn(8, 4) * 50).astype(np.float32)
+        y = (rng.randn(8, 2) * 50).astype(np.float32)
+        for clip in (nn.ClipGradByNorm(0.5),
+                     nn.ClipGradByValue(0.01)):
+            paddle.seed(5)
+            m1 = nn.Linear(4, 2)
+            o1 = opt.SGD(learning_rate=0.1,
+                         parameters=m1.parameters(), grad_clip=clip)
+            loss = F.mse_loss(m1(paddle.to_tensor(x)),
+                              paddle.to_tensor(y))
+            loss.backward()
+            o1.step()  # eager reference path (per-class _dygraph_clip)
+
+            paddle.seed(5)
+            m2 = nn.Linear(4, 2)
+            o2 = opt.SGD(learning_rate=0.1,
+                         parameters=m2.parameters(), grad_clip=clip)
+            prog = static.Program()
+            with static.program_guard(prog):
+                xin = static.data("x", shape=[None, 4], dtype="float32")
+                yin = static.data("y", shape=[None, 2], dtype="float32")
+                sloss = F.mse_loss(m2(xin), yin)
+                o2.minimize(sloss)
+            static.Executor().run(prog, feed={"x": x, "y": y},
+                                  fetch_list=[sloss])
+            for p1, p2 in zip(m1.parameters(), m2.parameters()):
+                np.testing.assert_allclose(p1.numpy(), p2.numpy(),
+                                           rtol=2e-5, atol=2e-6)
