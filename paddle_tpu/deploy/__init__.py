@@ -1,0 +1,55 @@
+"""Native deployment surface (reference: paddle/fluid/inference
+C/C++/Go/R APIs over AnalysisPredictor; paddle/fluid/jit/layer.h).
+
+Exports the C ABI sources (`pd_inference_c.h/.c`) and `build_capi()`,
+which compiles `libpaddle_tpu_c.so` against the running interpreter —
+the same self-building pattern as the FasterTokenizer C core. A C/Go/R
+application then links only against the header + .so; the XLA runtime
+is hosted inside via embedded CPython (there is no standalone PJRT
+C-API plugin to link against in this distribution, and XLA itself IS
+the inference engine — the reference's analysis/optimization passes
+have no separate existence here).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+
+__all__ = ["build_capi", "capi_header_path", "capi_source_path"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def capi_header_path():
+    return os.path.join(_HERE, "pd_inference_c.h")
+
+
+def capi_source_path():
+    return os.path.join(_HERE, "pd_inference_c.c")
+
+
+def build_capi(out_dir=None, cc=None):
+    """Compile libpaddle_tpu_c.so; returns its path.
+
+    Links against the running interpreter's libpython (the `--embed`
+    config), so the resulting .so must run with the same Python
+    installation available (PYTHONPATH / venv env of the host process
+    is honored for locating paddle_tpu and jax).
+    """
+    out_dir = out_dir or _HERE
+    os.makedirs(out_dir, exist_ok=True)
+    so_path = os.path.join(out_dir, "libpaddle_tpu_c.so")
+    cc = cc or os.environ.get("CC", "gcc")
+    include = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    version = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_config_var("VERSION")
+    cmd = [cc, "-shared", "-fPIC", "-O2",
+           capi_source_path(),
+           f"-I{include}", f"-I{_HERE}",
+           f"-L{libdir}", f"-lpython{version}",
+           f"-Wl,-rpath,{libdir}",
+           "-o", so_path]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return so_path

@@ -1,0 +1,77 @@
+"""Python side of the C inference ABI (pd_inference_c.c).
+
+The C layer passes raw pointers as integers; this module wraps them
+with ctypes/numpy and drives the regular paddle_tpu.inference
+Predictor. Handles are opaque ints into module-level registries — the
+C side never sees a PyObject.
+"""
+from __future__ import annotations
+
+import ctypes
+import itertools
+
+import numpy as np
+
+_predictors: dict = {}
+_outputs: dict = {}
+_ids = itertools.count(1)
+
+_DTYPES = {0: np.float32, 1: np.int64, 2: np.int32}
+
+
+def create(model_prefix):
+    import paddle_tpu.inference as inf
+    cfg = inf.Config(model_prefix)
+    pred = inf.create_predictor(cfg)
+    h = next(_ids)
+    _predictors[h] = {"pred": pred, "inputs": {}}
+    return h
+
+
+def destroy(h):
+    _predictors.pop(h, None)
+    _outputs.pop(h, None)
+
+
+def input_names(h):
+    return list(_predictors[h]["pred"].get_input_names())
+
+
+def set_input(h, name, ptr, dtype_code, shape):
+    dt = _DTYPES[int(dtype_code)]
+    n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    buf = (ctypes.c_char * (n * np.dtype(dt).itemsize)).from_address(
+        int(ptr))
+    arr = np.frombuffer(buf, dtype=dt).reshape(shape).copy()
+    _predictors[h]["inputs"][name] = arr
+
+
+def run(h):
+    entry = _predictors[h]
+    pred = entry["pred"]
+    names = pred.get_input_names()
+    missing = [n for n in names if n not in entry["inputs"]]
+    if missing:
+        raise ValueError(f"inputs not set: {missing}")
+    outs = pred.run([entry["inputs"][n] for n in names])
+    _outputs[h] = [np.ascontiguousarray(o) for o in outs]
+    return len(_outputs[h])
+
+
+def output_shape(h, idx):
+    return list(_outputs[h][int(idx)].shape)
+
+
+def output_copy_float(h, idx, ptr, numel):
+    src = np.ascontiguousarray(
+        _outputs[h][int(idx)].astype(np.float32))
+    if src.size != int(numel):
+        raise ValueError(
+            f"output {idx} has {src.size} elements, caller asked "
+            f"{numel}")
+    ctypes.memmove(int(ptr), src.ctypes.data, src.size * 4)
+
+
+def version():
+    import paddle_tpu.inference as inf
+    return str(inf.get_version())

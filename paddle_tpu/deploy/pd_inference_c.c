@@ -1,0 +1,288 @@
+/* libpaddle_tpu_c.so — native C ABI over the StableHLO inference
+ * artifact (see pd_inference_c.h for the contract). Embeds CPython to
+ * host the XLA runtime; every entry point takes the GIL, calls into
+ * paddle_tpu.deploy._capi_bridge, and converts results back to plain C
+ * types. Built by paddle_tpu.deploy.build_capi() with the interpreter's
+ * own include/lib paths (python3-config --embed).
+ */
+#include "pd_inference_c.h"
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdlib.h>
+#include <string.h>
+
+static PyObject *g_bridge = NULL;
+static char g_err[4096];
+static int g_initialized = 0;
+
+struct PD_Config {
+    char *prefix;
+};
+
+struct PD_Predictor {
+    long handle;
+    /* cached input names (C copies; freed on destroy) */
+    char **names;
+    size_t n_names;
+    size_t n_outputs;
+};
+
+static void set_err_from_py(void) {
+    PyObject *type, *value, *tb;
+    PyErr_Fetch(&type, &value, &tb);
+    if (value != NULL) {
+        PyObject *s = PyObject_Str(value);
+        if (s != NULL) {
+            const char *msg = PyUnicode_AsUTF8(s);
+            snprintf(g_err, sizeof(g_err), "%s",
+                     msg ? msg : "unknown python error");
+            Py_DECREF(s);
+        }
+    } else {
+        snprintf(g_err, sizeof(g_err), "unknown error");
+    }
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(tb);
+}
+
+int PD_Init(void) {
+    if (g_initialized) {
+        return 0;
+    }
+    if (!Py_IsInitialized()) {
+        /* isolated=0: honor PYTHONPATH / venv env of the host process */
+        Py_InitializeEx(0);
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *mod = PyImport_ImportModule("paddle_tpu.deploy._capi_bridge");
+    if (mod == NULL) {
+        set_err_from_py();
+        PyGILState_Release(st);
+        return -1;
+    }
+    g_bridge = mod; /* keep the reference for process lifetime */
+    g_initialized = 1;
+    /* release the GIL so later PyGILState_Ensure calls work from any
+     * thread */
+    PyEval_SaveThread();
+    return 0;
+}
+
+void PD_Shutdown(void) {
+    /* Embedded JAX/XLA does not tolerate a full Py_Finalize round trip;
+     * deployment processes exit afterwards anyway, matching the
+     * reference predictor's process-lifetime semantics. */
+}
+
+const char *PD_GetLastError(void) {
+    return g_err;
+}
+
+/* call bridge.<name>(args...); returns new ref or NULL (err recorded) */
+static PyObject *bridge_call(const char *name, PyObject *args) {
+    PyObject *fn = PyObject_GetAttrString(g_bridge, name);
+    if (fn == NULL) {
+        set_err_from_py();
+        Py_XDECREF(args);
+        return NULL;
+    }
+    PyObject *out = PyObject_CallObject(fn, args);
+    Py_DECREF(fn);
+    Py_XDECREF(args);
+    if (out == NULL) {
+        set_err_from_py();
+    }
+    return out;
+}
+
+const char *PD_GetVersion(void) {
+    static char ver[128] = "";
+    if (PD_Init() != 0) {
+        return "";
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *out = bridge_call("version", PyTuple_New(0));
+    if (out != NULL) {
+        const char *s = PyUnicode_AsUTF8(out);
+        if (s != NULL) {
+            snprintf(ver, sizeof(ver), "%s", s);
+        }
+        Py_DECREF(out);
+    }
+    PyGILState_Release(st);
+    return ver;
+}
+
+PD_Config *PD_ConfigCreate(void) {
+    PD_Config *c = (PD_Config *)calloc(1, sizeof(PD_Config));
+    return c;
+}
+
+void PD_ConfigSetModel(PD_Config *config, const char *model_prefix) {
+    if (config == NULL) {
+        return;
+    }
+    free(config->prefix);
+    config->prefix = strdup(model_prefix ? model_prefix : "");
+}
+
+void PD_ConfigDestroy(PD_Config *config) {
+    if (config != NULL) {
+        free(config->prefix);
+        free(config);
+    }
+}
+
+PD_Predictor *PD_PredictorCreate(PD_Config *config) {
+    if (config == NULL || config->prefix == NULL) {
+        snprintf(g_err, sizeof(g_err), "config has no model prefix");
+        return NULL;
+    }
+    if (PD_Init() != 0) {
+        return NULL;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *out = bridge_call(
+        "create", Py_BuildValue("(s)", config->prefix));
+    if (out == NULL) {
+        PyGILState_Release(st);
+        return NULL;
+    }
+    long handle = PyLong_AsLong(out);
+    Py_DECREF(out);
+
+    PD_Predictor *p = (PD_Predictor *)calloc(1, sizeof(PD_Predictor));
+    p->handle = handle;
+    PyObject *names = bridge_call("input_names",
+                                  Py_BuildValue("(l)", handle));
+    if (names != NULL && PyList_Check(names)) {
+        p->n_names = (size_t)PyList_Size(names);
+        p->names = (char **)calloc(p->n_names, sizeof(char *));
+        for (size_t i = 0; i < p->n_names; i++) {
+            const char *s =
+                PyUnicode_AsUTF8(PyList_GetItem(names, (Py_ssize_t)i));
+            p->names[i] = strdup(s ? s : "");
+        }
+    }
+    Py_XDECREF(names);
+    PyGILState_Release(st);
+    return p;
+}
+
+void PD_PredictorDestroy(PD_Predictor *pred) {
+    if (pred == NULL) {
+        return;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *out = bridge_call("destroy",
+                                Py_BuildValue("(l)", pred->handle));
+    Py_XDECREF(out);
+    PyGILState_Release(st);
+    for (size_t i = 0; i < pred->n_names; i++) {
+        free(pred->names[i]);
+    }
+    free(pred->names);
+    free(pred);
+}
+
+size_t PD_PredictorGetInputNum(PD_Predictor *pred) {
+    return pred ? pred->n_names : 0;
+}
+
+const char *PD_PredictorGetInputName(PD_Predictor *pred, size_t idx) {
+    if (pred == NULL || idx >= pred->n_names) {
+        return NULL;
+    }
+    return pred->names[idx];
+}
+
+int PD_PredictorSetInput(PD_Predictor *pred, const char *name,
+                         const void *data, int dtype,
+                         const int64_t *shape, int ndim) {
+    if (pred == NULL || data == NULL || name == NULL) {
+        snprintf(g_err, sizeof(g_err), "null argument");
+        return -1;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *shp = PyList_New(ndim);
+    for (int i = 0; i < ndim; i++) {
+        PyList_SetItem(shp, i, PyLong_FromLongLong(shape[i]));
+    }
+    PyObject *out = bridge_call(
+        "set_input",
+        Py_BuildValue("(lsKiN)", pred->handle, name,
+                      (unsigned long long)(uintptr_t)data, dtype, shp));
+    int rc = out != NULL ? 0 : -1;
+    Py_XDECREF(out);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int PD_PredictorRun(PD_Predictor *pred) {
+    if (pred == NULL) {
+        return -1;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *out = bridge_call("run", Py_BuildValue("(l)",
+                                                     pred->handle));
+    int rc = -1;
+    if (out != NULL) {
+        pred->n_outputs = (size_t)PyLong_AsLong(out);
+        Py_DECREF(out);
+        rc = 0;
+    }
+    PyGILState_Release(st);
+    return rc;
+}
+
+size_t PD_PredictorGetOutputNum(PD_Predictor *pred) {
+    return pred ? pred->n_outputs : 0;
+}
+
+int PD_PredictorGetOutputShape(PD_Predictor *pred, size_t idx,
+                               int64_t *shape, int *ndim_inout) {
+    if (pred == NULL || shape == NULL || ndim_inout == NULL) {
+        return -1;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *out = bridge_call(
+        "output_shape", Py_BuildValue("(ln)", pred->handle,
+                                      (Py_ssize_t)idx));
+    int rc = -1;
+    if (out != NULL && PyList_Check(out)) {
+        int rank = (int)PyList_Size(out);
+        if (rank <= *ndim_inout) {
+            for (int i = 0; i < rank; i++) {
+                shape[i] = PyLong_AsLongLong(
+                    PyList_GetItem(out, (Py_ssize_t)i));
+            }
+            *ndim_inout = rank;
+            rc = 0;
+        } else {
+            snprintf(g_err, sizeof(g_err),
+                     "shape capacity %d < rank %d", *ndim_inout, rank);
+        }
+    }
+    Py_XDECREF(out);
+    PyGILState_Release(st);
+    return rc;
+}
+
+int PD_PredictorGetOutputFloat(PD_Predictor *pred, size_t idx,
+                               float *out_buf, size_t numel) {
+    if (pred == NULL || out_buf == NULL) {
+        return -1;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject *out = bridge_call(
+        "output_copy_float",
+        Py_BuildValue("(lnKn)", pred->handle, (Py_ssize_t)idx,
+                      (unsigned long long)(uintptr_t)out_buf,
+                      (Py_ssize_t)numel));
+    int rc = out != NULL ? 0 : -1;
+    Py_XDECREF(out);
+    PyGILState_Release(st);
+    return rc;
+}

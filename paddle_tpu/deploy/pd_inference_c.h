@@ -1,0 +1,69 @@
+/* C inference API for paddle_tpu saved models.
+ *
+ * Mirrors the reference's C inference surface
+ * (paddle/fluid/inference/capi_exp/pd_inference_api.h) over the
+ * StableHLO artifact that paddle.jit.save / save_inference_model
+ * exports. The implementation (pd_inference_c.c -> libpaddle_tpu_c.so)
+ * hosts the XLA runtime by embedding CPython: a C/Go/R application
+ * links ONLY against this header + the .so — no Python appears in the
+ * application's code or build. Set PADDLE_TPU_NUM_THREADS etc. through
+ * the environment as usual; model discovery and execution match the
+ * Python paddle.inference.Predictor exactly (same module underneath).
+ *
+ * All functions return 0 on success and -1 on error unless noted;
+ * PD_GetLastError() describes the most recent failure.
+ */
+#ifndef PD_INFERENCE_C_H
+#define PD_INFERENCE_C_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+
+/* Runtime lifecycle. PD_Init is optional (PredictorCreate calls it);
+ * call PD_Shutdown at most once, at process exit. */
+int PD_Init(void);
+void PD_Shutdown(void);
+const char *PD_GetVersion(void);
+const char *PD_GetLastError(void);
+
+/* Config */
+PD_Config *PD_ConfigCreate(void);
+void PD_ConfigSetModel(PD_Config *config, const char *model_prefix);
+void PD_ConfigDestroy(PD_Config *config);
+
+/* Predictor */
+PD_Predictor *PD_PredictorCreate(PD_Config *config);
+void PD_PredictorDestroy(PD_Predictor *pred);
+
+size_t PD_PredictorGetInputNum(PD_Predictor *pred);
+/* Returned pointer is owned by the predictor; valid until destroy. */
+const char *PD_PredictorGetInputName(PD_Predictor *pred, size_t idx);
+
+/* Inputs: row-major data copied at call time. dtype codes follow the
+ * reference's PD_DataType: 0=float32, 1=int64, 2=int32. */
+int PD_PredictorSetInput(PD_Predictor *pred, const char *name,
+                         const void *data, int dtype,
+                         const int64_t *shape, int ndim);
+
+int PD_PredictorRun(PD_Predictor *pred);
+
+size_t PD_PredictorGetOutputNum(PD_Predictor *pred);
+/* ndim_inout: in = capacity of shape[], out = actual rank. */
+int PD_PredictorGetOutputShape(PD_Predictor *pred, size_t idx,
+                               int64_t *shape, int *ndim_inout);
+/* Copies the idx-th output (as float32) into out; numel must equal the
+ * product of the output shape. */
+int PD_PredictorGetOutputFloat(PD_Predictor *pred, size_t idx,
+                               float *out, size_t numel);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PD_INFERENCE_C_H */

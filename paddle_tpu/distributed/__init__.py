@@ -32,6 +32,7 @@ from . import shard_ops  # noqa: F401
 from . import fleet  # noqa: F401
 from .moe import MoELayer  # noqa: F401
 from . import auto_parallel  # noqa: F401
+from . import cost_model  # noqa: F401
 from .auto_parallel import shard_op, Engine, to_distributed  # noqa: F401
 
 

@@ -1,0 +1,163 @@
+"""Auto-parallel cost model + mesh tuner.
+
+Reference: python/paddle/distributed/auto_parallel/cost_model.py (an
+analytic per-op cost graph) and auto_parallel/tuner/ (profile-driven
+search over dist attrs). The TPU-first replacement does not re-derive
+per-op costs by hand: XLA already computes them. For every candidate
+mesh factorization we AOT-compile the REAL train step (GSPMD inserts
+the collectives) and read the compiler's own `cost_analysis()` /
+`memory_analysis()` — flops, bytes accessed, and per-device peak
+buffers of the exact program that would run — then rank by an analytic
+time estimate.
+
+    from paddle_tpu.distributed import cost_model
+    report = cost_model.tune_mesh(build_step, n_devices=8,
+                                  axis_names=("dp", "mp"))
+    best = report.best  # MeshPlan(shape={'dp': 4, 'mp': 2}, ...)
+
+`build_step(mesh)` builds model/optimizer/batch under the given
+ProcessMesh and returns either a `jit.CompiledTrainStep` together with
+its batch — `(step, batch)` — or a pre-lowered `jax.stages.Lowered`.
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+__all__ = ["MeshPlan", "TuneReport", "tune_mesh", "analyze_lowered",
+           "chip_specs"]
+
+
+# Per-chip peak numbers for the analytic time model; keyed by substring
+# of device_kind (fallback: generic). (flops/s bf16, HBM bytes/s,
+# ICI bytes/s per link)
+_CHIPS = {
+    "v5p": (459e12, 2765e9, 100e9),
+    "v5 lite": (197e12, 819e9, 50e9),
+    "v5e": (197e12, 819e9, 50e9),
+    "v4": (275e12, 1228e9, 50e9),
+    "v3": (123e12, 900e9, 50e9),
+    "cpu": (1e11, 50e9, 10e9),
+}
+
+
+def chip_specs(device_kind: str):
+    kind = (device_kind or "").lower()
+    for k, v in _CHIPS.items():
+        if k in kind:
+            return v
+    return _CHIPS["cpu"]
+
+
+@dataclass
+class MeshPlan:
+    shape: dict                    # axis name -> degree
+    flops: float = 0.0             # whole-program FLOPs (all devices)
+    bytes_accessed: float = 0.0
+    peak_bytes: Optional[int] = None   # per-device arg+temp+out bytes
+    est_seconds: Optional[float] = None
+    error: Optional[str] = None
+
+    def fits(self, hbm_bytes):
+        return self.peak_bytes is not None and \
+            self.peak_bytes <= hbm_bytes
+
+
+@dataclass
+class TuneReport:
+    plans: list = field(default_factory=list)
+
+    @property
+    def best(self) -> Optional[MeshPlan]:
+        ok = [p for p in self.plans if p.error is None
+              and p.est_seconds is not None]
+        return min(ok, key=lambda p: p.est_seconds) if ok else None
+
+    def summary(self):
+        lines = []
+        for p in sorted(self.plans,
+                        key=lambda p: (p.error is not None,
+                                       p.est_seconds or 0)):
+            if p.error:
+                lines.append(f"{p.shape}: FAILED {p.error[:60]}")
+            else:
+                mem = (f"{p.peak_bytes / 1e6:.0f}MB"
+                       if p.peak_bytes is not None else "?")
+                lines.append(
+                    f"{p.shape}: est {p.est_seconds * 1e3:.2f} ms, "
+                    f"{p.flops / 1e9:.1f} GFLOP, peak/device {mem}")
+        return "\n".join(lines)
+
+
+def _factorizations(n, k):
+    """All ordered k-tuples of positive ints whose product is n."""
+    if k == 1:
+        yield (n,)
+        return
+    for d in range(1, n + 1):
+        if n % d == 0:
+            for rest in _factorizations(n // d, k - 1):
+                yield (d,) + rest
+
+
+def analyze_lowered(lowered, n_devices, device_kind=None):
+    """Compile a lowered computation and pull XLA's own numbers."""
+    import jax
+    comp = lowered.compile()
+    ca = comp.cost_analysis() or {}
+    flops = float(ca.get("flops", 0.0))
+    bytes_acc = float(ca.get("bytes accessed", 0.0))
+    peak = None
+    try:
+        ms = comp.memory_analysis()
+        peak = int(ms.argument_size_in_bytes + ms.temp_size_in_bytes
+                   + ms.output_size_in_bytes)
+    except Exception:
+        pass
+    kind = device_kind or getattr(jax.devices()[0], "device_kind", "")
+    peak_flops, hbm_bw, _ = chip_specs(kind)
+    # roofline estimate of the per-device step time: compute and HBM
+    # traffic are totals over the SPMD program, split across devices
+    est = max(flops / n_devices / peak_flops,
+              bytes_acc / n_devices / hbm_bw)
+    return flops, bytes_acc, peak, est
+
+
+def tune_mesh(build_step: Callable, n_devices: int,
+              axis_names: Sequence[str] = ("dp", "mp"),
+              hbm_bytes: Optional[int] = None) -> TuneReport:
+    """Try every factorization of n_devices over axis_names, compile
+    the real step per candidate, rank by the roofline estimate.
+    Candidates whose per-device peak exceeds hbm_bytes are kept in the
+    report but excluded from `best` via est=None."""
+    from .mesh import ProcessMesh, set_mesh, get_mesh
+
+    report = TuneReport()
+    prev = get_mesh()
+    try:
+        for dims in _factorizations(int(n_devices), len(axis_names)):
+            shape = dict(zip(axis_names, dims))
+            plan = MeshPlan(shape=shape)
+            report.plans.append(plan)
+            try:
+                mesh = ProcessMesh(shape=list(dims),
+                                   dim_names=list(axis_names))
+                set_mesh(mesh)
+                built = build_step(mesh)
+                if isinstance(built, tuple):
+                    step, batch = built
+                    lowered = step.compile_info(*batch)
+                else:
+                    lowered = built
+                (plan.flops, plan.bytes_accessed, plan.peak_bytes,
+                 plan.est_seconds) = analyze_lowered(lowered, n_devices)
+                if hbm_bytes is not None and not plan.fits(hbm_bytes):
+                    plan.error = (f"peak {plan.peak_bytes} exceeds HBM "
+                                  f"{hbm_bytes}")
+                    plan.est_seconds = None
+            except Exception as e:  # candidate may simply not shard
+                plan.error = f"{type(e).__name__}: {e}"
+    finally:
+        set_mesh(prev)
+    return report

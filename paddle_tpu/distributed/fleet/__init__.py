@@ -47,6 +47,10 @@ class DistributedStrategy:
         self.recompute_configs = {}
         self.lamb = False
         self.lars = False
+        self.lars_configs = {"lars_coeff": 0.001,
+                             "lars_weight_decay": 0.0005,
+                             "epsilon": 1e-9,
+                             "exclude_from_weight_decay": []}
         self.dgc = False
         self.gradient_merge = False
         self.gradient_merge_configs = {"k_steps": 1, "avg": True}
@@ -73,10 +77,11 @@ class LocalSGDOptimizer:
     launch/multi-process data-parallel form, not the in-program GSPMD
     form where params cannot diverge)."""
 
-    def __init__(self, inner, k_steps=1):
+    def __init__(self, inner, k_steps=1, hcg=None):
         self._inner = inner
         self._k = max(int(k_steps), 1)
         self._local_steps = 0
+        self._sync_hcg = hcg
 
     def step(self):
         self._inner.step()
@@ -85,14 +90,24 @@ class LocalSGDOptimizer:
             self.sync_params()
 
     def sync_params(self):
+        """Average parameters across the DATA-parallel group only —
+        model/pipeline-parallel ranks hold DIFFERENT shards; averaging
+        them would blend unrelated weights."""
         from .. import collective as coll
-        world = ParallelEnv().world_size
-        if world <= 1:
+        hcg = self._sync_hcg
+        group = None
+        n = ParallelEnv().world_size
+        if hcg is not None:
+            if hcg.get_model_parallel_world_size() > 1 or \
+                    hcg.get_pipe_parallel_world_size() > 1:
+                group = hcg.get_data_parallel_group()
+                n = hcg.get_data_parallel_world_size()
+        if n <= 1:
             return
         from ...ops import math as _m
         for p in self._inner._parameter_list:
-            coll.all_reduce(p)
-            p.set_value(_m.scale(p, 1.0 / world))
+            coll.all_reduce(p, group=group)
+            p.set_value(_m.scale(p, 1.0 / n))
 
     def __getattr__(self, name):  # delegate the rest of the surface
         return getattr(self._inner, name)
@@ -181,11 +196,31 @@ class _Fleet:
                 strategy.gradient_merge_configs.get("k_steps", 1))
             optimizer._gradient_merge_avg = bool(
                 strategy.gradient_merge_configs.get("avg", True))
+        if strategy is not None and strategy.lars:
+            # reference lars_optimizer.py meta-optimizer: swap a
+            # momentum-family inner optimizer for LARS
+            from ...optimizer import Momentum, SGD, LarsMomentum
+            if isinstance(optimizer, (Momentum, SGD)):
+                cfg = getattr(strategy, "lars_configs", {}) or {}
+                optimizer = LarsMomentum(
+                    learning_rate=(optimizer._lr_scheduler
+                                   or optimizer._learning_rate),
+                    momentum=getattr(optimizer, "_momentum", 0.9),
+                    lars_coeff=float(cfg.get("lars_coeff", 0.001)),
+                    lars_weight_decay=float(
+                        cfg.get("lars_weight_decay", 0.0005)),
+                    epsilon=float(cfg.get("epsilon", 1e-9)),
+                    exclude_from_weight_decay=cfg.get(
+                        "exclude_from_weight_decay", None),
+                    parameters=optimizer._parameter_list,
+                    grad_clip=optimizer._grad_clip)
+                optimizer._hcg = self._hcg
         if strategy is not None and strategy.localsgd:
             optimizer = LocalSGDOptimizer(
                 optimizer,
                 k_steps=int(getattr(strategy, "localsgd_configs",
-                                    {}).get("k_steps", 1)))
+                                    {}).get("k_steps", 1)),
+                hcg=self._hcg)
         return optimizer
 
 

@@ -37,11 +37,14 @@ def _import_hubconf(repo_dir):
         raise FileNotFoundError(f"no {MODULE_HUBCONF} in {repo_dir}")
     spec = importlib.util.spec_from_file_location("hubconf", path)
     module = importlib.util.module_from_spec(spec)
-    sys.path.insert(0, repo_dir)
+    added = repo_dir not in sys.path
+    if added:
+        sys.path.insert(0, repo_dir)
     try:
         spec.loader.exec_module(module)
     finally:
-        sys.path.remove(repo_dir)
+        if added:  # never strip a pre-existing user entry
+            sys.path.remove(repo_dir)
     deps = getattr(module, VAR_DEPENDENCY, None)
     if deps:
         missing = [d for d in deps

@@ -258,8 +258,9 @@ class CompiledTrainStep:
 
     def compile_info(self, *batch):
         """Lower + return the compiled HLO text (for inspection)."""
-        batch_vals = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
-                      for b in batch]
+        batch_vals = [self._place_batch(
+            b._value if isinstance(b, Tensor) else jnp.asarray(b))
+            for b in batch]
         lr = jnp.asarray(0.0, jnp.float32)
         key = random_mod.next_key()
         p_vals = [p._value for p in self.params]

@@ -52,7 +52,8 @@ def _sdpa_ref(q, k, v, mask, causal, scale, dropout_p, key):
     probs = jax.nn.softmax(logits, axis=-1).astype(dt)
     if dropout_p > 0.0 and key is not None:
         keep = 1.0 - dropout_p
-        m = jax.random.bernoulli(key, keep, probs.shape)
+        from .common import _fast_bits_key
+        m = jax.random.bernoulli(_fast_bits_key(key), keep, probs.shape)
         probs = jnp.where(m, probs / keep, 0.0).astype(dt)
     return jnp.einsum("bhlm,bmhd->blhd", probs, v)
 

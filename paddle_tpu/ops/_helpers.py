@@ -24,14 +24,17 @@ def scalar_operand(x: Tensor, y):
     python float + float tensor keeps the tensor dtype; int tensor with a
     float scalar promotes to the default float dtype."""
     xd = np.dtype(x._value.dtype)
+    # numpy reports extension float dtypes (bfloat16, float8_*) as kind
+    # 'V'; classify through jnp so bf16 + 2.0 stays bf16 (a kind-based
+    # check silently promoted bf16 elementwise chains to f32)
+    is_float = jnp.issubdtype(x._value.dtype, jnp.floating)
+    is_complex = jnp.issubdtype(x._value.dtype, jnp.complexfloating)
     if isinstance(y, (bool, np.bool_)):
         return to_tensor(np.asarray(y))
     if isinstance(y, (int, np.integer)):
-        if xd.kind in "fc":
-            return to_tensor(np.asarray(y, dtype=xd))
         return to_tensor(np.asarray(y, dtype=xd))
     if isinstance(y, (float, np.floating)):
-        if xd.kind in "fc":
+        if is_float or is_complex:
             return to_tensor(np.asarray(y, dtype=xd))
         return to_tensor(np.asarray(y, dtype=dtypes.get_default_dtype().np_dtype))
     if isinstance(y, complex):

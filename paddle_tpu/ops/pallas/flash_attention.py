@@ -97,12 +97,19 @@ def _drop_thresh(p):
 
 def _block_keep(seed_ref, bh_id, qb, kb, block_q, block_k, thresh):
     """Keep-mask for the (qb, kb) block — THE single definition of the
-    position arithmetic all three kernels share (fwd/bwd mask identity
-    by construction)."""
+    position arithmetic all three kernels share. Separability makes it
+    cheap: hq depends only on the row and hk only on the column, so
+    feeding the oracle (block_q,1)/(1,block_k) position VECTORS runs
+    the first two fmix32 rounds on vectors; only the final mix touches
+    the full block (5 int ops/element instead of 15 — the hash was the
+    kernel's VPU hot spot)."""
     q_pos = qb * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
+        jnp.int32, (block_q, 1), 0)
     k_pos = kb * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
+        jnp.int32, (1, block_k), 1)
+    # same single definition as the test oracle — the hq/hk fmix rounds
+    # run on the (block_q,1)/(1,block_k) vectors and broadcast at the
+    # final mix, bit- and formula-identical to full-matrix positions
     return dropout_keep(seed_ref[0], seed_ref[1], bh_id, q_pos, k_pos,
                         thresh)
 

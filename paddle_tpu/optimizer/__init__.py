@@ -1,5 +1,6 @@
 """paddle.optimizer parity (reference: python/paddle/optimizer/)."""
-from .optimizer import Optimizer, SGD, Momentum  # noqa: F401
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, LarsMomentum)
 from .adam import (  # noqa: F401
     Adam, AdamW, Adamax, Adagrad, RMSProp, Adadelta, Lamb, NAdam, RAdam)
 from . import lr  # noqa: F401

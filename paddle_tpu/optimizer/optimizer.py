@@ -307,6 +307,48 @@ class SGD(Optimizer):
         return p - lr.astype(p.dtype) * g.astype(p.dtype), state
 
 
+class LarsMomentum(Optimizer):
+    """LARS: layer-wise adaptive momentum (reference:
+    operators/optimizers/lars_momentum_op.cu + the fleet `lars`
+    strategy knob; arXiv:1708.03888). Per-parameter trust ratio
+    local_lr = lr * coeff * ||w|| / (||g|| + decay * ||w|| + eps),
+    computed in f32 inside the one compiled step."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 epsilon=1e-9, parameters=None, grad_clip=None,
+                 name=None, exclude_from_weight_decay=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         name)
+        self._momentum = float(momentum)
+        self._coeff = float(lars_coeff)
+        self._lars_decay = float(lars_weight_decay)
+        self._eps = float(epsilon)
+        self._exclude = tuple(exclude_from_weight_decay or ())
+
+    def _accumulator_specs(self, p):
+        return {"velocity": jnp.zeros_like(p._value)}
+
+    def _rule(self, p, g, state, gstate, lr):
+        pf = p.astype(jnp.float32)
+        gf = g.astype(jnp.float32)
+        decay = self._lars_decay
+        name = getattr(self._cur_extra, "name", None) \
+            if self._cur_extra is not None else None
+        if name is not None and any(k in name for k in self._exclude):
+            decay = 0.0
+        wn = jnp.sqrt(jnp.sum(jnp.square(pf)))
+        gn = jnp.sqrt(jnp.sum(jnp.square(gf)))
+        local_lr = lr.astype(jnp.float32) * self._coeff * wn / (
+            gn + decay * wn + self._eps)
+        # ||w||=0 (fresh zero-init params): fall back to the global lr
+        local_lr = jnp.where(wn > 0, local_lr, lr.astype(jnp.float32))
+        v = state["velocity"].astype(jnp.float32) * self._momentum \
+            + local_lr * (gf + decay * pf)
+        new_p = (pf - v).astype(p.dtype)
+        return new_p, {"velocity": v.astype(state["velocity"].dtype)}
+
+
 class Momentum(Optimizer):
     """reference: python/paddle/optimizer/momentum.py (use_nesterov attr)."""
 

@@ -74,3 +74,43 @@ def check_grad(api_fn, inputs, grad_inputs=None, rtol=1e-2, atol=1e-3,
         got = tensors[i].grad.numpy().astype(np.float64)
         np.testing.assert_allclose(got, want, rtol=rtol, atol=atol,
                                    err_msg=f"grad mismatch for input {i}")
+
+
+def check_dtypes(api_fn, np_fn, inputs, dtypes=("float32", "bfloat16",
+                                                "float16"),
+                 rtol=None, atol=None, grad=False, **kwargs):
+    """Dtype sweep (the reference op_test's dtype white-list loop,
+    op_test.py:327): run the op in each floating dtype, compare against
+    the f64 numpy reference with per-dtype tolerances, optionally also
+    backward (tape grad must be finite and dtype-stable)."""
+    _TOL = {"float64": (1e-12, 1e-12), "float32": (1e-5, 1e-6),
+            "bfloat16": (3e-2, 3e-2), "float16": (5e-3, 5e-3)}
+    want = np_fn(*[a.astype(np.float64) for a in inputs], **kwargs)
+    if not isinstance(want, (list, tuple)):
+        want = [want]
+    for dt in dtypes:
+        if dt == "bfloat16":
+            import ml_dtypes
+            cast = [a.astype(ml_dtypes.bfloat16) for a in inputs]
+        else:
+            cast = [a.astype(dt) for a in inputs]
+        # leaves (not astype outputs): .grad only accumulates on leaves
+        tensors = [paddle.to_tensor(a, stop_gradient=not grad)
+                   for a in cast]
+        got = api_fn(*tensors, **kwargs)
+        outs = got if isinstance(got, (list, tuple)) else [got]
+        r, a_ = (rtol, atol) if rtol is not None else _TOL[dt]
+        for g, w in zip(outs, want):
+            assert str(g.dtype).endswith(dt), (g.dtype, dt)
+            np.testing.assert_allclose(
+                g.numpy().astype(np.float64), np.asarray(w), rtol=r,
+                atol=a_, err_msg=f"dtype {dt}")
+        if grad:
+            loss = None
+            for o in outs:
+                s = o.astype("float32").sum()
+                loss = s if loss is None else loss + s
+            loss.backward()
+            for t in tensors:
+                gv = t.grad.numpy().astype(np.float64)
+                assert np.isfinite(gv).all(), f"non-finite grad at {dt}"

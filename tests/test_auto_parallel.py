@@ -207,3 +207,61 @@ class TestEngineEdges:
                                       parameters=model.parameters()),
                     strategy=s)
         assert str(model.fc1.weight.dtype).endswith("bfloat16")
+
+
+class TestCostModelTuner:
+    """Mesh tuner over XLA's own cost/memory analysis (reference:
+    auto_parallel/cost_model.py + tuner/)."""
+
+    def _build(self, mesh):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.optimizer as opt
+        import paddle_tpu.distributed as dist
+        from paddle_tpu import jit
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(64, 256), nn.ReLU(),
+                              nn.Linear(256, 16))
+        o = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+        step = jit.compile_train_step(
+            lambda x, y: F.cross_entropy(model(x), y), model, o)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(16, 64).astype("float32"))
+        y = paddle.to_tensor(rng.randint(0, 16, (16,)))
+        return step, (x, y)
+
+    def test_ranks_all_factorizations(self):
+        from paddle_tpu.distributed import cost_model
+        report = cost_model.tune_mesh(self._build, n_devices=8,
+                                      axis_names=("dp", "mp"))
+        shapes = [tuple(p.shape.values()) for p in report.plans]
+        assert set(shapes) == {(1, 8), (2, 4), (4, 2), (8, 1)}
+        ok = [p for p in report.plans if p.error is None]
+        assert ok, report.summary()
+        for p in ok:
+            assert p.flops > 0 and p.est_seconds > 0
+        best = report.best
+        assert best is not None
+        assert best.est_seconds == min(p.est_seconds for p in ok)
+        assert "est" in report.summary()
+
+    def test_memory_cap_excludes_plans(self):
+        from paddle_tpu.distributed import cost_model
+        report = cost_model.tune_mesh(self._build, n_devices=8,
+                                      axis_names=("dp",),
+                                      hbm_bytes=1)  # nothing fits
+        assert report.best is None
+        assert all(p.error for p in report.plans)
+
+    def test_analyze_lowered_numbers(self):
+        import jax, jax.numpy as jnp
+        from paddle_tpu.distributed import cost_model
+        lowered = jax.jit(lambda a, b: (a @ b).sum()).lower(
+            jnp.ones((128, 256)), jnp.ones((256, 64)))
+        flops, bytes_acc, peak, est = cost_model.analyze_lowered(
+            lowered, 1, device_kind="cpu")
+        assert flops >= 2 * 128 * 256 * 64 * 0.9
+        assert peak is None or peak > 0
+        assert est > 0

@@ -1,0 +1,112 @@
+"""Native C-ABI deployment: a real C program consumes the saved model
+through libpaddle_tpu_c.so (reference: inference/capi_exp C API over
+AnalysisPredictor — the out-of-Python deployment path)."""
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+C_DRIVER = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include "pd_inference_c.h"
+
+int main(int argc, char **argv) {
+    if (argc < 2) { fprintf(stderr, "usage: driver <prefix>\n"); return 2; }
+    PD_Config *cfg = PD_ConfigCreate();
+    PD_ConfigSetModel(cfg, argv[1]);
+    PD_Predictor *p = PD_PredictorCreate(cfg);
+    if (!p) { fprintf(stderr, "create: %s\n", PD_GetLastError()); return 3; }
+    size_t nin = PD_PredictorGetInputNum(p);
+    printf("version=%s\n", PD_GetVersion());
+    printf("inputs=%zu first=%s\n", nin, PD_PredictorGetInputName(p, 0));
+
+    float data[8];
+    for (int i = 0; i < 8; i++) data[i] = 0.25f * (float)i - 1.0f;
+    int64_t shape[2] = {2, 4};
+    if (PD_PredictorSetInput(p, PD_PredictorGetInputName(p, 0), data, 0,
+                             shape, 2) != 0) {
+        fprintf(stderr, "set_input: %s\n", PD_GetLastError()); return 4;
+    }
+    if (PD_PredictorRun(p) != 0) {
+        fprintf(stderr, "run: %s\n", PD_GetLastError()); return 5;
+    }
+    int64_t oshape[8]; int rank = 8;
+    if (PD_PredictorGetOutputShape(p, 0, oshape, &rank) != 0) {
+        fprintf(stderr, "shape: %s\n", PD_GetLastError()); return 6;
+    }
+    size_t numel = 1;
+    printf("out_shape=");
+    for (int i = 0; i < rank; i++) {
+        printf("%lld%s", (long long)oshape[i], i + 1 < rank ? "x" : "\n");
+        numel *= (size_t)oshape[i];
+    }
+    float *out = (float *)malloc(numel * sizeof(float));
+    if (PD_PredictorGetOutputFloat(p, 0, out, numel) != 0) {
+        fprintf(stderr, "fetch: %s\n", PD_GetLastError()); return 7;
+    }
+    printf("out=");
+    for (size_t i = 0; i < numel; i++) printf("%.6f ", out[i]);
+    printf("\n");
+    free(out);
+    PD_PredictorDestroy(p);
+    PD_ConfigDestroy(cfg);
+    return 0;
+}
+"""
+
+
+@pytest.mark.skipif(shutil.which("gcc") is None, reason="no gcc")
+def test_c_program_runs_saved_model(tmp_path):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import jit
+    from paddle_tpu.jit.api import InputSpec
+
+    # 1) export a model from Python
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 3))
+    model.eval()
+    prefix = str(tmp_path / "toy")
+    jit.save(model, prefix,
+             input_spec=[InputSpec([2, 4], "float32", "x")])
+
+    # expected output from the Python predictor
+    x = (0.25 * np.arange(8, dtype=np.float32) - 1.0).reshape(2, 4)
+    import paddle_tpu.inference as inf
+    want = inf.create_predictor(inf.Config(prefix)).run([x])[0]
+
+    # 2) build the native library + the C driver
+    from paddle_tpu import deploy
+    so = deploy.build_capi(out_dir=str(tmp_path))
+    c_file = tmp_path / "driver.c"
+    c_file.write_text(C_DRIVER)
+    exe = str(tmp_path / "driver")
+    subprocess.run(
+        ["gcc", str(c_file), f"-I{os.path.dirname(deploy.capi_header_path())}",
+         so, f"-Wl,-rpath,{os.path.dirname(so)}", "-o", exe],
+        check=True, capture_output=True, text=True)
+
+    # 3) run the C program in a clean process (CPU devices; PYTHONPATH
+    #    points the embedded interpreter at the repo + site-packages)
+    env = dict(os.environ)
+    env["PADDLE_TPU_FORCE_CPU_DEVICES"] = "1"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + [p for p in sys.path if p and os.path.isdir(p)])
+    proc = subprocess.run([exe, prefix], env=env, capture_output=True,
+                          text=True, timeout=300)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    out_lines = dict(l.split("=", 1) for l in
+                     proc.stdout.strip().splitlines() if "=" in l)
+    assert out_lines["inputs"].startswith("1 ")
+    assert out_lines["out_shape"] == "2x3"
+    got = np.array([float(v) for v in out_lines["out"].split()],
+                   np.float32).reshape(2, 3)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

@@ -1144,3 +1144,21 @@ class TestGradientMergeLocalSGD:
         w0s, ws = build(False)
         np.testing.assert_allclose(ws - w0s, (wa - w0a) * 4,
                                    rtol=2e-4, atol=1e-6)
+
+    def test_lars_strategy_swaps_optimizer(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+        import paddle_tpu.distributed.fleet as fleet
+        strategy = fleet.DistributedStrategy()
+        strategy.lars = True
+        strategy.lars_configs = {"lars_coeff": 0.002}
+        fleet.init(is_collective=True, strategy=strategy)
+        try:
+            m = nn.Linear(4, 2)
+            o = fleet.distributed_optimizer(
+                opt.Momentum(learning_rate=0.1, momentum=0.8,
+                             parameters=m.parameters()))
+            assert isinstance(o, opt.LarsMomentum)
+            assert o._coeff == 0.002 and o._momentum == 0.8
+        finally:
+            fleet.shutdown()

@@ -181,3 +181,45 @@ class TestGradClipIntegration:
         s.step()
         moved = np.abs(lin.weight.numpy() - before).max()
         assert moved < 0.01  # clipped update is tiny
+
+
+class TestLarsMomentum:
+    def test_trust_ratio_matches_numpy(self):
+        import paddle_tpu.optimizer as opt
+        rng = np.random.RandomState(0)
+        w0 = rng.randn(6, 4).astype("float32")
+        g0 = rng.randn(6, 4).astype("float32") * 0.1
+        p = paddle.to_tensor(w0.copy(), stop_gradient=False)
+        o = opt.LarsMomentum(learning_rate=0.1, momentum=0.9,
+                             lars_coeff=0.001,
+                             lars_weight_decay=0.0005,
+                             parameters=[p])
+        (p * paddle.to_tensor(g0)).sum().backward()
+        o.step()
+        # numpy reference of the LARS rule, one step, v0 = 0
+        wn = np.sqrt((w0 ** 2).sum())
+        gn = np.sqrt((g0 ** 2).sum())
+        local_lr = 0.1 * 0.001 * wn / (gn + 0.0005 * wn + 1e-9)
+        v = local_lr * (g0 + 0.0005 * w0)
+        np.testing.assert_allclose(p.numpy(), w0 - v, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_trains_under_compiled_step(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu import jit
+        paddle.seed(0)
+        m = nn.Linear(8, 1)
+        o = opt.LarsMomentum(learning_rate=0.05,
+                             parameters=m.parameters())
+        step = jit.compile_train_step(
+            lambda a, b: F.mse_loss(m(a), b), m, o)
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(32, 8).astype("float32"))
+        y = paddle.to_tensor(
+            (rng.randn(32, 8) @ rng.randn(8, 1)).astype("float32") * 0)
+        l0 = float(step(x, y))
+        for _ in range(20):
+            l = float(step(x, y))
+        assert l < l0

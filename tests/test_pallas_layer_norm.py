@@ -1,0 +1,84 @@
+"""Fused Pallas LayerNorm vs the jnp reference path (interpret mode on
+CPU, the same strategy as the flash-attention tests)."""
+import os
+
+import numpy as np
+import pytest
+
+os.environ["PADDLE_TPU_PALLAS_INTERPRET"] = "1"
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from paddle_tpu.ops.pallas import layer_norm as pln  # noqa: E402
+
+
+def _ref(x, w, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * w.astype(jnp.float32) + b.astype(jnp.float32)).astype(
+        x.dtype)
+
+
+class TestFusedLayerNorm:
+    @pytest.mark.parametrize("shape", [(4, 6, 256), (64, 128),
+                                       (3, 640)])
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    def test_forward_matches_reference(self, shape, dtype):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal(shape) * 2 + 0.5, dtype)
+        w = jnp.asarray(rng.standard_normal(shape[-1]), dtype)
+        b = jnp.asarray(rng.standard_normal(shape[-1]), dtype)
+        got = pln.layer_norm_fused(x, w, b, 1e-5)
+        want = _ref(x, w, b)
+        tol = 1e-5 if dtype == "float32" else 2e-2
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32),
+                                   rtol=tol, atol=tol)
+
+    def test_grads_match_reference(self):
+        rng = np.random.default_rng(1)
+        shape = (8, 384)
+        x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+        w = jnp.asarray(rng.standard_normal(shape[-1]), jnp.float32)
+        b = jnp.asarray(rng.standard_normal(shape[-1]), jnp.float32)
+        ct = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+        def f_fused(x, w, b):
+            return jnp.sum(pln.layer_norm_fused(x, w, b, 1e-5) * ct)
+
+        def f_ref(x, w, b):
+            return jnp.sum(_ref(x, w, b) * ct)
+
+        g1 = jax.grad(f_fused, argnums=(0, 1, 2))(x, w, b)
+        g2 = jax.grad(f_ref, argnums=(0, 1, 2))(x, w, b)
+        for a, e, nm in zip(g1, g2, "x w b".split()):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                       rtol=2e-4, atol=2e-5,
+                                       err_msg=nm)
+
+    def test_row_padding_correct(self):
+        # rows not divisible by the block: pad path must not leak
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.standard_normal((7, 128)), jnp.float32)
+        w = jnp.ones((128,), jnp.float32)
+        b = jnp.zeros((128,), jnp.float32)
+        got = pln.layer_norm_fused(x, w, b, 1e-5, 4)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(_ref(x, w, b)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_functional_routes_to_kernel(self):
+        # the nn.functional path picks the kernel under interpret mode
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        rng = np.random.default_rng(3)
+        x = paddle.to_tensor(
+            rng.standard_normal((2, 5, 256)).astype("float32"))
+        w = paddle.to_tensor(rng.standard_normal(256).astype("float32"))
+        b = paddle.to_tensor(rng.standard_normal(256).astype("float32"))
+        got = F.layer_norm(x, 256, w, b).numpy()
+        want = np.asarray(_ref(x._value, w._value, b._value))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
