@@ -14,3 +14,4 @@ from . import autograd  # noqa: F401
 from . import autotune  # noqa: F401
 
 __all__ = ["nn", "optimizer", "autograd"]
+from . import asp  # noqa: E402,F401

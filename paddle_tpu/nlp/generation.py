@@ -180,7 +180,8 @@ class CompiledGenerator:
 
     def __init__(self, model, cache_spec, temperature=1.0, top_k=None,
                  eos_token_id=None, pad_token_id=0, top_p=None,
-                 decode_strategy=None, num_beams=4, length_penalty=0.0):
+                 decode_strategy=None, num_beams=4, length_penalty=0.0,
+                 num_return_sequences=1):
         self.model = model
         self.n_layers, self.n_kv, self.head_dim = cache_spec
         self.temperature = float(temperature)
@@ -196,6 +197,12 @@ class CompiledGenerator:
         self.decode_strategy = decode_strategy
         self.num_beams = int(num_beams)
         self.length_penalty = float(length_penalty)
+        self.num_return_sequences = int(num_return_sequences)
+        if decode_strategy == "beam_search" and \
+                self.num_return_sequences > self.num_beams:
+            raise ValueError(
+                f"num_return_sequences {self.num_return_sequences} > "
+                f"num_beams {self.num_beams}")
         self.eos_token_id = eos_token_id
         self.pad_token_id = int(pad_token_id)
         params = list(model.parameters())
@@ -387,12 +394,14 @@ class CompiledGenerator:
                 tokens, scores, lens = final[4], final[5], final[7]
                 norm = scores / jnp.maximum(
                     lens.astype(jnp.float32), 1.0) ** lp
-                best = jnp.argmax(norm, axis=1)  # [B]
+                nret = self.num_return_sequences
+                # top-n beams per row (paddle/HF convention: rows are
+                # [b0 seq0..seqn-1, b1 seq0..], best first)
+                top_norm, best = jax.lax.top_k(norm, nret)  # [B, n]
                 out = jnp.take_along_axis(
-                    tokens, best[:, None, None], axis=1)[:, 0]
-                best_score = jnp.take_along_axis(
-                    norm, best[:, None], axis=1)[:, 0]
-                return out, best_score
+                    tokens, best[:, :, None], axis=1)      # [B,n,max_new]
+                out = out.reshape(batch * nret, max_new)
+                return out, top_norm.reshape(batch * nret)
             finally:
                 for t, v in zip(state_tensors, originals):
                     t._value = v
@@ -403,16 +412,27 @@ class CompiledGenerator:
                  return_scores=False):
         from ..core import random as random_mod
         ids = as_tensor(input_ids)
-        batch, prompt_len = int(ids.shape[0]), int(ids.shape[1])
         beam = self.decode_strategy == "beam_search"
+        if return_scores and not beam:
+            raise ValueError("return_scores is only available with "
+                             "decode_strategy='beam_search'")
+        nret = self.num_return_sequences
+        if nret > 1 and not beam:
+            if self.decode_strategy == "greedy" or not (
+                    self.decode_strategy == "sampling" or self.top_k
+                    or self.top_p):
+                raise ValueError(
+                    "num_return_sequences > 1 needs a stochastic "
+                    "strategy (sampling/top_k/top_p) or beam_search")
+            # expanded rows sample independently through one trace
+            from ..ops import manipulation
+            ids = manipulation.repeat_interleave(ids, nret, axis=0)
+        batch, prompt_len = int(ids.shape[0]), int(ids.shape[1])
         sig = (batch, prompt_len, int(max_new_tokens), beam)
         fn = self._traces.get(sig)
         if fn is None:
             fn = (self._build_beam if beam else self._build)(*sig[:3])
             self._traces[sig] = fn
-        if return_scores and not beam:
-            raise ValueError("return_scores is only available with "
-                             "decode_strategy='beam_search'")
         was_training = getattr(self.model, "training", False)
         self.model.eval()
         try:
@@ -424,6 +444,9 @@ class CompiledGenerator:
                 self.model.train()
         new_tokens, scores = res if beam else (res, None)
         from ..ops import manipulation
+        if beam and nret > 1:
+            # beam rows are [b0 seq0..seqn-1, b1 ...]: tile the prompt
+            ids = manipulation.repeat_interleave(ids, nret, axis=0)
         out = manipulation.concat(
             [ids, Tensor(new_tokens, stop_gradient=True)], axis=1)
         if return_scores:

@@ -287,18 +287,25 @@ class GPTForCausalLM(nn.Layer):
     def generate(self, input_ids, max_new_tokens=16, temperature=1.0,
                  top_k=None, top_p=None, eos_token_id=None,
                  pad_token_id=0, decode_strategy=None, num_beams=4,
-                 length_penalty=0.0, use_compiled=True):
+                 length_penalty=0.0, num_return_sequences=1,
+                 use_compiled=True):
         """Autoregressive decoding with KV cache.
 
         Default path: one compiled XLA program (static cache +
         lax.while_loop — see nlp/generation.py). use_compiled=False
         keeps the eager per-token loop (growing concat caches) for
         debugging."""
+        if not use_compiled and (decode_strategy not in (None, "greedy")
+                                 or int(num_return_sequences) != 1):
+            raise NotImplementedError(
+                "the eager debug loop supports greedy decoding only; "
+                "beam_search/sampling/num_return_sequences need the "
+                "compiled path (use_compiled=True)")
         if use_compiled:
             from .generation import CompiledGenerator
             key = (float(temperature), top_k, top_p, eos_token_id,
                    int(pad_token_id), decode_strategy, int(num_beams),
-                   float(length_penalty))
+                   float(length_penalty), int(num_return_sequences))
             gens = getattr(self, "_compiled_generators", None)
             if gens is None:
                 gens = self._compiled_generators = {}
@@ -309,7 +316,8 @@ class GPTForCausalLM(nn.Layer):
                     temperature=temperature, top_k=top_k, top_p=top_p,
                     eos_token_id=eos_token_id, pad_token_id=pad_token_id,
                     decode_strategy=decode_strategy, num_beams=num_beams,
-                    length_penalty=length_penalty)
+                    length_penalty=length_penalty,
+                    num_return_sequences=num_return_sequences)
                 gens[key] = gen
             return gen(input_ids, max_new_tokens)
         from ..ops import manipulation, creation

@@ -566,3 +566,89 @@ class TestSparseNN:
         (c.values() * 3.0).sum().backward()
         assert s.values().grad is not None
         np.testing.assert_allclose(s.values().grad.numpy(), [3.0, 3.0])
+
+
+class TestASP:
+    """incubate.asp n:m structured sparsity (reference
+    fluid/contrib/sparsity/asp.py; TPU form = pruning training)."""
+
+    def test_mask_1d_pattern(self):
+        from paddle_tpu.incubate import asp
+        mat = np.array([[0.1, -5.0, 3.0, 0.2, 7.0, 1.0, -2.0, 0.5]],
+                       "float32")
+        mask = asp.get_mask_1d(mat, 2, 4)
+        # per 1x4 block: the 2 largest |values| survive
+        np.testing.assert_array_equal(
+            mask, [[False, True, True, False, True, False, True,
+                    False]])
+
+    def test_prune_model_density_and_guarantee(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.incubate import asp
+        paddle.seed(0)
+        m = paddle.nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                                 nn.Linear(16, 4))
+        masks = asp.prune_model(m, n=2, m=4)
+        assert len(masks) == 2
+        for p in (m[0].weight, m[2].weight):
+            assert abs(asp.calculate_density(p) - 0.5) < 1e-6
+            # every 1x4 input-dim block has exactly 2 nonzeros
+            w = p.numpy().T.reshape(p.shape[1], -1, 4)
+            nz = (w != 0).sum(-1)
+            assert (nz <= 2).all()
+        o = asp.decorate(opt.SGD(learning_rate=0.1,
+                                 parameters=m.parameters()))
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+        y = paddle.to_tensor(rng.randn(4, 4).astype("float32"))
+        for _ in range(3):
+            loss = F.mse_loss(m(x), y)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        # pruned positions stay exactly zero through training
+        for p in (m[0].weight, m[2].weight):
+            assert abs(asp.calculate_density(p) - 0.5) < 1e-6
+
+    def test_excluded_layers(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.incubate import asp
+        paddle.seed(1)
+        m = nn.Linear(8, 4)
+        name = m.weight.name
+        asp.set_excluded_layers([name])
+        try:
+            masks = asp.prune_model(m)
+            assert masks == {}
+            assert asp.calculate_density(m.weight) == 1.0
+        finally:
+            asp.reset_excluded_layers()
+
+    def test_minimize_path_keeps_sparsity(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.optimizer as opt
+        from paddle_tpu.incubate import asp
+        paddle.seed(2)
+        m = nn.Linear(8, 4)
+        asp.prune_model(m)
+        o = asp.decorate(opt.SGD(learning_rate=0.1,
+                                 parameters=m.parameters()))
+        rng = np.random.RandomState(0)
+        loss = F.mse_loss(m(paddle.to_tensor(
+            rng.randn(4, 8).astype("float32"))),
+            paddle.to_tensor(rng.randn(4, 4).astype("float32")))
+        o.minimize(loss)  # the reference's primary usage pattern
+        assert abs(asp.calculate_density(m.weight) - 0.5) < 1e-6
+
+    def test_with_mask_false_still_prunes(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.incubate import asp
+        paddle.seed(3)
+        m = nn.Linear(8, 4)
+        asp.prune_model(m, with_mask=False)
+        # weights pruned (reference semantics), but no mask retained
+        assert abs(asp.calculate_density(m.weight) - 0.5) < 1e-6
+        assert asp._find_mask(m.weight) is None

@@ -370,3 +370,50 @@ class TestBeamSearchTopP:
         out = m.generate(ids, max_new_tokens=3, decode_strategy="sampling",
                          top_p=0.9, temperature=0.8)
         assert out.shape == [1, 5]
+
+    def test_num_return_sequences_beam(self):
+        rng = np.random.default_rng(5)
+        V, K = 9, 4
+        table = rng.permutation(V * V).reshape(V, V).astype(np.float32)
+        prompt = np.array([[3], [6]], np.int64)
+        from paddle_tpu.nlp.generation import CompiledGenerator
+        model = _TableLM(table)
+        gen = CompiledGenerator(model, cache_spec=(1, 1, 4),
+                                decode_strategy="beam_search",
+                                num_beams=K, pad_token_id=0,
+                                num_return_sequences=3)
+        out, scores = gen(paddle.to_tensor(prompt), max_new_tokens=4,
+                          return_scores=True)
+        assert out.shape == [6, 5]      # 2 rows x 3 sequences
+        assert scores.shape == [6]
+        s = scores.numpy()
+        # per row: best-first ordering, and row 0's top-1 equals the
+        # plain beam search result
+        assert (np.diff(s.reshape(2, 3), axis=1) <= 1e-6).all()
+        best = CompiledGenerator(model, cache_spec=(1, 1, 4),
+                                 decode_strategy="beam_search",
+                                 num_beams=K, pad_token_id=0)
+        np.testing.assert_array_equal(
+            out.numpy().reshape(2, 3, 5)[:, 0],
+            best(paddle.to_tensor(prompt), max_new_tokens=4).numpy())
+
+    def test_num_return_sequences_sampling(self):
+        cfg = GPTConfig(vocab_size=32, hidden_size=16,
+                        num_hidden_layers=1, num_attention_heads=2,
+                        intermediate_size=32,
+                        max_position_embeddings=32)
+        paddle.seed(0)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        ids = paddle.to_tensor(np.array([[3, 1]], np.int64))
+        out = m.generate(ids, max_new_tokens=3,
+                         decode_strategy="sampling", top_k=8,
+                         temperature=1.5, num_return_sequences=4)
+        assert out.shape == [4, 5]
+        # all rows share the prompt
+        assert (out.numpy()[:, :2] == [3, 1]).all()
+        # greedy + n>1 must raise
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            m.generate(ids, max_new_tokens=3, decode_strategy="greedy",
+                       num_return_sequences=2)
