@@ -25,9 +25,16 @@ _excluded: set = set()
 
 
 def set_excluded_layers(param_names, main_program=None):
-    """Parameter NAMES (substrings match, like the reference's
-    name-prefix semantics) to skip in prune_model."""
+    """Parameter/layer NAMES to skip in prune_model. Matching follows
+    the reference's prefix semantics: exact name, or a dotted-prefix
+    (layer name) of the parameter name — 'linear_1' excludes
+    'linear_1.w_0' but NOT 'linear_10.w_0'."""
     _excluded.update(param_names)
+
+
+def _is_excluded(name):
+    return any(name == ex or name.startswith(ex + ".")
+               for ex in _excluded)
 
 
 def reset_excluded_layers(main_program=None):
@@ -78,7 +85,6 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
     decorated optimizer to re-apply). Returns {param_name: mask}."""
     from ..nn.layer.common import Linear
     from ..nn.layer.conv import Conv2D
-    from ..core.tensor import Tensor
     import jax.numpy as jnp
 
     if mask_algo not in _MASK_ALGOS:
@@ -93,7 +99,7 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
             continue
         w = sub.weight
         name = getattr(w, "name", "") or ""
-        if any(ex in name for ex in _excluded):
+        if _is_excluded(name):
             continue
         arr = np.asarray(w._value)
         w2, restore = _weight_2d(arr)

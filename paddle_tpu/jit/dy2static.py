@@ -373,6 +373,27 @@ def _scan_transfers(stmts):
     return sc
 
 
+def _prelude_writes(stmts):
+    """Names bound by simple assignments in the body's straight-line
+    prefix, whose RHS does not read the name itself — established fresh
+    every iteration, so they are loop-local."""
+    out: set[str] = set()
+    for st in stmts:
+        if isinstance(st, ast.Assign) and all(
+                isinstance(t, ast.Name) for t in st.targets):
+            reads = {n.id for n in ast.walk(st.value)
+                     if isinstance(n, ast.Name)
+                     and isinstance(n.ctx, ast.Load)}
+            for t in st.targets:
+                if t.id not in reads:
+                    out.add(t.id)
+            continue
+        if isinstance(st, ast.FunctionDef):
+            continue  # generated helper defs don't read bindings yet
+        break
+    return out
+
+
 def _name(n, ctx=ast.Load):
     return ast.Name(id=n, ctx=ctx())
 
@@ -695,6 +716,17 @@ class _Converter:
         if node.orelse or _has_unsupported(node.body):
             return node
         carried = sorted(_assigned_names(node.body))
+        unbound = [c for c in carried if c not in bound]
+        if unbound:
+            # names (re)created by simple assignments at the top of the
+            # body before anything can read them are loop-LOCAL (e.g. an
+            # inner loop's counter/flags) — they need no carry and no
+            # pre-binding. Only applied where conversion would otherwise
+            # bail entirely; under trace a post-loop read of such a name
+            # becomes NameError instead of Python's last-value leak.
+            prelude = _prelude_writes(node.body)
+            if all(c in prelude for c in unbound):
+                carried = [c for c in carried if c not in unbound]
         if not carried or any(c not in bound for c in carried):
             return node
         i = self.n

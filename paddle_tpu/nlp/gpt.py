@@ -296,11 +296,12 @@ class GPTForCausalLM(nn.Layer):
         keeps the eager per-token loop (growing concat caches) for
         debugging."""
         if not use_compiled and (decode_strategy not in (None, "greedy")
-                                 or int(num_return_sequences) != 1):
+                                 or int(num_return_sequences) != 1
+                                 or top_p is not None):
             raise NotImplementedError(
-                "the eager debug loop supports greedy decoding only; "
-                "beam_search/sampling/num_return_sequences need the "
-                "compiled path (use_compiled=True)")
+                "the eager debug loop supports greedy/top-k decoding "
+                "only; beam_search/sampling/top_p/num_return_sequences "
+                "need the compiled path (use_compiled=True)")
         if use_compiled:
             from .generation import CompiledGenerator
             key = (float(temperature), top_k, top_p, eos_token_id,

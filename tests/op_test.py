@@ -114,3 +114,31 @@ def check_dtypes(api_fn, np_fn, inputs, dtypes=("float32", "bfloat16",
             for t in tensors:
                 gv = t.grad.numpy().astype(np.float64)
                 assert np.isfinite(gv).all(), f"non-finite grad at {dt}"
+
+
+def check_static(api_fn, inputs, rtol=1e-5, atol=1e-6, **kwargs):
+    """Eager-vs-static parity (the reference op_test runs every op in
+    both executors): record api_fn into a Program, Executor.run it, and
+    compare against the eager result."""
+    import paddle_tpu.static as static
+    eager = api_fn(*[paddle.to_tensor(a) for a in inputs], **kwargs)
+    eager_outs = eager if isinstance(eager, (list, tuple)) else [eager]
+    eager_np = [np.asarray(o.numpy()) for o in eager_outs]
+
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog, static.Program()):
+            feeds = [static.data(f"in{i}", list(a.shape),
+                                 str(a.dtype)) for i, a in
+                     enumerate(inputs)]
+            outs = api_fn(*feeds, **kwargs)
+            outs = outs if isinstance(outs, (list, tuple)) else [outs]
+            got = static.Executor().run(
+                prog, feed={f"in{i}": a for i, a in enumerate(inputs)},
+                fetch_list=list(outs))
+    finally:
+        paddle.disable_static()
+    for g, w in zip(got, eager_np):
+        np.testing.assert_allclose(np.asarray(g), w, rtol=rtol,
+                                   atol=atol, err_msg="static != eager")

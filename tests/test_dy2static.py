@@ -509,3 +509,54 @@ class TestControlTransfers:
         assert float(f(x)) == 6.0
         g = jit.to_static(f)
         assert float(g(x)) == 6.0
+
+    def test_nested_loop_inner_break(self):
+        # break binds to the INNER loop; outer continues
+        def f(x):
+            s = x * 0.0
+            i = paddle.to_tensor(np.int64(0))
+            while i < 3:
+                j = paddle.to_tensor(np.int64(0))
+                while j < 10:
+                    s = s + x
+                    j = j + 1
+                    if j >= 2:
+                        break
+                i = i + 1
+            return s
+
+        x = np.array([1.0], "float32")
+        want = f(paddle.to_tensor(x)).numpy()   # 3 outer x 2 inner
+        got = jit.to_static(f)(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, want)
+        np.testing.assert_allclose(want, [6.0])
+
+    def test_layer_method_with_break(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                acc = h * 0.0
+                i = paddle.to_tensor(np.int64(0))
+                while i < 8:
+                    acc = acc + h
+                    if acc.sum() > 5.0:
+                        break
+                    i = i + 1
+                return acc.sum()
+
+        paddle.seed(4)
+        m = Net()
+        x = paddle.to_tensor(
+            np.abs(np.random.RandomState(0).randn(2, 4))
+            .astype("float32"))
+        jit.api.enable_to_static(False)
+        try:
+            want = float(m.forward(x))
+        finally:
+            jit.api.enable_to_static(True)
+        st = jit.to_static(m)
+        np.testing.assert_allclose(float(st(x)), want, rtol=1e-5)

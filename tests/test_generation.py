@@ -412,6 +412,11 @@ class TestBeamSearchTopP:
         assert out.shape == [4, 5]
         # all rows share the prompt
         assert (out.numpy()[:, :2] == [3, 1]).all()
+        # and the samples are genuinely independent: with top_k=8 and
+        # a hot temperature, 4 identical 3-token rows means the rows
+        # shared one RNG draw (the regression this guards against)
+        gen = out.numpy()[:, 2:]
+        assert len({tuple(r) for r in gen}) > 1, gen
         # greedy + n>1 must raise
         import pytest as _pytest
         with _pytest.raises(ValueError):

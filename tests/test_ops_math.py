@@ -408,3 +408,36 @@ class TestDtypeSweep:
         ins = [rng.randn(4, 6).astype("float64") * 0.5
                for _ in range(nin)]
         check_dtypes(api, ref, ins, grad=name not in ("floor",))
+
+
+class TestEagerStaticParity:
+    """Every op produces identical results recorded into a Program and
+    replayed by the Executor (reference op_test's dual-executor run)."""
+
+    CASES = [
+        ("add", lambda a, b: paddle.add(a, b), 2),
+        ("multiply", lambda a, b: paddle.multiply(a, b), 2),
+        ("matmul", lambda a, b: paddle.matmul(a, b), 2),
+        ("exp", lambda a: paddle.exp(a), 1),
+        ("tanh", lambda a: paddle.tanh(a), 1),
+        ("softmax", lambda a: paddle.nn.functional.softmax(a), 1),
+        ("relu", lambda a: paddle.nn.functional.relu(a), 1),
+        ("mean_axis", lambda a: paddle.mean(a, axis=1), 1),
+        ("cumsum", lambda a: paddle.cumsum(a, axis=-1), 1),
+        ("topk_values", lambda a: paddle.topk(a, 3)[0], 1),
+        ("concat_self", lambda a: paddle.concat([a, a], axis=0), 1),
+        ("transpose", lambda a: paddle.transpose(a, [1, 0]), 1),
+        ("layer_norm", lambda a: paddle.nn.functional.layer_norm(
+            a, a.shape[-1]), 1),
+        ("clip", lambda a: paddle.clip(a, -0.5, 0.5), 1),
+        ("log_softmax", lambda a: paddle.nn.functional.log_softmax(a),
+         1),
+    ]
+
+    @pytest.mark.parametrize("name,api,nin", CASES,
+                             ids=[c[0] for c in CASES])
+    def test_eager_static_parity(self, name, api, nin):
+        from op_test import check_static
+        rng = np.random.RandomState(1)
+        ins = [rng.randn(6, 6).astype("float32") for _ in range(nin)]
+        check_static(api, ins)
