@@ -9,6 +9,11 @@ Usage: python scripts/op_bench_check.py baseline.json new.json
 Exit 0 when no op regressed beyond threshold x baseline; exit 1 with a
 table of offenders otherwise. New/removed ops are reported but do not
 fail the gate.
+
+Caveat for tunneled TPUs (axon): host_us below ~100us carries queue
+noise even with op_bench's min-of-repeats — two identical runs can
+differ 2-4x per op. On such machines gate on --metric wall_us or use
+--threshold 3.0; on direct-attached devices/CPU the default is sound.
 """
 from __future__ import annotations
 
