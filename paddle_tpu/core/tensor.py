@@ -345,8 +345,32 @@ class Tensor:
     def rank(self):
         return self.ndim
 
+    def ndimension(self):
+        return self.ndim
+
     def element_size(self):
         return self.dtype.itemsize
+
+    def is_floating_point(self):
+        import jax.numpy as _jnp
+        return bool(_jnp.issubdtype(self._value.dtype, _jnp.floating))
+
+    # single memory space + XLA-owned layouts: these are identities
+    # kept for API parity (reference varbase_patch_methods cpu()/cuda())
+    def cpu(self):
+        return self
+
+    def cuda(self, device_id=None, blocking=True):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    def contiguous(self):
+        return self
 
     # -- conversion --------------------------------------------------------
     def numpy(self):

@@ -29,6 +29,7 @@ __all__ = [
     "unique", "unique_consecutive", "sort", "argsort", "topk", "kthvalue",
     "mode", "searchsorted", "bucketize", "moveaxis", "swapaxes", "diagonal",
     "tensordot", "trace", "kron", "diff", "bincount", "histogram",
+    "take",
     "flatten_", "as_strided", "view", "view_as", "atleast_1d", "atleast_2d",
     "atleast_3d", "select_scatter", "shard_index", "tolist", "pad",
 ]
@@ -397,6 +398,48 @@ def repeat_interleave(x, repeats, axis=None, name=None):
 
 register_op("take_along_axis", lambda x, index, axis=0:
             jnp.take_along_axis(x, index, axis=axis))
+
+
+def _take_fwd(x, index, mode):
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    idx = index.astype(jnp.int64)
+    if mode == "wrap":
+        idx = jnp.mod(idx, n)
+    elif mode == "clip":
+        # reference clips the RAW index to [0, n-1]: -1 -> 0, not n-1
+        idx = jnp.clip(idx, 0, n - 1)
+    else:  # raise (bounds checked eagerly in the wrapper)
+        idx = jnp.where(idx < 0, idx + n, idx)
+        idx = jnp.clip(idx, 0, n - 1)
+    return jnp.take(flat, idx)
+
+
+register_op("take_flat", _take_fwd)
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather shaped like `index` (reference:
+    python/paddle/tensor/math.py:5285). mode='raise' bounds-checks
+    eagerly; under tracing it degrades to clip (XLA cannot raise)."""
+    x, index = as_tensor(x), as_tensor(index)
+    if mode not in ("raise", "wrap", "clip"):
+        raise ValueError(f"bad mode {mode!r}: raise/wrap/clip")
+    if not jnp.issubdtype(index._value.dtype, jnp.integer):
+        raise TypeError(
+            f"take index must be int32/int64, got {index.dtype}")
+    if mode == "raise":
+        from ..core.tensor import _is_tracer
+        if not _is_tracer(index._value):
+            arr = index.numpy()
+            if arr.size:
+                n = int(np.prod(x.shape))
+                lo, hi = int(arr.min()), int(arr.max())
+                if lo < -n or hi >= n:
+                    raise IndexError(
+                        f"take index out of range [-{n}, {n}): "
+                        f"[{lo}, {hi}]")
+    return apply_op("take_flat", x, index, attrs=dict(mode=mode))
 
 
 def take_along_axis(arr, indices, axis, broadcast=True, name=None):

@@ -441,3 +441,35 @@ class TestEagerStaticParity:
         rng = np.random.RandomState(1)
         ins = [rng.randn(6, 6).astype("float32") for _ in range(nin)]
         check_static(api, ins)
+
+
+class TestTakeAndMethodParity:
+    def test_take_modes(self):
+        x = paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))
+        idx = paddle.to_tensor(np.array([[0, 5], [11, -1]], "int64"))
+        out = paddle.take(x, idx)
+        np.testing.assert_array_equal(out.numpy(),
+                                      [[0.0, 5.0], [11.0, 11.0]])
+        wrap = paddle.take(x, paddle.to_tensor(
+            np.array([12, -13], "int64")), mode="wrap")
+        np.testing.assert_array_equal(wrap.numpy(), [0.0, 11.0])
+        clip = paddle.take(x, paddle.to_tensor(
+            np.array([25, -40, -1], "int64")), mode="clip")
+        # reference clip semantics: raw index clipped to [0, n-1]
+        np.testing.assert_array_equal(clip.numpy(), [11.0, 0.0, 0.0])
+        with pytest.raises(IndexError):
+            paddle.take(x, paddle.to_tensor(np.array([12], "int64")))
+        with pytest.raises(TypeError):
+            paddle.take(x, paddle.to_tensor(
+                np.array([1.5], "float32")))
+        empty = paddle.take(x, paddle.to_tensor(
+            np.array([], "int64")))
+        assert empty.shape == [0]
+
+    def test_trivial_method_parity(self):
+        t = paddle.to_tensor(np.ones((2, 3), "float32"))
+        assert t.ndimension() == 2
+        assert t.is_floating_point()
+        assert not paddle.to_tensor(np.ones(2, "int64")).is_floating_point()
+        assert t.cpu() is t and t.cuda() is t and t.pin_memory() is t
+        assert t.is_contiguous() and t.contiguous() is t
