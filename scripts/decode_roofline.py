@@ -1,0 +1,205 @@
+"""Decode roofline decomposition: where do the 2.95 ms/step go?
+
+Times isolated compiled pieces of the GPT-124M decode step (bs16,
+max_len 640) to attribute per-step time to weight streaming, KV-cache
+attention, LM head, and while-loop/carry overhead. Prints a JSON report.
+
+Reference analogue: the reference profiles its fused decoder with
+nvprof over fused_multi_transformer_op.cu; here the XLA cost comes
+apart the same way.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def timeit(fn, *args, reps=50, warmup=3):
+    import jax
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def timeit_chained(fn, x, cks, cvs, p, reps=50, warmup=3):
+    """For donated-cache steps: thread the output caches back in so the
+    donated buffers stay alive across reps."""
+    import jax
+    for _ in range(warmup):
+        out, cks, cvs = fn(x, cks, cvs, p)
+    jax.block_until_ready((out, cks, cvs))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out, cks, cvs = fn(x, cks, cvs, p)
+    jax.block_until_ready((out, cks, cvs))
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    B, LMAX, H, NH, D, NL, V = 16, 640, 768, 12, 64, 12, 50304
+    FF = 4 * H
+    dt = jnp.bfloat16
+    key = jax.random.PRNGKey(0)
+
+    def rnd(*shape):
+        nonlocal key
+        key, k = jax.random.split(key)
+        return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(dt)
+
+    # per-layer weights
+    Wqkv = [rnd(H, 3 * H) for _ in range(NL)]
+    Wout = [rnd(H, H) for _ in range(NL)]
+    W1 = [rnd(H, FF) for _ in range(NL)]
+    W2 = [rnd(FF, H) for _ in range(NL)]
+    E = rnd(V, H)
+    ck = [rnd(B, LMAX, NH, D) for _ in range(NL)]
+    cv = [rnd(B, LMAX, NH, D) for _ in range(NL)]
+    x0 = rnd(B, 1, H)
+    pos = jnp.int32(400)
+
+    def ln(x):
+        xf = x.astype(jnp.float32)
+        mu = xf.mean(-1, keepdims=True)
+        var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+        return ((xf - mu) * jax.lax.rsqrt(var + 1e-5)).astype(x.dtype)
+
+    def attend(q, k_buf, v_buf, p):
+        # q [B,1,NH,D]; mask over cache axis
+        qf = q.transpose(0, 2, 1, 3).astype(jnp.float32)
+        kf = k_buf.transpose(0, 2, 3, 1).astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhdk->bhqk", qf, kf) / np.sqrt(D)
+        j = jnp.arange(LMAX)[None, None, None, :]
+        s = jnp.where(j <= p, s, -1e30)
+        a = jax.nn.softmax(s, axis=-1)
+        vf = v_buf.transpose(0, 2, 1, 3).astype(jnp.float32)
+        o = jnp.einsum("bhqk,bhkd->bhqd", a, vf)
+        return o.transpose(0, 2, 1, 3).astype(q.dtype)
+
+    def layer_step(x, i, cks, cvs, p, with_attn=True):
+        h = ln(x)
+        qkv = h.reshape(B, H) @ Wqkv[i]
+        q, kn, vn = jnp.split(qkv.reshape(B, 1, NH, 3 * D), 3, axis=-1)
+        ckb = jax.lax.dynamic_update_slice(
+            cks[i], kn, (0, p.astype(jnp.int32), 0, 0))
+        cvb = jax.lax.dynamic_update_slice(
+            cvs[i], vn, (0, p.astype(jnp.int32), 0, 0))
+        if with_attn:
+            o = attend(q, ckb, cvb, p)
+        else:
+            o = q
+        x = x + (o.reshape(B, H) @ Wout[i]).reshape(B, 1, H)
+        h = ln(x)
+        y = jax.nn.gelu(h.reshape(B, H) @ W1[i], approximate=True)
+        x = x + (y @ W2[i]).reshape(B, 1, H)
+        return x, ckb, cvb
+
+    def full_step(x, cks, cvs, p):
+        ncks, ncvs = [], []
+        for i in range(NL):
+            x, a, b = layer_step(x, i, cks, cvs, p)
+            ncks.append(a)
+            ncvs.append(b)
+        logits = (ln(x).reshape(B, H) @ E.T).astype(jnp.float32)
+        nxt = jnp.argmax(logits, axis=-1)
+        return nxt, ncks, ncvs
+
+    def noattn_step(x, cks, cvs, p):
+        ncks, ncvs = [], []
+        for i in range(NL):
+            x, a, b = layer_step(x, i, cks, cvs, p, with_attn=False)
+            ncks.append(a)
+            ncvs.append(b)
+        logits = (ln(x).reshape(B, H) @ E.T).astype(jnp.float32)
+        nxt = jnp.argmax(logits, axis=-1)
+        return nxt, ncks, ncvs
+
+    def mlp_only(x):
+        for i in range(NL):
+            h = ln(x)
+            qkv = h.reshape(B, H) @ Wqkv[i]
+            x = x + (qkv[:, :H]).reshape(B, 1, H)
+            h = ln(x)
+            y = jax.nn.gelu(h.reshape(B, H) @ W1[i], approximate=True)
+            x = x + (y @ W2[i]).reshape(B, 1, H)
+        return (ln(x).reshape(B, H) @ E.T).astype(jnp.float32)
+
+    def attn_only(cks, cvs, p):
+        q = x0.reshape(B, 1, NH, D)
+        outs = []
+        for i in range(NL):
+            outs.append(attend(q, cks[i], cvs[i], p))
+        return sum(outs)
+
+    report = {}
+
+    def note(k, v):
+        report[k] = v
+        print(f"  {k}: {v}", flush=True)
+
+    # (1) standalone full step, donated caches (true in-place)
+    step_d = jax.jit(full_step, donate_argnums=(1, 2))
+    t = timeit_chained(step_d, x0, [jnp.copy(a) for a in ck],
+                       [jnp.copy(a) for a in cv], pos)
+    note("standalone_step_donated_ms", round(t * 1e3, 3))
+
+    # (2) standalone step, no donation (forces full cache copies)
+    step_nd = jax.jit(full_step)
+    t = timeit(step_nd, x0, list(ck), list(cv), pos)
+    note("standalone_step_undonated_ms", round(t * 1e3, 3))
+
+    # (3) weights-only (no attention, no cache read)
+    t = timeit_chained(jax.jit(noattn_step, donate_argnums=(1, 2)),
+                       x0, [jnp.copy(a) for a in ck],
+                       [jnp.copy(a) for a in cv], pos)
+    note("step_no_attention_ms", round(t * 1e3, 3))
+
+    # (4) matmuls only (no cache update at all)
+    t = timeit(jax.jit(mlp_only), x0)
+    note("matmuls_only_ms", round(t * 1e3, 3))
+
+    # (5) attention reads only
+    t = timeit(jax.jit(attn_only), ck, cv, pos)
+    note("attention_only_ms", round(t * 1e3, 3))
+
+    # (6) loop of 64 steps as one program (the real decode shape)
+    def loop64(x, cks, cvs, p):
+        cks = list(cks)
+        cvs = list(cvs)
+
+        def body(carry, _):
+            x, cks, cvs, p = carry
+            nxt, cks, cvs = full_step(x, tuple(cks), tuple(cvs), p)
+            return (x, cks, cvs, p + 1), nxt
+
+        (x, cks, cvs, p), toks = jax.lax.scan(
+            body, (x, tuple(cks), tuple(cvs), p), None, length=64)
+        return toks, list(cks), list(cvs)
+
+    t = timeit_chained(jax.jit(loop64, donate_argnums=(1, 2)),
+                       x0, [jnp.copy(a) for a in ck],
+                       [jnp.copy(a) for a in cv], pos, reps=5)
+    note("loop64_per_step_ms", round(t / 64 * 1e3, 3))
+
+    # roofline bookkeeping
+    wbytes = sum(int(np.prod(w.shape)) for w in Wqkv + Wout + W1 + W2) * 2
+    ebytes = int(np.prod(E.shape)) * 2
+    kvbytes = 2 * NL * B * LMAX * NH * D * 2
+    report["weight_bytes_mb"] = round((wbytes + ebytes) / 1e6, 1)
+    report["kv_bytes_mb"] = round(kvbytes / 1e6, 1)
+    report["hbm_ideal_ms"] = round(
+        (wbytes + ebytes + kvbytes) / 819e9 * 1e3, 3)
+    print(json.dumps(report, indent=1))
+
+
+if __name__ == "__main__":
+    main()
