@@ -165,7 +165,8 @@ register_op("cumprod", lambda x, axis=None: jnp.cumprod(
     x.reshape(-1) if axis is None else x, axis=0 if axis is None else axis))
 register_op("logcumsumexp", lambda x, axis=None:
             jax.lax.cumlogsumexp(x.reshape(-1) if axis is None else x,
-                                 axis=0 if axis is None else axis))
+                                 axis=0 if axis is None
+                                 else axis % x.ndim))
 
 
 def cumsum(x, axis=None, dtype=None, name=None):

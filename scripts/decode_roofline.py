@@ -16,30 +16,57 @@ import time
 import numpy as np
 
 
-def timeit(fn, *args, reps=50, warmup=3):
+def timeit(fn, *args, reps=10, batches=5, warmup=3):
+    """min-of-batches mean: repo convention for tunnel-noise-robust
+    timing (see decode_bench.py / op_bench.py)."""
     import jax
     for _ in range(warmup):
         out = fn(*args)
     jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
+    best = float("inf")
+    for _ in range(batches):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, (time.perf_counter() - t0) / reps)
+    return best
+
+
+def timeit_varying(fn, make_args, reps=10, batches=5, warmup=3):
+    """Per-call distinct args (defeats identical-call caching on the
+    tunneled path); args are pre-built outside the timed window."""
+    import jax
+    arg_sets = [make_args(i) for i in range(batches * reps + warmup)]
+    jax.block_until_ready(arg_sets)
+    it = iter(arg_sets)
+    for _ in range(warmup):
+        out = fn(*next(it))
     jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / reps
+    best = float("inf")
+    for _ in range(batches):
+        t0 = time.perf_counter()
+        outs = [fn(*next(it)) for _ in range(reps)]
+        jax.block_until_ready(outs)
+        best = min(best, (time.perf_counter() - t0) / reps)
+    return best
 
 
-def timeit_chained(fn, x, cks, cvs, p, reps=50, warmup=3):
+def timeit_chained(fn, x, cks, cvs, p, reps=10, batches=5, warmup=3):
     """For donated-cache steps: thread the output caches back in so the
     donated buffers stay alive across reps."""
     import jax
     for _ in range(warmup):
         out, cks, cvs = fn(x, cks, cvs, p)
     jax.block_until_ready((out, cks, cvs))
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out, cks, cvs = fn(x, cks, cvs, p)
-    jax.block_until_ready((out, cks, cvs))
-    return (time.perf_counter() - t0) / reps
+    best = float("inf")
+    for _ in range(batches):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out, cks, cvs = fn(x, cks, cvs, p)
+        jax.block_until_ready((out, cks, cvs))
+        best = min(best, (time.perf_counter() - t0) / reps)
+    return best
 
 
 def main():
@@ -123,7 +150,10 @@ def main():
         nxt = jnp.argmax(logits, axis=-1)
         return nxt, ncks, ncvs
 
-    def mlp_only(x):
+    def mlp_only(x, step):
+        # step varies per call: defeats any identical-call memoization
+        # between host and device on the tunneled path
+        x = x + step.astype(x.dtype) * 0
         for i in range(NL):
             h = ln(x)
             qkv = h.reshape(B, H) @ Wqkv[i]
@@ -133,8 +163,8 @@ def main():
             x = x + (y @ W2[i]).reshape(B, 1, H)
         return (ln(x).reshape(B, H) @ E.T).astype(jnp.float32)
 
-    def attn_only(cks, cvs, p):
-        q = x0.reshape(B, 1, NH, D)
+    def attn_only(cks, cvs, p, step):
+        q = (x0 + step.astype(x0.dtype) * 0).reshape(B, 1, NH, D)
         outs = []
         for i in range(NL):
             outs.append(attend(q, cks[i], cvs[i], p))
@@ -164,11 +194,14 @@ def main():
     note("step_no_attention_ms", round(t * 1e3, 3))
 
     # (4) matmuls only (no cache update at all)
-    t = timeit(jax.jit(mlp_only), x0)
+    mfn = jax.jit(mlp_only)
+    t = timeit_varying(mfn, lambda i: (x0, jnp.float32(i)))
     note("matmuls_only_ms", round(t * 1e3, 3))
 
     # (5) attention reads only
-    t = timeit(jax.jit(attn_only), ck, cv, pos)
+    afn = jax.jit(attn_only)
+    t = timeit_varying(afn, lambda i: (ck, cv, pos, jnp.float32(i)),
+                       reps=6, batches=5)
     note("attention_only_ms", round(t * 1e3, 3))
 
     # (6) loop of 64 steps as one program (the real decode shape)
@@ -179,7 +212,12 @@ def main():
         def body(carry, _):
             x, cks, cvs, p = carry
             nxt, cks, cvs = full_step(x, tuple(cks), tuple(cvs), p)
-            return (x, cks, cvs, p + 1), nxt
+            # feed a token-derived x back in (as real decode does via the
+            # embedding) so no layer work is loop-invariant
+            x2 = jnp.broadcast_to(
+                ((nxt % 997).astype(jnp.float32) * 1e-3)
+                .astype(x.dtype)[:, None, None], x.shape)
+            return (x2, tuple(cks), tuple(cvs), p + 1), nxt
 
         (x, cks, cvs, p), toks = jax.lax.scan(
             body, (x, tuple(cks), tuple(cvs), p), None, length=64)

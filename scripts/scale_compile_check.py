@@ -1,0 +1,207 @@
+"""At-scale AOT compile proof for BASELINE configs #4 and #5.
+
+AOT-compiles the REAL compiled train step (forward + backward + AdamW,
+one donated-buffer XLA program — jit/trainer.py) for
+
+  - GPT-3 1.3B, hybrid DP=4 x TP=8 on a virtual v5p-32 topology
+    (BASELINE config #4 at its target scale), and
+  - Llama-7B, ZeRO-3 (p_g_os sharding over all 64 devices) on a
+    virtual v5p-64 (BASELINE config #5),
+
+then reads XLA's own memory_analysis()/cost_analysis() of the exact
+program that would run and asserts the per-device footprint fits v5p
+HBM (95 GB). No TPU hardware is needed: GSPMD partitions the same way
+over a forced-host-platform device mesh, which is what the cost-model
+tuner (distributed/cost_model.py) already relies on.
+
+Reference analogue: cluster-scale planning in
+python/paddle/distributed/auto_parallel/cost_model.py:1.
+
+Usage:
+  python scripts/scale_compile_check.py --config gpt13b
+  python scripts/scale_compile_check.py --config llama7b
+  python scripts/scale_compile_check.py            # both, subprocesses
+
+Each config runs in its own process (XLA_FLAGS device count is fixed at
+backend init). Output: one JSON line per config, accumulated into
+SCALE_r05.json at the repo root when run with no --config.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+V5P_HBM = 95e9           # bytes per v5p chip
+V5P_PEAK_BF16 = 459e12   # FLOP/s per v5p chip
+
+CONFIGS = {
+    "gpt13b": dict(n_devices=32, mesh="dp4 x mp8"),
+    "llama7b": dict(n_devices=64, mesh="zero3 sharding=64"),
+}
+
+
+def run_gpt13b():
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu import jit
+    from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 8}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    # GPT-3 XL shape (1.3B): 24 layers, d_model 2048, 16 heads, L=2048
+    cfg = GPTConfig(vocab_size=50304, hidden_size=2048,
+                    num_hidden_layers=24, num_attention_heads=16,
+                    max_position_embeddings=2048,
+                    hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0,
+                    use_recompute=True)
+    model = GPTForCausalLM(cfg)
+    model.to(dtype="bfloat16")  # bf16 weights, fp32 Adam moments
+    model = fleet.distributed_model(model)
+    optimizer = opt.AdamW(1e-4, parameters=model.parameters(),
+                          weight_decay=0.01,
+                          grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0))
+    optimizer = fleet.distributed_optimizer(optimizer)
+    model.train()
+    step = jit.compile_train_step(
+        lambda ids, labels: model(ids, labels=labels), model, optimizer)
+    rng = np.random.RandomState(0)
+    batch, seqlen = 32, 2048         # 8 per dp group
+    ids = dist.shard_batch(paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seqlen))))
+    labels = dist.shard_batch(paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seqlen))))
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    return step, (ids, labels), n_params, batch * seqlen
+
+
+def run_llama7b():
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu import jit
+    from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "sharding_degree": 64}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    cfg = LlamaConfig(use_recompute=True, max_position_embeddings=2048)
+    model = LlamaForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    optimizer = opt.AdamW(1e-4, parameters=model.parameters(),
+                          weight_decay=0.01)
+    model, optimizer = dist.group_sharded_parallel(model, optimizer,
+                                                   "p_g_os")
+    model.train()
+    step = jit.compile_train_step(
+        lambda ids, labels: model(ids, labels=labels), model, optimizer)
+    rng = np.random.RandomState(0)
+    batch, seqlen = 64, 2048
+    ids = dist.shard_batch(paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seqlen))))
+    labels = dist.shard_batch(paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seqlen))))
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    return step, (ids, labels), n_params, batch * seqlen
+
+
+def run_one(name):
+    spec = CONFIGS[name]
+    n_dev = spec["n_devices"]
+    os.environ["PADDLE_TPU_FORCE_CPU_DEVICES"] = str(n_dev)
+    t0 = time.time()
+    print(f"[{name}] building model + step on {n_dev} virtual devices...",
+          file=sys.stderr, flush=True)
+    step, batch, n_params, tokens = (
+        run_gpt13b() if name == "gpt13b" else run_llama7b())
+    t_build = time.time() - t0
+    print(f"[{name}] built ({n_params/1e9:.2f}B params, {t_build:.0f}s); "
+          f"lowering...", file=sys.stderr, flush=True)
+    t0 = time.time()
+    lowered = step.compile_info(*batch)
+    t_lower = time.time() - t0
+    print(f"[{name}] lowered ({t_lower:.0f}s); compiling (GSPMD over "
+          f"{n_dev} devices)...", file=sys.stderr, flush=True)
+    t0 = time.time()
+    comp = lowered.compile()
+    t_compile = time.time() - t0
+    ca = comp.cost_analysis() or {}
+    ms = comp.memory_analysis()
+    arg_b = int(ms.argument_size_in_bytes)
+    tmp_b = int(ms.temp_size_in_bytes)
+    out_b = int(ms.output_size_in_bytes)
+    alias_b = int(getattr(ms, "alias_size_in_bytes", 0))
+    # donated params/states alias outputs: live per-device footprint is
+    # arguments + temporaries (outputs reuse the donated buffers)
+    live = arg_b + tmp_b
+    flops = float(ca.get("flops", 0.0))
+    # per-device step FLOPs -> v5p roofline time & MFU estimate at scale
+    est_s = flops / V5P_PEAK_BF16
+    model_flops = 6.0 * n_params * tokens  # global fwd+bwd FLOPs
+    mfu_est = model_flops / n_dev / V5P_PEAK_BF16 / est_s if est_s else 0.0
+    rec = {
+        "config": name, "n_devices": n_dev, "mesh": spec["mesh"],
+        "n_params": n_params,
+        "per_device_bytes": {"arguments": arg_b, "temporaries": tmp_b,
+                             "output": out_b, "aliased": alias_b,
+                             "live": live},
+        "per_device_live_gb": round(live / 1e9, 2),
+        "hbm_gb": round(V5P_HBM / 1e9, 1),
+        "fits_hbm": bool(live <= V5P_HBM),
+        "per_device_step_flops": flops,
+        "est_step_seconds_v5p": round(est_s, 4),
+        "est_mfu_upper_bound": round(mfu_est, 3),
+        "compile_seconds": round(t_compile, 1),
+    }
+    assert rec["fits_hbm"], (
+        f"{name}: per-device live bytes {live/1e9:.1f} GB exceed v5p "
+        f"HBM {V5P_HBM/1e9:.0f} GB")
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", choices=list(CONFIGS))
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    if args.config:
+        run_one(args.config)
+        return
+    recs = []
+    for name in CONFIGS:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--config", name],
+            capture_output=True, text=True)
+        sys.stderr.write(p.stderr)
+        if p.returncode != 0:
+            raise SystemExit(
+                f"{name} failed (rc={p.returncode}):\n{p.stdout[-2000:]}")
+        recs.append(json.loads(p.stdout.strip().splitlines()[-1]))
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "SCALE_r05.json")
+    with open(out, "w") as f:
+        json.dump(recs, f, indent=1)
+    print(f"wrote {out}")
+    for r in recs:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    main()
