@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import weakref
 from typing import Any, Optional, Sequence
 
 import numpy as np
@@ -31,6 +32,20 @@ import jax.numpy as jnp
 from . import dtype as dtypes
 from . import device as devices
 from .dispatch import OpDef, get_jitted, get_vjp, get_op, _freeze
+
+# dtype -> "participates in autodiff" memo; dtype objects are interned
+# per-process so the dict stays tiny. Saves two convert_dtype() calls
+# per input/output on the taped dispatch hot path (SURVEY §3.1 #1 risk).
+_DIFF_DTYPES: dict = {}
+
+
+def _is_diff_dtype(dt) -> bool:
+    r = _DIFF_DTYPES.get(dt)
+    if r is None:
+        nd = np.dtype(dt)
+        r = _DIFF_DTYPES[dt] = (dtypes.is_floating(nd)
+                                or dtypes.is_complex(nd))
+    return r
 
 __all__ = ["Tensor", "Parameter", "to_tensor", "no_grad", "enable_grad",
            "is_grad_enabled", "set_grad_enabled", "apply_op", "run_backward",
@@ -282,7 +297,7 @@ class Tensor:
     """An eager tensor over a jax.Array (or a JAX tracer under jit)."""
 
     __slots__ = ("_value", "stop_gradient", "grad", "_grad_node", "_out_slot",
-                 "name", "persistable", "is_leaf_", "_retain_grad", "_hooks",
+                 "_name", "persistable", "is_leaf_", "_retain_grad", "_hooks",
                  "_grad_spec", "__weakref__")
 
     _iid = [0]
@@ -296,10 +311,19 @@ class Tensor:
         self.persistable = False
         self._retain_grad = False
         self._hooks = None
-        if name is None:
+        self._name = name  # generated lazily on first access
+
+    @property
+    def name(self):
+        n = self._name
+        if n is None:
             Tensor._iid[0] += 1
-            name = f"generated_tensor_{Tensor._iid[0]}"
-        self.name = name
+            n = self._name = f"generated_tensor_{Tensor._iid[0]}"
+        return n
+
+    @name.setter
+    def name(self, v):
+        self._name = v
 
     # -- basic metadata ----------------------------------------------------
     @property
@@ -417,6 +441,9 @@ class Tensor:
 
     def retain_grads(self):
         self._retain_grad = True
+        node = self._grad_node
+        if node is not None and node.out_refs[self._out_slot] is None:
+            node.out_refs[self._out_slot] = weakref.ref(self)
 
     def clear_grad(self):
         self.grad = None
@@ -591,18 +618,18 @@ def apply_op(op_name: str, *tensors, attrs: Optional[dict] = None,
                 break
     attrs = attrs or {}
 
-    out_tensors = tuple(Tensor(o, stop_gradient=not need_grad) for o in outs)
+    if single:
+        out_tensors = (Tensor(out, stop_gradient=not need_grad),)
+    else:
+        out_tensors = tuple(Tensor(o, stop_gradient=not need_grad)
+                            for o in outs)
 
     if need_grad:
         diff_in = tuple(i for i, t in enumerate(tensors)
                         if not t.stop_gradient
-                        and (dtypes.is_floating(np.dtype(t._value.dtype))
-                             or dtypes.is_complex(
-                                 np.dtype(t._value.dtype))))
-        diff_out = tuple(
-            i for i, o in enumerate(outs)
-            if dtypes.is_floating(np.dtype(o.dtype))
-            or dtypes.is_complex(np.dtype(o.dtype)))
+                        and _is_diff_dtype(t._value.dtype))
+        diff_out = tuple(i for i, o in enumerate(outs)
+                         if _is_diff_dtype(o.dtype))
         if diff_in and diff_out:
             in_edges = []
             for i in diff_in:
@@ -611,17 +638,27 @@ def apply_op(op_name: str, *tensors, attrs: Optional[dict] = None,
                     in_edges.append((t._grad_node, t._out_slot, t))
                 else:
                     in_edges.append((None, 0, t))
-            out_meta = [(outs[i].shape, np.dtype(outs[i].dtype))
+            out_meta = [(outs[i].shape, outs[i].dtype)
                         for i in diff_out]
             node = GradNode(
                 op, attrs, vals,
                 outs if op.save_outputs else None,
                 in_edges, diff_in, diff_out, single, out_meta)
-            import weakref
-            for slot, i in enumerate(diff_out):
-                out_tensors[i]._grad_node = node
-                out_tensors[i]._out_slot = slot
-                node.out_refs[slot] = weakref.ref(out_tensors[i])
+            if op.bwd is not None or op.save_outputs:
+                # custom-bwd ops re-enter through their saved outputs in
+                # apply_taped: those need the out weakrefs eagerly
+                for slot, i in enumerate(diff_out):
+                    out_tensors[i]._grad_node = node
+                    out_tensors[i]._out_slot = slot
+                    node.out_refs[slot] = weakref.ref(out_tensors[i])
+            else:
+                # plain ops: out_refs are only consumed for retain_grad /
+                # grad(inputs=...) intermediates — registered lazily by
+                # retain_grads() and run_backward() instead of paying a
+                # weakref per op on the dispatch hot path
+                for slot, i in enumerate(diff_out):
+                    out_tensors[i]._grad_node = node
+                    out_tensors[i]._out_slot = slot
         else:
             for t in out_tensors:
                 t.stop_gradient = True
@@ -664,6 +701,13 @@ def run_backward(tensors: Sequence[Tensor], grad_tensors=None,
     collected: dict[int, Any] = {}             # id(tensor) -> grad array
     wanted = {id(t): t for t in (inputs or [])}
     blocked = {id(t) for t in (no_grad_vars or [])}
+    for t in (inputs or []):
+        # out_refs are lazily registered (see apply_op): a wanted
+        # intermediate must be reachable through its producer's out_refs
+        # for the deposit loop below
+        node = t._grad_node
+        if node is not None and node.out_refs[t._out_slot] is None:
+            node.out_refs[t._out_slot] = weakref.ref(t)
 
     def deposit(t, g, as_leaf):
         """Deliver a gradient to a tensor: hooks, .grad, collection.

@@ -1,0 +1,64 @@
+// Save->load->run parity demo over the Go bindings, mirroring the C
+// driver in tests/test_capi_deploy.py: loads the saved-model prefix
+// given on the command line, feeds the same fixed input, prints the
+// output in the same "key=value" format so the Python test can compare
+// against the in-process predictor.
+package main
+
+import (
+	"fmt"
+	"os"
+	"strings"
+
+	paddle "paddle_tpu/goapi"
+)
+
+func main() {
+	if len(os.Args) < 2 {
+		fmt.Fprintln(os.Stderr, "usage: demo <model-prefix>")
+		os.Exit(2)
+	}
+	cfg := paddle.NewConfig()
+	defer cfg.Destroy()
+	cfg.SetModel(os.Args[1])
+
+	pred, err := paddle.NewPredictor(cfg)
+	if err != nil {
+		fmt.Fprintln(os.Stderr, "create:", err)
+		os.Exit(3)
+	}
+	defer pred.Destroy()
+
+	names := pred.GetInputNames()
+	fmt.Printf("version=%s\n", paddle.Version())
+	fmt.Printf("inputs=%d first=%s\n", len(names), names[0])
+
+	data := make([]float32, 8)
+	for i := range data {
+		data[i] = 0.25*float32(i) - 1.0
+	}
+	if err := pred.SetInputFloat32(names[0], data,
+		[]int64{2, 4}); err != nil {
+		fmt.Fprintln(os.Stderr, "set_input:", err)
+		os.Exit(4)
+	}
+	if err := pred.Run(); err != nil {
+		fmt.Fprintln(os.Stderr, "run:", err)
+		os.Exit(5)
+	}
+	out, shape, err := pred.GetOutputFloat32(0)
+	if err != nil {
+		fmt.Fprintln(os.Stderr, "fetch:", err)
+		os.Exit(6)
+	}
+	dims := make([]string, len(shape))
+	for i, d := range shape {
+		dims[i] = fmt.Sprintf("%d", d)
+	}
+	fmt.Printf("out_shape=%s\n", strings.Join(dims, "x"))
+	vals := make([]string, len(out))
+	for i, v := range out {
+		vals[i] = fmt.Sprintf("%.6f", v)
+	}
+	fmt.Printf("out=%s\n", strings.Join(vals, " "))
+}

@@ -1,0 +1,3 @@
+module paddle_tpu/goapi
+
+go 1.19

@@ -1,0 +1,188 @@
+// Package paddle: Go bindings for the paddle_tpu C inference ABI.
+//
+// Mirrors the reference Go API surface
+// (/root/reference/paddle/fluid/inference/goapi/{config,predictor,tensor}.go)
+// over libpaddle_tpu_c.so (deploy/pd_inference_c.h): Config -> Predictor
+// -> set inputs -> Run -> fetch outputs. The cgo layer links only the C
+// header + shared library; Python never appears in the Go program.
+//
+// Build: the test harness (tests/test_goapi_deploy.py) sets CGO_CFLAGS
+// / CGO_LDFLAGS to the built library. Manual builds:
+//
+//	CGO_CFLAGS="-I/path/to/paddle_tpu/deploy" \
+//	CGO_LDFLAGS="-L/path/with/so -lpaddle_tpu_c -Wl,-rpath,/path/with/so" \
+//	go build ./...
+package paddle
+
+/*
+#cgo LDFLAGS: -lpaddle_tpu_c
+#include <stdlib.h>
+#include <stdint.h>
+#include "pd_inference_c.h"
+*/
+import "C"
+
+import (
+	"errors"
+	"unsafe"
+)
+
+// DataType codes follow the C ABI (reference PD_DataType subset).
+type DataType int
+
+const (
+	Float32 DataType = 0
+	Int64   DataType = 1
+	Int32   DataType = 2
+)
+
+// Version reports the underlying library version string.
+func Version() string {
+	return C.GoString(C.PD_GetVersion())
+}
+
+func lastError() error {
+	return errors.New(C.GoString(C.PD_GetLastError()))
+}
+
+// Config mirrors paddle.inference.Config (goapi config.go).
+type Config struct {
+	c *C.PD_Config
+}
+
+func NewConfig() *Config {
+	return &Config{c: C.PD_ConfigCreate()}
+}
+
+// SetModel points the config at a saved-model prefix
+// (paddle.jit.save / save_inference_model artifact).
+func (cfg *Config) SetModel(prefix string) {
+	p := C.CString(prefix)
+	defer C.free(unsafe.Pointer(p))
+	C.PD_ConfigSetModel(cfg.c, p)
+}
+
+func (cfg *Config) Destroy() {
+	if cfg.c != nil {
+		C.PD_ConfigDestroy(cfg.c)
+		cfg.c = nil
+	}
+}
+
+// Predictor mirrors goapi predictor.go over the compiled artifact.
+type Predictor struct {
+	p *C.PD_Predictor
+}
+
+func NewPredictor(cfg *Config) (*Predictor, error) {
+	p := C.PD_PredictorCreate(cfg.c)
+	if p == nil {
+		return nil, lastError()
+	}
+	return &Predictor{p: p}, nil
+}
+
+func (pred *Predictor) Destroy() {
+	if pred.p != nil {
+		C.PD_PredictorDestroy(pred.p)
+		pred.p = nil
+	}
+}
+
+func (pred *Predictor) GetInputNum() int {
+	return int(C.PD_PredictorGetInputNum(pred.p))
+}
+
+func (pred *Predictor) GetOutputNum() int {
+	return int(C.PD_PredictorGetOutputNum(pred.p))
+}
+
+func (pred *Predictor) GetInputNames() []string {
+	n := pred.GetInputNum()
+	names := make([]string, n)
+	for i := 0; i < n; i++ {
+		names[i] = C.GoString(
+			C.PD_PredictorGetInputName(pred.p, C.size_t(i)))
+	}
+	return names
+}
+
+// SetInputFloat32 feeds a row-major float32 tensor.
+func (pred *Predictor) SetInputFloat32(name string, data []float32,
+	shape []int64) error {
+	numel := int64(1)
+	for _, d := range shape {
+		numel *= d
+	}
+	if int64(len(data)) != numel {
+		return errors.New("data length does not match shape")
+	}
+	cname := C.CString(name)
+	defer C.free(unsafe.Pointer(cname))
+	rc := C.PD_PredictorSetInput(pred.p, cname,
+		unsafe.Pointer(&data[0]), C.int(Float32),
+		(*C.int64_t)(unsafe.Pointer(&shape[0])), C.int(len(shape)))
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// SetInputInt64 feeds a row-major int64 tensor (token ids etc).
+func (pred *Predictor) SetInputInt64(name string, data []int64,
+	shape []int64) error {
+	numel := int64(1)
+	for _, d := range shape {
+		numel *= d
+	}
+	if int64(len(data)) != numel {
+		return errors.New("data length does not match shape")
+	}
+	cname := C.CString(name)
+	defer C.free(unsafe.Pointer(cname))
+	rc := C.PD_PredictorSetInput(pred.p, cname,
+		unsafe.Pointer(&data[0]), C.int(Int64),
+		(*C.int64_t)(unsafe.Pointer(&shape[0])), C.int(len(shape)))
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+func (pred *Predictor) Run() error {
+	if C.PD_PredictorRun(pred.p) != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// GetOutputShape returns the shape of output idx.
+func (pred *Predictor) GetOutputShape(idx int) ([]int64, error) {
+	shape := make([]int64, 16)
+	rank := C.int(len(shape))
+	rc := C.PD_PredictorGetOutputShape(pred.p, C.size_t(idx),
+		(*C.int64_t)(unsafe.Pointer(&shape[0])), &rank)
+	if rc != 0 {
+		return nil, lastError()
+	}
+	return shape[:int(rank)], nil
+}
+
+// GetOutputFloat32 copies output idx as float32.
+func (pred *Predictor) GetOutputFloat32(idx int) ([]float32, []int64, error) {
+	shape, err := pred.GetOutputShape(idx)
+	if err != nil {
+		return nil, nil, err
+	}
+	numel := int64(1)
+	for _, d := range shape {
+		numel *= d
+	}
+	out := make([]float32, numel)
+	rc := C.PD_PredictorGetOutputFloat(pred.p, C.size_t(idx),
+		(*C.float)(unsafe.Pointer(&out[0])), C.size_t(numel))
+	if rc != 0 {
+		return nil, nil, lastError()
+	}
+	return out, shape, nil
+}

@@ -44,12 +44,16 @@ class DecodeCache:
     recompile per step), the buffers here never change shape.
     """
 
-    __slots__ = ("k", "v", "pos")
+    __slots__ = ("k", "v", "pos", "k_scale", "v_scale")
 
-    def __init__(self, k, v, pos):
+    def __init__(self, k, v, pos, k_scale=None, v_scale=None):
         self.k = k
         self.v = v
         self.pos = pos
+        # int8 cache mode: k/v hold int8 codes, *_scale [B, max_len, H]
+        # f32 per-(batch, position, head) absmax scales
+        self.k_scale = k_scale
+        self.v_scale = v_scale
 
 
 def _kv_update_fwd(buf, upd, pos):
@@ -61,6 +65,39 @@ def _kv_update_fwd(buf, upd, pos):
 
 
 register_op("kv_cache_update", _kv_update_fwd)
+
+
+def _kv_update_q8_fwd(buf, sbuf, upd, pos):
+    """Quantize upd [B, l, H, D] to int8 per (b, l, h) and write both
+    the codes and the scales at pos. The int8 cache halves the decode
+    step's dominant HBM stream (BASELINE.md decode roofline); the
+    reference's analogue is the int8 KV of
+    fused_multi_transformer_int8_op.cu."""
+    z = jnp.zeros((), jnp.int32)
+    p = pos.astype(jnp.int32).reshape(())
+    amax = jnp.max(jnp.abs(upd.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax, 1e-9) / 127.0          # [B, l, H]
+    q = jnp.clip(jnp.round(upd.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    buf = jax.lax.dynamic_update_slice(buf, q, (z, p, z, z))
+    sbuf = jax.lax.dynamic_update_slice(
+        sbuf, scale.astype(sbuf.dtype), (z, p, z))
+    return buf, sbuf
+
+
+register_op("kv_cache_update_q8", _kv_update_q8_fwd, nondiff=True)
+
+
+def _kv_dequant_fwd(buf, sbuf, out_dtype="bfloat16"):
+    """int8 codes + scales -> float K/V; XLA fuses the convert+scale
+    into the attention matmul's operand read, so HBM traffic stays
+    int8-sized."""
+    return (buf.astype(jnp.float32)
+            * sbuf.astype(jnp.float32)[..., None]) \
+        .astype(jnp.dtype(out_dtype))
+
+
+register_op("kv_dequant", _kv_dequant_fwd, nondiff=True)
 
 
 def _window_mask_fwd(pos, l, lmax):
@@ -75,12 +112,29 @@ register_op("window_causal_mask", _window_mask_fwd, nondiff=True)
 
 
 def init_decode_caches(n_layers, batch_size, max_len, n_kv_heads,
-                       head_dim, dtype=None):
-    """Fresh zeroed caches (list of DecodeCache, one per layer)."""
+                       head_dim, dtype=None, quantized=False):
+    """Fresh zeroed caches (list of DecodeCache, one per layer).
+    quantized=True builds the int8 cache (codes + per-position-head
+    scales)."""
     if dtype is None:
         dtype = dtypes.get_default_dtype().np_dtype
     caches = []
     for _ in range(n_layers):
+        if quantized:
+            k = Tensor(jnp.zeros(
+                (batch_size, max_len, n_kv_heads, head_dim), jnp.int8),
+                stop_gradient=True)
+            v = Tensor(jnp.zeros(
+                (batch_size, max_len, n_kv_heads, head_dim), jnp.int8),
+                stop_gradient=True)
+            ks = Tensor(jnp.zeros((batch_size, max_len, n_kv_heads),
+                                  jnp.float32), stop_gradient=True)
+            vs = Tensor(jnp.zeros((batch_size, max_len, n_kv_heads),
+                                  jnp.float32), stop_gradient=True)
+            caches.append(DecodeCache(
+                k, v, Tensor(jnp.zeros((), jnp.int32),
+                             stop_gradient=True), ks, vs))
+            continue
         k = Tensor(jnp.zeros((batch_size, max_len, n_kv_heads, head_dim),
                              dtype), stop_gradient=True)
         v = Tensor(jnp.zeros((batch_size, max_len, n_kv_heads, head_dim),
@@ -117,8 +171,16 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
     """
     from ..nn import functional as F
     from ..ops import manipulation
-    k_buf = apply_op("kv_cache_update", cache.k, k_new, cache.pos)
-    v_buf = apply_op("kv_cache_update", cache.v, v_new, cache.pos)
+    quant = cache.k_scale is not None
+    if quant:
+        k_buf, ks_buf = apply_op("kv_cache_update_q8", cache.k,
+                                 cache.k_scale, k_new, cache.pos)
+        v_buf, vs_buf = apply_op("kv_cache_update_q8", cache.v,
+                                 cache.v_scale, v_new, cache.pos)
+    else:
+        k_buf = apply_op("kv_cache_update", cache.k, k_new, cache.pos)
+        v_buf = apply_op("kv_cache_update", cache.v, v_new, cache.pos)
+        ks_buf = vs_buf = None
     l, lmax = q.shape[1], k_buf.shape[1]
     mask = apply_op("window_causal_mask", cache.pos,
                     attrs=dict(l=int(l), lmax=int(lmax)))
@@ -131,15 +193,40 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
         while m.ndim < 4:
             m = manipulation.unsqueeze(m, axis=0)
         mask = apply_op("decode_merge_mask", mask, m)
-    kf, vf = k_buf, v_buf
-    n_rep = q.shape[2] // k_buf.shape[2]
+    if quant:
+        out_dt = str(q._value.dtype)
+        kf = apply_op("kv_dequant", k_buf, ks_buf,
+                      attrs=dict(out_dtype=out_dt))
+        vf = apply_op("kv_dequant", v_buf, vs_buf,
+                      attrs=dict(out_dtype=out_dt))
+    else:
+        kf, vf = k_buf, v_buf
+    n_rep = q.shape[2] // kf.shape[2]
     if n_rep > 1:
-        kf = manipulation.repeat_interleave(k_buf, n_rep, axis=2)
-        vf = manipulation.repeat_interleave(v_buf, n_rep, axis=2)
+        kf = manipulation.repeat_interleave(kf, n_rep, axis=2)
+        vf = manipulation.repeat_interleave(vf, n_rep, axis=2)
     out = F.scaled_dot_product_attention(
         q, kf, vf, attn_mask=mask, dropout_p=dropout_p, is_causal=False,
         training=training)
-    return out, DecodeCache(k_buf, v_buf, cache.pos + l)
+    return out, DecodeCache(k_buf, v_buf, cache.pos + l, ks_buf, vs_buf)
+
+
+def _pack_caches(caches):
+    """DecodeCache list -> loop-carry pytree: per layer
+    (k, v, k_scale|None, v_scale|None). None entries keep the pytree
+    structure identical whether or not the int8 cache is active."""
+    return tuple(
+        (c.k._value, c.v._value,
+         None if c.k_scale is None else c.k_scale._value,
+         None if c.v_scale is None else c.v_scale._value)
+        for c in caches)
+
+
+def _unpack_caches(ct, pos):
+    return [DecodeCache(Tensor(k), Tensor(v), Tensor(pos),
+                        None if ks is None else Tensor(ks),
+                        None if vs is None else Tensor(vs))
+            for k, v, ks, vs in ct]
 
 
 def _top_p_filter(logits, p):
@@ -181,9 +268,14 @@ class CompiledGenerator:
     def __init__(self, model, cache_spec, temperature=1.0, top_k=None,
                  eos_token_id=None, pad_token_id=0, top_p=None,
                  decode_strategy=None, num_beams=4, length_penalty=0.0,
-                 num_return_sequences=1):
+                 num_return_sequences=1, kv_cache_dtype=None):
         self.model = model
         self.n_layers, self.n_kv, self.head_dim = cache_spec
+        if kv_cache_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be None (model dtype) or 'int8', "
+                f"got {kv_cache_dtype!r}")
+        self.kv_int8 = kv_cache_dtype == "int8"
         self.temperature = float(temperature)
         self.top_k = top_k
         self.top_p = top_p
@@ -243,44 +335,61 @@ class CompiledGenerator:
                     t._value = v
                 caches = init_decode_caches(
                     self.n_layers, batch, max_len, self.n_kv,
-                    self.head_dim, dtype=fp)
+                    self.head_dim, dtype=fp, quantized=self.kv_int8)
                 logits_t, caches = model(Tensor(prompt), caches=caches)
                 last = logits_t._value[:, -1, :].astype(jnp.float32)
-                ck = tuple(c.k._value for c in caches)
-                cv = tuple(c.v._value for c in caches)
+                ct = _pack_caches(caches)
                 out0 = jnp.full((batch, max_new), pad, prompt.dtype)
                 done0 = jnp.zeros((batch,), bool)
 
-                def cond(carry):
-                    i, _, _, _, _, _, done = carry
-                    return (i < max_new) & ~jnp.all(done)
-
-                def body(carry):
-                    i, last, ck, cv, out, key, done = carry
+                def step_token(i, last, ct, out, key, done):
                     key, sub = jax.random.split(key)
                     nxt = self._sample(last, sub).astype(out.dtype)
-                    nxt = jnp.where(done, jnp.asarray(pad, out.dtype),
-                                    nxt)
+                    if eos is not None:
+                        nxt = jnp.where(done,
+                                        jnp.asarray(pad, out.dtype),
+                                        nxt)
                     out = jax.lax.dynamic_update_slice(
                         out, nxt[:, None], (jnp.int32(0), i))
                     if eos is not None:
                         done = done | (nxt == eos)
                     pos = prompt_len + i
-                    caches = [DecodeCache(Tensor(k), Tensor(v),
-                                          Tensor(pos))
-                              for k, v in zip(ck, cv)]
+                    caches = _unpack_caches(ct, pos)
                     lg, caches = model(Tensor(nxt[:, None]),
                                        caches=caches)
                     last = lg._value[:, -1, :].astype(jnp.float32)
-                    ck = tuple(c.k._value for c in caches)
-                    cv = tuple(c.v._value for c in caches)
-                    return (i + jnp.int32(1), last, ck, cv, out, key,
+                    return last, _pack_caches(caches), out, key, done
+
+                if eos is None:
+                    # no early exit possible: lax.scan's static trip
+                    # count lets XLA schedule the loop tighter than
+                    # while_loop (decode_roofline.py loop64 probe)
+                    def body(carry, i):
+                        last, ct, out, key, done = carry
+                        return step_token(i, last, ct, out, key,
+                                          done), None
+
+                    (last, ct, out, key, done), _ = jax.lax.scan(
+                        body, (last, ct, out0, key, done0),
+                        jnp.arange(max_new, dtype=jnp.int32))
+                    return out
+
+                def cond(carry):
+                    i = carry[0]
+                    done = carry[5]
+                    return (i < max_new) & ~jnp.all(done)
+
+                def body(carry):
+                    i, last, ct, out, key, done = carry
+                    last, ct, out, key, done = step_token(
+                        i, last, ct, out, key, done)
+                    return (i + jnp.int32(1), last, ct, out, key,
                             done)
 
                 final = jax.lax.while_loop(
                     cond, body,
-                    (jnp.int32(0), last, ck, cv, out0, key, done0))
-                return final[4]
+                    (jnp.int32(0), last, ct, out0, key, done0))
+                return final[3]
             finally:
                 for t, v in zip(state_tensors, originals):
                     t._value = v
@@ -320,12 +429,11 @@ class CompiledGenerator:
                 prompt_k = jnp.repeat(prompt, K, axis=0)  # [B*K, L]
                 caches = init_decode_caches(
                     self.n_layers, BK, max_len, self.n_kv,
-                    self.head_dim, dtype=fp)
+                    self.head_dim, dtype=fp, quantized=self.kv_int8)
                 logits_t, caches = model(Tensor(prompt_k), caches=caches)
                 last = logits_t._value[:, -1, :].astype(jnp.float32)
                 V = last.shape[-1]
-                ck = tuple(c.k._value for c in caches)
-                cv = tuple(c.v._value for c in caches)
+                ct = _pack_caches(caches)
                 # beam 0 live, beams 1..K-1 muted so step 1 spreads over
                 # the top-K tokens of the (identical) distributions
                 scores0 = jnp.tile(
@@ -342,11 +450,11 @@ class CompiledGenerator:
 
                 def cond(carry):
                     i = carry[0]
-                    done = carry[6]
+                    done = carry[5]
                     return (i < max_new) & ~jnp.all(done)
 
                 def body(carry):
-                    (i, last, ck, cv, tokens, scores, done, lens) = carry
+                    (i, last, ct, tokens, scores, done, lens) = carry
                     logp = jax.nn.log_softmax(
                         last.reshape(batch, K, V), axis=-1)
                     logp = jnp.where(done[:, :, None], pad_row[None, None],
@@ -370,28 +478,28 @@ class CompiledGenerator:
                     if eos is not None:
                         done = done | (tok == eos)
                     scores = top_val
-                    # flat gather reorders the KV caches to parent beams
+                    # flat gather reorders the KV caches (and their int8
+                    # scales, when present) to parent beams
                     flat = (jnp.arange(batch, dtype=jnp.int32)[:, None]
                             * K + beam_src).reshape(-1)
-                    ck = tuple(jnp.take(k, flat, axis=0) for k in ck)
-                    cv = tuple(jnp.take(v, flat, axis=0) for v in cv)
+                    ct = tuple(
+                        tuple(None if a is None
+                              else jnp.take(a, flat, axis=0)
+                              for a in layer)
+                        for layer in ct)
                     pos = prompt_len + i
-                    caches = [DecodeCache(Tensor(k), Tensor(v),
-                                          Tensor(pos))
-                              for k, v in zip(ck, cv)]
+                    caches = _unpack_caches(ct, pos)
                     lg, caches = model(Tensor(tok.reshape(BK, 1)),
                                        caches=caches)
                     last = lg._value[:, -1, :].astype(jnp.float32)
-                    ck = tuple(c.k._value for c in caches)
-                    cv = tuple(c.v._value for c in caches)
-                    return (i + jnp.int32(1), last, ck, cv, tokens,
-                            scores, done, lens)
+                    return (i + jnp.int32(1), last, _pack_caches(caches),
+                            tokens, scores, done, lens)
 
                 final = jax.lax.while_loop(
                     cond, body,
-                    (jnp.int32(0), last, ck, cv, tokens0, scores0,
+                    (jnp.int32(0), last, ct, tokens0, scores0,
                      done0, len0))
-                tokens, scores, lens = final[4], final[5], final[7]
+                tokens, scores, lens = final[3], final[4], final[6]
                 norm = scores / jnp.maximum(
                     lens.astype(jnp.float32), 1.0) ** lp
                 nret = self.num_return_sequences

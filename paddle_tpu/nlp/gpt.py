@@ -247,6 +247,8 @@ class GPTForCausalLM(nn.Layer):
         super().__init__()
         self.gpt = GPTModel(config)
         self.config = config
+        self._qhead_algo = None
+        self._qhead_group = None
 
     def forward(self, input_ids, position_ids=None, labels=None,
                 caches=None):
@@ -256,14 +258,41 @@ class GPTForCausalLM(nn.Layer):
                                      caches=caches)
         else:
             h = self.gpt(input_ids, position_ids)
-        w = self.gpt.embeddings.word_embeddings.weight
-        logits = linalg.matmul(h, w, transpose_y=True)
+        if self._qhead_algo is not None:
+            # weight-only quantized LM head (nn.quant): the vocab-sized
+            # matmul streams int8/int4 from HBM — the decode hot spot
+            from ..nn.quant import weight_only_linear
+            logits = weight_only_linear(
+                h, self.qhead_weight, None, self.qhead_scale,
+                weight_dtype=("int4" if "int4" in self._qhead_algo
+                              else "int8"),
+                in_features=self.config.hidden_size,
+                group_size=self._qhead_group)
+        else:
+            w = self.gpt.embeddings.word_embeddings.weight
+            logits = linalg.matmul(h, w, transpose_y=True)
         if labels is not None:
             loss = F.cross_entropy(logits, labels)
             return loss
         if caches is not None:
             return logits, new_caches
         return logits
+
+    def attach_quantized_head(self, algo="weight_only_int8",
+                              group_size=None):
+        """Quantize the tied LM head (logits = h @ E^T) for decode: the
+        transposed embedding is stored int8/int4 as buffers so the
+        compiled generator streams the narrow weight (nn.quant)."""
+        from ..nn.quant import weight_quantize
+        w = self.gpt.embeddings.word_embeddings.weight  # [V, H]
+        wt = np.ascontiguousarray(np.asarray(w.numpy()).T)  # [H, V]
+        if algo == "llm.int8":
+            algo = "weight_only_int8"  # same storage; see WeightOnlyLinear
+        q, s = weight_quantize(wt, algo=algo, group_size=group_size)
+        self.register_buffer("qhead_weight", q)
+        self.register_buffer("qhead_scale", s)
+        self._qhead_algo = algo
+        self._qhead_group = group_size
 
     def init_caches(self, batch_size):
         """Empty KV caches for incremental decoding."""
@@ -288,7 +317,7 @@ class GPTForCausalLM(nn.Layer):
                  top_k=None, top_p=None, eos_token_id=None,
                  pad_token_id=0, decode_strategy=None, num_beams=4,
                  length_penalty=0.0, num_return_sequences=1,
-                 use_compiled=True):
+                 use_compiled=True, kv_cache_dtype=None):
         """Autoregressive decoding with KV cache.
 
         Default path: one compiled XLA program (static cache +
@@ -306,7 +335,8 @@ class GPTForCausalLM(nn.Layer):
             from .generation import CompiledGenerator
             key = (float(temperature), top_k, top_p, eos_token_id,
                    int(pad_token_id), decode_strategy, int(num_beams),
-                   float(length_penalty), int(num_return_sequences))
+                   float(length_penalty), int(num_return_sequences),
+                   kv_cache_dtype)
             gens = getattr(self, "_compiled_generators", None)
             if gens is None:
                 gens = self._compiled_generators = {}
@@ -318,7 +348,8 @@ class GPTForCausalLM(nn.Layer):
                     eos_token_id=eos_token_id, pad_token_id=pad_token_id,
                     decode_strategy=decode_strategy, num_beams=num_beams,
                     length_penalty=length_penalty,
-                    num_return_sequences=num_return_sequences)
+                    num_return_sequences=num_return_sequences,
+                    kv_cache_dtype=kv_cache_dtype)
                 gens[key] = gen
             return gen(input_ids, max_new_tokens)
         from ..ops import manipulation, creation

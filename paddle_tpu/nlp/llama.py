@@ -258,13 +258,15 @@ class LlamaForCausalLM(nn.Layer):
     def generate(self, input_ids, max_new_tokens=16, temperature=1.0,
                  top_k=None, top_p=None, eos_token_id=None,
                  pad_token_id=0, decode_strategy=None, num_beams=4,
-                 length_penalty=0.0, num_return_sequences=1):
+                 length_penalty=0.0, num_return_sequences=1,
+                 kv_cache_dtype=None):
         """Compiled autoregressive decoding (one XLA program: static KV
         cache + lax.while_loop with EOS early exit — nlp/generation.py)."""
         from .generation import CompiledGenerator
         key = (float(temperature), top_k, top_p, eos_token_id,
                int(pad_token_id), decode_strategy, int(num_beams),
-               float(length_penalty), int(num_return_sequences))
+               float(length_penalty), int(num_return_sequences),
+               kv_cache_dtype)
         gens = getattr(self, "_compiled_generators", None)
         if gens is None:
             gens = self._compiled_generators = {}
@@ -276,6 +278,7 @@ class LlamaForCausalLM(nn.Layer):
                 pad_token_id=pad_token_id,
                 decode_strategy=decode_strategy, num_beams=num_beams,
                 length_penalty=length_penalty,
-                num_return_sequences=num_return_sequences)
+                num_return_sequences=num_return_sequences,
+                kv_cache_dtype=kv_cache_dtype)
             gens[key] = gen
         return gen(input_ids, max_new_tokens)

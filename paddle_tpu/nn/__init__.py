@@ -16,3 +16,4 @@ from .clip import (  # noqa: F401
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from . import utils  # noqa: F401
+from . import quant  # noqa: F401

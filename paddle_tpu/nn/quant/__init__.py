@@ -1,0 +1,290 @@
+"""Quantized compute for inference: weight-only int8/int4 streaming and
+native-int8 matmuls.
+
+Reference analogue: the int8 inference stack under
+/root/reference/paddle/fluid/operators/fused/ —
+fused_multi_transformer_int8_op.cu (int8 decoder layer),
+attn_gemm_int8.h (quantize-dequantize GEMM wrapper), and
+quant_dequant_kernel.h (per-channel scale kernels). The reference
+reaches int8 through hand-written CUDA epilogues; on TPU the same two
+wins map to XLA-fusable graph patterns:
+
+- weight-only (int8/int4): weights live in HBM as int8 (or two int4
+  nibbles per byte) and are dequantized INTO the matmul — XLA fuses the
+  `convert+multiply` into the operand read, so the HBM stream shrinks
+  2x/4x. This is the decode-time win: autoregressive decoding is
+  weight-bandwidth-bound (see BASELINE.md decode roofline).
+- llm.int8-style dynamic activation quant: per-token abs-max quantizes
+  activations to int8 and `lax.dot_general(int8, int8) -> int32`
+  engages the MXU's native int8 rate; outputs rescale by
+  (x_scale * w_scale). This is the compute win for large-batch prefill.
+
+All ops are registered in the dispatch registry so they run eagerly,
+under jit, and inside the compiled decode loop identically.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import register_op
+from ...core.tensor import Tensor, Parameter
+from ...ops._helpers import apply_op, as_tensor
+from ..layer.layers import Layer
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear", "WeightOnlyLinear", "quantize_for_decode"]
+
+
+# -- packing helpers (host-side, numpy) ------------------------------------
+
+def _pack_int4_cols(q):
+    """[in, out] int4 values in [-8,7] -> [ceil(in/2), out] int8 bytes,
+    row i holds rows 2i (low nibble) and 2i+1 (high nibble)."""
+    n = q.shape[0]
+    if n % 2:
+        q = np.concatenate([q, np.zeros((1,) + q.shape[1:], np.int8)])
+    lo = q[0::2] & 0x0F
+    hi = (q[1::2] & 0x0F) << 4
+    return (lo | hi).astype(np.int8)
+
+
+def weight_quantize(weight, algo="weight_only_int8", group_size=None):
+    """Quantize a [in, out] weight for weight-only inference.
+
+    Returns (quant_weight, scale):
+      - int8: quant [in, out] int8, scale [out] f32 (per-channel absmax)
+      - int4: quant [ceil(in/2), out] int8 (packed nibbles);
+        group_size groups the in-dim with one scale per (group, out):
+        scale [in/group, out] f32, else [out].
+    """
+    w = np.asarray(weight.numpy() if isinstance(weight, Tensor)
+                   else weight, np.float32)
+    if algo == "weight_only_int8":
+        scale = np.maximum(np.abs(w).max(axis=0), 1e-9) / 127.0
+        q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+        return (Tensor(jnp.asarray(q)),
+                Tensor(jnp.asarray(scale.astype(np.float32))))
+    if algo == "weight_only_int4":
+        if group_size:
+            g = int(group_size)
+            if w.shape[0] % g:
+                raise ValueError(f"in_features {w.shape[0]} not "
+                                 f"divisible by group_size {g}")
+            wg = w.reshape(w.shape[0] // g, g, w.shape[1])
+            scale = np.maximum(np.abs(wg).max(axis=1), 1e-9) / 7.0
+            q = np.clip(np.round(wg / scale[:, None, :]), -8, 7) \
+                .reshape(w.shape).astype(np.int8)
+        else:
+            scale = np.maximum(np.abs(w).max(axis=0), 1e-9) / 7.0
+            q = np.clip(np.round(w / scale), -8, 7).astype(np.int8)
+        packed = _pack_int4_cols(q)
+        return (Tensor(jnp.asarray(packed)),
+                Tensor(jnp.asarray(scale.astype(np.float32))))
+    raise ValueError(f"unknown algo {algo!r}; expected "
+                     "'weight_only_int8' or 'weight_only_int4'")
+
+
+def _unpack4_fwd(packed, rows):
+    """Packed nibble bytes -> int8 rows (sign-extended), on device so
+    XLA fuses the unpack into the consumer."""
+    p = packed.astype(jnp.uint8)
+    lo = (p & 0x0F).astype(jnp.int8)
+    hi = ((p >> 4) & 0x0F).astype(jnp.int8)
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    out = jnp.stack([lo, hi], axis=1).reshape(
+        (p.shape[0] * 2,) + p.shape[1:])
+    return out[:rows]
+
+
+register_op("wq_unpack_int4", _unpack4_fwd, nondiff=True)
+
+
+def weight_dequantize(quant_weight, scale, algo="weight_only_int8",
+                      in_features=None, group_size=None,
+                      out_dtype="float32"):
+    """Inverse of weight_quantize (up to rounding)."""
+    q = as_tensor(quant_weight)
+    s = as_tensor(scale)
+    if algo == "weight_only_int4":
+        rows = in_features if in_features is not None \
+            else q.shape[0] * 2
+        q = apply_op("wq_unpack_int4", q, attrs=dict(rows=int(rows)))
+    return apply_op("wq_dequant", q, s,
+                    attrs=dict(group_size=group_size,
+                               out_dtype=str(out_dtype)))
+
+
+def _dequant_fwd(q, scale, group_size=None, out_dtype="float32"):
+    dt = jnp.dtype(out_dtype)
+    if scale.ndim == 2 and group_size:
+        g = int(group_size)
+        wq = q.reshape(q.shape[0] // g, g, q.shape[1]).astype(jnp.float32)
+        w = wq * scale[:, None, :]
+        return w.reshape(q.shape).astype(dt)
+    return (q.astype(jnp.float32) * scale).astype(dt)
+
+
+register_op("wq_dequant", _dequant_fwd, nondiff=True)
+
+
+def _wo_linear_fwd(x, q, scale, rows=None, group_size=None):
+    """Weight-only matmul: dequantize fuses into the weight read.
+
+    x: [..., in] float; q: int8 [in, out] or packed [in/2, out];
+    scale: [out] or [in/group, out] f32. Compute dtype follows x.
+    """
+    if rows is not None and q.shape[0] != rows:
+        q = _unpack4_fwd(q, rows)
+    if scale.ndim == 2 and group_size:
+        g = int(group_size)
+        wq = q.reshape(q.shape[0] // g, g, q.shape[1]) \
+            .astype(jnp.float32)
+        w = (wq * scale[:, None, :]).reshape(
+            q.shape[0], q.shape[1]).astype(x.dtype)
+    else:
+        w = (q.astype(jnp.float32) * scale).astype(x.dtype)
+    return jnp.matmul(x, w)
+
+
+def _wo_linear_bwd(attrs, inputs, outputs, cts):
+    # inference-oriented: grad flows to the activation only (the int
+    # weight is not a training parameter)
+    x, q, scale = inputs[0], inputs[1], inputs[2]
+    (ct,) = cts
+    rows = attrs.get("rows")
+    gs = attrs.get("group_size")
+    if rows is not None and q.shape[0] != rows:
+        q = _unpack4_fwd(q, rows)
+    if scale.ndim == 2 and gs:
+        g = int(gs)
+        wq = q.reshape(q.shape[0] // g, g, q.shape[1]) \
+            .astype(jnp.float32)
+        w = (wq * scale[:, None, :]).reshape(
+            q.shape[0], q.shape[1]).astype(ct.dtype)
+    else:
+        w = (q.astype(jnp.float32) * scale).astype(ct.dtype)
+    return (jnp.matmul(ct, w.T), None, None)
+
+
+register_op("weight_only_matmul", _wo_linear_fwd, bwd=_wo_linear_bwd)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", in_features=None,
+                       group_size=None):
+    """y = x @ dequant(weight, scale) (+ bias): the weight stream is
+    int8 (or packed int4) in HBM; XLA fuses the dequant into the matmul
+    operand. weight_dtype: 'int8' | 'int4'."""
+    x = as_tensor(x)
+    q = as_tensor(weight)
+    s = as_tensor(weight_scale)
+    rows = None
+    if weight_dtype == "int4":
+        rows = int(in_features if in_features is not None
+                   else q.shape[0] * 2)
+    out = apply_op("weight_only_matmul", x, q, s,
+                   attrs=dict(rows=rows, group_size=group_size))
+    if bias is not None:
+        out = out + as_tensor(bias)
+    return out
+
+
+def _llm_int8_fwd(x, q, scale, threshold=6.0):
+    """Dynamic per-token int8 activation quant + int8xint8 MXU matmul.
+
+    The reference's attn_gemm_int8.h quantizes activations per tensor
+    with a precomputed scale; per-token absmax (computed on device, one
+    row reduction) is the accuracy-safer variant and still engages the
+    int32-accumulating int8 dot.
+    """
+    del threshold  # outlier split not needed at these scales
+    xs = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                 keepdims=True) / 127.0
+    xs = jnp.maximum(xs, 1e-9)
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) / xs), -127,
+                  127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, q, (((xq.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return (acc.astype(jnp.float32) * xs
+            * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+register_op("llm_int8_matmul", _llm_int8_fwd, nondiff=True)
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """int8 activations x int8 weights on the MXU (int32 accumulate),
+    per-token dynamic activation scales (reference:
+    fused_multi_transformer_int8_op.cu)."""
+    x = as_tensor(x)
+    out = apply_op("llm_int8_matmul", x, as_tensor(weight),
+                   as_tensor(weight_scale),
+                   attrs=dict(threshold=float(threshold)))
+    if bias is not None:
+        out = out + as_tensor(bias)
+    return out
+
+
+class WeightOnlyLinear(Layer):
+    """Drop-in inference replacement for nn.Linear: holds the int8 /
+    packed-int4 weight + scales; forward streams the narrow weight.
+
+    algo: 'weight_only_int8' | 'weight_only_int4' | 'llm.int8'
+    """
+
+    def __init__(self, linear, algo="weight_only_int8", group_size=None):
+        super().__init__()
+        w = linear.weight
+        self.in_features = int(w.shape[0])
+        self.out_features = int(w.shape[1])
+        self.algo = algo
+        self.group_size = group_size
+        quant_algo = ("weight_only_int8" if algo == "llm.int8"
+                      else algo)
+        q, s = weight_quantize(w, algo=quant_algo,
+                               group_size=group_size)
+        self.quant_weight = Parameter(q._value, trainable=False)
+        self.weight_scale = Parameter(s._value, trainable=False)
+        self.bias = linear.bias  # shared; may be None
+
+    def forward(self, x):
+        if self.algo == "llm.int8":
+            return llm_int8_linear(x, self.quant_weight, self.bias,
+                                   self.weight_scale)
+        wd = "int4" if self.algo == "weight_only_int4" else "int8"
+        return weight_only_linear(
+            x, self.quant_weight, self.bias, self.weight_scale,
+            weight_dtype=wd, in_features=self.in_features,
+            group_size=self.group_size)
+
+
+def quantize_for_decode(model, algo="weight_only_int8", group_size=None,
+                        quantize_head=True):
+    """Swap every nn.Linear in `model` for WeightOnlyLinear (true int8/
+    int4 HBM streams, unlike quantization.quantize_weights_* which
+    rebinds a dequantized copy) and, for causal LMs with a tied LM head
+    (GPT/Llama: logits = h @ E^T), attach a quantized head so the
+    vocab-sized matmul streams int8 too. Returns the count of swapped
+    layers."""
+    from ..layer.common import Linear
+    count = 0
+
+    def swap(layer):
+        nonlocal count
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, Linear):
+                layer._sub_layers[name] = WeightOnlyLinear(
+                    sub, algo=algo, group_size=group_size)
+                count += 1
+            else:
+                swap(sub)
+
+    swap(model)
+    if quantize_head and hasattr(model, "attach_quantized_head"):
+        model.attach_quantized_head(algo=algo, group_size=group_size)
+    return count

@@ -2,35 +2,32 @@
 
 The reference delegates to the external `paddle2onnx` package. This
 build has neither `paddle2onnx` nor `onnx` installed (and no network to
-fetch them), so the API exists but is dependency-gated with the
-documented alternative: `paddle.jit.save` produces a portable StableHLO
-artifact — the exchange format of the XLA ecosystem — reloadable from
+fetch them), so the exporter is self-contained: the layer's forward is
+traced to a jaxpr (the same functionalization paddle.jit.save uses) and
+the inference-subset primitives — matmul, conv, activations, norms,
+pooling, shape ops — are mapped to ONNX opset-11 nodes, serialized with
+a dependency-free protobuf wire-format writer (_proto.py).
+
+Models using primitives outside that subset raise a NotImplementedError
+naming the primitive, with the documented full-fidelity alternative:
+`paddle.jit.save` exports a portable StableHLO artifact loadable from
 Python (`paddle.jit.load`, `paddle.inference`) or any StableHLO
 consumer (IREE, XLA AOT).
 """
 from __future__ import annotations
 
-import importlib.util
-
 __all__ = ["export"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
+def export(layer, path, input_spec=None, opset_version=11, **configs):
     """Export `layer` to ONNX at `path`.onnx (reference signature).
 
-    Requires the optional `paddle2onnx`/`onnx` dependencies; without
-    them this raises with the StableHLO alternative spelled out.
-    """
-    missing = [m for m in ("onnx",)
-               if importlib.util.find_spec(m) is None]
-    if missing:
-        raise NotImplementedError(
-            f"paddle.onnx.export needs the optional {missing} "
-            "package(s), which are not installed in this TPU build "
-            "(no network egress). Portable alternative: "
-            "paddle.jit.save(layer, path, input_spec) exports a "
-            "StableHLO artifact loadable via paddle.jit.load / "
-            "paddle.inference or any StableHLO consumer.")
-    raise NotImplementedError(
-        "StableHLO->ONNX conversion is not implemented; use the "
-        "StableHLO artifact from paddle.jit.save directly.")
+    input_spec: list of paddle.static.InputSpec (shape/dtype/name) —
+    required (ONNX graphs are fixed-signature, like jit.save)."""
+    if input_spec is None:
+        raise ValueError(
+            "paddle.onnx.export requires input_spec (a list of "
+            "paddle.static.InputSpec) to fix the graph signature")
+    from ._export import export_onnx
+    return export_onnx(layer, path, input_spec,
+                       opset_version=opset_version)

@@ -1,0 +1,129 @@
+"""Minimal protobuf wire-format encoder/decoder for the ONNX subset.
+
+The image ships neither the `onnx` package nor an onnx.proto to compile
+(and protoc-3.21 gencode is incompatible with the installed
+protobuf-6.x runtime), so the exporter serializes ModelProto directly
+in the protobuf wire format. Field numbers follow the public, frozen
+onnx.proto3 schema (onnx/onnx.proto; stable since IR version 3):
+
+  ModelProto:    ir_version=1, producer_name=2, producer_version=3,
+                 model_version=5, doc_string=6, graph=7, opset_import=8
+  OperatorSetId: domain=1, version=2
+  GraphProto:    node=1, name=2, initializer=5, doc_string=10,
+                 input=11, output=12, value_info=13
+  NodeProto:     input=1, output=2, name=3, op_type=4, attribute=5,
+                 doc_string=6, domain=7
+  AttributeProto:name=1, f=2, i=3, s=4, t=5, floats=7, ints=8, type=20
+                 (FLOAT=1, INT=2, STRING=3, TENSOR=4, FLOATS=6, INTS=7)
+  TensorProto:   dims=1, data_type=2, float_data=4, int32_data=5,
+                 int64_data=7, name=8, raw_data=9
+                 (FLOAT=1, UINT8=2, INT8=3, INT32=6, INT64=7, BOOL=9,
+                  FLOAT16=10, DOUBLE=11, BFLOAT16=16)
+  ValueInfoProto:name=1, type=2
+  TypeProto:     tensor_type=1;  Tensor: elem_type=1, shape=2
+  TensorShapeProto: dim=1;  Dimension: dim_value=1, dim_param=2
+
+The decoder below parses the same subset back for round-trip tests.
+"""
+from __future__ import annotations
+
+import struct
+
+# -- wire-format primitives -------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64  # two's-complement 64-bit (negative ints)
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def f_varint(field: int, value: int) -> bytes:
+    return tag(field, 0) + _varint(int(value))
+
+
+def f_float(field: int, value: float) -> bytes:
+    return tag(field, 5) + struct.pack("<f", float(value))
+
+
+def f_bytes(field: int, value) -> bytes:
+    if isinstance(value, str):
+        value = value.encode("utf-8")
+    return tag(field, 2) + _varint(len(value)) + value
+
+
+def f_msg(field: int, payload: bytes) -> bytes:
+    return f_bytes(field, payload)
+
+
+def f_packed_varints(field: int, values) -> bytes:
+    payload = b"".join(_varint(int(v)) for v in values)
+    return f_bytes(field, payload)
+
+
+def f_packed_floats(field: int, values) -> bytes:
+    payload = b"".join(struct.pack("<f", float(v)) for v in values)
+    return f_bytes(field, payload)
+
+
+# -- decoder (for round-trip verification) ----------------------------------
+
+
+def read_varint(buf, i):
+    shift = 0
+    val = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return val, i
+        shift += 7
+
+
+def parse_message(buf):
+    """-> dict field_number -> list of (wire_type, value). value is an
+    int for varint fields, bytes for length-delimited, float for
+    fixed32."""
+    fields: dict = {}
+    i = 0
+    while i < len(buf):
+        key, i = read_varint(buf, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val, i = read_varint(buf, i)
+        elif wire == 2:
+            ln, i = read_varint(buf, i)
+            val = bytes(buf[i:i + ln])
+            i += ln
+        elif wire == 5:
+            val = struct.unpack("<f", buf[i:i + 4])[0]
+            i += 4
+        elif wire == 1:
+            val = struct.unpack("<d", buf[i:i + 8])[0]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        fields.setdefault(field, []).append((wire, val))
+    return fields
+
+
+def one(fields, n, default=None):
+    v = fields.get(n)
+    return v[0][1] if v else default
+
+
+def many(fields, n):
+    return [v for _, v in fields.get(n, [])]

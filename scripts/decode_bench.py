@@ -72,6 +72,45 @@ def main():
         "vs_baseline": 0.0,
     }))
 
+    # weight-only quantized decode (nn.quant): int8/int4 weight streams.
+    # Decode is weight-bandwidth-bound (BASELINE.md roofline), so
+    # narrowing the weight stream converts directly into tokens/s.
+    bf16_out = out.numpy()
+    runs = (
+        # (weight algo, group, kv dtype, tag)
+        (None, None, "int8", "kv8"),
+        ("weight_only_int8", None, None, "int8"),
+        ("weight_only_int8", None, "int8", "int8+kv8"),
+    )
+    for algo, gsz, kvdt, tag in runs:
+        from paddle_tpu.nn import quant as nnq
+        paddle.seed(0)
+        qmodel = GPTForCausalLM(cfg)
+        qmodel.to(dtype="bfloat16")
+        if algo is not None:
+            nnq.quantize_for_decode(qmodel, algo=algo, group_size=gsz)
+        qout = qmodel.generate(prompt, max_new_tokens=new_tokens,
+                               kv_cache_dtype=kvdt)
+        qnp = qout.numpy()
+        agree = float((qnp[:, prompt_len:] ==
+                       bf16_out[:, prompt_len:]).mean())
+        best_q = float("inf")
+        for _ in range(3 if on_tpu else 1):
+            t0 = time.perf_counter()
+            qout = qmodel.generate(prompt, max_new_tokens=new_tokens,
+                                   kv_cache_dtype=kvdt)
+            _ = qout.numpy()
+            best_q = min(best_q, time.perf_counter() - t0)
+        print(json.dumps({
+            "metric": f"gpt_decode_{tag}_tokens_per_sec_per_chip",
+            "value": round(batch * new_tokens / best_q, 2),
+            "unit": f"tokens/s ({'tpu' if on_tpu else 'cpu-smoke'}, "
+                    f"{n_params / 1e6:.0f}M params, bs{batch}, {tag}, "
+                    f"greedy-token agreement vs bf16 {agree:.2f})",
+            "vs_baseline": round(best_dt / best_q, 3),
+        }))
+        del qmodel
+
     # compiled beam search (reference: beam_search.cu) — whole search is
     # one XLA program; throughput counted in kept (best-beam) tokens
     beams = 4

@@ -228,6 +228,84 @@ def main():
                        [jnp.copy(a) for a in cv], pos, reps=5)
     note("loop64_per_step_ms", round(t / 64 * 1e3, 3))
 
+    # (7) weights as ARGUMENTS (the generator's shape: state passed to
+    # jit, not closed over) — isolates constant-layout specialization
+    Wflat = Wqkv + Wout + W1 + W2 + [E]
+
+    def loop64_args(ws, x, cks, cvs, p):
+        wqkv, wout, w1, w2 = (ws[:NL], ws[NL:2 * NL], ws[2 * NL:3 * NL],
+                              ws[3 * NL:4 * NL])
+        e = ws[-1]
+
+        def layer(x, i, cks, cvs, p):
+            h = ln(x)
+            qkv = h.reshape(B, H) @ wqkv[i]
+            q, kn, vn = jnp.split(qkv.reshape(B, 1, NH, 3 * D), 3,
+                                  axis=-1)
+            ckb = jax.lax.dynamic_update_slice(
+                cks[i], kn, (0, p.astype(jnp.int32), 0, 0))
+            cvb = jax.lax.dynamic_update_slice(
+                cvs[i], vn, (0, p.astype(jnp.int32), 0, 0))
+            o = attend(q, ckb, cvb, p)
+            x = x + (o.reshape(B, H) @ wout[i]).reshape(B, 1, H)
+            h = ln(x)
+            y = jax.nn.gelu(h.reshape(B, H) @ w1[i], approximate=True)
+            x = x + (y @ w2[i]).reshape(B, 1, H)
+            return x, ckb, cvb
+
+        def body(carry, _):
+            x, cks, cvs, p = carry
+            ncks, ncvs = [], []
+            for i in range(NL):
+                x, a_, b_ = layer(x, i, cks, cvs, p)
+                ncks.append(a_)
+                ncvs.append(b_)
+            logits = (ln(x).reshape(B, H) @ e.T).astype(jnp.float32)
+            nxt = jnp.argmax(logits, axis=-1)
+            x2 = jnp.broadcast_to(
+                ((nxt % 997).astype(jnp.float32) * 1e-3)
+                .astype(x.dtype)[:, None, None], x.shape)
+            return (x2, tuple(ncks), tuple(ncvs), p + 1), nxt
+
+        (x, cks, cvs, p), toks = jax.lax.scan(
+            body, (x, tuple(cks), tuple(cvs), p), None, length=64)
+        return toks, list(cks), list(cvs)
+
+    fn7 = jax.jit(loop64_args, donate_argnums=(2, 3))
+    cks7 = [jnp.copy(a) for a in ck]
+    cvs7 = [jnp.copy(a) for a in cv]
+    for _ in range(2):
+        toks, cks7, cvs7 = fn7(Wflat, x0, cks7, cvs7, pos)
+    jax.block_until_ready((toks, cks7, cvs7))
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        toks, cks7, cvs7 = fn7(Wflat, x0, cks7, cvs7, pos)
+        jax.block_until_ready((toks, cks7, cvs7))
+        best = min(best, time.perf_counter() - t0)
+    note("loop64_weights_as_args_per_step_ms", round(best / 64 * 1e3, 3))
+
+    # (8) logits head alone in the two layouts: [H,V] constant vs
+    # [V,H] argument with transpose (the generator's tied embedding)
+    h_in = rnd(B, H)
+
+    def head_t(w, h, i):
+        return ((h + i.astype(h.dtype) * 0) @ w.T).astype(jnp.float32)
+
+    Evh = rnd(V, H)
+    fn8 = jax.jit(head_t)
+    t = timeit_varying(fn8, lambda i: (Evh, h_in, jnp.float32(i)))
+    note("lm_head_arg_transposed_ms", round(t * 1e3, 3))
+
+    Ehv = rnd(H, V)
+
+    def head_n(w, h, i):
+        return ((h + i.astype(h.dtype) * 0) @ w).astype(jnp.float32)
+
+    fn8b = jax.jit(head_n)
+    t = timeit_varying(fn8b, lambda i: (Ehv, h_in, jnp.float32(i)))
+    note("lm_head_arg_contiguous_ms", round(t * 1e3, 3))
+
     # roofline bookkeeping
     wbytes = sum(int(np.prod(w.shape)) for w in Wqkv + Wout + W1 + W2) * 2
     ebytes = int(np.prod(E.shape)) * 2

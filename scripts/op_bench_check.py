@@ -4,16 +4,21 @@ tools/ci_op_benchmark.sh:1 — per-PR diff of op timings against a
 baseline run, failing on regressions).
 
 Usage: python scripts/op_bench_check.py baseline.json new.json
-       [--threshold 1.4] [--metric host_us]
+       [--threshold 1.3] [--metric wall_us] [--host-threshold 3.0]
+       [--fail-on-host]
 
-Exit 0 when no op regressed beyond threshold x baseline; exit 1 with a
-table of offenders otherwise. New/removed ops are reported but do not
-fail the gate.
+Gate design (measured on the axon-tunneled chip, see STATUS op-bench
+row): per-op host-dispatch timings below ~100us carry tunnel queue
+noise — two identical runs differ 2-10x per op — so `host_us` cannot
+hold a tight threshold there. The PIPELINED wall time (`wall_us`:
+min-of-repeats over a chained 100-op loop with one device sync) is
+stable run-to-run, so it is the PRIMARY gated metric at a tight 1.3x.
+`host_us` stays an advisory check at a loose 3.0x: regressions print
+as warnings (or fail with --fail-on-host on direct-attached devices).
 
-Caveat for tunneled TPUs (axon): host_us below ~100us carries queue
-noise even with op_bench's min-of-repeats — two identical runs can
-differ 2-4x per op. On such machines gate on --metric wall_us or use
---threshold 3.0; on direct-attached devices/CPU the default is sound.
+Exit 0 when no op regressed beyond threshold x baseline on the primary
+metric; exit 1 with a table of offenders otherwise. New/removed ops
+are reported but do not fail the gate.
 """
 from __future__ import annotations
 
@@ -22,48 +27,102 @@ import json
 import sys
 
 
+def find_regressions(base_ops, new_ops, metric, threshold):
+    """-> (regressions, n_compared): [(name, base, new, ratio)] beyond
+    threshold, and how many ops were actually compared (an op missing
+    the metric in either report is NOT compared — callers must check
+    n_compared so a metric-less baseline can't pass vacuously)."""
+    bad = []
+    compared = 0
+    for name, b in sorted(base_ops.items()):
+        n = new_ops.get(name)
+        if n is None or metric not in b or metric not in n:
+            continue
+        compared += 1
+        bv, nv = b[metric], n[metric]
+        ratio = nv / bv if bv else float("inf")
+        if ratio > threshold:
+            bad.append((name, bv, nv, ratio))
+    return bad, compared
+
+
+def run_gate(base, new, threshold=1.3, metric="wall_us",
+             host_threshold=3.0, fail_on_host=False, out=sys.stdout,
+             err=sys.stderr):
+    """Returns the exit code (0 ok, 1 regression)."""
+    if base.get("platform") != new.get("platform"):
+        print(f"WARNING: platform changed "
+              f"{base.get('platform')} -> {new.get('platform')}; "
+              "timings are not comparable", file=err)
+
+    for name, b in sorted(base["ops"].items()):
+        if name not in new["ops"]:
+            print(f"removed: {name}", file=err)
+    for name in sorted(set(new["ops"]) - set(base["ops"])):
+        print(f"new op (no baseline): {name}", file=err)
+
+    # advisory: host dispatch at a loose threshold
+    host_metric = "host_us" if metric != "host_us" else "wall_us"
+    advisory, _ = find_regressions(base["ops"], new["ops"], host_metric,
+                                   host_threshold)
+    for name, bv, nv, r in sorted(advisory, key=lambda x: -x[3]):
+        print(f"advisory: {name} {host_metric} {bv:.1f} -> {nv:.1f} us "
+              f"({r:.2f}x > {host_threshold:.1f}x)", file=err)
+
+    bad, n_compared = find_regressions(base["ops"], new["ops"], metric,
+                                       threshold)
+    common = len(set(base["ops"]) & set(new["ops"]))
+    if common and not n_compared:
+        print(f"ERROR: none of the {common} common ops carry the gated "
+              f"metric '{metric}' in both reports — the gate compared "
+              "nothing (regenerate the baseline with the current "
+              "op_bench.py, or pass --metric host_us)", file=out)
+        return 2
+    if bad or (fail_on_host and advisory):
+        if bad:
+            print(f"{len(bad)} op(s) regressed beyond "
+                  f"{threshold:.2f}x on {metric}:", file=out)
+            for name, bv, nv, r in sorted(bad, key=lambda x: -x[3]):
+                print(f"  {name:22s} {bv:9.1f} -> {nv:9.1f} us "
+                      f"({r:.2f}x)", file=out)
+        if fail_on_host and advisory:
+            print(f"{len(advisory)} op(s) regressed beyond "
+                  f"{host_threshold:.2f}x on {host_metric} "
+                  "(--fail-on-host)", file=out)
+        return 1
+    print(f"op benchmark gate OK ({n_compared} ops compared, "
+          f"{threshold:.2f}x on {metric}; advisory "
+          f"{host_threshold:.2f}x on {host_metric}"
+          f"{', enforced' if fail_on_host else ''})", file=out)
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("baseline")
     ap.add_argument("new")
-    ap.add_argument("--threshold", type=float, default=1.4,
-                    help="fail when new > threshold * baseline")
-    ap.add_argument("--metric", default="host_us",
-                    choices=["host_us", "wall_us"])
+    ap.add_argument("--threshold", type=float, default=1.3,
+                    help="fail when new > threshold * baseline on the "
+                         "primary metric (default 1.3x on wall_us)")
+    ap.add_argument("--metric", default="wall_us",
+                    choices=["host_us", "wall_us"],
+                    help="primary gated metric; wall_us (pipelined "
+                         "min-of-repeats) is stable through the tunnel")
+    ap.add_argument("--host-threshold", type=float, default=3.0,
+                    help="advisory threshold for the secondary metric")
+    ap.add_argument("--fail-on-host", action="store_true",
+                    help="turn the advisory host_us check into a "
+                         "failure (direct-attached devices)")
     args = ap.parse_args()
 
     with open(args.baseline) as f:
         base = json.load(f)
     with open(args.new) as f:
         new = json.load(f)
-
-    if base.get("platform") != new.get("platform"):
-        print(f"WARNING: platform changed "
-              f"{base.get('platform')} -> {new.get('platform')}; "
-              "timings are not comparable", file=sys.stderr)
-
-    bad = []
-    for name, b in sorted(base["ops"].items()):
-        n = new["ops"].get(name)
-        if n is None:
-            print(f"removed: {name}", file=sys.stderr)
-            continue
-        bv, nv = b[args.metric], n[args.metric]
-        ratio = nv / bv if bv else float("inf")
-        if ratio > args.threshold:
-            bad.append((name, bv, nv, ratio))
-    for name in sorted(set(new["ops"]) - set(base["ops"])):
-        print(f"new op (no baseline): {name}", file=sys.stderr)
-
-    if bad:
-        print(f"{len(bad)} op(s) regressed beyond "
-              f"{args.threshold:.2f}x on {args.metric}:")
-        for name, bv, nv, r in sorted(bad, key=lambda x: -x[3]):
-            print(f"  {name:22s} {bv:9.1f} -> {nv:9.1f} us "
-                  f"({r:.2f}x)")
-        sys.exit(1)
-    print(f"op benchmark gate OK ({len(base['ops'])} ops, "
-          f"threshold {args.threshold:.2f}x on {args.metric})")
+    sys.exit(run_gate(base, new, threshold=args.threshold,
+                      metric=args.metric,
+                      host_threshold=args.host_threshold,
+                      fail_on_host=args.fail_on_host))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,69 @@
+"""Go bindings over the C inference ABI (reference: inference/goapi/).
+
+save -> load -> run parity, mirroring tests/test_capi_deploy.py: a Go
+program (deploy/goapi/demo) consumes the saved model through cgo +
+libpaddle_tpu_c.so and must print the same outputs the in-process
+Python predictor computes. Skips when no Go toolchain is installed.
+"""
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOAPI = os.path.join(REPO, "paddle_tpu", "deploy", "goapi")
+
+
+@pytest.mark.skipif(shutil.which("go") is None, reason="no go toolchain")
+def test_go_program_runs_saved_model(tmp_path):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import jit
+    from paddle_tpu.jit.api import InputSpec
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 3))
+    model.eval()
+    prefix = str(tmp_path / "toy")
+    jit.save(model, prefix,
+             input_spec=[InputSpec([2, 4], "float32", "x")])
+
+    x = (0.25 * np.arange(8, dtype=np.float32) - 1.0).reshape(2, 4)
+    import paddle_tpu.inference as inf
+    want = inf.create_predictor(inf.Config(prefix)).run([x])[0]
+
+    from paddle_tpu import deploy
+    so = deploy.build_capi(out_dir=str(tmp_path))
+    so_dir = os.path.dirname(so)
+    # cgo expects lib<name>.so for -lpaddle_tpu_c
+    libname = os.path.join(so_dir, "libpaddle_tpu_c.so")
+    if not os.path.exists(libname):
+        shutil.copy(so, libname)
+
+    env = dict(os.environ)
+    env["CGO_ENABLED"] = "1"
+    env["CGO_CFLAGS"] = f"-I{os.path.dirname(deploy.capi_header_path())}"
+    env["CGO_LDFLAGS"] = (f"-L{so_dir} -lpaddle_tpu_c "
+                          f"-Wl,-rpath,{so_dir}")
+    exe = str(tmp_path / "go_demo")
+    build = subprocess.run(
+        ["go", "build", "-o", exe, "./demo"], cwd=GOAPI, env=env,
+        capture_output=True, text=True, timeout=600)
+    assert build.returncode == 0, build.stderr[-2000:]
+
+    env["PADDLE_TPU_FORCE_CPU_DEVICES"] = "1"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO] + [p for p in sys.path if p and os.path.isdir(p)])
+    proc = subprocess.run([exe, prefix], env=env, capture_output=True,
+                          text=True, timeout=300)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    out_lines = dict(l.split("=", 1) for l in
+                     proc.stdout.strip().splitlines() if "=" in l)
+    assert out_lines["inputs"].startswith("1 ")
+    assert out_lines["out_shape"] == "2x3"
+    got = np.array([float(v) for v in out_lines["out"].split()],
+                   np.float32).reshape(2, 3)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

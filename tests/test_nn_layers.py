@@ -589,9 +589,9 @@ class TestHubOnnx:
         with pytest.raises(ValueError):
             hub.list(str(tmp_path), source="bitbucket")
 
-    def test_onnx_export_gated_with_alternative(self):
+    def test_onnx_export_requires_input_spec(self):
         import paddle_tpu as paddle
         m = paddle.nn.Linear(2, 2)
-        with pytest.raises(NotImplementedError) as ei:
+        with pytest.raises(ValueError) as ei:
             paddle.onnx.export(m, "/tmp/m")
-        assert "StableHLO" in str(ei.value)
+        assert "input_spec" in str(ei.value)

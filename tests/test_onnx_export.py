@@ -1,0 +1,124 @@
+"""paddle.onnx.export: self-contained jaxpr -> ONNX opset-11 exporter.
+
+Reference: python/paddle/onnx/export.py (delegates to paddle2onnx; here
+the converter is in-tree). With no `onnx` runtime in the image, the
+exported file is verified by parsing the protobuf wire format back with
+the same dependency-free reader the writer uses (paddle_tpu/onnx/_proto)
+and checking the model structure: IR/opset fields, graph inputs/outputs
+with shapes and dtypes, node op_types, and bit-exact initializer
+payloads against the layer's weights.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit.api import InputSpec
+from paddle_tpu.onnx import _proto as P
+
+
+def _parse_model(path):
+    with open(path, "rb") as f:
+        buf = f.read()
+    model = P.parse_message(buf)
+    graph = P.parse_message(P.one(model, 7))
+    nodes = [P.parse_message(b) for b in P.many(graph, 1)]
+    inits = [P.parse_message(b) for b in P.many(graph, 5)]
+    ins = [P.parse_message(b) for b in P.many(graph, 11)]
+    outs = [P.parse_message(b) for b in P.many(graph, 12)]
+    return model, graph, nodes, inits, ins, outs
+
+
+def _vi_shape(vi):
+    ttype = P.parse_message(P.one(P.parse_message(P.one(vi, 2)), 1))
+    shape = P.parse_message(P.one(ttype, 2))
+    dims = [P.one(P.parse_message(d), 1) for d in P.many(shape, 1)]
+    return P.one(ttype, 1), dims
+
+
+class TestOnnxExport:
+    def test_mlp_structure_and_weights(self, tmp_path):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 3))
+        m.eval()
+        out = paddle.onnx.export(
+            m, str(tmp_path / "mlp"),
+            input_spec=[InputSpec([2, 4], "float32", "x")])
+        assert out.endswith(".onnx")
+        model, graph, nodes, inits, ins, outs = _parse_model(out)
+
+        assert P.one(model, 1) == 8                    # ir_version
+        opset = P.parse_message(P.one(model, 8))
+        assert P.one(opset, 2) == 11
+
+        ops = [P.one(n, 4).decode() for n in nodes]
+        assert ops.count("MatMul") == 2
+        assert "Tanh" in ops
+        assert "Add" in ops                            # bias adds
+
+        # graph I/O: x [2,4] f32 -> [2,3] f32
+        assert P.one(ins[0], 1) == b"x"
+        et, dims = _vi_shape(ins[0])
+        assert (et, dims) == (1, [2, 4])
+        et, dims = _vi_shape(outs[0])
+        assert (et, dims) == (1, [2, 3])
+
+        # initializer payloads are bit-exact copies of the weights
+        by_name = {P.one(t, 8).decode(): t for t in inits}
+        w0 = by_name["param.0.weight"]
+        want = np.asarray(m[0].weight.numpy(), np.float32)
+        assert P.many(w0, 1) == [4, 8]
+        got = np.frombuffer(P.one(w0, 9), np.float32).reshape(4, 8)
+        np.testing.assert_array_equal(got, want)
+
+    def test_conv_pool_net(self, tmp_path):
+        paddle.seed(1)
+        m = nn.Sequential(nn.Conv2D(1, 4, 3, padding=1), nn.ReLU(),
+                          nn.MaxPool2D(2, 2), nn.Flatten(),
+                          nn.Linear(4 * 4 * 4, 5))
+        m.eval()
+        out = paddle.onnx.export(
+            m, str(tmp_path / "conv"),
+            input_spec=[InputSpec([1, 1, 8, 8], "float32", "img")])
+        _, _, nodes, inits, ins, outs = _parse_model(out)
+        ops = [P.one(n, 4).decode() for n in nodes]
+        assert "Conv" in ops
+        assert "MaxPool" in ops
+        assert "MatMul" in ops
+        conv = nodes[ops.index("Conv")]
+        attrs = {P.one(P.parse_message(a), 1).decode():
+                 P.parse_message(a) for a in P.many(conv, 5)}
+        assert [v for _, v in attrs["strides"].get(8, [])] == [1, 1]
+        assert [v for _, v in attrs["pads"].get(8, [])] == [1, 1, 1, 1]
+        et, dims = _vi_shape(outs[0])
+        assert dims == [1, 5]
+
+    def test_layernorm_model(self, tmp_path):
+        paddle.seed(2)
+        m = nn.Sequential(nn.Linear(6, 6), nn.LayerNorm(6), nn.GELU())
+        m.eval()
+        out = paddle.onnx.export(
+            m, str(tmp_path / "ln"),
+            input_spec=[InputSpec([3, 6], "float32", "x")])
+        _, _, nodes, _, _, outs = _parse_model(out)
+        ops = [P.one(n, 4).decode() for n in nodes]
+        # LN decomposes through reductions; GELU through Erf
+        assert any(o.startswith("Reduce") for o in ops)
+        assert "Erf" in ops or "Tanh" in ops
+
+    def test_unsupported_primitive_raises_with_name(self, tmp_path):
+        class WithCumsum(nn.Layer):
+            def forward(self, x):
+                return paddle.cumsum(x, axis=0)
+
+        m = WithCumsum()
+        with pytest.raises(NotImplementedError) as ei:
+            paddle.onnx.export(
+                m, str(tmp_path / "bad"),
+                input_spec=[InputSpec([4], "float32", "x")])
+        assert "cumsum" in str(ei.value).lower()
+        assert "StableHLO" in str(ei.value)
+
+    def test_missing_input_spec_raises(self, tmp_path):
+        with pytest.raises(ValueError):
+            paddle.onnx.export(nn.Linear(2, 2), str(tmp_path / "m"))

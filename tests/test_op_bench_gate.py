@@ -1,0 +1,73 @@
+"""Op-bench regression gate semantics (scripts/op_bench_check.py).
+
+Reference: tools/check_op_benchmark_result.py — the gate itself must be
+tested or a silently-green gate hides regressions. Exercises the
+primary wall_us gate, the advisory host_us path, --fail-on-host, and
+the new/removed-op reporting.
+"""
+import io
+import importlib.util
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SPEC = importlib.util.spec_from_file_location(
+    "op_bench_check",
+    os.path.join(HERE, os.pardir, "scripts", "op_bench_check.py"))
+obc = importlib.util.module_from_spec(SPEC)
+SPEC.loader.exec_module(obc)
+
+
+def _report(**ops):
+    return {"platform": "tpu",
+            "ops": {k: {"host_us": h, "wall_us": w}
+                    for k, (h, w) in ops.items()}}
+
+
+def test_gate_passes_within_threshold():
+    base = _report(add=(30.0, 10.0), matmul=(40.0, 20.0))
+    new = _report(add=(35.0, 12.0), matmul=(45.0, 24.0))
+    out, err = io.StringIO(), io.StringIO()
+    assert obc.run_gate(base, new, out=out, err=err) == 0
+    assert "gate OK" in out.getvalue()
+
+
+def test_gate_fails_on_wall_us_regression():
+    base = _report(add=(30.0, 10.0), matmul=(40.0, 20.0))
+    new = _report(add=(30.0, 14.0), matmul=(40.0, 20.0))  # 1.4x wall
+    out, err = io.StringIO(), io.StringIO()
+    assert obc.run_gate(base, new, out=out, err=err) == 1
+    assert "add" in out.getvalue()
+
+
+def test_host_us_is_advisory_by_default():
+    # 4x host regression, wall flat: warns but passes (tunnel noise)
+    base = _report(add=(30.0, 10.0))
+    new = _report(add=(120.0, 10.5))
+    out, err = io.StringIO(), io.StringIO()
+    assert obc.run_gate(base, new, out=out, err=err) == 0
+    assert "advisory" in err.getvalue()
+
+
+def test_fail_on_host_enforces_advisory():
+    base = _report(add=(30.0, 10.0))
+    new = _report(add=(120.0, 10.5))
+    out, err = io.StringIO(), io.StringIO()
+    assert obc.run_gate(base, new, fail_on_host=True,
+                        out=out, err=err) == 1
+
+
+def test_new_and_removed_ops_do_not_fail():
+    base = _report(add=(30.0, 10.0), old_op=(10.0, 5.0))
+    new = _report(add=(30.0, 10.0), new_op=(10.0, 5.0))
+    out, err = io.StringIO(), io.StringIO()
+    assert obc.run_gate(base, new, out=out, err=err) == 0
+    assert "removed: old_op" in err.getvalue()
+    assert "new op (no baseline): new_op" in err.getvalue()
+
+
+def test_zero_baseline_is_infinite_regression():
+    base = _report(add=(30.0, 0.0))
+    new = _report(add=(30.0, 1.0))
+    out, err = io.StringIO(), io.StringIO()
+    assert obc.run_gate(base, new, out=out, err=err) == 1

@@ -1,0 +1,170 @@
+"""nn.quant: weight-only int8/int4 streaming + llm.int8 matmul.
+
+Reference analogue: the int8 inference stack
+(fused_multi_transformer_int8_op.cu / attn_gemm_int8.h). Checks
+quantize->dequantize round-trips, weight_only_linear parity with the
+dequantized matmul, the int8 dot_general path, layer swapping, and the
+quantized GPT decode path end-to-end (compiled generator).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.nn import quant
+
+
+def _randw(rs, i, o):
+    return (rs.randn(i, o) * 0.1).astype(np.float32)
+
+
+class TestWeightQuantize:
+    def test_int8_roundtrip(self):
+        rs = np.random.RandomState(0)
+        w = _randw(rs, 64, 32)
+        q, s = quant.weight_quantize(w, algo="weight_only_int8")
+        assert str(q.dtype).endswith("int8") and q.shape == [64, 32]
+        assert s.shape == [32]
+        wd = quant.weight_dequantize(q, s).numpy()
+        # absmax int8: max error is scale/2 = absmax/254 per channel
+        err = np.abs(wd - w).max(axis=0)
+        bound = np.abs(w).max(axis=0) / 127.0
+        assert (err <= bound + 1e-7).all()
+
+    def test_int4_roundtrip_packed(self):
+        rs = np.random.RandomState(1)
+        w = _randw(rs, 64, 16)
+        q, s = quant.weight_quantize(w, algo="weight_only_int4")
+        assert q.shape == [32, 16], "two nibbles per byte"
+        wd = quant.weight_dequantize(
+            q, s, algo="weight_only_int4", in_features=64).numpy()
+        bound = np.abs(w).max(axis=0) / 7.0
+        assert (np.abs(wd - w).max(axis=0) <= bound + 1e-7).all()
+
+    def test_int4_group_scales(self):
+        rs = np.random.RandomState(2)
+        w = _randw(rs, 64, 8)
+        q, s = quant.weight_quantize(w, algo="weight_only_int4",
+                                     group_size=16)
+        assert s.shape == [4, 8]
+        wd = quant.weight_dequantize(
+            q, s, algo="weight_only_int4", in_features=64,
+            group_size=16).numpy()
+        wg = w.reshape(4, 16, 8)
+        bound = np.abs(wg).max(axis=1) / 7.0   # per-group bound
+        err = np.abs(wd.reshape(4, 16, 8) - wg).max(axis=1)
+        assert (err <= bound + 1e-7).all()
+
+    def test_bad_algo_raises(self):
+        with pytest.raises(ValueError):
+            quant.weight_quantize(np.ones((4, 4), np.float32),
+                                  algo="int3")
+
+
+class TestWeightOnlyLinear:
+    def test_int8_matches_dequant_matmul(self):
+        rs = np.random.RandomState(3)
+        w = _randw(rs, 32, 24)
+        x = rs.randn(4, 32).astype(np.float32)
+        q, s = quant.weight_quantize(w, algo="weight_only_int8")
+        got = quant.weight_only_linear(paddle.to_tensor(x), q,
+                                       weight_scale=s).numpy()
+        want = x @ quant.weight_dequantize(q, s).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_int4_group_matches(self):
+        rs = np.random.RandomState(4)
+        w = _randw(rs, 32, 24)
+        x = rs.randn(4, 32).astype(np.float32)
+        q, s = quant.weight_quantize(w, algo="weight_only_int4",
+                                     group_size=8)
+        got = quant.weight_only_linear(
+            paddle.to_tensor(x), q, weight_scale=s, weight_dtype="int4",
+            in_features=32, group_size=8).numpy()
+        want = x @ quant.weight_dequantize(
+            q, s, algo="weight_only_int4", in_features=32,
+            group_size=8).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_activation_grad_flows(self):
+        rs = np.random.RandomState(5)
+        w = _randw(rs, 16, 8)
+        q, s = quant.weight_quantize(w)
+        x = paddle.to_tensor(rs.randn(2, 16).astype(np.float32),
+                             stop_gradient=False)
+        y = quant.weight_only_linear(x, q, weight_scale=s)
+        y.sum().backward()
+        wd = quant.weight_dequantize(q, s).numpy()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   np.tile(wd.sum(1), (2, 1)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_llm_int8_close_to_float(self):
+        rs = np.random.RandomState(6)
+        w = _randw(rs, 64, 32)
+        x = rs.randn(8, 64).astype(np.float32)
+        q, s = quant.weight_quantize(w)
+        got = quant.llm_int8_linear(paddle.to_tensor(x), q,
+                                    weight_scale=s).numpy()
+        want = x @ w
+        # two int8 quantizations (weights + per-token activations)
+        assert np.abs(got - want).max() < 0.05 * np.abs(want).max() + 0.05
+
+    def test_layer_swap(self):
+        rs = np.random.RandomState(7)
+        lin = nn.Linear(16, 8)
+        lin.weight.set_value(paddle.to_tensor(_randw(rs, 16, 8)))
+        model = nn.Sequential(lin, nn.ReLU(), nn.Linear(8, 4))
+        n = quant.quantize_for_decode(model, algo="weight_only_int8")
+        assert n == 2
+        assert isinstance(model[0], quant.WeightOnlyLinear)
+        x = rs.randn(2, 16).astype(np.float32)
+        y = model(paddle.to_tensor(x)).numpy()
+        assert np.isfinite(y).all()
+
+
+class TestQuantizedGPTDecode:
+    def _model(self):
+        from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+        cfg = GPTConfig(vocab_size=512, hidden_size=64,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        max_position_embeddings=128,
+                        hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+        paddle.seed(11)
+        return GPTForCausalLM(cfg), cfg
+
+    def test_quantized_logits_close_and_generate(self):
+        model, cfg = self._model()
+        model.eval()
+        rs = np.random.RandomState(0)
+        ids = paddle.to_tensor(rs.randint(0, 512, (2, 12)))
+        ref_logits = model(ids).numpy()
+
+        qmodel, _ = self._model()   # same seed -> same weights
+        qmodel.eval()
+        n = quant.quantize_for_decode(qmodel, algo="weight_only_int8")
+        assert n == 2 * 4  # qkv, out, fc1, fc2 per layer
+        assert qmodel._qhead_algo == "weight_only_int8"
+        q_logits = qmodel(ids).numpy()
+        # int8 weight error is small relative to logit scale
+        denom = np.abs(ref_logits).max()
+        assert np.abs(q_logits - ref_logits).max() < 0.05 * denom + 0.05
+
+        out_ref = model.generate(ids, max_new_tokens=8).numpy()
+        out_q = qmodel.generate(ids, max_new_tokens=8).numpy()
+        assert out_q.shape == out_ref.shape
+        # greedy tokens should mostly agree at int8
+        agree = (out_ref[:, 12:] == out_q[:, 12:]).mean()
+        assert agree >= 0.5, f"only {agree:.0%} of greedy tokens agree"
+
+    def test_int4_generate_runs(self):
+        qmodel, cfg = self._model()
+        qmodel.eval()
+        quant.quantize_for_decode(qmodel, algo="weight_only_int4",
+                                  group_size=16)
+        rs = np.random.RandomState(0)
+        ids = paddle.to_tensor(rs.randint(0, 512, (2, 12)))
+        out = qmodel.generate(ids, max_new_tokens=6).numpy()
+        assert out.shape == (2, 18)
+        assert (out[:, :12] == ids.numpy()).all()
