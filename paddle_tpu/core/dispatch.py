@@ -25,8 +25,15 @@ import jax
 
 __all__ = ["OpDef", "register_op", "get_jitted", "get_vjp", "clear_caches"]
 
-_JIT_CACHE: dict = {}
-_VJP_CACHE: dict = {}
+# Compiled executables are cached ON THE OPDEF INSTANCE (_exec_cache):
+# the cache's lifetime is the op's lifetime. Registered ops live
+# forever in _OPS, so their executables persist exactly as a global
+# cache would; dynamically-created ops (HostEmbedding's gather, MoE's
+# stacked-experts op) take their executables — and everything the
+# closures pin (e.g. a host-resident table) — with them when the owner
+# is garbage-collected. (A weak-keyed global cache cannot do this: the
+# cached jit wrapper strongly references the op through its fwd/bwd,
+# so value->key would keep every entry alive forever.)
 _LOCK = threading.Lock()
 
 
@@ -50,7 +57,8 @@ class OpDef:
         if registered; otherwise autodiff falls back to jax.vjp(fwd).
     """
 
-    __slots__ = ("name", "fwd", "bwd", "save_outputs", "nondiff")
+    __slots__ = ("name", "fwd", "bwd", "save_outputs", "nondiff",
+                 "_exec_cache", "__weakref__")
 
     def __init__(self, name, fwd, bwd=None, save_outputs=False, nondiff=False):
         self.name = name
@@ -58,6 +66,7 @@ class OpDef:
         self.bwd = bwd
         self.save_outputs = save_outputs or (bwd is not None)
         self.nondiff = nondiff
+        self._exec_cache = {}
 
 
 _OPS: dict[str, OpDef] = {}
@@ -78,23 +87,23 @@ def get_op(name) -> OpDef:
     return _OPS[name]
 
 
-def get_jitted(fn: Callable, attrs: dict[str, Any]):
-    """Compiled forward executable for (fn, attrs), cached."""
-    key = fn if not attrs else (fn, _freeze(attrs))
-    got = _JIT_CACHE.get(key)
+def get_jitted(op: "OpDef", attrs: dict[str, Any]):
+    """Compiled forward executable for (op, attrs), cached on the op."""
+    key = ("fwd", _freeze(attrs) if attrs else None)
+    got = op._exec_cache.get(key)
     if got is None:
         with _LOCK:
-            got = _JIT_CACHE.get(key)
+            got = op._exec_cache.get(key)
             if got is None:
                 if attrs:
-                    got = jax.jit(functools.partial(fn, **attrs))
+                    got = jax.jit(functools.partial(op.fwd, **attrs))
                 else:
-                    got = jax.jit(fn)
-                _JIT_CACHE[key] = got
+                    got = jax.jit(op.fwd)
+                op._exec_cache[key] = got
     return got
 
 
-def get_vjp(fn: Callable, attrs: dict[str, Any], diff_in: tuple[int, ...],
+def get_vjp(op: "OpDef", attrs: dict[str, Any], diff_in: tuple[int, ...],
             diff_out: tuple[int, ...], single: bool):
     """Compiled backward executable computing d(inputs)/d(outputs).
 
@@ -103,15 +112,16 @@ def get_vjp(fn: Callable, attrs: dict[str, Any], diff_in: tuple[int, ...],
     diff_out (the float outputs of the forward). `single` marks ops whose
     fwd returns a bare array rather than a tuple.
     """
-    key = (fn, _freeze(attrs), diff_in, diff_out, single)
-    got = _VJP_CACHE.get(key)
+    key = ("vjp", _freeze(attrs), diff_in, diff_out, single)
+    got = op._exec_cache.get(key)
     if got is None:
         with _LOCK:
-            got = _VJP_CACHE.get(key)
+            got = op._exec_cache.get(key)
             if got is None:
                 got = jax.jit(functools.partial(
-                    _vjp_impl, fn, dict(attrs), diff_in, diff_out, single))
-                _VJP_CACHE[key] = got
+                    _vjp_impl, op.fwd, dict(attrs), diff_in, diff_out,
+                    single))
+                op._exec_cache[key] = got
     return got
 
 
@@ -132,27 +142,30 @@ def _vjp_impl(fn, attrs, diff_in, diff_out, single, inputs, cts):
     return vjp_fn(tuple(cts))
 
 
-_BWD_CACHE: dict = {}
-
-
 def get_custom_bwd(op: OpDef, attrs: dict):
-    """Compiled custom-backward executable: (inputs, outputs, cts) -> grads."""
-    key = (op.name, _freeze(attrs))
-    got = _BWD_CACHE.get(key)
+    """Compiled custom-backward executable: (inputs, outputs, cts) -> grads.
+
+    Cached on the OpDef OBJECT, not under its name: dynamically-created
+    OpDefs (HostEmbedding's gather, MoE's stacked-experts op) may share
+    a name across instances while closing over different state — a
+    name-keyed cache silently routes later instances through the first
+    one's closure."""
+    key = ("bwd", _freeze(attrs))
+    got = op._exec_cache.get(key)
     if got is None:
         with _LOCK:
-            got = _BWD_CACHE.get(key)
+            got = op._exec_cache.get(key)
             if got is None:
                 a = dict(attrs)
+                bwd_fn = op.bwd
 
                 def run(inputs, outputs, cts):
-                    return op.bwd(a, inputs, outputs, cts)
+                    return bwd_fn(a, inputs, outputs, cts)
                 got = jax.jit(run)
-                _BWD_CACHE[key] = got
+                op._exec_cache[key] = got
     return got
 
 
 def clear_caches():
-    _JIT_CACHE.clear()
-    _VJP_CACHE.clear()
-    _BWD_CACHE.clear()
+    for op in _OPS.values():
+        op._exec_cache.clear()

@@ -186,7 +186,7 @@ class GradNode:
                 grads = fn(self.saved_inputs, self.saved_outputs,
                            full_cts)
                 return [grads[i] for i in self.diff_in]
-            fn = get_vjp(self.op.fwd, self.attrs, self.diff_in,
+            fn = get_vjp(self.op, self.attrs, self.diff_in,
                          self.diff_out, self.single)
             return list(fn(self.saved_inputs, full_cts))
 
@@ -595,7 +595,7 @@ def apply_op(op_name: str, *tensors, attrs: Optional[dict] = None,
         # boundaries for XLA, no jit-cache lookup on the Python hot path
         out = op.fwd(*vals, **attrs) if attrs else op.fwd(*vals)
     else:
-        fn = get_jitted(op.fwd, attrs)
+        fn = get_jitted(op, attrs)
         hook = _profile_hook  # read once (concurrent stop() nulls global)
         if hook is None:
             out = fn(*vals)

@@ -48,12 +48,14 @@ static void set_err_from_py(void) {
 }
 
 int PD_Init(void) {
+    int we_initialized_py = 0;
     if (g_initialized) {
         return 0;
     }
     if (!Py_IsInitialized()) {
         /* isolated=0: honor PYTHONPATH / venv env of the host process */
         Py_InitializeEx(0);
+        we_initialized_py = 1;
     }
     PyGILState_STATE st = PyGILState_Ensure();
     PyObject *mod = PyImport_ImportModule("paddle_tpu.deploy._capi_bridge");
@@ -64,9 +66,17 @@ int PD_Init(void) {
     }
     g_bridge = mod; /* keep the reference for process lifetime */
     g_initialized = 1;
-    /* release the GIL so later PyGILState_Ensure calls work from any
-     * thread */
-    PyEval_SaveThread();
+    if (we_initialized_py) {
+        /* this library owns the interpreter: drop the GIL so later
+         * PyGILState_Ensure calls work from any thread */
+        PyEval_SaveThread();
+    } else {
+        /* the host process initialized Python and may hold the GIL at
+         * this call: balance the Ensure with Release — SaveThread here
+         * would steal the caller's GIL and unbalance the GILState
+         * stack (ADVICE r4) */
+        PyGILState_Release(st);
+    }
     return 0;
 }
 

@@ -13,5 +13,6 @@ from . import optimizer  # noqa: F401
 from . import autograd  # noqa: F401
 from . import autotune  # noqa: F401
 
-__all__ = ["nn", "optimizer", "autograd"]
+__all__ = ["nn", "optimizer", "autograd", "HostEmbedding"]
 from . import asp  # noqa: E402,F401
+from .host_embedding import HostEmbedding  # noqa: E402,F401

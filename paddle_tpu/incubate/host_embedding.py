@@ -1,0 +1,234 @@
+"""Beyond-HBM embedding: the Parameter-Server capability, TPU-native.
+
+What the reference's brpc Parameter Server buys users is embedding
+tables LARGER than accelerator memory with sparse row updates
+(reference: paddle/fluid/distributed/ps/table/memory_sparse_table.cc:1,
+python/paddle/distributed/ps/the_one_ps.py:1031, and the
+paddle.static.nn.sparse_embedding entry point). The PS *architecture*
+(brpc servers, dense/sparse tables, pull/push RPC) is deleted by the
+TPU design — but the capability is reproduced with the mechanism that
+already powers ZeRO optimizer-state offload (distributed/sharding.py):
+
+- the table lives in HOST memory (memory_kind="pinned_host"; host RAM
+  is 100s of GB per host vs ~16 GB HBM on v5e),
+- the row gather executes ON THE HOST via XLA host compute
+  (jax.experimental.compute_on), so only the touched rows ever cross
+  to the device,
+- updates are sparse row scatter-adds applied host-side — SGD or
+  rowwise Adagrad, the classic PS rules (memory_sparse_table's
+  sgd/adagrad).
+
+Training contract (PS semantics): the table is OWNED BY THE LAYER, not
+the global optimizer — backward records (ids, row-grads); call
+apply_updates(lr) after each step. Dense params flow through the
+normal optimizer unchanged. Eager-mode training only (the reference PS
+likewise updates its tables outside the dense graph).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import OpDef
+from ..nn.layer.layers import Layer
+
+__all__ = ["HostEmbedding"]
+
+
+def _host_supported():
+    try:
+        return jax.devices()[0].platform in ("tpu", "gpu")
+    except Exception:
+        return False
+
+
+def _is_tracer(x):
+    from jax.core import Tracer
+    return isinstance(x, Tracer)
+
+
+class HostEmbedding(Layer):
+    """Embedding with a host-resident table and sparse host-side
+    updates. num_embeddings may exceed device HBM."""
+
+    def __init__(self, num_embeddings, embedding_dim,
+                 sparse_optimizer="sgd", initializer_range=0.01,
+                 seed=0):
+        super().__init__()
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+        if sparse_optimizer not in ("sgd", "adagrad"):
+            raise ValueError("sparse_optimizer must be 'sgd' or "
+                             f"'adagrad', got {sparse_optimizer!r}")
+        self.sparse_optimizer = sparse_optimizer
+        self._host_ok = _host_supported()
+        if not self._host_ok:
+            import warnings
+            warnings.warn(
+                "HostEmbedding: pinned_host memory needs a TPU/GPU "
+                "backend; the table stays in default memory on CPU "
+                "(functionally identical, no capacity win)")
+
+        # build the table host-side in chunks (never materialize a
+        # second full copy); rows ~ N(0, initializer_range)
+        rs = np.random.RandomState(seed)
+        tab = np.empty((self.num_embeddings, self.embedding_dim),
+                       np.float32)
+        chunk = max(1, (1 << 24) // max(self.embedding_dim, 1))
+        for lo in range(0, self.num_embeddings, chunk):
+            hi = min(lo + chunk, self.num_embeddings)
+            tab[lo:hi] = rs.randn(hi - lo, self.embedding_dim) \
+                .astype(np.float32) * initializer_range
+        # plain Tensor attribute: NOT a Parameter, so parameters() and
+        # the global optimizer never see it (PS tables are layer-owned);
+        # stop_gradient=False so the tape records the gather op
+        t = jax.device_put(tab, self._host_sharding())
+        del tab
+        object.__setattr__(self, "table",
+                           Tensor(t, stop_gradient=False))
+        if sparse_optimizer == "adagrad":
+            self._accum = jax.device_put(
+                np.zeros((self.num_embeddings,), np.float32),
+                self._host_sharding())
+        self._pending = []            # [(ids [n], grad_rows [n, D])]
+        self._gather_op = None
+        self._updater = None
+
+    def _host_sharding(self):
+        from jax.sharding import SingleDeviceSharding
+        dev = jax.devices()[0]
+        kind = "pinned_host" if self._host_ok else "device"
+        return SingleDeviceSharding(dev, memory_kind=kind)
+
+    # -- forward: host-side gather, device-side rows --------------------
+    def _build_gather_op(self):
+        layer = self
+
+        def fwd(idv, tablev):
+            from jax.experimental.compute_on import compute_on
+            flat = idv.reshape(-1)
+            if layer._host_ok:
+                with compute_on("device_host"):
+                    rows = jnp.take(tablev, flat, axis=0)
+            else:
+                rows = jnp.take(tablev, flat, axis=0)
+            return rows.reshape(tuple(idv.shape)
+                                + (layer.embedding_dim,))
+
+        def _record(idv, ctv):
+            layer._pending.append(
+                (np.asarray(idv).reshape(-1),
+                 np.asarray(ctv, np.float32).reshape(
+                     -1, layer.embedding_dim)))
+
+        def bwd(attrs, inputs, outputs, cts):
+            # the dispatch layer jits custom backwards, so the sparse
+            # (ids, row-grad) capture goes through an ordered
+            # io_callback — the host sees concrete arrays at execution
+            # time; no dense [N, D] cotangent ever materializes
+            from jax.experimental import io_callback
+            idv, _tablev = inputs
+            (ct,) = cts
+            io_callback(_record, None, idv, ct, ordered=True)
+            return (None, None)
+
+        return OpDef("host_embedding_gather", fwd, bwd=bwd)
+
+    def forward(self, input_ids):
+        from ..core.tensor import apply_op
+        ids = input_ids if isinstance(input_ids, Tensor) \
+            else Tensor(jnp.asarray(input_ids))
+        if self._gather_op is None:
+            self._gather_op = self._build_gather_op()
+        return apply_op(self._gather_op, ids, self.table)
+
+    # -- sparse update ---------------------------------------------------
+    def _build_updater(self):
+        host = self._host_sharding()
+        host_ok = self._host_ok
+
+        if self.sparse_optimizer == "sgd":
+            def upd(table, ids, rows, lr):
+                from jax.experimental.compute_on import compute_on
+                if host_ok:
+                    with compute_on("device_host"):
+                        return table.at[ids].add(-lr * rows)
+                return table.at[ids].add(-lr * rows)
+
+            return jax.jit(upd, donate_argnums=(0,),
+                           out_shardings=host)
+
+        def upd(table, accum, ids, rows, lr):
+            from jax.experimental.compute_on import compute_on
+
+            def rule(table, accum):
+                g2 = jnp.sum(rows * rows, axis=-1)
+                accum = accum.at[ids].add(g2)
+                denom = jnp.sqrt(accum[ids] + 1e-10)
+                return (table.at[ids].add(-lr * rows / denom[:, None]),
+                        accum)
+
+            if host_ok:
+                with compute_on("device_host"):
+                    return rule(table, accum)
+            return rule(table, accum)
+
+        return jax.jit(upd, donate_argnums=(0, 1),
+                       out_shardings=(host, host))
+
+    def apply_updates(self, lr):
+        """Apply all recorded row gradients (host-side sparse scatter).
+        Returns the number of updated rows (with multiplicity)."""
+        # the (ids, rows) capture is an async ordered io_callback inside
+        # the jitted backward — drain it before reading _pending
+        jax.effects_barrier()
+        if not self._pending:
+            return 0
+        if self._updater is None:
+            self._updater = self._build_updater()
+        lr = jnp.float32(lr)
+        n_rows = 0
+        for ids, rows in self._pending:
+            n_rows += len(ids)
+            if self.sparse_optimizer == "sgd":
+                new_t = self._updater(self.table._value,
+                                      jnp.asarray(ids),
+                                      jnp.asarray(rows), lr)
+            else:
+                new_t, self._accum = self._updater(
+                    self.table._value, self._accum, jnp.asarray(ids),
+                    jnp.asarray(rows), lr)
+            self.table._rebind(new_t)
+        self._pending.clear()
+        return n_rows
+
+    def clear_pending(self):
+        self._pending.clear()
+
+    def close(self):
+        """Release the host table NOW. The dispatch layer caches this
+        layer's executables on its own OpDef (collected with the
+        layer), but jax's global C++ jit cache also pins the traced
+        closures — for multi-GB tables, waiting for process exit is
+        not acceptable, so close() drops the buffers and flushes the
+        jax cache explicitly."""
+        import jax as _jax
+        self.table._rebind(jnp.zeros((0, 0), jnp.float32))
+        self._pending.clear()
+        self._gather_op = None
+        self._updater = None
+        if self.sparse_optimizer == "adagrad":
+            self._accum = None
+        _jax.clear_caches()
+
+    # -- inspection ------------------------------------------------------
+    def rows(self, ids):
+        """Fetch specific rows to host numpy (debug/eval)."""
+        return np.asarray(jnp.take(self.table._value,
+                                   jnp.asarray(ids), axis=0))
+
+    def table_memory_kind(self):
+        sh = getattr(self.table._value, "sharding", None)
+        return getattr(sh, "memory_kind", None)

@@ -324,6 +324,10 @@ class GPTForCausalLM(nn.Layer):
         lax.while_loop — see nlp/generation.py). use_compiled=False
         keeps the eager per-token loop (growing concat caches) for
         debugging."""
+        if decode_strategy == "greedy_search":
+            # reference spelling; normalize BEFORE the eager-path check
+            # so both loops accept it (ADVICE r4)
+            decode_strategy = "greedy"
         if not use_compiled and (decode_strategy not in (None, "greedy")
                                  or int(num_return_sequences) != 1
                                  or top_p is not None):

@@ -49,6 +49,15 @@ def linear_bias(x, weight, bias):
 
 # -- dropout -----------------------------------------------------------------
 
+def _use_rbg_dropout():
+    # PADDLE_TPU_RBG_DROPOUT=0 restores the threefry mask stream for
+    # exact-mask reproducibility against pre-r4 goldens (ADVICE r4);
+    # default stays rbg (the threefry path alone cost ~30% of a
+    # BERT-base train step)
+    import os
+    return os.environ.get("PADDLE_TPU_RBG_DROPOUT", "1") != "0"
+
+
 def _fast_bits_key(key):
     """Raw threefry uint32[2] -> typed rbg key. The mask bits then come
     from the TPU's rng_bit_generator HLO instead of per-element
@@ -56,7 +65,13 @@ def _fast_bits_key(key):
     train step (25 dropout sites x [B,L,H] masks). rbg is weaker
     statistically but ample for dropout; mask streams differ from the
     threefry ones, so fixed-seed mask values are not stable across this
-    change (distributions and determinism per (seed, draw) are)."""
+    change (distributions and determinism per (seed, draw) are; set
+    PADDLE_TPU_RBG_DROPOUT=0 for the old stream). The rbg key derives
+    from the threefry words by XOR with distinct odd constants (the
+    murmur/boost hash-combine multipliers) purely to decorrelate the
+    four lanes."""
+    if not _use_rbg_dropout():
+        return key
     k = key.reshape(-1).astype(jnp.uint32)
     data = jnp.stack([k[0], k[1],
                       k[0] ^ jnp.uint32(0x9E3779B9),

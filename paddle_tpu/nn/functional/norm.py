@@ -35,6 +35,31 @@ def _bcast(v, ndim, c_axis):
     return v.reshape(shape)
 
 
+def _one_pass_stats(xf, axes):
+    """Shifted one-pass mean/variance (keepdims): E[(x-s)^2] - E[x-s]^2
+    with s a per-slice sample of x (index 0 along each reduced axis).
+
+    Still ONE read of x — the subtraction is elementwise and fuses into
+    the reductions (jnp.var would re-read x after the mean
+    materializes, a full extra activation pass). The shift bounds the
+    cancellation of the raw E[x^2]-mean^2 form, which loses most
+    precision when |mean| >> std (ADVICE r4); variance is
+    shift-invariant, so the result matches the two-pass formula to f32
+    rounding. The Pallas LN kernel uses the centered two-pass form —
+    with the shift both paths agree on ill-conditioned inputs
+    (tests/test_nn_layers.py::TestNormLargeOffset)."""
+    ax = set(a % xf.ndim for a in axes)
+    idx = tuple(slice(0, 1) if i in ax else slice(None)
+                for i in range(xf.ndim))
+    s = xf[idx]
+    xs = xf - s
+    m = jnp.mean(xs, axis=tuple(ax), keepdims=True)
+    var = jnp.maximum(
+        jnp.mean(jnp.square(xs), axis=tuple(ax), keepdims=True)
+        - jnp.square(m), 0.0)
+    return m + s, var
+
+
 def _bn_train_fwd(x, mean_buf, var_buf, weight, bias, momentum, epsilon,
                   c_axis, use_global):
     if use_global:
@@ -42,13 +67,11 @@ def _bn_train_fwd(x, mean_buf, var_buf, weight, bias, momentum, epsilon,
         return y, mean_buf, var_buf
     axes = _bn_stats_axes(x.ndim, c_axis)
     xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
-    # one-pass stats: mean and E[x^2] reduce over a single read of x (XLA
-    # fuses both into the producing conv's epilogue); jnp.var would re-read
-    # x after mean materializes — a full extra activation pass per BN.
-    # E[x^2]-mean^2 can dip negative under cancellation: clamp at 0
-    mean = jnp.mean(xf, axis=axes)
-    var = jnp.maximum(
-        jnp.mean(jnp.square(xf), axis=axes) - jnp.square(mean), 0.0)
+    # shifted one-pass stats (see _one_pass_stats): single read of x,
+    # fused into the producing conv's epilogue, cancellation-safe
+    mean_k, var_k = _one_pass_stats(xf, axes)
+    mean = mean_k.reshape(-1)
+    var = var_k.reshape(-1)
     y = _bn_apply(x, mean, var, weight, bias, epsilon, c_axis)
     new_mean = momentum * mean_buf + (1.0 - momentum) * mean.astype(mean_buf.dtype)
     new_var = momentum * var_buf + (1.0 - momentum) * var.astype(var_buf.dtype)
@@ -146,10 +169,7 @@ def _ln_fwd(x, w, b, n_norm_axes, epsilon):
     axes = tuple(range(x.ndim - n_norm_axes, x.ndim))
     dt = x.dtype
     xf = x.astype(jnp.float32) if dt in (jnp.bfloat16, jnp.float16) else x
-    mean = jnp.mean(xf, axis=axes, keepdims=True)
-    var = jnp.maximum(
-        jnp.mean(jnp.square(xf), axis=axes, keepdims=True)
-        - jnp.square(mean), 0.0)
+    mean, var = _one_pass_stats(xf, axes)
     y = (xf - mean) * jax.lax.rsqrt(var + epsilon)
     if w is not None:
         y = y * w.astype(y.dtype)
@@ -205,10 +225,7 @@ def _in_fwd(x, w, b, epsilon, c_axis):
         tuple(i for i in range(1, x.ndim - 1))
     dt = x.dtype
     xf = x.astype(jnp.float32) if dt in (jnp.bfloat16, jnp.float16) else x
-    mean = jnp.mean(xf, axis=axes, keepdims=True)
-    var = jnp.maximum(
-        jnp.mean(jnp.square(xf), axis=axes, keepdims=True)
-        - jnp.square(mean), 0.0)
+    mean, var = _one_pass_stats(xf, axes)
     y = (xf - mean) * jax.lax.rsqrt(var + epsilon)
     if w is not None:
         y = y * _bcast(w.astype(y.dtype), x.ndim, c_axis)
@@ -242,10 +259,7 @@ def _gn_fwd(x, w, b, groups, epsilon, channel_last):
         c = x.shape[-1]
         gs = xf.reshape(x.shape[:-1] + (groups, c // groups))
         axes = tuple(range(1, x.ndim - 1)) + (x.ndim,)
-        mean = jnp.mean(gs, axis=axes, keepdims=True)
-        var = jnp.maximum(
-            jnp.mean(jnp.square(gs), axis=axes, keepdims=True)
-            - jnp.square(mean), 0.0)
+        mean, var = _one_pass_stats(gs, axes)
         y = ((gs - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x.shape)
         if w is not None:
             y = y * w.astype(y.dtype)
@@ -255,10 +269,7 @@ def _gn_fwd(x, w, b, groups, epsilon, channel_last):
         c = x.shape[1]
         gs = xf.reshape((x.shape[0], groups, c // groups) + x.shape[2:])
         axes = tuple(range(2, gs.ndim))
-        mean = jnp.mean(gs, axis=axes, keepdims=True)
-        var = jnp.maximum(
-            jnp.mean(jnp.square(gs), axis=axes, keepdims=True)
-            - jnp.square(mean), 0.0)
+        mean, var = _one_pass_stats(gs, axes)
         y = ((gs - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x.shape)
         if w is not None:
             y = y * _bcast(w.astype(y.dtype), x.ndim, 1)

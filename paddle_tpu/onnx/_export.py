@@ -444,11 +444,17 @@ def export_onnx(layer, path, input_spec, opset_version=_OPSET):
     model += P.f_bytes(2, "paddle_tpu")
     model += P.f_bytes(3, "0.0")
     model += P.f_msg(7, graph)
-    # the converter emits opset-11 node forms (Slice-with-inputs etc.);
-    # a lower requested opset would mislabel the file, so clamp UP —
-    # declaring a newer opset than requested is valid for consumers
-    model += P.f_msg(8, P.f_bytes(1, "") +
-                     P.f_varint(2, max(int(opset_version), _OPSET)))
+    # the converter emits opset-11 node forms exactly (Slice takes
+    # inputs: needs >=10; ReduceSum/Squeeze axes are ATTRIBUTES:
+    # removed at 13) — any other declared opset would mislabel the
+    # file, so the declaration is pinned at 11 regardless of request
+    if int(opset_version) != _OPSET:
+        import warnings
+        warnings.warn(
+            f"paddle.onnx.export emits opset {_OPSET} node forms; "
+            f"requested opset_version={opset_version} is recorded as "
+            f"{_OPSET}")
+    model += P.f_msg(8, P.f_bytes(1, "") + P.f_varint(2, _OPSET))
 
     out_path = path if path.endswith(".onnx") else path + ".onnx"
     with open(out_path, "wb") as f:

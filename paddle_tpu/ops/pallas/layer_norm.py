@@ -31,6 +31,19 @@ _INTERPRET = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "0") == "1"
 
 DEFAULT_BLOCK_R = 256
 
+# VMEM budget for one grid step's operands+temporaries. The bwd kernel
+# holds dy, x, xhat, a, dx (~6 [BR, C] f32 buffers): with the default
+# BR=256 a large C (>= 8192 f32) would blow VMEM and fail Mosaic
+# compilation at runtime — shrink BR as C grows instead (ADVICE r4).
+_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+_BWD_BUFFERS = 6
+
+
+def _auto_block_r(block, c):
+    cap = _VMEM_BUDGET_BYTES // (_BWD_BUFFERS * 4 * max(c, 1))
+    cap = max(8, (cap // 8) * 8)
+    return min(block, cap)
+
 
 def _fit(block, n):
     return max(8, min(block, n))
@@ -89,6 +102,7 @@ def _pad_rows(x2, br):
 def _ln_fwd(x, w, b, eps, block_r):
     c = x.shape[-1]
     r = _rows(x)
+    block_r = _auto_block_r(block_r, c)
     x2, pad = _pad_rows(x.reshape(r, c), _fit(block_r, r))
     br = _fit(block_r, r)
     n = x2.shape[0] // br
@@ -118,7 +132,7 @@ def _fwd_call(x2, w, b, br, c, n, eps):
 def _ln_bwd(dy, x, w, eps, block_r):
     c = x.shape[-1]
     r = _rows(x)
-    br = _fit(block_r, r)
+    br = _fit(_auto_block_r(block_r, c), r)
     dy2, pad = _pad_rows(dy.reshape(r, c), br)
     x2, _ = _pad_rows(x.reshape(r, c), br)
     n = dy2.shape[0] // br
@@ -172,6 +186,9 @@ def supported(x, w, b, n_norm_axes):
     if n_norm_axes != 1 or w is None or b is None:
         return False
     c = x.shape[-1]
+    # beyond this C even an 8-row block exceeds the VMEM budget
+    if _BWD_BUFFERS * 4 * 8 * c > _VMEM_BUDGET_BYTES:
+        return False
     return (c % 128 == 0 and x.ndim >= 2
             and tuple(w.shape) == (c,) and tuple(b.shape) == (c,)
             and x.dtype in (jnp.bfloat16, jnp.float32, jnp.float16))

@@ -150,7 +150,10 @@ def run_one(name):
     # per-device step FLOPs -> v5p roofline time & MFU estimate at scale
     est_s = flops / V5P_PEAK_BF16
     model_flops = 6.0 * n_params * tokens  # global fwd+bwd FLOPs
-    mfu_est = model_flops / n_dev / V5P_PEAK_BF16 / est_s if est_s else 0.0
+    # fraction of executed FLOPs that are model FLOPs (recompute and
+    # attention overhead lower it) — NOT an MFU prediction
+    flops_frac = model_flops / n_dev / V5P_PEAK_BF16 / est_s \
+        if est_s else 0.0
     rec = {
         "config": name, "n_devices": n_dev, "mesh": spec["mesh"],
         "n_params": n_params,
@@ -162,7 +165,7 @@ def run_one(name):
         "fits_hbm": bool(live <= V5P_HBM),
         "per_device_step_flops": flops,
         "est_step_seconds_v5p": round(est_s, 4),
-        "est_mfu_upper_bound": round(mfu_est, 3),
+        "model_flops_fraction": round(flops_frac, 3),
         "compile_seconds": round(t_compile, 1),
     }
     assert rec["fits_hbm"], (

@@ -163,6 +163,57 @@ class TestNorms:
         np.testing.assert_allclose(y, want, rtol=1e-4, atol=1e-4)
 
 
+class TestNormLargeOffset:
+    """ADVICE r4: the raw one-pass E[x^2]-mean^2 variance loses most
+    precision when |mean| >> std; the shifted one-pass
+    (functional/norm.py _one_pass_stats) must track an f64 two-pass
+    reference on such inputs, for every norm family."""
+
+    def _ill(self, *shape):
+        rs = np.random.RandomState(0)
+        return (1000.0 + 0.1 * rs.randn(*shape)).astype(np.float32)
+
+    def test_layer_norm_large_offset(self):
+        import os
+        os.environ["PADDLE_TPU_FUSED_LN"] = "0"   # exercise the jnp path
+        try:
+            x = self._ill(4, 64)
+            got = F.layer_norm(paddle.to_tensor(x), [64]).numpy()
+        finally:
+            os.environ.pop("PADDLE_TPU_FUSED_LN", None)
+        xf = x.astype(np.float64)
+        want = (xf - xf.mean(-1, keepdims=True)) / np.sqrt(
+            xf.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
+
+    def test_batch_norm_large_offset(self):
+        x = self._ill(8, 4, 6, 6)
+        bn = nn.BatchNorm2D(4)
+        bn.train()
+        got = bn(paddle.to_tensor(x)).numpy()
+        xf = x.astype(np.float64)
+        mu = xf.mean((0, 2, 3), keepdims=True)
+        var = xf.var((0, 2, 3), keepdims=True)
+        want = (xf - mu) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
+
+    def test_group_and_instance_norm_large_offset(self):
+        x = self._ill(2, 4, 5, 5)
+        xf = x.astype(np.float64)
+        got = F.group_norm(paddle.to_tensor(x), 2).numpy()
+        gs = xf.reshape(2, 2, 2, 5, 5)
+        mu = gs.mean((2, 3, 4), keepdims=True)
+        var = gs.var((2, 3, 4), keepdims=True)
+        want = ((gs - mu) / np.sqrt(var + 1e-5)).reshape(x.shape)
+        np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
+
+        got = F.instance_norm(paddle.to_tensor(x)).numpy()
+        mu = xf.mean((2, 3), keepdims=True)
+        var = xf.var((2, 3), keepdims=True)
+        want = (xf - mu) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
+
+
 class TestActivationsAndDropout:
     def test_activations(self):
         x = _randn(3, 5)
