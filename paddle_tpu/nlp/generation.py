@@ -44,16 +44,23 @@ class DecodeCache:
     recompile per step), the buffers here never change shape.
     """
 
-    __slots__ = ("k", "v", "pos", "k_scale", "v_scale")
+    __slots__ = ("k", "v", "pos", "k_scale", "v_scale", "fresh")
 
-    def __init__(self, k, v, pos, k_scale=None, v_scale=None):
+    def __init__(self, k, v, pos, k_scale=None, v_scale=None,
+                 fresh=False):
         self.k = k
         self.v = v
         self.pos = pos
-        # int8 cache mode: k/v hold int8 codes, *_scale [B, max_len, H]
-        # f32 per-(batch, position, head) absmax scales
+        # int8 cache mode: k/v hold int8 codes laid out
+        # [B, H_kv, max_len, D]; *_scale are per-head [H_kv] f32
+        # CONSTANTS from calibration (layout + constant scales are what
+        # let XLA fuse the dequant — see _kv_update_q8_fwd)
         self.k_scale = k_scale
         self.v_scale = v_scale
+        # True only on caches straight out of init_decode_caches (pos
+        # is provably 0 even when it traces as a jit constant): the
+        # int8 multi-token prefill guard keys on this
+        self.fresh = fresh
 
 
 def _kv_update_fwd(buf, upd, pos):
@@ -67,37 +74,52 @@ def _kv_update_fwd(buf, upd, pos):
 register_op("kv_cache_update", _kv_update_fwd)
 
 
-def _kv_update_q8_fwd(buf, sbuf, upd, pos):
-    """Quantize upd [B, l, H, D] to int8 per (b, l, h) and write both
-    the codes and the scales at pos. The int8 cache halves the decode
-    step's dominant HBM stream (BASELINE.md decode roofline); the
-    reference's analogue is the int8 KV of
-    fused_multi_transformer_int8_op.cu."""
+def _kv_update_q8_fwd(buf, upd, pos, scale):
+    """Quantize upd [B, l, H, D] with the per-head CONSTANT scales [H]
+    and write it into the int8 [B, H, max_len, D] cache at pos.
+
+    Design (measured, scripts/decode_roofline.py probes 9-11): the int8
+    cache halves the decode step's dominant HBM stream, but XLA only
+    fuses the dequant into the attention reads when (a) the cache is
+    laid out [B, H, L, D] and (b) the scale is a constant broadcast —
+    per-position runtime scales force a materialized dequantized copy
+    and LOSE 2x. Calibrated per-(layer, head) constants give
+    1.76 -> 1.32 ms/step on GPT-124M bs16. Reference analogue: the
+    int8 KV of fused_multi_transformer_int8_op.cu (also static scales).
+    """
     z = jnp.zeros((), jnp.int32)
     p = pos.astype(jnp.int32).reshape(())
-    amax = jnp.max(jnp.abs(upd.astype(jnp.float32)), axis=-1)
-    scale = jnp.maximum(amax, 1e-9) / 127.0          # [B, l, H]
-    q = jnp.clip(jnp.round(upd.astype(jnp.float32) / scale[..., None]),
+    u = upd.astype(jnp.float32).transpose(0, 2, 1, 3)  # [B,H,l,D]
+    q = jnp.clip(jnp.round(u / scale[None, :, None, None]),
                  -127, 127).astype(jnp.int8)
-    buf = jax.lax.dynamic_update_slice(buf, q, (z, p, z, z))
-    sbuf = jax.lax.dynamic_update_slice(
-        sbuf, scale.astype(sbuf.dtype), (z, p, z))
-    return buf, sbuf
+    return jax.lax.dynamic_update_slice(buf, q, (z, z, p, z))
 
 
 register_op("kv_cache_update_q8", _kv_update_q8_fwd, nondiff=True)
 
 
-def _kv_dequant_fwd(buf, sbuf, out_dtype="bfloat16"):
-    """int8 codes + scales -> float K/V; XLA fuses the convert+scale
-    into the attention matmul's operand read, so HBM traffic stays
-    int8-sized."""
-    return (buf.astype(jnp.float32)
-            * sbuf.astype(jnp.float32)[..., None]) \
-        .astype(jnp.dtype(out_dtype))
+def _kv8_attend_fwd(q, k8, v8, kscale, vscale, mask):
+    """Decode attention over the int8 [B, H_kv, L, D] cache: dequant
+    (convert * constant scale) fuses into the einsum operand reads.
+    q: [B, l, H, D]; mask: additive f32 [1, 1, l, L]; GQA handled by
+    grouping query heads over the kv heads."""
+    b, l, h, d = q.shape
+    hkv = k8.shape[1]
+    rep = h // hkv
+    if mask.dtype == jnp.bool_:
+        mask = jnp.where(mask, jnp.float32(0.0), jnp.float32(-1e30))
+    qf = q.transpose(0, 2, 1, 3).astype(jnp.float32) \
+        .reshape(b, hkv, rep * l, d)
+    kf = k8.astype(jnp.float32) * kscale[None, :, None, None]
+    s = jnp.einsum("bgqd,bgkd->bgqk", qf, kf) / np.sqrt(d)
+    s = s.reshape(b, h, l, -1) + mask
+    a = jax.nn.softmax(s, axis=-1).reshape(b, hkv, rep * l, -1)
+    vf = v8.astype(jnp.float32) * vscale[None, :, None, None]
+    o = jnp.einsum("bgqk,bgkd->bgqd", a, vf)
+    return o.reshape(b, h, l, d).transpose(0, 2, 1, 3).astype(q.dtype)
 
 
-register_op("kv_dequant", _kv_dequant_fwd, nondiff=True)
+register_op("kv8_attend", _kv8_attend_fwd, nondiff=True)
 
 
 def _window_mask_fwd(pos, l, lmax):
@@ -112,28 +134,31 @@ register_op("window_causal_mask", _window_mask_fwd, nondiff=True)
 
 
 def init_decode_caches(n_layers, batch_size, max_len, n_kv_heads,
-                       head_dim, dtype=None, quantized=False):
+                       head_dim, dtype=None, kv_scales=None):
     """Fresh zeroed caches (list of DecodeCache, one per layer).
-    quantized=True builds the int8 cache (codes + per-position-head
-    scales)."""
+
+    kv_scales: per-layer [(k_scale [H_kv], v_scale [H_kv])] float
+    arrays -> build the int8 cache (codes laid out [B, H_kv, L, D],
+    scales baked as constants; see _kv_update_q8_fwd for why)."""
     if dtype is None:
         dtype = dtypes.get_default_dtype().np_dtype
     caches = []
-    for _ in range(n_layers):
-        if quantized:
+    for li in range(n_layers):
+        if kv_scales is not None:
+            ks, vs = kv_scales[li]
             k = Tensor(jnp.zeros(
-                (batch_size, max_len, n_kv_heads, head_dim), jnp.int8),
+                (batch_size, n_kv_heads, max_len, head_dim), jnp.int8),
                 stop_gradient=True)
             v = Tensor(jnp.zeros(
-                (batch_size, max_len, n_kv_heads, head_dim), jnp.int8),
+                (batch_size, n_kv_heads, max_len, head_dim), jnp.int8),
                 stop_gradient=True)
-            ks = Tensor(jnp.zeros((batch_size, max_len, n_kv_heads),
-                                  jnp.float32), stop_gradient=True)
-            vs = Tensor(jnp.zeros((batch_size, max_len, n_kv_heads),
-                                  jnp.float32), stop_gradient=True)
             caches.append(DecodeCache(
                 k, v, Tensor(jnp.zeros((), jnp.int32),
-                             stop_gradient=True), ks, vs))
+                             stop_gradient=True),
+                Tensor(jnp.asarray(ks, jnp.float32),
+                       stop_gradient=True),
+                Tensor(jnp.asarray(vs, jnp.float32),
+                       stop_gradient=True), fresh=True))
             continue
         k = Tensor(jnp.zeros((batch_size, max_len, n_kv_heads, head_dim),
                              dtype), stop_gradient=True)
@@ -173,15 +198,15 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
     from ..ops import manipulation
     quant = cache.k_scale is not None
     if quant:
-        k_buf, ks_buf = apply_op("kv_cache_update_q8", cache.k,
-                                 cache.k_scale, k_new, cache.pos)
-        v_buf, vs_buf = apply_op("kv_cache_update_q8", cache.v,
-                                 cache.v_scale, v_new, cache.pos)
+        k_buf = apply_op("kv_cache_update_q8", cache.k, k_new,
+                         cache.pos, cache.k_scale)
+        v_buf = apply_op("kv_cache_update_q8", cache.v, v_new,
+                         cache.pos, cache.v_scale)
     else:
         k_buf = apply_op("kv_cache_update", cache.k, k_new, cache.pos)
         v_buf = apply_op("kv_cache_update", cache.v, v_new, cache.pos)
-        ks_buf = vs_buf = None
-    l, lmax = q.shape[1], k_buf.shape[1]
+    l = q.shape[1]
+    lmax = k_buf.shape[2] if quant else k_buf.shape[1]
     mask = apply_op("window_causal_mask", cache.pos,
                     attrs=dict(l=int(l), lmax=int(lmax)))
     if attn_mask is not None:
@@ -193,14 +218,36 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
         while m.ndim < 4:
             m = manipulation.unsqueeze(m, axis=0)
         mask = apply_op("decode_merge_mask", mask, m)
+    if quant and l == 1:
+        # decode step over the int8 cache: the dequant (convert x
+        # constant per-head scale) fuses into the attention reads
+        # (decode_roofline probes 9-11)
+        out = apply_op("kv8_attend", q, k_buf, v_buf,
+                       cache.k_scale, cache.v_scale, mask)
+        return out, DecodeCache(k_buf, v_buf, cache.pos + l,
+                                cache.k_scale, cache.v_scale)
     if quant:
-        out_dt = str(q._value.dtype)
-        kf = apply_op("kv_dequant", k_buf, ks_buf,
-                      attrs=dict(out_dtype=out_dt))
-        vf = apply_op("kv_dequant", v_buf, vs_buf,
-                      attrs=dict(out_dtype=out_dt))
+        # multi-token PREFILL on the int8 cache: attend over the raw
+        # float K/V of this chunk. Routing prefill through the int8
+        # cache read makes XLA lower the l x L einsum over dequantized
+        # operands as a serial wide-while loop (measured 46 GB accessed
+        # per generate). Attending only the chunk is exact ONLY when
+        # the cache holds nothing yet — reject chunked prefill rather
+        # than silently dropping cached context.
+        if not (cache.fresh or _is_zero_pos(cache.pos)):
+            raise NotImplementedError(
+                "int8 KV cache: multi-token writes are only supported "
+                "at pos==0 (single prefill). Chunked prefill / "
+                "multi-token continuation needs the dequantized read "
+                "path — use the bf16 cache for that call pattern.")
+        kf, vf = k_new, v_new
+        # first l cache slots ARE this chunk: slice the merged mask
+        mask = mask[:, :, :, :l]
+        new_cache = DecodeCache(k_buf, v_buf, cache.pos + l,
+                                cache.k_scale, cache.v_scale)
     else:
         kf, vf = k_buf, v_buf
+        new_cache = DecodeCache(k_buf, v_buf, cache.pos + l)
     n_rep = q.shape[2] // kf.shape[2]
     if n_rep > 1:
         kf = manipulation.repeat_interleave(kf, n_rep, axis=2)
@@ -208,7 +255,18 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
     out = F.scaled_dot_product_attention(
         q, kf, vf, attn_mask=mask, dropout_p=dropout_p, is_causal=False,
         training=training)
-    return out, DecodeCache(k_buf, v_buf, cache.pos + l, ks_buf, vs_buf)
+    return out, new_cache
+
+
+def _is_zero_pos(pos):
+    """True iff the cache position is provably 0 (a concrete zero).
+    Inside the compiled generator the prefill pos is the concrete
+    jnp.zeros(()) from init_decode_caches, so this stays decidable
+    under trace; a data-dependent pos is treated as non-zero."""
+    v = pos._value
+    if isinstance(v, jax.core.Tracer):
+        return False
+    return int(np.asarray(v)) == 0
 
 
 def _pack_caches(caches):
@@ -276,6 +334,7 @@ class CompiledGenerator:
                 f"kv_cache_dtype must be None (model dtype) or 'int8', "
                 f"got {kv_cache_dtype!r}")
         self.kv_int8 = kv_cache_dtype == "int8"
+        self._kv_scales = None   # per-layer (k[Hkv], v[Hkv]) constants
         self.temperature = float(temperature)
         self.top_k = top_k
         self.top_p = top_p
@@ -302,6 +361,33 @@ class CompiledGenerator:
         self.state_tensors = params + buffers
         self._state_ids = tuple(id(t._value) for t in self.state_tensors)
         self._traces = {}
+
+    def _calibrate_kv_scales(self, ids):
+        """One eager bf16-cache prefill over the first prompt measures
+        per-(layer, head) K/V absmax; scales (x1.27 headroom for later
+        tokens, /127) are then baked into the int8 cache as constants
+        (see _kv_update_q8_fwd). The reference's int8 decoder likewise
+        ships calibrated static scales
+        (fused_multi_transformer_int8_op.cu)."""
+        from ..core.tensor import no_grad
+        batch, plen = int(ids.shape[0]), int(ids.shape[1])
+        fp = next((t._value.dtype for t in self.state_tensors
+                   if jnp.issubdtype(t._value.dtype, jnp.floating)),
+                  dtypes.get_default_dtype().np_dtype)
+        with no_grad():
+            caches = init_decode_caches(self.n_layers, batch, plen,
+                                        self.n_kv, self.head_dim,
+                                        dtype=fp)
+            _, caches = self.model(ids, caches=caches)
+        scales = []
+        for c in caches:
+            ka = np.asarray(jnp.max(jnp.abs(
+                c.k._value.astype(jnp.float32)), axis=(0, 1, 3)))
+            va = np.asarray(jnp.max(jnp.abs(
+                c.v._value.astype(jnp.float32)), axis=(0, 1, 3)))
+            scales.append((np.maximum(ka * 1.27, 1e-6) / 127.0,
+                           np.maximum(va * 1.27, 1e-6) / 127.0))
+        return scales
 
     def _sample(self, logits, key):
         strat = self.decode_strategy
@@ -344,7 +430,9 @@ class CompiledGenerator:
                     t._value = v
                 caches = init_decode_caches(
                     self.n_layers, batch, max_len, self.n_kv,
-                    self.head_dim, dtype=fp, quantized=self.kv_int8)
+                    self.head_dim, dtype=fp,
+                    kv_scales=self._kv_scales if self.kv_int8
+                    else None)
                 logits_t, caches = model(Tensor(prompt), caches=caches)
                 last = logits_t._value[:, -1, :].astype(jnp.float32)
                 ct = _pack_caches(caches)
@@ -439,7 +527,9 @@ class CompiledGenerator:
                 prompt_k = jnp.repeat(prompt, K, axis=0)  # [B*K, L]
                 caches = init_decode_caches(
                     self.n_layers, BK, max_len, self.n_kv,
-                    self.head_dim, dtype=fp, quantized=self.kv_int8)
+                    self.head_dim, dtype=fp,
+                    kv_scales=self._kv_scales if self.kv_int8
+                    else None)
                 logits_t, caches = model(Tensor(prompt_k), caches=caches)
                 last = logits_t._value[:, -1, :].astype(jnp.float32)
                 V = last.shape[-1]
@@ -493,10 +583,9 @@ class CompiledGenerator:
                     flat = (jnp.arange(batch, dtype=jnp.int32)[:, None]
                             * K + beam_src).reshape(-1)
                     ct = tuple(
-                        tuple(None if a is None
-                              else jnp.take(a, flat, axis=0)
-                              for a in layer)
-                        for layer in ct)
+                        (jnp.take(k, flat, axis=0),
+                         jnp.take(v, flat, axis=0), ks, vs)
+                        for (k, v, ks, vs) in ct)
                     pos = prompt_len + i
                     caches = _unpack_caches(ct, pos)
                     lg, caches = model(Tensor(tok.reshape(BK, 1)),
@@ -561,6 +650,15 @@ class CompiledGenerator:
             self._traces.clear()
             self.state_tensors = cur_state
             self._state_ids = state_ids
+            self._kv_scales = None     # weights changed: recalibrate
+        if self.kv_int8 and self._kv_scales is None:
+            was_training = getattr(self.model, "training", False)
+            self.model.eval()
+            try:
+                self._kv_scales = self._calibrate_kv_scales(ids)
+            finally:
+                if was_training:
+                    self.model.train()
         cached = self._traces.get(sig)
         if cached is None:
             if len(self._traces) >= 8:

@@ -76,12 +76,20 @@ def main():
     # Decode is weight-bandwidth-bound (BASELINE.md roofline), so
     # narrowing the weight stream converts directly into tokens/s.
     bf16_out = out.numpy()
-    runs = (
-        # (weight algo, group, kv dtype, tag)
-        (None, None, "int8", "kv8"),
-        ("weight_only_int8", None, None, "int8"),
-        ("weight_only_int8", None, "int8", "int8+kv8"),
-    )
+    # Quantized variants are opt-in (--quant): under the r5
+    # weights-as-constants regime bf16 is the fastest stable config at
+    # this model size (BASELINE.md decode roofline), int8 weights
+    # measure 0.87x, and the int8 KV cache — despite a probe-proven
+    # 1.32 ms/step ceiling — currently trips an XLA/Mosaic fault at
+    # full generation length on the tunneled chip (worker crash;
+    # documented in BASELINE.md). Keep the driver bench deterministic.
+    runs = ()
+    if "--quant" in sys.argv:
+        runs = (
+            # (weight algo, group, kv dtype, tag)
+            ("weight_only_int8", None, None, "int8"),
+            (None, None, "int8", "kv8"),
+        )
     for algo, gsz, kvdt, tag in runs:
         from paddle_tpu.nn import quant as nnq
         paddle.seed(0)

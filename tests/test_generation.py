@@ -422,3 +422,109 @@ class TestBeamSearchTopP:
         with _pytest.raises(ValueError):
             m.generate(ids, max_new_tokens=3, decode_strategy="greedy",
                        num_return_sequences=2)
+
+
+class TestInt8KVCache:
+    """Calibrated int8 KV cache (kv_cache_dtype='int8'): per-head
+    constant scales, [B, H, L, D] codes, fused dequant reads
+    (reference: the static-scale int8 KV of
+    fused_multi_transformer_int8_op.cu; design record in BASELINE.md
+    decode roofline)."""
+
+    def _gpt(self):
+        from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+        cfg = GPTConfig(vocab_size=512, hidden_size=64,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        max_position_embeddings=128,
+                        hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+        paddle.seed(11)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        return m
+
+    def test_greedy_token_exact_vs_bf16(self):
+        m = self._gpt()
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 512, (2, 12)))
+        a = m.generate(ids, max_new_tokens=8).numpy()
+        b = m.generate(ids, max_new_tokens=8,
+                       kv_cache_dtype="int8").numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_eos_and_beam_paths(self):
+        m = self._gpt()
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 512, (2, 12)))
+        c = m.generate(ids, max_new_tokens=8, eos_token_id=3,
+                       kv_cache_dtype="int8").numpy()
+        assert c.shape == (2, 20)
+        d0 = m.generate(ids, max_new_tokens=6,
+                        decode_strategy="beam_search",
+                        num_beams=3).numpy()
+        d = m.generate(ids, max_new_tokens=6,
+                       decode_strategy="beam_search", num_beams=3,
+                       kv_cache_dtype="int8").numpy()
+        np.testing.assert_array_equal(d, d0)
+
+    def test_gqa_llama_token_exact(self):
+        from paddle_tpu.nlp import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, intermediate_size=128,
+                          max_position_embeddings=64)
+        paddle.seed(3)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        ids = paddle.to_tensor(
+            np.random.RandomState(1).randint(0, 256, (2, 8)))
+        a = m.generate(ids, max_new_tokens=6).numpy()
+        b = m.generate(ids, max_new_tokens=6,
+                       kv_cache_dtype="int8").numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_kv8_attend_matches_dequant_reference(self):
+        """Direct op check: kv8_attend == softmax(QK^T/sqrt(d))V over
+        the dequantized cache (independent numpy reference)."""
+        from paddle_tpu.nlp.generation import _kv8_attend_fwd
+        import jax.numpy as jnp
+        rs = np.random.RandomState(5)
+        B, H, L, D, l = 2, 4, 16, 8, 1
+        k8 = rs.randint(-127, 128, (B, H, L, D)).astype(np.int8)
+        v8 = rs.randint(-127, 128, (B, H, L, D)).astype(np.int8)
+        ks = rs.uniform(0.01, 0.02, (H,)).astype(np.float32)
+        vs = rs.uniform(0.01, 0.02, (H,)).astype(np.float32)
+        q = rs.randn(B, l, H, D).astype(np.float32)
+        mask = np.zeros((1, 1, l, L), np.float32)
+        mask[..., 10:] = -1e30          # only first 10 slots visible
+        got = np.asarray(_kv8_attend_fwd(
+            jnp.asarray(q), jnp.asarray(k8), jnp.asarray(v8),
+            jnp.asarray(ks), jnp.asarray(vs), jnp.asarray(mask)))
+        kf = k8.astype(np.float64) * ks[None, :, None, None]
+        vf = v8.astype(np.float64) * vs[None, :, None, None]
+        qf = np.transpose(q, (0, 2, 1, 3)).astype(np.float64)
+        s = np.einsum("bhqd,bhkd->bhqk", qf, kf) / np.sqrt(D)
+        s = s + mask
+        e = np.exp(s - s.max(-1, keepdims=True))
+        a = e / e.sum(-1, keepdims=True)
+        want = np.einsum("bhqk,bhkd->bhqd", a, vf)
+        want = np.transpose(want, (0, 2, 1, 3))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_chunked_prefill_rejected(self):
+        """Multi-token write at pos>0 on the int8 cache must raise, not
+        silently drop cached context."""
+        from paddle_tpu.nlp.generation import (init_decode_caches,
+                                               update_and_attend)
+        import jax.numpy as jnp
+        from paddle_tpu.core.tensor import Tensor
+        scales = [(np.full((2,), 0.01, np.float32),
+                   np.full((2,), 0.01, np.float32))]
+        caches = init_decode_caches(1, 1, 16, 2, 4, kv_scales=scales)
+        rs = np.random.RandomState(0)
+        q = Tensor(jnp.asarray(rs.randn(1, 3, 2, 4).astype(np.float32)))
+        out, c2 = update_and_attend(q, q, q, caches[0])   # pos 0: ok
+        assert out.shape == [1, 3, 2, 4]
+        import pytest as _pytest
+        with _pytest.raises(NotImplementedError):
+            update_and_attend(q, q, q, c2)               # pos 3, l 3

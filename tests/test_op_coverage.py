@@ -31,6 +31,10 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+# import every op-registering module explicitly so the registry the
+# closure test sees does not depend on which other tests ran first
+import paddle_tpu.nlp.generation  # noqa: F401  (decode cache ops)
+import paddle_tpu.nlp.llama       # noqa: F401  (rope ops)
 from paddle_tpu.core.dispatch import _OPS
 from paddle_tpu.ops._helpers import apply_op
 
@@ -887,9 +891,22 @@ ELSEWHERE = {
     # moe — tests/test_distributed.py
     "moe_dispatch": EW("test_distributed.py", "MoE|moe"),
     "moe_combine": EW("test_distributed.py", "MoE|moe"),
+    # compiled-decode cache ops — tests/test_generation.py (greedy/eos/
+    # beam/kv8 paths) + tests/test_weight_only_quant.py
+    **{n: EW("test_generation.py", "generate|DecodeCache") for n in [
+        "kv_cache_update", "window_causal_mask", "decode_merge_mask"]},
+    **{n: EW("test_generation.py", "kv_cache_dtype") for n in [
+        "kv_cache_update_q8", "kv8_attend"]},
+    # rotary embedding — tests/test_nlp_models.py (Llama family)
+    "rope": EW("test_nlp_models.py", "Llama|rope"),
+    "rope_dyn": EW("test_nlp_models.py", "Llama|rope"),
     # quantization — tests/test_inference_quant.py
     "fake_quantize_dequantize": EW("test_inference_quant.py",
                                    "quant"),
+    # weight-only / int8 compute — tests/test_weight_only_quant.py
+    **{n: EW("test_weight_only_quant.py", "weight_quantize|llm_int8")
+       for n in ["weight_only_matmul", "wq_dequant", "wq_unpack_int4",
+                 "llm_int8_matmul"]},
     # indexing protocol ops — tests/test_ops_math.py
     "getitem": EW("test_ops_math.py", "getitem|__getitem__|slice"),
     "setitem": EW("test_op_coverage.py", "def test_setitem_direct"),
