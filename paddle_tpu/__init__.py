@@ -108,6 +108,7 @@ from . import incubate  # noqa: E402,F401
 from . import text  # noqa: E402,F401
 from . import audio  # noqa: E402,F401
 from . import inference  # noqa: E402,F401
+from . import serving  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import geometric  # noqa: E402,F401
 from . import static  # noqa: E402,F401

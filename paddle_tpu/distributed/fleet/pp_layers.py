@@ -59,7 +59,10 @@ import warnings
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax<0.5: not yet promoted out of experimental
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ...nn.layer.layers import Layer

@@ -17,7 +17,10 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax<0.5: not yet promoted out of experimental
+    from jax.experimental.shard_map import shard_map
 
 __all__ = ["ring_attention", "ring_attention_sharded"]
 

@@ -18,7 +18,10 @@ from __future__ import annotations
 import math
 
 import jax
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax<0.5: not yet promoted out of experimental
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec, NamedSharding
 
 __all__ = ["ulysses_attention", "ulysses_attention_sharded"]

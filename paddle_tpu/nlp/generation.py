@@ -32,7 +32,7 @@ from ..core import dtype as dtypes
 from ..ops._helpers import apply_op, as_tensor
 
 __all__ = ["DecodeCache", "init_decode_caches", "update_and_attend",
-           "CompiledGenerator"]
+           "CompiledGenerator", "decode_model_step", "sample_logits"]
 
 
 class DecodeCache:
@@ -64,9 +64,21 @@ class DecodeCache:
 
 
 def _kv_update_fwd(buf, upd, pos):
+    p = pos.astype(jnp.int32)
+    if p.ndim == 1:
+        # per-row positions (continuous batching): each batch row writes
+        # its own offset — a batched dynamic-update-slice, which keeps
+        # the serving decode step ONE fixed-shape program while every
+        # slot sits at a different sequence position
+        z = jnp.zeros((), jnp.int32)
+
+        def row(b, u, q):
+            return jax.lax.dynamic_update_slice(
+                b, u.astype(b.dtype), (q,) + (z,) * (b.ndim - 1))
+
+        return jax.vmap(row)(buf, upd, p)
     z = jnp.zeros((), jnp.int32)
-    starts = [z, pos.astype(jnp.int32).reshape(())] + \
-        [z] * (buf.ndim - 2)
+    starts = [z, p.reshape(())] + [z] * (buf.ndim - 2)
     return jax.lax.dynamic_update_slice(buf, upd.astype(buf.dtype),
                                         starts)
 
@@ -123,11 +135,15 @@ register_op("kv8_attend", _kv8_attend_fwd, nondiff=True)
 
 
 def _window_mask_fwd(pos, l, lmax):
-    """Bool mask [1, 1, l, lmax]: key j visible to query i iff
-    j <= pos + i (causal within the valid window of a static cache)."""
+    """Bool mask: key j visible to query i iff j <= pos + i (causal
+    within the valid window of a static cache). Scalar pos ->
+    [1, 1, l, lmax]; per-row pos vector [B] -> [B, 1, l, lmax]."""
+    p = pos.astype(jnp.int32)
     i = jnp.arange(l, dtype=jnp.int32)[:, None]
     j = jnp.arange(lmax, dtype=jnp.int32)[None, :]
-    return (j <= (i + pos.astype(jnp.int32)))[None, None]
+    if p.ndim == 1:
+        return (j[None] <= (i[None] + p[:, None, None]))[:, None]
+    return (j <= (i + p))[None, None]
 
 
 register_op("window_causal_mask", _window_mask_fwd, nondiff=True)
@@ -197,6 +213,11 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
     from ..nn import functional as F
     from ..ops import manipulation
     quant = cache.k_scale is not None
+    if quant and getattr(cache.pos._value, "ndim", 0) == 1:
+        raise NotImplementedError(
+            "int8 KV cache: per-row position vectors (continuous "
+            "batching) need a rowwise quantized update path — use the "
+            "bf16/f32 cache for serving")
     if quant:
         k_buf = apply_op("kv_cache_update_q8", cache.k, k_new,
                          cache.pos, cache.k_scale)
@@ -285,6 +306,39 @@ def _unpack_caches(ct, pos):
                         None if ks is None else Tensor(ks),
                         None if vs is None else Tensor(vs))
             for k, v, ks, vs in ct]
+
+
+def decode_model_step(model, tokens, caches):
+    """One fixed-shape decode step, shared by CompiledGenerator's loop
+    body and the serving engine (serving/engine.py): feed `tokens`
+    [B, l] (a raw int array) through the model against the static
+    caches and return (last-position logits as f32 [B, V], advanced
+    caches). With a per-row `pos` vector in the caches this is the
+    continuous-batching step: every row advances from its own position
+    inside one compiled program."""
+    lg, caches = model(Tensor(tokens), caches=caches)
+    return lg._value[:, -1, :].astype(jnp.float32), caches
+
+
+def sample_logits(logits, key, temperature=1.0, top_k=None, top_p=None,
+                  strategy=None):
+    """Next-token selection over f32 logits [B, V] — the sampling half
+    of the decode step, factored out of CompiledGenerator._build so the
+    serving engine shares it. strategy None keeps the legacy rule:
+    argmax unless top_k/top_p request sampling."""
+    if strategy == "greedy":
+        return jnp.argmax(logits, axis=-1)
+    if temperature != 1.0:
+        logits = logits / temperature
+    stochastic = (strategy == "sampling") or top_k or top_p
+    if top_k:
+        vals, _ = jax.lax.top_k(logits, int(top_k))
+        logits = jnp.where(logits < vals[:, -1:], -1e30, logits)
+    if top_p:
+        logits = _top_p_filter(logits, float(top_p))
+    if stochastic:
+        return jax.random.categorical(key, logits, axis=-1)
+    return jnp.argmax(logits, axis=-1)
 
 
 def _top_p_filter(logits, p):
@@ -390,20 +444,9 @@ class CompiledGenerator:
         return scales
 
     def _sample(self, logits, key):
-        strat = self.decode_strategy
-        if strat == "greedy":
-            return jnp.argmax(logits, axis=-1)
-        if self.temperature != 1.0:
-            logits = logits / self.temperature
-        stochastic = (strat == "sampling") or self.top_k or self.top_p
-        if self.top_k:
-            vals, _ = jax.lax.top_k(logits, int(self.top_k))
-            logits = jnp.where(logits < vals[:, -1:], -1e30, logits)
-        if self.top_p:
-            logits = _top_p_filter(logits, float(self.top_p))
-        if stochastic:
-            return jax.random.categorical(key, logits, axis=-1)
-        return jnp.argmax(logits, axis=-1)
+        return sample_logits(logits, key, temperature=self.temperature,
+                             top_k=self.top_k, top_p=self.top_p,
+                             strategy=self.decode_strategy)
 
     def _build(self, batch, prompt_len, max_new):
         model = self.model
@@ -452,9 +495,8 @@ class CompiledGenerator:
                         done = done | (nxt == eos)
                     pos = prompt_len + i
                     caches = _unpack_caches(ct, pos)
-                    lg, caches = model(Tensor(nxt[:, None]),
-                                       caches=caches)
-                    last = lg._value[:, -1, :].astype(jnp.float32)
+                    last, caches = decode_model_step(model, nxt[:, None],
+                                                     caches)
                     return last, _pack_caches(caches), out, key, done
 
                 if eos is None:
@@ -588,9 +630,8 @@ class CompiledGenerator:
                         for (k, v, ks, vs) in ct)
                     pos = prompt_len + i
                     caches = _unpack_caches(ct, pos)
-                    lg, caches = model(Tensor(tok.reshape(BK, 1)),
-                                       caches=caches)
-                    last = lg._value[:, -1, :].astype(jnp.float32)
+                    last, caches = decode_model_step(
+                        model, tok.reshape(BK, 1), caches)
                     return (i + jnp.int32(1), last, _pack_caches(caches),
                             tokens, scores, done, lens)
 

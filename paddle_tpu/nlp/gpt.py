@@ -198,9 +198,17 @@ class GPTEmbeddings(nn.Layer):
         l = input_ids.shape[1]
         if position_ids is None:
             if isinstance(offset, Tensor):
-                # traced offset (static-cache decode): arange(l) + pos
-                position_ids = creation.arange(0, l, dtype="int64") + \
-                    offset.astype("int64")
+                ar = creation.arange(0, l, dtype="int64")
+                off = offset.astype("int64")
+                if len(off.shape) == 1:
+                    # per-row offsets (continuous-batching decode): each
+                    # slot sits at its own position -> ids [B, l]
+                    from ..ops import manipulation
+                    position_ids = manipulation.unsqueeze(ar, axis=0) + \
+                        manipulation.unsqueeze(off, axis=1)
+                else:
+                    # traced scalar offset (static-cache decode)
+                    position_ids = ar + off
             else:
                 position_ids = creation.arange(offset, offset + l,
                                                dtype="int64")

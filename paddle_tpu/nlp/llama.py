@@ -65,14 +65,22 @@ register_op("rope", _rope_fwd)
 
 
 def _rope_dyn_fwd(x, offset, theta):
-    """Rope with a TRACED position offset (static-cache decode): the
-    offset is a scalar int32 array, not a Python int attr."""
+    """Rope with a TRACED position offset (static-cache decode): a
+    scalar int32 array, or a per-row vector [B] (continuous-batching
+    decode, every slot at its own position)."""
     b, l, h, d = x.shape
-    pos = offset.astype(jnp.float32) + jnp.arange(l, dtype=jnp.float32)
+    off = offset.astype(jnp.float32)
+    steps = jnp.arange(l, dtype=jnp.float32)
     inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    freqs = jnp.outer(pos, inv)
-    cos = jnp.cos(freqs)[None, :, None, :]
-    sin = jnp.sin(freqs)[None, :, None, :]
+    if off.ndim == 1:
+        freqs = (off[:, None] + steps[None])[:, :, None] * \
+            inv[None, None, :]                        # [B, L, D/2]
+        cos = jnp.cos(freqs)[:, :, None, :]
+        sin = jnp.sin(freqs)[:, :, None, :]
+    else:
+        freqs = jnp.outer(off + steps, inv)           # [L, D/2]
+        cos = jnp.cos(freqs)[None, :, None, :]
+        sin = jnp.sin(freqs)[None, :, None, :]
     x1 = x[..., 0::2]
     x2 = x[..., 1::2]
     o1 = x1 * cos - x2 * sin

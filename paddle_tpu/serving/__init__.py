@@ -1,0 +1,30 @@
+"""paddle_tpu.serving — continuous-batching online inference.
+
+Wraps the compiled decode path (nlp/generation.py) in a slot-based
+scheduler so requests arriving at different times, with different
+prompt lengths and sampling params, share ONE fixed-shape compiled
+decode step:
+
+    from paddle_tpu.serving import ServingEngine, SamplingParams
+
+    eng = ServingEngine(model, num_slots=8, max_len=256)
+    req = eng.add_request(prompt_ids,
+                          SamplingParams(max_new_tokens=32,
+                                         eos_token_id=eos))
+    while eng.has_work:
+        for out in eng.step():
+            print(out.request_id, out.token_ids, out.finish_reason)
+    print(eng.metrics.snapshot()["ttft_s"])
+
+Greedy requests are bit-identical to offline CompiledGenerator decode
+(tested); `scripts/serving_bench.py` drives a Poisson arrival trace and
+reports TTFT/throughput.
+"""
+from .engine import ServingEngine  # noqa: F401
+from .metrics import Histogram, ServingMetrics  # noqa: F401
+from .request import (Request, RequestOutput, RequestState,  # noqa: F401
+                      SamplingParams)
+from .scheduler import Scheduler  # noqa: F401
+
+__all__ = ["ServingEngine", "Scheduler", "ServingMetrics", "Histogram",
+           "Request", "RequestOutput", "RequestState", "SamplingParams"]

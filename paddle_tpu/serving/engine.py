@@ -1,0 +1,390 @@
+"""ServingEngine: continuous batching over the compiled decode path.
+
+The engine owns a fixed-slot batch (default 8 slots) of static KV
+caches — the SAME buffers `nlp.generation` uses offline, stacked along
+the batch axis with one `pos` PER SLOT — and exactly two compiled
+programs touch them:
+
+- one decode step, shared by all slots: sample each slot's next token
+  from its held logits (per-slot temperature/top-k/top-p vectors, same
+  math as CompiledGenerator via `sample_logits`/`_top_p_filter`), then
+  one fixed-shape batched forward through the model where every row
+  reads/writes its own cache position (the per-row `pos` vector path in
+  `kv_cache_update`/`window_causal_mask`). Membership, lengths, and
+  sampling params change BETWEEN invocations only — the program never
+  retraces (the slot-granularity analogue of Ragged Paged Attention's
+  one-kernel-for-uneven-lengths, PAPERS.md; keeping the hot loop one
+  fixed program is what lets XLA fuse it, "Operator Fusion in XLA").
+- one prefill per prompt length: a batch-1 forward over a fresh cache
+  whose full KV rows are then written into the free slot of the shared
+  buffers with a single dynamic_update_slice, plus that request's
+  next-token logits into the held-logits row.
+
+Correctness contract (tests/test_serving.py): a request decoded greedily
+through the engine emits tokens bit-identical to running it ALONE
+through CompiledGenerator greedy decode, regardless of what its
+slot-neighbors are doing — per-row compute is row-independent and
+membership changes only rewrite the changed slot's rows.
+
+Weights enter both programs as closed-over constants (the measured
+layout win of generation.py's _build); construct the engine AFTER any
+weight rebinding (quantization etc.) — it snapshots model state.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core import random as random_mod
+from ..core.tensor import Tensor
+from ..profiler import RecordEvent
+from ..nlp.generation import (_pack_caches, _top_p_filter,
+                              _unpack_caches, decode_model_step,
+                              init_decode_caches)
+from .metrics import ServingMetrics
+from .request import Request, RequestOutput, RequestState, SamplingParams
+from .scheduler import Scheduler
+
+__all__ = ["ServingEngine"]
+
+
+def _sample_rows(logits, key, temps, top_k, top_p, greedy):
+    """Per-slot sampling over f32 logits [S, V]: each row applies ITS
+    OWN temperature/top-k/top-p (vectors [S]); greedy rows take argmax
+    of the raw logits — exactly CompiledGenerator's greedy step, so
+    greedy requests stay bit-identical to offline decode. top_k == 0
+    and top_p == 1.0 disable the respective filter for that row; the
+    nucleus mask is the same `_top_p_filter` the offline path uses."""
+    v = logits.shape[-1]
+    g = jnp.argmax(logits, axis=-1)
+    l = logits / temps[:, None]
+    sorted_desc = -jnp.sort(-l, axis=-1)
+    kidx = (jnp.clip(top_k, 1, v) - 1).astype(jnp.int32)
+    kth = jnp.take_along_axis(sorted_desc, kidx[:, None], axis=-1)
+    l = jnp.where((top_k > 0)[:, None] & (l < kth), -1e30, l)
+    filt = _top_p_filter(l, top_p[:, None])
+    l = jnp.where((top_p < 1.0)[:, None], filt, l)
+    s = jax.random.categorical(key, l, axis=-1)
+    return jnp.where(greedy, g, s)
+
+
+class ServingEngine:
+    """Online inference engine: submit requests at any time, pump
+    `step()` (or call `run()`/`generate()`); requests join free slots,
+    decode together in one compiled step, and retire on EOS /
+    max-tokens / timeout / cancellation without perturbing neighbors.
+    """
+
+    def __init__(self, model, cache_spec=None, *, num_slots: int = 8,
+                 max_len: int = 256, scheduler: Optional[Scheduler] = None,
+                 metrics: Optional[ServingMetrics] = None,
+                 max_queue: Optional[int] = None, clock=time.monotonic):
+        if cache_spec is None:
+            if not hasattr(model, "_decode_cache_spec"):
+                raise ValueError(
+                    "cache_spec not given and the model has no "
+                    "_decode_cache_spec(); pass (n_layers, n_kv_heads, "
+                    "head_dim) explicitly")
+            cache_spec = model._decode_cache_spec()
+        self.model = model
+        self.n_layers, self.n_kv, self.head_dim = cache_spec
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.scheduler = scheduler or Scheduler(self.num_slots,
+                                                max_queue=max_queue)
+        if self.scheduler.num_slots != self.num_slots:
+            raise ValueError("scheduler.num_slots != engine num_slots")
+        self.metrics = metrics or ServingMetrics()
+        self._clock = clock
+        self._id_counter = itertools.count()
+        self._requests: Dict[str, Request] = {}
+        # model-state snapshot: weights are constants in the compiled
+        # programs (see module doc)
+        params = list(model.parameters())
+        buffers = [b for _, b in model.named_buffers()]
+        self._state_tensors = params + buffers
+        self._fp = next(
+            (t._value.dtype for t in self._state_tensors
+             if jnp.issubdtype(t._value.dtype, jnp.floating)),
+            dtypes.get_default_dtype().np_dtype)
+        # device state: stacked KV rows, per-slot positions, per-slot
+        # held next-token logits (filled by prefill, advanced by decode)
+        self._ct = _pack_caches(init_decode_caches(
+            self.n_layers, self.num_slots, self.max_len, self.n_kv,
+            self.head_dim, dtype=self._fp))
+        self._pos = jnp.zeros((self.num_slots,), jnp.int32)
+        self._last_logits = None      # [S, V] f32, lazy (V from prefill)
+        # per-slot sampling vectors, rebuilt when membership changes
+        self._vec_dirty = True
+        self._temps = np.ones((self.num_slots,), np.float32)
+        self._topk = np.zeros((self.num_slots,), np.int32)
+        self._topp = np.ones((self.num_slots,), np.float32)
+        self._greedy = np.ones((self.num_slots,), bool)
+        self._active = np.zeros((self.num_slots,), bool)
+        self._prefill_fns: Dict[int, object] = {}
+        self._decode_fn = None
+        self._spans: Dict[str, RecordEvent] = {}
+
+    # -- compiled programs -------------------------------------------------
+    def _swap_state(self, state_vals):
+        originals = [t._value for t in self._state_tensors]
+        for t, v in zip(self._state_tensors, state_vals):
+            t._value = v
+        return originals
+
+    def _restore_state(self, originals):
+        for t, v in zip(self._state_tensors, originals):
+            t._value = v
+
+    def _build_prefill(self, prompt_len: int):
+        """Compiled per prompt length: batch-1 prefill over a fresh
+        cache, then write the whole KV row + next-token logits into the
+        free slot of the shared buffers."""
+        model = self.model
+        n_layers, n_kv, head_dim = self.n_layers, self.n_kv, self.head_dim
+        max_len, fp = self.max_len, self._fp
+        state_vals = [t._value for t in self._state_tensors]
+
+        def prefill(state_vals, ct, pos, last_logits, prompt, slot):
+            originals = self._swap_state(state_vals)
+            try:
+                caches = init_decode_caches(n_layers, 1, max_len, n_kv,
+                                            head_dim, dtype=fp)
+                logits_t, caches = model(Tensor(prompt), caches=caches)
+                row = logits_t._value[:, -1, :].astype(jnp.float32)
+                c1 = _pack_caches(caches)
+                z = jnp.zeros((), jnp.int32)
+                s = slot.astype(jnp.int32).reshape(())
+                new_ct = tuple(
+                    (jax.lax.dynamic_update_slice(
+                        k, k1.astype(k.dtype), (s, z, z, z)),
+                     jax.lax.dynamic_update_slice(
+                        v, v1.astype(v.dtype), (s, z, z, z)),
+                     ks, vs)
+                    for (k, v, ks, vs), (k1, v1, _, _) in zip(ct, c1))
+                pos = jax.lax.dynamic_update_slice(
+                    pos, jnp.full((1,), prompt_len, jnp.int32), (s,))
+                last_logits = jax.lax.dynamic_update_slice(
+                    last_logits, row, (s, jnp.zeros((), jnp.int32)))
+                return new_ct, pos, last_logits
+            finally:
+                self._restore_state(originals)
+
+        return jax.jit(lambda ct, pos, ll, prompt, slot: prefill(
+            state_vals, ct, pos, ll, prompt, slot))
+
+    def _build_decode(self):
+        """ONE fixed-shape step for all slots: sample from held logits
+        with per-slot params, batched forward with per-row positions."""
+        model = self.model
+        state_vals = [t._value for t in self._state_tensors]
+
+        def step(state_vals, ct, pos, last_logits, key, temps, top_k,
+                 top_p, greedy, active):
+            originals = self._swap_state(state_vals)
+            try:
+                nxt = _sample_rows(last_logits, key, temps, top_k,
+                                   top_p, greedy)
+                nxt = jnp.where(active, nxt, 0).astype(jnp.int32)
+                caches = _unpack_caches(ct, pos)
+                last, caches = decode_model_step(model, nxt[:, None],
+                                                 caches)
+                # only occupied slots advance; free rows stay frozen
+                # (their stale rows are fully overwritten at reuse)
+                new_pos = jnp.where(active, pos + 1, pos)
+                return _pack_caches(caches), new_pos, last, nxt
+            finally:
+                self._restore_state(originals)
+
+        return jax.jit(lambda ct, pos, ll, key, t, k, p, g, a: step(
+            state_vals, ct, pos, ll, key, t, k, p, g, a))
+
+    # -- request intake ----------------------------------------------------
+    def add_request(self, prompt_ids, sampling: Optional[SamplingParams]
+                    = None, request_id: Optional[str] = None,
+                    on_token=None) -> Request:
+        sampling = sampling or SamplingParams()
+        if isinstance(prompt_ids, Tensor):
+            prompt_ids = prompt_ids.numpy()
+        prompt = np.asarray(prompt_ids).reshape(-1)
+        if prompt.size >= self.max_len:
+            raise ValueError(
+                f"prompt length {prompt.size} >= engine max_len "
+                f"{self.max_len}")
+        if prompt.size + sampling.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt_len {prompt.size} + max_new_tokens "
+                f"{sampling.max_new_tokens} exceeds engine max_len "
+                f"{self.max_len}; lower max_new_tokens or grow the "
+                "engine's cache")
+        if request_id is None:
+            request_id = f"req-{next(self._id_counter)}"
+        if request_id in self._requests:
+            raise ValueError(f"duplicate request_id {request_id!r}")
+        req = Request(request_id, prompt, sampling, on_token=on_token,
+                      arrival_t=self._clock())
+        self._requests[request_id] = req
+        self.scheduler.submit(req)
+        self.metrics.on_submit(req)
+        return req
+
+    def cancel(self, request_id: str) -> bool:
+        """Mark a request cancelled. Queued requests drop immediately;
+        a running one is evicted at the next step boundary (its slot is
+        then free for the next queued request)."""
+        req = self._requests.get(request_id)
+        if req is None or req.finished:
+            return False
+        if req.state is RequestState.QUEUED:
+            self.scheduler.drop_queued(req)
+            req._finish("cancelled", self._clock())
+            self.metrics.on_finish(req, self._clock())
+            return True
+        req.state = RequestState.CANCELLED
+        return True
+
+    # -- step boundary: retire / admit / decode ----------------------------
+    def _finish_and_free(self, req: Request, reason: str, now: float,
+                         finished: List[RequestOutput]):
+        if req.slot is not None:
+            slot = req.slot
+            self.scheduler.retire(slot)
+            self._active[slot] = False
+            self._vec_dirty = True
+        req._finish(reason, now)
+        self.metrics.on_finish(req, now)
+        span = self._spans.pop(req.request_id, None)
+        if span is not None:
+            span.end()
+        finished.append(req.output())
+
+    def _evict(self, now: float, finished: List[RequestOutput]):
+        for req in self.scheduler.expired(now):
+            if req.state is RequestState.QUEUED:
+                self.scheduler.drop_queued(req)
+            self._finish_and_free(req, "timeout", now, finished)
+        for req in self.scheduler.cancelled_running():
+            self._finish_and_free(req, "cancelled", now, finished)
+
+    def _admit(self, now: float):
+        for slot, req in self.scheduler.assign():
+            req.state = RequestState.PREFILL
+            req.admitted_t = now
+            span = RecordEvent(f"serving::request[{req.request_id}]")
+            span.begin()
+            self._spans[req.request_id] = span
+            self._prefill(slot, req)
+            req.state = RequestState.DECODE
+            self._active[slot] = True
+            self._vec_dirty = True
+            self.metrics.on_admit(req, self._clock())
+
+    def _prefill(self, slot: int, req: Request):
+        plen = int(req.prompt_ids.size)
+        fn = self._prefill_fns.get(plen)
+        if fn is None:
+            fn = self._prefill_fns[plen] = self._build_prefill(plen)
+        if self._last_logits is None:
+            vocab = int(getattr(getattr(self.model, "config", None),
+                                "vocab_size", 0))
+            if not vocab:
+                # probe: one eager forward row tells us V
+                lg = self.model(Tensor(jnp.asarray(
+                    req.prompt_ids[None, :1], jnp.int32)))
+                vocab = int(lg.shape[-1])
+            self._last_logits = jnp.zeros((self.num_slots, vocab),
+                                          jnp.float32)
+        with RecordEvent(f"serving::prefill[{req.request_id}]"):
+            self._ct, self._pos, self._last_logits = fn(
+                self._ct, self._pos, self._last_logits,
+                jnp.asarray(req.prompt_ids[None, :], jnp.int32),
+                jnp.int32(slot))
+
+    def _refresh_vectors(self):
+        for s in range(self.num_slots):
+            req = self.scheduler.running.get(s)
+            if req is None:
+                self._temps[s], self._topk[s] = 1.0, 0
+                self._topp[s], self._greedy[s] = 1.0, True
+                continue
+            sp = req.sampling
+            self._temps[s] = sp.temperature
+            self._topk[s] = sp.top_k or 0
+            self._topp[s] = sp.top_p if sp.top_p is not None else 1.0
+            self._greedy[s] = sp.greedy
+        self._vec_dirty = False
+
+    def _decode(self, now_fn, finished: List[RequestOutput]):
+        if self._decode_fn is None:
+            self._decode_fn = self._build_decode()
+        if self._vec_dirty:
+            self._refresh_vectors()
+        key = random_mod.next_key_host()
+        with RecordEvent("serving::decode_step"):
+            self._ct, self._pos, self._last_logits, toks = \
+                self._decode_fn(
+                    self._ct, self._pos, self._last_logits, key,
+                    jnp.asarray(self._temps), jnp.asarray(self._topk),
+                    jnp.asarray(self._topp), jnp.asarray(self._greedy),
+                    jnp.asarray(self._active))
+            toks = np.asarray(toks)   # sync point: host sees the tokens
+        now = now_fn()
+        for slot, req in list(self.scheduler.running.items()):
+            tok = int(toks[slot])
+            prev_t = req._last_token_t
+            req._emit(tok, now)
+            self.metrics.on_token(req, now)
+            if prev_t is not None:
+                self.metrics.on_inter_token(now - prev_t)
+            sp = req.sampling
+            if sp.eos_token_id is not None and tok == sp.eos_token_id:
+                self._finish_and_free(req, "stop", now, finished)
+            elif len(req.output_tokens) >= sp.max_new_tokens:
+                self._finish_and_free(req, "length", now, finished)
+
+    def step(self) -> List[RequestOutput]:
+        """One scheduler round: evict (timeout/cancel), refill free
+        slots (prefill), then one compiled decode step for everyone.
+        Returns requests that finished this round."""
+        finished: List[RequestOutput] = []
+        now = self._clock()
+        self._evict(now, finished)
+        self._admit(now)
+        if self.scheduler.running:
+            self._decode(self._clock, finished)
+        self.metrics.on_step(self.scheduler.queue_depth,
+                             self.scheduler.occupancy, self.num_slots)
+        return finished
+
+    # -- conveniences ------------------------------------------------------
+    @property
+    def has_work(self) -> bool:
+        return self.scheduler.has_work
+
+    def run(self, max_steps: Optional[int] = None) -> List[RequestOutput]:
+        """Pump steps until idle (or max_steps); returns everything that
+        finished along the way."""
+        out: List[RequestOutput] = []
+        steps = 0
+        while self.has_work:
+            out.extend(self.step())
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return out
+
+    def generate(self, prompts: Sequence, sampling=None
+                 ) -> List[RequestOutput]:
+        """Blocking batch API: submit all prompts, run to completion,
+        return outputs in submission order."""
+        if sampling is None or isinstance(sampling, SamplingParams):
+            sampling = [sampling] * len(prompts)
+        reqs = [self.add_request(p, sp) for p, sp in zip(prompts, sampling)]
+        self.run()
+        return [r.output() for r in reqs]

@@ -1,0 +1,159 @@
+"""Serving telemetry: counters + histograms + profiler spans.
+
+Two consumers, one source of truth:
+- `ServingMetrics.snapshot()` — a plain dict for dashboards/benches
+  (queue depth, TTFT, inter-token latency, tokens/s, slot occupancy).
+- `profiler.RecordEvent` spans emitted by the engine around prefill,
+  each decode step, and each request's whole residency — so a Chrome
+  trace from a serving run (profiler.Profiler + export) shows the
+  serving timeline next to the op/XLA spans.
+"""
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Optional
+
+__all__ = ["Histogram", "ServingMetrics"]
+
+
+class Histogram:
+    """Bounded-reservoir histogram: running count/sum/min/max over all
+    observations, percentiles over the most recent `maxlen`."""
+
+    def __init__(self, maxlen: int = 8192):
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self._recent = deque(maxlen=maxlen)
+
+    def record(self, v: float):
+        v = float(v)
+        self.count += 1
+        self.total += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        self._recent.append(v)
+
+    def percentile(self, q: float) -> Optional[float]:
+        if not self._recent:
+            return None
+        xs = sorted(self._recent)
+        idx = min(len(xs) - 1, max(0, math.ceil(q / 100.0 * len(xs)) - 1))
+        return xs[idx]
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "mean": (self.total / self.count) if self.count else None,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.percentile(50),
+            "p90": self.percentile(90),
+            "p99": self.percentile(99),
+        }
+
+
+class ServingMetrics:
+    """Engine-owned counters/gauges/histograms. Times are seconds on
+    the engine's clock; tokens/s is measured over the busy window
+    (first admission .. last emitted token)."""
+
+    def __init__(self):
+        # counters
+        self.requests_received = 0
+        self.requests_admitted = 0
+        self.requests_completed = 0
+        self.requests_cancelled = 0
+        self.requests_timeout = 0
+        self.tokens_generated = 0
+        self.prompt_tokens = 0
+        self.prefills = 0
+        self.decode_steps = 0
+        # gauges (last observed at a step boundary)
+        self.queue_depth = 0
+        self.slot_occupancy = 0.0
+        self.num_slots = 0
+        # histograms
+        self.ttft_s = Histogram()
+        self.inter_token_s = Histogram()
+        self.queue_wait_s = Histogram()
+        self.e2e_s = Histogram()
+        self.queue_depth_hist = Histogram()
+        self.occupancy_hist = Histogram()
+        # busy window for throughput
+        self._first_admit_t: Optional[float] = None
+        self._last_token_t: Optional[float] = None
+
+    # -- recording hooks (called by the engine) ---------------------------
+    def on_submit(self, req):
+        self.requests_received += 1
+
+    def on_admit(self, req, now: float):
+        self.requests_admitted += 1
+        self.prefills += 1
+        self.prompt_tokens += int(req.prompt_ids.size)
+        self.queue_wait_s.record(now - req.arrival_t)
+        if self._first_admit_t is None:
+            self._first_admit_t = now
+
+    def on_token(self, req, now: float):
+        self.tokens_generated += 1
+        self._last_token_t = now
+        if len(req.output_tokens) == 1:
+            self.ttft_s.record(now - req.arrival_t)
+
+    def on_inter_token(self, dt: float):
+        self.inter_token_s.record(dt)
+
+    def on_finish(self, req, now: float):
+        if req.finish_reason == "cancelled":
+            self.requests_cancelled += 1
+        elif req.finish_reason == "timeout":
+            self.requests_timeout += 1
+        else:
+            self.requests_completed += 1
+        self.e2e_s.record(now - req.arrival_t)
+
+    def on_step(self, queue_depth: int, occupancy: float, num_slots: int):
+        self.decode_steps += 1
+        self.queue_depth = queue_depth
+        self.slot_occupancy = occupancy
+        self.num_slots = num_slots
+        self.queue_depth_hist.record(queue_depth)
+        self.occupancy_hist.record(occupancy)
+
+    # -- reading ----------------------------------------------------------
+    @property
+    def tokens_per_sec(self) -> Optional[float]:
+        if (self._first_admit_t is None or self._last_token_t is None
+                or self._last_token_t <= self._first_admit_t):
+            return None
+        return self.tokens_generated / (self._last_token_t
+                                        - self._first_admit_t)
+
+    def snapshot(self) -> dict:
+        return {
+            "requests": {
+                "received": self.requests_received,
+                "admitted": self.requests_admitted,
+                "completed": self.requests_completed,
+                "cancelled": self.requests_cancelled,
+                "timeout": self.requests_timeout,
+            },
+            "tokens_generated": self.tokens_generated,
+            "prompt_tokens": self.prompt_tokens,
+            "prefills": self.prefills,
+            "decode_steps": self.decode_steps,
+            "tokens_per_sec": self.tokens_per_sec,
+            "queue_depth": self.queue_depth,
+            "slot_occupancy": self.slot_occupancy,
+            "num_slots": self.num_slots,
+            "ttft_s": self.ttft_s.snapshot(),
+            "inter_token_s": self.inter_token_s.snapshot(),
+            "queue_wait_s": self.queue_wait_s.snapshot(),
+            "e2e_s": self.e2e_s.snapshot(),
+            "queue_depth_hist": self.queue_depth_hist.snapshot(),
+            "occupancy_hist": self.occupancy_hist.snapshot(),
+        }

@@ -1,0 +1,167 @@
+"""Request/response surface of the online serving engine.
+
+A `Request` is one user generation job moving through the continuous-
+batching lifecycle:
+
+    QUEUED -> PREFILL -> DECODE -> FINISHED | CANCELLED
+
+States advance only at step boundaries of the engine (between compiled
+program invocations), never inside one, so the compiled prefill/decode
+programs themselves stay fixed-shape. Per-request sampling knobs live in
+`SamplingParams`; the engine vectorizes them across slots (one value per
+slot row) and evaluates them on device, reusing the same nucleus filter
+(`nlp.generation._top_p_filter`) as the offline CompiledGenerator.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, List, Optional
+
+import numpy as np
+
+__all__ = ["RequestState", "SamplingParams", "Request", "RequestOutput"]
+
+
+class RequestState(Enum):
+    QUEUED = 0
+    PREFILL = 1
+    DECODE = 2
+    FINISHED = 3
+    CANCELLED = 4
+
+
+@dataclass
+class SamplingParams:
+    """Per-request decode knobs (the serving form of the generate()
+    kwargs). greedy=True (default) is argmax decoding — bit-identical
+    to CompiledGenerator's greedy path; setting any of top_k/top_p or
+    greedy=False samples on device with this request's own
+    temperature/top-k/top-p while slot neighbors keep theirs."""
+
+    max_new_tokens: int = 16
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    greedy: bool = True
+    eos_token_id: Optional[int] = None
+    timeout_s: Optional[float] = None
+
+    def __post_init__(self):
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if self.top_k is not None and self.top_k < 1:
+            raise ValueError("top_k must be >= 1")
+        if self.top_p is not None and not (0.0 < self.top_p <= 1.0):
+            raise ValueError("top_p must be in (0, 1]")
+        if self.top_k is not None or self.top_p is not None:
+            self.greedy = False
+
+
+_FINISH_SENTINEL = object()
+
+
+class Request:
+    """One queued/running generation job. Created by
+    ServingEngine.add_request; user-facing handles are the incremental
+    token stream (`on_token` callback or the blocking `stream()`
+    iterator) and the final `RequestOutput`."""
+
+    def __init__(self, request_id: str, prompt_ids, sampling: SamplingParams,
+                 on_token: Optional[Callable] = None, arrival_t: float = None):
+        self.request_id = request_id
+        self.prompt_ids = np.asarray(prompt_ids).reshape(-1)
+        if self.prompt_ids.size == 0:
+            raise ValueError("empty prompt")
+        self.sampling = sampling
+        self.on_token = on_token
+        self.state = RequestState.QUEUED
+        self.output_tokens: List[int] = []
+        self.finish_reason: Optional[str] = None  # stop|length|cancelled|timeout
+        self.slot: Optional[int] = None
+        # timeline (engine clock): arrival -> admitted (slot granted,
+        # prefill) -> first token -> finished
+        self.arrival_t = time.monotonic() if arrival_t is None else arrival_t
+        self.admitted_t: Optional[float] = None
+        self.first_token_t: Optional[float] = None
+        self.finished_t: Optional[float] = None
+        self._last_token_t: Optional[float] = None
+        self._stream_q: "queue.Queue" = queue.Queue()
+        self._done = threading.Event()
+
+    # -- engine-side transitions ------------------------------------------
+    def _emit(self, token: int, now: float):
+        self.output_tokens.append(token)
+        if self.first_token_t is None:
+            self.first_token_t = now
+        self._last_token_t = now
+        self._stream_q.put(token)
+        if self.on_token is not None:
+            self.on_token(self, token)
+
+    def _finish(self, reason: str, now: float):
+        self.finish_reason = reason
+        self.finished_t = now
+        self.state = (RequestState.CANCELLED if reason == "cancelled"
+                      else RequestState.FINISHED)
+        self._stream_q.put(_FINISH_SENTINEL)
+        self._done.set()
+
+    @property
+    def deadline(self) -> Optional[float]:
+        if self.sampling.timeout_s is None:
+            return None
+        return self.arrival_t + self.sampling.timeout_s
+
+    # -- user-facing ------------------------------------------------------
+    @property
+    def finished(self) -> bool:
+        return self.state in (RequestState.FINISHED,
+                              RequestState.CANCELLED)
+
+    def stream(self):
+        """Blocking token iterator — use when the engine is pumped from
+        another thread (engine.run()); yields tokens as they decode."""
+        while True:
+            tok = self._stream_q.get()
+            if tok is _FINISH_SENTINEL:
+                return
+            yield tok
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def output(self) -> "RequestOutput":
+        return RequestOutput(
+            request_id=self.request_id,
+            prompt_token_ids=self.prompt_ids.tolist(),
+            token_ids=list(self.output_tokens),
+            finish_reason=self.finish_reason,
+            ttft_s=(None if self.first_token_t is None
+                    else self.first_token_t - self.arrival_t),
+            queue_wait_s=(None if self.admitted_t is None
+                          else self.admitted_t - self.arrival_t),
+            e2e_s=(None if self.finished_t is None
+                   else self.finished_t - self.arrival_t))
+
+    def __repr__(self):
+        return (f"Request({self.request_id!r}, state={self.state.name}, "
+                f"prompt_len={self.prompt_ids.size}, "
+                f"generated={len(self.output_tokens)})")
+
+
+@dataclass
+class RequestOutput:
+    """Final result handed back when a request leaves the engine."""
+
+    request_id: str
+    prompt_token_ids: List[int]
+    token_ids: List[int]
+    finish_reason: Optional[str]
+    ttft_s: Optional[float] = None
+    queue_wait_s: Optional[float] = None
+    e2e_s: Optional[float] = None
+    metrics: dict = field(default_factory=dict)

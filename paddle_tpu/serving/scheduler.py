@@ -1,0 +1,95 @@
+"""Admission + continuous-batching policy.
+
+The scheduler owns WHICH request occupies WHICH slot; the engine owns
+the device state. All membership changes (admit into a free slot, evict
+on EOS / max-tokens / timeout / cancel) happen here, between compiled
+steps, so the compiled decode step itself never changes shape — the
+slot-based analogue of Ragged Paged Attention's "requests of uneven
+lengths share one kernel invocation" (PAPERS.md).
+
+Policy: plain FIFO fairness by arrival order. A freed slot is refilled
+by the longest-waiting queued request at the next step boundary.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from .request import Request, RequestState
+
+__all__ = ["Scheduler"]
+
+
+class Scheduler:
+    def __init__(self, num_slots: int, max_queue: Optional[int] = None):
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        self.num_slots = num_slots
+        self.max_queue = max_queue
+        self._queue: deque = deque()        # FIFO arrival order
+        self.running: Dict[int, Request] = {}   # slot -> request
+
+    # -- queue side -------------------------------------------------------
+    def submit(self, req: Request):
+        if self.max_queue is not None and len(self._queue) >= self.max_queue:
+            raise RuntimeError(
+                f"admission queue full ({self.max_queue}); shed load or "
+                "raise max_queue")
+        self._queue.append(req)
+
+    def drop_queued(self, req: Request) -> bool:
+        try:
+            self._queue.remove(req)
+            return True
+        except ValueError:
+            return False
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def occupancy(self) -> float:
+        return len(self.running) / self.num_slots
+
+    def free_slots(self) -> List[int]:
+        return [s for s in range(self.num_slots) if s not in self.running]
+
+    # -- membership changes (between compiled steps only) -----------------
+    def assign(self) -> List[Tuple[int, Request]]:
+        """Join policy: fill free slots from the queue in arrival order.
+        Returns the (slot, request) pairs granted this boundary; the
+        engine prefills each one before the next decode step."""
+        grants = []
+        for slot in self.free_slots():
+            if not self._queue:
+                break
+            req = self._queue.popleft()
+            req.slot = slot
+            self.running[slot] = req
+            grants.append((slot, req))
+        return grants
+
+    def retire(self, slot: int) -> Optional[Request]:
+        """Evict policy endpoint: free a slot (EOS / max-tokens /
+        timeout / cancel all land here, decided by the engine)."""
+        req = self.running.pop(slot, None)
+        if req is not None:
+            req.slot = None
+        return req
+
+    def expired(self, now: float) -> List[Request]:
+        """Queued or running requests past their deadline."""
+        out = [r for r in self._queue
+               if r.deadline is not None and now >= r.deadline]
+        out += [r for r in self.running.values()
+                if r.deadline is not None and now >= r.deadline]
+        return out
+
+    def cancelled_running(self) -> List[Request]:
+        return [r for r in self.running.values()
+                if r.state is RequestState.CANCELLED]
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._queue) or bool(self.running)

@@ -1,0 +1,152 @@
+"""Online serving bench: Poisson arrivals through the ServingEngine.
+
+Drives `paddle_tpu.serving.ServingEngine` with a Poisson arrival trace
+(exponential inter-arrival gaps, geometric-ish mixed prompt lengths and
+output budgets) against the tiny GPT config on CPU or a GPT-124M-ish
+config on the chip, and prints ONE JSON line:
+
+    {"bench": "serving", "requests": ..., "ttft_p50_s": ...,
+     "ttft_p99_s": ..., "inter_token_p50_s": ..., "tokens_per_sec": ...,
+     "occupancy_mean": ..., "decode_steps": ..., ...}
+
+Usage:
+    python scripts/serving_bench.py            # platform-sized run
+    python scripts/serving_bench.py --smoke    # seconds-fast CI run
+    python scripts/serving_bench.py --requests 64 --rate 50 --slots 8
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "default")
+
+
+def build_model(on_tpu: bool):
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768,
+                        num_hidden_layers=12, num_attention_heads=12,
+                        max_position_embeddings=2048,
+                        hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+    else:
+        cfg = GPTConfig(vocab_size=256, hidden_size=64,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        intermediate_size=128,
+                        max_position_embeddings=256,
+                        hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    model.eval()
+    return model, cfg
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--rate", type=float, default=None,
+                    help="mean arrivals/sec of the Poisson trace")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--max-len", type=int, default=None)
+    ap.add_argument("--max-new", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny fast run (CI)")
+    args = ap.parse_args()
+
+    import jax
+    import paddle_tpu as paddle  # noqa: F401
+    from paddle_tpu.serving import SamplingParams, ServingEngine
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    model, cfg = build_model(on_tpu)
+
+    if args.smoke:
+        n_req, rate, max_new, max_len = 6, 200.0, 6, 48
+        prompt_lens = [3, 5, 8]
+    elif on_tpu:
+        n_req = args.requests or 128
+        rate = args.rate or 32.0
+        max_new = args.max_new or 128
+        max_len = args.max_len or 1024
+        prompt_lens = [32, 64, 128, 256]
+    else:
+        n_req = args.requests or 24
+        rate = args.rate or 100.0
+        max_new = args.max_new or 16
+        max_len = args.max_len or 128
+        prompt_lens = [4, 8, 12, 16]
+
+    rng = np.random.RandomState(args.seed)
+    gaps = rng.exponential(1.0 / rate, size=n_req)
+    arrivals = np.cumsum(gaps)               # seconds from t0
+    prompts = [rng.randint(0, cfg.vocab_size,
+                           size=rng.choice(prompt_lens)).astype(np.int64)
+               for _ in range(n_req)]
+    budgets = rng.randint(max(1, max_new // 2), max_new + 1, size=n_req)
+
+    eng = ServingEngine(model, num_slots=args.slots, max_len=max_len)
+
+    # warm the compiled programs so the trace measures steady state, not
+    # XLA compile time: one request per distinct prompt length
+    for pl in sorted({p.size for p in prompts}):
+        eng.add_request(np.arange(1, pl + 1, dtype=np.int64),
+                        SamplingParams(max_new_tokens=2))
+    eng.run()
+    eng.metrics.__init__()   # drop warmup from the report
+
+    t0 = time.monotonic()
+    submitted = 0
+    reqs = []
+    while submitted < n_req or eng.has_work:
+        now = time.monotonic() - t0
+        while submitted < n_req and arrivals[submitted] <= now:
+            reqs.append(eng.add_request(
+                prompts[submitted],
+                SamplingParams(max_new_tokens=int(budgets[submitted]))))
+            submitted += 1
+        if eng.has_work:
+            eng.step()
+        elif submitted < n_req:
+            time.sleep(min(0.001, arrivals[submitted] - now))
+    wall = time.monotonic() - t0
+
+    snap = eng.metrics.snapshot()
+    report = {
+        "bench": "serving",
+        "platform": jax.devices()[0].platform,
+        "requests": n_req,
+        "slots": args.slots,
+        "max_len": max_len,
+        "arrival_rate_per_s": rate,
+        "wall_s": round(wall, 4),
+        "tokens_generated": snap["tokens_generated"],
+        "tokens_per_sec": snap["tokens_per_sec"],
+        "ttft_p50_s": snap["ttft_s"]["p50"],
+        "ttft_p99_s": snap["ttft_s"]["p99"],
+        "inter_token_p50_s": snap["inter_token_s"]["p50"],
+        "queue_wait_p99_s": snap["queue_wait_s"]["p99"],
+        "occupancy_mean": snap["occupancy_hist"]["mean"],
+        "decode_steps": snap["decode_steps"],
+        "completed": snap["requests"]["completed"],
+    }
+    print(json.dumps(report))
+    assert snap["requests"]["completed"] == n_req, \
+        (snap["requests"], n_req)
+
+
+if __name__ == "__main__":
+    main()

@@ -528,3 +528,57 @@ class TestInt8KVCache:
         import pytest as _pytest
         with _pytest.raises(NotImplementedError):
             update_and_attend(q, q, q, c2)               # pos 3, l 3
+
+
+class TestTopPFilter:
+    """Edge cases of the nucleus mask shared by CompiledGenerator and
+    the serving engine's per-slot sampler."""
+
+    @staticmethod
+    def _filter(logits, p):
+        import jax.numpy as jnp
+        from paddle_tpu.nlp.generation import _top_p_filter
+        return np.asarray(_top_p_filter(jnp.asarray(logits, jnp.float32),
+                                        p))
+
+    def test_top_p_one_keeps_all_tokens(self):
+        logits = np.array([[2.0, -1.0, 0.5, -3.0, 1.0]], np.float32)
+        out = self._filter(logits, 1.0)
+        np.testing.assert_array_equal(out, logits)   # nothing masked
+
+    def test_top_p_below_max_prob_keeps_exactly_argmax(self):
+        # softmax([4,0,-1,-2]) has max prob ~0.97: any p below it must
+        # keep the argmax alone (the first sorted token is always kept)
+        logits = np.array([[4.0, 0.0, -1.0, -2.0]], np.float32)
+        out = self._filter(logits, 0.01)
+        assert out[0, 0] == logits[0, 0]
+        assert np.all(out[0, 1:] <= -1e29)
+
+    def test_tied_probabilities_not_over_pruned(self):
+        # two exactly-tied maxima: the threshold lands ON their logit,
+        # and the mask is strict (<), so BOTH survive even at tiny p
+        logits = np.array([[1.5, 1.5, -2.0, -5.0]], np.float32)
+        out = self._filter(logits, 0.1)
+        np.testing.assert_array_equal(out[0, :2], logits[0, :2])
+        assert np.all(out[0, 2:] <= -1e29)
+
+    def test_mass_boundary_keeps_smallest_covering_prefix(self):
+        # probs ~ [0.5, 0.25, 0.125, ...]: p=0.6 needs the first TWO
+        # sorted tokens (0.5 < 0.6 <= 0.75)
+        logits = np.log(np.array([[0.5, 0.25, 0.125, 0.125]],
+                                 np.float32))
+        out = self._filter(logits, 0.6)
+        assert np.all(out[0, :2] > -1e29)
+        assert np.all(out[0, 2:] <= -1e29)
+
+    def test_row_vector_p_broadcasts_per_row(self):
+        # the serving engine passes p as a [S, 1] column (per-slot
+        # nucleus): row 0 prunes to argmax, row 1 keeps everything
+        import jax.numpy as jnp
+        from paddle_tpu.nlp.generation import _top_p_filter
+        logits = np.array([[4.0, 0.0, -1.0, -2.0],
+                           [4.0, 0.0, -1.0, -2.0]], np.float32)
+        p = np.array([[0.01], [1.0]], np.float32)
+        out = np.asarray(_top_p_filter(jnp.asarray(logits), p))
+        assert np.all(out[0, 1:] <= -1e29)
+        np.testing.assert_array_equal(out[1], logits[1])

@@ -148,3 +148,52 @@ class TestExport:
             with RecordEvent("fwd"):
                 _work()
         assert not p._events and not p._all_events
+
+
+class TestExportChromeTracingE2E:
+    """export_chrome_tracing end-to-end: the scheduler-driven window
+    flush path writes a parseable Chrome trace per recorded window (the
+    handler was previously only exercised on stop())."""
+
+    def test_window_flush_writes_trace_per_window(self, tmp_path):
+        d = str(tmp_path / "traces")
+        sched = make_scheduler(closed=1, ready=0, record=2, repeat=2)
+        with Profiler(targets=[ProfilerTarget.CPU], scheduler=sched,
+                      on_trace_ready=export_chrome_tracing(d)) as p:
+            for _ in range(6):
+                _work()
+                p.step()
+        files = sorted(os.listdir(d))
+        assert len(files) == 2, files         # one JSON per window
+        for f in files:
+            assert f.endswith(".paddle_trace.json")
+            trace = profiler.load_profiler_result(os.path.join(d, f))
+            evs = trace["traceEvents"]
+            assert evs and all(e["ph"] == "X" for e in evs)
+            assert any(e["name"].startswith("op::") for e in evs), evs
+
+    def test_worker_name_lands_in_filename(self, tmp_path):
+        d = str(tmp_path / "traces")
+        with Profiler(targets=[ProfilerTarget.CPU],
+                      on_trace_ready=export_chrome_tracing(
+                          d, worker_name="rank3")) as p:
+            with RecordEvent("tagged"):
+                _work()
+        [f] = os.listdir(d)
+        assert f.startswith("rank3_time_")
+        trace = profiler.load_profiler_result(os.path.join(d, f))
+        assert any(e["name"] == "tagged" for e in trace["traceEvents"])
+
+    def test_trace_json_fields_are_chrome_compatible(self, tmp_path):
+        d = str(tmp_path / "traces")
+        with Profiler(targets=[ProfilerTarget.CPU],
+                      on_trace_ready=export_chrome_tracing(d)) as p:
+            with RecordEvent("outer"):
+                _work()
+        [f] = os.listdir(d)
+        with open(os.path.join(d, f)) as fh:
+            trace = json.load(fh)             # parseable from disk
+        assert trace["displayTimeUnit"] == "ms"
+        for e in trace["traceEvents"]:
+            assert set(e) >= {"name", "ph", "ts", "dur", "pid", "tid"}
+            assert e["ts"] >= 0 and e["dur"] >= 0

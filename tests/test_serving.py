@@ -1,0 +1,348 @@
+"""Serving engine: continuous batching over the compiled decode path.
+
+The load-bearing property (ISSUE acceptance): a request's greedy tokens
+through `ServingEngine` are BIT-IDENTICAL to running it alone through
+`CompiledGenerator` greedy decode, no matter what its slot-neighbors do
+— including neighbors joining late, finishing early, or being cancelled
+mid-stream.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.nlp import (GPTConfig, GPTForCausalLM, LlamaConfig,
+                            LlamaForCausalLM)
+from paddle_tpu.serving import (Request, RequestState, SamplingParams,
+                                Scheduler, ServingEngine, ServingMetrics)
+
+
+_MODELS = {}   # engines/oracles never mutate the model: share per module
+
+
+def tiny_gpt():
+    m = _MODELS.get("gpt")
+    if m is None:
+        paddle.seed(7)
+        cfg = GPTConfig(vocab_size=97, hidden_size=32,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        intermediate_size=64,
+                        max_position_embeddings=128,
+                        hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+        m = _MODELS["gpt"] = GPTForCausalLM(cfg)
+        m.eval()
+    return m
+
+
+def tiny_llama():
+    m = _MODELS.get("llama")
+    if m is None:
+        paddle.seed(11)
+        cfg = LlamaConfig(vocab_size=89, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, intermediate_size=48,
+                          max_position_embeddings=128)
+        m = _MODELS["llama"] = LlamaForCausalLM(cfg)
+        m.eval()
+    return m
+
+
+def oracle_greedy(model, prompt, n_new):
+    """The request alone through CompiledGenerator greedy decode."""
+    out = model.generate(paddle.to_tensor(prompt[None]),
+                         max_new_tokens=n_new).numpy()
+    return out[0, prompt.size:]
+
+
+class TestSchedulerPolicy:
+    def test_fifo_admission_and_refill(self):
+        s = Scheduler(num_slots=2)
+        reqs = [Request(f"r{i}", np.array([1, 2]), SamplingParams())
+                for i in range(4)]
+        for r in reqs:
+            s.submit(r)
+        grants = s.assign()
+        assert [r.request_id for _, r in grants] == ["r0", "r1"]
+        assert s.queue_depth == 2 and s.occupancy == 1.0
+        assert s.assign() == []          # no free slot
+        s.retire(grants[0][0])
+        refill = s.assign()
+        assert [r.request_id for _, r in refill] == ["r2"]  # arrival order
+        assert refill[0][0] == grants[0][0]                 # freed slot
+
+    def test_max_queue_sheds_load(self):
+        s = Scheduler(num_slots=1, max_queue=1)
+        s.submit(Request("a", np.array([1]), SamplingParams()))
+        with pytest.raises(RuntimeError):
+            s.submit(Request("b", np.array([1]), SamplingParams()))
+
+    def test_expired_finds_deadline_overruns(self):
+        s = Scheduler(num_slots=1)
+        r = Request("a", np.array([1]),
+                    SamplingParams(timeout_s=5.0), arrival_t=100.0)
+        s.submit(r)
+        assert s.expired(104.0) == []
+        assert s.expired(105.0) == [r]
+
+
+class TestEquivalence:
+    def test_staggered_arrivals_match_solo_compiled_greedy(self):
+        """>= 3 staggered requests, different prompt lengths: greedy
+        tokens identical to per-request CompiledGenerator output."""
+        model = tiny_gpt()
+        prompts = [np.array([3, 14, 15, 9], np.int64),
+                   np.array([26, 5, 35], np.int64),
+                   np.array([1, 2, 3, 4, 5, 6], np.int64)]
+        want = [oracle_greedy(model, p, 8) for p in prompts]
+
+        eng = ServingEngine(model, num_slots=2, max_len=64)
+        reqs = [eng.add_request(prompts[0],
+                                SamplingParams(max_new_tokens=8))]
+        eng.step()
+        eng.step()
+        reqs.append(eng.add_request(prompts[1],
+                                    SamplingParams(max_new_tokens=8)))
+        eng.step()
+        # 2 slots busy: third queues, joins whichever slot frees first
+        reqs.append(eng.add_request(prompts[2],
+                                    SamplingParams(max_new_tokens=8)))
+        while eng.has_work:
+            eng.step()
+        for r, w in zip(reqs, want):
+            np.testing.assert_array_equal(np.asarray(r.output_tokens), w)
+            assert r.finish_reason == "length"
+
+    def test_llama_gqa_rotary_matches_solo(self):
+        """Vector-pos path through GQA + per-row rotary offsets."""
+        model = tiny_llama()
+        prompts = [np.array([3, 14, 15, 9], np.int64),
+                   np.array([26, 5, 35], np.int64),
+                   np.array([7, 8], np.int64)]
+        want = [oracle_greedy(model, p, 6) for p in prompts]
+        eng = ServingEngine(model, num_slots=3, max_len=48)
+        reqs = [eng.add_request(prompts[0],
+                                SamplingParams(max_new_tokens=6))]
+        eng.step()
+        reqs.append(eng.add_request(prompts[1],
+                                    SamplingParams(max_new_tokens=6)))
+        eng.step()
+        reqs.append(eng.add_request(prompts[2],
+                                    SamplingParams(max_new_tokens=6)))
+        while eng.has_work:
+            eng.step()
+        for r, w in zip(reqs, want):
+            np.testing.assert_array_equal(np.asarray(r.output_tokens), w)
+
+    def test_cancellation_frees_slot_without_perturbing_neighbors(self):
+        """Mid-stream cancel: the slot is handed to a queued request at
+        the next boundary; the surviving neighbor and the late joiner
+        both stay bit-identical to solo decode."""
+        model = tiny_gpt()
+        pa = np.array([3, 14, 15, 9], np.int64)
+        pb = np.array([26, 5, 35], np.int64)
+        pc = np.array([1, 2, 3, 4, 5], np.int64)
+        want_a = oracle_greedy(model, pa, 10)
+        want_c = oracle_greedy(model, pc, 6)
+
+        eng = ServingEngine(model, num_slots=2, max_len=64)
+        ra = eng.add_request(pa, SamplingParams(max_new_tokens=10))
+        rb = eng.add_request(pb, SamplingParams(max_new_tokens=10))
+        rc = eng.add_request(pc, SamplingParams(max_new_tokens=6))
+        eng.step()
+        eng.step()
+        eng.step()
+        assert rc.state is RequestState.QUEUED   # both slots busy
+        assert eng.cancel(rb.request_id)
+        outs = eng.step()                        # evict rb, admit rc
+        assert [o.request_id for o in outs] == [rb.request_id]
+        assert rb.finish_reason == "cancelled"
+        assert 0 < len(rb.output_tokens) < 10    # genuinely mid-stream
+        assert rc.slot is not None
+        while eng.has_work:
+            eng.step()
+        np.testing.assert_array_equal(np.asarray(ra.output_tokens),
+                                      want_a)
+        np.testing.assert_array_equal(np.asarray(rc.output_tokens),
+                                      want_c)
+
+    def test_eos_retires_slot_and_tokens_match(self):
+        model = tiny_gpt()
+        p = np.array([3, 14, 15, 9], np.int64)
+        free = oracle_greedy(model, p, 6)
+        eos = int(free[0])       # first generated token == instant stop
+        eng = ServingEngine(model, num_slots=2, max_len=64)
+        r_eos = eng.add_request(p, SamplingParams(max_new_tokens=6,
+                                                  eos_token_id=eos))
+        r_other = eng.add_request(np.array([26, 5, 35], np.int64),
+                                  SamplingParams(max_new_tokens=6))
+        while eng.has_work:
+            eng.step()
+        assert r_eos.finish_reason == "stop"
+        assert r_eos.output_tokens == [eos]      # eos token included
+        assert len(r_other.output_tokens) == 6
+        np.testing.assert_array_equal(
+            np.asarray(r_other.output_tokens),
+            oracle_greedy(model, np.array([26, 5, 35], np.int64), 6))
+
+
+class TestLifecycleAndPolicy:
+    def test_states_progress_and_output_record(self):
+        model = tiny_gpt()
+        eng = ServingEngine(model, num_slots=1, max_len=32)
+        seen = []
+        r = eng.add_request(
+            np.array([1, 2, 3], np.int64),
+            SamplingParams(max_new_tokens=3),
+            on_token=lambda req, tok: seen.append(tok))
+        assert r.state is RequestState.QUEUED
+        outs = eng.run()
+        assert r.state is RequestState.FINISHED
+        assert seen == r.output_tokens and len(seen) == 3
+        [o] = outs
+        assert o.request_id == r.request_id
+        assert o.finish_reason == "length"
+        assert o.token_ids == r.output_tokens
+        assert o.ttft_s is not None and o.ttft_s >= 0
+        assert o.e2e_s >= o.ttft_s
+
+    def test_timeout_evicts_queued_and_running(self):
+        model = tiny_gpt()
+        t = [0.0]
+        eng = ServingEngine(model, num_slots=1, max_len=32,
+                            clock=lambda: t[0])
+        run = eng.add_request(np.array([1, 2], np.int64),
+                              SamplingParams(max_new_tokens=30,
+                                             timeout_s=10.0))
+        qd = eng.add_request(np.array([3, 4], np.int64),
+                             SamplingParams(max_new_tokens=4,
+                                            timeout_s=5.0))
+        t[0] = 1.0
+        eng.step()           # run admitted; qd waits
+        t[0] = 6.0
+        eng.step()           # qd's deadline passed while queued
+        assert qd.finish_reason == "timeout"
+        t[0] = 11.0
+        eng.step()           # run's deadline passed while decoding
+        assert run.finish_reason == "timeout"
+        assert len(run.output_tokens) > 0
+        assert not eng.has_work
+
+    def test_cancel_queued_request(self):
+        model = tiny_gpt()
+        eng = ServingEngine(model, num_slots=1, max_len=32)
+        a = eng.add_request(np.array([1, 2], np.int64),
+                            SamplingParams(max_new_tokens=4))
+        b = eng.add_request(np.array([3, 4], np.int64),
+                            SamplingParams(max_new_tokens=4))
+        assert eng.cancel(b.request_id)
+        assert b.finish_reason == "cancelled"
+        assert b.output_tokens == []
+        eng.run()
+        assert a.finish_reason == "length"
+
+    def test_capacity_guard(self):
+        model = tiny_gpt()
+        eng = ServingEngine(model, num_slots=1, max_len=16)
+        with pytest.raises(ValueError):
+            eng.add_request(np.arange(1, 17, dtype=np.int64))
+        with pytest.raises(ValueError):
+            eng.add_request(np.arange(1, 9, dtype=np.int64),
+                            SamplingParams(max_new_tokens=9))
+
+    def test_per_request_sampling_params_coexist(self):
+        """A sampling request next to greedy neighbors: greedy rows stay
+        bit-identical, the sampling row emits valid tokens."""
+        model = tiny_gpt()
+        pg = np.array([3, 14, 15, 9], np.int64)
+        want = oracle_greedy(model, pg, 6)
+        eng = ServingEngine(model, num_slots=2, max_len=48)
+        rg = eng.add_request(pg, SamplingParams(max_new_tokens=6))
+        rs = eng.add_request(
+            np.array([26, 5, 35], np.int64),
+            SamplingParams(max_new_tokens=6, temperature=0.8, top_k=5,
+                           top_p=0.9))
+        assert not rs.sampling.greedy
+        eng.run()
+        np.testing.assert_array_equal(np.asarray(rg.output_tokens), want)
+        assert len(rs.output_tokens) == 6
+        assert all(0 <= t < 97 for t in rs.output_tokens)
+
+
+class TestMetricsAndTrace:
+    def test_snapshot_reports_ttft_throughput_occupancy(self):
+        model = tiny_gpt()
+        eng = ServingEngine(model, num_slots=2, max_len=48)
+        for i in range(3):
+            eng.add_request(np.array([1 + i, 2, 3], np.int64),
+                            SamplingParams(max_new_tokens=4))
+        eng.run()
+        snap = eng.metrics.snapshot()
+        assert snap["requests"]["received"] == 3
+        assert snap["requests"]["completed"] == 3
+        assert snap["tokens_generated"] == 12
+        assert snap["tokens_per_sec"] is not None \
+            and snap["tokens_per_sec"] > 0
+        assert snap["ttft_s"]["count"] == 3
+        assert snap["ttft_s"]["p99"] >= snap["ttft_s"]["p50"] > 0
+        assert snap["inter_token_s"]["count"] == 9   # 3 req x 3 gaps
+        assert 0 < snap["occupancy_hist"]["mean"] <= 1.0
+        assert snap["slot_occupancy"] == 0.0         # drained
+        assert snap["decode_steps"] > 0
+
+    def test_chrome_trace_contains_per_request_spans(self, tmp_path):
+        model = tiny_gpt()
+        eng = ServingEngine(model, num_slots=2, max_len=48)
+        with profiler.Profiler(
+                targets=[profiler.ProfilerTarget.CPU]) as p:
+            r0 = eng.add_request(np.array([1, 2, 3], np.int64),
+                                 SamplingParams(max_new_tokens=3))
+            r1 = eng.add_request(np.array([4, 5], np.int64),
+                                 SamplingParams(max_new_tokens=3))
+            eng.run()
+        path = str(tmp_path / "serving_trace.json")
+        p.export(path)
+        with open(path) as f:
+            trace = json.load(f)
+        names = [e["name"] for e in trace["traceEvents"]]
+        for r in (r0, r1):
+            assert f"serving::request[{r.request_id}]" in names
+            assert f"serving::prefill[{r.request_id}]" in names
+        assert names.count("serving::decode_step") >= 3
+        # request spans cover their prefill + decode steps
+        req_ev = next(e for e in trace["traceEvents"]
+                      if e["name"] == f"serving::request[{r0.request_id}]")
+        step_ev = next(e for e in trace["traceEvents"]
+                       if e["name"] == "serving::decode_step")
+        assert req_ev["dur"] >= step_ev["dur"]
+
+    def test_metrics_histogram_percentiles(self):
+        m = ServingMetrics()
+        for v in [1.0, 2.0, 3.0, 4.0, 5.0]:
+            m.ttft_s.record(v)
+        s = m.ttft_s.snapshot()
+        assert s["count"] == 5 and s["mean"] == 3.0
+        assert s["min"] == 1.0 and s["max"] == 5.0
+        assert s["p50"] == 3.0 and s["p99"] == 5.0
+
+
+@pytest.mark.slow
+def test_serving_bench_smoke():
+    """scripts/serving_bench.py end-to-end (Poisson trace, JSON line)."""
+    script = os.path.join(os.path.dirname(__file__), os.pardir,
+                          "scripts", "serving_bench.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, script, "--smoke"],
+                         capture_output=True, text=True, env=env,
+                         timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    report = json.loads(out.stdout.strip().splitlines()[-1])
+    assert report["bench"] == "serving"
+    assert report["completed"] == report["requests"]
+    assert report["tokens_per_sec"] > 0
+    assert report["ttft_p50_s"] > 0
