@@ -42,15 +42,28 @@ class DecodeCache:
     Tensor — the number of valid positions already written. Unlike the
     eager `MultiHeadAttention.Cache` (which grows by concat and forces a
     recompile per step), the buffers here never change shape.
+
+    Paged mode (serving): when `page_table` is set, k/v are SHARED pools
+    [num_pages, page_size, n_kv_heads, head_dim] and `page_table` is
+    [B, max_pages] int32 — row b's logical position p lives in
+    pool[page_table[b, p // page_size], p % page_size]. `pos` is the
+    per-row position vector [B]. Page 0 is reserved as a trash page:
+    rows of retired/free slots point every entry at it, and writes past
+    a row's allocated pages are redirected there, so one fixed-shape
+    program serves any mix of live/free rows (Ragged Paged Attention,
+    PAPERS.md).
     """
 
-    __slots__ = ("k", "v", "pos", "k_scale", "v_scale", "fresh")
+    __slots__ = ("k", "v", "pos", "k_scale", "v_scale", "fresh",
+                 "page_table")
 
     def __init__(self, k, v, pos, k_scale=None, v_scale=None,
-                 fresh=False):
+                 fresh=False, page_table=None):
         self.k = k
         self.v = v
         self.pos = pos
+        # paged mode: [B, max_pages] int32 page ids into the k/v pools
+        self.page_table = page_table
         # int8 cache mode: k/v hold int8 codes laid out
         # [B, H_kv, max_len, D]; *_scale are per-head [H_kv] f32
         # CONSTANTS from calibration (layout + constant scales are what
@@ -86,6 +99,56 @@ def _kv_update_fwd(buf, upd, pos):
 register_op("kv_cache_update", _kv_update_fwd)
 
 
+def _kv_update_paged_fwd(pool, upd, pos, page_table):
+    """Scatter upd [B, l, H, D] into the shared pool
+    [num_pages, page_size, H, D]: row b's token t lands at logical
+    position pos[b] + t, i.e. pool slot
+    page_table[b, p // page_size] * page_size + p % page_size.
+
+    Positions past the row's addressable window (chunk padding on the
+    last prefill chunk) are redirected into page 0 — the reserved trash
+    page — so the scatter never needs a branch and never clobbers live
+    pages. Free/retired rows get an all-zero page-table row from the
+    host for the same reason: their (masked, ignored) writes land in
+    trash. One fixed-shape scatter serves decode (l=1, batch B) and
+    chunked prefill (l=chunk, batch 1) alike.
+    """
+    ps = pool.shape[1]
+    b, l = upd.shape[0], upd.shape[1]
+    addressable = page_table.shape[1] * ps
+    p = pos.astype(jnp.int32)[:, None] + \
+        jnp.arange(l, dtype=jnp.int32)[None, :]          # [B, l] logical
+    pidx = jnp.clip(p // ps, 0, page_table.shape[1] - 1)
+    ids = jnp.take_along_axis(page_table.astype(jnp.int32), pidx,
+                              axis=1)                    # [B, l] pages
+    flat = ids * ps + p % ps
+    flat = jnp.where(p < addressable, flat, p % ps)      # OOB -> trash
+    flat_pool = pool.reshape((-1,) + pool.shape[2:])
+    flat_pool = flat_pool.at[flat.reshape(-1)].set(
+        upd.astype(pool.dtype).reshape((-1,) + upd.shape[2:]))
+    return flat_pool.reshape(pool.shape)
+
+
+register_op("kv_cache_update_paged", _kv_update_paged_fwd, nondiff=True)
+
+
+def _paged_gather_fwd(pool, page_table):
+    """Gather each row's pages into its contiguous logical view:
+    pool [P, page_size, H, D] + page_table [B, max_pages] ->
+    [B, max_pages * page_size, H, D] — the same layout the dense cache
+    holds, so the existing window_causal_mask + SDPA path attends over
+    it unchanged. Rows of the view belonging to unallocated entries
+    show trash-page contents; the additive -1e30 mask at positions
+    >= pos hides them exactly (trash is finite, never NaN: pools are
+    zero-init and only ever written with real K/V)."""
+    g = jnp.take(pool, page_table.astype(jnp.int32), axis=0)
+    b, m, ps = g.shape[0], g.shape[1], g.shape[2]
+    return g.reshape((b, m * ps) + pool.shape[2:])
+
+
+register_op("paged_kv_gather", _paged_gather_fwd, nondiff=True)
+
+
 def _kv_update_q8_fwd(buf, upd, pos, scale):
     """Quantize upd [B, l, H, D] with the per-head CONSTANT scales [H]
     and write it into the int8 [B, H, max_len, D] cache at pos.
@@ -100,11 +163,20 @@ def _kv_update_q8_fwd(buf, upd, pos, scale):
     int8 KV of fused_multi_transformer_int8_op.cu (also static scales).
     """
     z = jnp.zeros((), jnp.int32)
-    p = pos.astype(jnp.int32).reshape(())
+    p = pos.astype(jnp.int32)
     u = upd.astype(jnp.float32).transpose(0, 2, 1, 3)  # [B,H,l,D]
     q = jnp.clip(jnp.round(u / scale[None, :, None, None]),
                  -127, 127).astype(jnp.int8)
-    return jax.lax.dynamic_update_slice(buf, q, (z, z, p, z))
+    if p.ndim == 1:
+        # per-row positions (continuous batching over the int8 cache):
+        # each row quantizes with the same constant scales and writes
+        # at its own offset — the rowwise analogue of the float-cache
+        # vmap'd dynamic-update-slice above
+        def row(b, u8, q_):
+            return jax.lax.dynamic_update_slice(b, u8, (z, q_, z))
+
+        return jax.vmap(row)(buf, q, p)
+    return jax.lax.dynamic_update_slice(buf, q, (z, z, p.reshape(()), z))
 
 
 register_op("kv_cache_update_q8", _kv_update_q8_fwd, nondiff=True)
@@ -213,21 +285,36 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
     from ..nn import functional as F
     from ..ops import manipulation
     quant = cache.k_scale is not None
-    if quant and getattr(cache.pos._value, "ndim", 0) == 1:
+    paged = cache.page_table is not None
+    l = int(q.shape[1])
+    if quant and paged:
         raise NotImplementedError(
-            "int8 KV cache: per-row position vectors (continuous "
-            "batching) need a rowwise quantized update path — use the "
-            "bf16/f32 cache for serving")
+            "int8 KV cache: the paged pool path is float-only — a "
+            "quantized paged scatter/gather is future work")
+    if quant and getattr(cache.pos._value, "ndim", 0) == 1 and l != 1:
+        raise NotImplementedError(
+            "int8 KV cache: per-row position vectors support "
+            "single-token (decode) writes only; multi-token chunks "
+            "need the dequantized read path — use the bf16/f32 cache "
+            "for chunked prefill")
     if quant:
         k_buf = apply_op("kv_cache_update_q8", cache.k, k_new,
                          cache.pos, cache.k_scale)
         v_buf = apply_op("kv_cache_update_q8", cache.v, v_new,
                          cache.pos, cache.v_scale)
+    elif paged:
+        k_buf = apply_op("kv_cache_update_paged", cache.k, k_new,
+                         cache.pos, cache.page_table)
+        v_buf = apply_op("kv_cache_update_paged", cache.v, v_new,
+                         cache.pos, cache.page_table)
     else:
         k_buf = apply_op("kv_cache_update", cache.k, k_new, cache.pos)
         v_buf = apply_op("kv_cache_update", cache.v, v_new, cache.pos)
-    l = q.shape[1]
-    lmax = k_buf.shape[2] if quant else k_buf.shape[1]
+    if paged:
+        # logical view length: every row sees max_pages full pages
+        lmax = int(cache.page_table.shape[1]) * int(cache.k.shape[1])
+    else:
+        lmax = k_buf.shape[2] if quant else k_buf.shape[1]
     mask = apply_op("window_causal_mask", cache.pos,
                     attrs=dict(l=int(l), lmax=int(lmax)))
     if attn_mask is not None:
@@ -266,6 +353,14 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
         mask = mask[:, :, :, :l]
         new_cache = DecodeCache(k_buf, v_buf, cache.pos + l,
                                 cache.k_scale, cache.v_scale)
+    elif paged:
+        # attend over the row's pages gathered into the dense logical
+        # layout; the window mask (and trash-page rule, see the paged
+        # ops above) makes this bit-identical to the dense-cache read
+        kf = apply_op("paged_kv_gather", k_buf, cache.page_table)
+        vf = apply_op("paged_kv_gather", v_buf, cache.page_table)
+        new_cache = DecodeCache(k_buf, v_buf, cache.pos + l,
+                                page_table=cache.page_table)
     else:
         kf, vf = k_buf, v_buf
         new_cache = DecodeCache(k_buf, v_buf, cache.pos + l)
@@ -301,10 +396,16 @@ def _pack_caches(caches):
         for c in caches)
 
 
-def _unpack_caches(ct, pos):
+def _unpack_caches(ct, pos, page_table=None):
+    """page_table (optional [B, max_pages] raw int32 array) switches
+    every layer's cache into paged-pool mode; the table is shared
+    across layers (one page id addresses the same page in each
+    layer's pool)."""
+    pt = None if page_table is None else Tensor(page_table)
     return [DecodeCache(Tensor(k), Tensor(v), Tensor(pos),
                         None if ks is None else Tensor(ks),
-                        None if vs is None else Tensor(vs))
+                        None if vs is None else Tensor(vs),
+                        page_table=pt)
             for k, v, ks, vs in ct]
 
 

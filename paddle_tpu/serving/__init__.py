@@ -1,30 +1,35 @@
 """paddle_tpu.serving — continuous-batching online inference.
 
 Wraps the compiled decode path (nlp/generation.py) in a slot-based
-scheduler so requests arriving at different times, with different
-prompt lengths and sampling params, share ONE fixed-shape compiled
-decode step:
+scheduler over a PAGED KV pool: requests arriving at different times,
+with different prompt lengths and sampling params, share ONE
+fixed-shape compiled decode step, each holding only the KV pages its
+prompt + output budget needs (long prompts prefill chunk by chunk,
+interleaved with residents' decodes):
 
     from paddle_tpu.serving import ServingEngine, SamplingParams
 
-    eng = ServingEngine(model, num_slots=8, max_len=256)
+    eng = ServingEngine(model, num_slots=8, max_len=256,
+                        page_size=16, chunk_len=32)
     req = eng.add_request(prompt_ids,
                           SamplingParams(max_new_tokens=32,
                                          eos_token_id=eos))
     while eng.has_work:
         for out in eng.step():
             print(out.request_id, out.token_ids, out.finish_reason)
-    print(eng.metrics.snapshot()["ttft_s"])
+    print(eng.metrics.snapshot()["pool"])
 
 Greedy requests are bit-identical to offline CompiledGenerator decode
 (tested); `scripts/serving_bench.py` drives a Poisson arrival trace and
-reports TTFT/throughput.
+reports TTFT/throughput/pool utilization into BENCH_serving.json.
 """
 from .engine import ServingEngine  # noqa: F401
 from .metrics import Histogram, ServingMetrics  # noqa: F401
+from .paging import PagePool, chunk_bucket, pages_needed  # noqa: F401
 from .request import (Request, RequestOutput, RequestState,  # noqa: F401
                       SamplingParams)
 from .scheduler import Scheduler  # noqa: F401
 
 __all__ = ["ServingEngine", "Scheduler", "ServingMetrics", "Histogram",
+           "PagePool", "pages_needed", "chunk_bucket",
            "Request", "RequestOutput", "RequestState", "SamplingParams"]

@@ -1,30 +1,42 @@
 """ServingEngine: continuous batching over the compiled decode path.
 
-The engine owns a fixed-slot batch (default 8 slots) of static KV
-caches — the SAME buffers `nlp.generation` uses offline, stacked along
-the batch axis with one `pos` PER SLOT — and exactly two compiled
-programs touch them:
+The engine owns a PAGED KV pool — per layer one shared block pool
+[num_pages, page_size, H, D] — plus per-slot page tables [S, max_pages]
+of int32 page ids and one `pos` per slot. A request admitted into a
+slot allocates only the pages its prompt + output budget needs
+(`ceil((plen + max_new) / page_size)`), so a slot holding a 40-token
+request no longer pins `max_len` dense rows; HBM capacity bounds
+concurrency by TOKENS IN FLIGHT, not by slots × max_len (Ragged Paged
+Attention, PAPERS.md). Exactly two program shapes touch the pool:
 
-- one decode step, shared by all slots: sample each slot's next token
+- ONE decode step, shared by all slots: sample each slot's next token
   from its held logits (per-slot temperature/top-k/top-p vectors, same
   math as CompiledGenerator via `sample_logits`/`_top_p_filter`), then
-  one fixed-shape batched forward through the model where every row
-  reads/writes its own cache position (the per-row `pos` vector path in
-  `kv_cache_update`/`window_causal_mask`). Membership, lengths, and
-  sampling params change BETWEEN invocations only — the program never
-  retraces (the slot-granularity analogue of Ragged Paged Attention's
-  one-kernel-for-uneven-lengths, PAPERS.md; keeping the hot loop one
-  fixed program is what lets XLA fuse it, "Operator Fusion in XLA").
-- one prefill per prompt length: a batch-1 forward over a fresh cache
-  whose full KV rows are then written into the free slot of the shared
-  buffers with a single dynamic_update_slice, plus that request's
-  next-token logits into the held-logits row.
+  one fixed-shape batched forward where every row scatters its new K/V
+  into `page_table[slot, pos // page_size]` and attends over its pages
+  gathered back into the dense logical layout (the paged mode of
+  `update_and_attend`). Membership, page tables, lengths and sampling
+  params change BETWEEN invocations only — the program never retraces,
+  which is what lets XLA keep the hot loop one fused executable
+  ("Operator Fusion in XLA", PAPERS.md).
+- one CHUNKED prefill per power-of-two chunk bucket: a fixed-shape
+  batch-1 forward that feeds `chunk_len` prompt tokens through the
+  model, writing the chunk's K/V straight into the slot's pages and the
+  running next-token logits into the held-logits row. A long prompt
+  takes ceil(plen / chunk) of these, ONE per engine step, interleaved
+  with decode steps of resident slots — so a long prompt never stalls
+  anyone's decode for more than one chunk. Bucketing the tail chunk to
+  powers of two bounds the trace count at O(log chunk_len) instead of
+  one trace per distinct prompt length.
+
+Free slots and retired requests point their page-table rows at the
+reserved trash page 0, so the fixed-shape scatter/gather stays safe for
+any live/free mix (see serving/paging.py and the paged DecodeCache).
 
 Correctness contract (tests/test_serving.py): a request decoded greedily
 through the engine emits tokens bit-identical to running it ALONE
-through CompiledGenerator greedy decode, regardless of what its
-slot-neighbors are doing — per-row compute is row-independent and
-membership changes only rewrite the changed slot's rows.
+through CompiledGenerator greedy decode — through chunked prefill,
+page-table indirection, and page reuse after eviction.
 
 Weights enter both programs as closed-over constants (the measured
 layout win of generation.py's _build); construct the engine AFTER any
@@ -45,9 +57,9 @@ from ..core import random as random_mod
 from ..core.tensor import Tensor
 from ..profiler import RecordEvent
 from ..nlp.generation import (_pack_caches, _top_p_filter,
-                              _unpack_caches, decode_model_step,
-                              init_decode_caches)
+                              _unpack_caches, decode_model_step)
 from .metrics import ServingMetrics
+from .paging import PagePool, TRASH_PAGE, chunk_bucket, pages_needed
 from .request import Request, RequestOutput, RequestState, SamplingParams
 from .scheduler import Scheduler
 
@@ -76,13 +88,18 @@ def _sample_rows(logits, key, temps, top_k, top_p, greedy):
 
 class ServingEngine:
     """Online inference engine: submit requests at any time, pump
-    `step()` (or call `run()`/`generate()`); requests join free slots,
+    `step()` (or call `run()`/`generate()`); requests join free slots
+    when their page budget fits the pool, prefill chunk by chunk,
     decode together in one compiled step, and retire on EOS /
     max-tokens / timeout / cancellation without perturbing neighbors.
     """
 
+    MIN_CHUNK = 8     # smallest prefill bucket (power of two)
+
     def __init__(self, model, cache_spec=None, *, num_slots: int = 8,
-                 max_len: int = 256, scheduler: Optional[Scheduler] = None,
+                 max_len: int = 256, page_size: int = 16,
+                 num_pages: Optional[int] = None, chunk_len: int = 32,
+                 scheduler: Optional[Scheduler] = None,
                  metrics: Optional[ServingMetrics] = None,
                  max_queue: Optional[int] = None, clock=time.monotonic):
         if cache_spec is None:
@@ -96,6 +113,19 @@ class ServingEngine:
         self.n_layers, self.n_kv, self.head_dim = cache_spec
         self.num_slots = int(num_slots)
         self.max_len = int(max_len)
+        self.page_size = int(page_size)
+        if self.page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.max_pages = -(-self.max_len // self.page_size)
+        # default pool = dense-equivalent capacity (+ the trash page):
+        # every slot can still hold max_len, and sizing num_pages BELOW
+        # this is where the paged pool beats the dense cache — more
+        # resident short requests per HBM byte
+        self.num_pages = (self.num_slots * self.max_pages + 1
+                          if num_pages is None else int(num_pages))
+        self.chunk_len = int(chunk_len)
+        if self.chunk_len < self.MIN_CHUNK:
+            raise ValueError(f"chunk_len must be >= {self.MIN_CHUNK}")
         self.scheduler = scheduler or Scheduler(self.num_slots,
                                                 max_queue=max_queue)
         if self.scheduler.num_slots != self.num_slots:
@@ -113,13 +143,29 @@ class ServingEngine:
             (t._value.dtype for t in self._state_tensors
              if jnp.issubdtype(t._value.dtype, jnp.floating)),
             dtypes.get_default_dtype().np_dtype)
-        # device state: stacked KV rows, per-slot positions, per-slot
-        # held next-token logits (filled by prefill, advanced by decode)
-        self._ct = _pack_caches(init_decode_caches(
-            self.n_layers, self.num_slots, self.max_len, self.n_kv,
-            self.head_dim, dtype=self._fp))
+        # device state: per-layer shared K/V pools, per-slot positions,
+        # per-slot held next-token logits (filled by the final prefill
+        # chunk, advanced by decode)
+        self._ct = tuple(
+            (jnp.zeros((self.num_pages, self.page_size, self.n_kv,
+                        self.head_dim), self._fp),
+             jnp.zeros((self.num_pages, self.page_size, self.n_kv,
+                        self.head_dim), self._fp),
+             None, None)
+            for _ in range(self.n_layers))
         self._pos = jnp.zeros((self.num_slots,), jnp.int32)
         self._last_logits = None      # [S, V] f32, lazy (V from prefill)
+        # host page state: allocator, per-slot page lists, page tables
+        # (full for prefill; decode variant trash-masks non-DECODE rows
+        # so their ignored writes can't touch live pages)
+        self.pool = PagePool(self.num_pages)
+        self._slot_pages: Dict[int, List[int]] = {}
+        self._prefill_cursor: Dict[str, int] = {}
+        self._pt_host = np.full((self.num_slots, self.max_pages),
+                                TRASH_PAGE, np.int32)
+        self._pt_dirty = True
+        self._pt_full = None
+        self._pt_decode = None
         # per-slot sampling vectors, rebuilt when membership changes
         self._vec_dirty = True
         self._temps = np.ones((self.num_slots,), np.float32)
@@ -127,7 +173,7 @@ class ServingEngine:
         self._topp = np.ones((self.num_slots,), np.float32)
         self._greedy = np.ones((self.num_slots,), bool)
         self._active = np.zeros((self.num_slots,), bool)
-        self._prefill_fns: Dict[int, object] = {}
+        self._prefill_fns: Dict[int, object] = {}   # chunk bucket -> fn
         self._decode_fn = None
         self._spans: Dict[str, RecordEvent] = {}
 
@@ -142,68 +188,71 @@ class ServingEngine:
         for t, v in zip(self._state_tensors, originals):
             t._value = v
 
-    def _build_prefill(self, prompt_len: int):
-        """Compiled per prompt length: batch-1 prefill over a fresh
-        cache, then write the whole KV row + next-token logits into the
-        free slot of the shared buffers."""
+    def _build_prefill(self, bucket: int):
+        """Compiled once per chunk BUCKET (not per prompt length): a
+        batch-1 forward of `bucket` tokens for one slot, scattering the
+        chunk's K/V into the slot's pages at positions start..start+l-1
+        and recording the logits of the chunk's last REAL token into the
+        held-logits row. Host-side padding of the tail chunk rides on
+        the trash-page write redirect, so the padded tokens are inert."""
         model = self.model
-        n_layers, n_kv, head_dim = self.n_layers, self.n_kv, self.head_dim
-        max_len, fp = self.max_len, self._fp
         state_vals = [t._value for t in self._state_tensors]
 
-        def prefill(state_vals, ct, pos, last_logits, prompt, slot):
+        def prefill(state_vals, ct, pos, last_logits, page_table,
+                    tokens, slot, start, new_pos, last_idx):
             originals = self._swap_state(state_vals)
             try:
-                caches = init_decode_caches(n_layers, 1, max_len, n_kv,
-                                            head_dim, dtype=fp)
-                logits_t, caches = model(Tensor(prompt), caches=caches)
-                row = logits_t._value[:, -1, :].astype(jnp.float32)
-                c1 = _pack_caches(caches)
                 z = jnp.zeros((), jnp.int32)
                 s = slot.astype(jnp.int32).reshape(())
-                new_ct = tuple(
-                    (jax.lax.dynamic_update_slice(
-                        k, k1.astype(k.dtype), (s, z, z, z)),
-                     jax.lax.dynamic_update_slice(
-                        v, v1.astype(v.dtype), (s, z, z, z)),
-                     ks, vs)
-                    for (k, v, ks, vs), (k1, v1, _, _) in zip(ct, c1))
+                pt_row = jax.lax.dynamic_slice(
+                    page_table, (s, z), (1, page_table.shape[1]))
+                caches = _unpack_caches(ct, start, pt_row)
+                logits_t, caches = model(Tensor(tokens), caches=caches)
+                v = logits_t._value.shape[-1]
+                row = jax.lax.dynamic_slice(
+                    logits_t._value, (z, last_idx.astype(jnp.int32), z),
+                    (1, 1, v))[:, 0, :].astype(jnp.float32)
+                new_ct = _pack_caches(caches)
                 pos = jax.lax.dynamic_update_slice(
-                    pos, jnp.full((1,), prompt_len, jnp.int32), (s,))
+                    pos, new_pos.astype(jnp.int32).reshape(1), (s,))
                 last_logits = jax.lax.dynamic_update_slice(
-                    last_logits, row, (s, jnp.zeros((), jnp.int32)))
+                    last_logits, row, (s, z))
                 return new_ct, pos, last_logits
             finally:
                 self._restore_state(originals)
 
-        return jax.jit(lambda ct, pos, ll, prompt, slot: prefill(
-            state_vals, ct, pos, ll, prompt, slot))
+        return jax.jit(
+            lambda ct, pos, ll, pt, tokens, slot, start, new_pos,
+            last_idx: prefill(state_vals, ct, pos, ll, pt, tokens, slot,
+                              start, new_pos, last_idx))
 
     def _build_decode(self):
         """ONE fixed-shape step for all slots: sample from held logits
-        with per-slot params, batched forward with per-row positions."""
+        with per-slot params, batched forward with per-row positions
+        through the paged pool."""
         model = self.model
         state_vals = [t._value for t in self._state_tensors]
 
-        def step(state_vals, ct, pos, last_logits, key, temps, top_k,
-                 top_p, greedy, active):
+        def step(state_vals, ct, pos, last_logits, page_table, key,
+                 temps, top_k, top_p, greedy, active):
             originals = self._swap_state(state_vals)
             try:
                 nxt = _sample_rows(last_logits, key, temps, top_k,
                                    top_p, greedy)
                 nxt = jnp.where(active, nxt, 0).astype(jnp.int32)
-                caches = _unpack_caches(ct, pos)
+                caches = _unpack_caches(ct, pos, page_table)
                 last, caches = decode_model_step(model, nxt[:, None],
                                                  caches)
-                # only occupied slots advance; free rows stay frozen
-                # (their stale rows are fully overwritten at reuse)
+                # only occupied slots advance; free/prefilling rows stay
+                # frozen (their writes went to the trash page — the
+                # decode page table trash-masks non-DECODE rows)
                 new_pos = jnp.where(active, pos + 1, pos)
                 return _pack_caches(caches), new_pos, last, nxt
             finally:
                 self._restore_state(originals)
 
-        return jax.jit(lambda ct, pos, ll, key, t, k, p, g, a: step(
-            state_vals, ct, pos, ll, key, t, k, p, g, a))
+        return jax.jit(lambda ct, pos, ll, pt, key, t, k, p, g, a: step(
+            state_vals, ct, pos, ll, pt, key, t, k, p, g, a))
 
     # -- request intake ----------------------------------------------------
     def add_request(self, prompt_ids, sampling: Optional[SamplingParams]
@@ -223,21 +272,28 @@ class ServingEngine:
                 f"{sampling.max_new_tokens} exceeds engine max_len "
                 f"{self.max_len}; lower max_new_tokens or grow the "
                 "engine's cache")
+        need = pages_needed(prompt.size, sampling.max_new_tokens,
+                            self.page_size)
+        if need > self.num_pages - 1:
+            raise ValueError(
+                f"request needs {need} pages but the pool only has "
+                f"{self.num_pages - 1} allocatable pages; grow "
+                "num_pages or lower max_new_tokens")
         if request_id is None:
             request_id = f"req-{next(self._id_counter)}"
         if request_id in self._requests:
             raise ValueError(f"duplicate request_id {request_id!r}")
         req = Request(request_id, prompt, sampling, on_token=on_token,
                       arrival_t=self._clock())
+        self.scheduler.submit(req)     # may shed load (max_queue)
         self._requests[request_id] = req
-        self.scheduler.submit(req)
         self.metrics.on_submit(req)
         return req
 
     def cancel(self, request_id: str) -> bool:
         """Mark a request cancelled. Queued requests drop immediately;
-        a running one is evicted at the next step boundary (its slot is
-        then free for the next queued request)."""
+        a running one (prefilling or decoding) is evicted at the next
+        step boundary and its pages return to the pool."""
         req = self._requests.get(request_id)
         if req is None or req.finished:
             return False
@@ -249,7 +305,20 @@ class ServingEngine:
         req.state = RequestState.CANCELLED
         return True
 
-    # -- step boundary: retire / admit / decode ----------------------------
+    # -- page-table device views -------------------------------------------
+    def _page_tables(self):
+        """(full, decode) device page tables. The decode variant points
+        every non-DECODE row at the trash page so the fixed-shape
+        decode scatter can't touch a mid-prefill slot's live pages."""
+        if self._pt_dirty or self._pt_full is None:
+            self._pt_full = jnp.asarray(self._pt_host)
+            self._pt_decode = jnp.asarray(
+                np.where(self._active[:, None], self._pt_host,
+                         TRASH_PAGE).astype(np.int32))
+            self._pt_dirty = False
+        return self._pt_full, self._pt_decode
+
+    # -- step boundary: retire / admit / prefill / decode ------------------
     def _finish_and_free(self, req: Request, reason: str, now: float,
                          finished: List[RequestOutput]):
         if req.slot is not None:
@@ -257,6 +326,13 @@ class ServingEngine:
             self.scheduler.retire(slot)
             self._active[slot] = False
             self._vec_dirty = True
+            pages = self._slot_pages.pop(slot, None)
+            if pages:
+                self.pool.free(pages)
+            req.pages = None
+            self._pt_host[slot, :] = TRASH_PAGE
+            self._pt_dirty = True
+        self._prefill_cursor.pop(req.request_id, None)
         req._finish(reason, now)
         self.metrics.on_finish(req, now)
         span = self._spans.pop(req.request_id, None)
@@ -272,39 +348,88 @@ class ServingEngine:
         for req in self.scheduler.cancelled_running():
             self._finish_and_free(req, "cancelled", now, finished)
 
+    def _reserve(self, req: Request) -> bool:
+        """Page-aware admission (scheduler callback): grant the slot
+        only if the request's WHOLE page budget is free right now —
+        otherwise the queue head waits (FIFO backpressure) and nobody
+        behind it can starve it by stealing pages."""
+        pages = self.pool.alloc(pages_needed(
+            req.prompt_ids.size, req.sampling.max_new_tokens,
+            self.page_size))
+        if pages is None:
+            return False
+        req.pages = pages
+        return True
+
     def _admit(self, now: float):
-        for slot, req in self.scheduler.assign():
+        for slot, req in self.scheduler.assign(reserve=self._reserve):
             req.state = RequestState.PREFILL
             req.admitted_t = now
             span = RecordEvent(f"serving::request[{req.request_id}]")
             span.begin()
             self._spans[req.request_id] = span
-            self._prefill(slot, req)
-            req.state = RequestState.DECODE
-            self._active[slot] = True
-            self._vec_dirty = True
+            self._slot_pages[slot] = req.pages
+            self._pt_host[slot, :] = TRASH_PAGE
+            self._pt_host[slot, :len(req.pages)] = req.pages
+            self._pt_dirty = True
+            self._pos = self._pos.at[slot].set(0)
+            self._prefill_cursor[req.request_id] = 0
             self.metrics.on_admit(req, self._clock())
 
-    def _prefill(self, slot: int, req: Request):
+    def _ensure_last_logits(self, req: Request):
+        if self._last_logits is not None:
+            return
+        vocab = int(getattr(getattr(self.model, "config", None),
+                            "vocab_size", 0))
+        if not vocab:
+            # probe: one eager forward row tells us V
+            lg = self.model(Tensor(jnp.asarray(
+                req.prompt_ids[None, :1], jnp.int32)))
+            vocab = int(lg.shape[-1])
+        self._last_logits = jnp.zeros((self.num_slots, vocab),
+                                      jnp.float32)
+
+    def _advance_prefills(self) -> int:
+        """One chunk for EACH mid-prefill slot, then back to decode —
+        the interleave that keeps long prompts from stalling resident
+        decodes for more than one chunk. Returns chunks run."""
+        chunks = 0
+        for slot, req in sorted(self.scheduler.running.items()):
+            if req.state is not RequestState.PREFILL:
+                continue
+            self._prefill_chunk(slot, req)
+            chunks += 1
+            if self._prefill_cursor[req.request_id] >= \
+                    req.prompt_ids.size:
+                self._prefill_cursor.pop(req.request_id, None)
+                req.state = RequestState.DECODE
+                self._active[slot] = True
+                self._vec_dirty = True
+                self._pt_dirty = True    # row goes live for decode
+        return chunks
+
+    def _prefill_chunk(self, slot: int, req: Request):
         plen = int(req.prompt_ids.size)
-        fn = self._prefill_fns.get(plen)
+        cursor = self._prefill_cursor[req.request_id]
+        bucket = chunk_bucket(plen - cursor, self.chunk_len,
+                              self.MIN_CHUNK)
+        fn = self._prefill_fns.get(bucket)
         if fn is None:
-            fn = self._prefill_fns[plen] = self._build_prefill(plen)
-        if self._last_logits is None:
-            vocab = int(getattr(getattr(self.model, "config", None),
-                                "vocab_size", 0))
-            if not vocab:
-                # probe: one eager forward row tells us V
-                lg = self.model(Tensor(jnp.asarray(
-                    req.prompt_ids[None, :1], jnp.int32)))
-                vocab = int(lg.shape[-1])
-            self._last_logits = jnp.zeros((self.num_slots, vocab),
-                                          jnp.float32)
-        with RecordEvent(f"serving::prefill[{req.request_id}]"):
+            fn = self._prefill_fns[bucket] = self._build_prefill(bucket)
+        self._ensure_last_logits(req)
+        real = min(plen - cursor, bucket)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :real] = req.prompt_ids[cursor:cursor + real]
+        pt_full, _ = self._page_tables()
+        with RecordEvent(f"serving::prefill[{req.request_id}"
+                         f"@{cursor}+{bucket}]"):
             self._ct, self._pos, self._last_logits = fn(
-                self._ct, self._pos, self._last_logits,
-                jnp.asarray(req.prompt_ids[None, :], jnp.int32),
-                jnp.int32(slot))
+                self._ct, self._pos, self._last_logits, pt_full,
+                jnp.asarray(tokens), jnp.int32(slot),
+                jnp.asarray([cursor], jnp.int32),
+                jnp.int32(cursor + real), jnp.int32(real - 1))
+        self._prefill_cursor[req.request_id] = cursor + real
+        self.metrics.on_prefill_chunk(real)
 
     def _refresh_vectors(self):
         for s in range(self.num_slots):
@@ -325,17 +450,21 @@ class ServingEngine:
             self._decode_fn = self._build_decode()
         if self._vec_dirty:
             self._refresh_vectors()
+        _, pt_decode = self._page_tables()
         key = random_mod.next_key_host()
         with RecordEvent("serving::decode_step"):
             self._ct, self._pos, self._last_logits, toks = \
                 self._decode_fn(
-                    self._ct, self._pos, self._last_logits, key,
+                    self._ct, self._pos, self._last_logits, pt_decode,
+                    key,
                     jnp.asarray(self._temps), jnp.asarray(self._topk),
                     jnp.asarray(self._topp), jnp.asarray(self._greedy),
                     jnp.asarray(self._active))
             toks = np.asarray(toks)   # sync point: host sees the tokens
         now = now_fn()
         for slot, req in list(self.scheduler.running.items()):
+            if req.state is not RequestState.DECODE:
+                continue              # mid-prefill: no token this step
             tok = int(toks[slot])
             prev_t = req._last_token_t
             req._emit(tok, now)
@@ -349,17 +478,22 @@ class ServingEngine:
                 self._finish_and_free(req, "length", now, finished)
 
     def step(self) -> List[RequestOutput]:
-        """One scheduler round: evict (timeout/cancel), refill free
-        slots (prefill), then one compiled decode step for everyone.
+        """One scheduler round: evict (timeout/cancel), admit queued
+        requests whose pages fit, one prefill chunk per mid-prefill
+        slot, then one compiled decode step for every decoding slot.
         Returns requests that finished this round."""
         finished: List[RequestOutput] = []
         now = self._clock()
         self._evict(now, finished)
         self._admit(now)
-        if self.scheduler.running:
+        chunks = self._advance_prefills()
+        if self._active.any():
             self._decode(self._clock, finished)
         self.metrics.on_step(self.scheduler.queue_depth,
-                             self.scheduler.occupancy, self.num_slots)
+                             self.scheduler.occupancy, self.num_slots,
+                             pages_used=self.pool.used_pages,
+                             pages_total=self.num_pages - 1,
+                             stall_chunks=chunks)
         return finished
 
     # -- conveniences ------------------------------------------------------
@@ -385,6 +519,11 @@ class ServingEngine:
         return outputs in submission order."""
         if sampling is None or isinstance(sampling, SamplingParams):
             sampling = [sampling] * len(prompts)
+        elif len(sampling) != len(prompts):
+            raise ValueError(
+                f"sampling list length {len(sampling)} != number of "
+                f"prompts {len(prompts)}; pass one SamplingParams per "
+                "prompt (or a single shared instance)")
         reqs = [self.add_request(p, sp) for p, sp in zip(prompts, sampling)]
         self.run()
         return [r.output() for r in reqs]

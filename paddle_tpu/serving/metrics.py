@@ -70,11 +70,20 @@ class ServingMetrics:
         self.tokens_generated = 0
         self.prompt_tokens = 0
         self.prefills = 0
+        self.prefill_chunks = 0
+        self.prefill_chunk_tokens = 0
         self.decode_steps = 0
         # gauges (last observed at a step boundary)
         self.queue_depth = 0
         self.slot_occupancy = 0.0
         self.num_slots = 0
+        # paged KV pool gauges: used/total allocatable pages, and the
+        # prefill-stall gauge — how many prefill chunk programs ran
+        # ahead of the latest decode step (each one delays every
+        # resident decode by one chunk forward)
+        self.pool_pages_used = 0
+        self.pool_pages_total = 0
+        self.prefill_stall = 0
         # histograms
         self.ttft_s = Histogram()
         self.inter_token_s = Histogram()
@@ -82,6 +91,8 @@ class ServingMetrics:
         self.e2e_s = Histogram()
         self.queue_depth_hist = Histogram()
         self.occupancy_hist = Histogram()
+        self.pool_utilization_hist = Histogram()
+        self.prefill_stall_hist = Histogram()
         # busy window for throughput
         self._first_admit_t: Optional[float] = None
         self._last_token_t: Optional[float] = None
@@ -116,13 +127,25 @@ class ServingMetrics:
             self.requests_completed += 1
         self.e2e_s.record(now - req.arrival_t)
 
-    def on_step(self, queue_depth: int, occupancy: float, num_slots: int):
+    def on_prefill_chunk(self, n_tokens: int):
+        self.prefill_chunks += 1
+        self.prefill_chunk_tokens += int(n_tokens)
+
+    def on_step(self, queue_depth: int, occupancy: float, num_slots: int,
+                pages_used: int = 0, pages_total: int = 0,
+                stall_chunks: int = 0):
         self.decode_steps += 1
         self.queue_depth = queue_depth
         self.slot_occupancy = occupancy
         self.num_slots = num_slots
         self.queue_depth_hist.record(queue_depth)
         self.occupancy_hist.record(occupancy)
+        self.pool_pages_used = pages_used
+        self.pool_pages_total = pages_total
+        self.prefill_stall = stall_chunks
+        if pages_total:
+            self.pool_utilization_hist.record(pages_used / pages_total)
+        self.prefill_stall_hist.record(stall_chunks)
 
     # -- reading ----------------------------------------------------------
     @property
@@ -145,11 +168,20 @@ class ServingMetrics:
             "tokens_generated": self.tokens_generated,
             "prompt_tokens": self.prompt_tokens,
             "prefills": self.prefills,
+            "prefill_chunks": self.prefill_chunks,
+            "prefill_chunk_tokens": self.prefill_chunk_tokens,
             "decode_steps": self.decode_steps,
             "tokens_per_sec": self.tokens_per_sec,
             "queue_depth": self.queue_depth,
             "slot_occupancy": self.slot_occupancy,
             "num_slots": self.num_slots,
+            "pool": {
+                "pages_used": self.pool_pages_used,
+                "pages_total": self.pool_pages_total,
+                "utilization": self.pool_utilization_hist.snapshot(),
+            },
+            "prefill_stall": self.prefill_stall,
+            "prefill_stall_hist": self.prefill_stall_hist.snapshot(),
             "ttft_s": self.ttft_s.snapshot(),
             "inter_token_s": self.inter_token_s.snapshot(),
             "queue_wait_s": self.queue_wait_s.snapshot(),

@@ -1,0 +1,89 @@
+"""Host-side page bookkeeping for the paged KV pool.
+
+The device state is a shared per-layer pool [num_pages, page_size, H, D]
+plus a per-slot page table [S, max_pages] (see nlp/generation.py's paged
+DecodeCache). This module owns the HOST half: which pages are free,
+which belong to which request, and how prompts are cut into
+power-of-two chunk buckets so the compiled prefill-trace count stays
+O(log max_len) instead of one trace per distinct prompt length.
+
+Page 0 is reserved as the TRASH page: it is never handed out, free
+slots' page-table rows point every entry at it, and the device scatter
+redirects out-of-window writes into it — so membership changes never
+reshape or retrace the compiled programs.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+__all__ = ["PagePool", "TRASH_PAGE", "pages_needed", "chunk_bucket"]
+
+TRASH_PAGE = 0      # reserved: never allocated, absorbs masked writes
+
+
+class PagePool:
+    """Free-list allocator over page ids 1..num_pages-1 (0 is trash).
+
+    Allocation is all-or-nothing per request: the scheduler admits a
+    request only when its whole page budget is free, so a half-admitted
+    request can never wedge the pool.
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is the "
+                             "reserved trash page)")
+        self.num_pages = int(num_pages)
+        # LIFO free list: recently freed pages are reused first, which
+        # keeps the hot working set of pages small
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n pages, or None (without side effects) if not enough free."""
+        if n < 0:
+            raise ValueError("n must be >= 0")
+        if n > len(self._free):
+            return None
+        taken = self._free[-n:] if n else []
+        del self._free[len(self._free) - n:]
+        return taken
+
+    def free(self, pages: List[int]):
+        for p in pages:
+            if not (0 < p < self.num_pages):
+                raise ValueError(f"page id {p} out of range")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+        self._free.extend(pages)
+
+
+def pages_needed(prompt_len: int, max_new_tokens: int,
+                 page_size: int) -> int:
+    """Admission budget: pages covering every position the request can
+    legitimately occupy (prompt + full output allowance)."""
+    return -(-(int(prompt_len) + int(max_new_tokens)) // int(page_size))
+
+
+def chunk_bucket(remaining: int, chunk_len: int, min_chunk: int = 8
+                 ) -> int:
+    """Length of the next prefill chunk: full `chunk_len` chunks while
+    the remainder is large, then ONE power-of-two bucket >= the tail
+    (clamped to [min_chunk, chunk_len]). Distinct bucket values over
+    all prompts are {chunk_len} ∪ {min_chunk * 2**i <= chunk_len}, so
+    the engine compiles O(log chunk_len) prefill programs total."""
+    if remaining <= 0:
+        raise ValueError("remaining must be > 0")
+    if remaining >= chunk_len:
+        return chunk_len
+    b = min_chunk
+    while b < remaining:
+        b *= 2
+    return min(b, chunk_len)

@@ -5,8 +5,14 @@ batching lifecycle:
 
     QUEUED -> PREFILL -> DECODE -> FINISHED | CANCELLED
 
-States advance only at step boundaries of the engine (between compiled
-program invocations), never inside one, so the compiled prefill/decode
+PREFILL now spans MULTIPLE engine steps for long prompts: the engine
+feeds the prompt through one fixed-shape chunk program per step
+(chunked prefill), interleaved with the residents' decode steps, and
+flips the request to DECODE after the final chunk. Admission also
+allocates the request's KV pages (`pages`) from the shared paged pool;
+they return to the pool when the request retires. States advance only
+at step boundaries of the engine (between compiled program
+invocations), never inside one, so the compiled prefill/decode
 programs themselves stay fixed-shape. Per-request sampling knobs live in
 `SamplingParams`; the engine vectorizes them across slots (one value per
 slot row) and evaluates them on device, reusing the same nucleus filter
@@ -82,6 +88,8 @@ class Request:
         self.output_tokens: List[int] = []
         self.finish_reason: Optional[str] = None  # stop|length|cancelled|timeout
         self.slot: Optional[int] = None
+        # KV pages granted at admission (paged pool); None while queued
+        self.pages: Optional[List[int]] = None
         # timeline (engine clock): arrival -> admitted (slot granted,
         # prefill) -> first token -> finished
         self.arrival_t = time.monotonic() if arrival_t is None else arrival_t

@@ -8,12 +8,17 @@ slot-based analogue of Ragged Paged Attention's "requests of uneven
 lengths share one kernel invocation" (PAPERS.md).
 
 Policy: plain FIFO fairness by arrival order. A freed slot is refilled
-by the longest-waiting queued request at the next step boundary.
+by the longest-waiting queued request at the next step boundary —
+subject to the engine's resource check (`assign(reserve=...)`): with a
+paged KV pool a free slot alone is not admission, the request's whole
+page budget must be free too. Backpressure is head-of-line: when the
+oldest queued request's pages don't fit, nothing behind it is admitted
+either, so a large request can't be starved by a stream of small ones.
 """
 from __future__ import annotations
 
 from collections import deque
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .request import Request, RequestState
 
@@ -56,15 +61,23 @@ class Scheduler:
         return [s for s in range(self.num_slots) if s not in self.running]
 
     # -- membership changes (between compiled steps only) -----------------
-    def assign(self) -> List[Tuple[int, Request]]:
+    def assign(self, reserve: Optional[Callable[[Request], bool]] = None
+               ) -> List[Tuple[int, Request]]:
         """Join policy: fill free slots from the queue in arrival order.
-        Returns the (slot, request) pairs granted this boundary; the
-        engine prefills each one before the next decode step."""
+        `reserve(req)` (optional) must claim the request's resources
+        (KV pages) and return True, or refuse without side effects —
+        a refusal stops admission at the queue head (FIFO
+        backpressure). Returns the (slot, request) pairs granted this
+        boundary; the engine prefills each one across the following
+        steps."""
         grants = []
         for slot in self.free_slots():
             if not self._queue:
                 break
-            req = self._queue.popleft()
+            req = self._queue[0]
+            if reserve is not None and not reserve(req):
+                break
+            self._queue.popleft()
             req.slot = slot
             self.running[slot] = req
             grants.append((slot, req))

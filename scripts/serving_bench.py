@@ -1,13 +1,15 @@
 """Online serving bench: Poisson arrivals through the ServingEngine.
 
-Drives `paddle_tpu.serving.ServingEngine` with a Poisson arrival trace
-(exponential inter-arrival gaps, geometric-ish mixed prompt lengths and
-output budgets) against the tiny GPT config on CPU or a GPT-124M-ish
-config on the chip, and prints ONE JSON line:
+Drives `paddle_tpu.serving.ServingEngine` (paged KV pool + chunked
+prefill) with a Poisson arrival trace (exponential inter-arrival gaps,
+geometric-ish mixed prompt lengths and output budgets) against the
+tiny GPT config on CPU or a GPT-124M-ish config on the chip. Prints
+ONE JSON line and writes the same stable-schema report to
+BENCH_serving.json (override with --out, suppress with --out -):
 
-    {"bench": "serving", "requests": ..., "ttft_p50_s": ...,
-     "ttft_p99_s": ..., "inter_token_p50_s": ..., "tokens_per_sec": ...,
-     "occupancy_mean": ..., "decode_steps": ..., ...}
+    {"bench": "serving", "schema_version": 2, "requests": ...,
+     "ttft_p50_s": ..., "ttft_p99_s": ..., "tokens_per_sec": ...,
+     "pool_utilization_mean": ..., "prefill_chunks": ..., ...}
 
 Usage:
     python scripts/serving_bench.py            # platform-sized run
@@ -62,9 +64,17 @@ def main():
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--max-len", type=int, default=None)
     ap.add_argument("--max-new", type=int, default=None)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--pages", type=int, default=None,
+                    help="pool size; default = dense-equivalent "
+                    "(slots * ceil(max_len/page_size) + 1)")
+    ap.add_argument("--chunk", type=int, default=None,
+                    help="prefill chunk length (compiled shape)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--smoke", action="store_true",
                     help="tiny fast run (CI)")
+    ap.add_argument("--out", default="BENCH_serving.json",
+                    help="report path ('-' = print only)")
     args = ap.parse_args()
 
     import jax
@@ -75,19 +85,25 @@ def main():
     model, cfg = build_model(on_tpu)
 
     if args.smoke:
-        n_req, rate, max_new, max_len = 6, 200.0, 6, 48
+        n_req = args.requests or 6
+        rate = args.rate or 200.0
+        max_new = args.max_new or 6
+        max_len = args.max_len or 48
+        chunk = args.chunk or 16
         prompt_lens = [3, 5, 8]
     elif on_tpu:
         n_req = args.requests or 128
         rate = args.rate or 32.0
         max_new = args.max_new or 128
         max_len = args.max_len or 1024
+        chunk = args.chunk or 128
         prompt_lens = [32, 64, 128, 256]
     else:
         n_req = args.requests or 24
         rate = args.rate or 100.0
         max_new = args.max_new or 16
         max_len = args.max_len or 128
+        chunk = args.chunk or 32
         prompt_lens = [4, 8, 12, 16]
 
     rng = np.random.RandomState(args.seed)
@@ -98,10 +114,13 @@ def main():
                for _ in range(n_req)]
     budgets = rng.randint(max(1, max_new // 2), max_new + 1, size=n_req)
 
-    eng = ServingEngine(model, num_slots=args.slots, max_len=max_len)
+    eng = ServingEngine(model, num_slots=args.slots, max_len=max_len,
+                        page_size=args.page_size, num_pages=args.pages,
+                        chunk_len=chunk)
 
     # warm the compiled programs so the trace measures steady state, not
-    # XLA compile time: one request per distinct prompt length
+    # XLA compile time: one request per distinct prompt length (chunk
+    # bucketing folds these into O(log chunk) prefill traces)
     for pl in sorted({p.size for p in prompts}):
         eng.add_request(np.arange(1, pl + 1, dtype=np.int64),
                         SamplingParams(max_new_tokens=2))
@@ -125,12 +144,17 @@ def main():
     wall = time.monotonic() - t0
 
     snap = eng.metrics.snapshot()
+    pool = snap["pool"]
     report = {
         "bench": "serving",
+        "schema_version": 2,
         "platform": jax.devices()[0].platform,
         "requests": n_req,
         "slots": args.slots,
         "max_len": max_len,
+        "page_size": eng.page_size,
+        "num_pages": eng.num_pages,
+        "chunk_len": eng.chunk_len,
         "arrival_rate_per_s": rate,
         "wall_s": round(wall, 4),
         "tokens_generated": snap["tokens_generated"],
@@ -140,10 +164,18 @@ def main():
         "inter_token_p50_s": snap["inter_token_s"]["p50"],
         "queue_wait_p99_s": snap["queue_wait_s"]["p99"],
         "occupancy_mean": snap["occupancy_hist"]["mean"],
+        "pool_utilization_mean": pool["utilization"]["mean"],
+        "pool_utilization_max": pool["utilization"]["max"],
+        "prefill_chunks": snap["prefill_chunks"],
+        "prefill_stall_p99": snap["prefill_stall_hist"]["p99"],
         "decode_steps": snap["decode_steps"],
         "completed": snap["requests"]["completed"],
     }
     print(json.dumps(report))
+    if args.out != "-":
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+            f.write("\n")
     assert snap["requests"]["completed"] == n_req, \
         (snap["requests"], n_req)
 

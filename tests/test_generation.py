@@ -529,6 +529,95 @@ class TestInt8KVCache:
         with _pytest.raises(NotImplementedError):
             update_and_attend(q, q, q, c2)               # pos 3, l 3
 
+    def test_rowwise_pos_vector_decode_bit_exact(self):
+        """Per-row pos vector on the int8 cache (continuous batching):
+        the batched single-token update/attend is BIT-IDENTICAL to
+        running each row alone through the scalar-pos path; multi-token
+        rowwise chunks still raise."""
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.nlp.generation import (DecodeCache,
+                                               update_and_attend)
+        import jax.numpy as jnp
+        rs = np.random.RandomState(7)
+        B, H, L, D = 2, 2, 16, 4
+        k8 = rs.randint(-127, 128, (B, H, L, D)).astype(np.int8)
+        v8 = rs.randint(-127, 128, (B, H, L, D)).astype(np.int8)
+        ks = rs.uniform(0.01, 0.03, (H,)).astype(np.float32)
+        vs = rs.uniform(0.01, 0.03, (H,)).astype(np.float32)
+        q = rs.randn(B, 1, H, D).astype(np.float32)
+        kn = rs.randn(B, 1, H, D).astype(np.float32)
+        vn = rs.randn(B, 1, H, D).astype(np.float32)
+        pos = np.array([3, 5], np.int32)     # each row at its own pos
+        cache = DecodeCache(Tensor(jnp.asarray(k8)),
+                            Tensor(jnp.asarray(v8)),
+                            Tensor(jnp.asarray(pos)),
+                            Tensor(jnp.asarray(ks)),
+                            Tensor(jnp.asarray(vs)))
+        out, c2 = update_and_attend(Tensor(jnp.asarray(q)),
+                                    Tensor(jnp.asarray(kn)),
+                                    Tensor(jnp.asarray(vn)), cache)
+        for b in range(B):
+            cb = DecodeCache(Tensor(jnp.asarray(k8[b:b + 1])),
+                             Tensor(jnp.asarray(v8[b:b + 1])),
+                             Tensor(jnp.asarray(pos[b])),
+                             Tensor(jnp.asarray(ks)),
+                             Tensor(jnp.asarray(vs)))
+            ob, cb2 = update_and_attend(
+                Tensor(jnp.asarray(q[b:b + 1])),
+                Tensor(jnp.asarray(kn[b:b + 1])),
+                Tensor(jnp.asarray(vn[b:b + 1])), cb)
+            np.testing.assert_array_equal(np.asarray(ob._value),
+                                          np.asarray(out._value[b:b + 1]))
+            np.testing.assert_array_equal(np.asarray(cb2.k._value[0]),
+                                          np.asarray(c2.k._value[b]))
+            np.testing.assert_array_equal(np.asarray(cb2.v._value[0]),
+                                          np.asarray(c2.v._value[b]))
+        # per-row pos + multi-token chunk: still rejected
+        q3 = Tensor(jnp.asarray(rs.randn(B, 3, H, D).astype(np.float32)))
+        import pytest as _pytest
+        with _pytest.raises(NotImplementedError):
+            update_and_attend(q3, q3, q3, cache)
+
+    def test_rowwise_pos_vector_tokens_match_float_cache(self):
+        """Serving-style decode (per-row pos vector) over the int8
+        cache emits the same greedy tokens as the same decode over the
+        float cache — int8 composes with continuous batching."""
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.nlp.generation import (CompiledGenerator,
+                                               DecodeCache,
+                                               decode_model_step,
+                                               init_decode_caches)
+        import jax.numpy as jnp
+        m = self._gpt()
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 512, (2, 5)))
+        gen = CompiledGenerator(m, m._decode_cache_spec(),
+                                kv_cache_dtype="int8")
+        scales = gen._calibrate_kv_scales(ids)
+        n_layers, n_kv, hd = m._decode_cache_spec()
+
+        def prefill(kv_scales):
+            caches = init_decode_caches(n_layers, 2, 16, n_kv, hd,
+                                        kv_scales=kv_scales)
+            logits, caches = m(ids, caches=caches)
+            # re-seat pos as the serving engine does: per-row vector
+            pos = Tensor(jnp.asarray([5, 5], jnp.int32))
+            return (logits._value[:, -1, :],
+                    [DecodeCache(c.k, c.v, pos, c.k_scale, c.v_scale)
+                     for c in caches])
+
+        last_f, caches_f = prefill(None)
+        last_q, caches_q = prefill(scales)
+        for _ in range(4):
+            nxt_f = jnp.argmax(last_f, axis=-1).astype(jnp.int32)
+            nxt_q = jnp.argmax(last_q, axis=-1).astype(jnp.int32)
+            np.testing.assert_array_equal(np.asarray(nxt_f),
+                                          np.asarray(nxt_q))
+            last_f, caches_f = decode_model_step(m, nxt_f[:, None],
+                                                 caches_f)
+            last_q, caches_q = decode_model_step(m, nxt_q[:, None],
+                                                 caches_q)
+
 
 class TestTopPFilter:
     """Edge cases of the nucleus mask shared by CompiledGenerator and

@@ -897,6 +897,10 @@ ELSEWHERE = {
         "kv_cache_update", "window_causal_mask", "decode_merge_mask"]},
     **{n: EW("test_generation.py", "kv_cache_dtype") for n in [
         "kv_cache_update_q8", "kv8_attend"]},
+    # paged KV pool (serving) — bit-identity vs dense decode through
+    # page-table scatter/gather, chunked prefill, page reuse
+    **{n: EW("test_serving.py", "Paged|chunked") for n in [
+        "kv_cache_update_paged", "paged_kv_gather"]},
     # rotary embedding — tests/test_nlp_models.py (Llama family)
     "rope": EW("test_nlp_models.py", "Llama|rope"),
     "rope_dyn": EW("test_nlp_models.py", "Llama|rope"),

@@ -312,7 +312,9 @@ class TestMetricsAndTrace:
         names = [e["name"] for e in trace["traceEvents"]]
         for r in (r0, r1):
             assert f"serving::request[{r.request_id}]" in names
-            assert f"serving::prefill[{r.request_id}]" in names
+            # chunked prefill: one span per chunk, tagged @start+len
+            assert any(n.startswith(f"serving::prefill[{r.request_id}@")
+                       for n in names)
         assert names.count("serving::decode_step") >= 3
         # request spans cover their prefill + decode steps
         req_ev = next(e for e in trace["traceEvents"]
@@ -329,6 +331,238 @@ class TestMetricsAndTrace:
         assert s["count"] == 5 and s["mean"] == 3.0
         assert s["min"] == 1.0 and s["max"] == 5.0
         assert s["p50"] == 3.0 and s["p99"] == 5.0
+
+
+class TestPagedPoolAndChunkedPrefill:
+    """Tentpole invariants of the paged KV pool: bit-identity through
+    chunked prefill, page-table indirection and page reuse; ≥2x
+    resident requests under a dense-equivalent HBM budget; and a
+    bounded compiled-program count (no retrace across membership or
+    page-table changes, O(log) prefill buckets)."""
+
+    def test_chunked_prefill_interleaves_and_matches_solo(self):
+        """A prompt longer than chunk_len prefills across several steps
+        while a resident neighbor keeps decoding — one token per step,
+        never stalled — and both stay bit-identical to solo decode."""
+        model = tiny_gpt()
+        pa = np.array([3, 14, 15, 9], np.int64)
+        pb = np.arange(1, 21, dtype=np.int64) % 90      # plen 20 > chunk
+        want_a = oracle_greedy(model, pa, 12)
+        want_b = oracle_greedy(model, pb, 8)
+        eng = ServingEngine(model, num_slots=2, max_len=64,
+                            page_size=8, chunk_len=8)
+        ra = eng.add_request(pa, SamplingParams(max_new_tokens=12))
+        eng.step()
+        eng.step()
+        rb = eng.add_request(pb, SamplingParams(max_new_tokens=8))
+        # plen 20 / chunk 8 -> 3 chunks, ONE per step; ra must emit a
+        # token on every one of those steps (prefill never stalls it)
+        prefill_steps = 0
+        while rb.state is not RequestState.DECODE:
+            before = len(ra.output_tokens)
+            eng.step()
+            prefill_steps += 1
+            assert len(ra.output_tokens) == before + 1
+        assert prefill_steps == 3
+        while eng.has_work:
+            eng.step()
+        np.testing.assert_array_equal(np.asarray(ra.output_tokens),
+                                      want_a)
+        np.testing.assert_array_equal(np.asarray(rb.output_tokens),
+                                      want_b)
+
+    def test_page_reuse_after_eviction_stays_bit_identical(self):
+        """Waves of requests through a pool too small to hold them all
+        at once: later waves decode on pages freed by earlier ones and
+        still match solo CompiledGenerator decode exactly."""
+        model = tiny_gpt()
+        prompts = [np.array([3, 14, 15, 9], np.int64),
+                   np.array([26, 5, 35], np.int64),
+                   np.array([1, 2, 3, 4, 5, 6], np.int64),
+                   np.array([42, 17], np.int64)]
+        want = [oracle_greedy(model, p, 10) for p in prompts]
+        # 4 allocatable pages; each request needs 2 -> two waves
+        eng = ServingEngine(model, num_slots=2, max_len=32,
+                            page_size=8, num_pages=5, chunk_len=8)
+        reqs = [eng.add_request(p, SamplingParams(max_new_tokens=10))
+                for p in prompts]
+        eng.run()
+        for r, w in zip(reqs, want):
+            np.testing.assert_array_equal(np.asarray(r.output_tokens), w)
+        assert eng.pool.free_pages == 4          # everything returned
+
+    def test_2x_residency_under_dense_equivalent_hbm_budget(self):
+        """Acceptance: with page_size=16 and the SAME simulated HBM
+        budget as a 2-slot dense engine (2 x 96 = 192 KV rows), short
+        requests (prompt+output <= 48 tokens) sustain >= 2x the
+        concurrent residents (dense: 2)."""
+        model = tiny_gpt()
+        dense_slots, max_len = 2, 96
+        budget_rows = dense_slots * max_len              # 192
+        page_size = 16
+        num_pages = budget_rows // page_size + 1         # 12 + trash
+        eng = ServingEngine(model, num_slots=8, max_len=max_len,
+                            page_size=page_size, num_pages=num_pages,
+                            chunk_len=16)
+        assert (eng.num_pages - 1) * page_size <= budget_rows
+        want = None
+        reqs = []
+        for i in range(8):
+            p = np.array([3 + i, 14, 15, 9], np.int64)   # 4 + 28 <= 48
+            reqs.append(eng.add_request(
+                p, SamplingParams(max_new_tokens=28)))
+            if i == 0:
+                want = oracle_greedy(model, p, 28)
+        peak = 0
+        while eng.has_work:
+            eng.step()
+            peak = max(peak, len(eng.scheduler.running))
+        assert peak >= 2 * dense_slots, peak
+        # and the pool never lied about its budget
+        assert eng.metrics.pool_pages_total == num_pages - 1
+        np.testing.assert_array_equal(
+            np.asarray(reqs[0].output_tokens), want)
+
+    def test_single_compiled_program_per_shape_no_retrace(self):
+        """The decode step stays ONE compiled program and each chunk
+        bucket ONE prefill program across admissions, evictions,
+        cancellations and page reuse; total prefill traces stay within
+        the O(log chunk_len) bucket bound."""
+        import math
+        model = tiny_gpt()
+        eng = ServingEngine(model, num_slots=3, max_len=64,
+                            page_size=8, chunk_len=16)
+        rng = np.random.RandomState(0)
+        reqs = []
+        for plen in [1, 2, 3, 5, 7, 9, 12, 15, 17, 20, 23, 30]:
+            reqs.append(eng.add_request(
+                rng.randint(0, 97, size=plen).astype(np.int64),
+                SamplingParams(max_new_tokens=4)))
+        eng.step()
+        eng.cancel(reqs[2].request_id)      # eviction mid-run
+        eng.run()
+        assert all(r.finished for r in reqs)
+        assert eng._decode_fn._cache_size() == 1
+        # buckets: {8, 16} = {min_chunk * 2**i <= chunk_len}
+        bound = int(math.log2(eng.chunk_len)) + 1
+        assert len(eng._prefill_fns) <= bound, eng._prefill_fns.keys()
+        assert set(eng._prefill_fns) == {8, 16}
+        assert all(fn._cache_size() == 1
+                   for fn in eng._prefill_fns.values())
+
+
+class TestSchedulerEdgeCases:
+    """Timeout-while-QUEUED, cancel racing admission, and max_queue
+    backpressure interacting with page-aware admission."""
+
+    def test_timeout_fires_while_queued_behind_full_slots(self):
+        model = tiny_gpt()
+        t = [0.0]
+        eng = ServingEngine(model, num_slots=1, max_len=32,
+                            clock=lambda: t[0])
+        run = eng.add_request(np.array([1, 2], np.int64),
+                              SamplingParams(max_new_tokens=20))
+        qd = eng.add_request(np.array([3, 4], np.int64),
+                             SamplingParams(max_new_tokens=4,
+                                            timeout_s=2.0))
+        eng.step()
+        assert qd.state is RequestState.QUEUED
+        t[0] = 3.0
+        eng.step()                  # deadline passed while QUEUED
+        assert qd.finish_reason == "timeout"
+        assert qd.output_tokens == [] and qd.pages is None
+        eng.run()
+        assert run.finish_reason == "length"
+
+    def test_cancel_races_admission_in_same_step(self):
+        """Cancelling a queued request in the same step that would have
+        admitted it: the slot (and its pages) go to the next in line."""
+        model = tiny_gpt()
+        eng = ServingEngine(model, num_slots=1, max_len=32,
+                            page_size=8)
+        a = eng.add_request(np.array([1, 2], np.int64),
+                            SamplingParams(max_new_tokens=3))
+        b = eng.add_request(np.array([3, 4], np.int64),
+                            SamplingParams(max_new_tokens=3))
+        assert eng.cancel(a.request_id)     # before any step ran
+        eng.step()
+        assert a.finish_reason == "cancelled" and a.output_tokens == []
+        assert b.slot is not None           # b won the freed admission
+        eng.run()
+        assert b.finish_reason == "length"
+        assert eng.pool.free_pages == eng.num_pages - 1
+
+    def test_page_backpressure_holds_queue_despite_free_slot(self):
+        """A free SLOT is not admission: the queue head waits until its
+        page budget is free, and max_queue sheds load measured at the
+        queue, independent of pool state."""
+        model = tiny_gpt()
+        # 2 allocatable pages; each request needs 2 (4 + 20 > 16)
+        eng = ServingEngine(model, num_slots=2, max_len=32,
+                            page_size=16, num_pages=3, max_queue=1)
+        a = eng.add_request(np.array([1, 2, 3, 4], np.int64),
+                            SamplingParams(max_new_tokens=20))
+        eng.step()                          # a takes the whole pool
+        b = eng.add_request(np.array([5, 6, 7, 8], np.int64),
+                            SamplingParams(max_new_tokens=4))
+        with pytest.raises(RuntimeError):   # queue full (max_queue=1)
+            eng.add_request(np.array([9], np.int64))
+        eng.step()
+        # slot 1 is free but the pool is exhausted: b must wait
+        assert a.state is RequestState.DECODE
+        assert b.state is RequestState.QUEUED
+        assert eng.pool.free_pages == 0
+        eng.step()
+        assert b.state is RequestState.QUEUED   # still held back
+        while a.state is not RequestState.FINISHED:
+            eng.step()
+        while eng.has_work:
+            eng.step()
+        assert b.finish_reason == "length"      # admitted after free
+        assert len(b.output_tokens) == 4
+
+    def test_generate_rejects_mismatched_sampling_list(self):
+        model = tiny_gpt()
+        eng = ServingEngine(model, num_slots=2, max_len=32)
+        prompts = [np.array([1, 2], np.int64),
+                   np.array([3, 4], np.int64)]
+        with pytest.raises(ValueError, match="sampling list length"):
+            eng.generate(prompts, [SamplingParams(max_new_tokens=2)])
+        with pytest.raises(ValueError, match="sampling list length"):
+            eng.generate(prompts, [SamplingParams(max_new_tokens=2)] * 3)
+        outs = eng.generate(prompts, [SamplingParams(max_new_tokens=2),
+                                      SamplingParams(max_new_tokens=3)])
+        assert [len(o.token_ids) for o in outs] == [2, 3]
+
+
+def test_serving_bench_smoke_writes_stable_schema(tmp_path,
+                                                  monkeypatch):
+    """`serving_bench.py --smoke` in-process: one JSON line + a
+    stable-schema BENCH_serving.json for the perf trajectory."""
+    import importlib.util
+    script = os.path.join(os.path.dirname(__file__), os.pardir,
+                          "scripts", "serving_bench.py")
+    spec = importlib.util.spec_from_file_location("serving_bench",
+                                                  script)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = str(tmp_path / "BENCH_serving.json")
+    monkeypatch.setattr(sys, "argv",
+                        ["serving_bench.py", "--smoke", "--requests",
+                         "3", "--out", out])
+    mod.main()
+    with open(out) as f:
+        report = json.load(f)
+    assert report["bench"] == "serving"
+    assert report["schema_version"] == 2
+    for key in ("tokens_per_sec", "ttft_p50_s", "ttft_p99_s",
+                "pool_utilization_mean", "pool_utilization_max",
+                "prefill_chunks", "page_size", "num_pages",
+                "chunk_len", "completed"):
+        assert key in report, key
+    assert report["completed"] == report["requests"] == 3
+    assert report["tokens_per_sec"] > 0
+    assert 0 < report["pool_utilization_max"] <= 1.0
 
 
 @pytest.mark.slow
