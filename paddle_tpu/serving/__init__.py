@@ -24,12 +24,15 @@ Greedy requests are bit-identical to offline CompiledGenerator decode
 reports TTFT/throughput/pool utilization into BENCH_serving.json.
 """
 from .engine import ServingEngine  # noqa: F401
-from .metrics import Histogram, ServingMetrics  # noqa: F401
+from .errors import EngineClosed, QueueFull, ServingError  # noqa: F401
+from .metrics import (Histogram, ServingMetrics,  # noqa: F401
+                      prometheus_render)
 from .paging import PagePool, chunk_bucket, pages_needed  # noqa: F401
 from .request import (Request, RequestOutput, RequestState,  # noqa: F401
                       SamplingParams)
 from .scheduler import Scheduler  # noqa: F401
 
 __all__ = ["ServingEngine", "Scheduler", "ServingMetrics", "Histogram",
-           "PagePool", "pages_needed", "chunk_bucket",
-           "Request", "RequestOutput", "RequestState", "SamplingParams"]
+           "prometheus_render", "PagePool", "pages_needed",
+           "chunk_bucket", "Request", "RequestOutput", "RequestState",
+           "SamplingParams", "ServingError", "QueueFull", "EngineClosed"]

@@ -58,6 +58,7 @@ from ..core.tensor import Tensor
 from ..profiler import RecordEvent
 from ..nlp.generation import (_pack_caches, _top_p_filter,
                               _unpack_caches, decode_model_step)
+from .errors import EngineClosed
 from .metrics import ServingMetrics
 from .paging import PagePool, TRASH_PAGE, chunk_bucket, pages_needed
 from .request import Request, RequestOutput, RequestState, SamplingParams
@@ -176,6 +177,9 @@ class ServingEngine:
         self._prefill_fns: Dict[int, object] = {}   # chunk bucket -> fn
         self._decode_fn = None
         self._spans: Dict[str, RecordEvent] = {}
+        # shutdown latch: flipped by drain()/abort_all(); add_request
+        # raises EngineClosed once set
+        self._closed = False
 
     # -- compiled programs -------------------------------------------------
     def _swap_state(self, state_vals):
@@ -258,6 +262,9 @@ class ServingEngine:
     def add_request(self, prompt_ids, sampling: Optional[SamplingParams]
                     = None, request_id: Optional[str] = None,
                     on_token=None) -> Request:
+        if self._closed:
+            raise EngineClosed(
+                "engine is draining/closed; no new requests admitted")
         sampling = sampling or SamplingParams()
         if isinstance(prompt_ids, Tensor):
             prompt_ids = prompt_ids.numpy()
@@ -494,6 +501,41 @@ class ServingEngine:
                              pages_used=self.pool.used_pages,
                              pages_total=self.num_pages - 1,
                              stall_chunks=chunks)
+        return finished
+
+    # -- shutdown ----------------------------------------------------------
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def drain(self) -> List[RequestOutput]:
+        """Graceful shutdown half 1: stop admitting (add_request raises
+        EngineClosed), abort still-QUEUED requests (reason "aborted" —
+        they never held pages), then pump steps until every resident
+        finishes normally. On return the scheduler is empty and every
+        page is back in the pool. Idempotent."""
+        self._closed = True
+        finished: List[RequestOutput] = []
+        now = self._clock()
+        for req in self.scheduler.pop_queued():
+            self._finish_and_free(req, "aborted", now, finished)
+        finished.extend(self.run())
+        return finished
+
+    def abort_all(self, reason: str = "aborted") -> List[RequestOutput]:
+        """Forced shutdown half 2: retire EVERY request — queued and
+        resident — right now with `reason`, freeing their pages, without
+        running another compiled step. Residents keep whatever tokens
+        they already emitted (the HTTP layer uses reason
+        "replica_failure" to decide which are safe to retry)."""
+        self._closed = True
+        finished: List[RequestOutput] = []
+        now = self._clock()
+        for req in self.scheduler.pop_queued():
+            self._finish_and_free(req, reason, now, finished)
+        for slot in sorted(list(self.scheduler.running)):
+            self._finish_and_free(self.scheduler.running[slot], reason,
+                                  now, finished)
         return finished
 
     # -- conveniences ------------------------------------------------------

@@ -1,0 +1,36 @@
+"""Typed load-shed / lifecycle errors of the serving stack.
+
+The HTTP front-end (serving/http/) maps these to status codes without
+string-matching exception text:
+
+- `QueueFull`      -> 429 Too Many Requests (+ Retry-After)
+- `EngineClosed`   -> 503 Service Unavailable (draining / shut down)
+
+Both subclass `ServingError(RuntimeError)`, so pre-existing callers
+that caught RuntimeError keep working.
+"""
+from __future__ import annotations
+
+__all__ = ["ServingError", "QueueFull", "EngineClosed"]
+
+
+class ServingError(RuntimeError):
+    """Base of all typed serving errors."""
+
+
+class QueueFull(ServingError):
+    """Admission queue at max_queue: shed load now, retry later.
+
+    `retry_after_s` is the engine's hint for the HTTP Retry-After
+    header (how long until queue drain plausibly frees a spot).
+    """
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class EngineClosed(ServingError):
+    """The engine began shutdown (drain() or abort_all()): no new
+    requests are admitted; residents run to completion (drain) or are
+    force-retired (abort)."""

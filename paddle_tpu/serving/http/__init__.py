@@ -1,0 +1,51 @@
+"""paddle_tpu.serving.http — streaming HTTP front-end for ServingEngine.
+
+Stdlib-only network surface over the continuous-batching engine:
+`EngineDriver` gives each engine replica its own pump thread,
+`Router` does least-loaded placement / failover / drain across N
+replicas, and `ServingHTTPServer` exposes OpenAI-style
+`POST /v1/completions` (JSON + SSE streaming) plus `/healthz`,
+`/readyz` and Prometheus `/metrics`:
+
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.http import serve
+
+    engines = [ServingEngine(model, num_slots=8, max_len=256)
+               for _ in range(2)]
+    server = serve(engines, port=8000)       # starts drivers + HTTP
+    ...
+    server.drain()                           # graceful shutdown
+
+    curl -N localhost:8000/v1/completions -d \
+      '{"prompt": [3, 14, 15], "max_tokens": 8, "stream": true}'
+"""
+from typing import Optional, Sequence
+
+from .driver import EngineDriver, ReplicaDead  # noqa: F401
+from .protocol import (CompletionRequest, ProtocolError,  # noqa: F401
+                       parse_completion_request)
+from .router import Router, Ticket  # noqa: F401
+from .server import ServingHTTPServer  # noqa: F401
+
+__all__ = ["EngineDriver", "ReplicaDead", "Router", "Ticket",
+           "ServingHTTPServer", "ProtocolError", "CompletionRequest",
+           "parse_completion_request", "serve"]
+
+
+def serve(engines: Sequence, host: str = "127.0.0.1", port: int = 0,
+          *, model_name: str = "paddle-tpu",
+          default_timeout_s: Optional[float] = None,
+          max_retries: int = 3,
+          poll_interval_s: float = 0.05) -> ServingHTTPServer:
+    """One-call assembly: wrap each engine in a driver, front them with
+    a router, start the HTTP server on (host, port) — port 0 picks a
+    free one (see `server.url`). Returns the STARTED server; call
+    `drain()` (or `install_signal_handlers()` for SIGTERM) to stop."""
+    drivers = [EngineDriver(e, name=f"replica-{i}")
+               for i, e in enumerate(engines)]
+    router = Router(drivers, max_retries=max_retries,
+                    default_timeout_s=default_timeout_s)
+    server = ServingHTTPServer(router, host, port,
+                               model_name=model_name,
+                               poll_interval_s=poll_interval_s)
+    return server.start()

@@ -1,0 +1,227 @@
+"""EngineDriver: ONE thread owns one ServingEngine.
+
+The engine's compiled decode step is single-threaded by construction —
+all membership changes happen between compiled steps. The driver keeps
+that invariant under concurrent clients: every mutation (add_request,
+cancel, drain) funnels through a thread-safe inbox that the driver
+thread services BETWEEN steps, so the fixed-shape decode step keeps
+stepping while any number of HTTP threads submit and stream. Tokens fan
+back out through each Request's own stream queue (`Request.next_event`)
+— the driver never blocks on a slow reader.
+
+Failure semantics: if the pump thread dies (device error, injected
+fault), the driver marks itself dead, fails pending submissions with
+`ReplicaDead`, and force-retires every resident/queued request with
+finish reason "replica_failure" (freeing its pages). The router treats
+"replica_failure" with zero emitted tokens as retryable — those
+requests never started, so re-running them elsewhere is safe.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional
+
+from ..errors import EngineClosed, ServingError
+from ..request import Request, SamplingParams
+
+__all__ = ["EngineDriver", "ReplicaDead"]
+
+
+class ReplicaDead(ServingError):
+    """The replica's driver thread is gone; resubmit elsewhere."""
+
+
+class _Submission:
+    __slots__ = ("prompt_ids", "sampling", "request_id", "done",
+                 "request", "error")
+
+    def __init__(self, prompt_ids, sampling, request_id):
+        self.prompt_ids = prompt_ids
+        self.sampling = sampling
+        self.request_id = request_id
+        self.done = threading.Event()
+        self.request: Optional[Request] = None
+        self.error: Optional[BaseException] = None
+
+
+class EngineDriver:
+    """Pump thread + thread-safe intake for one ServingEngine replica."""
+
+    def __init__(self, engine, name: str = "replica-0", *,
+                 poll_interval_s: float = 0.002,
+                 submit_timeout_s: float = 30.0):
+        self.engine = engine
+        self.name = name
+        self.poll_interval_s = float(poll_interval_s)
+        self.submit_timeout_s = float(submit_timeout_s)
+        self._inbox: "queue.Queue" = queue.Queue()
+        self._wake = threading.Event()
+        self._stopped = threading.Event()
+        self._started = False
+        self._draining = False
+        self._dead = False
+        self.death_exc: Optional[BaseException] = None
+        self._fault: Optional[BaseException] = None
+        self.last_beat: Optional[float] = None
+        self._thread = threading.Thread(target=self._pump,
+                                        name=f"engine-driver[{name}]",
+                                        daemon=True)
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "EngineDriver":
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    @property
+    def dead(self) -> bool:
+        return self._dead
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def healthy(self) -> bool:
+        """Liveness probe: accepting work and the pump thread exists."""
+        return (self._started and not self._dead and not self._draining
+                and self._thread.is_alive())
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: stop admitting (pending submissions fail
+        with EngineClosed), let the engine finish its residents, then
+        join the pump thread. Returns True once the thread exited."""
+        if not self._started:
+            self._draining = True
+            return True
+        self._draining = True
+        self._wake.set()
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    def kill(self, exc: Optional[BaseException] = None):
+        """Fault injection (tests / chaos): the pump thread raises at
+        its next step boundary and takes the replica-death path."""
+        self._fault = exc or RuntimeError(f"{self.name}: injected fault")
+        self._wake.set()
+
+    # -- client-thread API -------------------------------------------------
+    def submit(self, prompt_ids, sampling: Optional[SamplingParams] = None,
+               request_id: Optional[str] = None) -> Request:
+        """Thread-safe add_request: enqueue for the driver thread and
+        wait for the engine's verdict. Raises QueueFull / EngineClosed /
+        ValueError exactly as engine.add_request would, or ReplicaDead
+        if the pump thread is gone."""
+        if self._dead:
+            raise ReplicaDead(f"{self.name} is dead") \
+                from self.death_exc
+        if self._draining or not self._started:
+            raise EngineClosed(f"{self.name} is not accepting requests")
+        sub = _Submission(prompt_ids, sampling, request_id)
+        self._inbox.put(("submit", sub))
+        self._wake.set()
+        deadline = time.monotonic() + self.submit_timeout_s
+        while not sub.done.wait(timeout=0.05):
+            if self._dead:
+                # one last grace period for _fail_pending to resolve it
+                if not sub.done.wait(timeout=0.1):
+                    raise ReplicaDead(f"{self.name} died mid-submit") \
+                        from self.death_exc
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"{self.name}: submission not serviced within "
+                    f"{self.submit_timeout_s}s")
+        if sub.error is not None:
+            raise sub.error
+        return sub.request
+
+    def cancel(self, request_id: str):
+        """Thread-safe engine.cancel (fire-and-forget: the eviction
+        happens at the driver's next step boundary)."""
+        if self._dead:
+            return
+        self._inbox.put(("cancel", request_id))
+        self._wake.set()
+
+    def stats(self) -> dict:
+        """Racy-but-consistent-enough load snapshot for placement (every
+        field is a single atomic read)."""
+        eng = self.engine
+        queued = eng.scheduler.queue_depth
+        residents = len(eng.scheduler.running)
+        return {
+            "name": self.name,
+            "healthy": self.healthy,
+            "dead": self._dead,
+            "draining": self._draining,
+            "queue_depth": queued,
+            "residents": residents,
+            "free_pages": eng.pool.free_pages,
+            "inflight": queued + residents + self._inbox.qsize(),
+        }
+
+    # -- pump thread -------------------------------------------------------
+    def _pump(self):
+        try:
+            while True:
+                if self._fault is not None:
+                    raise self._fault
+                if self._draining:
+                    self._fail_pending(EngineClosed(
+                        f"{self.name} draining"))
+                    self.engine.drain()
+                    return
+                self._service_inbox()
+                if self.engine.has_work:
+                    self.engine.step()
+                else:
+                    self._wake.wait(self.poll_interval_s)
+                    self._wake.clear()
+                self.last_beat = time.monotonic()
+        except BaseException as exc:   # replica death path
+            self._die(exc)
+        finally:
+            self._stopped.set()
+
+    def _service_inbox(self):
+        while True:
+            try:
+                kind, payload = self._inbox.get_nowait()
+            except queue.Empty:
+                return
+            if kind == "submit":
+                try:
+                    payload.request = self.engine.add_request(
+                        payload.prompt_ids, payload.sampling,
+                        request_id=payload.request_id)
+                except BaseException as e:
+                    payload.error = e
+                finally:
+                    payload.done.set()
+            elif kind == "cancel":
+                self.engine.cancel(payload)
+
+    def _fail_pending(self, exc: BaseException):
+        while True:
+            try:
+                kind, payload = self._inbox.get_nowait()
+            except queue.Empty:
+                return
+            if kind == "submit":
+                payload.error = exc
+                payload.done.set()
+
+    def _die(self, exc: BaseException):
+        self.death_exc = exc
+        self._dead = True
+        self._fail_pending(ReplicaDead(f"{self.name} died: {exc!r}"))
+        try:
+            # free every page and wake every waiting reader; requests
+            # with zero tokens are retried by the router
+            self.engine.abort_all("replica_failure")
+        except BaseException:
+            pass

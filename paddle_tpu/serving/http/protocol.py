@@ -1,0 +1,185 @@
+"""Wire protocol of the serving front-end (OpenAI-completions shaped).
+
+The stack has no server-side tokenizer, so `prompt` is a list of token
+ids (the shape every test and bench in this repo already speaks).
+
+    POST /v1/completions
+    {"prompt": [3, 14, 15, 9], "max_tokens": 8, "stream": true,
+     "temperature": 0.8, "top_k": 5, "top_p": 0.9,
+     "eos_token_id": 50256, "timeout": 30.0}
+
+Non-stream responses mirror the OpenAI completion object with
+`token_ids` in the choice; streaming responses are SSE (`data:` JSON
+frames, one per token, a final frame carrying `finish_reason` +
+`usage`, then `data: [DONE]`).
+
+Typed serving errors map to status codes here — never by
+string-matching exception text:
+
+    QueueFull           -> 429 (+ Retry-After)
+    EngineClosed        -> 503
+    ReplicaDead         -> 502
+    timeout, 0 tokens   -> 503 (deadline passed while queued)
+"""
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..errors import EngineClosed, QueueFull
+from ..request import RequestOutput, SamplingParams
+from .driver import ReplicaDead
+
+__all__ = ["ProtocolError", "CompletionRequest",
+           "parse_completion_request", "completion_body",
+           "stream_chunk", "stream_final", "sse", "SSE_DONE",
+           "error_body", "status_for_error", "status_for_output"]
+
+SSE_DONE = b"data: [DONE]\n\n"
+
+
+class ProtocolError(Exception):
+    """Client-side request problem -> HTTP 4xx."""
+
+    def __init__(self, status: int, message: str,
+                 err_type: str = "invalid_request_error"):
+        super().__init__(message)
+        self.status = int(status)
+        self.err_type = err_type
+
+
+@dataclass
+class CompletionRequest:
+    prompt_ids: np.ndarray
+    sampling: SamplingParams
+    stream: bool
+
+
+def _get(payload: dict, key: str, types, default=None):
+    v = payload.get(key, default)
+    if v is not None and not isinstance(v, types):
+        raise ProtocolError(400, f"field {key!r} has wrong type "
+                            f"({type(v).__name__})")
+    return v
+
+
+def parse_completion_request(raw: bytes) -> CompletionRequest:
+    try:
+        payload = json.loads(raw.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ProtocolError(400, f"request body is not JSON: {e}")
+    if not isinstance(payload, dict):
+        raise ProtocolError(400, "request body must be a JSON object")
+    prompt = payload.get("prompt")
+    if isinstance(prompt, str):
+        raise ProtocolError(
+            400, "string prompts are not supported: this endpoint "
+            "serves token ids; send \"prompt\": [int, ...]")
+    if (not isinstance(prompt, list) or not prompt
+            or not all(isinstance(t, int) for t in prompt)):
+        raise ProtocolError(400, "\"prompt\" must be a non-empty list "
+                            "of token ids")
+    max_tokens = _get(payload, "max_tokens", int, 16)
+    temperature = _get(payload, "temperature", (int, float), 1.0)
+    top_k = _get(payload, "top_k", int)
+    top_p = _get(payload, "top_p", (int, float))
+    eos = _get(payload, "eos_token_id", int)
+    timeout = _get(payload, "timeout", (int, float))
+    stream = bool(_get(payload, "stream", bool, False))
+    if timeout is not None and (timeout <= 0
+                                or not math.isfinite(timeout)):
+        raise ProtocolError(400, "\"timeout\" must be a positive "
+                            "finite number of seconds")
+    try:
+        sampling = SamplingParams(
+            max_new_tokens=max_tokens,
+            temperature=float(temperature),
+            top_k=top_k,
+            top_p=None if top_p is None else float(top_p),
+            greedy=bool(payload.get("greedy", True)),
+            eos_token_id=eos,
+            timeout_s=None if timeout is None else float(timeout))
+    except ValueError as e:
+        raise ProtocolError(400, str(e))
+    return CompletionRequest(
+        prompt_ids=np.asarray(prompt, dtype=np.int64),
+        sampling=sampling, stream=stream)
+
+
+# -- responses -------------------------------------------------------------
+def _usage(out: RequestOutput) -> dict:
+    return {"prompt_tokens": len(out.prompt_token_ids),
+            "completion_tokens": len(out.token_ids),
+            "total_tokens": len(out.prompt_token_ids)
+            + len(out.token_ids)}
+
+
+def completion_body(ticket_id: str, model: str,
+                    out: RequestOutput) -> dict:
+    return {
+        "id": ticket_id,
+        "object": "text_completion",
+        "model": model,
+        "choices": [{"index": 0, "token_ids": out.token_ids,
+                     "finish_reason": out.finish_reason}],
+        "usage": _usage(out),
+        "timing": {"ttft_s": out.ttft_s,
+                   "queue_wait_s": out.queue_wait_s,
+                   "e2e_s": out.e2e_s},
+    }
+
+
+def stream_chunk(ticket_id: str, model: str, token: int) -> dict:
+    return {"id": ticket_id, "object": "text_completion.chunk",
+            "model": model,
+            "choices": [{"index": 0, "token": int(token),
+                         "finish_reason": None}]}
+
+
+def stream_final(ticket_id: str, model: str,
+                 out: RequestOutput) -> dict:
+    return {"id": ticket_id, "object": "text_completion.chunk",
+            "model": model,
+            "choices": [{"index": 0, "token": None,
+                         "finish_reason": out.finish_reason}],
+            "usage": _usage(out)}
+
+
+def sse(data: dict) -> bytes:
+    return b"data: " + json.dumps(data).encode("utf-8") + b"\n\n"
+
+
+def error_body(status: int, message: str,
+               err_type: str = "server_error") -> dict:
+    return {"error": {"message": message, "type": err_type,
+                      "code": int(status)}}
+
+
+def status_for_error(exc: BaseException) -> int:
+    if isinstance(exc, ProtocolError):
+        return exc.status
+    if isinstance(exc, QueueFull):
+        return 429
+    if isinstance(exc, ReplicaDead):
+        return 502
+    if isinstance(exc, EngineClosed):
+        return 503
+    return 500
+
+
+def status_for_output(out: RequestOutput) -> int:
+    """Status of a completed non-stream request. A deadline that fired
+    while the request was still QUEUED (zero tokens) is load shedding
+    -> 503; a mid-decode timeout returns the partial output as 200 with
+    finish_reason "timeout"."""
+    if out.finish_reason in ("stop", "length"):
+        return 200
+    if out.finish_reason == "timeout":
+        return 503 if not out.token_ids else 200
+    if out.finish_reason == "replica_failure":
+        return 502
+    return 503          # "aborted" (drain), "cancelled", unknown

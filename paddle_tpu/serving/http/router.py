@@ -1,0 +1,240 @@
+"""Multi-replica router: placement, failover, deadlines, drain.
+
+Fronts N `EngineDriver` replicas with:
+
+- **Least-loaded placement**: replicas are ranked by
+  (queue depth, inflight, -free pages) — the emptiest queue wins, free
+  KV pages break ties, so a replica whose pool is fragmented by long
+  residents yields to one with headroom.
+- **Typed load shedding**: when every healthy replica's admission queue
+  is full, `submit` re-raises `QueueFull` (HTTP 429 + Retry-After);
+  when none is healthy (or the router is draining), `EngineClosed`
+  (HTTP 503).
+- **Retry of UNSTARTED requests**: a request that dies with reason
+  "replica_failure" and zero emitted tokens never started decoding —
+  the `Ticket` transparently resubmits it on a surviving replica with
+  capped exponential backoff + full jitter. Requests that already
+  streamed tokens are NOT retried (the client saw output; replaying
+  could diverge for sampled requests).
+- **Graceful drain**: `drain()` stops admission, drains every replica
+  in parallel (residents finish, queued are aborted), and joins the
+  driver threads. `/readyz` flips to 503 the moment drain begins.
+"""
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+from ..errors import EngineClosed, QueueFull, ServingError
+from ..request import Request, RequestOutput, SamplingParams
+from .driver import EngineDriver, ReplicaDead
+
+__all__ = ["Router", "Ticket"]
+
+_RETRYABLE_REASON = "replica_failure"
+
+
+class Ticket:
+    """One client request's journey through the router — possibly
+    spanning several engine-level Request attempts across replicas.
+    `events()` is the single consumption point: it forwards tokens,
+    surfaces idle beats (for disconnect probing), and performs the
+    unstarted-request failover transparently."""
+
+    def __init__(self, router: "Router", ticket_id: str, prompt_ids,
+                 sampling: Optional[SamplingParams]):
+        self.id = ticket_id
+        self._router = router
+        self._prompt_ids = prompt_ids
+        self._sampling = sampling
+        self.attempts = 1
+        self.error: Optional[ServingError] = None
+        # may raise QueueFull/EngineClosed straight to the HTTP layer
+        self.driver, self.request = router._place(prompt_ids, sampling,
+                                                  exclude=())
+        self._tried = [self.driver]
+
+    # -- consumption -------------------------------------------------------
+    def events(self, poll_s: float = 0.05):
+        """Yield ("token", id) / ("idle", None) / ("done", reason) /
+        ("error", exc). "idle" fires every `poll_s` with no token so the
+        caller can probe client liveness; after "done"/"error" the
+        generator returns."""
+        while True:
+            req = self.request
+            kind, val = req.next_event(timeout=poll_s)
+            if kind == "token":
+                yield ("token", val)
+            elif kind == "idle":
+                yield ("idle", None)
+            elif (val == _RETRYABLE_REASON and not req.output_tokens):
+                try:
+                    self._retry()
+                except ServingError as exc:
+                    self.error = exc
+                    yield ("error", exc)
+                    return
+            else:
+                yield ("done", val)
+                return
+
+    def result(self, poll_s: float = 0.05) -> RequestOutput:
+        """Blocking non-stream path: consume to completion. Raises the
+        terminal ServingError if every attempt failed."""
+        for kind, val in self.events(poll_s=poll_s):
+            if kind == "error":
+                raise val
+            if kind == "done":
+                break
+        return self.request.output()
+
+    def cancel(self):
+        """Client went away: evict the live attempt and reclaim its
+        slot/pages at the replica's next step boundary."""
+        self.driver.cancel(self.request.request_id)
+
+    # -- failover ----------------------------------------------------------
+    def _retry(self):
+        """Resubmit an unstarted request on another replica, capped
+        exponential backoff + full jitter between attempts."""
+        r = self._router
+        last: Optional[ServingError] = None
+        for attempt in range(r.max_retries):
+            delay = min(r.backoff_cap_s,
+                        r.backoff_base_s * (2 ** attempt))
+            time.sleep(delay * r._jitter())
+            try:
+                self.driver, self.request = r._place(
+                    self._prompt_ids, self._sampling,
+                    exclude=self._tried)
+            except (QueueFull, EngineClosed) as exc:
+                last = exc
+                continue
+            self._tried.append(self.driver)
+            self.attempts += 1
+            with r._lock:
+                r.retries_total += 1
+            return
+        raise last if last is not None else EngineClosed(
+            "failover retries exhausted")
+
+
+class Router:
+    def __init__(self, drivers: Sequence[EngineDriver], *,
+                 max_retries: int = 3, backoff_base_s: float = 0.05,
+                 backoff_cap_s: float = 2.0,
+                 default_timeout_s: Optional[float] = None,
+                 jitter=None):
+        if not drivers:
+            raise ValueError("router needs at least one driver")
+        names = [d.name for d in drivers]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate driver names: {names}")
+        self.drivers: List[EngineDriver] = list(drivers)
+        self.max_retries = int(max_retries)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.default_timeout_s = default_timeout_s
+        # full jitter in (0, 1]: decorrelates thundering-herd retries
+        self._jitter = jitter or (lambda: random.random() or 1.0)
+        self._lock = threading.Lock()
+        self._draining = False
+        self._ids = itertools.count()
+        self.retries_total = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "Router":
+        for d in self.drivers:
+            d.start()
+        return self
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def healthy(self) -> bool:
+        """Liveness: at least one replica pump thread is serving."""
+        return any(d.healthy for d in self.drivers)
+
+    @property
+    def ready(self) -> bool:
+        """Readiness: healthy AND still admitting (not draining)."""
+        return not self._draining and self.healthy
+
+    def drain(self, timeout: Optional[float] = None):
+        """Stop admitting, finish every resident on every replica,
+        join the driver threads. Safe to call more than once."""
+        self._draining = True
+        threads = [threading.Thread(target=d.drain, args=(timeout,),
+                                    daemon=True)
+                   for d in self.drivers]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout)
+
+    # -- submission --------------------------------------------------------
+    def submit(self, prompt_ids, sampling: Optional[SamplingParams] = None,
+               ticket_id: Optional[str] = None) -> Ticket:
+        """Place a request on the least-loaded healthy replica. Raises
+        QueueFull (429) when every healthy replica sheds, EngineClosed
+        (503) when draining or no replica is healthy."""
+        if self._draining:
+            raise EngineClosed("router is draining")
+        if sampling is not None and sampling.timeout_s is None \
+                and self.default_timeout_s is not None:
+            sampling.timeout_s = self.default_timeout_s
+        if ticket_id is None:
+            ticket_id = f"cmpl-{next(self._ids)}"
+        return Ticket(self, ticket_id, prompt_ids, sampling)
+
+    def _place(self, prompt_ids, sampling,
+               exclude: Sequence[EngineDriver]
+               ) -> Tuple[EngineDriver, Request]:
+        if self._draining:
+            raise EngineClosed("router is draining")
+        cands = [d for d in self.drivers
+                 if d.healthy and d not in exclude]
+        if not cands:
+            # every survivor already tried: allow re-tries on them
+            # rather than failing a retryable request outright
+            cands = [d for d in self.drivers if d.healthy]
+        if not cands:
+            raise EngineClosed("no healthy replica")
+        cands.sort(key=self._load_key)
+        last: Optional[ServingError] = None
+        for d in cands:
+            try:
+                return d, d.submit(prompt_ids, sampling)
+            except QueueFull as exc:
+                last = exc
+            except (ReplicaDead, EngineClosed) as exc:
+                # raced into death/drain between the health check and
+                # the submit; try the next candidate
+                last = exc
+        if isinstance(last, QueueFull):
+            raise last
+        raise EngineClosed("no replica accepted the request") from last
+
+    @staticmethod
+    def _load_key(d: EngineDriver):
+        s = d.stats()
+        return (s["queue_depth"], s["inflight"], -s["free_pages"])
+
+    # -- observability -----------------------------------------------------
+    def stats(self) -> dict:
+        return {
+            "ready": self.ready,
+            "draining": self._draining,
+            "replicas": [d.stats() for d in self.drivers],
+            "retries_total": self.retries_total,
+        }
+
+    def metrics_snapshots(self) -> dict:
+        """{replica name: engine metrics snapshot} for /metrics."""
+        return {d.name: d.engine.metrics.snapshot()
+                for d in self.drivers}

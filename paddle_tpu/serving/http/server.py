@@ -1,0 +1,273 @@
+"""Streaming HTTP front-end over the replica router. Stdlib only.
+
+`ServingHTTPServer` is a `ThreadingHTTPServer`: one handler thread per
+connection blocks on its Ticket's token queue while every engine's ONE
+fixed-shape decode step keeps stepping in its driver thread — N
+streaming clients cost N cheap waiting threads, not N engine loops.
+
+Endpoints:
+- `POST /v1/completions` — JSON in, JSON out; `"stream": true` switches
+  to SSE token streaming (one `data:` frame per token, final frame with
+  finish_reason + usage, then `data: [DONE]`).
+- `GET /healthz` — liveness: 200 while >= 1 replica pump thread serves.
+- `GET /readyz`  — readiness: 503 the moment drain begins (so a load
+  balancer stops routing here before residents finish).
+- `GET /metrics` — Prometheus text exposition, one labelled series set
+  per replica (`serving.metrics.prometheus_render`).
+
+Backpressure and failure map to status codes via typed errors
+(serving/errors.py): full queue -> 429 + Retry-After, draining/closed
+-> 503, replica death mid-request -> 502 (unstarted requests are
+retried on surviving replicas before any error surfaces).
+
+Client disconnects: every SSE write is followed by a liveness probe of
+the connection; a dropped reader cancels the request at the engine's
+next step boundary, returning its slot and KV pages to the pool.
+
+Graceful drain (`drain()` / SIGTERM via `install_signal_handlers`):
+stop admitting (new completions get 503), flip `/readyz`, finish every
+resident on every replica, join the driver threads, close the socket.
+"""
+from __future__ import annotations
+
+import json
+import math
+import select
+import signal
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..errors import EngineClosed, QueueFull, ServingError
+from ..metrics import prometheus_render
+from .protocol import (ProtocolError, completion_body, error_body,
+                       parse_completion_request, sse, SSE_DONE,
+                       status_for_error, status_for_output,
+                       stream_chunk, stream_final)
+from .router import Router
+
+__all__ = ["ServingHTTPServer"]
+
+
+class ServingHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, router: Router, host: str = "127.0.0.1",
+                 port: int = 0, *, model_name: str = "paddle-tpu",
+                 poll_interval_s: float = 0.05):
+        self.router = router
+        self.model_name = model_name
+        self.poll_interval_s = float(poll_interval_s)
+        self._accepting = True
+        self._serve_thread: Optional[threading.Thread] = None
+        super().__init__((host, port), _Handler)
+
+    def handle_error(self, request, client_address):
+        """Clients dropping connections mid-request is a normal event
+        for a streaming server — don't spray tracebacks for it."""
+        import sys
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError,
+                            TimeoutError)):
+            return
+        super().handle_error(request, client_address)
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def url(self) -> str:
+        host, port = self.server_address[:2]
+        return f"http://{host}:{port}"
+
+    @property
+    def accepting(self) -> bool:
+        return self._accepting and self.router.ready
+
+    def start(self) -> "ServingHTTPServer":
+        """Start the replica drivers and serve in a daemon thread."""
+        self.router.start()
+        self._serve_thread = threading.Thread(
+            target=self.serve_forever, name="serving-http",
+            daemon=True)
+        self._serve_thread.start()
+        return self
+
+    def drain(self, timeout: Optional[float] = None):
+        """Graceful shutdown: stop admitting (-> 503, /readyz flips),
+        finish every resident request, join the driver threads, then
+        stop the HTTP loop and close the listening socket. In-flight
+        streams run to completion before this returns."""
+        self._accepting = False
+        self.router.drain(timeout)
+        self.shutdown()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout)
+        self.server_close()
+
+    def install_signal_handlers(self, signals=(signal.SIGTERM,
+                                               signal.SIGINT)):
+        """SIGTERM/SIGINT -> graceful drain (call from the main
+        thread). The drain runs in a helper thread so the handler
+        returns immediately."""
+        def _on_signal(signum, frame):
+            threading.Thread(target=self.drain, daemon=True).start()
+        for s in signals:
+            signal.signal(s, _on_signal)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "paddle-tpu-serving"
+
+    def log_message(self, format, *args):   # noqa: A002 - stdlib name
+        pass                                # keep test/bench output clean
+
+    # -- plumbing ----------------------------------------------------------
+    def _send_json(self, status: int, obj: dict, headers=()):
+        body = json.dumps(obj).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_error_json(self, status: int, message: str,
+                         err_type: str = "server_error", headers=()):
+        self._send_json(status, error_body(status, message, err_type),
+                        headers=headers)
+
+    def _client_disconnected(self) -> bool:
+        """True once the peer closed its end: readable socket whose
+        recv(MSG_PEEK) returns b'' (EOF). Never consumes request data."""
+        try:
+            r, _, _ = select.select([self.connection], [], [], 0)
+            if not r:
+                return False
+            return self.connection.recv(1, socket.MSG_PEEK) == b""
+        except (OSError, ValueError):
+            return True
+
+    # -- routes ------------------------------------------------------------
+    def do_GET(self):
+        if self.path == "/healthz":
+            ok = self.server.router.healthy
+            self._send_json(200 if ok else 503,
+                            {"status": "ok" if ok else "unhealthy"})
+        elif self.path == "/readyz":
+            ok = self.server.accepting
+            self._send_json(200 if ok else 503,
+                            {"status": "ready" if ok else "draining"})
+        elif self.path == "/metrics":
+            router = self.server.router
+            stats = router.stats()
+            extra = {
+                "ready": int(self.server.accepting),
+                "replicas_healthy": sum(
+                    1 for r in stats["replicas"] if r["healthy"]),
+                "replicas_total": len(stats["replicas"]),
+                "router_retries_total": stats["retries_total"],
+            }
+            text = prometheus_render(router.metrics_snapshots(),
+                                     extra_gauges=extra)
+            body = text.encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._send_error_json(404, f"no route {self.path!r}",
+                                  "not_found")
+
+    def do_POST(self):
+        if self.path != "/v1/completions":
+            self._send_error_json(404, f"no route {self.path!r}",
+                                  "not_found")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            creq = parse_completion_request(self.rfile.read(length))
+        except ProtocolError as e:
+            self._send_error_json(e.status, str(e), e.err_type)
+            return
+        if not self.server.accepting:
+            self._send_error_json(503, "server is draining",
+                                  "service_unavailable")
+            return
+        try:
+            ticket = self.server.router.submit(creq.prompt_ids,
+                                               creq.sampling)
+        except QueueFull as e:
+            retry_after = max(1, math.ceil(e.retry_after_s))
+            self._send_error_json(
+                429, str(e), "rate_limit_exceeded",
+                headers=[("Retry-After", str(retry_after))])
+            return
+        except ServingError as e:
+            self._send_error_json(status_for_error(e), str(e))
+            return
+        if creq.stream:
+            self._respond_stream(ticket)
+        else:
+            self._respond_blocking(ticket)
+
+    # -- completion paths --------------------------------------------------
+    def _respond_blocking(self, ticket):
+        poll = self.server.poll_interval_s
+        for kind, val in ticket.events(poll_s=poll):
+            if kind in ("idle", "token"):
+                if self._client_disconnected():
+                    ticket.cancel()     # frees the slot + pages
+                    return
+            elif kind == "error":
+                self._send_error_json(status_for_error(val), str(val))
+                return
+            elif kind == "done":
+                break
+        out = ticket.request.output()
+        self._send_json(status_for_output(out),
+                        completion_body(ticket.id,
+                                        self.server.model_name, out))
+
+    def _respond_stream(self, ticket):
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        poll = self.server.poll_interval_s
+        model = self.server.model_name
+        try:
+            for kind, val in ticket.events(poll_s=poll):
+                if kind == "token":
+                    # probe BEFORE and not only on idle beats: a fast
+                    # decode keeps the token queue non-empty, so idle
+                    # may never fire and writes into a closed socket
+                    # can succeed silently (OS send buffer)
+                    if self._client_disconnected():
+                        ticket.cancel()
+                        return
+                    self.wfile.write(sse(stream_chunk(ticket.id, model,
+                                                      val)))
+                    self.wfile.flush()
+                elif kind == "idle":
+                    if self._client_disconnected():
+                        ticket.cancel()
+                        return
+                elif kind == "error":
+                    self.wfile.write(sse(error_body(
+                        status_for_error(val), str(val))))
+                    self.wfile.write(SSE_DONE)
+                    return
+                elif kind == "done":
+                    out = ticket.request.output()
+                    self.wfile.write(sse(stream_final(ticket.id, model,
+                                                      out)))
+                    self.wfile.write(SSE_DONE)
+                    return
+        except (BrokenPipeError, ConnectionResetError):
+            ticket.cancel()             # reader dropped mid-stream

@@ -1,32 +1,58 @@
 """Serving telemetry: counters + histograms + profiler spans.
 
-Two consumers, one source of truth:
+Three consumers, one source of truth:
 - `ServingMetrics.snapshot()` — a plain dict for dashboards/benches
   (queue depth, TTFT, inter-token latency, tokens/s, slot occupancy).
+- `prometheus_render(...)` — the same snapshot as Prometheus text
+  exposition for the HTTP server's `/metrics` endpoint, including
+  fixed-bucket `_bucket` series for TTFT and inter-token latency.
 - `profiler.RecordEvent` spans emitted by the engine around prefill,
   each decode step, and each request's whole residency — so a Chrome
   trace from a serving run (profiler.Profiler + export) shows the
   serving timeline next to the op/XLA spans.
+
+All recording hooks and `snapshot()` hold one lock, so a scrape thread
+(`/metrics`) never tears a read against the engine's driver thread —
+counts, sums and bucket vectors in one snapshot are mutually
+consistent.
 """
 from __future__ import annotations
 
+import bisect
 import math
+import threading
 from collections import deque
-from typing import Optional
+from typing import Optional, Sequence
 
-__all__ = ["Histogram", "ServingMetrics"]
+__all__ = ["Histogram", "ServingMetrics", "prometheus_render",
+           "TTFT_BUCKETS", "LATENCY_BUCKETS"]
+
+# fixed Prometheus-style bucket upper bounds (seconds). Fixed — not
+# adaptive — so series stay comparable across scrapes and restarts.
+TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                5.0, 10.0, 30.0, 60.0)
+LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5)
 
 
 class Histogram:
     """Bounded-reservoir histogram: running count/sum/min/max over all
-    observations, percentiles over the most recent `maxlen`."""
+    observations, percentiles over the most recent `maxlen`. With
+    `buckets` (sorted upper bounds) it also keeps exact fixed-bucket
+    counts over ALL observations — the Prometheus histogram shape (the
+    implicit +Inf bucket is the last slot)."""
 
-    def __init__(self, maxlen: int = 8192):
+    def __init__(self, maxlen: int = 8192,
+                 buckets: Optional[Sequence[float]] = None):
         self.count = 0
         self.total = 0.0
         self.min: Optional[float] = None
         self.max: Optional[float] = None
         self._recent = deque(maxlen=maxlen)
+        self.bucket_bounds = (tuple(sorted(float(b) for b in buckets))
+                              if buckets else None)
+        self._bucket_counts = ([0] * (len(self.bucket_bounds) + 1)
+                               if self.bucket_bounds else None)
 
     def record(self, v: float):
         v = float(v)
@@ -35,6 +61,21 @@ class Histogram:
         self.min = v if self.min is None else min(self.min, v)
         self.max = v if self.max is None else max(self.max, v)
         self._recent.append(v)
+        if self.bucket_bounds is not None:
+            self._bucket_counts[bisect.bisect_left(self.bucket_bounds,
+                                                   v)] += 1
+
+    def cumulative_buckets(self):
+        """[(upper_bound, cumulative_count), ..., (inf, count)] — the
+        Prometheus `_bucket{le=...}` series; None without buckets."""
+        if self.bucket_bounds is None:
+            return None
+        out, acc = [], 0
+        for bound, n in zip(self.bucket_bounds, self._bucket_counts):
+            acc += n
+            out.append((bound, acc))
+        out.append((math.inf, self.count))
+        return out
 
     def percentile(self, q: float) -> Optional[float]:
         if not self._recent:
@@ -44,8 +85,9 @@ class Histogram:
         return xs[idx]
 
     def snapshot(self) -> dict:
-        return {
+        out = {
             "count": self.count,
+            "sum": self.total,
             "mean": (self.total / self.count) if self.count else None,
             "min": self.min,
             "max": self.max,
@@ -53,6 +95,11 @@ class Histogram:
             "p90": self.percentile(90),
             "p99": self.percentile(99),
         }
+        cum = self.cumulative_buckets()
+        if cum is not None:
+            out["buckets"] = [["+Inf" if math.isinf(b) else b, n]
+                              for b, n in cum]
+        return out
 
 
 class ServingMetrics:
@@ -61,12 +108,17 @@ class ServingMetrics:
     (first admission .. last emitted token)."""
 
     def __init__(self):
+        # one lock covers every recording hook AND snapshot(): the
+        # /metrics scrape thread must never tear a read against the
+        # engine's driver thread (e.g. bucket counts vs. sum)
+        self._lock = threading.RLock()
         # counters
         self.requests_received = 0
         self.requests_admitted = 0
         self.requests_completed = 0
         self.requests_cancelled = 0
         self.requests_timeout = 0
+        self.requests_aborted = 0
         self.tokens_generated = 0
         self.prompt_tokens = 0
         self.prefills = 0
@@ -84,9 +136,9 @@ class ServingMetrics:
         self.pool_pages_used = 0
         self.pool_pages_total = 0
         self.prefill_stall = 0
-        # histograms
-        self.ttft_s = Histogram()
-        self.inter_token_s = Histogram()
+        # histograms (TTFT/inter-token carry fixed Prometheus buckets)
+        self.ttft_s = Histogram(buckets=TTFT_BUCKETS)
+        self.inter_token_s = Histogram(buckets=LATENCY_BUCKETS)
         self.queue_wait_s = Histogram()
         self.e2e_s = Histogram()
         self.queue_depth_hist = Histogram()
@@ -99,53 +151,62 @@ class ServingMetrics:
 
     # -- recording hooks (called by the engine) ---------------------------
     def on_submit(self, req):
-        self.requests_received += 1
+        with self._lock:
+            self.requests_received += 1
 
     def on_admit(self, req, now: float):
-        self.requests_admitted += 1
-        self.prefills += 1
-        self.prompt_tokens += int(req.prompt_ids.size)
-        self.queue_wait_s.record(now - req.arrival_t)
-        if self._first_admit_t is None:
-            self._first_admit_t = now
+        with self._lock:
+            self.requests_admitted += 1
+            self.prefills += 1
+            self.prompt_tokens += int(req.prompt_ids.size)
+            self.queue_wait_s.record(now - req.arrival_t)
+            if self._first_admit_t is None:
+                self._first_admit_t = now
 
     def on_token(self, req, now: float):
-        self.tokens_generated += 1
-        self._last_token_t = now
-        if len(req.output_tokens) == 1:
-            self.ttft_s.record(now - req.arrival_t)
+        with self._lock:
+            self.tokens_generated += 1
+            self._last_token_t = now
+            if len(req.output_tokens) == 1:
+                self.ttft_s.record(now - req.arrival_t)
 
     def on_inter_token(self, dt: float):
-        self.inter_token_s.record(dt)
+        with self._lock:
+            self.inter_token_s.record(dt)
 
     def on_finish(self, req, now: float):
-        if req.finish_reason == "cancelled":
-            self.requests_cancelled += 1
-        elif req.finish_reason == "timeout":
-            self.requests_timeout += 1
-        else:
-            self.requests_completed += 1
-        self.e2e_s.record(now - req.arrival_t)
+        with self._lock:
+            if req.finish_reason == "cancelled":
+                self.requests_cancelled += 1
+            elif req.finish_reason == "timeout":
+                self.requests_timeout += 1
+            elif req.finish_reason in ("stop", "length"):
+                self.requests_completed += 1
+            else:                 # "aborted", "replica_failure", ...
+                self.requests_aborted += 1
+            self.e2e_s.record(now - req.arrival_t)
 
     def on_prefill_chunk(self, n_tokens: int):
-        self.prefill_chunks += 1
-        self.prefill_chunk_tokens += int(n_tokens)
+        with self._lock:
+            self.prefill_chunks += 1
+            self.prefill_chunk_tokens += int(n_tokens)
 
     def on_step(self, queue_depth: int, occupancy: float, num_slots: int,
                 pages_used: int = 0, pages_total: int = 0,
                 stall_chunks: int = 0):
-        self.decode_steps += 1
-        self.queue_depth = queue_depth
-        self.slot_occupancy = occupancy
-        self.num_slots = num_slots
-        self.queue_depth_hist.record(queue_depth)
-        self.occupancy_hist.record(occupancy)
-        self.pool_pages_used = pages_used
-        self.pool_pages_total = pages_total
-        self.prefill_stall = stall_chunks
-        if pages_total:
-            self.pool_utilization_hist.record(pages_used / pages_total)
-        self.prefill_stall_hist.record(stall_chunks)
+        with self._lock:
+            self.decode_steps += 1
+            self.queue_depth = queue_depth
+            self.slot_occupancy = occupancy
+            self.num_slots = num_slots
+            self.queue_depth_hist.record(queue_depth)
+            self.occupancy_hist.record(occupancy)
+            self.pool_pages_used = pages_used
+            self.pool_pages_total = pages_total
+            self.prefill_stall = stall_chunks
+            if pages_total:
+                self.pool_utilization_hist.record(pages_used / pages_total)
+            self.prefill_stall_hist.record(stall_chunks)
 
     # -- reading ----------------------------------------------------------
     @property
@@ -157,6 +218,10 @@ class ServingMetrics:
                                         - self._first_admit_t)
 
     def snapshot(self) -> dict:
+        with self._lock:
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self) -> dict:
         return {
             "requests": {
                 "received": self.requests_received,
@@ -164,6 +229,7 @@ class ServingMetrics:
                 "completed": self.requests_completed,
                 "cancelled": self.requests_cancelled,
                 "timeout": self.requests_timeout,
+                "aborted": self.requests_aborted,
             },
             "tokens_generated": self.tokens_generated,
             "prompt_tokens": self.prompt_tokens,
@@ -189,3 +255,68 @@ class ServingMetrics:
             "queue_depth_hist": self.queue_depth_hist.snapshot(),
             "occupancy_hist": self.occupancy_hist.snapshot(),
         }
+
+
+# -- Prometheus text exposition -------------------------------------------
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _hist_lines(name: str, snap: dict, labels: dict, lines: list):
+    for le, n in snap.get("buckets", []):
+        le_s = le if isinstance(le, str) else repr(float(le))
+        lines.append(f"{name}_bucket"
+                     + _fmt_labels({**labels, "le": le_s}) + f" {n}")
+    lines.append(f"{name}_sum" + _fmt_labels(labels)
+                 + f" {snap.get('sum', 0.0)}")
+    lines.append(f"{name}_count" + _fmt_labels(labels)
+                 + f" {snap['count']}")
+
+
+def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
+                      extra_gauges: Optional[dict] = None) -> str:
+    """Render `{replica_label: ServingMetrics.snapshot()}` as Prometheus
+    text exposition (one labelled series set per replica). The HTTP
+    server's `/metrics` endpoint is this function verbatim;
+    `extra_gauges` adds unlabelled router-level gauges
+    (`{name: value}`)."""
+    lines = []
+    for name, kind in [("requests_total", "counter"),
+                       ("tokens_generated_total", "counter"),
+                       ("queue_depth", "gauge"),
+                       ("slot_occupancy", "gauge"),
+                       ("pool_pages_free", "gauge"),
+                       ("pool_pages_total", "gauge"),
+                       ("ttft_seconds", "histogram"),
+                       ("inter_token_seconds", "histogram")]:
+        lines.append(f"# TYPE {namespace}_{name} {kind}")
+    for replica, snap in sorted(snapshots.items()):
+        lab = {"replica": str(replica)}
+        for outcome in ("completed", "cancelled", "timeout", "aborted"):
+            lines.append(
+                f"{namespace}_requests_total"
+                + _fmt_labels({**lab, "outcome": outcome})
+                + f" {snap['requests'][outcome]}")
+        lines.append(f"{namespace}_tokens_generated_total"
+                     + _fmt_labels(lab) + f" {snap['tokens_generated']}")
+        lines.append(f"{namespace}_queue_depth" + _fmt_labels(lab)
+                     + f" {snap['queue_depth']}")
+        lines.append(f"{namespace}_slot_occupancy" + _fmt_labels(lab)
+                     + f" {snap['slot_occupancy']}")
+        pool = snap["pool"]
+        free = pool["pages_total"] - pool["pages_used"]
+        lines.append(f"{namespace}_pool_pages_free" + _fmt_labels(lab)
+                     + f" {free}")
+        lines.append(f"{namespace}_pool_pages_total" + _fmt_labels(lab)
+                     + f" {pool['pages_total']}")
+        _hist_lines(f"{namespace}_ttft_seconds", snap["ttft_s"], lab,
+                    lines)
+        _hist_lines(f"{namespace}_inter_token_seconds",
+                    snap["inter_token_s"], lab, lines)
+    for name, value in sorted((extra_gauges or {}).items()):
+        lines.append(f"# TYPE {namespace}_{name} gauge")
+        lines.append(f"{namespace}_{name} {value}")
+    return "\n".join(lines) + "\n"

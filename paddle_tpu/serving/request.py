@@ -139,6 +139,19 @@ class Request:
                 return
             yield tok
 
+    def next_event(self, timeout: Optional[float] = None):
+        """Poll-able stream read for front-ends that must interleave
+        token delivery with liveness checks (SSE writers probing for
+        client disconnect): returns ("token", id), ("finish", reason),
+        or ("idle", None) when `timeout` elapses with nothing queued."""
+        try:
+            tok = self._stream_q.get(timeout=timeout)
+        except queue.Empty:
+            return ("idle", None)
+        if tok is _FINISH_SENTINEL:
+            return ("finish", self.finish_reason)
+        return ("token", tok)
+
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._done.wait(timeout)
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
+from .errors import QueueFull
 from .request import Request, RequestState
 
 __all__ = ["Scheduler"]
@@ -37,7 +38,7 @@ class Scheduler:
     # -- queue side -------------------------------------------------------
     def submit(self, req: Request):
         if self.max_queue is not None and len(self._queue) >= self.max_queue:
-            raise RuntimeError(
+            raise QueueFull(
                 f"admission queue full ({self.max_queue}); shed load or "
                 "raise max_queue")
         self._queue.append(req)
@@ -48,6 +49,13 @@ class Scheduler:
             return True
         except ValueError:
             return False
+
+    def pop_queued(self) -> List[Request]:
+        """Remove and return every queued (not yet admitted) request —
+        the drain/abort path: the engine decides their finish reason."""
+        out = list(self._queue)
+        self._queue.clear()
+        return out
 
     @property
     def queue_depth(self) -> int:

@@ -15,6 +15,11 @@ Usage:
     python scripts/serving_bench.py            # platform-sized run
     python scripts/serving_bench.py --smoke    # seconds-fast CI run
     python scripts/serving_bench.py --requests 64 --rate 50 --slots 8
+    python scripts/serving_bench.py --http --replicas 2   # + loopback
+        # HTTP trace through serving/http (mixed SSE / non-stream
+        # clients): client-observed TTFT p50/p99 and tokens/s land
+        # under the report's "http" key, alongside the in-process
+        # numbers
 """
 from __future__ import annotations
 
@@ -73,6 +78,11 @@ def main():
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--smoke", action="store_true",
                     help="tiny fast run (CI)")
+    ap.add_argument("--http", action="store_true",
+                    help="also drive the serving/http front-end over "
+                    "loopback with the same Poisson trace")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="router replicas for --http")
     ap.add_argument("--out", default="BENCH_serving.json",
                     help="report path ('-' = print only)")
     args = ap.parse_args()
@@ -171,6 +181,14 @@ def main():
         "decode_steps": snap["decode_steps"],
         "completed": snap["requests"]["completed"],
     }
+    if args.http:
+        report["http"] = http_trace(
+            model, cfg, n_req=n_req, rate=rate, max_new=max_new,
+            max_len=max_len, chunk=chunk, prompt_lens=prompt_lens,
+            slots=args.slots, page_size=args.page_size,
+            pages=args.pages, replicas=args.replicas,
+            seed=args.seed + 1)
+
     print(json.dumps(report))
     if args.out != "-":
         with open(args.out, "w") as f:
@@ -178,6 +196,137 @@ def main():
             f.write("\n")
     assert snap["requests"]["completed"] == n_req, \
         (snap["requests"], n_req)
+    if args.http:
+        assert report["http"]["completed"] == n_req, report["http"]
+
+
+def http_trace(model, cfg, *, n_req, rate, max_new, max_len, chunk,
+               prompt_lens, slots, page_size, pages, replicas, seed):
+    """Same Poisson trace, but through the serving/http front-end over
+    loopback: N replicas behind the least-loaded router, half the
+    clients SSE-streaming (client-observed TTFT = first token frame),
+    half blocking JSON (server-reported TTFT). Returns the `http`
+    section of the report."""
+    import http.client
+    import threading
+
+    from paddle_tpu.serving import Histogram, ServingEngine
+    from paddle_tpu.serving.http import serve
+
+    engines = [ServingEngine(model, num_slots=slots, max_len=max_len,
+                             page_size=page_size, num_pages=pages,
+                             chunk_len=chunk)
+               for _ in range(replicas)]
+    server = serve(engines, poll_interval_s=0.01)
+    host, port = server.server_address[:2]
+
+    def post(body):
+        conn = http.client.HTTPConnection(host, port, timeout=300)
+        conn.request("POST", "/v1/completions", json.dumps(body),
+                     {"Content-Type": "application/json"})
+        return conn, conn.getresponse()
+
+    # warm every compiled program ON EVERY replica through the HTTP
+    # path (concurrent requests per prompt length spread across the
+    # router), then drop warmup from the metrics
+    def warm(pl):
+        conn, resp = post({"prompt": list(range(1, pl + 1)),
+                           "max_tokens": 2})
+        resp.read()
+        conn.close()
+
+    for pl in sorted(set(prompt_lens)):
+        ws = [threading.Thread(target=warm, args=(pl,))
+              for _ in range(replicas)]
+        for w in ws:
+            w.start()
+        for w in ws:
+            w.join()
+    for eng in engines:
+        eng.metrics.__init__()
+
+    rng = np.random.RandomState(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           size=rng.choice(prompt_lens)).tolist()
+               for _ in range(n_req)]
+    budgets = rng.randint(max(1, max_new // 2), max_new + 1,
+                          size=n_req)
+
+    lock = threading.Lock()
+    ttft = Histogram()
+    done = {"completed": 0, "tokens": 0, "errors": 0}
+
+    def record(ttft_s, n_tokens, ok):
+        with lock:
+            if ttft_s is not None:
+                ttft.record(ttft_s)
+            done["tokens"] += n_tokens
+            done["completed" if ok else "errors"] += 1
+
+    def stream_client(i):
+        sent = time.monotonic()
+        conn, resp = post({"prompt": prompts[i], "stream": True,
+                           "max_tokens": int(budgets[i])})
+        first, n, fin = None, 0, None
+        while True:
+            line = resp.readline()
+            if not line or line.strip() == b"data: [DONE]":
+                break
+            if not line.startswith(b"data: "):
+                continue
+            choice = json.loads(line[6:])["choices"][0]
+            if choice["token"] is not None:
+                n += 1
+                if first is None:
+                    first = time.monotonic() - sent
+            if choice["finish_reason"]:
+                fin = choice["finish_reason"]
+        conn.close()
+        record(first, n, fin in ("stop", "length"))
+
+    def blocking_client(i):
+        conn, resp = post({"prompt": prompts[i],
+                           "max_tokens": int(budgets[i])})
+        body = json.loads(resp.read())
+        conn.close()
+        if resp.status != 200:
+            record(None, 0, False)
+            return
+        choice = body["choices"][0]
+        record(body["timing"]["ttft_s"], len(choice["token_ids"]),
+               choice["finish_reason"] in ("stop", "length"))
+
+    t0 = time.monotonic()
+    threads = []
+    for i in range(n_req):
+        wait = arrivals[i] - (time.monotonic() - t0)
+        if wait > 0:
+            time.sleep(wait)
+        fn = stream_client if i % 2 == 0 else blocking_client
+        threads.append(threading.Thread(target=fn, args=(i,)))
+        threads[-1].start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    server.drain()
+
+    snaps = [e.metrics.snapshot() for e in engines]
+    return {
+        "replicas": replicas,
+        "requests": n_req,
+        "stream_fraction": 0.5,
+        "wall_s": round(wall, 4),
+        "completed": done["completed"],
+        "errors": done["errors"],
+        "tokens_received": done["tokens"],
+        "tokens_per_sec": (done["tokens"] / wall) if wall > 0 else None,
+        "ttft_p50_s": ttft.percentile(50),
+        "ttft_p99_s": ttft.percentile(99),
+        "engine_decode_steps": sum(s["decode_steps"] for s in snaps),
+        "engine_tokens_generated": sum(s["tokens_generated"]
+                                       for s in snaps),
+    }
 
 
 if __name__ == "__main__":

@@ -18,8 +18,9 @@ import paddle_tpu as paddle
 from paddle_tpu import profiler
 from paddle_tpu.nlp import (GPTConfig, GPTForCausalLM, LlamaConfig,
                             LlamaForCausalLM)
-from paddle_tpu.serving import (Request, RequestState, SamplingParams,
-                                Scheduler, ServingEngine, ServingMetrics)
+from paddle_tpu.serving import (EngineClosed, QueueFull, Request,
+                                RequestState, SamplingParams, Scheduler,
+                                ServingEngine, ServingMetrics)
 
 
 _MODELS = {}   # engines/oracles never mutate the model: share per module
@@ -76,11 +77,25 @@ class TestSchedulerPolicy:
         assert [r.request_id for _, r in refill] == ["r2"]  # arrival order
         assert refill[0][0] == grants[0][0]                 # freed slot
 
-    def test_max_queue_sheds_load(self):
+    def test_max_queue_sheds_load_with_typed_error(self):
+        """QueueFull (a RuntimeError subclass — old callers keep
+        working) lets the HTTP layer map load shedding to 429 without
+        string-matching."""
         s = Scheduler(num_slots=1, max_queue=1)
         s.submit(Request("a", np.array([1]), SamplingParams()))
-        with pytest.raises(RuntimeError):
+        with pytest.raises(QueueFull) as ei:
             s.submit(Request("b", np.array([1]), SamplingParams()))
+        assert isinstance(ei.value, RuntimeError)
+        assert ei.value.retry_after_s > 0
+
+    def test_pop_queued_empties_the_queue(self):
+        s = Scheduler(num_slots=1)
+        reqs = [Request(f"r{i}", np.array([1]), SamplingParams())
+                for i in range(3)]
+        for r in reqs:
+            s.submit(r)
+        assert s.pop_queued() == reqs
+        assert s.queue_depth == 0 and s.pop_queued() == []
 
     def test_expired_finds_deadline_overruns(self):
         s = Scheduler(num_slots=1)
@@ -533,6 +548,78 @@ class TestSchedulerEdgeCases:
         outs = eng.generate(prompts, [SamplingParams(max_new_tokens=2),
                                       SamplingParams(max_new_tokens=3)])
         assert [len(o.token_ids) for o in outs] == [2, 3]
+
+
+class TestDrainAndAbort:
+    """Graceful-shutdown primitives the HTTP layer builds on: drain()
+    finishes residents without admitting, abort_all() force-retires
+    everything; BOTH return every page to the pool."""
+
+    def test_drain_finishes_residents_aborts_queued_frees_pages(self):
+        model = tiny_gpt()
+        p = np.array([3, 14, 15, 9], np.int64)
+        want = oracle_greedy(model, p, 6)
+        eng = ServingEngine(model, num_slots=1, max_len=32, page_size=8)
+        resident = eng.add_request(p, SamplingParams(max_new_tokens=6))
+        queued = eng.add_request(np.array([26, 5, 35], np.int64),
+                                 SamplingParams(max_new_tokens=6))
+        eng.step()
+        eng.step()
+        assert resident.state is RequestState.DECODE
+        assert queued.state is RequestState.QUEUED
+        outs = eng.drain()
+        # resident ran to completion, untouched by the shutdown
+        assert resident.finish_reason == "length"
+        np.testing.assert_array_equal(
+            np.asarray(resident.output_tokens), want)
+        # queued never started: aborted, zero tokens, never held pages
+        assert queued.finish_reason == "aborted"
+        assert queued.output_tokens == [] and queued.pages is None
+        assert {o.request_id for o in outs} == {resident.request_id,
+                                               queued.request_id}
+        # all pages back, nothing resident, engine closed for intake
+        assert eng.pool.free_pages == eng.num_pages - 1
+        assert not eng.has_work and eng.closed
+        with pytest.raises(EngineClosed):
+            eng.add_request(p, SamplingParams(max_new_tokens=2))
+        assert eng.drain() == []          # idempotent
+
+    def test_abort_all_force_retires_everything_and_frees_pages(self):
+        model = tiny_gpt()
+        eng = ServingEngine(model, num_slots=2, max_len=32, page_size=8)
+        ra = eng.add_request(np.array([3, 14, 15, 9], np.int64),
+                             SamplingParams(max_new_tokens=10))
+        rb = eng.add_request(np.array([26, 5, 35], np.int64),
+                             SamplingParams(max_new_tokens=10))
+        rc = eng.add_request(np.array([1, 2], np.int64),
+                             SamplingParams(max_new_tokens=4))
+        eng.step()
+        eng.step()                        # ra/rb decoding, rc queued
+        assert eng.pool.used_pages > 0
+        outs = eng.abort_all("replica_failure")
+        assert len(outs) == 3
+        assert all(r.finish_reason == "replica_failure"
+                   for r in (ra, rb, rc))
+        assert len(ra.output_tokens) > 0      # keeps partial output
+        assert rc.output_tokens == []         # unstarted: retry-safe
+        assert eng.pool.free_pages == eng.num_pages - 1
+        assert not eng.has_work
+        assert eng.metrics.requests_aborted == 3
+        with pytest.raises(EngineClosed):
+            eng.add_request(np.array([1], np.int64))
+
+    def test_abort_all_wakes_stream_readers(self):
+        """A thread blocked on Request.stream() unblocks when the
+        request is force-retired (the HTTP layer depends on this)."""
+        model = tiny_gpt()
+        eng = ServingEngine(model, num_slots=1, max_len=64)
+        r = eng.add_request(np.array([3, 14, 15, 9], np.int64),
+                            SamplingParams(max_new_tokens=30))
+        eng.step()
+        eng.step()
+        eng.abort_all()
+        assert r.wait(timeout=1.0)
+        assert list(r.stream()) == r.output_tokens
 
 
 def test_serving_bench_smoke_writes_stable_schema(tmp_path,

@@ -1,0 +1,484 @@
+"""serving/http: streaming HTTP front-end + multi-replica router.
+
+E2E invariants (ISSUE acceptance):
+- concurrent HTTP clients (mixed SSE-stream / blocking JSON) against a
+  2-replica router get greedy outputs BIT-IDENTICAL to solo
+  CompiledGenerator decode;
+- killing one replica mid-load loses no unstarted request (retried on
+  the survivor with backoff);
+- graceful drain finishes residents, flips /readyz, exits with zero
+  resident requests and every page back in the pool;
+- a full admission queue returns 429 with Retry-After;
+- a client dropping its SSE stream cancels the request, frees its
+  slot/pages, and never stalls neighbors.
+"""
+import json
+import math
+import socket
+import threading
+import time
+
+import http.client
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+from paddle_tpu.serving import (Histogram, SamplingParams,
+                                ServingEngine, ServingMetrics,
+                                prometheus_render)
+from paddle_tpu.serving.http import (EngineDriver, ProtocolError,
+                                     Router, ServingHTTPServer,
+                                     parse_completion_request, serve)
+
+_MODELS = {}
+
+
+def tiny_gpt():
+    m = _MODELS.get("gpt")
+    if m is None:
+        paddle.seed(7)
+        cfg = GPTConfig(vocab_size=97, hidden_size=32,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        intermediate_size=64,
+                        max_position_embeddings=128,
+                        hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+        m = _MODELS["gpt"] = GPTForCausalLM(cfg)
+        m.eval()
+    return m
+
+
+def oracle_greedy(model, prompt, n_new):
+    out = model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                         max_new_tokens=n_new).numpy()
+    return out[0, len(prompt):].tolist()
+
+
+# -- tiny loopback clients -------------------------------------------------
+def post_json(addr, body, timeout=120.0):
+    """Blocking JSON completion. Returns (status, headers, body dict)."""
+    conn = http.client.HTTPConnection(*addr, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/completions", json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), \
+            json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def get(addr, path, timeout=30.0):
+    conn = http.client.HTTPConnection(*addr, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode()
+    finally:
+        conn.close()
+
+
+def read_sse(addr, body, timeout=120.0):
+    """Streaming completion: read SSE to [DONE]. Returns
+    (status, tokens, finish_reason)."""
+    conn = http.client.HTTPConnection(*addr, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/completions",
+                     json.dumps({**body, "stream": True}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        tokens, finish = [], None
+        while True:
+            line = resp.readline()
+            if not line or line.strip() == b"data: [DONE]":
+                break
+            if not line.startswith(b"data: "):
+                continue
+            frame = json.loads(line[6:])
+            if "error" in frame:
+                finish = frame["error"]["type"] or "error"
+                continue
+            choice = frame["choices"][0]
+            if choice["token"] is not None:
+                tokens.append(choice["token"])
+            if choice["finish_reason"]:
+                finish = choice["finish_reason"]
+        return resp.status, tokens, finish
+    finally:
+        conn.close()
+
+
+def wait_until(pred, timeout=30.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def make_server(n_replicas=2, poll_interval_s=0.02, **engine_kw):
+    model = tiny_gpt()
+    kw = dict(num_slots=2, max_len=64)
+    kw.update(engine_kw)
+    engines = [ServingEngine(model, **kw) for _ in range(n_replicas)]
+    server = serve(engines, poll_interval_s=poll_interval_s)
+    return server, engines, server.server_address[:2]
+
+
+# -- protocol unit tests (no engine) ---------------------------------------
+class TestProtocol:
+    def parse_err(self, raw):
+        with pytest.raises(ProtocolError) as ei:
+            parse_completion_request(raw if isinstance(raw, bytes)
+                                     else json.dumps(raw).encode())
+        return ei.value
+
+    def test_rejects_malformed_requests_with_400(self):
+        assert self.parse_err(b"{not json").status == 400
+        assert self.parse_err({"max_tokens": 4}).status == 400  # no prompt
+        assert self.parse_err({"prompt": []}).status == 400
+        assert self.parse_err({"prompt": "hello"}).status == 400  # text
+        assert self.parse_err({"prompt": [1.5]}).status == 400
+        assert self.parse_err({"prompt": [1], "max_tokens": 0}).status \
+            == 400                       # SamplingParams invariant
+        assert self.parse_err({"prompt": [1], "top_p": 1.5}).status == 400
+        assert self.parse_err({"prompt": [1], "timeout": -1}).status == 400
+        assert self.parse_err({"prompt": [1],
+                               "temperature": "hot"}).status == 400
+
+    def test_parses_sampling_knobs(self):
+        creq = parse_completion_request(json.dumps(
+            {"prompt": [3, 14], "max_tokens": 9, "stream": True,
+             "temperature": 0.8, "top_k": 5, "top_p": 0.9,
+             "eos_token_id": 42, "timeout": 30}).encode())
+        assert creq.prompt_ids.tolist() == [3, 14] and creq.stream
+        sp = creq.sampling
+        assert sp.max_new_tokens == 9 and sp.temperature == 0.8
+        assert sp.top_k == 5 and sp.top_p == 0.9 and not sp.greedy
+        assert sp.eos_token_id == 42 and sp.timeout_s == 30.0
+
+    def test_defaults_are_greedy(self):
+        creq = parse_completion_request(b'{"prompt": [1, 2]}')
+        assert creq.sampling.greedy and not creq.stream
+        assert creq.sampling.max_new_tokens == 16
+
+
+class TestMetricsRendering:
+    def test_histogram_fixed_buckets_cumulative(self):
+        h = Histogram(buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.record(v)
+        snap = h.snapshot()
+        assert snap["buckets"] == [[0.1, 1], [1.0, 3], [10.0, 4],
+                                   ["+Inf", 5]]
+        assert snap["sum"] == pytest.approx(56.05)
+
+    def test_prometheus_text_exposition(self):
+        m = ServingMetrics()
+
+        class R:
+            prompt_ids = np.array([1, 2, 3])
+            arrival_t = 0.5
+            output_tokens = [7]
+            finish_reason = "length"
+        m.on_submit(R)
+        m.on_admit(R, 0.51)
+        m.on_token(R, 0.53)          # TTFT 0.03s -> le="0.05" bucket
+        m.on_finish(R, 1.0)
+        m.on_step(2, 0.5, 2, pages_used=3, pages_total=8)
+        text = prometheus_render({"replica-0": m.snapshot()},
+                                 extra_gauges={"ready": 1})
+        assert 'paddle_serving_ttft_seconds_bucket{le="0.05",' \
+            'replica="replica-0"} 1' in text
+        assert 'paddle_serving_ttft_seconds_count{replica="replica-0"}'\
+            ' 1' in text
+        assert 'paddle_serving_requests_total{outcome="completed",' \
+            'replica="replica-0"} 1' in text
+        assert 'paddle_serving_pool_pages_free{replica="replica-0"} 5' \
+            in text
+        assert 'paddle_serving_queue_depth{replica="replica-0"} 2' \
+            in text
+        assert "paddle_serving_ready 1" in text
+        # scrape-safety: snapshot under the driver lock doesn't deadlock
+        with m._lock:
+            m.snapshot()
+
+
+# -- e2e over loopback -----------------------------------------------------
+class TestHTTPEndToEnd:
+    def test_mixed_clients_two_replicas_bit_identical(self):
+        """6 concurrent clients (3 SSE, 3 blocking) against 2 replicas:
+        every greedy output matches solo CompiledGenerator decode."""
+        model = tiny_gpt()
+        server, engines, addr = make_server(n_replicas=2)
+        try:
+            prompts = [[3 + i, 14, 15, 9] for i in range(4)] \
+                + [[26, 5, 35], [1, 2, 3, 4, 5, 6]]
+            want = [oracle_greedy(model, p, 8) for p in prompts]
+            results = [None] * len(prompts)
+
+            def client(i):
+                body = {"prompt": prompts[i], "max_tokens": 8}
+                if i % 2 == 0:
+                    st, toks, fin = read_sse(addr, body)
+                else:
+                    st, _, out = post_json(addr, body)
+                    toks = out["choices"][0]["token_ids"]
+                    fin = out["choices"][0]["finish_reason"]
+                results[i] = (st, toks, fin)
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(len(prompts))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+            for i, (st, toks, fin) in enumerate(results):
+                assert st == 200 and fin == "length", (i, results[i])
+                assert toks == want[i], i
+            # every request was served by exactly one replica
+            served = [e.metrics.requests_completed for e in engines]
+            assert sum(served) == len(prompts)
+        finally:
+            server.drain()
+        assert all(e.pool.free_pages == e.num_pages - 1
+                   for e in engines)
+
+    def test_full_queue_returns_429_with_retry_after(self):
+        server, engines, addr = make_server(
+            n_replicas=1, num_slots=1, max_len=128, max_queue=1)
+        driver = server.router.drivers[0]
+        try:
+            blocker = driver.submit(
+                np.array([3, 14, 15, 9], np.int64),
+                SamplingParams(max_new_tokens=100))
+            assert wait_until(
+                lambda: driver.stats()["residents"] == 1)
+            queued = driver.submit(
+                np.array([26, 5, 35], np.int64),
+                SamplingParams(max_new_tokens=4))   # fills max_queue
+            assert wait_until(
+                lambda: driver.stats()["queue_depth"] == 1)
+            st, headers, body = post_json(
+                addr, {"prompt": [1, 2], "max_tokens": 2})
+            assert st == 429
+            assert int(headers["Retry-After"]) >= 1
+            assert body["error"]["type"] == "rate_limit_exceeded"
+            assert blocker.finish_reason is None    # blocker unharmed
+        finally:
+            server.drain()
+        assert blocker.finish_reason == "length"    # drain finished it
+        assert queued.finished
+        assert engines[0].pool.free_pages == engines[0].num_pages - 1
+
+    def test_client_disconnect_mid_stream_cancels_and_frees(self):
+        """Dropping an SSE reader cancels the request at the next step
+        boundary, frees its slot/pages, and never stalls the
+        neighbor."""
+        model = tiny_gpt()
+        server, engines, addr = make_server(
+            n_replicas=1, num_slots=2, max_len=128, page_size=8)
+        eng = engines[0]
+        driver = server.router.drivers[0]
+        try:
+            pn = [26, 5, 35]
+            want_n = oracle_greedy(model, pn, 60)
+            neighbor = driver.submit(np.array(pn, np.int64),
+                                     SamplingParams(max_new_tokens=60))
+            # victim: raw socket so we control the disconnect
+            body = json.dumps({"prompt": [3, 14, 15, 9],
+                               "max_tokens": 120,
+                               "stream": True}).encode()
+            sock = socket.create_connection(addr, timeout=30)
+            sock.sendall(b"POST /v1/completions HTTP/1.1\r\n"
+                         b"Host: t\r\nContent-Type: application/json\r\n"
+                         + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                         + body)
+            reader = sock.makefile("rb")
+            seen = 0
+            while seen < 2:                 # genuinely mid-stream
+                line = reader.readline()
+                assert line, "stream ended before 2 tokens"
+                if line.startswith(b"data: ") and b'"token": ' in line:
+                    if json.loads(line[6:])["choices"][0]["token"] \
+                            is not None:
+                        seen += 1
+            victim = next(r for r in eng._requests.values()
+                          if r.sampling.max_new_tokens == 120)
+            # client walks away (shutdown sends FIN even though the
+            # makefile wrapper still holds a reference to the fd)
+            sock.shutdown(socket.SHUT_RDWR)
+            reader.close()
+            sock.close()
+            assert wait_until(lambda: victim.finished, timeout=30)
+            assert victim.finish_reason == "cancelled"
+            assert 2 <= len(victim.output_tokens) < 120
+            # its pages are back while the neighbor still runs
+            assert wait_until(
+                lambda: victim.slot is None and victim.pages is None)
+            # neighbor never perturbed: completes bit-identical
+            assert neighbor.wait(timeout=60)
+            assert neighbor.output_tokens == want_n
+        finally:
+            server.drain()
+        assert eng.pool.free_pages == eng.num_pages - 1
+        assert len(eng.scheduler.running) == 0
+
+    def test_replica_kill_retries_unstarted_on_survivor(self):
+        """Kill replica-0 with a resident stream + a queued (unstarted)
+        request: the stream ends with replica_failure (it already
+        emitted tokens — not replayed), the queued request is retried
+        on the survivor and completes bit-identically; liveness stays
+        green on the survivor."""
+        model = tiny_gpt()
+        server, engines, addr = make_server(
+            n_replicas=2, num_slots=1, max_len=128)
+        d0, d1 = server.router.drivers
+        try:
+            pv = [1, 2, 3, 4, 5]
+            want_v = oracle_greedy(model, pv, 8)
+            results = {}
+
+            def stream_a():   # lands replica-0 (both empty, stable sort)
+                results["a"] = read_sse(
+                    addr, {"prompt": [3, 14, 15, 9], "max_tokens": 120})
+
+            def block_b():    # lands replica-1 (replica-0 busy)
+                results["b"] = post_json(
+                    addr, {"prompt": [26, 5, 35], "max_tokens": 120})
+
+            def block_c():    # queues on replica-0 (equal load tie)
+                results["c"] = post_json(addr, {"prompt": pv,
+                                                "max_tokens": 8})
+
+            ta = threading.Thread(target=stream_a)
+            ta.start()
+            assert wait_until(lambda: d0.stats()["residents"] == 1)
+            tb = threading.Thread(target=block_b)
+            tb.start()
+            assert wait_until(lambda: d1.stats()["residents"] == 1)
+            tc = threading.Thread(target=block_c)
+            tc.start()
+            assert wait_until(lambda: d0.stats()["queue_depth"] == 1)
+            # the resident stream must have STARTED (emitted tokens)
+            # before the kill, so it is not retry-eligible
+            assert wait_until(lambda: any(
+                r.output_tokens for r in engines[0]._requests.values()))
+
+            d0.kill()                      # replica-0 dies mid-load
+            for t in (ta, tb, tc):
+                t.join(120)
+
+            st_a, toks_a, fin_a = results["a"]
+            assert st_a == 200 and fin_a == "replica_failure"
+            assert len(toks_a) > 0         # started: not replayed
+            st_b, _, out_b = results["b"]
+            assert st_b == 200
+            assert out_b["choices"][0]["finish_reason"] == "length"
+            assert len(out_b["choices"][0]["token_ids"]) == 120
+            # the unstarted request survived the kill: retried on the
+            # survivor, output bit-identical to solo decode
+            st_c, _, out_c = results["c"]
+            assert st_c == 200, out_c
+            assert out_c["choices"][0]["token_ids"] == want_v
+            assert server.router.retries_total >= 1
+            # dead replica freed its pages; probes reflect the state
+            assert engines[0].pool.free_pages == \
+                engines[0].num_pages - 1
+            assert not d0.healthy and d1.healthy
+            assert get(addr, "/healthz")[0] == 200
+            assert get(addr, "/readyz")[0] == 200
+        finally:
+            server.drain()
+
+    def test_graceful_drain_finishes_residents_and_flips_readyz(self):
+        """Drain under load: /readyz flips to 503 immediately, new
+        completions are rejected 503, the in-flight stream receives
+        every token, and the drained engine holds zero residents with
+        all pages free."""
+        model = tiny_gpt()
+        server, engines, addr = make_server(n_replicas=1, num_slots=2,
+                                            max_len=128)
+        want = oracle_greedy(model, [3, 14, 15, 9], 110)
+        result = {}
+
+        def client():
+            result["r"] = read_sse(addr, {"prompt": [3, 14, 15, 9],
+                                          "max_tokens": 110})
+
+        t = threading.Thread(target=client)
+        t.start()
+        assert wait_until(
+            lambda: server.router.drivers[0].stats()["residents"] == 1)
+        drainer = threading.Thread(target=server.drain)
+        drainer.start()
+        assert wait_until(lambda: not server.accepting, timeout=10)
+        st, body_txt = get(addr, "/readyz")
+        assert st == 503 and "draining" in body_txt
+        st, _, body = post_json(addr, {"prompt": [1, 2],
+                                       "max_tokens": 2})
+        assert st == 503
+        drainer.join(120)
+        t.join(120)
+        st, toks, fin = result["r"]
+        assert st == 200 and fin == "length"
+        assert toks == want              # resident finished, bit-exact
+        eng = engines[0]
+        assert len(eng.scheduler.running) == 0
+        assert eng.scheduler.queue_depth == 0
+        assert eng.pool.free_pages == eng.num_pages - 1
+
+    def test_metrics_endpoint_serves_prometheus_text(self):
+        server, engines, addr = make_server(n_replicas=2)
+        try:
+            st, _, _ = post_json(addr, {"prompt": [3, 14, 15, 9],
+                                        "max_tokens": 4})
+            assert st == 200
+            st, text = get(addr, "/metrics")
+            assert st == 200
+            assert 'paddle_serving_requests_total{outcome="completed"' \
+                in text
+            assert 'replica="replica-0"' in text \
+                and 'replica="replica-1"' in text
+            assert "paddle_serving_ttft_seconds_bucket" in text
+            assert "paddle_serving_pool_pages_free" in text
+            assert "paddle_serving_replicas_healthy 2" in text
+            assert "paddle_serving_router_retries_total 0" in text
+        finally:
+            server.drain()
+
+
+def test_serving_bench_http_smoke_appends_http_section(tmp_path,
+                                                       monkeypatch):
+    """`serving_bench.py --smoke --http` in-process: the stable-schema
+    report gains client-observed HTTP TTFT/throughput alongside the
+    in-process numbers."""
+    import importlib.util
+    import os
+    import sys
+    script = os.path.join(os.path.dirname(__file__), os.pardir,
+                          "scripts", "serving_bench.py")
+    spec = importlib.util.spec_from_file_location("serving_bench_http",
+                                                  script)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = str(tmp_path / "BENCH_serving.json")
+    monkeypatch.setattr(sys, "argv",
+                        ["serving_bench.py", "--smoke", "--http",
+                         "--requests", "4", "--replicas", "2",
+                         "--out", out])
+    mod.main()
+    with open(out) as f:
+        report = json.load(f)
+    assert report["schema_version"] == 2         # schema unchanged
+    assert report["completed"] == 4              # in-process section
+    http_sec = report["http"]
+    assert http_sec["replicas"] == 2
+    assert http_sec["completed"] == 4 and http_sec["errors"] == 0
+    assert http_sec["tokens_per_sec"] > 0
+    assert http_sec["ttft_p50_s"] > 0
+    assert http_sec["ttft_p99_s"] >= http_sec["ttft_p50_s"]
+    assert not math.isnan(http_sec["wall_s"])
