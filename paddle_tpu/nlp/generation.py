@@ -22,6 +22,8 @@ is a length-L write at pos 0, decode a length-1 write at pos L+i.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -30,9 +32,31 @@ from ..core.dispatch import register_op
 from ..core.tensor import Tensor
 from ..core import dtype as dtypes
 from ..ops._helpers import apply_op, as_tensor
+from ..ops.pallas.paged_attention import (gqa_attend_reference,
+                                          paged_decode_attention)
 
 __all__ = ["DecodeCache", "init_decode_caches", "update_and_attend",
-           "CompiledGenerator", "decode_model_step", "sample_logits"]
+           "CompiledGenerator", "decode_model_step", "sample_logits",
+           "resolve_paged_attn_impl", "PAGED_ATTN_IMPLS"]
+
+PAGED_ATTN_IMPLS = ("kernel", "gather")
+
+
+def resolve_paged_attn_impl(override=None):
+    """Which implementation the paged l==1 decode branch uses:
+    "kernel" (default) — the Pallas ragged paged-attention kernel that
+    walks the page table and streams only live pages (pure-JAX
+    reference off-TPU); "gather" — the original `paged_kv_gather` +
+    dense SDPA path, kept so bit-equivalence can always be
+    cross-checked. An explicit override wins; otherwise the
+    PADDLE_TPU_PAGED_ATTN env var (read at TRACE time — a compiled
+    serving step keeps the impl it was built with)."""
+    impl = override or os.environ.get("PADDLE_TPU_PAGED_ATTN", "kernel")
+    if impl not in PAGED_ATTN_IMPLS:
+        raise ValueError(
+            f"paged attention impl must be one of {PAGED_ATTN_IMPLS} "
+            f"(PADDLE_TPU_PAGED_ATTN / attn_impl), got {impl!r}")
+    return impl
 
 
 class DecodeCache:
@@ -55,15 +79,18 @@ class DecodeCache:
     """
 
     __slots__ = ("k", "v", "pos", "k_scale", "v_scale", "fresh",
-                 "page_table")
+                 "page_table", "attn_impl")
 
     def __init__(self, k, v, pos, k_scale=None, v_scale=None,
-                 fresh=False, page_table=None):
+                 fresh=False, page_table=None, attn_impl=None):
         self.k = k
         self.v = v
         self.pos = pos
         # paged mode: [B, max_pages] int32 page ids into the k/v pools
         self.page_table = page_table
+        # paged decode impl override ("kernel"/"gather"); None defers
+        # to PADDLE_TPU_PAGED_ATTN (see resolve_paged_attn_impl)
+        self.attn_impl = attn_impl
         # int8 cache mode: k/v hold int8 codes laid out
         # [B, H_kv, max_len, D]; *_scale are per-head [H_kv] f32
         # CONSTANTS from calibration (layout + constant scales are what
@@ -147,6 +174,21 @@ def _paged_gather_fwd(pool, page_table):
 
 
 register_op("paged_kv_gather", _paged_gather_fwd, nondiff=True)
+
+# Pallas ragged paged-attention decode: reads KV pages in place (walks
+# the page table, streams only pages below ceil((pos+1)/page_size)) —
+# no [B, max_pages * page_size, H, D] gather materialized. Off-TPU the
+# fwd runs the pure-JAX reference, so CPU tier-1 tests exercise the op.
+register_op("paged_decode_attention", paged_decode_attention,
+            nondiff=True)
+
+
+# Grouped-query decode attention: attends q [B, l, H, D] over the full
+# K/V buffers [B, lmax, H_kv, D] WITHOUT repeat_interleave — queries
+# group per kv head, so the H -> H_kv fold of the cache is never
+# copied, and the per-group unroll keeps the output bit-identical to
+# the old repeated path (see gqa_attend_reference).
+register_op("gqa_decode_attend", gqa_attend_reference, nondiff=True)
 
 
 def _kv_update_q8_fwd(buf, upd, pos, scale):
@@ -315,17 +357,42 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
         lmax = int(cache.page_table.shape[1]) * int(cache.k.shape[1])
     else:
         lmax = k_buf.shape[2] if quant else k_buf.shape[1]
-    mask = apply_op("window_causal_mask", cache.pos,
-                    attrs=dict(l=int(l), lmax=int(lmax)))
+    user_m = None
     if attn_mask is not None:
         m = as_tensor(attn_mask)
         if int(m.shape[-1]) != int(lmax):
+            if paged:
+                raise ValueError(
+                    f"decode attn_mask last dim {m.shape[-1]} does not "
+                    f"match the PAGED cache's logical view: page_table "
+                    f"width {cache.page_table.shape[1]} pages x "
+                    f"page_size {cache.k.shape[1]} = {lmax} slots. A "
+                    "mask sized for the dense max_len must be padded "
+                    "to the page-aligned width (padding positions are "
+                    "hidden by the positional window anyway)")
             raise ValueError(
                 f"decode attn_mask last dim {m.shape[-1]} must equal "
                 f"the cache max_len {lmax} (mask indexes cache slots)")
         while m.ndim < 4:
             m = manipulation.unsqueeze(m, axis=0)
-        mask = apply_op("decode_merge_mask", mask, m)
+        user_m = m
+    if paged and l == 1 and \
+            resolve_paged_attn_impl(cache.attn_impl) == "kernel":
+        # Pallas ragged paged-attention: walks page_table[b, :] and
+        # streams only live pages (flash-style online softmax across
+        # page blocks, GQA grouped in-kernel) — the dense logical view
+        # is never materialized and the user mask composes in-kernel
+        args = [q, k_buf, v_buf, cache.page_table, cache.pos]
+        if user_m is not None:
+            args.append(user_m)
+        out = apply_op("paged_decode_attention", *args)
+        return out, DecodeCache(k_buf, v_buf, cache.pos + l,
+                                page_table=cache.page_table,
+                                attn_impl=cache.attn_impl)
+    mask = apply_op("window_causal_mask", cache.pos,
+                    attrs=dict(l=int(l), lmax=int(lmax)))
+    if user_m is not None:
+        mask = apply_op("decode_merge_mask", mask, user_m)
     if quant and l == 1:
         # decode step over the int8 cache: the dequant (convert x
         # constant per-head scale) fuses into the attention reads
@@ -360,11 +427,18 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
         kf = apply_op("paged_kv_gather", k_buf, cache.page_table)
         vf = apply_op("paged_kv_gather", v_buf, cache.page_table)
         new_cache = DecodeCache(k_buf, v_buf, cache.pos + l,
-                                page_table=cache.page_table)
+                                page_table=cache.page_table,
+                                attn_impl=cache.attn_impl)
     else:
         kf, vf = k_buf, v_buf
         new_cache = DecodeCache(k_buf, v_buf, cache.pos + l)
     n_rep = q.shape[2] // kf.shape[2]
+    if n_rep > 1 and l == 1 and dropout_p == 0.0 and not training:
+        # decode-step GQA without materializing the cache H -> H_kv
+        # fold: queries grouped per kv head (bit-compatible with the
+        # repeat_interleave path — tests/test_paged_attention.py)
+        out = apply_op("gqa_decode_attend", q, kf, vf, mask)
+        return out, new_cache
     if n_rep > 1:
         kf = manipulation.repeat_interleave(kf, n_rep, axis=2)
         vf = manipulation.repeat_interleave(vf, n_rep, axis=2)
@@ -396,16 +470,17 @@ def _pack_caches(caches):
         for c in caches)
 
 
-def _unpack_caches(ct, pos, page_table=None):
+def _unpack_caches(ct, pos, page_table=None, attn_impl=None):
     """page_table (optional [B, max_pages] raw int32 array) switches
     every layer's cache into paged-pool mode; the table is shared
     across layers (one page id addresses the same page in each
-    layer's pool)."""
+    layer's pool). attn_impl pins the paged decode implementation
+    ("kernel"/"gather") for the trace being built."""
     pt = None if page_table is None else Tensor(page_table)
     return [DecodeCache(Tensor(k), Tensor(v), Tensor(pos),
                         None if ks is None else Tensor(ks),
                         None if vs is None else Tensor(vs),
-                        page_table=pt)
+                        page_table=pt, attn_impl=attn_impl)
             for k, v, ks, vs in ct]
 
 

@@ -6,4 +6,12 @@ the reference fuses per-arch with cuBLASLt/cuDNN epilogues, these tile
 directly onto MXU/VMEM. Kernels degrade gracefully: callers fall back to
 plain-XLA reference implementations off-TPU (tested against them on CPU
 via interpret mode).
+
+Kernels:
+- flash_attention.py — fused attention fwd/bwd (online softmax, bias /
+  key-padding masks, in-kernel dropout)
+- layer_norm.py — fused LayerNorm fwd/bwd
+- paged_attention.py — ragged paged-attention decode for the serving
+  engine's paged KV pool (scalar-prefetched page-table walk, streams
+  only live pages)
 """

@@ -1,0 +1,286 @@
+"""Ragged paged-attention decode kernel (Pallas, TPU).
+
+The serving engine's paged decode path used to materialize each row's
+logical KV view with `paged_kv_gather` — a transient
+[S, max_pages * page_size, H, D] HBM stream PER LAYER PER STEP that
+scales with the pool horizon, not with the tokens actually resident,
+and XLA cannot fuse a data-dependent gather into the attention reads
+("Operator Fusion in XLA", PAPERS.md). This kernel is the fix from
+"Ragged Paged Attention" (PAPERS.md): walk the page table and stream
+ONLY the pages a row actually occupies.
+
+Structure — grid (batch_row, kv_head, page):
+
+- `page_table` [B, max_pages] and `pos` [B] ride in as SCALAR-PREFETCH
+  operands (pltpu.PrefetchScalarGridSpec), so the K/V BlockSpec index
+  maps can chase the page table: grid step (b, g, p) DMAs pool page
+  `page_table[b, p]` for kv head g. Steps past the row's last live
+  page (`pos[b] // page_size`) clamp their index to that page — the
+  pipeline skips the re-fetch of an unchanged block, so HBM traffic is
+  O(pages actually used) per row, and compute there is predicated off.
+- Flash-style online softmax across page blocks: running (m, l, acc)
+  scratch in VMEM, exactly the flash_attention.py recurrence with
+  page_size-wide key blocks. The partial tail page is handled by
+  in-page masking (position > pos[b] -> -inf), which also covers
+  trash-page rows: a retired/free slot's page-table row points at the
+  reserved page 0 and every position past `pos` contributes -inf.
+- GQA without materialization: queries are grouped [B, H_kv, rep, D]
+  so kv head g serves its `rep = H // H_kv` query heads from ONE
+  streamed copy of K/V — no `repeat_interleave` of the cache.
+
+Off-TPU the op runs `paged_attention_reference` — the same math as the
+gather path (gather pages -> masked grouped softmax), kept around both
+as the CPU tier-1 path and as the oracle the kernel is tested against
+(tests/test_paged_attention.py runs the kernel in interpret mode).
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["paged_decode_attention", "paged_attention_reference",
+           "gqa_attend_reference"]
+
+# interpret mode: run the kernel on CPU for testing (tests set this)
+_INTERPRET = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "0") == "1"
+
+_NEG_INF = -1e30
+_LANES = 128
+
+
+def _prec(dt):
+    # bf16 x bf16 -> f32 on the MXU is exact at DEFAULT; 'highest' is
+    # invalid for bf16 operands under Mosaic (see flash_attention.py)
+    return (jax.lax.Precision.DEFAULT if jnp.dtype(dt) == jnp.bfloat16
+            else jax.lax.Precision.HIGHEST)
+
+
+def _use_kernel():
+    try:
+        plat = jax.devices()[0].platform
+    except Exception:
+        plat = "cpu"
+    return plat == "tpu" or _INTERPRET
+
+
+def _mask_to_additive(mask, b, h, lmax):
+    """User attn_mask (bool or additive float, broadcastable
+    [B|1, H|1, 1, lmax]) -> additive f32 [B, H, lmax]."""
+    if mask.dtype == jnp.bool_:
+        mask = jnp.where(mask, jnp.float32(0.0), jnp.float32(_NEG_INF))
+    mask = mask.astype(jnp.float32)
+    return jnp.broadcast_to(mask, (b, h, 1, lmax)).reshape(b, h, lmax)
+
+
+def _pa_kernel(tab_ref, pos_ref, q_ref, k_ref, v_ref, *rest, ps, rep,
+               scale, has_mask):
+    if has_mask:
+        mask_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        mask_ref = None
+        o_ref, m_ref, l_ref, acc_ref = rest
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    n_p = pl.num_programs(2)
+    pos_b = pos_ref[b]
+    prec = _prec(q_ref.dtype)
+    scale32 = jnp.float32(scale)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, jnp.float32(_NEG_INF))
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # a page contributes iff it holds at least one valid position
+    # (j <= pos); fully-dead pages are exactly zero under the online
+    # softmax, so skipping them is not an approximation
+    @pl.when(p * ps <= pos_b)
+    def _compute():
+        q = q_ref[0, 0]                     # [rep, D]
+        k = k_ref[0, :, 0, :]               # [ps, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec) * scale32       # [rep, ps]
+        # in-page validity: global position p*ps + local <= pos[b]
+        # (masks the partial tail page AND trash-page positions)
+        k_pos = p * ps + jax.lax.broadcasted_iota(
+            jnp.int32, (q_ref.shape[2], ps), 1)
+        s = jnp.where(k_pos <= pos_b, s, jnp.float32(_NEG_INF))
+        if has_mask:
+            s = s + mask_ref[0]             # additive f32 [rep, ps]
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        pexp = jnp.exp(s - m_new)
+        l_ref[:] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(pexp, axis=1, keepdims=True),
+            l_ref.shape)
+        v = v_ref[0, :, 0, :]               # [ps, D]
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            pexp.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(p == n_p - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], jnp.float32(1e-30))
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _paged_attention_kernel(q, k_pool, v_pool, page_table, pos, mask):
+    """q [B, 1, H, D]; pools [P, ps, H_kv, D]; page_table [B, max_pages]
+    int32; pos [B] int32; mask None | additive f32 [B, H, lmax]."""
+    b, l, h, d = q.shape
+    p_total, ps, hkv, _ = k_pool.shape
+    mp = page_table.shape[1]
+    rep = h // hkv
+    scale = 1.0 / math.sqrt(d)
+    q4 = q.reshape(b, hkv, rep, d)
+
+    def last_live(posr, bi):
+        # index of the row's last live page (pos -> ceil((pos+1)/ps)-1)
+        return jnp.minimum(posr[bi] // ps, mp - 1)
+
+    def kv_idx(bi, g, p, tab, posr):
+        # dead steps re-fetch the previous (clamped) page: the pipeline
+        # skips the DMA of an unchanged block index, so only live pages
+        # ever stream from HBM
+        return (tab[bi, jnp.minimum(p, last_live(posr, bi))], 0, g, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, rep, d), lambda bi, g, p, tab, posr:
+                     (bi, g, 0, 0)),
+        pl.BlockSpec((1, ps, 1, d), kv_idx),
+        pl.BlockSpec((1, ps, 1, d), kv_idx),
+    ]
+    ops = [q4, k_pool, v_pool]
+    if mask is not None:
+        ops.append(mask.reshape(b * hkv, rep, mp * ps))
+        in_specs.append(pl.BlockSpec(
+            (1, rep, ps),
+            lambda bi, g, p, tab, posr: (bi * hkv + g, 0, p)))
+
+    kernel = functools.partial(_pa_kernel, ps=ps, rep=rep, scale=scale,
+                               has_mask=mask is not None)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, mp),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, rep, d), lambda bi, g, p, tab,
+                               posr: (bi, g, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rep, _LANES), jnp.float32),
+            pltpu.VMEM((rep, _LANES), jnp.float32),
+            pltpu.VMEM((rep, d), jnp.float32),
+        ],
+    )
+    # Mosaic rejects i64 index arithmetic; trace in 32-bit mode
+    # (jax.experimental.disable_x64 — the bare jax.enable_x64 alias was
+    # removed in jax 0.4.37)
+    from jax.experimental import disable_x64
+    with disable_x64():
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, hkv, rep, d), q.dtype),
+            compiler_params=pltpu.TPUCompilerParams(
+                dimension_semantics=("parallel", "parallel",
+                                     "arbitrary")),
+            interpret=_INTERPRET,
+        )(page_table, pos, *ops)
+    return out.reshape(b, l, h, d)
+
+
+def gqa_attend_reference(q, k, v, mask):
+    """Grouped-query attention over un-repeated K/V buffers:
+    q [B, l, H, D] against k/v [B, lmax, H_kv, D], mask bool or
+    additive float broadcastable [B|1, 1|H, l, lmax].
+
+    Unrolled over the `rep = H / H_kv` group members so every dot has
+    EXACTLY the shape the old `repeat_interleave` + SDPA path gave XLA
+    — which makes the output bit-identical to that path (a fused
+    [rep*l, D] x [D, lmax] grouping reassociates the reduction and
+    drifts by an ulp) while never materializing the H-fold copy of the
+    cache. rep is a small static (1..8): the unroll is trace-time."""
+    b, l, h, d = q.shape
+    hkv = k.shape[2]
+    rep = h // hkv
+    scale = 1.0 / math.sqrt(d)
+    qg = q.reshape(b, l, hkv, rep, d)
+    is_bool = mask.dtype == jnp.bool_
+    outs = []
+    for r in range(rep):
+        # heads served in this unroll step: h = g*rep + r for every g
+        mh = mask if mask.shape[1] == 1 else mask[:, r::rep]
+        s = jnp.einsum("blgd,bmgd->bglm", qg[:, :, :, r], k) * scale
+        s = s.astype(jnp.float32)
+        if is_bool:
+            s = jnp.where(mh, s, jnp.float32(_NEG_INF))
+        else:
+            s = s + mh.astype(jnp.float32)
+        a = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+        outs.append(jnp.einsum("bglm,bmgd->blgd", a, v))
+    return jnp.stack(outs, axis=3).reshape(b, l, h, d)
+
+
+def paged_attention_reference(q, k_pool, v_pool, page_table, pos,
+                              mask=None):
+    """Pure-JAX reference: gather the rows' pages into the dense
+    logical view and run the masked grouped softmax — the same math as
+    `paged_kv_gather` + grouped SDPA, shaped for this op's signature.
+    Off-TPU tier-1 runs land here (bit-identical to the gather impl by
+    construction); the kernel is tested against it."""
+    b, l, h, d = q.shape
+    ps, hkv = k_pool.shape[1], k_pool.shape[2]
+    mp = page_table.shape[1]
+    lmax = mp * ps
+    tab = page_table.astype(jnp.int32)
+    kf = jnp.take(k_pool, tab, axis=0).reshape(b, lmax, hkv, d)
+    vf = jnp.take(v_pool, tab, axis=0).reshape(b, lmax, hkv, d)
+    j = jnp.arange(lmax, dtype=jnp.int32)[None, :]
+    add = jnp.where(j <= pos.astype(jnp.int32)[:, None],
+                    jnp.float32(0.0), jnp.float32(_NEG_INF))
+    add = add[:, None, None, :]                       # [B, 1, 1, lmax]
+    if mask is not None:
+        add = add + mask.reshape(b, h, 1, lmax)
+    return gqa_attend_reference(q, kf, vf, add)
+
+
+def paged_decode_attention(q, k_pool, v_pool, page_table, pos,
+                           mask=None):
+    """Single-token ragged paged-attention decode (the registered op's
+    forward). q [B, 1, H, D]; k/v pools [P, page_size, H_kv, D];
+    page_table [B, max_pages]; pos [B] (or scalar, broadcast) — the
+    per-row count of positions already written BEFORE this step's
+    token, i.e. positions 0..pos are attended (the new token's K/V was
+    just scattered at pos). mask: optional user attention mask
+    (bool or additive float, broadcastable [B|1, H|1, 1, lmax]),
+    composed with the positional window in-kernel."""
+    b, l, h, d = q.shape
+    if l != 1:
+        raise ValueError(
+            f"paged_decode_attention is a single-token decode kernel; "
+            f"got l={l} (chunked prefill stays on the gather path)")
+    lmax = page_table.shape[1] * k_pool.shape[1]
+    posv = pos.astype(jnp.int32)
+    if posv.ndim == 0:
+        posv = jnp.broadcast_to(posv[None], (b,))
+    if mask is not None:
+        mask = _mask_to_additive(mask, b, h, lmax)
+    if _use_kernel():
+        return _paged_attention_kernel(
+            q, k_pool, v_pool, page_table.astype(jnp.int32), posv,
+            mask)
+    return paged_attention_reference(q, k_pool, v_pool, page_table,
+                                     posv, mask)

@@ -14,11 +14,13 @@ Attention, PAPERS.md). Exactly two program shapes touch the pool:
   math as CompiledGenerator via `sample_logits`/`_top_p_filter`), then
   one fixed-shape batched forward where every row scatters its new K/V
   into `page_table[slot, pos // page_size]` and attends over its pages
-  gathered back into the dense logical layout (the paged mode of
-  `update_and_attend`). Membership, page tables, lengths and sampling
-  params change BETWEEN invocations only — the program never retraces,
-  which is what lets XLA keep the hot loop one fused executable
-  ("Operator Fusion in XLA", PAPERS.md).
+  IN PLACE through the Pallas ragged paged-attention kernel (default
+  `attn_impl="kernel"`: walks the page table, streams only live pages
+  — Ragged Paged Attention, PAPERS.md; `attn_impl="gather"` keeps the
+  old gather-into-dense-view path for cross-checks). Membership, page
+  tables, lengths and sampling params change BETWEEN invocations only
+  — the program never retraces, which is what lets XLA keep the hot
+  loop one fused executable ("Operator Fusion in XLA", PAPERS.md).
 - one CHUNKED prefill per power-of-two chunk bucket: a fixed-shape
   batch-1 forward that feeds `chunk_len` prompt tokens through the
   model, writing the chunk's K/V straight into the slot's pages and the
@@ -57,7 +59,8 @@ from ..core import random as random_mod
 from ..core.tensor import Tensor
 from ..profiler import RecordEvent
 from ..nlp.generation import (_pack_caches, _top_p_filter,
-                              _unpack_caches, decode_model_step)
+                              _unpack_caches, decode_model_step,
+                              resolve_paged_attn_impl)
 from .errors import EngineClosed
 from .metrics import ServingMetrics
 from .paging import PagePool, TRASH_PAGE, chunk_bucket, pages_needed
@@ -102,7 +105,8 @@ class ServingEngine:
                  num_pages: Optional[int] = None, chunk_len: int = 32,
                  scheduler: Optional[Scheduler] = None,
                  metrics: Optional[ServingMetrics] = None,
-                 max_queue: Optional[int] = None, clock=time.monotonic):
+                 max_queue: Optional[int] = None, clock=time.monotonic,
+                 attn_impl: Optional[str] = None):
         if cache_spec is None:
             if not hasattr(model, "_decode_cache_spec"):
                 raise ValueError(
@@ -131,7 +135,14 @@ class ServingEngine:
                                                 max_queue=max_queue)
         if self.scheduler.num_slots != self.num_slots:
             raise ValueError("scheduler.num_slots != engine num_slots")
+        # paged decode attention implementation: "kernel" (Pallas
+        # ragged paged attention, the default) or "gather" (the
+        # paged_kv_gather + dense SDPA cross-check path). Resolved ONCE
+        # here — the compiled decode step keeps the impl it was traced
+        # with; flipping PADDLE_TPU_PAGED_ATTN later needs a new engine.
+        self.attn_impl = resolve_paged_attn_impl(attn_impl)
         self.metrics = metrics or ServingMetrics()
+        self.metrics.attn_impl = self.attn_impl
         self._clock = clock
         self._id_counter = itertools.count()
         self._requests: Dict[str, Request] = {}
@@ -210,7 +221,8 @@ class ServingEngine:
                 s = slot.astype(jnp.int32).reshape(())
                 pt_row = jax.lax.dynamic_slice(
                     page_table, (s, z), (1, page_table.shape[1]))
-                caches = _unpack_caches(ct, start, pt_row)
+                caches = _unpack_caches(ct, start, pt_row,
+                                        attn_impl=self.attn_impl)
                 logits_t, caches = model(Tensor(tokens), caches=caches)
                 v = logits_t._value.shape[-1]
                 row = jax.lax.dynamic_slice(
@@ -244,7 +256,8 @@ class ServingEngine:
                 nxt = _sample_rows(last_logits, key, temps, top_k,
                                    top_p, greedy)
                 nxt = jnp.where(active, nxt, 0).astype(jnp.int32)
-                caches = _unpack_caches(ct, pos, page_table)
+                caches = _unpack_caches(ct, pos, page_table,
+                                        attn_impl=self.attn_impl)
                 last, caches = decode_model_step(model, nxt[:, None],
                                                  caches)
                 # only occupied slots advance; free/prefilling rows stay
@@ -459,6 +472,7 @@ class ServingEngine:
             self._refresh_vectors()
         _, pt_decode = self._page_tables()
         key = random_mod.next_key_host()
+        t0 = time.perf_counter()
         with RecordEvent("serving::decode_step"):
             self._ct, self._pos, self._last_logits, toks = \
                 self._decode_fn(
@@ -468,6 +482,9 @@ class ServingEngine:
                     jnp.asarray(self._topp), jnp.asarray(self._greedy),
                     jnp.asarray(self._active))
             toks = np.asarray(toks)   # sync point: host sees the tokens
+        # wall time of the synchronized step (the attn_impl A/B metric);
+        # real perf_counter regardless of an injected test clock
+        self.metrics.on_decode_step(time.perf_counter() - t0)
         now = now_fn()
         for slot, req in list(self.scheduler.running.items()):
             if req.state is not RequestState.DECODE:

@@ -136,9 +136,16 @@ class ServingMetrics:
         self.pool_pages_used = 0
         self.pool_pages_total = 0
         self.prefill_stall = 0
+        # which paged decode attention implementation the engine runs
+        # ("kernel" | "gather"); set by the engine at construction so
+        # benches/dashboards can attribute latency to the impl
+        self.attn_impl: Optional[str] = None
         # histograms (TTFT/inter-token carry fixed Prometheus buckets)
         self.ttft_s = Histogram(buckets=TTFT_BUCKETS)
         self.inter_token_s = Histogram(buckets=LATENCY_BUCKETS)
+        # synchronized wall time of one compiled decode step — the
+        # number the attn_impl A/B compares
+        self.decode_step_s = Histogram(buckets=LATENCY_BUCKETS)
         self.queue_wait_s = Histogram()
         self.e2e_s = Histogram()
         self.queue_depth_hist = Histogram()
@@ -185,6 +192,10 @@ class ServingMetrics:
             else:                 # "aborted", "replica_failure", ...
                 self.requests_aborted += 1
             self.e2e_s.record(now - req.arrival_t)
+
+    def on_decode_step(self, wall_s: float):
+        with self._lock:
+            self.decode_step_s.record(wall_s)
 
     def on_prefill_chunk(self, n_tokens: int):
         with self._lock:
@@ -237,6 +248,8 @@ class ServingMetrics:
             "prefill_chunks": self.prefill_chunks,
             "prefill_chunk_tokens": self.prefill_chunk_tokens,
             "decode_steps": self.decode_steps,
+            "attn_impl": self.attn_impl,
+            "decode_step_s": self.decode_step_s.snapshot(),
             "tokens_per_sec": self.tokens_per_sec,
             "queue_depth": self.queue_depth,
             "slot_occupancy": self.slot_occupancy,

@@ -507,6 +507,43 @@ def main():
     note("loop64_kv_int8_blhd_headscale_per_step_ms",
          round(t / 64 * 1e3, 3))
 
+    # (12) paged decode attention A/B at the same shapes: the gather
+    # impl materializes each row's [max_pages * page_size] logical view
+    # per layer; the ragged kernel walks the page table and streams
+    # only live pages (on CPU this times its pure-JAX reference — run
+    # on the chip for the real number)
+    from paddle_tpu.ops.pallas.paged_attention import \
+        paged_decode_attention
+    from paddle_tpu.nlp.generation import _paged_gather_fwd
+    PS = 16
+    MP = LMAX // PS
+    NPAGES = B * MP + 1
+    kpool = rnd(NPAGES, PS, NH, D)
+    vpool = rnd(NPAGES, PS, NH, D)
+    ptab = jnp.asarray(
+        np.arange(1, B * MP + 1, dtype=np.int32).reshape(B, MP))
+    posv = jnp.full((B,), 400, jnp.int32)
+    qrow = rnd(B, 1, NH, D)
+
+    def paged_gather_attend(q, kp_, vp_, pt_, p_):
+        kf = _paged_gather_fwd(kp_, pt_)
+        vf = _paged_gather_fwd(vp_, pt_)
+        qf = q.transpose(0, 2, 1, 3).astype(jnp.float32)
+        s = jnp.einsum("bhqd,bkhd->bhqk", qf,
+                       kf.astype(jnp.float32)) / np.sqrt(D)
+        j = jnp.arange(MP * PS)[None, None, None, :]
+        s = jnp.where(j <= p_[:, None, None, None], s, -1e30)
+        a = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bhqd", a, vf.astype(jnp.float32))
+        return o.transpose(0, 2, 1, 3).astype(q.dtype)
+
+    t = timeit(jax.jit(paged_gather_attend), qrow, kpool, vpool, ptab,
+               posv)
+    note("paged_attn_gather_ms", round(t * 1e3, 3))
+    t = timeit(jax.jit(paged_decode_attention), qrow, kpool, vpool,
+               ptab, posv)
+    note("paged_attn_kernel_ms", round(t * 1e3, 3))
+
     # roofline bookkeeping
     wbytes = sum(int(np.prod(w.shape)) for w in Wqkv + Wout + W1 + W2) * 2
     ebytes = int(np.prod(E.shape)) * 2

@@ -33,6 +33,8 @@ def _cases():
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
     from paddle_tpu import ops
+    import paddle_tpu.nlp.generation  # noqa: F401  (paged decode ops)
+    from paddle_tpu.ops._helpers import apply_op
 
     rng = np.random.RandomState(0)
 
@@ -115,6 +117,16 @@ def _cases():
         "squeeze_unsqueeze": lambda: (
             lambda x: paddle.unsqueeze(paddle.squeeze(x, 0), 0),
             (t(1, *M),)),
+        # ragged paged-attention decode: 8 slots x 8 pages of 16 over
+        # 8 kv heads served to 8 query heads (the serving hot path; on
+        # CPU this times the pure-JAX reference, on TPU the kernel)
+        "paged_decode_attention": lambda: (
+            lambda q, kp, vp, pt, pos: apply_op(
+                "paged_decode_attention", q, kp, vp, pt, pos),
+            (t(8, 1, 8, 64), t(65, 16, 8, 64), t(65, 16, 8, 64),
+             paddle.to_tensor(np.arange(1, 65, dtype=np.int32)
+                              .reshape(8, 8)),
+             paddle.to_tensor(np.full((8,), 100, np.int32)))),
     }
     return cases
 

@@ -3,13 +3,21 @@
 Drives `paddle_tpu.serving.ServingEngine` (paged KV pool + chunked
 prefill) with a Poisson arrival trace (exponential inter-arrival gaps,
 geometric-ish mixed prompt lengths and output budgets) against the
-tiny GPT config on CPU or a GPT-124M-ish config on the chip. Prints
-ONE JSON line and writes the same stable-schema report to
-BENCH_serving.json (override with --out, suppress with --out -):
+tiny GPT config on CPU or a GPT-124M-ish config on the chip. The SAME
+trace runs once per paged-attention implementation — "kernel" (Pallas
+ragged paged attention, the engine default) and "gather" (the
+paged_kv_gather + dense SDPA cross-check path) — so the A/B shows up
+in the bench trajectory. Prints ONE JSON line and writes the same
+stable-schema report to BENCH_serving.json (override with --out,
+suppress with --out -):
 
-    {"bench": "serving", "schema_version": 2, "requests": ...,
-     "ttft_p50_s": ..., "ttft_p99_s": ..., "tokens_per_sec": ...,
-     "pool_utilization_mean": ..., "prefill_chunks": ..., ...}
+    {"bench": "serving", "schema_version": 3, "attn_impl": "kernel",
+     "requests": ..., "ttft_p50_s": ..., "tokens_per_sec": ...,
+     "decode_step_ms_p50": ..., "ab": {"kernel": {...},
+     "gather": {...}}, ...}
+
+Top-level numbers are the default ("kernel") run; "ab" holds the
+per-impl summaries (tokens/s, TTFT, per-step decode wall time).
 
 Usage:
     python scripts/serving_bench.py            # platform-sized run
@@ -124,54 +132,54 @@ def main():
                for _ in range(n_req)]
     budgets = rng.randint(max(1, max_new // 2), max_new + 1, size=n_req)
 
-    eng = ServingEngine(model, num_slots=args.slots, max_len=max_len,
-                        page_size=args.page_size, num_pages=args.pages,
-                        chunk_len=chunk)
+    # the A/B: the SAME trace (arrivals, prompts, budgets) once per
+    # paged-attention implementation, kernel first (the default)
+    runs = {}
+    for attn_impl in ("kernel", "gather"):
+        runs[attn_impl] = run_trace(
+            model, arrivals, prompts, budgets, slots=args.slots,
+            max_len=max_len, page_size=args.page_size, pages=args.pages,
+            chunk=chunk, attn_impl=attn_impl)
 
-    # warm the compiled programs so the trace measures steady state, not
-    # XLA compile time: one request per distinct prompt length (chunk
-    # bucketing folds these into O(log chunk) prefill traces)
-    for pl in sorted({p.size for p in prompts}):
-        eng.add_request(np.arange(1, pl + 1, dtype=np.int64),
-                        SamplingParams(max_new_tokens=2))
-    eng.run()
-    eng.metrics.__init__()   # drop warmup from the report
-
-    t0 = time.monotonic()
-    submitted = 0
-    reqs = []
-    while submitted < n_req or eng.has_work:
-        now = time.monotonic() - t0
-        while submitted < n_req and arrivals[submitted] <= now:
-            reqs.append(eng.add_request(
-                prompts[submitted],
-                SamplingParams(max_new_tokens=int(budgets[submitted]))))
-            submitted += 1
-        if eng.has_work:
-            eng.step()
-        elif submitted < n_req:
-            time.sleep(min(0.001, arrivals[submitted] - now))
-    wall = time.monotonic() - t0
-
-    snap = eng.metrics.snapshot()
+    snap = runs["kernel"]["snap"]
     pool = snap["pool"]
+
+    def _ms(v):
+        return None if v is None else round(v * 1e3, 4)
+
+    def _ab(run):
+        s = run["snap"]
+        return {
+            "wall_s": round(run["wall_s"], 4),
+            "tokens_per_sec": s["tokens_per_sec"],
+            "ttft_p50_s": s["ttft_s"]["p50"],
+            "ttft_p99_s": s["ttft_s"]["p99"],
+            "decode_steps": s["decode_steps"],
+            "decode_step_ms_p50": _ms(s["decode_step_s"]["p50"]),
+            "decode_step_ms_p99": _ms(s["decode_step_s"]["p99"]),
+            "completed": s["requests"]["completed"],
+        }
+
     report = {
         "bench": "serving",
-        "schema_version": 2,
+        "schema_version": 3,
         "platform": jax.devices()[0].platform,
+        "attn_impl": "kernel",
         "requests": n_req,
         "slots": args.slots,
         "max_len": max_len,
-        "page_size": eng.page_size,
-        "num_pages": eng.num_pages,
-        "chunk_len": eng.chunk_len,
+        "page_size": runs["kernel"]["page_size"],
+        "num_pages": runs["kernel"]["num_pages"],
+        "chunk_len": runs["kernel"]["chunk_len"],
         "arrival_rate_per_s": rate,
-        "wall_s": round(wall, 4),
+        "wall_s": round(runs["kernel"]["wall_s"], 4),
         "tokens_generated": snap["tokens_generated"],
         "tokens_per_sec": snap["tokens_per_sec"],
         "ttft_p50_s": snap["ttft_s"]["p50"],
         "ttft_p99_s": snap["ttft_s"]["p99"],
         "inter_token_p50_s": snap["inter_token_s"]["p50"],
+        "decode_step_ms_p50": _ms(snap["decode_step_s"]["p50"]),
+        "decode_step_ms_p99": _ms(snap["decode_step_s"]["p99"]),
         "queue_wait_p99_s": snap["queue_wait_s"]["p99"],
         "occupancy_mean": snap["occupancy_hist"]["mean"],
         "pool_utilization_mean": pool["utilization"]["mean"],
@@ -180,6 +188,7 @@ def main():
         "prefill_stall_p99": snap["prefill_stall_hist"]["p99"],
         "decode_steps": snap["decode_steps"],
         "completed": snap["requests"]["completed"],
+        "ab": {impl: _ab(run) for impl, run in runs.items()},
     }
     if args.http:
         report["http"] = http_trace(
@@ -194,10 +203,51 @@ def main():
         with open(args.out, "w") as f:
             json.dump(report, f, indent=1, sort_keys=True)
             f.write("\n")
-    assert snap["requests"]["completed"] == n_req, \
-        (snap["requests"], n_req)
+    for impl, run in runs.items():
+        assert run["snap"]["requests"]["completed"] == n_req, \
+            (impl, run["snap"]["requests"], n_req)
     if args.http:
         assert report["http"]["completed"] == n_req, report["http"]
+
+
+def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
+              page_size, pages, chunk, attn_impl):
+    """One Poisson-trace replay through a fresh engine pinned to
+    `attn_impl`; returns {snap, wall_s, engine-shape fields}."""
+    from paddle_tpu.serving import SamplingParams, ServingEngine
+
+    n_req = len(prompts)
+    eng = ServingEngine(model, num_slots=slots, max_len=max_len,
+                        page_size=page_size, num_pages=pages,
+                        chunk_len=chunk, attn_impl=attn_impl)
+
+    # warm the compiled programs so the trace measures steady state, not
+    # XLA compile time: one request per distinct prompt length (chunk
+    # bucketing folds these into O(log chunk) prefill traces)
+    for pl in sorted({p.size for p in prompts}):
+        eng.add_request(np.arange(1, pl + 1, dtype=np.int64),
+                        SamplingParams(max_new_tokens=2))
+    eng.run()
+    eng.metrics.__init__()   # drop warmup from the report
+    eng.metrics.attn_impl = eng.attn_impl
+
+    t0 = time.monotonic()
+    submitted = 0
+    while submitted < n_req or eng.has_work:
+        now = time.monotonic() - t0
+        while submitted < n_req and arrivals[submitted] <= now:
+            eng.add_request(
+                prompts[submitted],
+                SamplingParams(max_new_tokens=int(budgets[submitted])))
+            submitted += 1
+        if eng.has_work:
+            eng.step()
+        elif submitted < n_req:
+            time.sleep(min(0.001, arrivals[submitted] - now))
+    wall = time.monotonic() - t0
+    return {"snap": eng.metrics.snapshot(), "wall_s": wall,
+            "page_size": eng.page_size, "num_pages": eng.num_pages,
+            "chunk_len": eng.chunk_len}
 
 
 def http_trace(model, cfg, *, n_req, rate, max_new, max_len, chunk,

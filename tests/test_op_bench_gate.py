@@ -71,3 +71,19 @@ def test_zero_baseline_is_infinite_regression():
     new = _report(add=(30.0, 1.0))
     out, err = io.StringIO(), io.StringIO()
     assert obc.run_gate(base, new, out=out, err=err) == 1
+
+
+def test_paged_decode_attention_is_benched():
+    """The ragged paged-attention decode op must keep a tracked perf
+    number: its case stays in op_bench's table so every report (and
+    therefore the wall_us gate) carries it."""
+    spec = importlib.util.spec_from_file_location(
+        "op_bench", os.path.join(HERE, os.pardir, "scripts",
+                                 "op_bench.py"))
+    ob = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ob)
+    cases = ob._cases()
+    assert "paged_decode_attention" in cases
+    fn, args = cases["paged_decode_attention"]()
+    out = fn(*args)
+    assert tuple(out.shape) == (8, 1, 8, 64)

@@ -901,6 +901,12 @@ ELSEWHERE = {
     # page-table scatter/gather, chunked prefill, page reuse
     **{n: EW("test_serving.py", "Paged|chunked") for n in [
         "kv_cache_update_paged", "paged_kv_gather"]},
+    # ragged paged-attention decode kernel + grouped-GQA decode —
+    # kernel vs gather bit-identity, interpret-mode kernel vs
+    # reference, ServingEngine A/B (tests/test_paged_attention.py)
+    **{n: EW("test_paged_attention.py",
+             "paged_decode_attention|gqa_decode_attend") for n in [
+        "paged_decode_attention", "gqa_decode_attend"]},
     # rotary embedding — tests/test_nlp_models.py (Llama family)
     "rope": EW("test_nlp_models.py", "Llama|rope"),
     "rope_dyn": EW("test_nlp_models.py", "Llama|rope"),

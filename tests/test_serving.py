@@ -641,15 +641,23 @@ def test_serving_bench_smoke_writes_stable_schema(tmp_path,
     with open(out) as f:
         report = json.load(f)
     assert report["bench"] == "serving"
-    assert report["schema_version"] == 2
+    assert report["schema_version"] == 3
     for key in ("tokens_per_sec", "ttft_p50_s", "ttft_p99_s",
                 "pool_utilization_mean", "pool_utilization_max",
                 "prefill_chunks", "page_size", "num_pages",
-                "chunk_len", "completed"):
+                "chunk_len", "completed", "attn_impl",
+                "decode_step_ms_p50", "ab"):
         assert key in report, key
     assert report["completed"] == report["requests"] == 3
     assert report["tokens_per_sec"] > 0
     assert 0 < report["pool_utilization_max"] <= 1.0
+    # the A/B: both paged-attention impls ran the same trace to
+    # completion, kernel is the default, per-step wall time recorded
+    assert report["attn_impl"] == "kernel"
+    assert set(report["ab"]) == {"kernel", "gather"}
+    for impl, run in report["ab"].items():
+        assert run["completed"] == 3, impl
+        assert run["decode_step_ms_p50"] > 0, impl
 
 
 @pytest.mark.slow

@@ -473,8 +473,10 @@ def test_serving_bench_http_smoke_appends_http_section(tmp_path,
     mod.main()
     with open(out) as f:
         report = json.load(f)
-    assert report["schema_version"] == 2         # schema unchanged
+    assert report["schema_version"] == 3         # attn_impl A/B schema
     assert report["completed"] == 4              # in-process section
+    assert report["attn_impl"] == "kernel"
+    assert set(report["ab"]) == {"kernel", "gather"}
     http_sec = report["http"]
     assert http_sec["replicas"] == 2
     assert http_sec["completed"] == 4 and http_sec["errors"] == 0
