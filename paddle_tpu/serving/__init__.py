@@ -24,15 +24,20 @@ Greedy requests are bit-identical to offline CompiledGenerator decode
 reports TTFT/throughput/pool utilization into BENCH_serving.json.
 """
 from .engine import ServingEngine  # noqa: F401
-from .errors import EngineClosed, QueueFull, ServingError  # noqa: F401
+from .errors import (EngineClosed, QueueFull, RateLimited,  # noqa: F401
+                     ServingError)
 from .metrics import (Histogram, ServingMetrics,  # noqa: F401
                       prometheus_render)
 from .paging import PagePool, chunk_bucket, pages_needed  # noqa: F401
+from .prefix import (PrefixGrant, RadixPrefixCache,  # noqa: F401
+                     resolve_prefix_cache_flag)
 from .request import (Request, RequestOutput, RequestState,  # noqa: F401
                       SamplingParams)
 from .scheduler import Scheduler  # noqa: F401
 
 __all__ = ["ServingEngine", "Scheduler", "ServingMetrics", "Histogram",
            "prometheus_render", "PagePool", "pages_needed",
-           "chunk_bucket", "Request", "RequestOutput", "RequestState",
-           "SamplingParams", "ServingError", "QueueFull", "EngineClosed"]
+           "chunk_bucket", "RadixPrefixCache", "PrefixGrant",
+           "resolve_prefix_cache_flag", "Request", "RequestOutput",
+           "RequestState", "SamplingParams", "ServingError",
+           "QueueFull", "EngineClosed", "RateLimited"]

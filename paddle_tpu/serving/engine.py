@@ -35,6 +35,19 @@ Free slots and retired requests point their page-table rows at the
 reserved trash page 0, so the fixed-shape scatter/gather stays safe for
 any live/free mix (see serving/paging.py and the paged DecodeCache).
 
+An AUTOMATIC PREFIX CACHE (serving/prefix.py, default on, gated by
+`prefix_cache=...` / PADDLE_TPU_PREFIX_CACHE) sits between the pool and
+admission: finished requests' pages are indexed in a token-id radix
+tree; a new prompt's longest cached prefix attaches those pages to its
+page table (refcount++, zero prefill work) and only the uncached tail
+runs chunked prefill — a mid-page match gets its partial page
+copy-on-write (one compiled single-page copy) so shared pages are never
+written through. Retired pages park in the cache instead of freeing;
+admission under page pressure evicts LRU unreferenced leaves before
+applying backpressure. None of this changes any compiled program — only
+which page ids the host page tables carry — so greedy outputs stay
+token-identical with the cache on, off, hot, or thrashing.
+
 Correctness contract (tests/test_serving.py): a request decoded greedily
 through the engine emits tokens bit-identical to running it ALONE
 through CompiledGenerator greedy decode — through chunked prefill,
@@ -64,6 +77,7 @@ from ..nlp.generation import (_pack_caches, _top_p_filter,
 from .errors import EngineClosed
 from .metrics import ServingMetrics
 from .paging import PagePool, TRASH_PAGE, chunk_bucket, pages_needed
+from .prefix import RadixPrefixCache, resolve_prefix_cache_flag
 from .request import Request, RequestOutput, RequestState, SamplingParams
 from .scheduler import Scheduler
 
@@ -106,7 +120,8 @@ class ServingEngine:
                  scheduler: Optional[Scheduler] = None,
                  metrics: Optional[ServingMetrics] = None,
                  max_queue: Optional[int] = None, clock=time.monotonic,
-                 attn_impl: Optional[str] = None):
+                 attn_impl: Optional[str] = None,
+                 prefix_cache=None):
         if cache_spec is None:
             if not hasattr(model, "_decode_cache_spec"):
                 raise ValueError(
@@ -171,6 +186,16 @@ class ServingEngine:
         # (full for prefill; decode variant trash-masks non-DECODE rows
         # so their ignored writes can't touch live pages)
         self.pool = PagePool(self.num_pages)
+        # automatic prefix cache (serving/prefix.py): radix tree of
+        # finished requests' pages over the pool. Admission
+        # longest-prefix-matches the prompt and attaches shared pages
+        # (refcount++) instead of re-prefilling them; gated by
+        # ServingEngine(prefix_cache=...) / PADDLE_TPU_PREFIX_CACHE
+        # (default on). Greedy outputs are token-identical either way —
+        # only the page ids in the host page tables differ.
+        self.prefix_cache = (
+            RadixPrefixCache(self.pool, self.page_size)
+            if resolve_prefix_cache_flag(prefix_cache) else None)
         self._slot_pages: Dict[int, List[int]] = {}
         self._prefill_cursor: Dict[str, int] = {}
         self._pt_host = np.full((self.num_slots, self.max_pages),
@@ -187,6 +212,7 @@ class ServingEngine:
         self._active = np.zeros((self.num_slots,), bool)
         self._prefill_fns: Dict[int, object] = {}   # chunk bucket -> fn
         self._decode_fn = None
+        self._copy_page_fn = None    # COW single-page copy, jitted once
         self._spans: Dict[str, RecordEvent] = {}
         # shutdown latch: flipped by drain()/abort_all(); add_request
         # raises EngineClosed once set
@@ -271,6 +297,26 @@ class ServingEngine:
         return jax.jit(lambda ct, pos, ll, pt, key, t, k, p, g, a: step(
             state_vals, ct, pos, ll, pt, key, t, k, p, g, a))
 
+    def _build_copy_page(self):
+        """ONE compiled single-page pool copy for copy-on-write: src and
+        dst page ids are traced scalars, so every COW across every
+        layer's K and V pools reuses this one program (no retrace across
+        cache hit/miss/eviction transitions)."""
+        def cp(ct, src, dst):
+            out = []
+            for k, v, ks, vs in ct:
+                out.append((k.at[dst].set(k[src]),
+                            v.at[dst].set(v[src]), ks, vs))
+            return tuple(out)
+        return jax.jit(cp)
+
+    def _copy_page(self, src: int, dst: int):
+        if self._copy_page_fn is None:
+            self._copy_page_fn = self._build_copy_page()
+        with RecordEvent(f"serving::cow_copy[{src}->{dst}]"):
+            self._ct = self._copy_page_fn(self._ct, jnp.int32(src),
+                                          jnp.int32(dst))
+
     # -- request intake ----------------------------------------------------
     def add_request(self, prompt_ids, sampling: Optional[SamplingParams]
                     = None, request_id: Optional[str] = None,
@@ -348,8 +394,9 @@ class ServingEngine:
             self._vec_dirty = True
             pages = self._slot_pages.pop(slot, None)
             if pages:
-                self.pool.free(pages)
+                self._retire_pages(req, reason, pages)
             req.pages = None
+            req._prefix_grant = None
             self._pt_host[slot, :] = TRASH_PAGE
             self._pt_dirty = True
         self._prefill_cursor.pop(req.request_id, None)
@@ -359,6 +406,28 @@ class ServingEngine:
         if span is not None:
             span.end()
         finished.append(req.output())
+
+    def _retire_pages(self, req: Request, reason: str,
+                      pages: List[int]):
+        """Route a retiring request's pages: without the prefix cache
+        they return to the pool; with it, a normally finished request's
+        written pages are INSERTED into the radix tree (multi-turn
+        follow-ups re-sending prompt + completion hit them), everything
+        else just drops its references — shared pages stay resident for
+        their other holders, private ones free."""
+        if self.prefix_cache is None:
+            self.pool.free(pages)
+            return
+        if reason in ("stop", "length"):
+            # every emitted token's KV was written by the decode step
+            # that sampled it, so prompt + output positions are valid
+            seq = np.concatenate([
+                req.prompt_ids.astype(np.int64),
+                np.asarray(req.output_tokens, np.int64)])
+            self.prefix_cache.insert(
+                seq, pages, req.prompt_ids.size + len(req.output_tokens))
+        else:
+            self.prefix_cache.release(pages)
 
     def _evict(self, now: float, finished: List[RequestOutput]):
         for req in self.scheduler.expired(now):
@@ -370,15 +439,29 @@ class ServingEngine:
 
     def _reserve(self, req: Request) -> bool:
         """Page-aware admission (scheduler callback): grant the slot
-        only if the request's WHOLE page budget is free right now —
+        only if the request's WHOLE page budget is available right now —
         otherwise the queue head waits (FIFO backpressure) and nobody
-        behind it can starve it by stealing pages."""
-        pages = self.pool.alloc(pages_needed(
-            req.prompt_ids.size, req.sampling.max_new_tokens,
-            self.page_size))
-        if pages is None:
+        behind it can starve it by stealing pages. With the prefix
+        cache, "available" is match-then-reserve: the prompt's cached
+        prefix attaches shared pages (no fresh allocation for them) and
+        LRU leaves of the cache are evicted before the head is held
+        back, so backpressure only fires when genuinely referenced
+        pages exhaust the pool."""
+        if self.prefix_cache is None:
+            pages = self.pool.alloc(pages_needed(
+                req.prompt_ids.size, req.sampling.max_new_tokens,
+                self.page_size))
+            if pages is None:
+                return False
+            req.pages = pages
+            return True
+        grant = self.prefix_cache.acquire(req.prompt_ids,
+                                          req.sampling.max_new_tokens)
+        if grant is None:
             return False
-        req.pages = pages
+        req.pages = grant.pages
+        req.cached_tokens = grant.cached_len
+        req._prefix_grant = grant
         return True
 
     def _admit(self, now: float):
@@ -393,7 +476,16 @@ class ServingEngine:
             self._pt_host[slot, :len(req.pages)] = req.pages
             self._pt_dirty = True
             self._pos = self._pos.at[slot].set(0)
-            self._prefill_cursor[req.request_id] = 0
+            # prefix-cache hit: the matched span's KV is already in the
+            # attached pages — prefill starts at the first uncached
+            # token. A mid-page match first copies the shared partial
+            # page into the request's private one (copy-on-write): a
+            # shared page is never written through.
+            grant = req._prefix_grant
+            if grant is not None and grant.cow_src is not None:
+                self._copy_page(grant.cow_src, grant.cow_dst)
+                self.prefix_cache.cow_done(grant)
+            self._prefill_cursor[req.request_id] = req.cached_tokens
             self.metrics.on_admit(req, self._clock())
 
     def _ensure_last_logits(self, req: Request):
@@ -517,7 +609,12 @@ class ServingEngine:
                              self.scheduler.occupancy, self.num_slots,
                              pages_used=self.pool.used_pages,
                              pages_total=self.num_pages - 1,
-                             stall_chunks=chunks)
+                             stall_chunks=chunks,
+                             pages_cached=self.pool.cached_pages,
+                             prefix_stats=(
+                                 self.prefix_cache.stats()
+                                 if self.prefix_cache is not None
+                                 else None))
         return finished
 
     # -- shutdown ----------------------------------------------------------
@@ -530,13 +627,15 @@ class ServingEngine:
         EngineClosed), abort still-QUEUED requests (reason "aborted" —
         they never held pages), then pump steps until every resident
         finishes normally. On return the scheduler is empty and every
-        page is back in the pool. Idempotent."""
+        page is either free or cache-resident (leak-checked).
+        Idempotent."""
         self._closed = True
         finished: List[RequestOutput] = []
         now = self._clock()
         for req in self.scheduler.pop_queued():
             self._finish_and_free(req, "aborted", now, finished)
         finished.extend(self.run())
+        self.pool.assert_quiesced()
         return finished
 
     def abort_all(self, reason: str = "aborted") -> List[RequestOutput]:
@@ -553,6 +652,7 @@ class ServingEngine:
         for slot in sorted(list(self.scheduler.running)):
             self._finish_and_free(self.scheduler.running[slot], reason,
                                   now, finished)
+        self.pool.assert_quiesced()
         return finished
 
     # -- conveniences ------------------------------------------------------

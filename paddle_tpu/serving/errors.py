@@ -4,14 +4,15 @@ The HTTP front-end (serving/http/) maps these to status codes without
 string-matching exception text:
 
 - `QueueFull`      -> 429 Too Many Requests (+ Retry-After)
+- `RateLimited`    -> 429 Too Many Requests (+ Retry-After, per client)
 - `EngineClosed`   -> 503 Service Unavailable (draining / shut down)
 
-Both subclass `ServingError(RuntimeError)`, so pre-existing callers
+All subclass `ServingError(RuntimeError)`, so pre-existing callers
 that caught RuntimeError keep working.
 """
 from __future__ import annotations
 
-__all__ = ["ServingError", "QueueFull", "EngineClosed"]
+__all__ = ["ServingError", "QueueFull", "EngineClosed", "RateLimited"]
 
 
 class ServingError(RuntimeError):
@@ -24,6 +25,17 @@ class QueueFull(ServingError):
     `retry_after_s` is the engine's hint for the HTTP Retry-After
     header (how long until queue drain plausibly frees a spot).
     """
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class RateLimited(ServingError):
+    """This CLIENT (API key / remote address) exceeded its token
+    bucket: back off for `retry_after_s`. Unlike QueueFull — global
+    load shedding — this is per-client fairness: other clients are
+    still admitted."""
 
     def __init__(self, message: str, retry_after_s: float = 1.0):
         super().__init__(message)

@@ -24,28 +24,36 @@ from typing import Optional, Sequence
 from .driver import EngineDriver, ReplicaDead  # noqa: F401
 from .protocol import (CompletionRequest, ProtocolError,  # noqa: F401
                        parse_completion_request)
+from .ratelimit import RateLimiter, TokenBucket  # noqa: F401
 from .router import Router, Ticket  # noqa: F401
 from .server import ServingHTTPServer  # noqa: F401
 
 __all__ = ["EngineDriver", "ReplicaDead", "Router", "Ticket",
            "ServingHTTPServer", "ProtocolError", "CompletionRequest",
-           "parse_completion_request", "serve"]
+           "parse_completion_request", "RateLimiter", "TokenBucket",
+           "serve"]
 
 
 def serve(engines: Sequence, host: str = "127.0.0.1", port: int = 0,
           *, model_name: str = "paddle-tpu",
           default_timeout_s: Optional[float] = None,
           max_retries: int = 3,
-          poll_interval_s: float = 0.05) -> ServingHTTPServer:
+          poll_interval_s: float = 0.05,
+          rate_limit: Optional[float] = None,
+          rate_limit_burst: Optional[float] = None) -> ServingHTTPServer:
     """One-call assembly: wrap each engine in a driver, front them with
     a router, start the HTTP server on (host, port) — port 0 picks a
-    free one (see `server.url`). Returns the STARTED server; call
-    `drain()` (or `install_signal_handlers()` for SIGTERM) to stop."""
+    free one (see `server.url`). `rate_limit`/`rate_limit_burst` turn
+    on per-client token-bucket limiting (429 + Retry-After per API
+    key / remote address). Returns the STARTED server; call `drain()`
+    (or `install_signal_handlers()` for SIGTERM) to stop."""
     drivers = [EngineDriver(e, name=f"replica-{i}")
                for i, e in enumerate(engines)]
     router = Router(drivers, max_retries=max_retries,
                     default_timeout_s=default_timeout_s)
     server = ServingHTTPServer(router, host, port,
                                model_name=model_name,
-                               poll_interval_s=poll_interval_s)
+                               poll_interval_s=poll_interval_s,
+                               rate_limit=rate_limit,
+                               rate_limit_burst=rate_limit_burst)
     return server.start()

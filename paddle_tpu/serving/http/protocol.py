@@ -17,6 +17,7 @@ Typed serving errors map to status codes here — never by
 string-matching exception text:
 
     QueueFull           -> 429 (+ Retry-After)
+    RateLimited         -> 429 (+ Retry-After, per client key)
     EngineClosed        -> 503
     ReplicaDead         -> 502
     timeout, 0 tokens   -> 503 (deadline passed while queued)
@@ -30,7 +31,7 @@ from typing import Optional
 
 import numpy as np
 
-from ..errors import EngineClosed, QueueFull
+from ..errors import EngineClosed, QueueFull, RateLimited
 from ..request import RequestOutput, SamplingParams
 from .driver import ReplicaDead
 
@@ -112,10 +113,14 @@ def parse_completion_request(raw: bytes) -> CompletionRequest:
 
 # -- responses -------------------------------------------------------------
 def _usage(out: RequestOutput) -> dict:
+    # cached_tokens: prompt tokens served from the engine's prefix
+    # cache (shared KV pages; zero prefill work) — the OpenAI-style
+    # cache-hit accounting knob clients use to verify prompt reuse
     return {"prompt_tokens": len(out.prompt_token_ids),
             "completion_tokens": len(out.token_ids),
             "total_tokens": len(out.prompt_token_ids)
-            + len(out.token_ids)}
+            + len(out.token_ids),
+            "cached_tokens": int(getattr(out, "cached_tokens", 0) or 0)}
 
 
 def completion_body(ticket_id: str, model: str,
@@ -162,7 +167,7 @@ def error_body(status: int, message: str,
 def status_for_error(exc: BaseException) -> int:
     if isinstance(exc, ProtocolError):
         return exc.status
-    if isinstance(exc, QueueFull):
+    if isinstance(exc, (QueueFull, RateLimited)):
         return 429
     if isinstance(exc, ReplicaDead):
         return 502

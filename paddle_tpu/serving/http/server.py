@@ -20,6 +20,18 @@ Backpressure and failure map to status codes via typed errors
 -> 503, replica death mid-request -> 502 (unstarted requests are
 retried on surviving replicas before any error surfaces).
 
+Per-client rate limiting (`rate_limit` req/s + `rate_limit_burst` on
+the ctor, default off): each API key (Authorization header; remote
+address otherwise) draws from its own token bucket BEFORE the request
+reaches the router — one chatty client 429s (+ Retry-After) while
+everyone else keeps being admitted (serving/http/ratelimit.py).
+
+Connection handling: non-SSE completions (and every GET probe) are
+HTTP/1.1 keep-alive — `Content-Length` + `Connection: keep-alive`, so
+benchmark and SDK clients reuse one socket across calls instead of
+paying a TCP handshake per completion. SSE streams still close when
+done (their length is unknowable up front).
+
 Client disconnects: every SSE write is followed by a liveness probe of
 the connection; a dropped reader cancels the request at the engine's
 next step boundary, returning its slot and KV pages to the pool.
@@ -39,12 +51,14 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from ..errors import EngineClosed, QueueFull, ServingError
+from ..errors import (EngineClosed, QueueFull, RateLimited,
+                      ServingError)
 from ..metrics import prometheus_render
 from .protocol import (ProtocolError, completion_body, error_body,
                        parse_completion_request, sse, SSE_DONE,
                        status_for_error, status_for_output,
                        stream_chunk, stream_final)
+from .ratelimit import RateLimiter
 from .router import Router
 
 __all__ = ["ServingHTTPServer"]
@@ -56,10 +70,19 @@ class ServingHTTPServer(ThreadingHTTPServer):
 
     def __init__(self, router: Router, host: str = "127.0.0.1",
                  port: int = 0, *, model_name: str = "paddle-tpu",
-                 poll_interval_s: float = 0.05):
+                 poll_interval_s: float = 0.05,
+                 rate_limit: Optional[float] = None,
+                 rate_limit_burst: Optional[float] = None,
+                 rate_limit_max_clients: int = 4096):
         self.router = router
         self.model_name = model_name
         self.poll_interval_s = float(poll_interval_s)
+        # per-client token buckets (None = unlimited): keyed by API key
+        # (Authorization header) falling back to the remote address
+        self.rate_limiter = (
+            None if rate_limit is None else
+            RateLimiter(rate_limit, rate_limit_burst,
+                        max_clients=rate_limit_max_clients))
         self._accepting = True
         self._serve_thread: Optional[threading.Thread] = None
         super().__init__((host, port), _Handler)
@@ -129,6 +152,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        # explicit keep-alive: Content-Length bounds the body, so the
+        # client may reuse this socket for its next completion (SSE
+        # streams are the only close-per-request path)
+        self.send_header("Connection", "keep-alive")
         for k, v in headers:
             self.send_header(k, v)
         self.end_headers()
@@ -170,6 +197,11 @@ class _Handler(BaseHTTPRequestHandler):
                 "replicas_total": len(stats["replicas"]),
                 "router_retries_total": stats["retries_total"],
             }
+            if self.server.rate_limiter is not None:
+                extra["rate_limited_total"] = \
+                    self.server.rate_limiter.rejected_total
+                extra["rate_limit_clients"] = \
+                    self.server.rate_limiter.clients
             text = prometheus_render(router.metrics_snapshots(),
                                      extra_gauges=extra)
             body = text.encode("utf-8")
@@ -194,6 +226,18 @@ class _Handler(BaseHTTPRequestHandler):
         except ProtocolError as e:
             self._send_error_json(e.status, str(e), e.err_type)
             return
+        limiter = self.server.rate_limiter
+        if limiter is not None:
+            key = (self.headers.get("Authorization")
+                   or f"addr:{self.client_address[0]}")
+            try:
+                limiter.check(key)
+            except RateLimited as e:
+                retry_after = max(1, math.ceil(e.retry_after_s))
+                self._send_error_json(
+                    429, str(e), "rate_limit_exceeded",
+                    headers=[("Retry-After", str(retry_after))])
+                return
         if not self.server.accepting:
             self._send_error_json(503, "server is draining",
                                   "service_unavailable")

@@ -135,7 +135,13 @@ class ServingMetrics:
         # resident decode by one chunk forward)
         self.pool_pages_used = 0
         self.pool_pages_total = 0
+        self.pool_pages_cached = 0
         self.prefill_stall = 0
+        # prefix-cache mirror (source of truth: RadixPrefixCache; the
+        # engine pushes a stats() snapshot every step so scrapes never
+        # touch the cache's tree): lookups/hits/cached-token counters,
+        # eviction + COW totals, resident-page gauge. None = cache off.
+        self.prefix: Optional[dict] = None
         # which paged decode attention implementation the engine runs
         # ("kernel" | "gather"); set by the engine at construction so
         # benches/dashboards can attribute latency to the impl
@@ -152,6 +158,9 @@ class ServingMetrics:
         self.occupancy_hist = Histogram()
         self.pool_utilization_hist = Histogram()
         self.prefill_stall_hist = Histogram()
+        # per-admission prefix-cache hit size (tokens served from
+        # shared pages; 0 on a cold miss)
+        self.prefix_cached_tokens_hist = Histogram()
         # busy window for throughput
         self._first_admit_t: Optional[float] = None
         self._last_token_t: Optional[float] = None
@@ -166,6 +175,8 @@ class ServingMetrics:
             self.requests_admitted += 1
             self.prefills += 1
             self.prompt_tokens += int(req.prompt_ids.size)
+            self.prefix_cached_tokens_hist.record(
+                getattr(req, "cached_tokens", 0))
             self.queue_wait_s.record(now - req.arrival_t)
             if self._first_admit_t is None:
                 self._first_admit_t = now
@@ -204,7 +215,8 @@ class ServingMetrics:
 
     def on_step(self, queue_depth: int, occupancy: float, num_slots: int,
                 pages_used: int = 0, pages_total: int = 0,
-                stall_chunks: int = 0):
+                stall_chunks: int = 0, pages_cached: int = 0,
+                prefix_stats: Optional[dict] = None):
         with self._lock:
             self.decode_steps += 1
             self.queue_depth = queue_depth
@@ -214,6 +226,9 @@ class ServingMetrics:
             self.occupancy_hist.record(occupancy)
             self.pool_pages_used = pages_used
             self.pool_pages_total = pages_total
+            self.pool_pages_cached = pages_cached
+            if prefix_stats is not None:
+                self.prefix = dict(prefix_stats)
             self.prefill_stall = stall_chunks
             if pages_total:
                 self.pool_utilization_hist.record(pages_used / pages_total)
@@ -257,8 +272,14 @@ class ServingMetrics:
             "pool": {
                 "pages_used": self.pool_pages_used,
                 "pages_total": self.pool_pages_total,
+                "pages_cached": self.pool_pages_cached,
                 "utilization": self.pool_utilization_hist.snapshot(),
             },
+            "prefix": (None if self.prefix is None else {
+                **self.prefix,
+                "cached_tokens_per_request":
+                    self.prefix_cached_tokens_hist.snapshot(),
+            }),
             "prefill_stall": self.prefill_stall,
             "prefill_stall_hist": self.prefill_stall_hist.snapshot(),
             "ttft_s": self.ttft_s.snapshot(),
@@ -303,6 +324,14 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                        ("slot_occupancy", "gauge"),
                        ("pool_pages_free", "gauge"),
                        ("pool_pages_total", "gauge"),
+                       ("pool_pages_cached", "gauge"),
+                       ("prefix_lookups_total", "counter"),
+                       ("prefix_hits_total", "counter"),
+                       ("prefix_cached_tokens_total", "counter"),
+                       ("prefix_evicted_pages_total", "counter"),
+                       ("prefix_cow_copies_total", "counter"),
+                       ("prefix_resident_pages", "gauge"),
+                       ("prefix_hit_rate", "gauge"),
                        ("ttft_seconds", "histogram"),
                        ("inter_token_seconds", "histogram")]:
         lines.append(f"# TYPE {namespace}_{name} {kind}")
@@ -320,11 +349,31 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
         lines.append(f"{namespace}_slot_occupancy" + _fmt_labels(lab)
                      + f" {snap['slot_occupancy']}")
         pool = snap["pool"]
-        free = pool["pages_total"] - pool["pages_used"]
+        free = (pool["pages_total"] - pool["pages_used"]
+                - pool.get("pages_cached", 0))
         lines.append(f"{namespace}_pool_pages_free" + _fmt_labels(lab)
                      + f" {free}")
         lines.append(f"{namespace}_pool_pages_total" + _fmt_labels(lab)
                      + f" {pool['pages_total']}")
+        lines.append(f"{namespace}_pool_pages_cached" + _fmt_labels(lab)
+                     + f" {pool.get('pages_cached', 0)}")
+        prefix = snap.get("prefix")
+        if prefix is not None:
+            for metric, key in [("prefix_lookups_total", "lookups"),
+                                ("prefix_hits_total", "hits"),
+                                ("prefix_cached_tokens_total",
+                                 "cached_tokens"),
+                                ("prefix_evicted_pages_total",
+                                 "evicted_pages"),
+                                ("prefix_cow_copies_total",
+                                 "cow_copies"),
+                                ("prefix_resident_pages",
+                                 "resident_pages")]:
+                lines.append(f"{namespace}_{metric}" + _fmt_labels(lab)
+                             + f" {prefix[key]}")
+            lines.append(f"{namespace}_prefix_hit_rate"
+                         + _fmt_labels(lab)
+                         + f" {prefix['hit_rate'] or 0.0}")
         _hist_lines(f"{namespace}_ttft_seconds", snap["ttft_s"], lab,
                     lines)
         _hist_lines(f"{namespace}_inter_token_seconds",

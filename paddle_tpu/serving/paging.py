@@ -11,10 +11,27 @@ Page 0 is reserved as the TRASH page: it is never handed out, free
 slots' page-table rows point every entry at it, and the device scatter
 redirects out-of-window writes into it — so membership changes never
 reshape or retrace the compiled programs.
+
+Pages are REFERENCE COUNTED so the prefix cache (serving/prefix.py) can
+share one physical page between any number of requests plus the radix
+tree. Every page is in exactly one of three states:
+
+- FREE      — on the free list, allocatable;
+- USED      — refcount >= 1: held by running request(s) and/or
+              protected mid-operation (COW source during the copy);
+- CACHED    — refcount == 0 but still resident: the page belongs to the
+              prefix cache's radix tree and nobody references it right
+              now. Cached pages are NOT allocatable; the cache evicts
+              (frees) them under page pressure.
+
+Invariants are enforced, not assumed: double free, freeing a page that
+is still shared (refcount > 1), retaining a free page, and parking a
+referenced page all raise. `assert_quiesced()` is the engine-shutdown
+leak check: after drain/abort every page must be FREE or CACHED.
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Iterable, List, Optional
 
 __all__ = ["PagePool", "TRASH_PAGE", "pages_needed", "chunk_bucket"]
 
@@ -22,11 +39,14 @@ TRASH_PAGE = 0      # reserved: never allocated, absorbs masked writes
 
 
 class PagePool:
-    """Free-list allocator over page ids 1..num_pages-1 (0 is trash).
+    """Refcounted free-list allocator over page ids 1..num_pages-1
+    (0 is trash).
 
     Allocation is all-or-nothing per request: the scheduler admits a
     request only when its whole page budget is free, so a half-admitted
-    request can never wedge the pool.
+    request can never wedge the pool. `retain`/`release` move shared
+    pages' refcounts for the prefix cache; `park` turns an unreferenced
+    page into cache-resident state instead of freeing it.
     """
 
     def __init__(self, num_pages: int):
@@ -37,32 +57,142 @@ class PagePool:
         # LIFO free list: recently freed pages are reused first, which
         # keeps the hot working set of pages small
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._free_set = set(self._free)
+        self._ref = [0] * self.num_pages
+        self._is_cached = [False] * self.num_pages
+        self._n_cached = 0
 
+    # -- introspection -----------------------------------------------------
     @property
     def free_pages(self) -> int:
         return len(self._free)
 
     @property
-    def used_pages(self) -> int:
-        return (self.num_pages - 1) - len(self._free)
+    def cached_pages(self) -> int:
+        """Unreferenced-but-resident pages parked by the prefix cache."""
+        return self._n_cached
 
+    @property
+    def used_pages(self) -> int:
+        """Pages referenced by at least one live request."""
+        return (self.num_pages - 1) - len(self._free) - self._n_cached
+
+    def refcount(self, page: int) -> int:
+        self._check_range(page)
+        return self._ref[page]
+
+    def is_cached(self, page: int) -> bool:
+        self._check_range(page)
+        return self._is_cached[page]
+
+    def _check_range(self, p: int):
+        if not (0 < p < self.num_pages):
+            raise ValueError(f"page id {p} out of range")
+
+    # -- allocation --------------------------------------------------------
     def alloc(self, n: int) -> Optional[List[int]]:
-        """n pages, or None (without side effects) if not enough free."""
+        """n pages at refcount 1, or None (without side effects) if not
+        enough free."""
         if n < 0:
             raise ValueError("n must be >= 0")
         if n > len(self._free):
             return None
         taken = self._free[-n:] if n else []
         del self._free[len(self._free) - n:]
+        for p in taken:
+            self._free_set.discard(p)
+            self._ref[p] = 1
         return taken
 
-    def free(self, pages: List[int]):
+    # -- sharing (prefix cache) --------------------------------------------
+    def retain(self, pages: Iterable[int]):
+        """refcount++ on resident pages. A CACHED page leaves the
+        cache-resident state (it is referenced again); a FREE page
+        cannot be retained — that is a use-after-free."""
+        pages = list(pages)
         for p in pages:
-            if not (0 < p < self.num_pages):
-                raise ValueError(f"page id {p} out of range")
-            if p in self._free:
+            self._check_range(p)
+            if p in self._free_set:
+                raise ValueError(f"retain of free page {p} "
+                                 "(use-after-free)")
+        for p in pages:
+            if self._is_cached[p]:
+                self._is_cached[p] = False
+                self._n_cached -= 1
+            self._ref[p] += 1
+
+    def release(self, pages: Iterable[int]) -> List[int]:
+        """refcount-- on each page; returns the pages that dropped to
+        zero. The caller (the prefix cache) decides their fate: `park`
+        the tree-resident ones, `free` the rest."""
+        pages = list(pages)
+        for p in pages:
+            self._check_range(p)
+            if p in self._free_set or self._ref[p] < 1:
+                raise ValueError(f"release of unreferenced page {p}")
+        zeroed = []
+        for p in pages:
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                zeroed.append(p)
+        return zeroed
+
+    def park(self, pages: Iterable[int]):
+        """Mark unreferenced pages cache-resident (the prefix cache's
+        LRU pool) instead of freeing them."""
+        pages = list(pages)
+        for p in pages:
+            self._check_range(p)
+            if p in self._free_set:
+                raise ValueError(f"park of free page {p}")
+            if self._ref[p] != 0:
+                raise ValueError(f"park of referenced page {p} "
+                                 f"(refcount {self._ref[p]})")
+            if self._is_cached[p]:
+                raise ValueError(f"page {p} already cache-resident")
+        for p in pages:
+            self._is_cached[p] = True
+            self._n_cached += 1
+
+    # -- freeing -----------------------------------------------------------
+    def free(self, pages: Iterable[int]):
+        """Return pages to the free list. Raises on double free and on
+        freeing a page some OTHER holder still references (refcount
+        > 1): a shared page must be `release`d, never freed through."""
+        pages = list(pages)
+        for p in pages:
+            self._check_range(p)
+            if p in self._free_set:
                 raise ValueError(f"double free of page {p}")
-        self._free.extend(pages)
+            if self._ref[p] > 1:
+                raise ValueError(
+                    f"free of page {p} still referenced "
+                    f"(refcount {self._ref[p]}); release shared pages "
+                    "instead of freeing through them")
+        for p in pages:
+            if self._is_cached[p]:
+                self._is_cached[p] = False
+                self._n_cached -= 1
+            self._ref[p] = 0
+            self._free.append(p)
+            self._free_set.add(p)
+
+    # -- invariants --------------------------------------------------------
+    def assert_quiesced(self):
+        """Engine-shutdown leak check: every page FREE or CACHED (no
+        request reference survived retirement), and the accounting
+        closes: free + cached == allocatable pool size."""
+        leaked = [p for p in range(1, self.num_pages) if self._ref[p] > 0]
+        if leaked:
+            raise RuntimeError(
+                f"page leak: pages {leaked} still referenced after "
+                "shutdown (refcounts "
+                f"{[self._ref[p] for p in leaked]})")
+        if len(self._free) + self._n_cached != self.num_pages - 1:
+            raise RuntimeError(
+                f"page accounting broken: free {len(self._free)} + "
+                f"cached {self._n_cached} != pool size "
+                f"{self.num_pages - 1}")
 
 
 def pages_needed(prompt_len: int, max_new_tokens: int,

@@ -1,0 +1,419 @@
+"""Automatic prefix cache: a token-id radix tree over the paged KV pool.
+
+Production traffic is dominated by shared prefixes — system prompts,
+few-shot templates, multi-turn conversations re-sending their history.
+The paged KV pool (serving/paging.py) stores KV at page granularity
+precisely so those prefixes can be SHARED: the KV vector written for
+position p is a deterministic function of tokens[0..p], so any two
+requests whose token ids agree on [0, L) can point their page tables at
+the same physical pages for those positions and skip prefilling them.
+
+Structure
+---------
+A radix tree over page-aligned token spans. Each node's edge covers
+exactly one FULL page: `page_size` consecutive token ids mapped to one
+page id in the shared per-layer pools; a path root->node spells a
+page-aligned token prefix and the list of page ids holding its KV.
+Children are keyed by the next page's token ids (exact-match dict hop),
+so a lookup costs O(prompt_len / page_size) dict probes. Divergence
+inside a page is NOT shared at page granularity — two prompts that
+split mid-page get separate pages — which is what keeps sharing free of
+partial-page aliasing.
+
+Leaves may additionally carry PARTIAL pages: a page whose first
+`len(tokens) < page_size` positions are valid (the tail of a finished
+request). A new prompt that matches into a partial page (or into the
+head of a full page) cannot attach it directly — the request will keep
+writing KV into that page's remaining positions — so the match is
+granted COPY-ON-WRITE: the engine allocates a fresh page, performs one
+single-page device copy, and the page table points at the private copy.
+A shared page is never written through.
+
+Lifecycle
+---------
+- `acquire(prompt, max_new)` — admission: longest-prefix match, then
+  refcount++ the matched full pages (zero prefill work, zero copies),
+  allocate the fresh tail (evicting LRU unreferenced leaves first under
+  page pressure), and return a `PrefixGrant` with the page-table order
+  and the number of cached tokens. Refusal (even after eviction) has no
+  side effects — admission backpressure degrades to exactly the
+  cache-off behavior.
+- `insert(tokens, pages, valid)` — retirement of a normally finished
+  request: its full pages become tree nodes (the partial tail page a
+  partial leaf) so multi-turn follow-ups hit; pages already in the tree
+  are deduplicated (the request's duplicate copy is freed). All of the
+  request's references are dropped; pages that hit refcount 0 are
+  PARKED as cache-resident rather than freed.
+- `release(pages)` — retirement of cancelled/aborted/timed-out
+  requests: refcount--; tree pages park, private pages free.
+- `evict(need)` — leaf-to-root LRU: only unreferenced leaves (and
+  partial pages) are freed, oldest last-use first; a node referenced by
+  any running request is never touched. Eviction happens inside
+  `acquire` before admission backpressure, so a cold or thrashing cache
+  behaves exactly like no cache at all.
+
+The compiled decode/prefill programs never see any of this: hits, COW
+and eviction only change which page ids the host page tables carry.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .paging import PagePool, pages_needed
+
+__all__ = ["RadixPrefixCache", "PrefixGrant", "resolve_prefix_cache_flag"]
+
+
+def resolve_prefix_cache_flag(override=None) -> bool:
+    """Whether the engine runs the automatic prefix cache: an explicit
+    `ServingEngine(prefix_cache=...)` wins; otherwise the
+    PADDLE_TPU_PREFIX_CACHE env var (default on)."""
+    import os
+    if override is not None:
+        if isinstance(override, bool):
+            return override
+        flag = str(override)
+    else:
+        flag = os.environ.get("PADDLE_TPU_PREFIX_CACHE", "on")
+    low = flag.strip().lower()
+    if low in ("on", "1", "true", "yes"):
+        return True
+    if low in ("off", "0", "false", "no"):
+        return False
+    raise ValueError(
+        "PADDLE_TPU_PREFIX_CACHE / prefix_cache must be on|off, "
+        f"got {flag!r}")
+
+
+class _Node:
+    """One radix edge: a full page of `page_size` token ids."""
+
+    __slots__ = ("tokens", "page", "parent", "children", "partials",
+                 "last_used")
+
+    def __init__(self, tokens: Optional[np.ndarray], page: Optional[int],
+                 parent: Optional["_Node"]):
+        self.tokens = tokens          # int64 [page_size]; None at root
+        self.page = page              # pool page id; None at root
+        self.parent = parent
+        self.children: Dict[bytes, "_Node"] = {}
+        self.partials: List["_Partial"] = []
+        self.last_used = 0
+
+
+class _Partial:
+    """A leaf-only partially filled page: positions [0, len(tokens))
+    of `page` hold valid KV for `tokens` (< page_size of them)."""
+
+    __slots__ = ("tokens", "page", "last_used")
+
+    def __init__(self, tokens: np.ndarray, page: int):
+        self.tokens = tokens
+        self.page = page
+        self.last_used = 0
+
+
+@dataclass
+class PrefixGrant:
+    """Everything the engine needs to admit a cache-hit request:
+    `pages` in page-table order (shared fulls, then the COW copy if
+    any, then fresh tail pages), the prefill cursor start
+    (`cached_len`), and the pending single-page COW copy. `cow_src`
+    stays refcount-protected until the engine reports the copy done
+    via `RadixPrefixCache.cow_done`."""
+
+    pages: List[int]
+    cached_len: int
+    cow_src: Optional[int] = None
+    cow_dst: Optional[int] = None
+    matched_full_pages: int = 0
+    fresh_pages: List[int] = field(default_factory=list)
+
+
+def _tok(seq) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(seq).reshape(-1),
+                                dtype=np.int64)
+
+
+def _common_prefix(a: np.ndarray, b: np.ndarray) -> int:
+    n = min(a.size, b.size)
+    if n == 0:
+        return 0
+    neq = np.nonzero(a[:n] != b[:n])[0]
+    return int(neq[0]) if neq.size else n
+
+
+class RadixPrefixCache:
+    """Radix-tree prefix cache over one engine's `PagePool`.
+
+    Single-threaded by construction, like everything else that touches
+    page tables: the engine calls it only between compiled steps.
+    """
+
+    def __init__(self, pool: PagePool, page_size: int):
+        self.pool = pool
+        self.page_size = int(page_size)
+        self.root = _Node(None, None, None)
+        # page id -> owning _Node/_Partial, for release() routing and
+        # O(1) "is this page tree-resident"
+        self._owner: Dict[int, object] = {}
+        self._tick = itertools.count(1)
+        # counters (mirrored into ServingMetrics at step boundaries)
+        self.lookups = 0
+        self.hits = 0
+        self.cached_tokens_total = 0
+        self.evicted_pages_total = 0
+        self.cow_copies_total = 0
+        self.inserted_pages_total = 0
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def tree_pages(self) -> int:
+        """Pages the radix tree currently indexes (referenced or
+        cache-resident)."""
+        return len(self._owner)
+
+    def stats(self) -> dict:
+        return {
+            "lookups": self.lookups,
+            "hits": self.hits,
+            "cached_tokens": self.cached_tokens_total,
+            "evicted_pages": self.evicted_pages_total,
+            "cow_copies": self.cow_copies_total,
+            "inserted_pages": self.inserted_pages_total,
+            "tree_pages": self.tree_pages,
+            "resident_pages": self.pool.cached_pages,
+            "hit_rate": (self.hits / self.lookups) if self.lookups
+            else None,
+        }
+
+    def _touch(self, obj):
+        obj.last_used = next(self._tick)
+
+    # -- matching ----------------------------------------------------------
+    def _match_full(self, tok: np.ndarray, limit: int
+                    ) -> Tuple[_Node, List[int], int]:
+        """Walk full-page edges: returns (last node, matched page ids,
+        matched token count). Only whole pages match here; `limit`
+        caps the match so at least one prompt token always prefills
+        (the sampler needs the last token's logits)."""
+        ps = self.page_size
+        node, pages, depth = self.root, [], 0
+        while depth + ps <= limit:
+            child = node.children.get(tok[depth:depth + ps].tobytes())
+            if child is None:
+                break
+            node = child
+            pages.append(child.page)
+            depth += ps
+            self._touch(child)
+        return node, pages, depth
+
+    def _best_tail(self, node: _Node, tail: np.ndarray
+                   ) -> Tuple[int, Optional[int]]:
+        """Best copy-on-write candidate below `node` for the remaining
+        (sub-page) prompt tokens: a partial leaf or the head of a full
+        child page sharing the longest prefix with `tail`. Returns
+        (matched token count, source page id)."""
+        best_k, best_page, best_obj = 0, None, None
+        for part in node.partials:
+            k = _common_prefix(tail, part.tokens)
+            if k > best_k:
+                best_k, best_page, best_obj = k, part.page, part
+        for child in node.children.values():
+            k = _common_prefix(tail, child.tokens)
+            if k > best_k:
+                best_k, best_page, best_obj = k, child.page, child
+        if best_obj is not None:
+            self._touch(best_obj)
+        return best_k, best_page
+
+    def lookup(self, prompt) -> int:
+        """Side-effect-free probe: how many tokens of `prompt` the
+        cache could serve right now (full pages + best COW tail)."""
+        tok = _tok(prompt)
+        limit = max(0, tok.size - 1)
+        node, _, depth = self._match_full(tok, limit)
+        k, _ = self._best_tail(node, tok[depth:limit])
+        return depth + k
+
+    # -- admission ---------------------------------------------------------
+    def acquire(self, prompt, max_new_tokens: int
+                ) -> Optional[PrefixGrant]:
+        """Longest-prefix match + page reservation for one request.
+        On success every page in the grant holds one reference for the
+        request (shared pages refcount++, fresh pages refcount 1, the
+        COW source an extra protection ref until `cow_done`). On
+        refusal — only when even evicting every unreferenced cached
+        page cannot cover the fresh tail — nothing changed."""
+        ps = self.page_size
+        tok = _tok(prompt)
+        plen = tok.size
+        self.lookups += 1
+        limit = plen - 1        # >= 1 token must prefill for logits
+        node, shared, depth = self._match_full(tok, limit)
+        cow_k, cow_src = self._best_tail(node, tok[depth:limit])
+        total = pages_needed(plen, max_new_tokens, ps)
+        need_fresh = total - len(shared)
+        # protect the match from the eviction below (and from evictions
+        # by admissions later in this same step boundary)
+        self.pool.retain(shared)
+        if cow_src is not None:
+            self.pool.retain([cow_src])
+        fresh = self.pool.alloc(need_fresh)
+        if fresh is None:
+            self.evict(need_fresh - self.pool.free_pages)
+            fresh = self.pool.alloc(need_fresh)
+        if fresh is None:
+            # roll back: the match returns to exactly its prior state
+            self.release(shared)
+            if cow_src is not None:
+                self.release([cow_src])
+            return None
+        cached = depth + cow_k
+        if cached:
+            self.hits += 1
+            self.cached_tokens_total += cached
+        grant = PrefixGrant(
+            pages=shared + fresh, cached_len=cached,
+            matched_full_pages=len(shared), fresh_pages=fresh)
+        if cow_src is not None:
+            self.cow_copies_total += 1
+            grant.cow_src = cow_src
+            # the fresh page covering page index len(shared) — the one
+            # the table points at for the partially-cached span
+            grant.cow_dst = fresh[0]
+        return grant
+
+    def cow_done(self, grant: PrefixGrant):
+        """The engine finished the single-page device copy: drop the
+        COW source's protection reference."""
+        if grant.cow_src is not None:
+            self.release([grant.cow_src])
+            grant.cow_src = None
+
+    # -- retirement --------------------------------------------------------
+    def release(self, pages: List[int]):
+        """Drop one reference per page; pages that hit refcount 0 park
+        (tree-resident) or free (private)."""
+        zeroed = self.pool.release(pages)
+        park = [p for p in zeroed if p in self._owner]
+        if park:
+            self.pool.park(park)
+        gone = [p for p in zeroed if p not in self._owner]
+        if gone:
+            self.pool.free(gone)
+
+    def insert(self, tokens, pages: List[int], valid: int):
+        """Index a finished request's written pages so future prompts
+        hit. `tokens` is its prompt + generated ids, `valid` how many
+        positions actually hold KV (prompt_len + emitted tokens);
+        trailing unconsumed budget pages are simply freed. Duplicates
+        (another request cached the same span first) are freed, the
+        tree keeps its original. Finally drops ALL of the request's
+        page references."""
+        ps = self.page_size
+        tok = _tok(tokens)
+        valid = int(valid)
+        if valid > tok.size or valid > len(pages) * ps:
+            raise ValueError(
+                f"valid={valid} exceeds tokens ({tok.size}) or page "
+                f"capacity ({len(pages) * ps})")
+        node = self.root
+        n_full = valid // ps
+        for i in range(n_full):
+            span = tok[i * ps:(i + 1) * ps]
+            key = span.tobytes()
+            child = node.children.get(key)
+            if child is None:
+                page = pages[i]
+                child = _Node(np.array(span), page, node)
+                node.children[key] = child
+                self._owner[page] = child
+                self.inserted_pages_total += 1
+            node = child
+            self._touch(node)
+        rem = valid - n_full * ps
+        if rem > 0:
+            ptoks = np.array(tok[n_full * ps:valid])
+            page = pages[n_full]
+            if page not in self._owner and self._tail_is_new(node, ptoks):
+                part = _Partial(ptoks, page)
+                node.partials.append(part)
+                self._owner[page] = part
+                self.inserted_pages_total += 1
+                self._touch(part)
+        self.release(pages)
+
+    def _tail_is_new(self, node: _Node, ptoks: np.ndarray) -> bool:
+        """A partial tail is worth keeping only if no resident page
+        already covers it (an equal-or-longer partial, or a full child
+        whose head matches)."""
+        for part in node.partials:
+            if part.tokens.size >= ptoks.size and \
+                    _common_prefix(part.tokens, ptoks) == ptoks.size:
+                return False
+        for child in node.children.values():
+            if _common_prefix(child.tokens, ptoks) == ptoks.size:
+                return False
+        return True
+
+    # -- eviction ----------------------------------------------------------
+    def _evictable(self, obj) -> bool:
+        if isinstance(obj, _Partial):
+            return self.pool.refcount(obj.page) == 0
+        return (not obj.children and not obj.partials
+                and self.pool.refcount(obj.page) == 0)
+
+    def evict(self, need: int) -> int:
+        """Free at least `need` unreferenced cached pages, LRU leaves
+        first, walking leaf-to-root as parents become childless. Pages
+        referenced by running requests are never touched. Returns the
+        number of pages actually freed."""
+        if need <= 0:
+            return 0
+        # seed the heap with every current leaf candidate
+        heap = []
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            for part in node.partials:
+                heapq.heappush(heap, (part.last_used, id(part), part,
+                                      node))
+            if node is not self.root and self._evictable(node):
+                heapq.heappush(heap, (node.last_used, id(node), node,
+                                      node.parent))
+        freed = 0
+        while freed < need and heap:
+            _, _, obj, parent = heapq.heappop(heap)
+            if isinstance(obj, _Partial):
+                if obj not in parent.partials or \
+                        self.pool.refcount(obj.page) != 0:
+                    continue
+                parent.partials.remove(obj)
+            else:
+                if obj.parent is None or not self._evictable(obj) or \
+                        parent.children.get(obj.tokens.tobytes()) is not obj:
+                    continue
+                del parent.children[obj.tokens.tobytes()]
+                obj.parent = None
+            del self._owner[obj.page]
+            self.pool.free([obj.page])
+            self.evicted_pages_total += 1
+            freed += 1
+            # the parent may have just become an evictable leaf
+            if parent is not self.root and self._evictable(parent):
+                heapq.heappush(heap, (parent.last_used, id(parent),
+                                      parent, parent.parent))
+        return freed
+
+    def clear(self) -> int:
+        """Drop every unreferenced cached page (e.g. tests forcing a
+        cold cache). Referenced nodes survive."""
+        return self.evict(self.tree_pages)

@@ -90,6 +90,11 @@ class Request:
         self.slot: Optional[int] = None
         # KV pages granted at admission (paged pool); None while queued
         self.pages: Optional[List[int]] = None
+        # prefix-cache hit: how many prompt tokens were served from
+        # shared cached pages (0 = cold miss or cache off); the grant
+        # handle lives here between reserve and retirement
+        self.cached_tokens: int = 0
+        self._prefix_grant = None
         # timeline (engine clock): arrival -> admitted (slot granted,
         # prefill) -> first token -> finished
         self.arrival_t = time.monotonic() if arrival_t is None else arrival_t
@@ -161,6 +166,7 @@ class Request:
             prompt_token_ids=self.prompt_ids.tolist(),
             token_ids=list(self.output_tokens),
             finish_reason=self.finish_reason,
+            cached_tokens=self.cached_tokens,
             ttft_s=(None if self.first_token_t is None
                     else self.first_token_t - self.arrival_t),
             queue_wait_s=(None if self.admitted_t is None
@@ -182,6 +188,9 @@ class RequestOutput:
     prompt_token_ids: List[int]
     token_ids: List[int]
     finish_reason: Optional[str]
+    # prompt tokens served from the prefix cache (OpenAI-style
+    # usage.cached_tokens in the HTTP layer)
+    cached_tokens: int = 0
     ttft_s: Optional[float] = None
     queue_wait_s: Optional[float] = None
     e2e_s: Optional[float] = None

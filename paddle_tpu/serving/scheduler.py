@@ -11,9 +11,15 @@ Policy: plain FIFO fairness by arrival order. A freed slot is refilled
 by the longest-waiting queued request at the next step boundary —
 subject to the engine's resource check (`assign(reserve=...)`): with a
 paged KV pool a free slot alone is not admission, the request's whole
-page budget must be free too. Backpressure is head-of-line: when the
-oldest queued request's pages don't fit, nothing behind it is admitted
-either, so a large request can't be starved by a stream of small ones.
+page budget must be free too. With the prefix cache the reserve
+callback is MATCH-THEN-RESERVE: it longest-prefix-matches the prompt
+against the radix tree (shared pages need no fresh allocation) and
+evicts LRU unreferenced cached pages before refusing — so head-of-line
+backpressure only engages once genuinely referenced pages exhaust the
+pool, and a cold cache degrades to exactly the cache-off admission
+order. Backpressure stays head-of-line: when the oldest queued
+request's pages don't fit, nothing behind it is admitted either, so a
+large request can't be starved by a stream of small ones.
 """
 from __future__ import annotations
 
@@ -80,6 +86,11 @@ class Scheduler:
         steps."""
         grants = []
         for slot in self.free_slots():
+            while self._queue and \
+                    self._queue[0].state is RequestState.CANCELLED:
+                # cancel raced admission (marked between the boundary's
+                # evict pass and this assign): never grant it resources
+                self._queue.popleft()
             if not self._queue:
                 break
             req = self._queue[0]

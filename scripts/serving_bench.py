@@ -11,18 +11,28 @@ in the bench trajectory. Prints ONE JSON line and writes the same
 stable-schema report to BENCH_serving.json (override with --out,
 suppress with --out -):
 
-    {"bench": "serving", "schema_version": 3, "attn_impl": "kernel",
+    {"bench": "serving", "schema_version": 4, "attn_impl": "kernel",
      "requests": ..., "ttft_p50_s": ..., "tokens_per_sec": ...,
      "decode_step_ms_p50": ..., "ab": {"kernel": {...},
-     "gather": {...}}, ...}
+     "gather": {...}}, "prefix_stats": {...}, ...}
 
 Top-level numbers are the default ("kernel") run; "ab" holds the
 per-impl summaries (tokens/s, TTFT, per-step decode wall time).
+
+`--prefix-share P` builds a shared-prefix trace instead of fully
+random prompts: fraction P of the requests prepend one of K
+(`--prefix-prompts`) fixed "system prompts" to their unique tail —
+the traffic shape the automatic prefix cache (serving/prefix.py)
+exists for. The SAME trace then runs once with the cache ON and once
+OFF, and the report's "prefix" section records TTFT and
+prefill-steps-per-request for both (plus hit rate / cached tokens),
+so the cache's win is a number in the trajectory, not a claim.
 
 Usage:
     python scripts/serving_bench.py            # platform-sized run
     python scripts/serving_bench.py --smoke    # seconds-fast CI run
     python scripts/serving_bench.py --requests 64 --rate 50 --slots 8
+    python scripts/serving_bench.py --prefix-share 0.8 --smoke
     python scripts/serving_bench.py --http --replicas 2   # + loopback
         # HTTP trace through serving/http (mixed SSE / non-stream
         # clients): client-observed TTFT p50/p99 and tokens/s land
@@ -86,6 +96,12 @@ def main():
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--smoke", action="store_true",
                     help="tiny fast run (CI)")
+    ap.add_argument("--prefix-share", type=float, default=0.0,
+                    help="fraction of requests that share one of K "
+                    "system prompts; > 0 adds a prefix-cache on/off "
+                    "A/B over the same trace to the report")
+    ap.add_argument("--prefix-prompts", type=int, default=4,
+                    help="K: number of distinct shared system prompts")
     ap.add_argument("--http", action="store_true",
                     help="also drive the serving/http front-end over "
                     "loopback with the same Poisson trace")
@@ -106,9 +122,10 @@ def main():
         n_req = args.requests or 6
         rate = args.rate or 200.0
         max_new = args.max_new or 6
-        max_len = args.max_len or 48
+        max_len = args.max_len or 64
         chunk = args.chunk or 16
         prompt_lens = [3, 5, 8]
+        prefix_len = 24
     elif on_tpu:
         n_req = args.requests or 128
         rate = args.rate or 32.0
@@ -116,6 +133,7 @@ def main():
         max_len = args.max_len or 1024
         chunk = args.chunk or 128
         prompt_lens = [32, 64, 128, 256]
+        prefix_len = 256
     else:
         n_req = args.requests or 24
         rate = args.rate or 100.0
@@ -123,13 +141,25 @@ def main():
         max_len = args.max_len or 128
         chunk = args.chunk or 32
         prompt_lens = [4, 8, 12, 16]
+        prefix_len = 40
 
     rng = np.random.RandomState(args.seed)
     gaps = rng.exponential(1.0 / rate, size=n_req)
     arrivals = np.cumsum(gaps)               # seconds from t0
-    prompts = [rng.randint(0, cfg.vocab_size,
+    share = float(args.prefix_share)
+    if not (0.0 <= share <= 1.0):
+        raise SystemExit("--prefix-share must be in [0, 1]")
+    sys_prompts = [rng.randint(0, cfg.vocab_size,
+                               size=prefix_len).astype(np.int64)
+                   for _ in range(max(1, args.prefix_prompts))]
+    prompts = []
+    for _ in range(n_req):
+        tail = rng.randint(0, cfg.vocab_size,
                            size=rng.choice(prompt_lens)).astype(np.int64)
-               for _ in range(n_req)]
+        if share > 0.0 and rng.random_sample() < share:
+            tail = np.concatenate(
+                [sys_prompts[rng.randint(len(sys_prompts))], tail])
+        prompts.append(tail)
     budgets = rng.randint(max(1, max_new // 2), max_new + 1, size=n_req)
 
     # the A/B: the SAME trace (arrivals, prompts, budgets) once per
@@ -140,6 +170,18 @@ def main():
             model, arrivals, prompts, budgets, slots=args.slots,
             max_len=max_len, page_size=args.page_size, pages=args.pages,
             chunk=chunk, attn_impl=attn_impl)
+
+    # the prefix-cache A/B: the SAME shared-prefix trace with the
+    # radix cache on vs off (cache pre-warmed with the K system
+    # prompts — steady-state behavior, not cold-start compile noise)
+    prefix_runs = {}
+    if share > 0.0:
+        for flag in (True, False):
+            prefix_runs["on" if flag else "off"] = run_trace(
+                model, arrivals, prompts, budgets, slots=args.slots,
+                max_len=max_len, page_size=args.page_size,
+                pages=args.pages, chunk=chunk, attn_impl="kernel",
+                prefix_cache=flag, warm_prompts=sys_prompts)
 
     snap = runs["kernel"]["snap"]
     pool = snap["pool"]
@@ -160,9 +202,26 @@ def main():
             "completed": s["requests"]["completed"],
         }
 
+    def _prefix_summary(run):
+        s = run["snap"]
+        n = s["requests"]["completed"] or 1
+        pf = s.get("prefix") or {}
+        return {
+            "wall_s": round(run["wall_s"], 4),
+            "ttft_p50_s": s["ttft_s"]["p50"],
+            "ttft_p99_s": s["ttft_s"]["p99"],
+            "prefill_chunks": s["prefill_chunks"],
+            "prefill_chunks_per_request": s["prefill_chunks"] / n,
+            "hit_rate": pf.get("hit_rate"),
+            "cached_tokens": pf.get("cached_tokens", 0),
+            "evicted_pages": pf.get("evicted_pages", 0),
+            "cow_copies": pf.get("cow_copies", 0),
+            "completed": s["requests"]["completed"],
+        }
+
     report = {
         "bench": "serving",
-        "schema_version": 3,
+        "schema_version": 4,
         "platform": jax.devices()[0].platform,
         "attn_impl": "kernel",
         "requests": n_req,
@@ -189,7 +248,18 @@ def main():
         "decode_steps": snap["decode_steps"],
         "completed": snap["requests"]["completed"],
         "ab": {impl: _ab(run) for impl, run in runs.items()},
+        # hit-rate/cached-token trajectory of the default (cache-on)
+        # kernel run — nonzero only when the trace actually shares
+        "prefix_stats": snap.get("prefix"),
     }
+    if share > 0.0:
+        report["prefix"] = {
+            "share": share,
+            "system_prompts": len(sys_prompts),
+            "prefix_len": prefix_len,
+            **{flag: _prefix_summary(run)
+               for flag, run in prefix_runs.items()},
+        }
     if args.http:
         report["http"] = http_trace(
             model, cfg, n_req=n_req, rate=rate, max_new=max_new,
@@ -206,26 +276,45 @@ def main():
     for impl, run in runs.items():
         assert run["snap"]["requests"]["completed"] == n_req, \
             (impl, run["snap"]["requests"], n_req)
+    for flag, run in prefix_runs.items():
+        assert run["snap"]["requests"]["completed"] == n_req, \
+            (flag, run["snap"]["requests"], n_req)
+    if share > 0.0:
+        on, off = report["prefix"]["on"], report["prefix"]["off"]
+        # the acceptance number: a warm cache must do strictly less
+        # prefill work per request than no cache on a sharing trace
+        assert on["prefill_chunks_per_request"] < \
+            off["prefill_chunks_per_request"], report["prefix"]
+        assert on["hit_rate"] and on["hit_rate"] > 0, report["prefix"]
     if args.http:
         assert report["http"]["completed"] == n_req, report["http"]
 
 
 def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
-              page_size, pages, chunk, attn_impl):
+              page_size, pages, chunk, attn_impl, prefix_cache=None,
+              warm_prompts=()):
     """One Poisson-trace replay through a fresh engine pinned to
-    `attn_impl`; returns {snap, wall_s, engine-shape fields}."""
+    `attn_impl` (and, for the prefix A/B, to `prefix_cache` on/off);
+    returns {snap, wall_s, engine-shape fields}. `warm_prompts` run to
+    completion before the clock starts, so a prefix-cache run measures
+    the steady state (system prompts resident) rather than cold
+    compulsory misses."""
     from paddle_tpu.serving import SamplingParams, ServingEngine
 
     n_req = len(prompts)
     eng = ServingEngine(model, num_slots=slots, max_len=max_len,
                         page_size=page_size, num_pages=pages,
-                        chunk_len=chunk, attn_impl=attn_impl)
+                        chunk_len=chunk, attn_impl=attn_impl,
+                        prefix_cache=prefix_cache)
 
     # warm the compiled programs so the trace measures steady state, not
     # XLA compile time: one request per distinct prompt length (chunk
     # bucketing folds these into O(log chunk) prefill traces)
     for pl in sorted({p.size for p in prompts}):
         eng.add_request(np.arange(1, pl + 1, dtype=np.int64),
+                        SamplingParams(max_new_tokens=2))
+    for wp in warm_prompts:
+        eng.add_request(np.asarray(wp, dtype=np.int64),
                         SamplingParams(max_new_tokens=2))
     eng.run()
     eng.metrics.__init__()   # drop warmup from the report

@@ -404,7 +404,11 @@ class TestPagedPoolAndChunkedPrefill:
         eng.run()
         for r, w in zip(reqs, want):
             np.testing.assert_array_equal(np.asarray(r.output_tokens), w)
-        assert eng.pool.free_pages == 4          # everything returned
+        # accounting closes: nothing referenced — every page is free or
+        # parked in the prefix cache (finished requests stay resident)
+        assert eng.pool.used_pages == 0
+        assert eng.pool.free_pages + eng.pool.cached_pages == 4
+        assert eng.prefix_cache.evicted_pages_total > 0   # pool pressure
 
     def test_2x_residency_under_dense_equivalent_hbm_budget(self):
         """Acceptance: with page_size=16 and the SAME simulated HBM
@@ -505,7 +509,9 @@ class TestSchedulerEdgeCases:
         assert b.slot is not None           # b won the freed admission
         eng.run()
         assert b.finish_reason == "length"
-        assert eng.pool.free_pages == eng.num_pages - 1
+        assert eng.pool.used_pages == 0      # b's pages parked or free
+        assert eng.pool.free_pages + eng.pool.cached_pages \
+            == eng.num_pages - 1
 
     def test_page_backpressure_holds_queue_despite_free_slot(self):
         """A free SLOT is not admission: the queue head waits until its
@@ -577,8 +583,12 @@ class TestDrainAndAbort:
         assert queued.output_tokens == [] and queued.pages is None
         assert {o.request_id for o in outs} == {resident.request_id,
                                                queued.request_id}
-        # all pages back, nothing resident, engine closed for intake
-        assert eng.pool.free_pages == eng.num_pages - 1
+        # accounting closes (leak-checked inside drain), nothing
+        # resident, engine closed for intake; the finished resident's
+        # pages stay cache-resident for future prefix hits
+        assert eng.pool.used_pages == 0
+        assert eng.pool.free_pages + eng.pool.cached_pages \
+            == eng.num_pages - 1
         assert not eng.has_work and eng.closed
         with pytest.raises(EngineClosed):
             eng.add_request(p, SamplingParams(max_new_tokens=2))
@@ -641,12 +651,12 @@ def test_serving_bench_smoke_writes_stable_schema(tmp_path,
     with open(out) as f:
         report = json.load(f)
     assert report["bench"] == "serving"
-    assert report["schema_version"] == 3
+    assert report["schema_version"] == 4
     for key in ("tokens_per_sec", "ttft_p50_s", "ttft_p99_s",
                 "pool_utilization_mean", "pool_utilization_max",
                 "prefill_chunks", "page_size", "num_pages",
                 "chunk_len", "completed", "attn_impl",
-                "decode_step_ms_p50", "ab"):
+                "decode_step_ms_p50", "ab", "prefix_stats"):
         assert key in report, key
     assert report["completed"] == report["requests"] == 3
     assert report["tokens_per_sec"] > 0
@@ -658,6 +668,38 @@ def test_serving_bench_smoke_writes_stable_schema(tmp_path,
     for impl, run in report["ab"].items():
         assert run["completed"] == 3, impl
         assert run["decode_step_ms_p50"] > 0, impl
+    # prefix-cache counters ride in the default run's report
+    assert report["prefix_stats"]["lookups"] > 0
+    assert "hit_rate" in report["prefix_stats"]
+
+
+def test_serving_bench_prefix_share_smoke(tmp_path, monkeypatch):
+    """`serving_bench.py --smoke --prefix-share 0.8` (ISSUE
+    acceptance): the same shared-prefix trace with the cache on does
+    strictly fewer prefill chunks per request than with it off, and
+    hit-rate/cached-token numbers land in the report."""
+    import importlib.util
+    script = os.path.join(os.path.dirname(__file__), os.pardir,
+                          "scripts", "serving_bench.py")
+    spec = importlib.util.spec_from_file_location(
+        "serving_bench_prefix", script)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = str(tmp_path / "BENCH_serving.json")
+    monkeypatch.setattr(sys, "argv",
+                        ["serving_bench.py", "--smoke", "--requests",
+                         "6", "--prefix-share", "0.8", "--out", out])
+    mod.main()    # bench asserts on < off prefill chunks internally
+    with open(out) as f:
+        report = json.load(f)
+    sec = report["prefix"]
+    assert sec["share"] == 0.8
+    on, off = sec["on"], sec["off"]
+    assert on["completed"] == off["completed"] == 6
+    assert on["prefill_chunks_per_request"] \
+        < off["prefill_chunks_per_request"]
+    assert on["hit_rate"] > 0 and on["cached_tokens"] > 0
+    assert off["cached_tokens"] == 0
 
 
 @pytest.mark.slow

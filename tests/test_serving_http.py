@@ -119,12 +119,14 @@ def wait_until(pred, timeout=30.0, interval=0.005):
     return False
 
 
-def make_server(n_replicas=2, poll_interval_s=0.02, **engine_kw):
+def make_server(n_replicas=2, poll_interval_s=0.02, server_kw=None,
+                **engine_kw):
     model = tiny_gpt()
     kw = dict(num_slots=2, max_len=64)
     kw.update(engine_kw)
     engines = [ServingEngine(model, **kw) for _ in range(n_replicas)]
-    server = serve(engines, poll_interval_s=poll_interval_s)
+    server = serve(engines, poll_interval_s=poll_interval_s,
+                   **(server_kw or {}))
     return server, engines, server.server_address[:2]
 
 
@@ -244,8 +246,11 @@ class TestHTTPEndToEnd:
             assert sum(served) == len(prompts)
         finally:
             server.drain()
-        assert all(e.pool.free_pages == e.num_pages - 1
-                   for e in engines)
+        # drain leak-checks: nothing referenced; finished requests'
+        # pages stay resident in the prefix cache (not leaked)
+        assert all(e.pool.used_pages == 0 for e in engines)
+        assert all(e.pool.free_pages + e.pool.cached_pages
+                   == e.num_pages - 1 for e in engines)
 
     def test_full_queue_returns_429_with_retry_after(self):
         server, engines, addr = make_server(
@@ -272,7 +277,9 @@ class TestHTTPEndToEnd:
             server.drain()
         assert blocker.finish_reason == "length"    # drain finished it
         assert queued.finished
-        assert engines[0].pool.free_pages == engines[0].num_pages - 1
+        assert engines[0].pool.used_pages == 0
+        assert engines[0].pool.free_pages \
+            + engines[0].pool.cached_pages == engines[0].num_pages - 1
 
     def test_client_disconnect_mid_stream_cancels_and_frees(self):
         """Dropping an SSE reader cancels the request at the next step
@@ -324,7 +331,9 @@ class TestHTTPEndToEnd:
             assert neighbor.output_tokens == want_n
         finally:
             server.drain()
-        assert eng.pool.free_pages == eng.num_pages - 1
+        assert eng.pool.used_pages == 0
+        assert eng.pool.free_pages + eng.pool.cached_pages \
+            == eng.num_pages - 1
         assert len(eng.scheduler.running) == 0
 
     def test_replica_kill_retries_unstarted_on_survivor(self):
@@ -429,7 +438,9 @@ class TestHTTPEndToEnd:
         eng = engines[0]
         assert len(eng.scheduler.running) == 0
         assert eng.scheduler.queue_depth == 0
-        assert eng.pool.free_pages == eng.num_pages - 1
+        assert eng.pool.used_pages == 0
+        assert eng.pool.free_pages + eng.pool.cached_pages \
+            == eng.num_pages - 1
 
     def test_metrics_endpoint_serves_prometheus_text(self):
         server, engines, addr = make_server(n_replicas=2)
@@ -447,6 +458,154 @@ class TestHTTPEndToEnd:
             assert "paddle_serving_pool_pages_free" in text
             assert "paddle_serving_replicas_healthy 2" in text
             assert "paddle_serving_router_retries_total 0" in text
+        finally:
+            server.drain()
+
+
+class TestKeepAliveAndRateLimit:
+    def test_keep_alive_two_requests_one_socket(self):
+        """Non-SSE completions are HTTP/1.1 keep-alive: two requests
+        ride one TCP connection (Content-Length + Connection:
+        keep-alive), both bit-identical to solo decode."""
+        model = tiny_gpt()
+        server, engines, addr = make_server(n_replicas=1)
+        try:
+            conn = http.client.HTTPConnection(*addr, timeout=120)
+            outs = []
+            for prompt in ([3, 14, 15, 9], [26, 5, 35]):
+                conn.request("POST", "/v1/completions",
+                             json.dumps({"prompt": prompt,
+                                         "max_tokens": 6}),
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                headers = dict(resp.getheaders())
+                body = json.loads(resp.read())
+                assert resp.status == 200
+                assert headers["Connection"].lower() == "keep-alive"
+                assert int(headers["Content-Length"]) > 0
+                outs.append(body["choices"][0]["token_ids"])
+            conn.close()     # the SAME socket carried both requests
+            assert outs[0] == oracle_greedy(model, [3, 14, 15, 9], 6)
+            assert outs[1] == oracle_greedy(model, [26, 5, 35], 6)
+        finally:
+            server.drain()
+
+    def test_rate_limit_per_client_429_with_retry_after(self):
+        """Token bucket per API key: the key that burns its burst gets
+        a typed 429 + Retry-After while a DIFFERENT key (and the
+        anonymous remote-addr key) is still admitted."""
+        server, engines, addr = make_server(
+            n_replicas=1, server_kw={"rate_limit": 0.5,
+                                     "rate_limit_burst": 1})
+        try:
+            def post_key(key):
+                conn = http.client.HTTPConnection(*addr, timeout=120)
+                try:
+                    headers = {"Content-Type": "application/json"}
+                    if key:
+                        headers["Authorization"] = f"Bearer {key}"
+                    conn.request("POST", "/v1/completions",
+                                 json.dumps({"prompt": [1, 2],
+                                             "max_tokens": 2}),
+                                 headers)
+                    resp = conn.getresponse()
+                    return resp.status, dict(resp.getheaders()), \
+                        json.loads(resp.read())
+                finally:
+                    conn.close()
+
+            st, _, _ = post_key("alice")
+            assert st == 200
+            st, headers, body = post_key("alice")      # burst spent
+            assert st == 429
+            assert int(headers["Retry-After"]) >= 1
+            assert body["error"]["type"] == "rate_limit_exceeded"
+            st, _, _ = post_key("bob")                 # other client ok
+            assert st == 200
+            st, _, _ = post_key(None)                  # addr-keyed ok
+            assert st == 200
+            assert server.rate_limiter.rejected_total == 1
+            st, text = get(addr, "/metrics")
+            assert "paddle_serving_rate_limited_total 1" in text
+        finally:
+            server.drain()
+
+    def test_rate_limit_bucket_refills(self):
+        """Unit: a drained bucket refills at `rate`; the Retry-After
+        hint is exact under an injected clock."""
+        from paddle_tpu.serving import RateLimited
+        from paddle_tpu.serving.http import RateLimiter, TokenBucket
+        t = [0.0]
+        b = TokenBucket(rate=2.0, burst=2.0, clock=lambda: t[0])
+        assert b.try_acquire() == 0.0 and b.try_acquire() == 0.0
+        wait = b.try_acquire()
+        assert wait == pytest.approx(0.5)    # 1 token at 2/s
+        t[0] = 0.5
+        assert b.try_acquire() == 0.0        # refilled exactly
+        rl = RateLimiter(rate=1.0, burst=1.0, clock=lambda: t[0])
+        rl.check("k")
+        with pytest.raises(RateLimited) as ei:
+            rl.check("k")
+        assert ei.value.retry_after_s == pytest.approx(1.0)
+        rl.check("other")                    # independent buckets
+        t[0] = 1.5
+        rl.check("k")                        # refilled
+        assert rl.rejected_total == 1
+
+    def test_rate_limiter_concurrent_clients(self):
+        """Thread-safety: N threads on N distinct keys each get their
+        full burst; total rejections match total over-budget calls."""
+        from paddle_tpu.serving import RateLimited
+        from paddle_tpu.serving.http import RateLimiter
+        t = [0.0]
+        rl = RateLimiter(rate=1.0, burst=3.0, clock=lambda: t[0])
+        granted = {}
+
+        def client(key):
+            ok = 0
+            for _ in range(5):
+                try:
+                    rl.check(key)
+                    ok += 1
+                except RateLimited:
+                    pass
+            granted[key] = ok
+
+        threads = [threading.Thread(target=client, args=(f"k{i}",))
+                   for i in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert all(v == 3 for v in granted.values()), granted
+        assert rl.rejected_total == 8 * 2
+        assert rl.clients == 8
+
+    def test_usage_reports_cached_tokens(self):
+        """The OpenAI-style usage block carries cached_tokens: second
+        identical prompt hits the engine's prefix cache; both outputs
+        stay bit-identical to solo decode."""
+        model = tiny_gpt()
+        server, engines, addr = make_server(
+            n_replicas=1, num_slots=2, max_len=64, page_size=8)
+        try:
+            prompt = list(range(1, 21))      # 20 tokens, page_size 8
+            want = oracle_greedy(model, prompt, 6)
+            st1, _, out1 = post_json(addr, {"prompt": prompt,
+                                            "max_tokens": 6})
+            st2, _, out2 = post_json(addr, {"prompt": prompt,
+                                            "max_tokens": 6})
+            assert st1 == st2 == 200
+            assert out1["choices"][0]["token_ids"] == want
+            assert out2["choices"][0]["token_ids"] == want
+            assert out1["usage"]["cached_tokens"] == 0   # cold
+            assert out2["usage"]["cached_tokens"] > 0    # prefix hit
+            assert out2["usage"]["cached_tokens"] \
+                <= out2["usage"]["prompt_tokens"] - 1
+            st, text = get(addr, "/metrics")
+            assert "paddle_serving_prefix_hits_total" in text
+            assert "paddle_serving_prefix_cached_tokens_total" in text
+            assert "paddle_serving_prefix_hit_rate" in text
         finally:
             server.drain()
 
@@ -473,7 +632,7 @@ def test_serving_bench_http_smoke_appends_http_section(tmp_path,
     mod.main()
     with open(out) as f:
         report = json.load(f)
-    assert report["schema_version"] == 3         # attn_impl A/B schema
+    assert report["schema_version"] == 4         # + prefix-cache schema
     assert report["completed"] == 4              # in-process section
     assert report["attn_impl"] == "kernel"
     assert set(report["ab"]) == {"kernel", "gather"}
