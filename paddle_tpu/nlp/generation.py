@@ -33,7 +33,8 @@ from ..core.tensor import Tensor
 from ..core import dtype as dtypes
 from ..ops._helpers import apply_op, as_tensor
 from ..ops.pallas.paged_attention import (gqa_attend_reference,
-                                          paged_decode_attention)
+                                          paged_decode_attention,
+                                          ragged_paged_attention)
 
 __all__ = ["DecodeCache", "init_decode_caches", "update_and_attend",
            "CompiledGenerator", "decode_model_step", "sample_logits",
@@ -79,10 +80,11 @@ class DecodeCache:
     """
 
     __slots__ = ("k", "v", "pos", "k_scale", "v_scale", "fresh",
-                 "page_table", "attn_impl")
+                 "page_table", "attn_impl", "q_len")
 
     def __init__(self, k, v, pos, k_scale=None, v_scale=None,
-                 fresh=False, page_table=None, attn_impl=None):
+                 fresh=False, page_table=None, attn_impl=None,
+                 q_len=None):
         self.k = k
         self.v = v
         self.pos = pos
@@ -91,6 +93,12 @@ class DecodeCache:
         # paged decode impl override ("kernel"/"gather"); None defers
         # to PADDLE_TPU_PAGED_ATTN (see resolve_paged_attn_impl)
         self.attn_impl = attn_impl
+        # ragged paged mode (the serving engine's UNIFIED step): per-row
+        # valid query count [B] int32 — row b's tokens occupy positions
+        # pos[b] .. pos[b] + q_len[b] - 1 of a width-l padded batch;
+        # queries past q_len are dead padding. None = every row uses
+        # the full width l (the classic prefill/decode shapes).
+        self.q_len = q_len
         # int8 cache mode: k/v hold int8 codes laid out
         # [B, H_kv, max_len, D]; *_scale are per-head [H_kv] f32
         # CONSTANTS from calibration (layout + constant scales are what
@@ -180,6 +188,14 @@ register_op("paged_kv_gather", _paged_gather_fwd, nondiff=True)
 # no [B, max_pages * page_size, H, D] gather materialized. Off-TPU the
 # fwd runs the pure-JAX reference, so CPU tier-1 tests exercise the op.
 register_op("paged_decode_attention", paged_decode_attention,
+            nondiff=True)
+
+# Ragged generalization: per-row query lengths, so ONE kernel/step
+# serves a mixed batch — decode rows (q_len == 1) next to mid-prefill
+# rows (q_len == chunk) — over the same paged pool. The serving
+# engine's unified step (PADDLE_TPU_UNIFIED_STEP) attends through this
+# op; off-TPU the fwd runs the pure-JAX ragged reference.
+register_op("ragged_paged_attention", ragged_paged_attention,
             nondiff=True)
 
 
@@ -376,7 +392,7 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
         while m.ndim < 4:
             m = manipulation.unsqueeze(m, axis=0)
         user_m = m
-    if paged and l == 1 and \
+    if paged and l == 1 and cache.q_len is None and \
             resolve_paged_attn_impl(cache.attn_impl) == "kernel":
         # Pallas ragged paged-attention: walks page_table[b, :] and
         # streams only live pages (flash-style online softmax across
@@ -389,6 +405,23 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
         return out, DecodeCache(k_buf, v_buf, cache.pos + l,
                                 page_table=cache.page_table,
                                 attn_impl=cache.attn_impl)
+    if paged and cache.q_len is not None and \
+            resolve_paged_attn_impl(cache.attn_impl) == "kernel":
+        # UNIFIED ragged step (per-row q_len over a width-l padded
+        # batch): one kernel invocation serves decode rows (q_len 1)
+        # and mid-prefill rows (q_len up to l) together — query i of
+        # row b attends keys j <= pos[b] + i, dead queries past q_len
+        # are masked in-kernel (outputs unspecified, the engine drops
+        # them)
+        args = [q, k_buf, v_buf, cache.page_table, cache.pos,
+                cache.q_len]
+        if user_m is not None:
+            args.append(user_m)
+        out = apply_op("ragged_paged_attention", *args)
+        return out, DecodeCache(k_buf, v_buf, cache.pos + cache.q_len,
+                                page_table=cache.page_table,
+                                attn_impl=cache.attn_impl,
+                                q_len=cache.q_len)
     mask = apply_op("window_causal_mask", cache.pos,
                     attrs=dict(l=int(l), lmax=int(lmax)))
     if user_m is not None:
@@ -470,17 +503,22 @@ def _pack_caches(caches):
         for c in caches)
 
 
-def _unpack_caches(ct, pos, page_table=None, attn_impl=None):
+def _unpack_caches(ct, pos, page_table=None, attn_impl=None,
+                   q_len=None):
     """page_table (optional [B, max_pages] raw int32 array) switches
     every layer's cache into paged-pool mode; the table is shared
     across layers (one page id addresses the same page in each
     layer's pool). attn_impl pins the paged decode implementation
-    ("kernel"/"gather") for the trace being built."""
+    ("kernel"/"gather") for the trace being built. q_len (optional
+    [B] raw int32 array) switches the paged caches into RAGGED mode —
+    the serving engine's unified prefill+decode step, where each row
+    carries its own live query count over a shared padded width."""
     pt = None if page_table is None else Tensor(page_table)
+    ql = None if q_len is None else Tensor(q_len)
     return [DecodeCache(Tensor(k), Tensor(v), Tensor(pos),
                         None if ks is None else Tensor(ks),
                         None if vs is None else Tensor(vs),
-                        page_table=pt, attn_impl=attn_impl)
+                        page_table=pt, attn_impl=attn_impl, q_len=ql)
             for k, v, ks, vs in ct]
 
 

@@ -32,6 +32,25 @@ Off-TPU the op runs `paged_attention_reference` — the same math as the
 gather path (gather pages -> masked grouped softmax), kept around both
 as the CPU tier-1 path and as the oracle the kernel is tested against
 (tests/test_paged_attention.py runs the kernel in interpret mode).
+
+RAGGED GENERALIZATION (`ragged_paged_attention`): the same walk, but
+every row carries its own query length — grid
+(batch_row, kv_head, q_block, page), with `q_len` [B] riding next to
+`page_table`/`pos` as a third scalar-prefetch operand. Row b's query
+token i sits at global position pos[b] + i and attends keys
+j <= pos[b] + i (the causal window of the chunk being written), so ONE
+invocation serves a mixed batch: decode rows at q_len == 1 next to
+mid-prefill rows at q_len == chunk — the one-kernel/step target of
+Ragged Paged Attention (PAPERS.md), with the per-row tail causally
+masked in the fused online-softmax loop (the low-precision-friendly
+primitive style of Tensor Processing Primitives, PAPERS.md). Query
+blocks past q_len[b] and pages past the row's live prefix
+ceil((pos[b] + q_len[b]) / page_size) are skipped: their grid steps
+clamp the K/V block index to the last live page (no re-fetch) and
+predicate compute off, so both HBM traffic and MXU work scale with the
+tokens actually packed, not with the padded step shape. Outputs at
+query positions >= q_len[b] are unspecified-but-finite (the engine
+discards them).
 """
 from __future__ import annotations
 
@@ -45,7 +64,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["paged_decode_attention", "paged_attention_reference",
-           "gqa_attend_reference"]
+           "gqa_attend_reference", "ragged_paged_attention",
+           "ragged_attention_reference"]
 
 # interpret mode: run the kernel on CPU for testing (tests set this)
 _INTERPRET = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "0") == "1"
@@ -69,13 +89,15 @@ def _use_kernel():
     return plat == "tpu" or _INTERPRET
 
 
-def _mask_to_additive(mask, b, h, lmax):
+def _mask_to_additive(mask, b, h, lmax, lq=1):
     """User attn_mask (bool or additive float, broadcastable
-    [B|1, H|1, 1, lmax]) -> additive f32 [B, H, lmax]."""
+    [B|1, H|1, lq|1, lmax]) -> additive f32 [B, H, lq, lmax]
+    (squeezed to [B, H, lmax] for the single-token kernel)."""
     if mask.dtype == jnp.bool_:
         mask = jnp.where(mask, jnp.float32(0.0), jnp.float32(_NEG_INF))
     mask = mask.astype(jnp.float32)
-    return jnp.broadcast_to(mask, (b, h, 1, lmax)).reshape(b, h, lmax)
+    out = jnp.broadcast_to(mask, (b, h, lq, lmax))
+    return out.reshape(b, h, lmax) if lq == 1 else out
 
 
 def _pa_kernel(tab_ref, pos_ref, q_ref, k_ref, v_ref, *rest, ps, rep,
@@ -202,6 +224,154 @@ def _paged_attention_kernel(q, k_pool, v_pool, page_table, pos, mask):
     return out.reshape(b, l, h, d)
 
 
+def _ragged_kernel(tab_ref, pos_ref, qlen_ref, q_ref, k_ref, v_ref,
+                   *rest, ps, qblk, rep, scale, has_mask):
+    if has_mask:
+        mask_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        mask_ref = None
+        o_ref, m_ref, l_ref, acc_ref = rest
+    b = pl.program_id(0)
+    t = pl.program_id(2)
+    p = pl.program_id(3)
+    n_p = pl.num_programs(3)
+    pos_b = pos_ref[b]
+    qlen_b = qlen_ref[b]
+    prec = _prec(q_ref.dtype)
+    scale32 = jnp.float32(scale)
+    # last valid query of THIS block (block-dead when t*qblk >= q_len)
+    last_qi = jnp.minimum((t + 1) * qblk, qlen_b) - 1
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, jnp.float32(_NEG_INF))
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # a page contributes iff it holds a position some live query of the
+    # block attends (j <= pos + last_qi); dead blocks skip every page
+    @pl.when((t * qblk < qlen_b) & (p * ps <= pos_b + last_qi))
+    def _compute():
+        q = q_ref[0, 0, :, 0].reshape(qblk * rep, q_ref.shape[-1])
+        k = k_ref[0, :, 0, :]                      # [ps, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec) * scale32              # [qblk*rep, ps]
+        # per-query causal window: query t*qblk + i (live iff < q_len)
+        # attends key position p*ps + j iff j_pos <= pos + q_pos
+        qi = t * qblk + jax.lax.broadcasted_iota(
+            jnp.int32, (qblk, rep, ps), 0).reshape(qblk * rep, ps)
+        k_pos = p * ps + jax.lax.broadcasted_iota(
+            jnp.int32, (qblk, rep, ps), 2).reshape(qblk * rep, ps)
+        live = (qi < qlen_b) & (k_pos <= pos_b + qi)
+        s = jnp.where(live, s, jnp.float32(_NEG_INF))
+        if has_mask:
+            s = s + mask_ref[0].reshape(qblk * rep, ps)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        pexp = jnp.exp(s - m_new)
+        l_ref[:] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(pexp, axis=1, keepdims=True),
+            l_ref.shape)
+        v = v_ref[0, :, 0, :]                      # [ps, D]
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            pexp.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(p == n_p - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], jnp.float32(1e-30))
+        d = o_ref.shape[-1]
+        o_ref[0, 0, :, 0] = (acc_ref[:] / l).reshape(
+            qblk, rep, d).astype(o_ref.dtype)
+
+
+def _ragged_attention_kernel(q, k_pool, v_pool, page_table, pos, q_len,
+                             mask):
+    """q [B, lq, H, D]; pools [P, ps, H_kv, D]; page_table
+    [B, max_pages] int32; pos/q_len [B] int32; mask None | additive f32
+    [B, H, lq, lmax]. lq is padded up to a multiple of the query block
+    so the grid tiles evenly; padded queries are dead by q_len."""
+    b, lq, h, d = q.shape
+    _, ps, hkv, _ = k_pool.shape
+    mp = page_table.shape[1]
+    rep = h // hkv
+    scale = 1.0 / math.sqrt(d)
+    qblk = min(lq, 8)
+    nqb = -(-lq // qblk)
+    lq_pad = nqb * qblk
+    if lq_pad != lq:
+        padq = jnp.zeros((b, lq_pad - lq, h, d), q.dtype)
+        q = jnp.concatenate([q, padq], axis=1)
+        if mask is not None:
+            padm = jnp.zeros((b, h, lq_pad - lq, mp * ps), jnp.float32)
+            mask = jnp.concatenate([mask, padm], axis=2)
+    q6 = q.reshape(b, nqb, qblk, hkv, rep, d)
+
+    def kv_idx(bi, g, t, p, tab, posr, qlr):
+        # clamp dead steps (block-dead rows and pages past the block's
+        # causal horizon) to the last live page: unchanged block index,
+        # no re-fetch, compute predicated off in-kernel
+        last_qi = jnp.minimum((t + 1) * qblk, qlr[bi]) - 1
+        lp = jnp.clip((posr[bi] + last_qi) // ps, 0, mp - 1)
+        return (tab[bi, jnp.minimum(p, lp)], 0, g, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, qblk, 1, rep, d),
+                     lambda bi, g, t, p, tab, posr, qlr:
+                     (bi, t, 0, g, 0, 0)),
+        pl.BlockSpec((1, ps, 1, d), kv_idx),
+        pl.BlockSpec((1, ps, 1, d), kv_idx),
+    ]
+    ops = [q6, k_pool, v_pool]
+    if mask is not None:
+        # [B, H, lq, lmax] -> [B*hkv, lq, rep, lmax]: block rows match
+        # the kernel's (qblk, rep) score layout
+        m5 = mask.reshape(b, hkv, rep, lq_pad, mp * ps)
+        ops.append(m5.transpose(0, 1, 3, 2, 4)
+                   .reshape(b * hkv, lq_pad, rep, mp * ps))
+        in_specs.append(pl.BlockSpec(
+            (1, qblk, rep, ps),
+            lambda bi, g, t, p, tab, posr, qlr:
+            (bi * hkv + g, t, 0, p)))
+
+    kernel = functools.partial(_ragged_kernel, ps=ps, qblk=qblk,
+                               rep=rep, scale=scale,
+                               has_mask=mask is not None)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, hkv, nqb, mp),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, qblk, 1, rep, d),
+                               lambda bi, g, t, p, tab, posr, qlr:
+                               (bi, t, 0, g, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((qblk * rep, _LANES), jnp.float32),
+            pltpu.VMEM((qblk * rep, _LANES), jnp.float32),
+            pltpu.VMEM((qblk * rep, d), jnp.float32),
+        ],
+    )
+    from jax.experimental import disable_x64
+    with disable_x64():
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, nqb, qblk, hkv, rep, d),
+                                           q.dtype),
+            compiler_params=pltpu.TPUCompilerParams(
+                dimension_semantics=("parallel", "parallel",
+                                     "arbitrary", "arbitrary")),
+            interpret=_INTERPRET,
+        )(page_table, pos, q_len, *ops)
+    return out.reshape(b, lq_pad, h, d)[:, :lq]
+
+
 def gqa_attend_reference(q, k, v, mask):
     """Grouped-query attention over un-repeated K/V buffers:
     q [B, l, H, D] against k/v [B, lmax, H_kv, D], mask bool or
@@ -284,3 +454,64 @@ def paged_decode_attention(q, k_pool, v_pool, page_table, pos,
             mask)
     return paged_attention_reference(q, k_pool, v_pool, page_table,
                                      posv, mask)
+
+
+def ragged_attention_reference(q, k_pool, v_pool, page_table, pos,
+                               q_len, mask=None):
+    """Pure-JAX ragged reference: gather the rows' pages into the dense
+    logical view and run the grouped softmax under the ragged causal
+    window — query i of row b attends keys j <= pos[b] + i, queries at
+    i >= q_len[b] are fully masked (their outputs are unspecified). At
+    lq == 1 this is EXACTLY `paged_attention_reference`'s math (same
+    gather, same mask, same grouped dots), so l==1 rows stay
+    bit-identical to the gather path; for l > 1 rows the grouped unroll
+    reproduces the dense repeat_interleave + SDPA oracle (the same
+    per-group shape argument as gqa_attend_reference)."""
+    b, lq, h, d = q.shape
+    ps, hkv = k_pool.shape[1], k_pool.shape[2]
+    mp = page_table.shape[1]
+    lmax = mp * ps
+    tab = page_table.astype(jnp.int32)
+    kf = jnp.take(k_pool, tab, axis=0).reshape(b, lmax, hkv, d)
+    vf = jnp.take(v_pool, tab, axis=0).reshape(b, lmax, hkv, d)
+    i = jnp.arange(lq, dtype=jnp.int32)[None, :, None]
+    j = jnp.arange(lmax, dtype=jnp.int32)[None, None, :]
+    live = (i < q_len.astype(jnp.int32)[:, None, None]) & \
+        (j <= pos.astype(jnp.int32)[:, None, None] + i)
+    add = jnp.where(live, jnp.float32(0.0), jnp.float32(_NEG_INF))
+    add = add[:, None]                            # [B, 1, lq, lmax]
+    if mask is not None:
+        add = add + mask.reshape(b, h, lq, lmax)
+    return gqa_attend_reference(q, kf, vf, add)
+
+
+def ragged_paged_attention(q, k_pool, v_pool, page_table, pos, q_len,
+                           mask=None):
+    """Ragged paged attention over per-row query lengths (the
+    registered op's forward): one invocation serves a mixed batch of
+    mid-prefill rows (q_len > 1) and decoding rows (q_len == 1) against
+    the same paged pool. q [B, lq, H, D] — row b's tokens occupy global
+    positions pos[b] .. pos[b] + q_len[b] - 1 (their K/V was just
+    scattered there); query i attends keys j <= pos[b] + i. Rows may be
+    dead (q_len == 0): no position advances and the row's output is
+    unspecified-but-finite. mask: optional user attention mask (bool or
+    additive float, broadcastable [B|1, H|1, lq|1, lmax]), composed
+    with the ragged causal window in-kernel."""
+    b, lq, h, d = q.shape
+    lmax = page_table.shape[1] * k_pool.shape[1]
+    posv = pos.astype(jnp.int32)
+    if posv.ndim == 0:
+        posv = jnp.broadcast_to(posv[None], (b,))
+    qlv = q_len.astype(jnp.int32)
+    if qlv.ndim == 0:
+        qlv = jnp.broadcast_to(qlv[None], (b,))
+    if mask is not None:
+        mask = _mask_to_additive(mask, b, h, lmax, lq)
+        if lq == 1:
+            mask = mask.reshape(b, h, 1, lmax)
+    if _use_kernel():
+        return _ragged_attention_kernel(
+            q, k_pool, v_pool, page_table.astype(jnp.int32), posv, qlv,
+            mask)
+    return ragged_attention_reference(q, k_pool, v_pool, page_table,
+                                      posv, qlv, mask)

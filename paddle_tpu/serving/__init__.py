@@ -2,10 +2,12 @@
 
 Wraps the compiled decode path (nlp/generation.py) in a slot-based
 scheduler over a PAGED KV pool: requests arriving at different times,
-with different prompt lengths and sampling params, share ONE
-fixed-shape compiled decode step, each holding only the KV pages its
-prompt + output budget needs (long prompts prefill chunk by chunk,
-interleaved with residents' decodes):
+with different prompt lengths and sampling params, share ONE compiled
+unified ragged prefill+decode step (PADDLE_TPU_UNIFIED_STEP, default
+on) — decode rows at q_len 1 next to mid-prefill rows at q_len up to
+chunk_len in the same fixed-shape invocation, prefill tokens packed
+into spare decode capacity — each holding only the KV pages its
+prompt + output budget needs:
 
     from paddle_tpu.serving import ServingEngine, SamplingParams
 
@@ -23,7 +25,7 @@ Greedy requests are bit-identical to offline CompiledGenerator decode
 (tested); `scripts/serving_bench.py` drives a Poisson arrival trace and
 reports TTFT/throughput/pool utilization into BENCH_serving.json.
 """
-from .engine import ServingEngine  # noqa: F401
+from .engine import ServingEngine, resolve_unified_flag  # noqa: F401
 from .errors import (EngineClosed, QueueFull, RateLimited,  # noqa: F401
                      ServingError)
 from .metrics import (Histogram, ServingMetrics,  # noqa: F401
@@ -35,7 +37,8 @@ from .request import (Request, RequestOutput, RequestState,  # noqa: F401
                       SamplingParams)
 from .scheduler import Scheduler  # noqa: F401
 
-__all__ = ["ServingEngine", "Scheduler", "ServingMetrics", "Histogram",
+__all__ = ["ServingEngine", "resolve_unified_flag", "Scheduler",
+           "ServingMetrics", "Histogram",
            "prometheus_render", "PagePool", "pages_needed",
            "chunk_bucket", "RadixPrefixCache", "PrefixGrant",
            "resolve_prefix_cache_flag", "Request", "RequestOutput",

@@ -7,29 +7,33 @@ slot allocates only the pages its prompt + output budget needs
 (`ceil((plen + max_new) / page_size)`), so a slot holding a 40-token
 request no longer pins `max_len` dense rows; HBM capacity bounds
 concurrency by TOKENS IN FLIGHT, not by slots × max_len (Ragged Paged
-Attention, PAPERS.md). Exactly two program shapes touch the pool:
+Attention, PAPERS.md).
 
-- ONE decode step, shared by all slots: sample each slot's next token
-  from its held logits (per-slot temperature/top-k/top-p vectors, same
-  math as CompiledGenerator via `sample_logits`/`_top_p_filter`), then
-  one fixed-shape batched forward where every row scatters its new K/V
-  into `page_table[slot, pos // page_size]` and attends over its pages
-  IN PLACE through the Pallas ragged paged-attention kernel (default
-  `attn_impl="kernel"`: walks the page table, streams only live pages
-  — Ragged Paged Attention, PAPERS.md; `attn_impl="gather"` keeps the
-  old gather-into-dense-view path for cross-checks). Membership, page
-  tables, lengths and sampling params change BETWEEN invocations only
-  — the program never retraces, which is what lets XLA keep the hot
-  loop one fused executable ("Operator Fusion in XLA", PAPERS.md).
-- one CHUNKED prefill per power-of-two chunk bucket: a fixed-shape
-  batch-1 forward that feeds `chunk_len` prompt tokens through the
-  model, writing the chunk's K/V straight into the slot's pages and the
-  running next-token logits into the held-logits row. A long prompt
-  takes ceil(plen / chunk) of these, ONE per engine step, interleaved
-  with decode steps of resident slots — so a long prompt never stalls
-  anyone's decode for more than one chunk. Bucketing the tail chunk to
-  powers of two bounds the trace count at O(log chunk_len) instead of
-  one trace per distinct prompt length.
+By default (PADDLE_TPU_UNIFIED_STEP=on / ServingEngine(unified=...))
+exactly ONE program shape touches the pool — the UNIFIED RAGGED
+PREFILL+DECODE STEP, a fixed-shape [num_slots, chunk_len] forward in
+which every row carries its own live query count (`q_len`) through the
+ragged paged-attention op: decoding rows sample their next token from
+the held logits (per-slot temperature/top-k/top-p vectors, same math
+as CompiledGenerator via `sample_logits`/`_top_p_filter`) and run it
+at q_len 1; mid-prefill rows feed up to `chunk_len` prompt tokens in
+the SAME invocation (q_len up to chunk_len); idle rows ride dead at
+q_len 0. `Scheduler.pack_tokens` decides the packing each step under a
+`token_budget` (default the full num_slots * chunk_len step shape):
+decode rows always get their token — a long prompt can NEVER stall a
+resident decoder — and prefill rows split the spare. Membership, page
+tables, q_lens and sampling params change BETWEEN invocations only —
+the one program never retraces, which is what lets XLA keep the hot
+loop one fused executable ("Operator Fusion in XLA", PAPERS.md), and
+the per-row l>1 shape is the verify path speculative decoding needs.
+
+The legacy ALTERNATING path (PADDLE_TPU_UNIFIED_STEP=off) keeps the
+two old program families for A/B: one fixed-shape decode step for all
+slots, plus one chunked-prefill program per power-of-two chunk bucket
+(a batch-1 forward of `chunk_len` prompt tokens, ONE chunk per engine
+step interleaved with resident decodes, O(log chunk_len) traces
+total). Greedy outputs are token-identical across the gate, asserted
+against the solo CompiledGenerator oracle either way.
 
 Free slots and retired requests point their page-table rows at the
 reserved trash page 0, so the fixed-shape scatter/gather stays safe for
@@ -60,6 +64,7 @@ weight rebinding (quantization etc.) — it snapshots model state.
 from __future__ import annotations
 
 import itertools
+import os
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -81,7 +86,29 @@ from .prefix import RadixPrefixCache, resolve_prefix_cache_flag
 from .request import Request, RequestOutput, RequestState, SamplingParams
 from .scheduler import Scheduler
 
-__all__ = ["ServingEngine"]
+__all__ = ["ServingEngine", "resolve_unified_flag"]
+
+UNIFIED_STEP_MODES = ("on", "off")
+
+
+def resolve_unified_flag(override=None) -> bool:
+    """Whether the engine runs the UNIFIED ragged prefill+decode step
+    (default on): ONE compiled program per engine — decode rows
+    (q_len 1) and mid-prefill rows (q_len up to chunk_len) share every
+    step through the ragged paged-attention op — instead of the old
+    two program families (per-bucket prefill chunks alternating with
+    the fixed-shape decode step). An explicit override wins; otherwise
+    PADDLE_TPU_UNIFIED_STEP=on|off (read at engine construction; the
+    old alternating path is kept for A/B, same oracle pattern as
+    PADDLE_TPU_PAGED_ATTN / PADDLE_TPU_PREFIX_CACHE)."""
+    if override is not None:
+        return bool(override)
+    v = os.environ.get("PADDLE_TPU_UNIFIED_STEP", "on")
+    if v not in UNIFIED_STEP_MODES:
+        raise ValueError(
+            f"PADDLE_TPU_UNIFIED_STEP must be one of "
+            f"{UNIFIED_STEP_MODES}, got {v!r}")
+    return v == "on"
 
 
 def _sample_rows(logits, key, temps, top_k, top_p, greedy):
@@ -121,7 +148,8 @@ class ServingEngine:
                  metrics: Optional[ServingMetrics] = None,
                  max_queue: Optional[int] = None, clock=time.monotonic,
                  attn_impl: Optional[str] = None,
-                 prefix_cache=None):
+                 prefix_cache=None, unified=None,
+                 token_budget: Optional[int] = None):
         if cache_spec is None:
             if not hasattr(model, "_decode_cache_spec"):
                 raise ValueError(
@@ -156,8 +184,31 @@ class ServingEngine:
         # here — the compiled decode step keeps the impl it was traced
         # with; flipping PADDLE_TPU_PAGED_ATTN later needs a new engine.
         self.attn_impl = resolve_paged_attn_impl(attn_impl)
+        # unified ragged prefill+decode step (default on): ONE compiled
+        # program of width chunk_len serves every prefill/decode mix
+        # per step — decode rows at q_len 1, mid-prefill rows at q_len
+        # up to chunk_len — and the scheduler PACKS prefill tokens into
+        # spare decode-step capacity (token_budget) instead of
+        # alternating program families. Gated by
+        # ServingEngine(unified=...) / PADDLE_TPU_UNIFIED_STEP.
+        self.unified = resolve_unified_flag(unified)
+        # per-step packed-token ceiling: decode rows always get their
+        # token; prefill packing is throttled to the spare budget.
+        # Default = the full compiled step shape (num_slots * chunk_len
+        # — no artificial throttle; the [S, chunk_len] trace shape is
+        # the bound). Set it LOWER on hardware where attention FLOPs
+        # dominate step latency (very long contexts): the ragged
+        # kernel's work scales with tokens actually packed, so a
+        # smaller budget caps per-step latency for residents at the
+        # cost of slower prefill.
+        self.token_budget = (self.num_slots * self.chunk_len
+                             if token_budget is None
+                             else int(token_budget))
+        if self.token_budget < 1:
+            raise ValueError("token_budget must be >= 1")
         self.metrics = metrics or ServingMetrics()
         self.metrics.attn_impl = self.attn_impl
+        self.metrics.unified = self.unified
         self._clock = clock
         self._id_counter = itertools.count()
         self._requests: Dict[str, Request] = {}
@@ -212,6 +263,7 @@ class ServingEngine:
         self._active = np.zeros((self.num_slots,), bool)
         self._prefill_fns: Dict[int, object] = {}   # chunk bucket -> fn
         self._decode_fn = None
+        self._unified_fn = None      # the ONE compiled ragged step
         self._copy_page_fn = None    # COW single-page copy, jitted once
         self._spans: Dict[str, RecordEvent] = {}
         # shutdown latch: flipped by drain()/abort_all(); add_request
@@ -296,6 +348,56 @@ class ServingEngine:
 
         return jax.jit(lambda ct, pos, ll, pt, key, t, k, p, g, a: step(
             state_vals, ct, pos, ll, pt, key, t, k, p, g, a))
+
+    def _build_unified(self):
+        """THE one compiled ragged prefill+decode step: a fixed-shape
+        [S, chunk_len] forward where every row carries its own live
+        query count (`q_len` — 1 for decoding rows, up to chunk_len for
+        mid-prefill rows, 0 for idle/free rows) through the ragged
+        paged-attention op. Decode rows first sample their next token
+        from the held logits (per-slot params, exactly the old decode
+        step's math) and feed it at column 0; prefill rows feed their
+        prompt chunk. Each live row's last-real-token logits land back
+        in its held-logits row, and positions advance by q_len.
+        Padding columns' K/V writes land at positions >= pos + q_len —
+        never attended before the real token overwrites them — so ONE
+        trace serves every prefill/decode mix, membership change and
+        packing decision (the engine's whole point: the per-bucket
+        prefill programs AND the separate decode program collapse into
+        this)."""
+        model = self.model
+        state_vals = [t._value for t in self._state_tensors]
+
+        def ustep(state_vals, ct, pos, last_logits, page_table, tokens,
+                  q_len, is_decode, key, temps, top_k, top_p, greedy):
+            originals = self._swap_state(state_vals)
+            try:
+                nxt = _sample_rows(last_logits, key, temps, top_k,
+                                   top_p, greedy)
+                nxt = jnp.where(is_decode, nxt, 0).astype(jnp.int32)
+                col0 = (jnp.arange(tokens.shape[1], dtype=jnp.int32)
+                        == 0)[None, :]
+                toks = jnp.where(is_decode[:, None] & col0,
+                                 nxt[:, None], tokens)
+                caches = _unpack_caches(ct, pos, page_table,
+                                        attn_impl=self.attn_impl,
+                                        q_len=q_len)
+                logits_t, caches = model(Tensor(toks), caches=caches)
+                lg = logits_t._value.astype(jnp.float32)   # [S, W, V]
+                last_idx = jnp.maximum(q_len - 1, 0)
+                row_last = jnp.take_along_axis(
+                    lg, last_idx[:, None, None], axis=1)[:, 0]
+                live = (q_len > 0)[:, None]
+                new_last = jnp.where(live, row_last, last_logits)
+                new_pos = pos + q_len
+                return _pack_caches(caches), new_pos, new_last, nxt
+            finally:
+                self._restore_state(originals)
+
+        return jax.jit(
+            lambda ct, pos, ll, pt, tokens, q_len, isd, key, t, k, p,
+            g: ustep(state_vals, ct, pos, ll, pt, tokens, q_len, isd,
+                     key, t, k, p, g))
 
     def _build_copy_page(self):
         """ONE compiled single-page pool copy for copy-on-write: src and
@@ -475,7 +577,11 @@ class ServingEngine:
             self._pt_host[slot, :] = TRASH_PAGE
             self._pt_host[slot, :len(req.pages)] = req.pages
             self._pt_dirty = True
-            self._pos = self._pos.at[slot].set(0)
+            # the slot's write position starts at the first uncached
+            # token (0 on a prefix miss): the unified step reads it as
+            # the row's pos; the old path's prefill program passes the
+            # cursor explicitly and overwrites pos itself
+            self._pos = self._pos.at[slot].set(req.cached_tokens)
             # prefix-cache hit: the matched span's KV is already in the
             # attached pages — prefill starts at the first uncached
             # token. A mid-page match first copies the shared partial
@@ -593,18 +699,111 @@ class ServingEngine:
             elif len(req.output_tokens) >= sp.max_new_tokens:
                 self._finish_and_free(req, "length", now, finished)
 
+    def _unified_step(self, finished: List[RequestOutput]) -> int:
+        """One UNIFIED ragged step: pack this round's tokens — every
+        decoding slot's next token plus as many prefill prompt tokens
+        as the spare token budget allows (Scheduler.pack_tokens) — and
+        run them through THE one compiled ragged program. Returns the
+        number of prefill tokens packed alongside the decodes (0 when
+        nothing ran)."""
+        running = self.scheduler.running
+        if not running:
+            return 0
+        W = self.chunk_len
+        remaining = {
+            slot: int(req.prompt_ids.size)
+            - self._prefill_cursor[req.request_id]
+            for slot, req in running.items()
+            if req.state is RequestState.PREFILL}
+        decode_slots, grants = self.scheduler.pack_tokens(
+            self.token_budget, W, remaining)
+        if not decode_slots and not grants:
+            return 0
+        tokens = np.zeros((self.num_slots, W), np.int32)
+        q_len = np.zeros((self.num_slots,), np.int32)
+        is_decode = np.zeros((self.num_slots,), bool)
+        for slot in decode_slots:
+            q_len[slot] = 1
+            is_decode[slot] = True
+        for slot, take in grants.items():
+            req = running[slot]
+            cur = self._prefill_cursor[req.request_id]
+            tokens[slot, :take] = req.prompt_ids[cur:cur + take]
+            q_len[slot] = take
+        self._ensure_last_logits(next(iter(running.values())))
+        if self._unified_fn is None:
+            self._unified_fn = self._build_unified()
+        if self._vec_dirty:
+            self._refresh_vectors()
+        pt_full, _ = self._page_tables()
+        key = random_mod.next_key_host()
+        t0 = time.perf_counter()
+        with RecordEvent("serving::unified_step"):
+            self._ct, self._pos, self._last_logits, toks = \
+                self._unified_fn(
+                    self._ct, self._pos, self._last_logits, pt_full,
+                    jnp.asarray(tokens), jnp.asarray(q_len),
+                    jnp.asarray(is_decode), key,
+                    jnp.asarray(self._temps), jnp.asarray(self._topk),
+                    jnp.asarray(self._topp), jnp.asarray(self._greedy))
+            toks = np.asarray(toks)   # sync point: host sees the tokens
+        n_prefill = int(sum(grants.values()))
+        self.metrics.on_unified_step(n_prefill, len(decode_slots),
+                                     time.perf_counter() - t0)
+        now = self._clock()
+        # prefill bookkeeping: advance cursors, flip finished rows to
+        # DECODE (their last real token's logits are now held — they
+        # sample their first token next step)
+        for slot, take in grants.items():
+            req = running[slot]
+            cur = self._prefill_cursor[req.request_id] + take
+            self._prefill_cursor[req.request_id] = cur
+            self.metrics.on_prefill_chunk(take)
+            if cur >= req.prompt_ids.size:
+                self._prefill_cursor.pop(req.request_id, None)
+                req.state = RequestState.DECODE
+                self._active[slot] = True
+                self._vec_dirty = True
+                self._pt_dirty = True
+        # decode emission: exactly the old decode step's retirement
+        for slot in decode_slots:
+            req = running.get(slot)
+            if req is None or req.state is not RequestState.DECODE:
+                continue
+            tok = int(toks[slot])
+            prev_t = req._last_token_t
+            req._emit(tok, now)
+            self.metrics.on_token(req, now)
+            if prev_t is not None:
+                self.metrics.on_inter_token(now - prev_t)
+            sp = req.sampling
+            if sp.eos_token_id is not None and tok == sp.eos_token_id:
+                self._finish_and_free(req, "stop", now, finished)
+            elif len(req.output_tokens) >= sp.max_new_tokens:
+                self._finish_and_free(req, "length", now, finished)
+        return n_prefill
+
     def step(self) -> List[RequestOutput]:
         """One scheduler round: evict (timeout/cancel), admit queued
-        requests whose pages fit, one prefill chunk per mid-prefill
-        slot, then one compiled decode step for every decoding slot.
-        Returns requests that finished this round."""
+        requests whose pages fit, then run the round's tokens. With the
+        unified step (default) that is ONE compiled ragged program —
+        decode tokens and packed prefill chunks together, so a long
+        prompt never stalls a resident decoder. On the legacy
+        alternating path (PADDLE_TPU_UNIFIED_STEP=off) it is one
+        prefill chunk per mid-prefill slot, then one compiled decode
+        step for every decoding slot. Returns requests that finished
+        this round."""
         finished: List[RequestOutput] = []
         now = self._clock()
         self._evict(now, finished)
         self._admit(now)
-        chunks = self._advance_prefills()
-        if self._active.any():
-            self._decode(self._clock, finished)
+        if self.unified:
+            self._unified_step(finished)
+            chunks = 0   # packed prefill never stalls a decode
+        else:
+            chunks = self._advance_prefills()
+            if self._active.any():
+                self._decode(self._clock, finished)
         self.metrics.on_step(self.scheduler.queue_depth,
                              self.scheduler.occupancy, self.num_slots,
                              pages_used=self.pool.used_pages,

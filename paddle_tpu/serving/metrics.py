@@ -25,7 +25,7 @@ from collections import deque
 from typing import Optional, Sequence
 
 __all__ = ["Histogram", "ServingMetrics", "prometheus_render",
-           "TTFT_BUCKETS", "LATENCY_BUCKETS"]
+           "TTFT_BUCKETS", "LATENCY_BUCKETS", "PACKED_TOKEN_BUCKETS"]
 
 # fixed Prometheus-style bucket upper bounds (seconds). Fixed — not
 # adaptive — so series stay comparable across scrapes and restarts.
@@ -33,6 +33,9 @@ TTFT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                 5.0, 10.0, 30.0, 60.0)
 LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                    0.5, 1.0, 2.5)
+# per-unified-step packed token counts (decode tokens + prefill tokens
+# sharing one ragged program invocation)
+PACKED_TOKEN_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
 
 
 class Histogram:
@@ -146,12 +149,30 @@ class ServingMetrics:
         # ("kernel" | "gather"); set by the engine at construction so
         # benches/dashboards can attribute latency to the impl
         self.attn_impl: Optional[str] = None
+        # whether the engine runs the unified ragged prefill+decode
+        # step (True) or the legacy alternating program families
+        # (False); set by the engine at construction — the second A/B
+        # tag next to attn_impl so scrapes can tell the paths apart
+        self.unified: Optional[bool] = None
+        # unified-step counters: steps run, and the packed token split
+        self.unified_steps = 0
+        self.packed_prefill_tokens = 0
+        self.packed_decode_tokens = 0
+        # off-path counter: engine steps where prefill chunk programs
+        # ran ahead of the decode step, stalling every resident decoder
+        # (the TTFT spike the unified step exists to kill; stays 0 with
+        # unified on)
+        self.prefill_stall_steps = 0
         # histograms (TTFT/inter-token carry fixed Prometheus buckets)
         self.ttft_s = Histogram(buckets=TTFT_BUCKETS)
         self.inter_token_s = Histogram(buckets=LATENCY_BUCKETS)
         # synchronized wall time of one compiled decode step — the
         # number the attn_impl A/B compares
         self.decode_step_s = Histogram(buckets=LATENCY_BUCKETS)
+        # tokens packed into one unified step (prefill + decode
+        # together — the "how full is the budget" histogram)
+        self.packed_tokens_hist = Histogram(
+            buckets=PACKED_TOKEN_BUCKETS)
         self.queue_wait_s = Histogram()
         self.e2e_s = Histogram()
         self.queue_depth_hist = Histogram()
@@ -208,6 +229,20 @@ class ServingMetrics:
         with self._lock:
             self.decode_step_s.record(wall_s)
 
+    def on_unified_step(self, prefill_tokens: int, decode_tokens: int,
+                        wall_s: float):
+        """One unified ragged step ran, packing `prefill_tokens` prompt
+        tokens next to `decode_tokens` sampled tokens. The wall time
+        lands in the same decode_step_s histogram the alternating path
+        records, so the on/off A/B compares like for like."""
+        with self._lock:
+            self.unified_steps += 1
+            self.packed_prefill_tokens += int(prefill_tokens)
+            self.packed_decode_tokens += int(decode_tokens)
+            self.packed_tokens_hist.record(
+                int(prefill_tokens) + int(decode_tokens))
+            self.decode_step_s.record(wall_s)
+
     def on_prefill_chunk(self, n_tokens: int):
         with self._lock:
             self.prefill_chunks += 1
@@ -230,6 +265,8 @@ class ServingMetrics:
             if prefix_stats is not None:
                 self.prefix = dict(prefix_stats)
             self.prefill_stall = stall_chunks
+            if stall_chunks:
+                self.prefill_stall_steps += 1
             if pages_total:
                 self.pool_utilization_hist.record(pages_used / pages_total)
             self.prefill_stall_hist.record(stall_chunks)
@@ -264,6 +301,12 @@ class ServingMetrics:
             "prefill_chunk_tokens": self.prefill_chunk_tokens,
             "decode_steps": self.decode_steps,
             "attn_impl": self.attn_impl,
+            "unified": self.unified,
+            "unified_steps": self.unified_steps,
+            "packed_prefill_tokens": self.packed_prefill_tokens,
+            "packed_decode_tokens": self.packed_decode_tokens,
+            "packed_tokens_per_step": self.packed_tokens_hist.snapshot(),
+            "prefill_stall_steps": self.prefill_stall_steps,
             "decode_step_s": self.decode_step_s.snapshot(),
             "tokens_per_sec": self.tokens_per_sec,
             "queue_depth": self.queue_depth,
@@ -332,11 +375,32 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                        ("prefix_cow_copies_total", "counter"),
                        ("prefix_resident_pages", "gauge"),
                        ("prefix_hit_rate", "gauge"),
+                       ("engine_info", "gauge"),
+                       ("unified_steps_total", "counter"),
+                       ("prefill_stall_steps_total", "counter"),
+                       ("packed_tokens_per_step", "histogram"),
                        ("ttft_seconds", "histogram"),
                        ("inter_token_seconds", "histogram")]:
         lines.append(f"# TYPE {namespace}_{name} {kind}")
     for replica, snap in sorted(snapshots.items()):
         lab = {"replica": str(replica)}
+        # info-style gauge: the A/B tags (which attention impl, unified
+        # vs alternating step) ride as labels so scrapes from an A/B
+        # fleet are distinguishable without relabeling
+        lines.append(
+            f"{namespace}_engine_info" + _fmt_labels({
+                **lab, "attn_impl": snap.get("attn_impl") or "unknown",
+                "unified": ("on" if snap.get("unified") else "off")})
+            + " 1")
+        lines.append(f"{namespace}_unified_steps_total"
+                     + _fmt_labels(lab)
+                     + f" {snap.get('unified_steps', 0)}")
+        lines.append(f"{namespace}_prefill_stall_steps_total"
+                     + _fmt_labels(lab)
+                     + f" {snap.get('prefill_stall_steps', 0)}")
+        if snap.get("packed_tokens_per_step") is not None:
+            _hist_lines(f"{namespace}_packed_tokens_per_step",
+                        snap["packed_tokens_per_step"], lab, lines)
         for outcome in ("completed", "cancelled", "timeout", "aborted"):
             lines.append(
                 f"{namespace}_requests_total"

@@ -102,6 +102,32 @@ class Scheduler:
             grants.append((slot, req))
         return grants
 
+    def pack_tokens(self, budget: int, width: int,
+                    prefill_remaining: Dict[int, int]
+                    ) -> Tuple[List[int], Dict[int, int]]:
+        """Unified-step token packing (the PACK-instead-of-ALTERNATE
+        policy): every DECODE slot gets its one token — a resident
+        decoder is never stalled by prefill work — and mid-PREFILL
+        slots then split the SPARE budget (`budget` minus decode
+        tokens) in slot order, each taking at most `width` prompt
+        tokens this step. `prefill_remaining` maps mid-prefill slots to
+        their unprefilled prompt token counts. Returns
+        (decode_slots, {slot: tokens granted this step}); a prefill
+        slot that gets no grant simply idles one step (its q_len is 0 —
+        no state changes, no retrace)."""
+        decode_slots = [s for s, r in sorted(self.running.items())
+                        if r.state is RequestState.DECODE]
+        spare = max(0, budget - len(decode_slots))
+        grants: Dict[int, int] = {}
+        for slot in sorted(prefill_remaining):
+            if spare <= 0:
+                break
+            take = min(prefill_remaining[slot], width, spare)
+            if take > 0:
+                grants[slot] = take
+                spare -= take
+        return decode_slots, grants
+
     def retire(self, slot: int) -> Optional[Request]:
         """Evict policy endpoint: free a slot (EOS / max-tokens /
         timeout / cancel all land here, decided by the engine)."""

@@ -544,6 +544,41 @@ def main():
                ptab, posv)
     note("paged_attn_kernel_ms", round(t * 1e3, 3))
 
+    # (13) ragged-mix A/B — the unified-step attention shape: half the
+    # batch decoding (q_len 1), half mid-prefill (q_len = W), over the
+    # same pools. "unified" is ONE ragged invocation; "alternating" is
+    # the old two-family shape — the single-token kernel over the
+    # decode rows plus one batch-1 chunk attend per prefill row (what
+    # an engine step used to dispatch). On CPU this times the pure-JAX
+    # references; run on the chip for the kernel's dead-block skipping.
+    from paddle_tpu.ops.pallas.paged_attention import \
+        ragged_paged_attention
+    W = 16
+    qlen_mix = np.ones((B,), np.int32)
+    qlen_mix[B // 2:] = W
+    qlen_mixv = jnp.asarray(qlen_mix)
+    qrag = rnd(B, W, NH, D)
+
+    t = timeit(jax.jit(ragged_paged_attention), qrag, kpool, vpool,
+               ptab, posv, qlen_mixv)
+    note("ragged_mix_unified_ms", round(t * 1e3, 3))
+
+    def alternating(qr, kp_, vp_, pt_, p_):
+        # decode family: one single-token kernel call over the
+        # decoding half; prefill family: one batch-1 W-wide gathered
+        # attend per mid-prefill row (timing shape of the old chunk
+        # programs — the window math differs per query but the cost
+        # does not)
+        outs = [paged_decode_attention(
+            qr[:B // 2, :1], kp_, vp_, pt_[:B // 2], p_[:B // 2])]
+        for b in range(B // 2, B):
+            outs.append(paged_gather_attend(
+                qr[b:b + 1], kp_, vp_, pt_[b:b + 1], p_[b:b + 1]))
+        return outs
+
+    t = timeit(jax.jit(alternating), qrag, kpool, vpool, ptab, posv)
+    note("ragged_mix_alternating_ms", round(t * 1e3, 3))
+
     # roofline bookkeeping
     wbytes = sum(int(np.prod(w.shape)) for w in Wqkv + Wout + W1 + W2) * 2
     ebytes = int(np.prod(E.shape)) * 2

@@ -127,6 +127,20 @@ def _cases():
              paddle.to_tensor(np.arange(1, 65, dtype=np.int32)
                               .reshape(8, 8)),
              paddle.to_tensor(np.full((8,), 100, np.int32)))),
+        # ragged generalization (the serving engine's UNIFIED step):
+        # the same pools, but a mixed batch — decode rows (q_len 1)
+        # next to mid-prefill rows (q_len up to the step width 16)
+        # through one invocation
+        "ragged_paged_attention": lambda: (
+            lambda q, kp, vp, pt, pos, ql: apply_op(
+                "ragged_paged_attention", q, kp, vp, pt, pos, ql),
+            (t(8, 16, 8, 64), t(65, 16, 8, 64), t(65, 16, 8, 64),
+             paddle.to_tensor(np.arange(1, 65, dtype=np.int32)
+                              .reshape(8, 8)),
+             paddle.to_tensor(np.asarray(
+                 [100, 96, 88, 100, 40, 16, 0, 64], np.int32)),
+             paddle.to_tensor(np.asarray(
+                 [1, 1, 1, 1, 16, 16, 8, 3], np.int32)))),
     }
     return cases
 

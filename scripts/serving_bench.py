@@ -11,13 +11,23 @@ in the bench trajectory. Prints ONE JSON line and writes the same
 stable-schema report to BENCH_serving.json (override with --out,
 suppress with --out -):
 
-    {"bench": "serving", "schema_version": 4, "attn_impl": "kernel",
+    {"bench": "serving", "schema_version": 5, "attn_impl": "kernel",
      "requests": ..., "ttft_p50_s": ..., "tokens_per_sec": ...,
      "decode_step_ms_p50": ..., "ab": {"kernel": {...},
-     "gather": {...}}, "prefix_stats": {...}, ...}
+     "gather": {...}}, "prefix_stats": {...}, "unified": {...}, ...}
 
 Top-level numbers are the default ("kernel") run; "ab" holds the
 per-impl summaries (tokens/s, TTFT, per-step decode wall time).
+
+`--unified-ab` adds the unified-step A/B: the SAME Poisson trace under
+a LONG-PROMPT-HEAVY mix runs once with the unified ragged
+prefill+decode step ON (one compiled program, prefill packed into
+spare decode capacity) and once OFF (the legacy alternating
+prefill-bucket/decode families), recording client-observed TTFT
+p50/p99, tokens/s, prefill-stall steps and packed tokens per step
+under the report's "unified" key — and asserts TTFT p99 does not
+regress with the unified step on (the stall-kill this step exists
+for).
 
 `--prefix-share P` builds a shared-prefix trace instead of fully
 random prompts: fraction P of the requests prepend one of K
@@ -102,6 +112,10 @@ def main():
                     "A/B over the same trace to the report")
     ap.add_argument("--prefix-prompts", type=int, default=4,
                     help="K: number of distinct shared system prompts")
+    ap.add_argument("--unified-ab", action="store_true",
+                    help="run the same Poisson trace under a "
+                    "long-prompt-heavy mix with the unified ragged "
+                    "step on vs off and record the TTFT/stall A/B")
     ap.add_argument("--http", action="store_true",
                     help="also drive the serving/http front-end over "
                     "loopback with the same Poisson trace")
@@ -125,6 +139,7 @@ def main():
         max_len = args.max_len or 64
         chunk = args.chunk or 16
         prompt_lens = [3, 5, 8]
+        long_prompt_lens = [3, 30, 40, 45]
         prefix_len = 24
     elif on_tpu:
         n_req = args.requests or 128
@@ -133,6 +148,7 @@ def main():
         max_len = args.max_len or 1024
         chunk = args.chunk or 128
         prompt_lens = [32, 64, 128, 256]
+        long_prompt_lens = [32, 384, 512, 768]
         prefix_len = 256
     else:
         n_req = args.requests or 24
@@ -141,6 +157,7 @@ def main():
         max_len = args.max_len or 128
         chunk = args.chunk or 32
         prompt_lens = [4, 8, 12, 16]
+        long_prompt_lens = [6, 60, 80, 100]
         prefix_len = 40
 
     rng = np.random.RandomState(args.seed)
@@ -170,6 +187,40 @@ def main():
             model, arrivals, prompts, budgets, slots=args.slots,
             max_len=max_len, page_size=args.page_size, pages=args.pages,
             chunk=chunk, attn_impl=attn_impl)
+
+    # the unified-step A/B: the SAME arrivals under a LONG-PROMPT-HEAVY
+    # mix (the traffic shape whose prefill chunks stall every resident
+    # decoder on the alternating path) once with the unified ragged
+    # step on, once off
+    unified_runs = {}
+    if args.unified_ab:
+        # TTFT-focused load spike: more requests than slots arriving in
+        # a burst (10x the base rate), long prompts, tiny output
+        # budgets — the prefill-stall scenario whose TTFT spikes the
+        # unified step exists to kill. Both runs replay the SAME
+        # arrivals/prompts/budgets; only the step architecture differs.
+        uni_n = max(n_req, 2 * args.slots)
+        uni_arrivals = np.cumsum(
+            rng.exponential(1.0 / (rate * 10.0), size=uni_n))
+        long_prompts = [
+            rng.randint(0, cfg.vocab_size,
+                        size=rng.choice(long_prompt_lens))
+            .astype(np.int64) for _ in range(uni_n)]
+        ttft_budgets = rng.randint(1, 3, size=uni_n)
+        for flag in (True, False):
+            # best-of-2 per arm by TTFT p99: a single OS/GC hiccup in
+            # a sub-100ms replay poisons a p99 of max-of-N samples;
+            # the MIN across repeats is the stable statistic (same
+            # convention as op_bench / decode_roofline timing)
+            attempts = [run_trace(
+                model, uni_arrivals, long_prompts, ttft_budgets,
+                slots=args.slots, max_len=max_len,
+                page_size=args.page_size, pages=args.pages,
+                chunk=chunk, attn_impl="kernel", unified=flag)
+                for _ in range(2)]
+            unified_runs["on" if flag else "off"] = min(
+                attempts,
+                key=lambda r: r["snap"]["ttft_s"]["p99"] or 0.0)
 
     # the prefix-cache A/B: the SAME shared-prefix trace with the
     # radix cache on vs off (cache pre-warmed with the K system
@@ -202,6 +253,23 @@ def main():
             "completed": s["requests"]["completed"],
         }
 
+    def _unified_summary(run):
+        s = run["snap"]
+        packed = s.get("packed_tokens_per_step") or {}
+        return {
+            "wall_s": round(run["wall_s"], 4),
+            "tokens_per_sec": s["tokens_per_sec"],
+            "ttft_p50_s": s["ttft_s"]["p50"],
+            "ttft_p99_s": s["ttft_s"]["p99"],
+            "inter_token_p99_s": s["inter_token_s"]["p99"],
+            "decode_steps": s["decode_steps"],
+            "unified_steps": s["unified_steps"],
+            "prefill_stall_steps": s["prefill_stall_steps"],
+            "packed_tokens_per_step_mean": packed.get("mean"),
+            "packed_tokens_per_step_max": packed.get("max"),
+            "completed": s["requests"]["completed"],
+        }
+
     def _prefix_summary(run):
         s = run["snap"]
         n = s["requests"]["completed"] or 1
@@ -221,7 +289,7 @@ def main():
 
     report = {
         "bench": "serving",
-        "schema_version": 4,
+        "schema_version": 5,
         "platform": jax.devices()[0].platform,
         "attn_impl": "kernel",
         "requests": n_req,
@@ -252,6 +320,13 @@ def main():
         # kernel run — nonzero only when the trace actually shares
         "prefix_stats": snap.get("prefix"),
     }
+    if unified_runs:
+        report["unified"] = {
+            "long_prompt_lens": [int(x) for x in long_prompt_lens],
+            "requests": uni_n,
+            **{flag: _unified_summary(run)
+               for flag, run in unified_runs.items()},
+        }
     if share > 0.0:
         report["prefix"] = {
             "share": share,
@@ -279,6 +354,20 @@ def main():
     for flag, run in prefix_runs.items():
         assert run["snap"]["requests"]["completed"] == n_req, \
             (flag, run["snap"]["requests"], n_req)
+    for flag, run in unified_runs.items():
+        assert run["snap"]["requests"]["completed"] == uni_n, \
+            (flag, run["snap"]["requests"], uni_n)
+    if unified_runs:
+        on, off = report["unified"]["on"], report["unified"]["off"]
+        # the acceptance numbers: packing really happened, the off
+        # path really stalled, and client-observed TTFT p99 does not
+        # regress with the unified step on (small tolerance absorbs
+        # scheduler-noise on sub-ms CPU smoke steps)
+        assert on["prefill_stall_steps"] == 0, report["unified"]
+        assert off["prefill_stall_steps"] > 0, report["unified"]
+        assert on["packed_tokens_per_step_max"] > 1, report["unified"]
+        assert on["ttft_p99_s"] <= off["ttft_p99_s"] * 1.15, \
+            report["unified"]
     if share > 0.0:
         on, off = report["prefix"]["on"], report["prefix"]["off"]
         # the acceptance number: a warm cache must do strictly less
@@ -292,10 +381,11 @@ def main():
 
 def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
               page_size, pages, chunk, attn_impl, prefix_cache=None,
-              warm_prompts=()):
+              warm_prompts=(), unified=None):
     """One Poisson-trace replay through a fresh engine pinned to
-    `attn_impl` (and, for the prefix A/B, to `prefix_cache` on/off);
-    returns {snap, wall_s, engine-shape fields}. `warm_prompts` run to
+    `attn_impl` (and, for the prefix A/B, to `prefix_cache` on/off;
+    for the unified-step A/B, to `unified` on/off); returns
+    {snap, wall_s, engine-shape fields}. `warm_prompts` run to
     completion before the clock starts, so a prefix-cache run measures
     the steady state (system prompts resident) rather than cold
     compulsory misses."""
@@ -305,7 +395,7 @@ def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
     eng = ServingEngine(model, num_slots=slots, max_len=max_len,
                         page_size=page_size, num_pages=pages,
                         chunk_len=chunk, attn_impl=attn_impl,
-                        prefix_cache=prefix_cache)
+                        prefix_cache=prefix_cache, unified=unified)
 
     # warm the compiled programs so the trace measures steady state, not
     # XLA compile time: one request per distinct prompt length (chunk

@@ -907,6 +907,13 @@ ELSEWHERE = {
     **{n: EW("test_paged_attention.py",
              "paged_decode_attention|gqa_decode_attend") for n in [
         "paged_decode_attention", "gqa_decode_attend"]},
+    # ragged generalization (per-row q_len — the serving engine's
+    # unified prefill+decode step): interpret-mode kernel vs reference
+    # vs dense oracle over mixed q_len batches
+    # (tests/test_paged_attention.py) + unified-engine token identity
+    # (tests/test_serving_unified.py)
+    "ragged_paged_attention": EW("test_paged_attention.py",
+                                 "ragged_paged_attention|Ragged"),
     # rotary embedding — tests/test_nlp_models.py (Llama family)
     "rope": EW("test_nlp_models.py", "Llama|rope"),
     "rope_dyn": EW("test_nlp_models.py", "Llama|rope"),

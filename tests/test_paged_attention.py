@@ -125,6 +125,168 @@ class TestKernelVsReference:
         assert not np.array_equal(base[2], got[2])
 
 
+def build_ragged(rng, q_len, max_pages, page_size, n_kv, head_dim,
+                 pos=None):
+    """Random pools + page tables whose live prefix covers each row's
+    pos[b] + q_len[b] positions (the chunk being written included);
+    everything past it points at the trash page 0."""
+    q_len = np.asarray(q_len, np.int32)
+    batch = q_len.size
+    if pos is None:
+        pos = rng.randint(0, max_pages * page_size // 2, size=batch)
+    pos = np.asarray(pos, np.int32)
+    n_pages = batch * max_pages + 1
+    kp = rng.randn(n_pages, page_size, n_kv, head_dim).astype(np.float32)
+    vp = rng.randn(n_pages, page_size, n_kv, head_dim).astype(np.float32)
+    pt = np.zeros((batch, max_pages), np.int32)
+    page = 1
+    for b in range(batch):
+        live = -(-(int(pos[b]) + max(int(q_len[b]), 1)) // page_size)
+        for i in range(min(live, max_pages)):
+            pt[b, i] = page
+            page += 1
+    return kp, vp, pt, pos, q_len
+
+
+class TestRaggedKernelVsReference:
+    """The RAGGED kernel (per-row q_len, interpret mode) against the
+    pure-JAX ragged reference and a dense SDPA oracle: mixed batches of
+    decode rows (q_len 1) and mid-prefill rows (q_len up to
+    page_size + 1), partial tail pages, a chunk spanning a page
+    boundary, trash-page rows and user masks on l > 1 rows."""
+
+    @pytest.fixture(autouse=True)
+    def _interpret(self, monkeypatch):
+        monkeypatch.setattr(pa, "_INTERPRET", True)
+
+    def _dense_oracle(self, q, kp, vp, pt, pos, q_len, mask=None):
+        """Row-by-row repeat_interleave + softmax over the gathered
+        dense view under the ragged causal window."""
+        b, lq, h, d = q.shape
+        ps, hkv = kp.shape[1], kp.shape[2]
+        mp = pt.shape[1]
+        lmax = mp * ps
+        rep = h // hkv
+        out = np.zeros((b, lq, h, d), np.float32)
+        for bi in range(b):
+            kf = kp[pt[bi]].reshape(lmax, hkv, d)
+            vf = vp[pt[bi]].reshape(lmax, hkv, d)
+            for i in range(int(q_len[bi])):
+                for hh in range(h):
+                    g = hh // rep
+                    s = (q[bi, i, hh] @ kf[:, g].T) / np.sqrt(d)
+                    s = s.astype(np.float64)
+                    if mask is not None:
+                        s += mask[bi, hh, i]
+                    s[np.arange(lmax) > int(pos[bi]) + i] = -np.inf
+                    a = np.exp(s - s.max())
+                    a /= a.sum()
+                    out[bi, i, hh] = a @ vf[:, g]
+        return out
+
+    @pytest.mark.parametrize("page_size", [8, 16])
+    @pytest.mark.parametrize("rep", [1, 4])
+    def test_mixed_qlen_matches_reference_and_oracle(self, page_size,
+                                                     rep):
+        rng = np.random.RandomState(page_size * 10 + rep)
+        hkv, d, mp = 2, 16, 5
+        h = hkv * rep
+        # decode row, small chunk, full-page chunk, page_size+1 chunk
+        q_len = np.array([1, 3, page_size, page_size + 1], np.int32)
+        # pos mixes: fresh row, partial tail page, chunk STARTING
+        # mid-page so the q_len=page_size+1 row spans a page boundary
+        pos = np.array([7, page_size - 2, 0, page_size // 2], np.int32)
+        kp, vp, pt, pos, q_len = build_ragged(
+            rng, q_len, mp, page_size, hkv, d, pos)
+        lq = int(q_len.max())
+        q = rng.randn(len(q_len), lq, h, d).astype(np.float32)
+        args = (jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(pt), jnp.asarray(pos), jnp.asarray(q_len))
+        ref = np.asarray(pa.ragged_attention_reference(*args))
+        out = np.asarray(pa.ragged_paged_attention(*args))  # kernel
+        oracle = self._dense_oracle(q, kp, vp, pt, pos, q_len)
+        for b in range(len(q_len)):
+            ql = int(q_len[b])
+            np.testing.assert_allclose(out[b, :ql], ref[b, :ql],
+                                       rtol=2e-5, atol=2e-6)
+            np.testing.assert_allclose(out[b, :ql], oracle[b, :ql],
+                                       rtol=1e-4, atol=1e-5)
+        assert np.isfinite(out).all()   # dead queries: finite garbage
+
+    def test_trash_rows_dead_rows_and_isolation(self):
+        """q_len == 0 rows and all-trash page tables yield finite
+        garbage; other rows' pages never leak across rows."""
+        rng = np.random.RandomState(5)
+        page_size, mp, hkv, d = 8, 4, 2, 16
+        q_len = np.array([4, 0, 6], np.int32)
+        kp, vp, pt, pos, q_len = build_ragged(
+            rng, q_len, mp, page_size, hkv, d, pos=[3, 0, 9])
+        pt[1, :] = 0                                  # trash row
+        lq = int(q_len.max())
+        q = rng.randn(3, lq, hkv, d).astype(np.float32)
+        run = lambda pool: np.asarray(pa.ragged_paged_attention(
+            jnp.asarray(q), jnp.asarray(pool), jnp.asarray(vp),
+            jnp.asarray(pt), jnp.asarray(pos), jnp.asarray(q_len)))
+        base = run(kp)
+        assert np.isfinite(base).all()
+        poisoned = kp.copy()
+        poisoned[pt[2, 0]] = 1e6                      # row 2's page
+        got = run(poisoned)
+        np.testing.assert_array_equal(base[0], got[0])
+        assert not np.array_equal(base[2, :6], got[2, :6])
+
+    def test_user_mask_composes_on_multi_token_rows(self):
+        """A per-head additive user mask composes with the ragged
+        causal window in-kernel on l > 1 rows."""
+        rng = np.random.RandomState(6)
+        page_size, mp, hkv, rep, d = 8, 4, 2, 2, 16
+        h = hkv * rep
+        q_len = np.array([1, 5, page_size + 1], np.int32)
+        kp, vp, pt, pos, q_len = build_ragged(
+            rng, q_len, mp, page_size, hkv, d, pos=[2, 6, 3])
+        lq = int(q_len.max())
+        q = rng.randn(3, lq, h, d).astype(np.float32)
+        mask = rng.randn(3, h, lq, mp * page_size).astype(np.float32)
+        args = (jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(pt), jnp.asarray(pos), jnp.asarray(q_len))
+        ref = np.asarray(pa.ragged_attention_reference(
+            *args, jnp.asarray(mask)))
+        out = np.asarray(pa.ragged_paged_attention(
+            *args, jnp.asarray(mask)))
+        oracle = self._dense_oracle(q, kp, vp, pt, pos, q_len, mask)
+        for b in range(3):
+            ql = int(q_len[b])
+            np.testing.assert_allclose(out[b, :ql], ref[b, :ql],
+                                       rtol=2e-5, atol=2e-6)
+            np.testing.assert_allclose(out[b, :ql], oracle[b, :ql],
+                                       rtol=1e-4, atol=1e-5)
+        # and the mask bites: a hard mask changes the output
+        hard = np.zeros((3, h, lq, mp * page_size), np.float32)
+        hard[:, :, :, 1:] = -1e30
+        only0 = np.asarray(pa.ragged_paged_attention(
+            *args, jnp.asarray(hard)))
+        assert not np.allclose(only0, out)
+
+    def test_l1_rows_bit_identical_to_single_token_reference(self):
+        """An all-decode ragged batch (every q_len 1) on the CPU
+        reference is BIT-identical to paged_attention_reference — the
+        contract that keeps unified-step decode rows on the proven
+        gather-path math."""
+        rng = np.random.RandomState(7)
+        page_size, mp, hkv, d = 8, 4, 2, 16
+        q_len = np.ones(3, np.int32)
+        kp, vp, pt, pos, q_len = build_ragged(
+            rng, q_len, mp, page_size, hkv, d, pos=[3, 9, 17])
+        q = rng.randn(3, 1, hkv * 2, d).astype(np.float32)
+        args = (jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(pt), jnp.asarray(pos))
+        ragged = pa.ragged_attention_reference(*args,
+                                               jnp.asarray(q_len))
+        single = pa.paged_attention_reference(*args)
+        np.testing.assert_array_equal(np.asarray(ragged),
+                                      np.asarray(single))
+
+
 class TestKernelVsGatherImpl:
     """update_and_attend dispatch: the kernel impl (pure-JAX reference
     on CPU) is bit-identical to the gather impl, with and without a

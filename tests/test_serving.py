@@ -311,8 +311,12 @@ class TestMetricsAndTrace:
         assert snap["decode_steps"] > 0
 
     def test_chrome_trace_contains_per_request_spans(self, tmp_path):
+        # pinned to the legacy alternating path (its per-chunk prefill
+        # and decode_step spans); the unified step's spans are covered
+        # in tests/test_serving_unified.py
         model = tiny_gpt()
-        eng = ServingEngine(model, num_slots=2, max_len=48)
+        eng = ServingEngine(model, num_slots=2, max_len=48,
+                            unified=False)
         with profiler.Profiler(
                 targets=[profiler.ProfilerTarget.CPU]) as p:
             r0 = eng.add_request(np.array([1, 2, 3], np.int64),
@@ -446,11 +450,13 @@ class TestPagedPoolAndChunkedPrefill:
         """The decode step stays ONE compiled program and each chunk
         bucket ONE prefill program across admissions, evictions,
         cancellations and page reuse; total prefill traces stay within
-        the O(log chunk_len) bucket bound."""
+        the O(log chunk_len) bucket bound. (Pinned to the legacy
+        alternating path — the unified step collapses all of this into
+        ONE program, asserted in tests/test_serving_unified.py.)"""
         import math
         model = tiny_gpt()
         eng = ServingEngine(model, num_slots=3, max_len=64,
-                            page_size=8, chunk_len=16)
+                            page_size=8, chunk_len=16, unified=False)
         rng = np.random.RandomState(0)
         reqs = []
         for plen in [1, 2, 3, 5, 7, 9, 12, 15, 17, 20, 23, 30]:
@@ -651,7 +657,7 @@ def test_serving_bench_smoke_writes_stable_schema(tmp_path,
     with open(out) as f:
         report = json.load(f)
     assert report["bench"] == "serving"
-    assert report["schema_version"] == 4
+    assert report["schema_version"] == 5
     for key in ("tokens_per_sec", "ttft_p50_s", "ttft_p99_s",
                 "pool_utilization_mean", "pool_utilization_max",
                 "prefill_chunks", "page_size", "num_pages",

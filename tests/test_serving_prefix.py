@@ -341,11 +341,14 @@ class TestEngineEquivalence:
     def test_no_retrace_across_hit_miss_eviction(self):
         """The compiled decode step, each prefill bucket, and the COW
         copy stay ONE program each across hits, misses, COW admissions
-        and evictions."""
+        and evictions. (Pinned to the legacy alternating path; the
+        unified step's single-program property is asserted in
+        tests/test_serving_unified.py.)"""
         import math
         model = tiny_gpt()
         eng = ServingEngine(model, num_slots=3, max_len=32,
-                            page_size=8, num_pages=9, chunk_len=16)
+                            page_size=8, num_pages=9, chunk_len=16,
+                            unified=False)
         base = np.arange(1, 10, dtype=np.int64)
         rng = np.random.RandomState(0)
         for i in range(6):
