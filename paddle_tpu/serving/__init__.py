@@ -26,8 +26,10 @@ Greedy requests are bit-identical to offline CompiledGenerator decode
 reports TTFT/throughput/pool utilization into BENCH_serving.json.
 """
 from .engine import ServingEngine, resolve_unified_flag  # noqa: F401
-from .errors import (EngineClosed, QueueFull, RateLimited,  # noqa: F401
-                     ServingError)
+from .errors import (EngineClosed, PoisonedRequest,  # noqa: F401
+                     QueueFull, RateLimited, ServingError)
+from .faults import (FaultInjector, InjectedFault,  # noqa: F401
+                     resolve_faults)
 from .metrics import (Histogram, ServingMetrics,  # noqa: F401
                       prometheus_render)
 from .paging import PagePool, chunk_bucket, pages_needed  # noqa: F401
@@ -43,4 +45,6 @@ __all__ = ["ServingEngine", "resolve_unified_flag", "Scheduler",
            "chunk_bucket", "RadixPrefixCache", "PrefixGrant",
            "resolve_prefix_cache_flag", "Request", "RequestOutput",
            "RequestState", "SamplingParams", "ServingError",
-           "QueueFull", "EngineClosed", "RateLimited"]
+           "QueueFull", "EngineClosed", "RateLimited",
+           "PoisonedRequest", "FaultInjector", "InjectedFault",
+           "resolve_faults"]

@@ -79,7 +79,7 @@ from ..profiler import RecordEvent
 from ..nlp.generation import (_pack_caches, _top_p_filter,
                               _unpack_caches, decode_model_step,
                               resolve_paged_attn_impl)
-from .errors import EngineClosed
+from .errors import EngineClosed, PoisonedRequest
 from .metrics import ServingMetrics
 from .paging import PagePool, TRASH_PAGE, chunk_bucket, pages_needed
 from .prefix import RadixPrefixCache, resolve_prefix_cache_flag
@@ -266,6 +266,13 @@ class ServingEngine:
         self._unified_fn = None      # the ONE compiled ragged step
         self._copy_page_fn = None    # COW single-page copy, jitted once
         self._spans: Dict[str, RecordEvent] = {}
+        # fault-injection hook (serving/faults.py): called with the
+        # round's participant request ids right BEFORE each compiled
+        # launch; a raise aborts the round with no state mutated. The
+        # same hook drives the poison-quarantine bisection probes, so
+        # a hook that raises deterministically for one request id IS a
+        # poisoned request. None (the default) costs nothing.
+        self.step_fault_hook = None
         # shutdown latch: flipped by drain()/abort_all(); add_request
         # raises EngineClosed once set
         self._closed = False
@@ -502,6 +509,11 @@ class ServingEngine:
             self._pt_host[slot, :] = TRASH_PAGE
             self._pt_dirty = True
         self._prefill_cursor.pop(req.request_id, None)
+        # retire the id: duplicate detection guards LIVE requests only,
+        # and a router re-placing a migrated request may legitimately
+        # reuse its id on this engine later (also caps _requests growth
+        # over a long-running server's lifetime)
+        self._requests.pop(req.request_id, None)
         req._finish(reason, now)
         self.metrics.on_finish(req, now)
         span = self._spans.pop(req.request_id, None)
@@ -607,14 +619,18 @@ class ServingEngine:
         self._last_logits = jnp.zeros((self.num_slots, vocab),
                                       jnp.float32)
 
-    def _advance_prefills(self) -> int:
+    def _advance_prefills(self, suppress=frozenset()) -> int:
         """One chunk for EACH mid-prefill slot, then back to decode —
         the interleave that keeps long prompts from stalling resident
-        decodes for more than one chunk. Returns chunks run."""
+        decodes for more than one chunk. Slots in `suppress` idle
+        (quarantine probes). Returns chunks run."""
         chunks = 0
         for slot, req in sorted(self.scheduler.running.items()):
-            if req.state is not RequestState.PREFILL:
+            if req.state is not RequestState.PREFILL \
+                    or slot in suppress:
                 continue
+            if self.step_fault_hook is not None:
+                self.step_fault_hook([req.request_id])
             self._prefill_chunk(slot, req)
             chunks += 1
             if self._prefill_cursor[req.request_id] >= \
@@ -663,47 +679,92 @@ class ServingEngine:
             self._greedy[s] = sp.greedy
         self._vec_dirty = False
 
-    def _decode(self, now_fn, finished: List[RequestOutput]):
+    def _decode(self, now_fn, finished: List[RequestOutput],
+                suppress=frozenset()):
         if self._decode_fn is None:
             self._decode_fn = self._build_decode()
         if self._vec_dirty:
             self._refresh_vectors()
-        _, pt_decode = self._page_tables()
-        key = random_mod.next_key_host()
-        t0 = time.perf_counter()
-        with RecordEvent("serving::decode_step"):
-            self._ct, self._pos, self._last_logits, toks = \
-                self._decode_fn(
-                    self._ct, self._pos, self._last_logits, pt_decode,
-                    key,
-                    jnp.asarray(self._temps), jnp.asarray(self._topk),
-                    jnp.asarray(self._topp), jnp.asarray(self._greedy),
-                    jnp.asarray(self._active))
-            toks = np.asarray(toks)   # sync point: host sees the tokens
-        # wall time of the synchronized step (the attn_impl A/B metric);
-        # real perf_counter regardless of an injected test clock
-        self.metrics.on_decode_step(time.perf_counter() - t0)
-        now = now_fn()
-        for slot, req in list(self.scheduler.running.items()):
-            if req.state is not RequestState.DECODE:
-                continue              # mid-prefill: no token this step
-            tok = int(toks[slot])
-            prev_t = req._last_token_t
-            req._emit(tok, now)
-            self.metrics.on_token(req, now)
-            if prev_t is not None:
-                self.metrics.on_inter_token(now - prev_t)
-            sp = req.sampling
-            if sp.eos_token_id is not None and tok == sp.eos_token_id:
-                self._finish_and_free(req, "stop", now, finished)
-            elif len(req.output_tokens) >= sp.max_new_tokens:
-                self._finish_and_free(req, "length", now, finished)
+        # quarantine probes suppress slots: deactivate them for this
+        # ONE invocation (their writes trash-mask, pos freezes) and
+        # afterwards restore both the active flags and their held
+        # logits rows (the decode program recomputes the whole [S, V]
+        # block; a suppressed row's output is garbage it must not keep)
+        saved_logits = self._last_logits
+        saved_active = self._active.copy() if suppress else None
+        if suppress:
+            for s in suppress:
+                self._active[s] = False
+            self._pt_dirty = True
+        ran = False
+        try:
+            if not self._active.any():
+                return
+            if self.step_fault_hook is not None:
+                ids = [r.request_id for s, r in
+                       sorted(self.scheduler.running.items())
+                       if r.state is RequestState.DECODE
+                       and s not in suppress]
+                if ids:
+                    self.step_fault_hook(ids)
+            _, pt_decode = self._page_tables()
+            key = random_mod.next_key_host()
+            t0 = time.perf_counter()
+            with RecordEvent("serving::decode_step"):
+                self._ct, self._pos, self._last_logits, toks = \
+                    self._decode_fn(
+                        self._ct, self._pos, self._last_logits,
+                        pt_decode, key,
+                        jnp.asarray(self._temps),
+                        jnp.asarray(self._topk),
+                        jnp.asarray(self._topp),
+                        jnp.asarray(self._greedy),
+                        jnp.asarray(self._active))
+                toks = np.asarray(toks)   # sync: host sees the tokens
+            ran = True
+            # wall time of the synchronized step (the attn_impl A/B
+            # metric); real perf_counter regardless of an injected
+            # test clock
+            self.metrics.on_decode_step(time.perf_counter() - t0)
+            now = now_fn()
+            for slot, req in list(self.scheduler.running.items()):
+                if req.state is not RequestState.DECODE \
+                        or slot in suppress:
+                    continue          # mid-prefill: no token this step
+                tok = int(toks[slot])
+                prev_t = req._last_token_t
+                req._emit(tok, now)
+                self.metrics.on_token(req, now)
+                if prev_t is not None:
+                    self.metrics.on_inter_token(now - prev_t)
+                sp = req.sampling
+                if sp.eos_token_id is not None \
+                        and tok == sp.eos_token_id:
+                    self._finish_and_free(req, "stop", now, finished)
+                elif len(req.output_tokens) >= sp.max_new_tokens:
+                    self._finish_and_free(req, "length", now, finished)
+        finally:
+            if suppress:
+                # restore ONLY the suppressed entries — innocents that
+                # finished during the probe must stay retired
+                for s in suppress:
+                    self._active[s] = saved_active[s]
+                self._pt_dirty = True
+                if ran:
+                    ll = np.array(self._last_logits)   # writable copy
+                    old = np.asarray(saved_logits)
+                    for s in suppress:
+                        ll[s] = old[s]
+                    self._last_logits = jnp.asarray(ll)
 
-    def _unified_step(self, finished: List[RequestOutput]) -> int:
+    def _unified_step(self, finished: List[RequestOutput],
+                      suppress=frozenset()) -> int:
         """One UNIFIED ragged step: pack this round's tokens — every
         decoding slot's next token plus as many prefill prompt tokens
         as the spare token budget allows (Scheduler.pack_tokens) — and
-        run them through THE one compiled ragged program. Returns the
+        run them through THE one compiled ragged program. Slots in
+        `suppress` ride at q_len 0 (quarantine probes): positions,
+        cursors and held logits untouched by construction. Returns the
         number of prefill tokens packed alongside the decodes (0 when
         nothing ran)."""
         running = self.scheduler.running
@@ -714,11 +775,19 @@ class ServingEngine:
             slot: int(req.prompt_ids.size)
             - self._prefill_cursor[req.request_id]
             for slot, req in running.items()
-            if req.state is RequestState.PREFILL}
+            if req.state is RequestState.PREFILL
+            and slot not in suppress}
         decode_slots, grants = self.scheduler.pack_tokens(
             self.token_budget, W, remaining)
+        if suppress:
+            decode_slots = [s for s in decode_slots
+                            if s not in suppress]
         if not decode_slots and not grants:
             return 0
+        if self.step_fault_hook is not None:
+            self.step_fault_hook(
+                [running[s].request_id for s in decode_slots]
+                + [running[s].request_id for s in sorted(grants)])
         tokens = np.zeros((self.num_slots, W), np.int32)
         q_len = np.zeros((self.num_slots,), np.int32)
         is_decode = np.zeros((self.num_slots,), bool)
@@ -783,6 +852,65 @@ class ServingEngine:
                 self._finish_and_free(req, "length", now, finished)
         return n_prefill
 
+    def _run_round(self, finished: List[RequestOutput],
+                   suppress=frozenset()) -> int:
+        """Run one round's compiled work — the unified ragged step, or
+        the legacy prefill-chunks-then-decode pair — excluding any
+        slots in `suppress` (they idle this round: positions, held
+        logits and prefill cursors untouched). Suppression exists for
+        `_quarantine_poison`'s bisection probes. Returns prefill
+        chunks run ahead of the decode (legacy path only)."""
+        if self.unified:
+            self._unified_step(finished, suppress=suppress)
+            return 0
+        chunks = self._advance_prefills(suppress)
+        if self._active.any():
+            self._decode(self._clock, finished, suppress=suppress)
+        return chunks
+
+    def _quarantine_poison(self, finished: List[RequestOutput]) -> bool:
+        """A round raised: find the ONE resident request that
+        deterministically kills the step, fail it alone (finish reason
+        "poisoned", typed `PoisonedRequest`, HTTP 422, never retried)
+        and keep the replica serving everyone else. Group-testing
+        bisection over the resident slots: each probe re-runs the
+        round with half the candidates suppressed — a probe that
+        raises exonerates the suppressed half, a probe that succeeds
+        convicts it (and the innocents it ran simply made progress).
+        The verdict is verified (a round WITHOUT the suspect must
+        succeed); an empty batch or a fault that doesn't track one
+        request returns False and the original exception propagates as
+        replica death. Assumes deterministic faults — the shape
+        `FaultInjector.poison` injects and real poison inputs show."""
+        candidates = sorted(self.scheduler.running)
+        if not candidates:
+            return False
+        while len(candidates) > 1:
+            half = frozenset(candidates[:len(candidates) // 2])
+            try:
+                self._run_round(finished, suppress=half)
+            except Exception:
+                survivors = [s for s in candidates if s not in half]
+            else:
+                survivors = list(half)
+            candidates = [s for s in survivors
+                          if s in self.scheduler.running]
+            if not candidates:
+                return False
+        slot = candidates[0]
+        req = self.scheduler.running.get(slot)
+        if req is None:
+            return False
+        try:     # verdict check: the round must succeed without it
+            self._run_round(finished, suppress=frozenset([slot]))
+        except Exception:
+            return False
+        req.error = PoisonedRequest(
+            f"request {req.request_id} deterministically kills the "
+            "serving step; quarantined")
+        self._finish_and_free(req, "poisoned", self._clock(), finished)
+        return True
+
     def step(self) -> List[RequestOutput]:
         """One scheduler round: evict (timeout/cancel), admit queued
         requests whose pages fit, then run the round's tokens. With the
@@ -791,19 +919,21 @@ class ServingEngine:
         prompt never stalls a resident decoder. On the legacy
         alternating path (PADDLE_TPU_UNIFIED_STEP=off) it is one
         prefill chunk per mid-prefill slot, then one compiled decode
-        step for every decoding slot. Returns requests that finished
-        this round."""
+        step for every decoding slot. A round that RAISES goes through
+        poison quarantine (`_quarantine_poison`): if exactly one
+        resident deterministically kills the step, it alone fails and
+        the replica keeps serving; otherwise the exception propagates
+        (replica death). Returns requests that finished this round."""
         finished: List[RequestOutput] = []
         now = self._clock()
         self._evict(now, finished)
         self._admit(now)
-        if self.unified:
-            self._unified_step(finished)
-            chunks = 0   # packed prefill never stalls a decode
-        else:
-            chunks = self._advance_prefills()
-            if self._active.any():
-                self._decode(self._clock, finished)
+        chunks = 0
+        try:
+            chunks = self._run_round(finished)
+        except Exception:
+            if not self._quarantine_poison(finished):
+                raise
         self.metrics.on_step(self.scheduler.queue_depth,
                              self.scheduler.occupancy, self.num_slots,
                              pages_used=self.pool.used_pages,

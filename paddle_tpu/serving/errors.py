@@ -6,13 +6,15 @@ string-matching exception text:
 - `QueueFull`      -> 429 Too Many Requests (+ Retry-After)
 - `RateLimited`    -> 429 Too Many Requests (+ Retry-After, per client)
 - `EngineClosed`   -> 503 Service Unavailable (draining / shut down)
+- `PoisonedRequest`-> 422 Unprocessable (this request kills the step)
 
 All subclass `ServingError(RuntimeError)`, so pre-existing callers
 that caught RuntimeError keep working.
 """
 from __future__ import annotations
 
-__all__ = ["ServingError", "QueueFull", "EngineClosed", "RateLimited"]
+__all__ = ["ServingError", "QueueFull", "EngineClosed", "RateLimited",
+           "PoisonedRequest"]
 
 
 class ServingError(RuntimeError):
@@ -46,3 +48,11 @@ class EngineClosed(ServingError):
     """The engine began shutdown (drain() or abort_all()): no new
     requests are admitted; residents run to completion (drain) or are
     force-retired (abort)."""
+
+
+class PoisonedRequest(ServingError):
+    """This ONE request deterministically kills the serving step. The
+    engine's quarantine isolated it by bisecting the resident batch,
+    failed it alone (finish reason "poisoned", HTTP 422) and kept the
+    replica serving its co-residents. Never retried or migrated —
+    replaying a poisoned request would kill the next replica too."""

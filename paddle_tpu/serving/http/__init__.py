@@ -21,17 +21,20 @@ replicas, and `ServingHTTPServer` exposes OpenAI-style
 """
 from typing import Optional, Sequence
 
-from .driver import EngineDriver, ReplicaDead  # noqa: F401
+from ..faults import FaultInjector, InjectedFault, resolve_faults  # noqa: F401,E501
+from .driver import EngineDriver, ReplicaDead, ReplicaHung  # noqa: F401
 from .protocol import (CompletionRequest, ProtocolError,  # noqa: F401
                        parse_completion_request)
 from .ratelimit import RateLimiter, TokenBucket  # noqa: F401
-from .router import Router, Ticket  # noqa: F401
+from .router import (CircuitBreaker, ReplicaWatchdog,  # noqa: F401
+                     Router, Ticket)
 from .server import ServingHTTPServer  # noqa: F401
 
-__all__ = ["EngineDriver", "ReplicaDead", "Router", "Ticket",
+__all__ = ["EngineDriver", "ReplicaDead", "ReplicaHung", "Router",
+           "Ticket", "CircuitBreaker", "ReplicaWatchdog",
            "ServingHTTPServer", "ProtocolError", "CompletionRequest",
            "parse_completion_request", "RateLimiter", "TokenBucket",
-           "serve"]
+           "FaultInjector", "InjectedFault", "resolve_faults", "serve"]
 
 
 def serve(engines: Sequence, host: str = "127.0.0.1", port: int = 0,
@@ -40,17 +43,32 @@ def serve(engines: Sequence, host: str = "127.0.0.1", port: int = 0,
           max_retries: int = 3,
           poll_interval_s: float = 0.05,
           rate_limit: Optional[float] = None,
-          rate_limit_burst: Optional[float] = None) -> ServingHTTPServer:
+          rate_limit_burst: Optional[float] = None,
+          watchdog_timeout_s: Optional[float] = None,
+          breaker_failures: int = 3,
+          breaker_open_s: float = 1.0,
+          faults: Optional[FaultInjector] = None) -> ServingHTTPServer:
     """One-call assembly: wrap each engine in a driver, front them with
     a router, start the HTTP server on (host, port) — port 0 picks a
     free one (see `server.url`). `rate_limit`/`rate_limit_burst` turn
     on per-client token-bucket limiting (429 + Retry-After per API
-    key / remote address). Returns the STARTED server; call `drain()`
-    (or `install_signal_handlers()` for SIGTERM) to stop."""
-    drivers = [EngineDriver(e, name=f"replica-{i}")
+    key / remote address). `watchdog_timeout_s` starts the heartbeat
+    watchdog (a replica whose pump stalls that long is condemned and
+    its streams migrate; size it above the worst-case step time
+    including first-use compilation). `faults` injects a deterministic
+    fault schedule (serving/faults.py) — when omitted, the
+    PADDLE_TPU_FAULTS env spec is parsed (unset = no injection).
+    Returns the STARTED server; call `drain()` (or
+    `install_signal_handlers()` for SIGTERM) to stop."""
+    if faults is None:
+        faults = resolve_faults()
+    drivers = [EngineDriver(e, name=f"replica-{i}", faults=faults)
                for i, e in enumerate(engines)]
     router = Router(drivers, max_retries=max_retries,
-                    default_timeout_s=default_timeout_s)
+                    default_timeout_s=default_timeout_s,
+                    watchdog_timeout_s=watchdog_timeout_s,
+                    breaker_failures=breaker_failures,
+                    breaker_open_s=breaker_open_s)
     server = ServingHTTPServer(router, host, port,
                                model_name=model_name,
                                poll_interval_s=poll_interval_s,

@@ -9,12 +9,28 @@ stepping while any number of HTTP threads submit and stream. Tokens fan
 back out through each Request's own stream queue (`Request.next_event`)
 — the driver never blocks on a slow reader.
 
-Failure semantics: if the pump thread dies (device error, injected
+Failure semantics: if the pump thread RAISES (device error, injected
 fault), the driver marks itself dead, fails pending submissions with
 `ReplicaDead`, and force-retires every resident/queued request with
-finish reason "replica_failure" (freeing its pages). The router treats
-"replica_failure" with zero emitted tokens as retryable — those
-requests never started, so re-running them elsewhere is safe.
+finish reason "replica_failure" (freeing its pages). If the pump thread
+HANGS instead — a wedged step never raises — the heartbeat
+(`last_beat`, stamped once per pump iteration) goes stale and the
+router's watchdog calls `condemn()`, which takes the same death path
+from the outside and leaves a pending raise for the wedged thread in
+case it ever wakes. Either way the router re-places EVERY
+"replica_failure" request on a survivor: an unstarted request is simply
+resubmitted, and a request that already streamed tokens is MIGRATED
+(re-prefilled as prompt + emitted tokens; greedy decode resumes
+token-identically — see serving/http/router.py). A request the
+engine's quarantine identified as poison (it deterministically kills
+the step) is the one exception: it fails alone with reason "poisoned"
+and is never re-placed anywhere.
+
+Fault injection (`serving/faults.py`): construct with `faults=` to
+route every step boundary through `FaultInjector.on_step` (kills,
+hangs), every admission through `on_add_request`, and every engine
+round through the engine's `step_fault_hook` (poison). Without an
+injector none of the hooks exist.
 """
 from __future__ import annotations
 
@@ -26,11 +42,16 @@ from typing import Optional
 from ..errors import EngineClosed, ServingError
 from ..request import Request, SamplingParams
 
-__all__ = ["EngineDriver", "ReplicaDead"]
+__all__ = ["EngineDriver", "ReplicaDead", "ReplicaHung"]
 
 
 class ReplicaDead(ServingError):
     """The replica's driver thread is gone; resubmit elsewhere."""
+
+
+class ReplicaHung(ReplicaDead):
+    """The replica's pump stopped beating (wedged step, not a raise);
+    the watchdog condemned it."""
 
 
 class _Submission:
@@ -51,11 +72,13 @@ class EngineDriver:
 
     def __init__(self, engine, name: str = "replica-0", *,
                  poll_interval_s: float = 0.002,
-                 submit_timeout_s: float = 30.0):
+                 submit_timeout_s: float = 30.0,
+                 faults=None, condemn_grace_s: float = 1.0):
         self.engine = engine
         self.name = name
         self.poll_interval_s = float(poll_interval_s)
         self.submit_timeout_s = float(submit_timeout_s)
+        self.condemn_grace_s = float(condemn_grace_s)
         self._inbox: "queue.Queue" = queue.Queue()
         self._wake = threading.Event()
         self._stopped = threading.Event()
@@ -65,6 +88,22 @@ class EngineDriver:
         self.death_exc: Optional[BaseException] = None
         self._fault: Optional[BaseException] = None
         self.last_beat: Optional[float] = None
+        self.steps = 0            # engine steps completed by the pump
+        # serializes engine mutation between the pump thread and an
+        # external condemn(): the pump holds it around inbox service +
+        # engine.step(); condemn() takes it (bounded wait) before
+        # abort_all so a LIVE pump is never raced mid-step. A truly
+        # wedged pump blocks in the faults hook / compiled call, which
+        # run outside or under it — hence the bounded wait.
+        self._mutate_lock = threading.RLock()
+        self._death_lock = threading.Lock()
+        self._faults = faults
+        if faults is not None:
+            # poison path: the engine calls this with each round's
+            # participant request ids right before the compiled launch
+            engine.step_fault_hook = (
+                lambda ids, _f=faults, _n=name: _f.on_engine_step(_n,
+                                                                  ids))
         self._thread = threading.Thread(target=self._pump,
                                         name=f"engine-driver[{name}]",
                                         daemon=True)
@@ -77,6 +116,10 @@ class EngineDriver:
         return self
 
     @property
+    def started(self) -> bool:
+        return self._started
+
+    @property
     def dead(self) -> bool:
         return self._dead
 
@@ -86,7 +129,9 @@ class EngineDriver:
 
     @property
     def healthy(self) -> bool:
-        """Liveness probe: accepting work and the pump thread exists."""
+        """Liveness probe: accepting work and the pump thread exists.
+        A condemned-but-wedged pump (thread alive, `dead` set) is NOT
+        healthy."""
         return (self._started and not self._dead and not self._draining
                 and self._thread.is_alive())
 
@@ -107,6 +152,27 @@ class EngineDriver:
         its next step boundary and takes the replica-death path."""
         self._fault = exc or RuntimeError(f"{self.name}: injected fault")
         self._wake.set()
+
+    def condemn(self, exc: Optional[BaseException] = None):
+        """Declare this replica dead from OUTSIDE the pump thread —
+        the watchdog path for a HUNG step (a raised step takes the
+        death path through the pump itself). Marks the driver dead,
+        fails pending submissions, and force-retires residents with
+        reason "replica_failure" so their clients migrate; a pending
+        raise is left for the wedged pump in case it ever wakes (it
+        then exits without touching the engine again). Best-effort
+        mutual exclusion: waits up to `condemn_grace_s` for the step
+        lock so a merely-slow pump is never raced mid-step; a truly
+        wedged thread holds nothing and we proceed."""
+        exc = exc or ReplicaHung(f"{self.name}: heartbeat stale")
+        self._fault = exc
+        self._wake.set()
+        got = self._mutate_lock.acquire(timeout=self.condemn_grace_s)
+        try:
+            self._do_die(exc)
+        finally:
+            if got:
+                self._mutate_lock.release()
 
     # -- client-thread API -------------------------------------------------
     def submit(self, prompt_ids, sampling: Optional[SamplingParams] = None,
@@ -162,6 +228,8 @@ class EngineDriver:
             "residents": residents,
             "free_pages": eng.pool.free_pages,
             "inflight": queued + residents + self._inbox.qsize(),
+            "steps": self.steps,
+            "last_beat": self.last_beat,
         }
 
     # -- pump thread -------------------------------------------------------
@@ -170,20 +238,37 @@ class EngineDriver:
             while True:
                 if self._fault is not None:
                     raise self._fault
+                if self._faults is not None:
+                    # may sleep (hung step) or raise (injected kill);
+                    # runs OUTSIDE the mutate lock so a watchdog can
+                    # condemn and reclaim the engine while we are
+                    # wedged right here
+                    self._faults.on_step(self.name, self.steps)
+                    if self._fault is not None:
+                        raise self._fault
                 if self._draining:
                     self._fail_pending(EngineClosed(
                         f"{self.name} draining"))
-                    self.engine.drain()
+                    with self._mutate_lock:
+                        self.engine.drain()
                     return
-                self._service_inbox()
-                if self.engine.has_work:
-                    self.engine.step()
-                else:
+                worked = False
+                with self._mutate_lock:
+                    if self._dead:
+                        # condemned while wedged: the watchdog already
+                        # reclaimed the engine; just exit
+                        return
+                    self._service_inbox()
+                    if self.engine.has_work:
+                        self.engine.step()
+                        self.steps += 1
+                        worked = True
+                if not worked:
                     self._wake.wait(self.poll_interval_s)
                     self._wake.clear()
                 self.last_beat = time.monotonic()
         except BaseException as exc:   # replica death path
-            self._die(exc)
+            self._do_die(exc)
         finally:
             self._stopped.set()
 
@@ -195,6 +280,9 @@ class EngineDriver:
                 return
             if kind == "submit":
                 try:
+                    if self._faults is not None:
+                        self._faults.on_add_request(self.name,
+                                                    payload.request_id)
                     payload.request = self.engine.add_request(
                         payload.prompt_ids, payload.sampling,
                         request_id=payload.request_id)
@@ -215,13 +303,19 @@ class EngineDriver:
                 payload.error = exc
                 payload.done.set()
 
-    def _die(self, exc: BaseException):
-        self.death_exc = exc
-        self._dead = True
+    def _do_die(self, exc: BaseException):
+        """Idempotent death: exactly one caller (the raising pump OR a
+        condemning watchdog) marks the replica dead, fails pending
+        submissions, and force-retires every request (freeing pages,
+        waking every reader with reason "replica_failure" — the signal
+        the router's failover/migration keys on)."""
+        with self._death_lock:
+            if self._dead:
+                return
+            self.death_exc = exc
+            self._dead = True
         self._fail_pending(ReplicaDead(f"{self.name} died: {exc!r}"))
         try:
-            # free every page and wake every waiting reader; requests
-            # with zero tokens are retried by the router
             self.engine.abort_all("replica_failure")
         except BaseException:
             pass

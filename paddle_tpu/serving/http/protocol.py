@@ -19,8 +19,14 @@ string-matching exception text:
     QueueFull           -> 429 (+ Retry-After)
     RateLimited         -> 429 (+ Retry-After, per client key)
     EngineClosed        -> 503
-    ReplicaDead         -> 502
+    ReplicaDead         -> 502 (only after failover/migration failed)
+    PoisonedRequest     -> 422 (this request kills the step; not retried)
     timeout, 0 tokens   -> 503 (deadline passed while queued)
+
+`usage` carries two resilience fields next to the token counts:
+`cached_tokens` (prompt tokens served from the prefix cache) and
+`migrations` (how many times the request was moved to another replica
+mid-stream after its host died — the stream stayed token-identical).
 """
 from __future__ import annotations
 
@@ -31,7 +37,8 @@ from typing import Optional
 
 import numpy as np
 
-from ..errors import EngineClosed, QueueFull, RateLimited
+from ..errors import (EngineClosed, PoisonedRequest, QueueFull,
+                      RateLimited)
 from ..request import RequestOutput, SamplingParams
 from .driver import ReplicaDead
 
@@ -120,7 +127,10 @@ def _usage(out: RequestOutput) -> dict:
             "completion_tokens": len(out.token_ids),
             "total_tokens": len(out.prompt_token_ids)
             + len(out.token_ids),
-            "cached_tokens": int(getattr(out, "cached_tokens", 0) or 0)}
+            "cached_tokens": int(getattr(out, "cached_tokens", 0) or 0),
+            # mid-stream replica migrations this request survived
+            # (each one a token-identical continuation on a survivor)
+            "migrations": int(getattr(out, "migrations", 0) or 0)}
 
 
 def completion_body(ticket_id: str, model: str,
@@ -169,6 +179,8 @@ def status_for_error(exc: BaseException) -> int:
         return exc.status
     if isinstance(exc, (QueueFull, RateLimited)):
         return 429
+    if isinstance(exc, PoisonedRequest):
+        return 422
     if isinstance(exc, ReplicaDead):
         return 502
     if isinstance(exc, EngineClosed):
@@ -180,11 +192,16 @@ def status_for_output(out: RequestOutput) -> int:
     """Status of a completed non-stream request. A deadline that fired
     while the request was still QUEUED (zero tokens) is load shedding
     -> 503; a mid-decode timeout returns the partial output as 200 with
-    finish_reason "timeout"."""
+    finish_reason "timeout". "replica_failure" surfaces only after
+    failover AND migration were exhausted -> 502; "poisoned" (the
+    request itself kills the serving step; quarantined, never
+    retried) -> 422."""
     if out.finish_reason in ("stop", "length"):
         return 200
     if out.finish_reason == "timeout":
         return 503 if not out.token_ids else 200
     if out.finish_reason == "replica_failure":
         return 502
+    if out.finish_reason == "poisoned":
+        return 422
     return 503          # "aborted" (drain), "cancelled", unknown

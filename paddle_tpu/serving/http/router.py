@@ -1,68 +1,223 @@
-"""Multi-replica router: placement, failover, deadlines, drain.
+"""Multi-replica router: placement, failover, migration, health, drain.
 
 Fronts N `EngineDriver` replicas with:
 
-- **Least-loaded placement**: replicas are ranked by
-  (queue depth, inflight, -free pages) — the emptiest queue wins, free
-  KV pages break ties, so a replica whose pool is fragmented by long
-  residents yields to one with headroom.
+- **Least-loaded, health-scored placement**: replicas are ranked by
+  (breaker state, queue depth, inflight, -free pages) — a flapping
+  replica (open/half-open breaker) yields to a clean one, the emptiest
+  queue wins among equals, free KV pages break ties.
 - **Typed load shedding**: when every healthy replica's admission queue
   is full, `submit` re-raises `QueueFull` (HTTP 429 + Retry-After);
   when none is healthy (or the router is draining), `EngineClosed`
   (HTTP 503).
-- **Retry of UNSTARTED requests**: a request that dies with reason
-  "replica_failure" and zero emitted tokens never started decoding —
-  the `Ticket` transparently resubmits it on a surviving replica with
-  capped exponential backoff + full jitter. Requests that already
-  streamed tokens are NOT retried (the client saw output; replaying
-  could diverge for sampled requests).
+- **Failover for EVERY request a replica death touches** — not just
+  unstarted ones. A request that dies with reason "replica_failure"
+  and zero emitted tokens is transparently resubmitted on a survivor.
+  A request that already STREAMED tokens is MIGRATED mid-stream: the
+  `Ticket` banks the emitted history, re-places
+  `prompt + emitted_tokens` on a survivor (re-prefill is cheap — the
+  prefix cache often already holds most of it), shrinks the remaining
+  token budget by the same amount, and resumes the stream where it
+  stopped. Greedy decode is deterministic, so the continuation is
+  token-identical to an uninterrupted run (asserted against the solo
+  CompiledGenerator oracle); SSE clients see at most a latency blip,
+  and `usage.migrations` reports how many blips. The first failover
+  attempt fires IMMEDIATELY; capped exponential backoff + full jitter
+  applies only between subsequent attempts. Requests quarantined as
+  POISON (finish reason "poisoned") are never re-placed.
+- **Watchdog**: a monitor thread (`watchdog_timeout_s`) condemns a
+  replica whose pump heartbeat goes stale — catching HUNG steps that
+  never raise — which force-retires its residents into the same
+  migration path.
+- **Circuit breaker per replica** (closed/open/half-open): consecutive
+  placement failures open the breaker and take the replica out of
+  rotation; after `breaker_open_s` one probe placement is allowed
+  (half-open) — success closes, failure re-opens. Watchdog kills and
+  replica deaths trip it immediately.
 - **Graceful drain**: `drain()` stops admission, drains every replica
   in parallel (residents finish, queued are aborted), and joins the
   driver threads. `/readyz` flips to 503 the moment drain begins.
 """
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import random
 import threading
 import time
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from ..errors import EngineClosed, QueueFull, ServingError
+from ..faults import InjectedFault
 from ..request import Request, RequestOutput, SamplingParams
-from .driver import EngineDriver, ReplicaDead
+from .driver import EngineDriver, ReplicaDead, ReplicaHung
 
-__all__ = ["Router", "Ticket"]
+__all__ = ["Router", "Ticket", "CircuitBreaker", "ReplicaWatchdog"]
 
 _RETRYABLE_REASON = "replica_failure"
+
+
+class CircuitBreaker:
+    """Per-replica placement gate: closed (serving) / open (shunned) /
+    half-open (one probe allowed). `failure_threshold` CONSECUTIVE
+    failures open it; after `open_s` the next `allow()` observes
+    half-open and lets a probe through — a success closes, a failure
+    re-opens. Pure unit: every transition takes `now` explicitly, so
+    tests drive it with a fake clock and no threads."""
+
+    CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+    STATE_CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+    # placement rank: half-open counts like closed — after the
+    # cooldown the flapper sits idle (it was shunned), so load-ranking
+    # naturally routes it the probe; ranking it below closed would
+    # mean it only ever recovers once every clean replica fails
+    PLACEMENT_RANK = {CLOSED: 0, HALF_OPEN: 0, OPEN: 1}
+
+    def __init__(self, failure_threshold: int = 3, open_s: float = 1.0):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = int(failure_threshold)
+        self.open_s = float(open_s)
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive = 0
+        self._opened_at: Optional[float] = None
+        self.failures_total = 0
+        self.opens_total = 0
+
+    def _state_locked(self, now: float) -> str:
+        if (self._state == self.OPEN
+                and now - self._opened_at >= self.open_s):
+            self._state = self.HALF_OPEN    # cooled off: probe allowed
+        return self._state
+
+    def state(self, now: float) -> str:
+        with self._lock:
+            return self._state_locked(now)
+
+    def allow(self, now: float) -> bool:
+        """May this replica receive a placement right now?"""
+        with self._lock:
+            return self._state_locked(now) != self.OPEN
+
+    def record_success(self, now: float):
+        with self._lock:
+            self._state = self.CLOSED
+            self._consecutive = 0
+            self._opened_at = None
+
+    def record_failure(self, now: float):
+        with self._lock:
+            self.failures_total += 1
+            st = self._state_locked(now)
+            self._consecutive += 1
+            if (st == self.HALF_OPEN
+                    or self._consecutive >= self.failure_threshold):
+                if st != self.OPEN:
+                    self.opens_total += 1
+                self._state = self.OPEN
+                self._opened_at = now
+
+    def trip(self, now: float):
+        """Immediate open — replica death / watchdog kill."""
+        with self._lock:
+            self.failures_total += 1
+            self._consecutive = max(self._consecutive,
+                                    self.failure_threshold)
+            if self._state != self.OPEN:
+                self.opens_total += 1
+            self._state = self.OPEN
+            self._opened_at = now
+
+
+class ReplicaWatchdog:
+    """Heartbeat monitor: condemns a replica whose pump has not beaten
+    for `timeout_s` — the HUNG-step detector (a step that RAISES
+    already takes the driver's own death path; a step that never
+    returns beats nothing and only this catches it). Pure logic:
+    `poll()` does one scan with the injected clock, so unit tests
+    drive it with a fake clock and fake drivers; `Router` runs it on a
+    daemon thread. `timeout_s` must exceed the worst-case legitimate
+    step time (including first-use compilation) or a slow step reads
+    as a hang."""
+
+    def __init__(self, drivers: Sequence, timeout_s: float,
+                 clock=time.monotonic, on_kill=None):
+        self.drivers = list(drivers)
+        self.timeout_s = float(timeout_s)
+        self.clock = clock
+        self.on_kill = on_kill
+        self.kills_total = 0
+
+    def poll(self) -> List:
+        """One scan; returns the drivers condemned by it."""
+        condemned = []
+        now = self.clock()
+        for d in self.drivers:
+            if not getattr(d, "started", False) or d.dead or d.draining:
+                continue
+            beat = d.last_beat
+            if beat is None:
+                continue            # pump not yet ticking
+            stale = now - beat
+            if stale > self.timeout_s:
+                d.condemn(ReplicaHung(
+                    f"{d.name}: no heartbeat for {stale:.3f}s "
+                    f"(watchdog_timeout_s={self.timeout_s})"))
+                self.kills_total += 1
+                condemned.append(d)
+                if self.on_kill is not None:
+                    self.on_kill(d)
+        return condemned
 
 
 class Ticket:
     """One client request's journey through the router — possibly
     spanning several engine-level Request attempts across replicas.
     `events()` is the single consumption point: it forwards tokens,
-    surfaces idle beats (for disconnect probing), and performs the
-    unstarted-request failover transparently."""
+    surfaces idle beats (for disconnect probing), and performs
+    failover — resubmission of unstarted requests AND mid-stream
+    migration of started ones — transparently. `output()` is the
+    merged client-facing view across every attempt."""
 
     def __init__(self, router: "Router", ticket_id: str, prompt_ids,
                  sampling: Optional[SamplingParams]):
         self.id = ticket_id
         self._router = router
-        self._prompt_ids = prompt_ids
-        self._sampling = sampling
+        self._prompt_ids = np.asarray(prompt_ids).reshape(-1)
+        self._sampling = sampling or SamplingParams()
         self.attempts = 1
+        self.migrations = 0
         self.error: Optional[ServingError] = None
+        self._history: List[int] = []   # tokens banked from dead attempts
+        self._cancelled = False
+        self._ttft_s: Optional[float] = None   # first attempt's, if any
+        # the engine-level request id is the TICKET id — stable across
+        # every attempt, never the engines' own per-replica counters:
+        # replicas number requests independently, so engine-issued ids
+        # collide across replicas, and anything keyed on a request id
+        # globally (fault injection, logs, traces) must follow the
+        # request when it migrates
         # may raise QueueFull/EngineClosed straight to the HTTP layer
-        self.driver, self.request = router._place(prompt_ids, sampling,
-                                                  exclude=())
+        self.driver, self.request = router._place(
+            self._prompt_ids, self._sampling, exclude=(),
+            request_id=self.id)
         self._tried = [self.driver]
 
     # -- consumption -------------------------------------------------------
     def events(self, poll_s: float = 0.05):
         """Yield ("token", id) / ("idle", None) / ("done", reason) /
-        ("error", exc). "idle" fires every `poll_s` with no token so the
-        caller can probe client liveness; after "done"/"error" the
-        generator returns."""
+        ("error", exc). "idle" fires every `poll_s` with no token so
+        the caller can probe client liveness. A replica death
+        ("replica_failure") triggers transparent failover: an
+        unstarted request is resubmitted, a started one is MIGRATED
+        (emitted history re-prefilled on a survivor, stream resumes
+        token-identically). Only when failover itself fails does the
+        caller see it: ("error", exc) if nothing was ever delivered,
+        else ("done", "replica_failure") closing the partial stream.
+        After "done"/"error" the generator returns."""
         while True:
             req = self.request
             kind, val = req.next_event(timeout=poll_s)
@@ -70,12 +225,15 @@ class Ticket:
                 yield ("token", val)
             elif kind == "idle":
                 yield ("idle", None)
-            elif (val == _RETRYABLE_REASON and not req.output_tokens):
+            elif val == _RETRYABLE_REASON and not self._cancelled:
                 try:
-                    self._retry()
+                    self._failover(req)
                 except ServingError as exc:
                     self.error = exc
-                    yield ("error", exc)
+                    if self._history:
+                        yield ("done", val)
+                    else:
+                        yield ("error", exc)
                     return
             else:
                 yield ("done", val)
@@ -83,40 +241,106 @@ class Ticket:
 
     def result(self, poll_s: float = 0.05) -> RequestOutput:
         """Blocking non-stream path: consume to completion. Raises the
-        terminal ServingError if every attempt failed."""
+        terminal ServingError if every attempt failed before anything
+        was delivered."""
         for kind, val in self.events(poll_s=poll_s):
             if kind == "error":
                 raise val
             if kind == "done":
                 break
-        return self.request.output()
+        return self.output()
+
+    def output(self) -> RequestOutput:
+        """Merged client-facing view of every attempt: banked history
+        + the final attempt's tokens against the ORIGINAL prompt, with
+        the migration count (usage.migrations over HTTP)."""
+        out = self.request.output()
+        if not self._history and not self.migrations:
+            return out
+        return RequestOutput(
+            request_id=out.request_id,
+            prompt_token_ids=self._prompt_ids.tolist(),
+            token_ids=self._history + list(out.token_ids),
+            finish_reason=out.finish_reason,
+            cached_tokens=out.cached_tokens,
+            migrations=self.migrations,
+            ttft_s=self._ttft_s if self._ttft_s is not None
+            else out.ttft_s,
+            queue_wait_s=out.queue_wait_s,
+            e2e_s=out.e2e_s)
 
     def cancel(self):
         """Client went away: evict the live attempt and reclaim its
-        slot/pages at the replica's next step boundary."""
-        self.driver.cancel(self.request.request_id)
+        slot/pages at the replica's next step boundary. Takes the
+        router lock so a cancel racing a mid-failover retry can never
+        target a STALE (driver, request) pair: whichever side wins the
+        lock, the attempt that survives is the one cancelled (`_retry`
+        re-checks the flag after swapping the pair in)."""
+        with self._router._lock:
+            self._cancelled = True
+            driver, request = self.driver, self.request
+        driver.cancel(request.request_id)
 
     # -- failover ----------------------------------------------------------
-    def _retry(self):
-        """Resubmit an unstarted request on another replica, capped
-        exponential backoff + full jitter between attempts."""
+    def _failover(self, dead: Request):
+        """The live attempt died with its replica. Bank whatever it
+        streamed (by finish time the stream queue has been fully
+        drained to the client, so `output_tokens` IS the delivered
+        prefix), then re-place on a survivor: the new prompt is
+        prompt + banked history and the token budget shrinks by the
+        same amount — greedy decode is deterministic, so the survivor
+        continues the exact sequence (token-identical to an
+        uninterrupted run; asserted against the solo oracle)."""
+        if self._ttft_s is None and dead.output_tokens:
+            self._ttft_s = dead.output().ttft_s
+        self._history.extend(dead.output_tokens)
+        if not self._history:
+            self._retry(self._prompt_ids, self._sampling)
+            return
+        remaining = self._sampling.max_new_tokens - len(self._history)
+        if remaining <= 0:
+            # unreachable: the engine retires at max_new_tokens before
+            # a death can leave a full budget — guard anyway
+            raise EngineClosed("no token budget left to migrate")
+        prompt = np.concatenate(
+            [self._prompt_ids,
+             np.asarray(self._history, dtype=self._prompt_ids.dtype)])
+        sampling = dataclasses.replace(self._sampling,
+                                       max_new_tokens=remaining)
+        self._retry(prompt, sampling)
+        self.migrations += 1
+        with self._router._lock:
+            self._router.migrations_total += 1
+
+    def _retry(self, prompt_ids, sampling):
+        """Re-place on another replica. Attempt 0 fires IMMEDIATELY —
+        a dead replica's requests should land on a survivor with zero
+        added latency; capped exponential backoff + full jitter only
+        paces the attempts after a failed re-placement."""
         r = self._router
         last: Optional[ServingError] = None
         for attempt in range(r.max_retries):
-            delay = min(r.backoff_cap_s,
-                        r.backoff_base_s * (2 ** attempt))
-            time.sleep(delay * r._jitter())
+            if attempt > 0:
+                delay = min(r.backoff_cap_s,
+                            r.backoff_base_s * (2 ** (attempt - 1)))
+                time.sleep(delay * r._jitter())
             try:
-                self.driver, self.request = r._place(
-                    self._prompt_ids, self._sampling,
-                    exclude=self._tried)
+                driver, request = r._place(
+                    prompt_ids, sampling, exclude=self._tried,
+                    request_id=self.id)
             except (QueueFull, EngineClosed) as exc:
                 last = exc
                 continue
-            self._tried.append(self.driver)
-            self.attempts += 1
+            # swap the live pair in under the router lock so cancel()
+            # can never act on a stale pair
             with r._lock:
+                self.driver, self.request = driver, request
+                self._tried.append(driver)
+                self.attempts += 1
                 r.retries_total += 1
+                cancelled = self._cancelled
+            if cancelled:       # cancel raced the re-placement: honor it
+                driver.cancel(request.request_id)
             return
         raise last if last is not None else EngineClosed(
             "failover retries exhausted")
@@ -127,7 +351,12 @@ class Router:
                  max_retries: int = 3, backoff_base_s: float = 0.05,
                  backoff_cap_s: float = 2.0,
                  default_timeout_s: Optional[float] = None,
-                 jitter=None):
+                 jitter=None,
+                 watchdog_timeout_s: Optional[float] = None,
+                 watchdog_interval_s: Optional[float] = None,
+                 breaker_failures: int = 3,
+                 breaker_open_s: float = 1.0,
+                 clock=time.monotonic):
         if not drivers:
             raise ValueError("router needs at least one driver")
         names = [d.name for d in drivers]
@@ -140,16 +369,53 @@ class Router:
         self.default_timeout_s = default_timeout_s
         # full jitter in (0, 1]: decorrelates thundering-herd retries
         self._jitter = jitter or (lambda: random.random() or 1.0)
+        self._clock = clock
         self._lock = threading.Lock()
         self._draining = False
         self._ids = itertools.count()
         self.retries_total = 0
+        self.migrations_total = 0
+        self.breakers: Dict[str, CircuitBreaker] = {
+            d.name: CircuitBreaker(breaker_failures, breaker_open_s)
+            for d in self.drivers}
+        self.watchdog: Optional[ReplicaWatchdog] = None
+        self._watchdog_stop = threading.Event()
+        self._watchdog_thread: Optional[threading.Thread] = None
+        self._watchdog_interval_s = None
+        if watchdog_timeout_s is not None:
+            self.watchdog = ReplicaWatchdog(
+                self.drivers, watchdog_timeout_s, clock=clock,
+                on_kill=self._on_watchdog_kill)
+            self._watchdog_interval_s = (
+                float(watchdog_interval_s) if watchdog_interval_s
+                else max(0.01, float(watchdog_timeout_s) / 4.0))
+
+    def _on_watchdog_kill(self, driver: EngineDriver):
+        self.breakers[driver.name].trip(self._clock())
+
+    @property
+    def watchdog_kills_total(self) -> int:
+        return self.watchdog.kills_total if self.watchdog else 0
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "Router":
         for d in self.drivers:
             d.start()
+        if self.watchdog is not None and self._watchdog_thread is None:
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog_loop, name="router-watchdog",
+                daemon=True)
+            self._watchdog_thread.start()
         return self
+
+    def _watchdog_loop(self):
+        while not self._watchdog_stop.wait(self._watchdog_interval_s):
+            if self._draining:
+                return
+            try:
+                self.watchdog.poll()
+            except Exception:
+                pass    # a torn stats read must not kill the monitor
 
     @property
     def draining(self) -> bool:
@@ -169,6 +435,7 @@ class Router:
         """Stop admitting, finish every resident on every replica,
         join the driver threads. Safe to call more than once."""
         self._draining = True
+        self._watchdog_stop.set()
         threads = [threading.Thread(target=d.drain, args=(timeout,),
                                     daemon=True)
                    for d in self.drivers]
@@ -180,7 +447,7 @@ class Router:
     # -- submission --------------------------------------------------------
     def submit(self, prompt_ids, sampling: Optional[SamplingParams] = None,
                ticket_id: Optional[str] = None) -> Ticket:
-        """Place a request on the least-loaded healthy replica. Raises
+        """Place a request on the least-loaded allowed replica. Raises
         QueueFull (429) when every healthy replica sheds, EngineClosed
         (503) when draining or no replica is healthy."""
         if self._draining:
@@ -193,45 +460,64 @@ class Router:
         return Ticket(self, ticket_id, prompt_ids, sampling)
 
     def _place(self, prompt_ids, sampling,
-               exclude: Sequence[EngineDriver]
+               exclude: Sequence[EngineDriver],
+               request_id: Optional[str] = None
                ) -> Tuple[EngineDriver, Request]:
         if self._draining:
             raise EngineClosed("router is draining")
-        cands = [d for d in self.drivers
-                 if d.healthy and d not in exclude]
-        if not cands:
-            # every survivor already tried: allow re-tries on them
-            # rather than failing a retryable request outright
-            cands = [d for d in self.drivers if d.healthy]
-        if not cands:
+        now = self._clock()
+        healthy = [d for d in self.drivers if d.healthy]
+        if not healthy:
             raise EngineClosed("no healthy replica")
+        # breaker gate, with a last-resort fallback: if EVERY healthy
+        # replica's breaker is open, shunning them all would turn a
+        # flap into a total outage — use them anyway
+        allowed = [d for d in healthy
+                   if self.breakers[d.name].allow(now)]
+        pool = allowed or healthy
+        # every survivor already tried: allow re-tries on them rather
+        # than failing a retryable request outright
+        cands = [d for d in pool if d not in exclude] or pool
         cands.sort(key=self._load_key)
         last: Optional[ServingError] = None
         for d in cands:
             try:
-                return d, d.submit(prompt_ids, sampling)
+                req = d.submit(prompt_ids, sampling,
+                               request_id=request_id)
             except QueueFull as exc:
+                # load, not a fault: no breaker charge
                 last = exc
-            except (ReplicaDead, EngineClosed) as exc:
+            except (ReplicaDead, EngineClosed, InjectedFault) as exc:
                 # raced into death/drain between the health check and
-                # the submit; try the next candidate
+                # the submit (or an injected admission fault): charge
+                # the breaker, try the next candidate
+                self.breakers[d.name].record_failure(self._clock())
                 last = exc
+            else:
+                self.breakers[d.name].record_success(self._clock())
+                return d, req
         if isinstance(last, QueueFull):
             raise last
         raise EngineClosed("no replica accepted the request") from last
 
-    @staticmethod
-    def _load_key(d: EngineDriver):
+    def _load_key(self, d: EngineDriver):
         s = d.stats()
-        return (s["queue_depth"], s["inflight"], -s["free_pages"])
+        rank = CircuitBreaker.PLACEMENT_RANK[
+            self.breakers[d.name].state(self._clock())]
+        return (rank, s["queue_depth"], s["inflight"], -s["free_pages"])
 
     # -- observability -----------------------------------------------------
     def stats(self) -> dict:
+        now = self._clock()
         return {
             "ready": self.ready,
             "draining": self._draining,
             "replicas": [d.stats() for d in self.drivers],
             "retries_total": self.retries_total,
+            "migrations_total": self.migrations_total,
+            "watchdog_kills_total": self.watchdog_kills_total,
+            "breakers": {name: b.state(now)
+                         for name, b in self.breakers.items()},
         }
 
     def metrics_snapshots(self) -> dict:

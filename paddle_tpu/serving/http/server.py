@@ -17,8 +17,13 @@ Endpoints:
 
 Backpressure and failure map to status codes via typed errors
 (serving/errors.py): full queue -> 429 + Retry-After, draining/closed
--> 503, replica death mid-request -> 502 (unstarted requests are
-retried on surviving replicas before any error surfaces).
+-> 503, a poisoned request (it deterministically kills the serving
+step; quarantined by the engine, never retried) -> 422, replica death
+-> 502 — and a 502 surfaces only after failover AND mid-stream
+migration were exhausted: unstarted requests are resubmitted on
+survivors, started streams are migrated (prompt + emitted tokens
+re-prefilled elsewhere, the stream resumes token-identically;
+`usage.migrations` counts the blips).
 
 Per-client rate limiting (`rate_limit` req/s + `rate_limit_burst` on
 the ctor, default off): each API key (Authorization header; remote
@@ -202,8 +207,11 @@ class _Handler(BaseHTTPRequestHandler):
                     self.server.rate_limiter.rejected_total
                 extra["rate_limit_clients"] = \
                     self.server.rate_limiter.clients
+            # router= adds the resilience series: retries/migrations/
+            # watchdog-kill counters + per-replica breaker_state gauge
             text = prometheus_render(router.metrics_snapshots(),
-                                     extra_gauges=extra)
+                                     extra_gauges=extra,
+                                     router=stats)
             body = text.encode("utf-8")
             self.send_response(200)
             self.send_header("Content-Type",
@@ -272,7 +280,9 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             elif kind == "done":
                 break
-        out = ticket.request.output()
+        # merged view across attempts (mid-stream migration banks the
+        # tokens of dead attempts; usage carries the migration count)
+        out = ticket.output()
         self._send_json(status_for_output(out),
                         completion_body(ticket.id,
                                         self.server.model_name, out))
@@ -308,7 +318,7 @@ class _Handler(BaseHTTPRequestHandler):
                     self.wfile.write(SSE_DONE)
                     return
                 elif kind == "done":
-                    out = ticket.request.output()
+                    out = ticket.output()
                     self.wfile.write(sse(stream_final(ticket.id, model,
                                                       out)))
                     self.wfile.write(SSE_DONE)

@@ -122,6 +122,9 @@ class ServingMetrics:
         self.requests_cancelled = 0
         self.requests_timeout = 0
         self.requests_aborted = 0
+        # requests quarantined by the engine's poison bisection (they
+        # deterministically killed the step; HTTP 422, never retried)
+        self.requests_poisoned = 0
         self.tokens_generated = 0
         self.prompt_tokens = 0
         self.prefills = 0
@@ -221,6 +224,8 @@ class ServingMetrics:
                 self.requests_timeout += 1
             elif req.finish_reason in ("stop", "length"):
                 self.requests_completed += 1
+            elif req.finish_reason == "poisoned":
+                self.requests_poisoned += 1
             else:                 # "aborted", "replica_failure", ...
                 self.requests_aborted += 1
             self.e2e_s.record(now - req.arrival_t)
@@ -293,6 +298,7 @@ class ServingMetrics:
                 "cancelled": self.requests_cancelled,
                 "timeout": self.requests_timeout,
                 "aborted": self.requests_aborted,
+                "poisoned": self.requests_poisoned,
             },
             "tokens_generated": self.tokens_generated,
             "prompt_tokens": self.prompt_tokens,
@@ -353,13 +359,21 @@ def _hist_lines(name: str, snap: dict, labels: dict, lines: list):
                  + f" {snap['count']}")
 
 
+BREAKER_STATE_CODES = {"closed": 0, "half_open": 1, "open": 2}
+
+
 def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
-                      extra_gauges: Optional[dict] = None) -> str:
+                      extra_gauges: Optional[dict] = None,
+                      router: Optional[dict] = None) -> str:
     """Render `{replica_label: ServingMetrics.snapshot()}` as Prometheus
     text exposition (one labelled series set per replica). The HTTP
     server's `/metrics` endpoint is this function verbatim;
     `extra_gauges` adds unlabelled router-level gauges
-    (`{name: value}`)."""
+    (`{name: value}`). `router` (a `Router.stats()` dict) adds the
+    resilience series: `retries_total` / `migrations_total` /
+    `watchdog_kills_total` counters and a per-replica `breaker_state`
+    gauge (value 0 closed / 1 half_open / 2 open, with the state name
+    also riding as a label)."""
     lines = []
     for name, kind in [("requests_total", "counter"),
                        ("tokens_generated_total", "counter"),
@@ -376,6 +390,7 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                        ("prefix_resident_pages", "gauge"),
                        ("prefix_hit_rate", "gauge"),
                        ("engine_info", "gauge"),
+                       ("poisoned_total", "counter"),
                        ("unified_steps_total", "counter"),
                        ("prefill_stall_steps_total", "counter"),
                        ("packed_tokens_per_step", "histogram"),
@@ -401,11 +416,14 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
         if snap.get("packed_tokens_per_step") is not None:
             _hist_lines(f"{namespace}_packed_tokens_per_step",
                         snap["packed_tokens_per_step"], lab, lines)
-        for outcome in ("completed", "cancelled", "timeout", "aborted"):
+        for outcome in ("completed", "cancelled", "timeout", "aborted",
+                        "poisoned"):
             lines.append(
                 f"{namespace}_requests_total"
                 + _fmt_labels({**lab, "outcome": outcome})
-                + f" {snap['requests'][outcome]}")
+                + f" {snap['requests'].get(outcome, 0)}")
+        lines.append(f"{namespace}_poisoned_total" + _fmt_labels(lab)
+                     + f" {snap['requests'].get('poisoned', 0)}")
         lines.append(f"{namespace}_tokens_generated_total"
                      + _fmt_labels(lab) + f" {snap['tokens_generated']}")
         lines.append(f"{namespace}_queue_depth" + _fmt_labels(lab)
@@ -442,6 +460,21 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                     lines)
         _hist_lines(f"{namespace}_inter_token_seconds",
                     snap["inter_token_s"], lab, lines)
+    if router is not None:
+        for name in ("retries_total", "migrations_total",
+                     "watchdog_kills_total"):
+            lines.append(f"# TYPE {namespace}_{name} counter")
+            lines.append(f"{namespace}_{name} {router.get(name, 0)}")
+        breakers = router.get("breakers") or {}
+        if breakers:
+            lines.append(f"# TYPE {namespace}_breaker_state gauge")
+            for replica, state in sorted(breakers.items()):
+                code = BREAKER_STATE_CODES.get(state, -1)
+                lines.append(
+                    f"{namespace}_breaker_state"
+                    + _fmt_labels({"replica": str(replica),
+                                   "state": str(state)})
+                    + f" {code}")
     for name, value in sorted((extra_gauges or {}).items()):
         lines.append(f"# TYPE {namespace}_{name} gauge")
         lines.append(f"{namespace}_{name} {value}")

@@ -86,7 +86,11 @@ class Request:
         self.on_token = on_token
         self.state = RequestState.QUEUED
         self.output_tokens: List[int] = []
-        self.finish_reason: Optional[str] = None  # stop|length|cancelled|timeout
+        # stop|length|cancelled|timeout|replica_failure|poisoned|aborted
+        self.finish_reason: Optional[str] = None
+        # typed terminal error, when the finish reason carries one
+        # (today: PoisonedRequest attached by the engine's quarantine)
+        self.error: Optional[BaseException] = None
         self.slot: Optional[int] = None
         # KV pages granted at admission (paged pool); None while queued
         self.pages: Optional[List[int]] = None
@@ -191,6 +195,10 @@ class RequestOutput:
     # prompt tokens served from the prefix cache (OpenAI-style
     # usage.cached_tokens in the HTTP layer)
     cached_tokens: int = 0
+    # how many times this request was MIGRATED mid-stream to another
+    # replica after its host died (usage.migrations over HTTP); only
+    # the router's merged Ticket view sets it nonzero
+    migrations: int = 0
     ttft_s: Optional[float] = None
     queue_wait_s: Optional[float] = None
     e2e_s: Optional[float] = None

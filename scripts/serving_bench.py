@@ -11,10 +11,11 @@ in the bench trajectory. Prints ONE JSON line and writes the same
 stable-schema report to BENCH_serving.json (override with --out,
 suppress with --out -):
 
-    {"bench": "serving", "schema_version": 5, "attn_impl": "kernel",
+    {"bench": "serving", "schema_version": 6, "attn_impl": "kernel",
      "requests": ..., "ttft_p50_s": ..., "tokens_per_sec": ...,
      "decode_step_ms_p50": ..., "ab": {"kernel": {...},
-     "gather": {...}}, "prefix_stats": {...}, "unified": {...}, ...}
+     "gather": {...}}, "prefix_stats": {...}, "unified": {...},
+     "chaos": {...}, ...}
 
 Top-level numbers are the default ("kernel") run; "ab" holds the
 per-impl summaries (tokens/s, TTFT, per-step decode wall time).
@@ -28,6 +29,17 @@ p50/p99, tokens/s, prefill-stall steps and packed tokens per step
 under the report's "unified" key — and asserts TTFT p99 does not
 regress with the unified step on (the stall-kill this step exists
 for).
+
+`--chaos` replays the standard Poisson trace through a 2-replica HTTP
+front-end TWICE — once fault-free, once with the FaultInjector
+(serving/faults.py) killing one replica after the first token has
+streamed. Every client is an SSE stream that counts its tokens; the
+chaos run must deliver EVERY stream complete and exact
+(truncated_streams == 0, asserted — replica death is a latency blip,
+not data loss; mid-stream requests MIGRATE to the survivor). The
+report's "chaos" section records truncated/migrated stream counts,
+recovery p99 (worst client-observed inter-token gap across migrated
+streams) and goodput vs the fault-free run.
 
 `--prefix-share P` builds a shared-prefix trace instead of fully
 random prompts: fraction P of the requests prepend one of K
@@ -43,6 +55,7 @@ Usage:
     python scripts/serving_bench.py --smoke    # seconds-fast CI run
     python scripts/serving_bench.py --requests 64 --rate 50 --slots 8
     python scripts/serving_bench.py --prefix-share 0.8 --smoke
+    python scripts/serving_bench.py --chaos --smoke  # replica-kill A/B
     python scripts/serving_bench.py --http --replicas 2   # + loopback
         # HTTP trace through serving/http (mixed SSE / non-stream
         # clients): client-observed TTFT p50/p99 and tokens/s land
@@ -119,6 +132,10 @@ def main():
     ap.add_argument("--http", action="store_true",
                     help="also drive the serving/http front-end over "
                     "loopback with the same Poisson trace")
+    ap.add_argument("--chaos", action="store_true",
+                    help="replay the trace through 2 HTTP replicas "
+                    "fault-free AND with an injected replica kill "
+                    "mid-load; asserts zero truncated streams")
     ap.add_argument("--replicas", type=int, default=2,
                     help="router replicas for --http")
     ap.add_argument("--out", default="BENCH_serving.json",
@@ -289,7 +306,7 @@ def main():
 
     report = {
         "bench": "serving",
-        "schema_version": 5,
+        "schema_version": 6,
         "platform": jax.devices()[0].platform,
         "attn_impl": "kernel",
         "requests": n_req,
@@ -342,6 +359,12 @@ def main():
             slots=args.slots, page_size=args.page_size,
             pages=args.pages, replicas=args.replicas,
             seed=args.seed + 1)
+    if args.chaos:
+        report["chaos"] = chaos_trace(
+            model, cfg, n_req=n_req, rate=rate, max_new=max_new,
+            max_len=max_len, chunk=chunk, prompt_lens=prompt_lens,
+            slots=args.slots, page_size=args.page_size,
+            pages=args.pages, seed=args.seed + 2)
 
     print(json.dumps(report))
     if args.out != "-":
@@ -377,6 +400,15 @@ def main():
         assert on["hit_rate"] and on["hit_rate"] > 0, report["prefix"]
     if args.http:
         assert report["http"]["completed"] == n_req, report["http"]
+    if args.chaos:
+        chaos = report["chaos"]
+        # the acceptance number: a replica kill mid-load truncates or
+        # duplicates ZERO streams — every client got its exact greedy
+        # sequence, mid-stream requests migrated to the survivor
+        assert chaos["truncated_streams"] == 0, chaos
+        assert chaos["completed"] == n_req, chaos
+        if chaos["kills_fired"]:
+            assert chaos["migrated_streams"] >= 1, chaos
 
 
 def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
@@ -555,6 +587,175 @@ def http_trace(model, cfg, *, n_req, rate, max_new, max_len, chunk,
         "engine_decode_steps": sum(s["decode_steps"] for s in snaps),
         "engine_tokens_generated": sum(s["tokens_generated"]
                                        for s in snaps),
+    }
+
+
+def chaos_trace(model, cfg, *, n_req, rate, max_new, max_len, chunk,
+                prompt_lens, slots, page_size, pages, seed):
+    """--chaos: the SAME Poisson trace twice through a 2-replica HTTP
+    front-end — once fault-free, once with the FaultInjector killing
+    replica-0 after its first token has streamed. Every client is an
+    SSE stream that records its tokens, worst inter-token gap, and the
+    final frame's usage. Greedy + no EOS means every request must
+    finish "length" with EXACTLY its budget of tokens — so
+    `len(tokens) != budget` catches truncation AND duplication; the
+    caller asserts truncated_streams == 0. recovery_p99_s is the p99
+    of the migrated streams' worst client-observed inter-token gap
+    (the latency blip a migration costs); goodput_ratio compares
+    chaos-run token throughput against the fault-free run."""
+    import threading
+    import http.client
+
+    from paddle_tpu.serving import (FaultInjector, Histogram,
+                                    ServingEngine)
+    from paddle_tpu.serving.http import serve
+
+    rng = np.random.RandomState(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           size=rng.choice(prompt_lens)).tolist()
+               for _ in range(n_req)]
+    budgets = rng.randint(max(2, max_new // 2), max_new + 1,
+                          size=n_req)
+
+    def run(inject: bool):
+        engines = [ServingEngine(model, num_slots=slots,
+                                 max_len=max_len, page_size=page_size,
+                                 num_pages=pages, chunk_len=chunk)
+                   for _ in range(2)]
+        inj = FaultInjector(seed=seed) if inject else None
+        server = serve(engines, poll_interval_s=0.01, faults=inj,
+                       watchdog_timeout_s=10.0)
+        host, port = server.server_address[:2]
+
+        def post(body):
+            conn = http.client.HTTPConnection(host, port, timeout=300)
+            conn.request("POST", "/v1/completions", json.dumps(body),
+                         {"Content-Type": "application/json"})
+            return conn, conn.getresponse()
+
+        # compile-warm both replicas before any fault can fire (a
+        # first-use XLA compile inside the trace would read as a hang)
+        for pl in sorted(set(len(p) for p in prompts)):
+            ws = []
+            for _ in range(2):
+                def warm(pl=pl):
+                    conn, resp = post({"prompt": list(range(1, pl + 1)),
+                                       "max_tokens": 2})
+                    resp.read()
+                    conn.close()
+                ws.append(threading.Thread(target=warm))
+            for w in ws:
+                w.start()
+            for w in ws:
+                w.join()
+        for eng in engines:
+            eng.metrics.__init__()
+
+        lock = threading.Lock()
+        rows = []
+
+        def stream_client(i):
+            conn, resp = post({"prompt": prompts[i], "stream": True,
+                               "max_tokens": int(budgets[i])})
+            toks, fin, usage = [], None, {}
+            worst_gap, last_t = 0.0, time.monotonic()
+            while True:
+                line = resp.readline()
+                if not line or line.strip() == b"data: [DONE]":
+                    break
+                if not line.startswith(b"data: "):
+                    continue
+                frame = json.loads(line[6:])
+                if "error" in frame:
+                    fin = "error"
+                    continue
+                choice = frame["choices"][0]
+                if choice["token"] is not None:
+                    now = time.monotonic()
+                    worst_gap = max(worst_gap, now - last_t)
+                    last_t = now
+                    toks.append(choice["token"])
+                if choice["finish_reason"]:
+                    fin = choice["finish_reason"]
+                    usage = frame.get("usage") or {}
+            conn.close()
+            with lock:
+                rows.append({"i": i, "tokens": toks, "fin": fin,
+                             "worst_gap_s": worst_gap,
+                             "migrations": usage.get("migrations", 0)})
+
+        killer_done = threading.Event()
+
+        def killer():
+            # kill replica-0 once it has STARTED streaming (>= 1
+            # emitted token) — the mid-stream shape migration exists
+            # for; deterministic trigger, injected raise
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if engines[0].metrics.tokens_generated >= 1:
+                    inj.kill_at_step("replica-0", 0)
+                    break
+                time.sleep(0.002)
+            killer_done.set()
+
+        t0 = time.monotonic()
+        kt = None
+        if inject:
+            kt = threading.Thread(target=killer)
+            kt.start()
+        threads = []
+        for i in range(n_req):
+            wait = arrivals[i] - (time.monotonic() - t0)
+            if wait > 0:
+                time.sleep(wait)
+            threads.append(threading.Thread(target=stream_client,
+                                            args=(i,)))
+            threads[-1].start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        if kt is not None:
+            kt.join()
+        server.drain()
+        total_tokens = sum(len(r["tokens"]) for r in rows)
+        truncated = sum(
+            1 for r in rows
+            if r["fin"] != "length"
+            or len(r["tokens"]) != int(budgets[r["i"]]))
+        migrated = [r for r in rows if r["migrations"] > 0]
+        rec = Histogram()
+        for r in migrated:
+            rec.record(r["worst_gap_s"])
+        return {
+            "wall_s": round(wall, 4),
+            "completed": sum(1 for r in rows if r["fin"] == "length"),
+            "truncated_streams": truncated,
+            "migrated_streams": len(migrated),
+            "tokens_received": total_tokens,
+            "tokens_per_sec": (total_tokens / wall) if wall else None,
+            "recovery_p99_s": rec.percentile(99),
+            "kills_fired": inj.kills_fired if inj else 0,
+        }
+
+    base = run(inject=False)
+    chaos = run(inject=True)
+    ratio = (None if not base["tokens_per_sec"]
+             else (chaos["tokens_per_sec"] or 0.0)
+             / base["tokens_per_sec"])
+    return {
+        "replicas": 2,
+        "requests": n_req,
+        "killed_replica": "replica-0",
+        "kills_fired": chaos["kills_fired"],
+        "completed": chaos["completed"],
+        "truncated_streams": chaos["truncated_streams"],
+        "migrated_streams": chaos["migrated_streams"],
+        "recovery_p99_s": chaos["recovery_p99_s"],
+        "goodput_tokens_per_sec": chaos["tokens_per_sec"],
+        "fault_free_tokens_per_sec": base["tokens_per_sec"],
+        "goodput_ratio": ratio,
+        "fault_free": base,
     }
 
 

@@ -39,6 +39,12 @@ def main():
                     "(full -> HTTP 429)")
     ap.add_argument("--timeout", type=float, default=None,
                     help="default per-request deadline in seconds")
+    ap.add_argument("--watchdog-timeout", type=float, default=None,
+                    help="condemn a replica whose pump heartbeat is "
+                    "stale this long (hung-step detector); size it "
+                    "ABOVE the worst-case step time incl. first-use "
+                    "compilation. Residents of a condemned replica "
+                    "migrate to survivors")
     args = ap.parse_args()
 
     import jax
@@ -55,8 +61,11 @@ def main():
                              max_len=max_len, page_size=args.page_size,
                              chunk_len=chunk, max_queue=args.max_queue)
                for _ in range(args.replicas)]
+    # PADDLE_TPU_FAULTS (chaos spec, serving/faults.py) is parsed by
+    # serve() itself — export it to rehearse kills/hangs/poisons
     server = serve(engines, args.host, args.port,
-                   default_timeout_s=args.timeout)
+                   default_timeout_s=args.timeout,
+                   watchdog_timeout_s=args.watchdog_timeout)
     server.install_signal_handlers()
     print(f"serving {args.replicas} replica(s) of "
           f"{type(model).__name__} (vocab={cfg.vocab_size}) on "

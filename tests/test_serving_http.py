@@ -4,8 +4,9 @@ E2E invariants (ISSUE acceptance):
 - concurrent HTTP clients (mixed SSE-stream / blocking JSON) against a
   2-replica router get greedy outputs BIT-IDENTICAL to solo
   CompiledGenerator decode;
-- killing one replica mid-load loses no unstarted request (retried on
-  the survivor with backoff);
+- killing one replica mid-load loses NOTHING: unstarted requests are
+  retried on the survivor, started streams are migrated mid-stream and
+  stay token-identical;
 - graceful drain finishes residents, flips /readyz, exits with zero
   resident requests and every page back in the pool;
 - a full admission queue returns 429 with Retry-After;
@@ -338,10 +339,10 @@ class TestHTTPEndToEnd:
 
     def test_replica_kill_retries_unstarted_on_survivor(self):
         """Kill replica-0 with a resident stream + a queued (unstarted)
-        request: the stream ends with replica_failure (it already
-        emitted tokens — not replayed), the queued request is retried
-        on the survivor and completes bit-identically; liveness stays
-        green on the survivor."""
+        request: the queued request is retried on the survivor and the
+        STARTED stream is MIGRATED there mid-stream — both complete
+        bit-identically to solo decode (no truncated or duplicated
+        token); liveness stays green on the survivor."""
         model = tiny_gpt()
         server, engines, addr = make_server(
             n_replicas=2, num_slots=1, max_len=128)
@@ -349,11 +350,13 @@ class TestHTTPEndToEnd:
         try:
             pv = [1, 2, 3, 4, 5]
             want_v = oracle_greedy(model, pv, 8)
+            pa = [3, 14, 15, 9]
+            want_a = oracle_greedy(model, pa, 120)
             results = {}
 
             def stream_a():   # lands replica-0 (both empty, stable sort)
                 results["a"] = read_sse(
-                    addr, {"prompt": [3, 14, 15, 9], "max_tokens": 120})
+                    addr, {"prompt": pa, "max_tokens": 120})
 
             def block_b():    # lands replica-1 (replica-0 busy)
                 results["b"] = post_json(
@@ -382,8 +385,11 @@ class TestHTTPEndToEnd:
                 t.join(120)
 
             st_a, toks_a, fin_a = results["a"]
-            assert st_a == 200 and fin_a == "replica_failure"
-            assert len(toks_a) > 0         # started: not replayed
+            # the started stream MIGRATED to the survivor and finished
+            # token-identical to an uninterrupted solo run
+            assert st_a == 200 and fin_a == "length"
+            assert toks_a == want_a
+            assert server.router.migrations_total >= 1
             st_b, _, out_b = results["b"]
             assert st_b == 200
             assert out_b["choices"][0]["finish_reason"] == "length"
@@ -632,7 +638,7 @@ def test_serving_bench_http_smoke_appends_http_section(tmp_path,
     mod.main()
     with open(out) as f:
         report = json.load(f)
-    assert report["schema_version"] == 5         # + unified-step schema
+    assert report["schema_version"] == 6         # + chaos schema
     assert report["completed"] == 4              # in-process section
     assert report["attn_impl"] == "kernel"
     assert set(report["ab"]) == {"kernel", "gather"}
