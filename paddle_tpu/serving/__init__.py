@@ -4,10 +4,16 @@ Wraps the compiled decode path (nlp/generation.py) in a slot-based
 scheduler over a PAGED KV pool: requests arriving at different times,
 with different prompt lengths and sampling params, share ONE compiled
 unified ragged prefill+decode step (PADDLE_TPU_UNIFIED_STEP, default
-on) — decode rows at q_len 1 next to mid-prefill rows at q_len up to
-chunk_len in the same fixed-shape invocation, prefill tokens packed
-into spare decode capacity — each holding only the KV pages its
-prompt + output budget needs:
+on) — decode rows next to mid-prefill rows at q_len up to chunk_len
+in the same fixed-shape invocation, prefill tokens packed into spare
+decode capacity — each holding only the KV pages its prompt + output
+budget needs. A decode row is no longer pinned to one token per step:
+with SPECULATIVE DECODING on (PADDLE_TPU_SPEC_DECODE=ngram[:k] /
+ServingEngine(spec=...), serving/spec.py, default off) a model-free
+per-request drafter proposes up to k next tokens, the row verifies
+them at q_len 1+k through the SAME step, and the whole accepted burst
+is emitted at once — still bit-token-identical to one-at-a-time
+greedy decode:
 
     from paddle_tpu.serving import ServingEngine, SamplingParams
 
@@ -38,6 +44,8 @@ from .prefix import (PrefixGrant, RadixPrefixCache,  # noqa: F401
 from .request import (Request, RequestOutput, RequestState,  # noqa: F401
                       SamplingParams)
 from .scheduler import Scheduler  # noqa: F401
+from .spec import (Drafter, NgramDrafter, SpecConfig,  # noqa: F401
+                   resolve_spec_config)
 
 __all__ = ["ServingEngine", "resolve_unified_flag", "Scheduler",
            "ServingMetrics", "Histogram",
@@ -47,4 +55,5 @@ __all__ = ["ServingEngine", "resolve_unified_flag", "Scheduler",
            "RequestState", "SamplingParams", "ServingError",
            "QueueFull", "EngineClosed", "RateLimited",
            "PoisonedRequest", "FaultInjector", "InjectedFault",
-           "resolve_faults"]
+           "resolve_faults", "Drafter", "NgramDrafter", "SpecConfig",
+           "resolve_spec_config"]

@@ -16,16 +16,36 @@ which every row carries its own live query count (`q_len`) through the
 ragged paged-attention op: decoding rows sample their next token from
 the held logits (per-slot temperature/top-k/top-p vectors, same math
 as CompiledGenerator via `sample_logits`/`_top_p_filter`) and run it
-at q_len 1; mid-prefill rows feed up to `chunk_len` prompt tokens in
-the SAME invocation (q_len up to chunk_len); idle rows ride dead at
-q_len 0. `Scheduler.pack_tokens` decides the packing each step under a
+at q_len 1 — or, with SPECULATIVE DECODING on, at q_len 1 + k with k
+drafter-proposed tokens riding behind the sampled one (see below);
+mid-prefill rows feed up to `chunk_len` prompt tokens in the SAME
+invocation (q_len up to chunk_len); idle rows ride dead at q_len 0.
+`Scheduler.pack_tokens` decides the packing each step under a
 `token_budget` (default the full num_slots * chunk_len step shape):
 decode rows always get their token — a long prompt can NEVER stall a
-resident decoder — and prefill rows split the spare. Membership, page
-tables, q_lens and sampling params change BETWEEN invocations only —
-the one program never retraces, which is what lets XLA keep the hot
-loop one fused executable ("Operator Fusion in XLA", PAPERS.md), and
-the per-row l>1 shape is the verify path speculative decoding needs.
+resident decoder — prefill rows split the spare, and draft tokens
+take what's left. Membership, page tables, q_lens and sampling params
+change BETWEEN invocations only — the one program never retraces,
+which is what lets XLA keep the hot loop one fused executable
+("Operator Fusion in XLA", PAPERS.md).
+
+SPECULATIVE DECODING (serving/spec.py, PADDLE_TPU_SPEC_DECODE=
+off|ngram[:k] / ServingEngine(spec=...), default off) lifts decode
+rows past one token per step-latency WITHOUT a new program: a
+host-side per-request Drafter (model-free n-gram prompt-lookup by
+default) proposes up to k next tokens, the row feeds
+[sampled, draft_1..draft_k] at q_len 1+k through the SAME unified
+step, and greedy acceptance — computed inside that program — keeps
+the longest prefix of drafts matching the model's own argmax chain:
+the row's pos advances by 1 + accepted (rejected drafts roll back;
+their already-written KV sits past the new pos exactly like padding
+columns, overwritten before it is ever attended), the held logits
+come from the last ACCEPTED position (so the next step's sample IS
+the correction token), and the engine emits the whole verified burst.
+Every emitted token is the one sequential greedy decode would have
+produced — bit-token-identical on vs off, same oracle pattern as the
+other gates — and the prefix cache only ever indexes committed
+tokens.
 
 The legacy ALTERNATING path (PADDLE_TPU_UNIFIED_STEP=off) keeps the
 two old program families for A/B: one fixed-shape decode step for all
@@ -85,6 +105,7 @@ from .paging import PagePool, TRASH_PAGE, chunk_bucket, pages_needed
 from .prefix import RadixPrefixCache, resolve_prefix_cache_flag
 from .request import Request, RequestOutput, RequestState, SamplingParams
 from .scheduler import Scheduler
+from .spec import Drafter, resolve_spec_config
 
 __all__ = ["ServingEngine", "resolve_unified_flag"]
 
@@ -149,7 +170,7 @@ class ServingEngine:
                  max_queue: Optional[int] = None, clock=time.monotonic,
                  attn_impl: Optional[str] = None,
                  prefix_cache=None, unified=None,
-                 token_budget: Optional[int] = None):
+                 token_budget: Optional[int] = None, spec=None):
         if cache_spec is None:
             if not hasattr(model, "_decode_cache_spec"):
                 raise ValueError(
@@ -186,8 +207,9 @@ class ServingEngine:
         self.attn_impl = resolve_paged_attn_impl(attn_impl)
         # unified ragged prefill+decode step (default on): ONE compiled
         # program of width chunk_len serves every prefill/decode mix
-        # per step — decode rows at q_len 1, mid-prefill rows at q_len
-        # up to chunk_len — and the scheduler PACKS prefill tokens into
+        # per step — decode rows at q_len 1 (1 + k with speculative
+        # drafts riding along), mid-prefill rows at q_len up to
+        # chunk_len — and the scheduler PACKS prefill tokens into
         # spare decode-step capacity (token_budget) instead of
         # alternating program families. Gated by
         # ServingEngine(unified=...) / PADDLE_TPU_UNIFIED_STEP.
@@ -206,9 +228,26 @@ class ServingEngine:
                              else int(token_budget))
         if self.token_budget < 1:
             raise ValueError("token_budget must be >= 1")
+        # speculative decoding (serving/spec.py, default off): a
+        # SpecConfig when drafting is on, None otherwise. The verify
+        # pass IS a unified-step row at q_len 1+k, so speculation
+        # requires the unified path — explicitly enabling both spec
+        # and the legacy alternating step is a config error.
+        self.spec = resolve_spec_config(spec)
+        if self.spec is not None and not self.unified:
+            raise ValueError(
+                "speculative decoding requires the unified ragged "
+                "step: the verify pass rides the per-row q_len>1 "
+                "path (set unified=True / PADDLE_TPU_UNIFIED_STEP=on "
+                "or turn PADDLE_TPU_SPEC_DECODE off)")
+        # per-request drafters, created at admission for greedy
+        # requests and dropped at retirement (request_id -> Drafter)
+        self._drafters: Dict[str, Drafter] = {}
         self.metrics = metrics or ServingMetrics()
         self.metrics.attn_impl = self.attn_impl
         self.metrics.unified = self.unified
+        self.metrics.spec = (None if self.spec is None
+                             else self.spec.mode)
         self._clock = clock
         self._id_counter = itertools.count()
         self._requests: Dict[str, Request] = {}
@@ -357,21 +396,33 @@ class ServingEngine:
             state_vals, ct, pos, ll, pt, key, t, k, p, g, a))
 
     def _build_unified(self):
-        """THE one compiled ragged prefill+decode step: a fixed-shape
-        [S, chunk_len] forward where every row carries its own live
-        query count (`q_len` — 1 for decoding rows, up to chunk_len for
-        mid-prefill rows, 0 for idle/free rows) through the ragged
-        paged-attention op. Decode rows first sample their next token
-        from the held logits (per-slot params, exactly the old decode
-        step's math) and feed it at column 0; prefill rows feed their
-        prompt chunk. Each live row's last-real-token logits land back
-        in its held-logits row, and positions advance by q_len.
-        Padding columns' K/V writes land at positions >= pos + q_len —
-        never attended before the real token overwrites them — so ONE
-        trace serves every prefill/decode mix, membership change and
-        packing decision (the engine's whole point: the per-bucket
-        prefill programs AND the separate decode program collapse into
-        this)."""
+        """THE one compiled ragged prefill+decode+verify step: a
+        fixed-shape [S, chunk_len] forward where every row carries its
+        own live query count (`q_len` — 1 + granted drafts for
+        decoding rows, up to chunk_len for mid-prefill rows, 0 for
+        idle/free rows) through the ragged paged-attention op. Decode
+        rows first sample their next token from the held logits
+        (per-slot params, exactly the old decode step's math), feed it
+        at column 0 with any speculative drafts behind it; prefill
+        rows feed their prompt chunk. GREEDY ACCEPTANCE of drafts is
+        fused into the same trace: draft column i+1 is accepted iff it
+        equals the argmax of the logits at column i (the token the
+        sequential path would commit next), `accept` is the length of
+        the matching prefix, a decode row's pos advances by
+        1 + accept (REJECTED drafts roll back — their K/V stays past
+        the new pos exactly like padding columns, overwritten before
+        it is ever attended), and its held logits come from column
+        `accept` so the next step's sample is the model's own
+        correction token. Prefill rows keep the PR-6 semantics: pos
+        advances by q_len, held logits from the last real column.
+        With speculation off decode rows simply ride at q_len 1,
+        where accept is 0 by construction — SAME program, same trace,
+        zero cost; enabling speculation changes only the host-side
+        q_len/tokens values (the retrace probe asserts this). ONE
+        trace serves every prefill/decode/verify mix, membership
+        change and packing decision (the engine's whole point: the
+        per-bucket prefill programs AND the separate decode program
+        collapse into this)."""
         model = self.model
         state_vals = [t._value for t in self._state_tensors]
 
@@ -391,13 +442,31 @@ class ServingEngine:
                                         q_len=q_len)
                 logits_t, caches = model(Tensor(toks), caches=caches)
                 lg = logits_t._value.astype(jnp.float32)   # [S, W, V]
-                last_idx = jnp.maximum(q_len - 1, 0)
+                # greedy draft verification: column i's argmax is the
+                # token sequential decode would commit after column i;
+                # accept = longest prefix of draft columns 1..q_len-1
+                # matching that chain (cumprod kills everything after
+                # the first mismatch). Rows without drafts (q_len 1,
+                # prefill, idle) get accept 0 for free.
+                preds = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                match = (toks[:, 1:] == preds[:, :-1])
+                dcol = jnp.arange(tokens.shape[1] - 1,
+                                  dtype=jnp.int32)[None, :]
+                valid = dcol < (q_len - 1)[:, None]
+                accept = jnp.cumprod(
+                    jnp.where(match & valid, 1, 0), axis=1
+                ).sum(axis=1).astype(jnp.int32)
+                accept = jnp.where(is_decode, accept, 0)
+                last_idx = jnp.where(is_decode, accept,
+                                     jnp.maximum(q_len - 1, 0))
                 row_last = jnp.take_along_axis(
                     lg, last_idx[:, None, None], axis=1)[:, 0]
                 live = (q_len > 0)[:, None]
                 new_last = jnp.where(live, row_last, last_logits)
-                new_pos = pos + q_len
-                return _pack_caches(caches), new_pos, new_last, nxt
+                new_pos = pos + jnp.where(is_decode, 1 + accept,
+                                          q_len)
+                return (_pack_caches(caches), new_pos, new_last, nxt,
+                        accept)
             finally:
                 self._restore_state(originals)
 
@@ -509,6 +578,7 @@ class ServingEngine:
             self._pt_host[slot, :] = TRASH_PAGE
             self._pt_dirty = True
         self._prefill_cursor.pop(req.request_id, None)
+        self._drafters.pop(req.request_id, None)
         # retire the id: duplicate detection guards LIVE requests only,
         # and a router re-placing a migrated request may legitimately
         # reuse its id on this engine later (also caps _requests growth
@@ -604,6 +674,15 @@ class ServingEngine:
                 self._copy_page(grant.cow_src, grant.cow_dst)
                 self.prefix_cache.cow_done(grant)
             self._prefill_cursor[req.request_id] = req.cached_tokens
+            # speculative decoding: one drafter PER REQUEST, seeded by
+            # nothing but the token history it is shown each step — a
+            # migrated stream's prompt already carries its banked
+            # emitted history, so re-seeding is automatic. Only greedy
+            # requests speculate (sampled rows would need rejection
+            # sampling to stay unbiased).
+            if self.spec is not None and req.sampling.greedy:
+                self._drafters[req.request_id] = \
+                    self.spec.make_drafter()
             self.metrics.on_admit(req, self._clock())
 
     def _ensure_last_logits(self, req: Request):
@@ -757,16 +836,52 @@ class ServingEngine:
                         ll[s] = old[s]
                     self._last_logits = jnp.asarray(ll)
 
+    def _propose_drafts(self, running, suppress) -> Dict[int, np.ndarray]:
+        """Host-side drafting (speculative decoding): ask each greedy
+        DECODE slot's drafter for up to k next tokens over the
+        request's committed history (prompt + emitted). The per-slot
+        cap keeps every transient K/V write inside the request's own
+        page budget: drafts <= max_new - emitted - 1 means the deepest
+        draft position is plen + max_new - 1, the last slot admission
+        reserved — page pressure can never make speculation scribble
+        on a neighbor. Returns {slot: proposed token ids}."""
+        proposals: Dict[int, np.ndarray] = {}
+        for slot, req in sorted(running.items()):
+            if (req.state is not RequestState.DECODE
+                    or slot in suppress or not req.sampling.greedy):
+                continue
+            drafter = self._drafters.get(req.request_id)
+            if drafter is None:
+                continue
+            cap = min(self.spec.k, self.chunk_len - 1,
+                      req.sampling.max_new_tokens
+                      - len(req.output_tokens) - 1)
+            if cap <= 0:
+                continue
+            hist = np.concatenate(
+                [req.prompt_ids.astype(np.int64),
+                 np.asarray(req.output_tokens, np.int64)])
+            prop = np.asarray(drafter.propose(hist, cap)).reshape(-1)
+            if prop.size:
+                proposals[slot] = prop[:cap].astype(np.int64)
+        return proposals
+
     def _unified_step(self, finished: List[RequestOutput],
                       suppress=frozenset()) -> int:
         """One UNIFIED ragged step: pack this round's tokens — every
-        decoding slot's next token plus as many prefill prompt tokens
-        as the spare token budget allows (Scheduler.pack_tokens) — and
-        run them through THE one compiled ragged program. Slots in
-        `suppress` ride at q_len 0 (quarantine probes): positions,
-        cursors and held logits untouched by construction. Returns the
-        number of prefill tokens packed alongside the decodes (0 when
-        nothing ran)."""
+        decoding slot's next token, its granted speculative drafts,
+        plus as many prefill prompt tokens as the spare token budget
+        allows (Scheduler.pack_tokens) — and run them through THE one
+        compiled ragged program. Decode rows come back with a verified
+        burst (1 + accepted drafts, each token exactly what sequential
+        greedy decode would emit); the program already rolled pos back
+        past any rejected draft. Slots in `suppress` ride at q_len 0
+        (quarantine probes): positions, cursors and held logits
+        untouched by construction, and no drafted-but-unverified token
+        can leak — drafts are only ever emitted through the verify
+        pass of a step their slot participated in. Returns the number
+        of prefill tokens packed alongside the decodes (0 when nothing
+        ran)."""
         running = self.scheduler.running
         if not running:
             return 0
@@ -777,11 +892,18 @@ class ServingEngine:
             for slot, req in running.items()
             if req.state is RequestState.PREFILL
             and slot not in suppress}
-        decode_slots, grants = self.scheduler.pack_tokens(
-            self.token_budget, W, remaining)
+        proposals = (self._propose_drafts(running, suppress)
+                     if self.spec is not None else {})
+        decode_slots, grants, draft_grants = \
+            self.scheduler.pack_tokens(
+                self.token_budget, W, remaining,
+                draft_wanted={s: int(p.size)
+                              for s, p in proposals.items()})
         if suppress:
             decode_slots = [s for s in decode_slots
                             if s not in suppress]
+            draft_grants = {s: n for s, n in draft_grants.items()
+                            if s not in suppress}
         if not decode_slots and not grants:
             return 0
         if self.step_fault_hook is not None:
@@ -792,7 +914,10 @@ class ServingEngine:
         q_len = np.zeros((self.num_slots,), np.int32)
         is_decode = np.zeros((self.num_slots,), bool)
         for slot in decode_slots:
-            q_len[slot] = 1
+            m = draft_grants.get(slot, 0)
+            if m:
+                tokens[slot, 1:1 + m] = proposals[slot][:m]
+            q_len[slot] = 1 + m
             is_decode[slot] = True
         for slot, take in grants.items():
             req = running[slot]
@@ -808,7 +933,7 @@ class ServingEngine:
         key = random_mod.next_key_host()
         t0 = time.perf_counter()
         with RecordEvent("serving::unified_step"):
-            self._ct, self._pos, self._last_logits, toks = \
+            self._ct, self._pos, self._last_logits, toks, accept = \
                 self._unified_fn(
                     self._ct, self._pos, self._last_logits, pt_full,
                     jnp.asarray(tokens), jnp.asarray(q_len),
@@ -816,9 +941,12 @@ class ServingEngine:
                     jnp.asarray(self._temps), jnp.asarray(self._topk),
                     jnp.asarray(self._topp), jnp.asarray(self._greedy))
             toks = np.asarray(toks)   # sync point: host sees the tokens
+            accept = np.asarray(accept)
         n_prefill = int(sum(grants.values()))
+        n_drafts = int(sum(draft_grants.values()))
         self.metrics.on_unified_step(n_prefill, len(decode_slots),
-                                     time.perf_counter() - t0)
+                                     time.perf_counter() - t0,
+                                     draft_tokens=n_drafts)
         now = self._clock()
         # prefill bookkeeping: advance cursors, flip finished rows to
         # DECODE (their last real token's logits are now held — they
@@ -834,22 +962,56 @@ class ServingEngine:
                 self._active[slot] = True
                 self._vec_dirty = True
                 self._pt_dirty = True
-        # decode emission: exactly the old decode step's retirement
+        # decode emission: the old decode step's retirement, token by
+        # token over the verified burst — EOS or the token budget can
+        # end the request mid-burst, and the sequential semantics
+        # (emit the terminal token, drop everything after it) are
+        # exactly what one-at-a-time decode would have done
+        spec_drafted = spec_accepted = 0
+        spec_burst_sizes: List[int] = []
         for slot in decode_slots:
             req = running.get(slot)
             if req is None or req.state is not RequestState.DECODE:
                 continue
-            tok = int(toks[slot])
+            m = draft_grants.get(slot, 0)
+            acc = min(int(accept[slot]), m) if m else 0
+            burst = [int(toks[slot])]
+            if acc:
+                burst.extend(int(t) for t in proposals[slot][:acc])
             prev_t = req._last_token_t
-            req._emit(tok, now)
-            self.metrics.on_token(req, now)
-            if prev_t is not None:
-                self.metrics.on_inter_token(now - prev_t)
+            emitted, reason = 0, None
             sp = req.sampling
-            if sp.eos_token_id is not None and tok == sp.eos_token_id:
-                self._finish_and_free(req, "stop", now, finished)
-            elif len(req.output_tokens) >= sp.max_new_tokens:
-                self._finish_and_free(req, "length", now, finished)
+            for tok in burst:
+                req._emit(tok, now)
+                emitted += 1
+                self.metrics.on_token(req, now)
+                if sp.eos_token_id is not None \
+                        and tok == sp.eos_token_id:
+                    reason = "stop"
+                    break
+                if len(req.output_tokens) >= sp.max_new_tokens:
+                    reason = "length"
+                    break
+            # a burst lands at one step boundary: attribute the step
+            # gap ACROSS its tokens (gap/emitted each) instead of one
+            # full gap plus zeros — per-token latency percentiles stay
+            # meaningful when >1 token arrives per step
+            if prev_t is not None and emitted:
+                dt = (now - prev_t) / emitted
+                for _ in range(emitted):
+                    self.metrics.on_inter_token(dt)
+            if m:
+                acc_emitted = max(0, emitted - 1)
+                spec_drafted += m
+                spec_accepted += acc_emitted
+                req.accepted_draft_tokens += acc_emitted
+            if self.spec is not None:
+                spec_burst_sizes.append(emitted)
+            if reason is not None:
+                self._finish_and_free(req, reason, now, finished)
+        if spec_burst_sizes:
+            self.metrics.on_spec(spec_drafted, spec_accepted,
+                                 spec_burst_sizes)
         return n_prefill
 
     def _run_round(self, finished: List[RequestOutput],

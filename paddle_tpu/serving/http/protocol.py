@@ -128,6 +128,11 @@ def _usage(out: RequestOutput) -> dict:
             "total_tokens": len(out.prompt_token_ids)
             + len(out.token_ids),
             "cached_tokens": int(getattr(out, "cached_tokens", 0) or 0),
+            # completion tokens that arrived as VERIFIED speculative
+            # drafts (speculative decoding; each one skipped a full
+            # decode step and is still exactly the greedy token)
+            "accepted_draft_tokens": int(
+                getattr(out, "accepted_draft_tokens", 0) or 0),
             # mid-stream replica migrations this request survived
             # (each one a token-identical continuation on a survivor)
             "migrations": int(getattr(out, "migrations", 0) or 0)}

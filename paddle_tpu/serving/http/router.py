@@ -192,6 +192,9 @@ class Ticket:
         self.migrations = 0
         self.error: Optional[ServingError] = None
         self._history: List[int] = []   # tokens banked from dead attempts
+        # accepted speculative drafts banked from dead attempts (the
+        # live attempt's own count rides on its Request)
+        self._accepted_drafts = 0
         self._cancelled = False
         self._ttft_s: Optional[float] = None   # first attempt's, if any
         # the engine-level request id is the TICKET id — stable across
@@ -263,6 +266,8 @@ class Ticket:
             token_ids=self._history + list(out.token_ids),
             finish_reason=out.finish_reason,
             cached_tokens=out.cached_tokens,
+            accepted_draft_tokens=(self._accepted_drafts
+                                   + out.accepted_draft_tokens),
             migrations=self.migrations,
             ttft_s=self._ttft_s if self._ttft_s is not None
             else out.ttft_s,
@@ -294,6 +299,7 @@ class Ticket:
         if self._ttft_s is None and dead.output_tokens:
             self._ttft_s = dead.output().ttft_s
         self._history.extend(dead.output_tokens)
+        self._accepted_drafts += dead.accepted_draft_tokens
         if not self._history:
             self._retry(self._prompt_ids, self._sampling)
             return

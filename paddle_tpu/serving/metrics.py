@@ -25,7 +25,8 @@ from collections import deque
 from typing import Optional, Sequence
 
 __all__ = ["Histogram", "ServingMetrics", "prometheus_render",
-           "TTFT_BUCKETS", "LATENCY_BUCKETS", "PACKED_TOKEN_BUCKETS"]
+           "TTFT_BUCKETS", "LATENCY_BUCKETS", "PACKED_TOKEN_BUCKETS",
+           "SPEC_TOKEN_BUCKETS"]
 
 # fixed Prometheus-style bucket upper bounds (seconds). Fixed — not
 # adaptive — so series stay comparable across scrapes and restarts.
@@ -36,6 +37,9 @@ LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
 # per-unified-step packed token counts (decode tokens + prefill tokens
 # sharing one ragged program invocation)
 PACKED_TOKEN_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+# tokens a decode row emitted in ONE step with speculation on
+# (1 sampled + accepted drafts; 1 == nothing accepted/drafted)
+SPEC_TOKEN_BUCKETS = (1, 2, 3, 4, 6, 8, 12, 16)
 
 
 class Histogram:
@@ -161,6 +165,16 @@ class ServingMetrics:
         self.unified_steps = 0
         self.packed_prefill_tokens = 0
         self.packed_decode_tokens = 0
+        self.packed_draft_tokens = 0
+        # speculative decoding (serving/spec.py): the drafter mode tag
+        # ("ngram"; None = off) — third A/B label next to
+        # attn_impl/unified — plus the drafted-vs-accepted economics:
+        # spec_drafted_tokens counts every draft packed into a verify
+        # row, spec_accepted_tokens the subset the model confirmed
+        # AND the engine committed (acceptance rate = accepted/drafted)
+        self.spec: Optional[str] = None
+        self.spec_drafted_tokens = 0
+        self.spec_accepted_tokens = 0
         # off-path counter: engine steps where prefill chunk programs
         # ran ahead of the decode step, stalling every resident decoder
         # (the TTFT spike the unified step exists to kill; stays 0 with
@@ -172,10 +186,15 @@ class ServingMetrics:
         # synchronized wall time of one compiled decode step — the
         # number the attn_impl A/B compares
         self.decode_step_s = Histogram(buckets=LATENCY_BUCKETS)
-        # tokens packed into one unified step (prefill + decode
-        # together — the "how full is the budget" histogram)
+        # tokens packed into one unified step (prefill + decode +
+        # draft together — the "how full is the budget" histogram)
         self.packed_tokens_hist = Histogram(
             buckets=PACKED_TOKEN_BUCKETS)
+        # tokens ONE decode row emitted in ONE step with speculation
+        # on (1 + accepted drafts; mean > 1 is the whole point — the
+        # accepted-tokens-per-step number the spec A/B reports)
+        self.spec_tokens_per_step = Histogram(
+            buckets=SPEC_TOKEN_BUCKETS)
         self.queue_wait_s = Histogram()
         self.e2e_s = Histogram()
         self.queue_depth_hist = Histogram()
@@ -235,18 +254,33 @@ class ServingMetrics:
             self.decode_step_s.record(wall_s)
 
     def on_unified_step(self, prefill_tokens: int, decode_tokens: int,
-                        wall_s: float):
+                        wall_s: float, draft_tokens: int = 0):
         """One unified ragged step ran, packing `prefill_tokens` prompt
-        tokens next to `decode_tokens` sampled tokens. The wall time
-        lands in the same decode_step_s histogram the alternating path
-        records, so the on/off A/B compares like for like."""
+        tokens and `draft_tokens` speculative drafts next to
+        `decode_tokens` sampled tokens. The wall time lands in the
+        same decode_step_s histogram the alternating path records, so
+        the on/off A/B compares like for like."""
         with self._lock:
             self.unified_steps += 1
             self.packed_prefill_tokens += int(prefill_tokens)
             self.packed_decode_tokens += int(decode_tokens)
+            self.packed_draft_tokens += int(draft_tokens)
             self.packed_tokens_hist.record(
-                int(prefill_tokens) + int(decode_tokens))
+                int(prefill_tokens) + int(decode_tokens)
+                + int(draft_tokens))
             self.decode_step_s.record(wall_s)
+
+    def on_spec(self, drafted: int, accepted: int,
+                burst_sizes: Sequence[int]):
+        """One unified step's speculative outcome: `drafted` draft
+        tokens rode verify rows, `accepted` of them were confirmed and
+        committed, and each decode row emitted `burst_sizes[i]` tokens
+        (1 + its accepted drafts, truncated by EOS/budget)."""
+        with self._lock:
+            self.spec_drafted_tokens += int(drafted)
+            self.spec_accepted_tokens += int(accepted)
+            for n in burst_sizes:
+                self.spec_tokens_per_step.record(int(n))
 
     def on_prefill_chunk(self, n_tokens: int):
         with self._lock:
@@ -311,7 +345,13 @@ class ServingMetrics:
             "unified_steps": self.unified_steps,
             "packed_prefill_tokens": self.packed_prefill_tokens,
             "packed_decode_tokens": self.packed_decode_tokens,
+            "packed_draft_tokens": self.packed_draft_tokens,
             "packed_tokens_per_step": self.packed_tokens_hist.snapshot(),
+            "spec": self.spec,
+            "spec_drafted_tokens": self.spec_drafted_tokens,
+            "spec_accepted_tokens": self.spec_accepted_tokens,
+            "spec_tokens_per_step":
+                self.spec_tokens_per_step.snapshot(),
             "prefill_stall_steps": self.prefill_stall_steps,
             "decode_step_s": self.decode_step_s.snapshot(),
             "tokens_per_sec": self.tokens_per_sec,
@@ -393,6 +433,9 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                        ("poisoned_total", "counter"),
                        ("unified_steps_total", "counter"),
                        ("prefill_stall_steps_total", "counter"),
+                       ("spec_drafted_total", "counter"),
+                       ("spec_accepted_total", "counter"),
+                       ("spec_tokens_per_step", "histogram"),
                        ("packed_tokens_per_step", "histogram"),
                        ("ttft_seconds", "histogram"),
                        ("inter_token_seconds", "histogram")]:
@@ -405,7 +448,8 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
         lines.append(
             f"{namespace}_engine_info" + _fmt_labels({
                 **lab, "attn_impl": snap.get("attn_impl") or "unknown",
-                "unified": ("on" if snap.get("unified") else "off")})
+                "unified": ("on" if snap.get("unified") else "off"),
+                "spec": snap.get("spec") or "off"})
             + " 1")
         lines.append(f"{namespace}_unified_steps_total"
                      + _fmt_labels(lab)
@@ -413,6 +457,15 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
         lines.append(f"{namespace}_prefill_stall_steps_total"
                      + _fmt_labels(lab)
                      + f" {snap.get('prefill_stall_steps', 0)}")
+        lines.append(f"{namespace}_spec_drafted_total"
+                     + _fmt_labels(lab)
+                     + f" {snap.get('spec_drafted_tokens', 0)}")
+        lines.append(f"{namespace}_spec_accepted_total"
+                     + _fmt_labels(lab)
+                     + f" {snap.get('spec_accepted_tokens', 0)}")
+        if snap.get("spec_tokens_per_step") is not None:
+            _hist_lines(f"{namespace}_spec_tokens_per_step",
+                        snap["spec_tokens_per_step"], lab, lines)
         if snap.get("packed_tokens_per_step") is not None:
             _hist_lines(f"{namespace}_packed_tokens_per_step",
                         snap["packed_tokens_per_step"], lab, lines)

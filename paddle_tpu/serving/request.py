@@ -99,6 +99,10 @@ class Request:
         # handle lives here between reserve and retirement
         self.cached_tokens: int = 0
         self._prefix_grant = None
+        # speculative decoding: emitted tokens that arrived as
+        # VERIFIED drafts (each one skipped a full decode step; 0 with
+        # speculation off) — usage.accepted_draft_tokens over HTTP
+        self.accepted_draft_tokens: int = 0
         # timeline (engine clock): arrival -> admitted (slot granted,
         # prefill) -> first token -> finished
         self.arrival_t = time.monotonic() if arrival_t is None else arrival_t
@@ -171,6 +175,7 @@ class Request:
             token_ids=list(self.output_tokens),
             finish_reason=self.finish_reason,
             cached_tokens=self.cached_tokens,
+            accepted_draft_tokens=self.accepted_draft_tokens,
             ttft_s=(None if self.first_token_t is None
                     else self.first_token_t - self.arrival_t),
             queue_wait_s=(None if self.admitted_t is None
@@ -195,6 +200,9 @@ class RequestOutput:
     # prompt tokens served from the prefix cache (OpenAI-style
     # usage.cached_tokens in the HTTP layer)
     cached_tokens: int = 0
+    # emitted tokens that arrived as VERIFIED speculative drafts
+    # (usage.accepted_draft_tokens over HTTP; 0 with speculation off)
+    accepted_draft_tokens: int = 0
     # how many times this request was MIGRATED mid-stream to another
     # replica after its host died (usage.migrations over HTTP); only
     # the router's merged Ticket view sets it nonzero

@@ -103,18 +103,27 @@ class Scheduler:
         return grants
 
     def pack_tokens(self, budget: int, width: int,
-                    prefill_remaining: Dict[int, int]
-                    ) -> Tuple[List[int], Dict[int, int]]:
+                    prefill_remaining: Dict[int, int],
+                    draft_wanted: Optional[Dict[int, int]] = None
+                    ) -> Tuple[List[int], Dict[int, int],
+                               Dict[int, int]]:
         """Unified-step token packing (the PACK-instead-of-ALTERNATE
         policy): every DECODE slot gets its one token — a resident
-        decoder is never stalled by prefill work — and mid-PREFILL
-        slots then split the SPARE budget (`budget` minus decode
-        tokens) in slot order, each taking at most `width` prompt
-        tokens this step. `prefill_remaining` maps mid-prefill slots to
-        their unprefilled prompt token counts. Returns
-        (decode_slots, {slot: tokens granted this step}); a prefill
-        slot that gets no grant simply idles one step (its q_len is 0 —
-        no state changes, no retrace)."""
+        decoder is never stalled by prefill work — then mid-PREFILL
+        slots split the SPARE budget (`budget` minus decode tokens) in
+        slot order, each taking at most `width` prompt tokens this
+        step, and finally DRAFT tokens (speculative decoding's verify
+        rows, `draft_wanted` maps decode slots to proposed draft
+        counts) take whatever spare remains, at most `width - 1` per
+        slot so the row's `q_len = 1 + drafts` fits the step shape.
+        Prefill outranks drafts deliberately: a prompt token is
+        guaranteed work, a draft is a bet the verify pass may reject.
+        `prefill_remaining` maps mid-prefill slots to their
+        unprefilled prompt token counts. Returns (decode_slots,
+        {slot: prefill tokens}, {slot: draft tokens}); a prefill slot
+        that gets no grant simply idles one step (its q_len is 0 — no
+        state changes, no retrace), a decode slot granted no drafts
+        just runs its plain q_len-1 step."""
         decode_slots = [s for s, r in sorted(self.running.items())
                         if r.state is RequestState.DECODE]
         spare = max(0, budget - len(decode_slots))
@@ -126,7 +135,19 @@ class Scheduler:
             if take > 0:
                 grants[slot] = take
                 spare -= take
-        return decode_slots, grants
+        draft_grants: Dict[int, int] = {}
+        if draft_wanted:
+            decode = set(decode_slots)
+            for slot in sorted(draft_wanted):
+                if spare <= 0:
+                    break
+                if slot not in decode:
+                    continue
+                take = min(draft_wanted[slot], width - 1, spare)
+                if take > 0:
+                    draft_grants[slot] = take
+                    spare -= take
+        return decode_slots, grants, draft_grants
 
     def retire(self, slot: int) -> Optional[Request]:
         """Evict policy endpoint: free a slot (EOS / max-tokens /

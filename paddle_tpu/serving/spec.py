@@ -1,0 +1,202 @@
+"""Draft-then-verify speculative decoding for the serving engine.
+
+Decode throughput of a resident slot is otherwise pinned at ONE token
+per compiled-step latency: the step samples a token, writes its KV,
+and must run again before the next token exists. Speculative decoding
+breaks the pin without changing a single emitted token: a cheap
+DRAFTER proposes up to `k` likely next tokens from the request's own
+token history, the engine feeds `[sampled, draft_1 .. draft_k]` as a
+`q_len = 1 + k` row of THE SAME unified ragged step (PR 6's per-row
+`q_len > 1` path through `ragged_paged_attention` is exactly this
+verify shape), and greedy acceptance — computed inside the same
+compiled program — keeps the longest prefix of drafts that match the
+model's own argmax chain. Every accepted draft is a token the
+sequential path would have produced in its own full step; a rejected
+draft rolls the slot's `pos` back so its (already written) KV is
+overwritten by the next real token, exactly like the unified step's
+padding columns. Outputs therefore stay bit-token-identical to
+one-at-a-time greedy decoding — the contract the
+`PADDLE_TPU_SPEC_DECODE` on/off oracle tests pin down.
+
+The subsystem is deliberately split so the expensive part never
+changes shape:
+
+- `Drafter` (ABC): host-side proposal source, one instance PER
+  REQUEST (created at admission, re-created from prompt + banked
+  history when a stream migrates to another replica). Drafting is
+  pure host work — enabling speculation adds NO compiled program.
+- `NgramDrafter`: the model-free default — prompt-lookup over the
+  request's own prompt + output history. It finds the most recent
+  previous occurrence of the history's tail n-gram and proposes the
+  tokens that followed it, extrapolating the implied period when the
+  match overlaps the tail (so a repeating pattern drafts a full `k`
+  tokens, not just the sliver before history ran out). Zero extra
+  weights; big wins on code/templated traffic and on the repetitive
+  tails greedy decode produces.
+- `SpecConfig`: the engine-facing knob bundle (`k` drafts per slot
+  per step, drafter factory). A small draft MODEL sharing the batch
+  is a future `Drafter` subclass — the ABC takes token history in,
+  returns proposed ids out, and nothing in the engine cares how.
+
+Gated `PADDLE_TPU_SPEC_DECODE=off|ngram[:k]` (default off until
+A/B'd) or `ServingEngine(spec=...)`; requires the unified ragged step
+(the verify pass IS a unified-step row). Only greedy rows speculate:
+a sampled row's distribution would need rejection sampling to stay
+unbiased, and the serving contract here is exact greedy equivalence.
+"""
+from __future__ import annotations
+
+import os
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+__all__ = ["Drafter", "NgramDrafter", "SpecConfig",
+           "resolve_spec_config", "SPEC_DECODE_ENV"]
+
+SPEC_DECODE_ENV = "PADDLE_TPU_SPEC_DECODE"
+SPEC_MODES = ("off", "ngram")
+
+_EMPTY = np.empty((0,), np.int64)
+
+
+class Drafter(ABC):
+    """Per-request proposal source for draft-then-verify decoding.
+
+    One instance serves ONE request for its whole residency: the
+    engine constructs it at admission and calls `propose` once per
+    step with the request's full committed history (prompt + every
+    emitted token — for a migrated stream that prompt already carries
+    the banked tokens from the dead replica, so the drafter is
+    re-seeded for free). Proposals are SPECULATIVE: the engine may
+    pack fewer than proposed (token budget), and the verify pass may
+    reject any suffix — a drafter must not assume its drafts were
+    emitted. Committed tokens only ever arrive via the next call's
+    `history`.
+    """
+
+    @abstractmethod
+    def propose(self, history: np.ndarray, k: int) -> np.ndarray:
+        """Return up to `k` proposed next token ids (int array, may be
+        empty) given the committed `history` (1-D int array,
+        prompt + emitted tokens, always non-empty)."""
+
+
+class NgramDrafter(Drafter):
+    """Model-free prompt-lookup drafter (n-gram suffix matching).
+
+    Finds the most recent PREVIOUS occurrence of the history's final
+    `n`-gram (longest `n` first, `max_ngram` down to `min_ngram`) and
+    proposes the tokens that followed it. The continuation is read
+    cyclically with the period implied by the match distance
+    `d = tail_start - match_start`: index `i` proposes
+    `history[match_start + n + (i % d)]`. For a distant match this IS
+    the plain following-token window (always in bounds); for a match
+    overlapping the tail — a repeating pattern, the shape greedy
+    decode and templated/code traffic produce constantly — it unrolls
+    the period so all `k` drafts are filled instead of stopping where
+    history ends. Stateless between calls, so migration re-seeding is
+    just "construct a new one"."""
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if min_ngram < 1:
+            raise ValueError("min_ngram must be >= 1")
+        if max_ngram < min_ngram:
+            raise ValueError("max_ngram must be >= min_ngram")
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+
+    def propose(self, history: np.ndarray, k: int) -> np.ndarray:
+        h = np.asarray(history).reshape(-1).astype(np.int64)
+        n_h = int(h.size)
+        if k <= 0 or n_h < self.min_ngram + 1:
+            return _EMPTY
+        for n in range(min(self.max_ngram, n_h - 1),
+                       self.min_ngram - 1, -1):
+            tail = h[n_h - n:]
+            # windows over h[:-1] start at 0..n_h-1-n: every previous
+            # occurrence, overlapping the tail allowed (that overlap
+            # IS the period-detection that makes loops draft well)
+            wins = np.lib.stride_tricks.sliding_window_view(
+                h[:n_h - 1], n)
+            hits = np.nonzero((wins == tail).all(axis=1))[0]
+            if hits.size == 0:
+                continue
+            p = int(hits[-1])              # most recent occurrence
+            d = (n_h - n) - p              # implied period, >= 1
+            idx = p + n + (np.arange(k) % d)
+            return h[idx]
+        return _EMPTY
+
+
+def _default_drafter() -> Drafter:
+    return NgramDrafter()
+
+
+@dataclass
+class SpecConfig:
+    """Engine-facing speculative-decoding knobs.
+
+    `k` is the per-slot per-step draft budget (the verify row runs at
+    `q_len = 1 + granted drafts`, further capped by the step width and
+    the request's remaining token budget); `drafter` is a zero-arg
+    factory producing one `Drafter` PER REQUEST; `mode` is the tag
+    metrics/Prometheus report next to `attn_impl`/`unified`."""
+
+    k: int = 4
+    drafter: Callable[[], Drafter] = field(default=_default_drafter)
+    mode: str = "ngram"
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError("spec k must be >= 1")
+
+    def make_drafter(self) -> Drafter:
+        d = self.drafter()
+        if not isinstance(d, Drafter):
+            raise TypeError(
+                f"spec drafter factory returned {type(d).__name__}, "
+                "not a serving.spec.Drafter")
+        return d
+
+
+def resolve_spec_config(override=None) -> Optional[SpecConfig]:
+    """Resolve the speculative-decoding gate to a SpecConfig (on) or
+    None (off). An explicit override wins; otherwise
+    PADDLE_TPU_SPEC_DECODE=off|ngram[:k] (read at engine
+    construction, default off — same env-gate pattern as
+    PADDLE_TPU_PAGED_ATTN / PADDLE_TPU_PREFIX_CACHE /
+    PADDLE_TPU_UNIFIED_STEP). Accepted overrides: None (use the env),
+    a SpecConfig, a mode string ("off", "ngram", "ngram:8"), or a
+    bool (True = default ngram config)."""
+    if override is None:
+        spec = os.environ.get(SPEC_DECODE_ENV, "off")
+    elif isinstance(override, SpecConfig):
+        return override
+    elif isinstance(override, bool):
+        return SpecConfig() if override else None
+    elif isinstance(override, str):
+        spec = override
+    else:
+        raise TypeError(
+            f"spec must be None, bool, str or SpecConfig, got "
+            f"{type(override).__name__}")
+    mode, _, knob = spec.partition(":")
+    if mode not in SPEC_MODES:
+        raise ValueError(
+            f"{SPEC_DECODE_ENV} mode must be one of {SPEC_MODES} "
+            f"(optionally 'ngram:<k>'), got {spec!r}")
+    if mode == "off":
+        if knob:
+            raise ValueError(f"'off' takes no ':k' suffix: {spec!r}")
+        return None
+    if not knob:
+        return SpecConfig()
+    try:
+        k = int(knob)
+    except ValueError:
+        raise ValueError(
+            f"{SPEC_DECODE_ENV} ':k' suffix must be an int: {spec!r}")
+    return SpecConfig(k=k)

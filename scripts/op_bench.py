@@ -141,6 +141,21 @@ def _cases():
                  [100, 96, 88, 100, 40, 16, 0, 64], np.int32)),
              paddle.to_tensor(np.asarray(
                  [1, 1, 1, 1, 16, 16, 8, 3], np.int32)))),
+        # speculative decoding's VERIFY shape through the same ragged
+        # op: decode rows carrying 1 sampled + k drafts (q_len 1+k,
+        # k=4 here) next to plain q_len-1 decode rows — the per-step
+        # hot mix `ServingEngine(spec=...)` runs, tracked so the
+        # verify pass keeps a perf number of its own
+        "ragged_paged_attention_verify": lambda: (
+            lambda q, kp, vp, pt, pos, ql: apply_op(
+                "ragged_paged_attention", q, kp, vp, pt, pos, ql),
+            (t(8, 16, 8, 64), t(65, 16, 8, 64), t(65, 16, 8, 64),
+             paddle.to_tensor(np.arange(1, 65, dtype=np.int32)
+                              .reshape(8, 8)),
+             paddle.to_tensor(np.asarray(
+                 [100, 96, 88, 75, 40, 16, 9, 64], np.int32)),
+             paddle.to_tensor(np.asarray(
+                 [5, 5, 5, 5, 1, 1, 5, 3], np.int32)))),
     }
     return cases
 

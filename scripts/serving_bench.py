@@ -11,11 +11,11 @@ in the bench trajectory. Prints ONE JSON line and writes the same
 stable-schema report to BENCH_serving.json (override with --out,
 suppress with --out -):
 
-    {"bench": "serving", "schema_version": 6, "attn_impl": "kernel",
+    {"bench": "serving", "schema_version": 7, "attn_impl": "kernel",
      "requests": ..., "ttft_p50_s": ..., "tokens_per_sec": ...,
      "decode_step_ms_p50": ..., "ab": {"kernel": {...},
      "gather": {...}}, "prefix_stats": {...}, "unified": {...},
-     "chaos": {...}, ...}
+     "spec": {...}, "chaos": {...}, ...}
 
 Top-level numbers are the default ("kernel") run; "ab" holds the
 per-impl summaries (tokens/s, TTFT, per-step decode wall time).
@@ -29,6 +29,19 @@ p50/p99, tokens/s, prefill-stall steps and packed tokens per step
 under the report's "unified" key — and asserts TTFT p99 does not
 regress with the unified step on (the stall-kill this step exists
 for).
+
+`--spec-ab` adds the speculative-decoding A/B: the SAME Poisson
+arrivals over a TEMPLATED/CODE-HEAVY prompt mix (repeating template
+blocks — the traffic shape the model-free n-gram/prompt-lookup
+drafter exists for) run once with speculation off and once with
+`spec="ngram"` (draft-then-verify through the unified ragged step,
+serving/spec.py). Both runs collect every request's emitted tokens;
+the report's "spec" section records accepted-tokens-per-step (the
+per-decode-row burst size the verify pass confirmed), the
+drafted-vs-accepted economics, and the tokens/s ratio — and the
+script ASSERTS the two arms are token-identical, that
+accepted-tokens-per-step beat 1.0, and that tokens/s did not regress
+with speculation on.
 
 `--chaos` replays the standard Poisson trace through a 2-replica HTTP
 front-end TWICE — once fault-free, once with the FaultInjector
@@ -129,6 +142,15 @@ def main():
                     help="run the same Poisson trace under a "
                     "long-prompt-heavy mix with the unified ragged "
                     "step on vs off and record the TTFT/stall A/B")
+    ap.add_argument("--spec-ab", action="store_true",
+                    help="run the same Poisson arrivals over a "
+                    "templated/code-heavy prompt mix with "
+                    "speculative decoding off vs ngram and record "
+                    "the accepted-tokens-per-step / tokens/s A/B "
+                    "(token identity asserted)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft budget per slot per step for "
+                    "--spec-ab (the SpecConfig k knob)")
     ap.add_argument("--http", action="store_true",
                     help="also drive the serving/http front-end over "
                     "loopback with the same Poisson trace")
@@ -239,6 +261,54 @@ def main():
                 attempts,
                 key=lambda r: r["snap"]["ttft_s"]["p99"] or 0.0)
 
+    # the speculative-decoding A/B: the SAME Poisson arrivals over a
+    # TEMPLATED/CODE-HEAVY prompt mix (repeating template blocks — the
+    # shape prompt-lookup drafting wins on) once with speculation off,
+    # once with the ngram drafter on. Both arms collect every emitted
+    # token so the report can ASSERT the arms are token-identical.
+    spec_runs = {}
+    spec_n = spec_max_new = 0
+    if args.spec_ab:
+        if args.smoke:
+            spec_max_new, tpl_len, tpl_reps = 16, 6, 3
+        elif on_tpu:
+            spec_max_new, tpl_len, tpl_reps = 96, 32, 4
+        else:
+            spec_max_new, tpl_len, tpl_reps = 24, 8, 3
+        spec_n = max(n_req, 2 * args.slots)
+        spec_arrivals = np.cumsum(
+            rng.exponential(1.0 / rate, size=spec_n))
+        templates = [rng.randint(0, cfg.vocab_size, size=tpl_len)
+                     .astype(np.int64) for _ in range(2)]
+        spec_prompts = []
+        for _ in range(spec_n):
+            head = rng.randint(0, cfg.vocab_size,
+                               size=int(rng.randint(1, 4))
+                               ).astype(np.int64)
+            tpl = templates[rng.randint(len(templates))]
+            spec_prompts.append(
+                np.concatenate([head, np.tile(tpl, tpl_reps)]))
+        spec_budgets = np.full(spec_n, spec_max_new)
+        for mode in ("off", "on"):
+            # best-of-2 per arm by tokens/s (same hiccup-absorbing
+            # convention as the unified A/B); tokens are identical
+            # across attempts, so either attempt's list works for the
+            # identity check
+            attempts = [run_trace(
+                model, spec_arrivals, spec_prompts, spec_budgets,
+                slots=args.slots, max_len=max_len,
+                page_size=args.page_size, pages=args.pages,
+                chunk=chunk, attn_impl="kernel",
+                spec=(False if mode == "off"
+                      else f"ngram:{args.spec_k}"),
+                collect_tokens=True) for _ in range(2)]
+            for a in attempts[1:]:
+                assert a["tokens"] == attempts[0]["tokens"], \
+                    "spec arm not deterministic across repeats"
+            spec_runs[mode] = max(
+                attempts,
+                key=lambda r: r["snap"]["tokens_per_sec"] or 0.0)
+
     # the prefix-cache A/B: the SAME shared-prefix trace with the
     # radix cache on vs off (cache pre-warmed with the K system
     # prompts — steady-state behavior, not cold-start compile noise)
@@ -287,6 +357,21 @@ def main():
             "completed": s["requests"]["completed"],
         }
 
+    def _spec_summary(run):
+        s = run["snap"]
+        burst = s.get("spec_tokens_per_step") or {}
+        return {
+            "wall_s": round(run["wall_s"], 4),
+            "tokens_per_sec": s["tokens_per_sec"],
+            "ttft_p50_s": s["ttft_s"]["p50"],
+            "inter_token_p50_s": s["inter_token_s"]["p50"],
+            "unified_steps": s["unified_steps"],
+            "spec_drafted_tokens": s.get("spec_drafted_tokens", 0),
+            "spec_accepted_tokens": s.get("spec_accepted_tokens", 0),
+            "accepted_tokens_per_step": burst.get("mean"),
+            "completed": s["requests"]["completed"],
+        }
+
     def _prefix_summary(run):
         s = run["snap"]
         n = s["requests"]["completed"] or 1
@@ -306,7 +391,7 @@ def main():
 
     report = {
         "bench": "serving",
-        "schema_version": 6,
+        "schema_version": 7,
         "platform": jax.devices()[0].platform,
         "attn_impl": "kernel",
         "requests": n_req,
@@ -343,6 +428,29 @@ def main():
             "requests": uni_n,
             **{flag: _unified_summary(run)
                for flag, run in unified_runs.items()},
+        }
+    if spec_runs:
+        on_s, off_s = (_spec_summary(spec_runs["on"]),
+                       _spec_summary(spec_runs["off"]))
+        ratio = (None if not off_s["tokens_per_sec"]
+                 else (on_s["tokens_per_sec"] or 0.0)
+                 / off_s["tokens_per_sec"])
+        report["spec"] = {
+            "requests": spec_n,
+            "k": args.spec_k,
+            "max_new": spec_max_new,
+            "trace": "templated",
+            "off": off_s,
+            "on": on_s,
+            "accepted_tokens_per_step":
+                on_s["accepted_tokens_per_step"],
+            "acceptance_rate": (
+                None if not on_s["spec_drafted_tokens"]
+                else on_s["spec_accepted_tokens"]
+                / on_s["spec_drafted_tokens"]),
+            "tokens_per_sec_ratio": ratio,
+            "token_identical": (spec_runs["on"]["tokens"]
+                                == spec_runs["off"]["tokens"]),
         }
     if share > 0.0:
         report["prefix"] = {
@@ -391,6 +499,20 @@ def main():
         assert on["packed_tokens_per_step_max"] > 1, report["unified"]
         assert on["ttft_p99_s"] <= off["ttft_p99_s"] * 1.15, \
             report["unified"]
+    if spec_runs:
+        sp = report["spec"]
+        # the acceptance numbers: the two arms emitted EXACTLY the
+        # same tokens (draft-then-verify is a pure speedup, never a
+        # quality knob), the verify pass really confirmed >1 token
+        # per decode-row step on the templated trace, and throughput
+        # did not regress with speculation on
+        assert sp["token_identical"], "spec on/off token mismatch"
+        assert sp["on"]["completed"] == sp["off"]["completed"] \
+            == spec_n, sp
+        assert sp["accepted_tokens_per_step"] is not None \
+            and sp["accepted_tokens_per_step"] > 1.0, sp
+        assert sp["on"]["tokens_per_sec"] >= \
+            sp["off"]["tokens_per_sec"], sp
     if share > 0.0:
         on, off = report["prefix"]["on"], report["prefix"]["off"]
         # the acceptance number: a warm cache must do strictly less
@@ -413,21 +535,26 @@ def main():
 
 def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
               page_size, pages, chunk, attn_impl, prefix_cache=None,
-              warm_prompts=(), unified=None):
+              warm_prompts=(), unified=None, spec=None,
+              collect_tokens=False):
     """One Poisson-trace replay through a fresh engine pinned to
     `attn_impl` (and, for the prefix A/B, to `prefix_cache` on/off;
-    for the unified-step A/B, to `unified` on/off); returns
-    {snap, wall_s, engine-shape fields}. `warm_prompts` run to
-    completion before the clock starts, so a prefix-cache run measures
-    the steady state (system prompts resident) rather than cold
-    compulsory misses."""
+    for the unified-step A/B, to `unified` on/off; for the spec A/B,
+    to `spec` — False forces speculation off, "ngram[:k]" turns the
+    drafter on); returns {snap, wall_s, engine-shape fields, and —
+    with collect_tokens — every request's emitted token list in
+    submission order, the spec A/B's token-identity evidence}.
+    `warm_prompts` run to completion before the clock starts, so a
+    prefix-cache run measures the steady state (system prompts
+    resident) rather than cold compulsory misses."""
     from paddle_tpu.serving import SamplingParams, ServingEngine
 
     n_req = len(prompts)
     eng = ServingEngine(model, num_slots=slots, max_len=max_len,
                         page_size=page_size, num_pages=pages,
                         chunk_len=chunk, attn_impl=attn_impl,
-                        prefix_cache=prefix_cache, unified=unified)
+                        prefix_cache=prefix_cache, unified=unified,
+                        spec=spec)
 
     # warm the compiled programs so the trace measures steady state, not
     # XLA compile time: one request per distinct prompt length (chunk
@@ -441,24 +568,30 @@ def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
     eng.run()
     eng.metrics.__init__()   # drop warmup from the report
     eng.metrics.attn_impl = eng.attn_impl
+    eng.metrics.unified = eng.unified
+    eng.metrics.spec = None if eng.spec is None else eng.spec.mode
 
     t0 = time.monotonic()
     submitted = 0
+    reqs = []
     while submitted < n_req or eng.has_work:
         now = time.monotonic() - t0
         while submitted < n_req and arrivals[submitted] <= now:
-            eng.add_request(
+            reqs.append(eng.add_request(
                 prompts[submitted],
-                SamplingParams(max_new_tokens=int(budgets[submitted])))
+                SamplingParams(max_new_tokens=int(budgets[submitted]))))
             submitted += 1
         if eng.has_work:
             eng.step()
         elif submitted < n_req:
             time.sleep(min(0.001, arrivals[submitted] - now))
     wall = time.monotonic() - t0
-    return {"snap": eng.metrics.snapshot(), "wall_s": wall,
-            "page_size": eng.page_size, "num_pages": eng.num_pages,
-            "chunk_len": eng.chunk_len}
+    out = {"snap": eng.metrics.snapshot(), "wall_s": wall,
+           "page_size": eng.page_size, "num_pages": eng.num_pages,
+           "chunk_len": eng.chunk_len}
+    if collect_tokens:
+        out["tokens"] = [list(r.output_tokens) for r in reqs]
+    return out
 
 
 def http_trace(model, cfg, *, n_req, rate, max_new, max_len, chunk,

@@ -73,17 +73,40 @@ def test_zero_baseline_is_infinite_regression():
     assert obc.run_gate(base, new, out=out, err=err) == 1
 
 
-def test_paged_decode_attention_is_benched():
-    """The ragged paged-attention decode op must keep a tracked perf
-    number: its case stays in op_bench's table so every report (and
-    therefore the wall_us gate) carries it."""
+def _op_bench_cases():
     spec = importlib.util.spec_from_file_location(
         "op_bench", os.path.join(HERE, os.pardir, "scripts",
                                  "op_bench.py"))
     ob = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(ob)
-    cases = ob._cases()
+    return ob._cases()
+
+
+def test_paged_decode_attention_is_benched():
+    """The ragged paged-attention decode op must keep a tracked perf
+    number: its case stays in op_bench's table so every report (and
+    therefore the wall_us gate) carries it."""
+    cases = _op_bench_cases()
     assert "paged_decode_attention" in cases
     fn, args = cases["paged_decode_attention"]()
     out = fn(*args)
     assert tuple(out.shape) == (8, 1, 8, 64)
+
+
+def test_ragged_verify_shape_is_benched():
+    """Speculative decoding's VERIFY pass — mixed per-row q_len with
+    1 + k draft rows next to plain q_len-1 decode rows through
+    `ragged_paged_attention` — must keep its own tracked perf number
+    next to the uniform ragged entry: the spec subsystem's step cost
+    IS this shape, and a silent regression here taxes every
+    speculative token."""
+    cases = _op_bench_cases()
+    assert "ragged_paged_attention" in cases
+    assert "ragged_paged_attention_verify" in cases
+    fn, args = cases["ragged_paged_attention_verify"]()
+    # the q_len operand really is the verify mix: some rows 1 + k,
+    # some plain decode rows at 1
+    ql = args[-1].numpy().tolist()
+    assert 1 in ql and max(ql) > 1
+    out = fn(*args)
+    assert tuple(out.shape) == (8, 16, 8, 64)

@@ -114,26 +114,26 @@ class TestSchedulerPacking:
     def test_decode_rows_always_get_their_token(self):
         s = self._sched([RequestState.DECODE, RequestState.DECODE,
                          RequestState.PREFILL])
-        decode, grants = s.pack_tokens(2, 16, {2: 40})   # budget == decodes
+        decode, grants, _ = s.pack_tokens(2, 16, {2: 40})  # budget == decodes
         assert decode == [0, 1]
         assert grants == {}                              # no spare left
 
     def test_prefill_packs_into_spare_budget(self):
         s = self._sched([RequestState.DECODE, RequestState.PREFILL,
                          RequestState.PREFILL])
-        decode, grants = s.pack_tokens(20, 16, {1: 40, 2: 3})
+        decode, grants, _ = s.pack_tokens(20, 16, {1: 40, 2: 3})
         assert decode == [0]
         # slot 1 takes min(40, width 16, spare 19) = 16, slot 2 the rest
         assert grants == {1: 16, 2: 3}
 
     def test_width_caps_single_row_chunk(self):
         s = self._sched([RequestState.PREFILL])
-        _, grants = s.pack_tokens(100, 8, {0: 50})
+        _, grants, _ = s.pack_tokens(100, 8, {0: 50})
         assert grants == {0: 8}
 
     def test_spare_exhaustion_stops_in_slot_order(self):
         s = self._sched([RequestState.PREFILL, RequestState.PREFILL])
-        _, grants = s.pack_tokens(5, 16, {0: 4, 1: 10})
+        _, grants, _ = s.pack_tokens(5, 16, {0: 4, 1: 10})
         assert grants == {0: 4, 1: 1}                    # 5 total
 
 
@@ -319,7 +319,7 @@ def test_serving_bench_unified_ab_smoke(tmp_path, monkeypatch):
     mod.main()
     with open(out) as f:
         report = json.load(f)
-    assert report["schema_version"] == 6
+    assert report["schema_version"] == 7
     uni = report["unified"]
     assert set(uni) >= {"on", "off", "long_prompt_lens", "requests"}
     on, off = uni["on"], uni["off"]
