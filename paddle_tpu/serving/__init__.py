@@ -27,18 +27,29 @@ greedy decode:
             print(out.request_id, out.token_ids, out.finish_reason)
     print(eng.metrics.snapshot()["pool"])
 
+OVERLOAD degrades gracefully instead of refusing (default on,
+PADDLE_TPU_PREEMPT / ServingEngine(preempt=...)): requests carry
+`priority` + placement `deadline_s`, the queue orders by (priority,
+deadline, arrival), a blocked higher-priority request preempts the
+least-important resident (tokens banked, KV swapped whole-page to the
+host-RAM tier, resumed later token-identically), and queued requests
+past their deadline fail fast as typed DeadlineExceeded (HTTP 504).
+
 Greedy requests are bit-identical to offline CompiledGenerator decode
 (tested); `scripts/serving_bench.py` drives a Poisson arrival trace and
 reports TTFT/throughput/pool utilization into BENCH_serving.json.
 """
-from .engine import ServingEngine, resolve_unified_flag  # noqa: F401
-from .errors import (EngineClosed, PoisonedRequest,  # noqa: F401
-                     QueueFull, RateLimited, ServingError)
+from .engine import (ServingEngine, resolve_preempt_flag,  # noqa: F401
+                     resolve_unified_flag)
+from .errors import (DeadlineExceeded, EngineClosed,  # noqa: F401
+                     PoisonedRequest, QueueFull, RateLimited,
+                     ServingError)
 from .faults import (FaultInjector, InjectedFault,  # noqa: F401
                      resolve_faults)
 from .metrics import (Histogram, ServingMetrics,  # noqa: F401
                       prometheus_render)
-from .paging import PagePool, chunk_bucket, pages_needed  # noqa: F401
+from .paging import (HostPagePool, PagePool, chunk_bucket,  # noqa: F401
+                     pages_needed)
 from .prefix import (PrefixGrant, RadixPrefixCache,  # noqa: F401
                      resolve_prefix_cache_flag)
 from .request import (Request, RequestOutput, RequestState,  # noqa: F401
@@ -47,13 +58,15 @@ from .scheduler import Scheduler  # noqa: F401
 from .spec import (Drafter, NgramDrafter, SpecConfig,  # noqa: F401
                    resolve_spec_config)
 
-__all__ = ["ServingEngine", "resolve_unified_flag", "Scheduler",
+__all__ = ["ServingEngine", "resolve_unified_flag",
+           "resolve_preempt_flag", "Scheduler",
            "ServingMetrics", "Histogram",
-           "prometheus_render", "PagePool", "pages_needed",
+           "prometheus_render", "PagePool", "HostPagePool",
+           "pages_needed",
            "chunk_bucket", "RadixPrefixCache", "PrefixGrant",
            "resolve_prefix_cache_flag", "Request", "RequestOutput",
            "RequestState", "SamplingParams", "ServingError",
            "QueueFull", "EngineClosed", "RateLimited",
-           "PoisonedRequest", "FaultInjector", "InjectedFault",
-           "resolve_faults", "Drafter", "NgramDrafter", "SpecConfig",
-           "resolve_spec_config"]
+           "PoisonedRequest", "DeadlineExceeded", "FaultInjector",
+           "InjectedFault", "resolve_faults", "Drafter",
+           "NgramDrafter", "SpecConfig", "resolve_spec_config"]

@@ -72,10 +72,31 @@ applying backpressure. None of this changes any compiled program — only
 which page ids the host page tables carry — so greedy outputs stay
 token-identical with the cache on, off, hot, or thrashing.
 
+OVERLOAD IS A SCHEDULING PROBLEM, NOT A FAILURE MODE (default on,
+gated `preempt=...` / PADDLE_TPU_PREEMPT): requests carry a
+`priority` (lower = more important) and an optional placement
+`deadline_s`; the queue orders by (priority, deadline, arrival). When
+the queue head is blocked — no slot, or its page budget doesn't fit —
+and a STRICTLY lower-priority resident exists, that resident is
+PREEMPTED instead of the head being refused: its emitted tokens are
+banked (the client's stream object stays live), its private KV pages
+swap out whole-page to a HOST-RAM tier (`HostPagePool`; one compiled
+copy program per direction over traced page ids — no retrace), its
+shared prefix pages return to the radix tree, and its slot frees. It
+re-admits later via swap-in: pos restored from the banked pages, held
+logits regenerated by re-prefilling one token, the drafter re-seeded
+— greedy output bit-token-identical to never having been preempted.
+Queued requests whose placement deadline expires fail fast as typed
+`DeadlineExceeded` ("deadline", HTTP 504) instead of silently burning
+queue slots. Parked prefix-cache pages may also SPILL to the host
+tier under page pressure (restored on the next match) — stage 1 of
+the ROADMAP's fleet-scale prefix cache.
+
 Correctness contract (tests/test_serving.py): a request decoded greedily
 through the engine emits tokens bit-identical to running it ALONE
 through CompiledGenerator greedy decode — through chunked prefill,
-page-table indirection, and page reuse after eviction.
+page-table indirection, page reuse after eviction, and
+preempt-swap-resume cycles.
 
 Weights enter both programs as closed-over constants (the measured
 layout win of generation.py's _build); construct the engine AFTER any
@@ -99,17 +120,57 @@ from ..profiler import RecordEvent
 from ..nlp.generation import (_pack_caches, _top_p_filter,
                               _unpack_caches, decode_model_step,
                               resolve_paged_attn_impl)
-from .errors import EngineClosed, PoisonedRequest
+from .errors import DeadlineExceeded, EngineClosed, PoisonedRequest
 from .metrics import ServingMetrics
-from .paging import PagePool, TRASH_PAGE, chunk_bucket, pages_needed
+from .paging import (HostPagePool, PagePool, TRASH_PAGE, chunk_bucket,
+                     pages_needed)
 from .prefix import RadixPrefixCache, resolve_prefix_cache_flag
 from .request import Request, RequestOutput, RequestState, SamplingParams
 from .scheduler import Scheduler
 from .spec import Drafter, resolve_spec_config
 
-__all__ = ["ServingEngine", "resolve_unified_flag"]
+__all__ = ["ServingEngine", "resolve_unified_flag",
+           "resolve_preempt_flag"]
 
 UNIFIED_STEP_MODES = ("on", "off")
+PREEMPT_MODES = ("on", "off")
+
+
+def resolve_preempt_flag(override=None) -> bool:
+    """Whether overload turns into PREEMPTION instead of pure
+    backpressure (default on): when the ordered queue's head is
+    blocked and a strictly lower-priority resident exists, that
+    resident is preempted — its emitted tokens banked, its KV pages
+    swapped to the host-RAM tier, its slot freed — and it resumes
+    later via swap-in, token-identically. An explicit override wins;
+    otherwise PADDLE_TPU_PREEMPT=on|off (read at engine construction;
+    same gate pattern as PADDLE_TPU_UNIFIED_STEP)."""
+    if override is not None:
+        return bool(override)
+    v = os.environ.get("PADDLE_TPU_PREEMPT", "on")
+    if v not in PREEMPT_MODES:
+        raise ValueError(
+            f"PADDLE_TPU_PREEMPT must be one of {PREEMPT_MODES}, "
+            f"got {v!r}")
+    return v == "on"
+
+
+class _SwapHandle:
+    """A preempted request's claim on the host tier: `host_slots[j]`
+    holds the KV payload of the page at page-table index `base + j`;
+    `kv_len` is how many leading positions of the committed sequence
+    hold valid KV. `restores`/`drops` are filled by the resume
+    reservation (which host pages swap back in vs. are redundant with
+    a fresh prefix-cache match)."""
+
+    __slots__ = ("host_slots", "base", "kv_len", "restores", "drops")
+
+    def __init__(self, host_slots, base, kv_len):
+        self.host_slots = list(host_slots)
+        self.base = int(base)
+        self.kv_len = int(kv_len)
+        self.restores = []      # [(host_slot, dst_page), ...]
+        self.drops = []         # host slots made redundant by a match
 
 
 def resolve_unified_flag(override=None) -> bool:
@@ -170,7 +231,8 @@ class ServingEngine:
                  max_queue: Optional[int] = None, clock=time.monotonic,
                  attn_impl: Optional[str] = None,
                  prefix_cache=None, unified=None,
-                 token_budget: Optional[int] = None, spec=None):
+                 token_budget: Optional[int] = None, spec=None,
+                 preempt=None, host_pages: Optional[int] = None):
         if cache_spec is None:
             if not hasattr(model, "_decode_cache_spec"):
                 raise ValueError(
@@ -286,6 +348,22 @@ class ServingEngine:
         self.prefix_cache = (
             RadixPrefixCache(self.pool, self.page_size)
             if resolve_prefix_cache_flag(prefix_cache) else None)
+        # HOST-RAM page tier (graceful overload degradation + stage 1
+        # of the fleet-scale prefix cache): whole-page KV payloads of
+        # preempted residents — and, under pressure, of parked prefix
+        # pages — live here until swap-in restores them into freshly
+        # allocated device pages. Default capacity mirrors the device
+        # pool; 0 disables the tier (preemption then degrades to
+        # recompute-on-resume).
+        self.host_pages = (self.num_pages - 1 if host_pages is None
+                           else int(host_pages))
+        self.host_pool = HostPagePool(self.host_pages)
+        # overload preemption gate (PADDLE_TPU_PREEMPT, default on)
+        self.preempt = resolve_preempt_flag(preempt)
+        if self.prefix_cache is not None and self.host_pages > 0:
+            self.prefix_cache.set_host_tier(self._host_store_page,
+                                            self._host_load_page,
+                                            self._host_drop_page)
         self._slot_pages: Dict[int, List[int]] = {}
         self._prefill_cursor: Dict[str, int] = {}
         self._pt_host = np.full((self.num_slots, self.max_pages),
@@ -304,6 +382,20 @@ class ServingEngine:
         self._decode_fn = None
         self._unified_fn = None      # the ONE compiled ragged step
         self._copy_page_fn = None    # COW single-page copy, jitted once
+        # host-tier swap programs, each jitted ONCE over traced page
+        # ids (the PR 5 COW no-retrace discipline): device->host reads
+        # one page's K/V across all layers, host->device writes it back
+        self._swap_out_fn = None
+        self._swap_in_fn = None
+        # liveness hook (serving/http/driver.py): called at every step
+        # boundary AND immediately before each compiled launch, so a
+        # replica grinding through a long round still beats its
+        # watchdog heartbeat. None (the default) costs nothing.
+        self.heartbeat_hook = None
+        # tokens packed into the compiled call currently in flight
+        # (0 between launches): the watchdog scales its grace with
+        # this, so a legitimately huge packed step is not condemned
+        self.step_tokens_inflight = 0
         self._spans: Dict[str, RecordEvent] = {}
         # fault-injection hook (serving/faults.py): called with the
         # round's participant request ids right BEFORE each compiled
@@ -495,6 +587,89 @@ class ServingEngine:
             self._ct = self._copy_page_fn(self._ct, jnp.int32(src),
                                           jnp.int32(dst))
 
+    def _build_swap_out(self):
+        """ONE compiled device->host page read: stacks one page's K and
+        V across every layer into a [n_layers, 2, page_size, H, D]
+        block. The page id is a traced scalar, so every swap-out of
+        every page reuses this single program (no retrace ever — the
+        COW-copy discipline)."""
+        def so(ct, src):
+            return jnp.stack([jnp.stack((k[src], v[src]))
+                              for k, v, _, _ in ct])
+        return jax.jit(so)
+
+    def _build_swap_in(self):
+        """ONE compiled host->device page write: scatters a
+        [n_layers, 2, page_size, H, D] block back into page `dst` of
+        every layer's pools. dst is a traced scalar — one trace serves
+        every restore."""
+        def si(ct, data, dst):
+            out = []
+            for i, (k, v, ks, vs) in enumerate(ct):
+                out.append((k.at[dst].set(data[i, 0].astype(k.dtype)),
+                            v.at[dst].set(data[i, 1].astype(v.dtype)),
+                            ks, vs))
+            return tuple(out)
+        return jax.jit(si)
+
+    def _extract_page(self, src: int) -> np.ndarray:
+        """Read one device page's KV (all layers) to host RAM."""
+        if self._swap_out_fn is None:
+            self._swap_out_fn = self._build_swap_out()
+        with RecordEvent(f"serving::swap_out[{src}]"):
+            return np.asarray(self._swap_out_fn(self._ct,
+                                                jnp.int32(src)))
+
+    def _restore_page(self, data, dst: int):
+        """Write one host-RAM page payload back into device page
+        `dst`."""
+        if self._swap_in_fn is None:
+            self._swap_in_fn = self._build_swap_in()
+        with RecordEvent(f"serving::swap_in[{dst}]"):
+            self._ct = self._swap_in_fn(self._ct, jnp.asarray(data),
+                                        jnp.int32(dst))
+
+    # -- host tier callbacks (prefix-cache spill) --------------------------
+    def _host_store_page(self, page: int):
+        """Prefix spill: copy a parked page's KV to the host tier;
+        returns the host slot (the cache then swap_out's the device
+        page) or None when the tier is full."""
+        return self.host_pool.store(self._extract_page(page))
+
+    def _host_load_page(self, host_slot: int):
+        """Prefix restore: swap a spilled page back into a freshly
+        allocated device page, handed back PARKED (cache-resident) so
+        the cache's retain path treats it like any other tree page.
+        Under pressure another LRU parked page is SPILLED to make room
+        (the in-progress match is retained, so it can never be the one
+        displaced, and a spill never drops a host copy — unlike evict,
+        which could tear down the very node being restored); None when
+        no page can be freed — the match simply stops and the tail
+        prefills."""
+        pages = self.pool.alloc(1)
+        if pages is None and self.prefix_cache is not None \
+                and self.prefix_cache.spill(1) >= 1:
+            pages = self.pool.alloc(1)
+        if pages is None:
+            return None
+        self._restore_page(self.host_pool.load(host_slot), pages[0])
+        self.host_pool.free(host_slot)
+        self.pool.swapped_restored(1, spill=True)
+        self.pool.release(pages)
+        self.pool.park(pages)
+        self.metrics.on_swap_in(1, 0.0)
+        return pages[0]
+
+    def _host_drop_page(self, host_slot: int):
+        """A spilled page was evicted from the tree while on host."""
+        self.host_pool.free(host_slot)
+        self.pool.drop_swapped(1, spill=True)
+
+    def _beat(self):
+        hook = self.heartbeat_hook
+        if hook is not None:
+            hook()
+
     # -- request intake ----------------------------------------------------
     def add_request(self, prompt_ids, sampling: Optional[SamplingParams]
                     = None, request_id: Optional[str] = None,
@@ -541,8 +716,9 @@ class ServingEngine:
         req = self._requests.get(request_id)
         if req is None or req.finished:
             return False
-        if req.state is RequestState.QUEUED:
+        if req.state in (RequestState.QUEUED, RequestState.PREEMPTED):
             self.scheduler.drop_queued(req)
+            self._release_swap(req)      # host-tier KV, if preempted
             req._finish("cancelled", self._clock())
             self.metrics.on_finish(req, self._clock())
             return True
@@ -577,6 +753,7 @@ class ServingEngine:
             req._prefix_grant = None
             self._pt_host[slot, :] = TRASH_PAGE
             self._pt_dirty = True
+        self._release_swap(req)   # preempted-and-never-resumed cleanup
         self._prefill_cursor.pop(req.request_id, None)
         self._drafters.pop(req.request_id, None)
         # retire the id: duplicate detection guards LIVE requests only,
@@ -614,8 +791,18 @@ class ServingEngine:
             self.prefix_cache.release(pages)
 
     def _evict(self, now: float, finished: List[RequestOutput]):
+        # fail-fast 504: a queued request whose PLACEMENT deadline
+        # passed can no longer be served in time — fail it now instead
+        # of letting it burn a queue position (overload semantics)
+        for req in self.scheduler.deadline_expired(now):
+            self.scheduler.drop_queued(req)
+            req.error = DeadlineExceeded(
+                f"request {req.request_id} missed its placement "
+                f"deadline ({req.sampling.deadline_s}s) while queued")
+            self._finish_and_free(req, "deadline", now, finished)
         for req in self.scheduler.expired(now):
-            if req.state is RequestState.QUEUED:
+            if req.state in (RequestState.QUEUED,
+                             RequestState.PREEMPTED):
                 self.scheduler.drop_queued(req)
             self._finish_and_free(req, "timeout", now, finished)
         for req in self.scheduler.cancelled_running():
@@ -624,13 +811,19 @@ class ServingEngine:
     def _reserve(self, req: Request) -> bool:
         """Page-aware admission (scheduler callback): grant the slot
         only if the request's WHOLE page budget is available right now —
-        otherwise the queue head waits (FIFO backpressure) and nobody
-        behind it can starve it by stealing pages. With the prefix
+        otherwise the queue head waits (ordered head-of-line
+        backpressure) and nobody behind it can starve it by stealing
+        pages. A blocked head is no longer the end of the story: the
+        step boundary may PREEMPT a strictly lower-priority resident
+        on its behalf (see `_preempt_for_overload`). With the prefix
         cache, "available" is match-then-reserve: the prompt's cached
-        prefix attaches shared pages (no fresh allocation for them) and
-        LRU leaves of the cache are evicted before the head is held
-        back, so backpressure only fires when genuinely referenced
-        pages exhaust the pool."""
+        prefix attaches shared pages (no fresh allocation for them)
+        and LRU cached pages are spilled to the host tier / evicted
+        before the head is held back, so backpressure only fires when
+        genuinely referenced pages exhaust the pool. A PREEMPTED
+        request re-admits through `_reserve_resume` (swap-in) instead."""
+        if req._swap is not None:
+            return self._reserve_resume(req)
         if self.prefix_cache is None:
             pages = self.pool.alloc(pages_needed(
                 req.prompt_ids.size, req.sampling.max_new_tokens,
@@ -648,6 +841,181 @@ class ServingEngine:
         req._prefix_grant = grant
         return True
 
+    def _reserve_resume(self, req: Request) -> bool:
+        """Re-admission of a PREEMPTED request: allocate its full page
+        budget for the committed sequence (prompt + banked tokens),
+        prefix-matching it against the radix tree when the cache is on
+        (the shared prefix released at preemption usually re-attaches
+        for free), then plan which host-tier pages swap back into
+        which page-table positions. The actual device restores run in
+        `_admit` (`_apply_swap_in`); refusal leaves the host copy and
+        the queue position untouched — the request just keeps
+        waiting."""
+        swap = req._swap
+        seq = req.prefill_ids
+        remaining = req.sampling.max_new_tokens - len(req.output_tokens)
+        ps = self.page_size
+        if self.prefix_cache is not None:
+            grant = self.prefix_cache.acquire(seq, remaining)
+            if grant is None:
+                return False
+            pages = grant.pages
+            m_full = grant.matched_full_pages
+            match_cov = grant.cached_len
+        else:
+            pages = self.pool.alloc(
+                pages_needed(seq.size, remaining, ps))
+            if pages is None:
+                return False
+            grant, m_full, match_cov = None, 0, 0
+        # plan the restores: host slot j holds page-table index
+        # swap.base + j. Indices below the fresh match are shared tree
+        # pages that already hold the identical KV (never write
+        # through them — drop the redundant host copy); indices at or
+        # past it restore into the grant's private fresh pages. The
+        # window only extends coverage if it is CONTIGUOUS with the
+        # match (m_full >= base); a tree that shrank underneath us
+        # leaves a gap, and the gap's tail must re-prefill instead.
+        swap.restores, swap.drops = [], []
+        cov = match_cov
+        if m_full >= swap.base:
+            end = min(swap.kv_len,
+                      (swap.base + len(swap.host_slots)) * ps)
+            for j, host_slot in enumerate(swap.host_slots):
+                idx = swap.base + j
+                if idx < m_full:
+                    swap.drops.append(host_slot)
+                else:
+                    swap.restores.append((host_slot, pages[idx]))
+            if swap.restores and end > m_full * ps:
+                # restored pages supersede any partial-page COW the
+                # match planned at index m_full: cancel the copy (its
+                # content is a strict prefix of the restored page)
+                if grant is not None and grant.cow_src is not None:
+                    self.prefix_cache.cow_done(grant)
+                    grant.cow_dst = None
+                    cov = max(m_full * ps, end)
+                else:
+                    cov = max(match_cov, end)
+        else:
+            swap.drops = list(swap.host_slots)
+        req.pages = pages
+        req._prefix_grant = grant
+        req.cached_tokens = min(cov, seq.size - 1)
+        return True
+
+    def _release_swap(self, req: Request):
+        """Discard a preempted request's host-tier KV (it died before
+        resuming: cancel / timeout / abort / replica death)."""
+        swap = req._swap
+        if swap is None:
+            return
+        for host_slot in swap.host_slots:
+            self.host_pool.free(host_slot)
+        if swap.host_slots:
+            self.pool.drop_swapped(len(swap.host_slots))
+        req._swap = None
+
+    def _apply_swap_in(self, req: Request):
+        """Execute the restore plan `_reserve_resume` made: swap each
+        surviving host page back into its freshly allocated device
+        page and release the redundant ones."""
+        swap = req._swap
+        t0 = time.perf_counter()
+        for host_slot, dst in swap.restores:
+            self._restore_page(self.host_pool.load(host_slot), dst)
+            self.host_pool.free(host_slot)
+        if swap.restores:
+            self.pool.swapped_restored(len(swap.restores))
+        for host_slot in swap.drops:
+            self.host_pool.free(host_slot)
+        if swap.drops:
+            self.pool.drop_swapped(len(swap.drops))
+        req._swap = None
+        self.metrics.on_swap_in(len(swap.restores),
+                                time.perf_counter() - t0)
+
+    # -- preemption (graceful overload degradation) ------------------------
+    def _preempt(self, slot: int, req: Request, now: float):
+        """Preempt one resident: bank its committed tokens (the stream
+        object stays live — the client notices nothing but a gap),
+        swap its private KV pages to the host tier (whole-page copies
+        through the one compiled swap program), release its shared
+        prefix pages back to the tree, free the slot, and requeue it
+        by its ORIGINAL arrival key. Resume is `_reserve_resume` +
+        `_apply_swap_in`: pos restored from the swapped pages, held
+        logits regenerated by re-prefilling the last committed token,
+        the drafter re-created from the banked history — greedy output
+        provably identical to never having been preempted."""
+        pages = self._slot_pages.pop(slot)
+        self.scheduler.retire(slot)
+        self._active[slot] = False
+        self._vec_dirty = True
+        self._pt_host[slot, :] = TRASH_PAGE
+        self._pt_dirty = True
+        # committed KV: a decode row holds prompt + every emitted
+        # token; a mid-prefill row exactly its prefill cursor
+        if req.state is RequestState.DECODE:
+            kv_len = int(req.prompt_ids.size) + len(req.output_tokens)
+        else:
+            kv_len = int(self._prefill_cursor.get(req.request_id, 0))
+        self._prefill_cursor.pop(req.request_id, None)
+        self._drafters.pop(req.request_id, None)
+        span = self._spans.pop(req.request_id, None)
+        if span is not None:
+            span.end()
+        grant = req._prefix_grant
+        base = grant.matched_full_pages if grant is not None else 0
+        shared, private = pages[:base], pages[base:]
+        if shared:
+            self.prefix_cache.release(shared)
+        n_kv = -(-kv_len // self.page_size)
+        n_keep = max(0, min(n_kv - base, len(private)))
+        host_slots = []
+        for p in private[:n_keep]:
+            host_slot = self.host_pool.store(self._extract_page(p))
+            if host_slot is None:
+                break        # host tier full: the tail recomputes
+            host_slots.append(host_slot)
+        kept = private[:len(host_slots)]
+        if kept:
+            self.pool.swap_out(kept)
+        rest = private[len(host_slots):]
+        if rest:
+            self.pool.free(rest)
+        req._swap = _SwapHandle(host_slots, base, kv_len)
+        req._resume_ids = np.concatenate(
+            [req.prompt_ids.astype(np.int64),
+             np.asarray(req.output_tokens, np.int64)])
+        req.pages = None
+        req._prefix_grant = None
+        req.slot = None
+        req.state = RequestState.PREEMPTED
+        req.preemptions += 1
+        self.scheduler.requeue(req)
+        self.metrics.on_preempt(len(kept))
+
+    def _preempt_for_overload(self, now: float):
+        """The overload policy: after admission, a still-queued head
+        means backpressure — but if a STRICTLY lower-priority resident
+        exists, refusal is the wrong answer. Preempt the least
+        important resident, re-run admission, and repeat while the
+        (possibly new) head keeps outranking someone. Strict priority
+        ordering makes thrash impossible: equal-priority traffic never
+        preempts itself, and a preempted request can only be displaced
+        again by somebody strictly more important."""
+        if not self.preempt:
+            return
+        for _ in range(self.num_slots):
+            head = self.scheduler.peek_queued()
+            if head is None:
+                break
+            victim = self.scheduler.preemption_victim(head)
+            if victim is None:
+                break
+            self._preempt(victim[0], victim[1], now)
+            self._admit(now)
+
     def _admit(self, now: float):
         for slot, req in self.scheduler.assign(reserve=self._reserve):
             req.state = RequestState.PREFILL
@@ -659,6 +1027,10 @@ class ServingEngine:
             self._pt_host[slot, :] = TRASH_PAGE
             self._pt_host[slot, :len(req.pages)] = req.pages
             self._pt_dirty = True
+            # preemption resume: swap the banked KV pages back in from
+            # the host tier before any prefill touches the slot
+            if req._swap is not None:
+                self._apply_swap_in(req)
             # the slot's write position starts at the first uncached
             # token (0 on a prefix miss): the unified step reads it as
             # the row's pos; the old path's prefill program passes the
@@ -713,7 +1085,7 @@ class ServingEngine:
             self._prefill_chunk(slot, req)
             chunks += 1
             if self._prefill_cursor[req.request_id] >= \
-                    req.prompt_ids.size:
+                    req.prefill_ids.size:
                 self._prefill_cursor.pop(req.request_id, None)
                 req.state = RequestState.DECODE
                 self._active[slot] = True
@@ -722,7 +1094,7 @@ class ServingEngine:
         return chunks
 
     def _prefill_chunk(self, slot: int, req: Request):
-        plen = int(req.prompt_ids.size)
+        plen = int(req.prefill_ids.size)
         cursor = self._prefill_cursor[req.request_id]
         bucket = chunk_bucket(plen - cursor, self.chunk_len,
                               self.MIN_CHUNK)
@@ -732,8 +1104,10 @@ class ServingEngine:
         self._ensure_last_logits(req)
         real = min(plen - cursor, bucket)
         tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :real] = req.prompt_ids[cursor:cursor + real]
+        tokens[0, :real] = req.prefill_ids[cursor:cursor + real]
         pt_full, _ = self._page_tables()
+        self.step_tokens_inflight = int(bucket)
+        self._beat()
         with RecordEvent(f"serving::prefill[{req.request_id}"
                          f"@{cursor}+{bucket}]"):
             self._ct, self._pos, self._last_logits = fn(
@@ -741,6 +1115,8 @@ class ServingEngine:
                 jnp.asarray(tokens), jnp.int32(slot),
                 jnp.asarray([cursor], jnp.int32),
                 jnp.int32(cursor + real), jnp.int32(real - 1))
+        self.step_tokens_inflight = 0
+        self._beat()
         self._prefill_cursor[req.request_id] = cursor + real
         self.metrics.on_prefill_chunk(real)
 
@@ -788,6 +1164,8 @@ class ServingEngine:
                     self.step_fault_hook(ids)
             _, pt_decode = self._page_tables()
             key = random_mod.next_key_host()
+            self.step_tokens_inflight = int(self._active.sum())
+            self._beat()
             t0 = time.perf_counter()
             with RecordEvent("serving::decode_step"):
                 self._ct, self._pos, self._last_logits, toks = \
@@ -800,6 +1178,8 @@ class ServingEngine:
                         jnp.asarray(self._greedy),
                         jnp.asarray(self._active))
                 toks = np.asarray(toks)   # sync: host sees the tokens
+            self.step_tokens_inflight = 0
+            self._beat()
             ran = True
             # wall time of the synchronized step (the attn_impl A/B
             # metric); real perf_counter regardless of an injected
@@ -887,7 +1267,7 @@ class ServingEngine:
             return 0
         W = self.chunk_len
         remaining = {
-            slot: int(req.prompt_ids.size)
+            slot: int(req.prefill_ids.size)
             - self._prefill_cursor[req.request_id]
             for slot, req in running.items()
             if req.state is RequestState.PREFILL
@@ -922,7 +1302,7 @@ class ServingEngine:
         for slot, take in grants.items():
             req = running[slot]
             cur = self._prefill_cursor[req.request_id]
-            tokens[slot, :take] = req.prompt_ids[cur:cur + take]
+            tokens[slot, :take] = req.prefill_ids[cur:cur + take]
             q_len[slot] = take
         self._ensure_last_logits(next(iter(running.values())))
         if self._unified_fn is None:
@@ -931,6 +1311,11 @@ class ServingEngine:
             self._refresh_vectors()
         pt_full, _ = self._page_tables()
         key = random_mod.next_key_host()
+        # beat the watchdog heartbeat around the compiled launch and
+        # expose the packed size: a legitimately huge packed step gets
+        # proportional grace instead of a false-positive condemnation
+        self.step_tokens_inflight = int(q_len.sum())
+        self._beat()
         t0 = time.perf_counter()
         with RecordEvent("serving::unified_step"):
             self._ct, self._pos, self._last_logits, toks, accept = \
@@ -942,6 +1327,8 @@ class ServingEngine:
                     jnp.asarray(self._topp), jnp.asarray(self._greedy))
             toks = np.asarray(toks)   # sync point: host sees the tokens
             accept = np.asarray(accept)
+        self.step_tokens_inflight = 0
+        self._beat()
         n_prefill = int(sum(grants.values()))
         n_drafts = int(sum(draft_grants.values()))
         self.metrics.on_unified_step(n_prefill, len(decode_slots),
@@ -956,7 +1343,7 @@ class ServingEngine:
             cur = self._prefill_cursor[req.request_id] + take
             self._prefill_cursor[req.request_id] = cur
             self.metrics.on_prefill_chunk(take)
-            if cur >= req.prompt_ids.size:
+            if cur >= req.prefill_ids.size:
                 self._prefill_cursor.pop(req.request_id, None)
                 req.state = RequestState.DECODE
                 self._active[slot] = True
@@ -1074,11 +1461,14 @@ class ServingEngine:
         return True
 
     def step(self) -> List[RequestOutput]:
-        """One scheduler round: evict (timeout/cancel), admit queued
-        requests whose pages fit, then run the round's tokens. With the
-        unified step (default) that is ONE compiled ragged program —
-        decode tokens and packed prefill chunks together, so a long
-        prompt never stalls a resident decoder. On the legacy
+        """One scheduler round: evict (timeout / cancel / expired
+        placement deadline -> fail-fast "deadline"), admit queued
+        requests whose pages fit, PREEMPT the least-important resident
+        when a strictly higher-priority head is still blocked
+        (graceful overload degradation), then run the round's tokens.
+        With the unified step (default) that is ONE compiled ragged
+        program — decode tokens and packed prefill chunks together, so
+        a long prompt never stalls a resident decoder. On the legacy
         alternating path (PADDLE_TPU_UNIFIED_STEP=off) it is one
         prefill chunk per mid-prefill slot, then one compiled decode
         step for every decoding slot. A round that RAISES goes through
@@ -1087,9 +1477,11 @@ class ServingEngine:
         the replica keeps serving; otherwise the exception propagates
         (replica death). Returns requests that finished this round."""
         finished: List[RequestOutput] = []
+        self._beat()
         now = self._clock()
         self._evict(now, finished)
         self._admit(now)
+        self._preempt_for_overload(now)
         chunks = 0
         try:
             chunks = self._run_round(finished)
@@ -1102,6 +1494,9 @@ class ServingEngine:
                              pages_total=self.num_pages - 1,
                              stall_chunks=chunks,
                              pages_cached=self.pool.cached_pages,
+                             pages_swapped=self.pool.swapped_pages,
+                             host_pages_used=self.host_pool.used_pages,
+                             host_pages_total=self.host_pages,
                              prefix_stats=(
                                  self.prefix_cache.stats()
                                  if self.prefix_cache is not None
@@ -1115,16 +1510,24 @@ class ServingEngine:
 
     def drain(self) -> List[RequestOutput]:
         """Graceful shutdown half 1: stop admitting (add_request raises
-        EngineClosed), abort still-QUEUED requests (reason "aborted" —
-        they never held pages), then pump steps until every resident
+        EngineClosed), abort still-QUEUED never-started requests
+        (reason "aborted" — they never held pages), but let PREEMPTED
+        requests RESUME and finish (they already streamed tokens; a
+        drain must deliver them), then pump steps until every resident
         finishes normally. On return the scheduler is empty and every
-        page is either free or cache-resident (leak-checked).
-        Idempotent."""
+        page is either free or cache-resident, with nothing stranded
+        in the host tier (leak-checked). Idempotent."""
         self._closed = True
         finished: List[RequestOutput] = []
         now = self._clock()
+        resume: List[Request] = []
         for req in self.scheduler.pop_queued():
-            self._finish_and_free(req, "aborted", now, finished)
+            if req.state is RequestState.PREEMPTED:
+                resume.append(req)
+            else:
+                self._finish_and_free(req, "aborted", now, finished)
+        for req in resume:
+            self.scheduler.requeue(req)
         finished.extend(self.run())
         self.pool.assert_quiesced()
         return finished

@@ -3,10 +3,12 @@
 The HTTP front-end (serving/http/) maps these to status codes without
 string-matching exception text:
 
-- `QueueFull`      -> 429 Too Many Requests (+ Retry-After)
-- `RateLimited`    -> 429 Too Many Requests (+ Retry-After, per client)
-- `EngineClosed`   -> 503 Service Unavailable (draining / shut down)
-- `PoisonedRequest`-> 422 Unprocessable (this request kills the step)
+- `QueueFull`        -> 429 Too Many Requests (+ Retry-After)
+- `RateLimited`      -> 429 Too Many Requests (+ Retry-After, per client)
+- `EngineClosed`     -> 503 Service Unavailable (draining / shut down)
+- `PoisonedRequest`  -> 422 Unprocessable (this request kills the step)
+- `DeadlineExceeded` -> 504 Gateway Timeout (deadline passed while the
+                        request was still queued; it never started)
 
 All subclass `ServingError(RuntimeError)`, so pre-existing callers
 that caught RuntimeError keep working.
@@ -14,7 +16,7 @@ that caught RuntimeError keep working.
 from __future__ import annotations
 
 __all__ = ["ServingError", "QueueFull", "EngineClosed", "RateLimited",
-           "PoisonedRequest"]
+           "PoisonedRequest", "DeadlineExceeded"]
 
 
 class ServingError(RuntimeError):
@@ -56,3 +58,12 @@ class PoisonedRequest(ServingError):
     failed it alone (finish reason "poisoned", HTTP 422) and kept the
     replica serving its co-residents. Never retried or migrated —
     replaying a poisoned request would kill the next replica too."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's placement deadline (`deadline_s`) expired while it
+    was still QUEUED: it never reached a slot, emitted nothing, and is
+    failed fast (finish reason "deadline", HTTP 504) instead of
+    silently burning a queue position it can no longer use. A request
+    that already STARTED is never deadline-failed — runtime limits are
+    `timeout_s`'s job."""

@@ -21,7 +21,12 @@ program):
   raises BEFORE the compiled program launches, deterministically, so
   the engine's quarantine bisection can isolate it
   (`ServingEngine.step_fault_hook` calls `on_engine_step` with the
-  round's participant ids).
+  round's participant ids);
+- **overload spike** — at a chosen step boundary the replica's driver
+  injects a burst of N synthetic low-priority junk requests through
+  the REAL admission path (`take_spike`), exercising queue ordering,
+  deadline fail-fast and preemption under a traffic wave the trace
+  itself didn't contain.
 
 All hooks are cheap no-ops when nothing is scheduled; a server built
 without an injector pays nothing. `PADDLE_TPU_FAULTS` (parsed by
@@ -29,7 +34,8 @@ without an injector pays nothing. `PADDLE_TPU_FAULTS` (parsed by
 touching code:
 
     PADDLE_TPU_FAULTS="kill:replica-0@40;hang:replica-1@10x5.0;
-                       fail_add:3;fail_add:replica-0@7;poison:req-9"
+                       fail_add:3;fail_add:replica-0@7;poison:req-9;
+                       spike:replica-0@20x8"
 
 `chaos_schedule` derives a random-but-reproducible kill/hang/poison
 schedule from the injector's seed for soak tests, always leaving
@@ -83,6 +89,8 @@ class FaultInjector:
         self._kills: Dict[str, List[int]] = {}
         # scope -> [(step, duration_s)] still pending
         self._hangs: Dict[str, List[tuple]] = {}
+        # scope -> [(step, n_requests)] still pending
+        self._spikes: Dict[str, List[tuple]] = {}
         # scope -> set of 1-based admission ordinals that fail
         self._fail_adds: Dict[str, set] = {}
         self._adds_seen: Dict[str, int] = {}
@@ -92,6 +100,7 @@ class FaultInjector:
         self.hangs_fired = 0
         self.add_fails_fired = 0
         self.poison_hits = 0
+        self.spikes_fired = 0
 
     # -- scheduling --------------------------------------------------------
     def kill_at_step(self, replica: str, step: int) -> "FaultInjector":
@@ -113,6 +122,29 @@ class FaultInjector:
                 (int(step), float(duration_s)))
             self._hangs[replica].sort()
         return self
+
+    def spike_at_step(self, replica: str, step: int,
+                      n: int) -> "FaultInjector":
+        """Inject an OVERLOAD SPIKE: at the replica's first step
+        boundary with index >= `step`, its driver submits `n`
+        synthetic low-priority junk requests through the real
+        admission path (see `EngineDriver`). One-shot."""
+        if n < 1:
+            raise ValueError("spike size must be >= 1")
+        with self._lock:
+            self._spikes.setdefault(replica, []).append(
+                (int(step), int(n)))
+            self._spikes[replica].sort()
+        return self
+
+    def take_spike(self, replica: str, step: int) -> int:
+        """Driver hook: the number of junk requests to inject at this
+        boundary (0 almost always)."""
+        with self._lock:
+            due = self._pop_due(self._spikes, replica, step)
+            if due is not None:
+                self.spikes_fired += 1
+        return 0 if due is None else due[1]
 
     def fail_add_request(self, k: int,
                          replica: str = _ANY) -> "FaultInjector":
@@ -240,7 +272,8 @@ class FaultInjector:
         ';'-separated events — `kill:<replica>@<step>`,
         `hang:<replica>@<step>x<seconds>`, `fail_add:<k>` or
         `fail_add:<replica>@<k>`, `poison:<request_id>`,
-        `seed:<int>` (applies to chaos_schedule draws)."""
+        `spike:<replica>@<step>xN` (overload burst of N junk
+        requests), `seed:<int>` (applies to chaos_schedule draws)."""
         inj = cls()
         for raw in spec.split(";"):
             item = raw.strip()
@@ -264,6 +297,10 @@ class FaultInjector:
                         inj.fail_add_request(int(k), replica)
                     else:
                         inj.fail_add_request(int(rest))
+                elif kind == "spike":
+                    replica, _, tail = rest.rpartition("@")
+                    step, _, n = tail.partition("x")
+                    inj.spike_at_step(replica, int(step), int(n or 1))
                 elif kind == "poison":
                     inj.poison(rest)
                 else:

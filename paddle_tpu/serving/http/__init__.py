@@ -41,6 +41,7 @@ def serve(engines: Sequence, host: str = "127.0.0.1", port: int = 0,
           *, model_name: str = "paddle-tpu",
           default_timeout_s: Optional[float] = None,
           max_retries: int = 3,
+          max_migrations: int = 8,
           poll_interval_s: float = 0.05,
           rate_limit: Optional[float] = None,
           rate_limit_burst: Optional[float] = None,
@@ -65,6 +66,7 @@ def serve(engines: Sequence, host: str = "127.0.0.1", port: int = 0,
     drivers = [EngineDriver(e, name=f"replica-{i}", faults=faults)
                for i, e in enumerate(engines)]
     router = Router(drivers, max_retries=max_retries,
+                    max_migrations=max_migrations,
                     default_timeout_s=default_timeout_s,
                     watchdog_timeout_s=watchdog_timeout_s,
                     breaker_failures=breaker_failures,

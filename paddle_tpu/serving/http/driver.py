@@ -39,6 +39,8 @@ import threading
 import time
 from typing import Optional
 
+import numpy as np
+
 from ..errors import EngineClosed, ServingError
 from ..request import Request, SamplingParams
 
@@ -73,12 +75,15 @@ class EngineDriver:
     def __init__(self, engine, name: str = "replica-0", *,
                  poll_interval_s: float = 0.002,
                  submit_timeout_s: float = 30.0,
-                 faults=None, condemn_grace_s: float = 1.0):
+                 faults=None, condemn_grace_s: float = 1.0,
+                 watchdog_grace_per_token_s: float = 0.02):
         self.engine = engine
         self.name = name
         self.poll_interval_s = float(poll_interval_s)
         self.submit_timeout_s = float(submit_timeout_s)
         self.condemn_grace_s = float(condemn_grace_s)
+        self.watchdog_grace_per_token_s = float(
+            watchdog_grace_per_token_s)
         self._inbox: "queue.Queue" = queue.Queue()
         self._wake = threading.Event()
         self._stopped = threading.Event()
@@ -98,6 +103,12 @@ class EngineDriver:
         self._mutate_lock = threading.RLock()
         self._death_lock = threading.Lock()
         self._faults = faults
+        # watchdog false-positive hardening: the ENGINE beats the
+        # heartbeat at every step boundary AND around each compiled
+        # launch (not just once per pump iteration), so a pump
+        # grinding through a long multi-part round is never mistaken
+        # for a hang
+        engine.heartbeat_hook = self._on_beat
         if faults is not None:
             # poison path: the engine calls this with each round's
             # participant request ids right before the compiled launch
@@ -118,6 +129,18 @@ class EngineDriver:
     @property
     def started(self) -> bool:
         return self._started
+
+    def _on_beat(self):
+        self.last_beat = time.monotonic()
+
+    @property
+    def watchdog_grace_s(self) -> float:
+        """Extra heartbeat staleness the watchdog tolerates for this
+        replica RIGHT NOW, scaled with the tokens packed into the
+        compiled call in flight: a legitimately huge unified
+        verify/prefill step is slow, not dead. 0 between launches."""
+        return self.watchdog_grace_per_token_s * float(
+            getattr(self.engine, "step_tokens_inflight", 0) or 0)
 
     @property
     def dead(self) -> bool:
@@ -238,6 +261,7 @@ class EngineDriver:
             while True:
                 if self._fault is not None:
                     raise self._fault
+                spike_n = 0
                 if self._faults is not None:
                     # may sleep (hung step) or raise (injected kill);
                     # runs OUTSIDE the mutate lock so a watchdog can
@@ -246,6 +270,8 @@ class EngineDriver:
                     self._faults.on_step(self.name, self.steps)
                     if self._fault is not None:
                         raise self._fault
+                    spike_n = self._faults.take_spike(self.name,
+                                                      self.steps)
                 if self._draining:
                     self._fail_pending(EngineClosed(
                         f"{self.name} draining"))
@@ -258,6 +284,8 @@ class EngineDriver:
                         # condemned while wedged: the watchdog already
                         # reclaimed the engine; just exit
                         return
+                    if spike_n:
+                        self._inject_spike(spike_n)
                     self._service_inbox()
                     if self.engine.has_work:
                         self.engine.step()
@@ -271,6 +299,21 @@ class EngineDriver:
             self._do_die(exc)
         finally:
             self._stopped.set()
+
+    def _inject_spike(self, n: int):
+        """Overload-spike fault (serving/faults.py): submit `n`
+        synthetic junk requests at rock-bottom priority through the
+        REAL admission path — they queue behind every real request,
+        exercise deadline fail-fast / preemption pressure, and any
+        that the queue sheds (QueueFull) simply vanish."""
+        for _ in range(n):
+            try:
+                self.engine.add_request(
+                    np.array([1, 2, 3], np.int64),
+                    SamplingParams(max_new_tokens=4,
+                                   priority=1 << 16))
+            except Exception:
+                break
 
     def _service_inbox(self):
         while True:

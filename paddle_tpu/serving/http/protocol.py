@@ -6,7 +6,15 @@ ids (the shape every test and bench in this repo already speaks).
     POST /v1/completions
     {"prompt": [3, 14, 15, 9], "max_tokens": 8, "stream": true,
      "temperature": 0.8, "top_k": 5, "top_p": 0.9,
-     "eos_token_id": 50256, "timeout": 30.0}
+     "eos_token_id": 50256, "timeout": 30.0,
+     "priority": 0, "deadline": 2.0}
+
+`priority` (int, default 0, LOWER = more important) and `deadline`
+(seconds from arrival by which the request must have been PLACED)
+drive the overload scheduler: the queue orders by
+(priority, deadline, arrival), a blocked higher-priority request may
+preempt the lowest-priority resident, and a queued request whose
+deadline expires fails fast as 504 instead of silently waiting.
 
 Non-stream responses mirror the OpenAI completion object with
 `token_ids` in the choice; streaming responses are SSE (`data:` JSON
@@ -21,12 +29,15 @@ string-matching exception text:
     EngineClosed        -> 503
     ReplicaDead         -> 502 (only after failover/migration failed)
     PoisonedRequest     -> 422 (this request kills the step; not retried)
-    timeout, 0 tokens   -> 503 (deadline passed while queued)
+    DeadlineExceeded    -> 504 (placement deadline expired while queued)
+    timeout, 0 tokens   -> 503 (runtime timeout passed while queued)
 
-`usage` carries two resilience fields next to the token counts:
-`cached_tokens` (prompt tokens served from the prefix cache) and
+`usage` carries three resilience fields next to the token counts:
+`cached_tokens` (prompt tokens served from the prefix cache),
 `migrations` (how many times the request was moved to another replica
-mid-stream after its host died — the stream stayed token-identical).
+mid-stream after its host died — the stream stayed token-identical)
+and `preemptions` (how many times it was preempted under overload,
+swapped to the host tier and resumed — also token-identical).
 """
 from __future__ import annotations
 
@@ -37,8 +48,8 @@ from typing import Optional
 
 import numpy as np
 
-from ..errors import (EngineClosed, PoisonedRequest, QueueFull,
-                      RateLimited)
+from ..errors import (DeadlineExceeded, EngineClosed, PoisonedRequest,
+                      QueueFull, RateLimited)
 from ..request import RequestOutput, SamplingParams
 from .driver import ReplicaDead
 
@@ -97,10 +108,16 @@ def parse_completion_request(raw: bytes) -> CompletionRequest:
     top_p = _get(payload, "top_p", (int, float))
     eos = _get(payload, "eos_token_id", int)
     timeout = _get(payload, "timeout", (int, float))
+    priority = _get(payload, "priority", int, 0)
+    deadline = _get(payload, "deadline", (int, float))
     stream = bool(_get(payload, "stream", bool, False))
     if timeout is not None and (timeout <= 0
                                 or not math.isfinite(timeout)):
         raise ProtocolError(400, "\"timeout\" must be a positive "
+                            "finite number of seconds")
+    if deadline is not None and (deadline <= 0
+                                 or not math.isfinite(deadline)):
+        raise ProtocolError(400, "\"deadline\" must be a positive "
                             "finite number of seconds")
     try:
         sampling = SamplingParams(
@@ -110,7 +127,9 @@ def parse_completion_request(raw: bytes) -> CompletionRequest:
             top_p=None if top_p is None else float(top_p),
             greedy=bool(payload.get("greedy", True)),
             eos_token_id=eos,
-            timeout_s=None if timeout is None else float(timeout))
+            timeout_s=None if timeout is None else float(timeout),
+            priority=int(priority),
+            deadline_s=None if deadline is None else float(deadline))
     except ValueError as e:
         raise ProtocolError(400, str(e))
     return CompletionRequest(
@@ -135,7 +154,10 @@ def _usage(out: RequestOutput) -> dict:
                 getattr(out, "accepted_draft_tokens", 0) or 0),
             # mid-stream replica migrations this request survived
             # (each one a token-identical continuation on a survivor)
-            "migrations": int(getattr(out, "migrations", 0) or 0)}
+            "migrations": int(getattr(out, "migrations", 0) or 0),
+            # overload preemptions this request survived (banked +
+            # swapped to the host tier + resumed, token-identically)
+            "preemptions": int(getattr(out, "preemptions", 0) or 0)}
 
 
 def completion_body(ticket_id: str, model: str,
@@ -186,6 +208,8 @@ def status_for_error(exc: BaseException) -> int:
         return 429
     if isinstance(exc, PoisonedRequest):
         return 422
+    if isinstance(exc, DeadlineExceeded):
+        return 504
     if isinstance(exc, ReplicaDead):
         return 502
     if isinstance(exc, EngineClosed):
@@ -205,6 +229,11 @@ def status_for_output(out: RequestOutput) -> int:
         return 200
     if out.finish_reason == "timeout":
         return 503 if not out.token_ids else 200
+    if out.finish_reason == "deadline":
+        # the placement deadline expired while queued: by construction
+        # zero tokens — the overload fail-fast, distinct from 429
+        # (shed at the door) and 503 (not admitting at all)
+        return 504
     if out.finish_reason == "replica_failure":
         return 502
     if out.finish_reason == "poisoned":

@@ -152,7 +152,12 @@ class ReplicaWatchdog:
         self.kills_total = 0
 
     def poll(self) -> List:
-        """One scan; returns the drivers condemned by it."""
+        """One scan; returns the drivers condemned by it. A driver may
+        expose `watchdog_grace_s` — extra tolerated staleness scaled
+        with the tokens packed into its in-flight compiled call — so a
+        legitimately huge unified verify/prefill step reads as SLOW,
+        not dead (false-positive hardening; `EngineDriver` computes it
+        from `engine.step_tokens_inflight`)."""
         condemned = []
         now = self.clock()
         for d in self.drivers:
@@ -162,10 +167,13 @@ class ReplicaWatchdog:
             if beat is None:
                 continue            # pump not yet ticking
             stale = now - beat
-            if stale > self.timeout_s:
+            allowed = self.timeout_s + float(
+                getattr(d, "watchdog_grace_s", 0.0) or 0.0)
+            if stale > allowed:
                 d.condemn(ReplicaHung(
                     f"{d.name}: no heartbeat for {stale:.3f}s "
-                    f"(watchdog_timeout_s={self.timeout_s})"))
+                    f"(watchdog_timeout_s={self.timeout_s}, "
+                    f"grace={allowed - self.timeout_s:.3f}s)"))
                 self.kills_total += 1
                 condemned.append(d)
                 if self.on_kill is not None:
@@ -195,6 +203,14 @@ class Ticket:
         # accepted speculative drafts banked from dead attempts (the
         # live attempt's own count rides on its Request)
         self._accepted_drafts = 0
+        # engine-level preemptions banked from dead attempts (the
+        # overload counter follows the request across migrations)
+        self._preemptions = 0
+        # the dead attempt whose tokens were just banked: while a
+        # terminal failover failure leaves it as self.request, the
+        # merged output must not count its tokens TWICE (they are
+        # already in _history)
+        self._banked: Optional[Request] = None
         self._cancelled = False
         self._ttft_s: Optional[float] = None   # first attempt's, if any
         # the engine-level request id is the TICKET id — stable across
@@ -256,18 +272,28 @@ class Ticket:
     def output(self) -> RequestOutput:
         """Merged client-facing view of every attempt: banked history
         + the final attempt's tokens against the ORIGINAL prompt, with
-        the migration count (usage.migrations over HTTP)."""
+        the migration count (usage.migrations over HTTP). When every
+        re-placement failed (migration cap / no survivor) the live
+        attempt IS the banked dead one — its tokens and counters are
+        already in the banked totals and must not be added twice."""
         out = self.request.output()
         if not self._history and not self.migrations:
             return out
+        live_is_banked = self.request is self._banked
         return RequestOutput(
             request_id=out.request_id,
             prompt_token_ids=self._prompt_ids.tolist(),
-            token_ids=self._history + list(out.token_ids),
+            token_ids=(list(self._history) if live_is_banked
+                       else self._history + list(out.token_ids)),
             finish_reason=out.finish_reason,
             cached_tokens=out.cached_tokens,
-            accepted_draft_tokens=(self._accepted_drafts
-                                   + out.accepted_draft_tokens),
+            accepted_draft_tokens=(
+                self._accepted_drafts
+                + (0 if live_is_banked
+                   else out.accepted_draft_tokens)),
+            preemptions=(self._preemptions
+                         + (0 if live_is_banked
+                            else out.preemptions)),
             migrations=self.migrations,
             ttft_s=self._ttft_s if self._ttft_s is not None
             else out.ttft_s,
@@ -300,9 +326,20 @@ class Ticket:
             self._ttft_s = dead.output().ttft_s
         self._history.extend(dead.output_tokens)
         self._accepted_drafts += dead.accepted_draft_tokens
+        self._preemptions += dead.preemptions
+        self._banked = dead
         if not self._history:
             self._retry(self._prompt_ids, self._sampling)
             return
+        # migration CAP (satellite fix): a fleet where every survivor
+        # keeps dying must not bounce a started stream forever — after
+        # max_migrations the typed replica error surfaces and the
+        # partial stream closes, usage.migrations reported as-is
+        if self.migrations >= self._router.max_migrations:
+            raise ReplicaDead(
+                f"migration cap reached ({self.migrations} of "
+                f"{self._router.max_migrations}); giving up on "
+                f"ticket {self.id}")
         remaining = self._sampling.max_new_tokens - len(self._history)
         if remaining <= 0:
             # unreachable: the engine retires at max_new_tokens before
@@ -341,6 +378,7 @@ class Ticket:
             # can never act on a stale pair
             with r._lock:
                 self.driver, self.request = driver, request
+                self._banked = None      # live attempt is fresh again
                 self._tried.append(driver)
                 self.attempts += 1
                 r.retries_total += 1
@@ -354,7 +392,8 @@ class Ticket:
 
 class Router:
     def __init__(self, drivers: Sequence[EngineDriver], *,
-                 max_retries: int = 3, backoff_base_s: float = 0.05,
+                 max_retries: int = 3, max_migrations: int = 8,
+                 backoff_base_s: float = 0.05,
                  backoff_cap_s: float = 2.0,
                  default_timeout_s: Optional[float] = None,
                  jitter=None,
@@ -370,6 +409,10 @@ class Router:
             raise ValueError(f"duplicate driver names: {names}")
         self.drivers: List[EngineDriver] = list(drivers)
         self.max_retries = int(max_retries)
+        # per-ticket bound on mid-stream migrations: a chaos schedule
+        # that kills every survivor must terminate in a typed replica
+        # error, not an endless bounce
+        self.max_migrations = int(max_migrations)
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_cap_s = float(backoff_cap_s)
         self.default_timeout_s = default_timeout_s

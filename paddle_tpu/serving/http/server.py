@@ -283,7 +283,16 @@ class _Handler(BaseHTTPRequestHandler):
         # merged view across attempts (mid-stream migration banks the
         # tokens of dead attempts; usage carries the migration count)
         out = ticket.output()
-        self._send_json(status_for_output(out),
+        status = status_for_output(out)
+        if out.finish_reason == "deadline":
+            # fail-fast overload path: the request never started (by
+            # construction zero tokens), so clients get the typed
+            # error envelope, not an empty completion
+            self._send_error_json(
+                status, "placement deadline exceeded while queued; "
+                "the request never started", "deadline_exceeded")
+            return
+        self._send_json(status,
                         completion_body(ticket.id,
                                         self.server.model_name, out))
 

@@ -126,9 +126,19 @@ class ServingMetrics:
         self.requests_cancelled = 0
         self.requests_timeout = 0
         self.requests_aborted = 0
+        # queued requests that missed their placement deadline and
+        # were failed fast ("deadline", HTTP 504) — the overload
+        # fail-fast path, distinct from the runtime timeout above
+        self.requests_deadline = 0
         # requests quarantined by the engine's poison bisection (they
         # deterministically killed the step; HTTP 422, never retried)
         self.requests_poisoned = 0
+        # overload preemption: residents preempted (banked + swapped
+        # to the host tier + requeued) and the whole-page traffic
+        # through the device<->host swap programs
+        self.preemptions = 0
+        self.swapped_out_pages = 0
+        self.swapped_in_pages = 0
         self.tokens_generated = 0
         self.prompt_tokens = 0
         self.prefills = 0
@@ -146,6 +156,11 @@ class ServingMetrics:
         self.pool_pages_used = 0
         self.pool_pages_total = 0
         self.pool_pages_cached = 0
+        # host-RAM tier gauges: outstanding swapped-out logical pages
+        # (device side) and host slot occupancy
+        self.pool_pages_swapped = 0
+        self.host_pages_used = 0
+        self.host_pages_total = 0
         self.prefill_stall = 0
         # prefix-cache mirror (source of truth: RadixPrefixCache; the
         # engine pushes a stats() snapshot every step so scrapes never
@@ -186,6 +201,10 @@ class ServingMetrics:
         # synchronized wall time of one compiled decode step — the
         # number the attn_impl A/B compares
         self.decode_step_s = Histogram(buckets=LATENCY_BUCKETS)
+        # wall time of one preempted request's RESUME swap-in (all its
+        # restored pages, host->device) — the latency a preemption
+        # adds at re-admission, the overload bench's p99
+        self.swap_in_s = Histogram(buckets=LATENCY_BUCKETS)
         # tokens packed into one unified step (prefill + decode +
         # draft together — the "how full is the budget" histogram)
         self.packed_tokens_hist = Histogram(
@@ -241,6 +260,8 @@ class ServingMetrics:
                 self.requests_cancelled += 1
             elif req.finish_reason == "timeout":
                 self.requests_timeout += 1
+            elif req.finish_reason == "deadline":
+                self.requests_deadline += 1
             elif req.finish_reason in ("stop", "length"):
                 self.requests_completed += 1
             elif req.finish_reason == "poisoned":
@@ -252,6 +273,21 @@ class ServingMetrics:
     def on_decode_step(self, wall_s: float):
         with self._lock:
             self.decode_step_s.record(wall_s)
+
+    def on_preempt(self, pages_out: int):
+        """One resident was preempted: `pages_out` of its KV pages
+        swapped out to the host tier (0 = pure recompute fallback)."""
+        with self._lock:
+            self.preemptions += 1
+            self.swapped_out_pages += int(pages_out)
+
+    def on_swap_in(self, pages_in: int, wall_s: float):
+        """Host->device restore: a resumed request's pages (or one
+        prefix-cache spill restore) swapped back in."""
+        with self._lock:
+            self.swapped_in_pages += int(pages_in)
+            if pages_in and wall_s > 0:
+                self.swap_in_s.record(wall_s)
 
     def on_unified_step(self, prefill_tokens: int, decode_tokens: int,
                         wall_s: float, draft_tokens: int = 0):
@@ -290,6 +326,8 @@ class ServingMetrics:
     def on_step(self, queue_depth: int, occupancy: float, num_slots: int,
                 pages_used: int = 0, pages_total: int = 0,
                 stall_chunks: int = 0, pages_cached: int = 0,
+                pages_swapped: int = 0, host_pages_used: int = 0,
+                host_pages_total: int = 0,
                 prefix_stats: Optional[dict] = None):
         with self._lock:
             self.decode_steps += 1
@@ -301,6 +339,9 @@ class ServingMetrics:
             self.pool_pages_used = pages_used
             self.pool_pages_total = pages_total
             self.pool_pages_cached = pages_cached
+            self.pool_pages_swapped = pages_swapped
+            self.host_pages_used = host_pages_used
+            self.host_pages_total = host_pages_total
             if prefix_stats is not None:
                 self.prefix = dict(prefix_stats)
             self.prefill_stall = stall_chunks
@@ -331,9 +372,14 @@ class ServingMetrics:
                 "completed": self.requests_completed,
                 "cancelled": self.requests_cancelled,
                 "timeout": self.requests_timeout,
+                "deadline": self.requests_deadline,
                 "aborted": self.requests_aborted,
                 "poisoned": self.requests_poisoned,
             },
+            "preemptions": self.preemptions,
+            "swapped_out_pages": self.swapped_out_pages,
+            "swapped_in_pages": self.swapped_in_pages,
+            "swap_in_s": self.swap_in_s.snapshot(),
             "tokens_generated": self.tokens_generated,
             "prompt_tokens": self.prompt_tokens,
             "prefills": self.prefills,
@@ -362,7 +408,12 @@ class ServingMetrics:
                 "pages_used": self.pool_pages_used,
                 "pages_total": self.pool_pages_total,
                 "pages_cached": self.pool_pages_cached,
+                "pages_swapped": self.pool_pages_swapped,
                 "utilization": self.pool_utilization_hist.snapshot(),
+            },
+            "host_pool": {
+                "pages_used": self.host_pages_used,
+                "pages_total": self.host_pages_total,
             },
             "prefix": (None if self.prefix is None else {
                 **self.prefix,
@@ -431,6 +482,14 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                        ("prefix_hit_rate", "gauge"),
                        ("engine_info", "gauge"),
                        ("poisoned_total", "counter"),
+                       ("preemptions_total", "counter"),
+                       ("deadline_expired_total", "counter"),
+                       ("swapped_out_pages_total", "counter"),
+                       ("swapped_in_pages_total", "counter"),
+                       ("pool_pages_swapped", "gauge"),
+                       ("host_pages_used", "gauge"),
+                       ("host_pages_total", "gauge"),
+                       ("swap_in_seconds", "histogram"),
                        ("unified_steps_total", "counter"),
                        ("prefill_stall_steps_total", "counter"),
                        ("spec_drafted_total", "counter"),
@@ -469,14 +528,28 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
         if snap.get("packed_tokens_per_step") is not None:
             _hist_lines(f"{namespace}_packed_tokens_per_step",
                         snap["packed_tokens_per_step"], lab, lines)
-        for outcome in ("completed", "cancelled", "timeout", "aborted",
-                        "poisoned"):
+        for outcome in ("completed", "cancelled", "timeout", "deadline",
+                        "aborted", "poisoned"):
             lines.append(
                 f"{namespace}_requests_total"
                 + _fmt_labels({**lab, "outcome": outcome})
                 + f" {snap['requests'].get(outcome, 0)}")
         lines.append(f"{namespace}_poisoned_total" + _fmt_labels(lab)
                      + f" {snap['requests'].get('poisoned', 0)}")
+        lines.append(f"{namespace}_deadline_expired_total"
+                     + _fmt_labels(lab)
+                     + f" {snap['requests'].get('deadline', 0)}")
+        lines.append(f"{namespace}_preemptions_total" + _fmt_labels(lab)
+                     + f" {snap.get('preemptions', 0)}")
+        lines.append(f"{namespace}_swapped_out_pages_total"
+                     + _fmt_labels(lab)
+                     + f" {snap.get('swapped_out_pages', 0)}")
+        lines.append(f"{namespace}_swapped_in_pages_total"
+                     + _fmt_labels(lab)
+                     + f" {snap.get('swapped_in_pages', 0)}")
+        if snap.get("swap_in_s") is not None:
+            _hist_lines(f"{namespace}_swap_in_seconds",
+                        snap["swap_in_s"], lab, lines)
         lines.append(f"{namespace}_tokens_generated_total"
                      + _fmt_labels(lab) + f" {snap['tokens_generated']}")
         lines.append(f"{namespace}_queue_depth" + _fmt_labels(lab)
@@ -492,6 +565,14 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                      + f" {pool['pages_total']}")
         lines.append(f"{namespace}_pool_pages_cached" + _fmt_labels(lab)
                      + f" {pool.get('pages_cached', 0)}")
+        lines.append(f"{namespace}_pool_pages_swapped"
+                     + _fmt_labels(lab)
+                     + f" {pool.get('pages_swapped', 0)}")
+        host = snap.get("host_pool") or {}
+        lines.append(f"{namespace}_host_pages_used" + _fmt_labels(lab)
+                     + f" {host.get('pages_used', 0)}")
+        lines.append(f"{namespace}_host_pages_total" + _fmt_labels(lab)
+                     + f" {host.get('pages_total', 0)}")
         prefix = snap.get("prefix")
         if prefix is not None:
             for metric, key in [("prefix_lookups_total", "lookups"),

@@ -24,16 +24,30 @@ tree. Every page is in exactly one of three states:
               now. Cached pages are NOT allocatable; the cache evicts
               (frees) them under page pressure.
 
+A fourth, SWAPPED, state tracks the HOST-RAM tier (graceful overload
+degradation): `swap_out(pages)` declares that a page's KV content has
+been copied to host memory — the device page returns to the free list
+(that is the point: preempting a resident frees HBM) and the pool
+counts the outstanding host-resident logical page until either
+`swapped_restored` (the content was swapped back into freshly
+allocated device pages) or `drop_swapped` (the preempted request died
+before resuming and its host copy was discarded). The actual host
+bytes live in a `HostPagePool`.
+
 Invariants are enforced, not assumed: double free, freeing a page that
-is still shared (refcount > 1), retaining a free page, and parking a
-referenced page all raise. `assert_quiesced()` is the engine-shutdown
-leak check: after drain/abort every page must be FREE or CACHED.
+is still shared (refcount > 1), retaining a free page, parking a
+referenced page, swapping out a shared or free page, and
+over-draining the swapped count all raise. `assert_quiesced()` is the
+engine-shutdown leak check: after drain/abort every page must be FREE
+or CACHED — and no preempted request's KV may be stranded in the host
+tier (swapped count 0).
 """
 from __future__ import annotations
 
-from typing import Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional
 
-__all__ = ["PagePool", "TRASH_PAGE", "pages_needed", "chunk_bucket"]
+__all__ = ["PagePool", "HostPagePool", "TRASH_PAGE", "pages_needed",
+           "chunk_bucket"]
 
 TRASH_PAGE = 0      # reserved: never allocated, absorbs masked writes
 
@@ -61,6 +75,13 @@ class PagePool:
         self._ref = [0] * self.num_pages
         self._is_cached = [False] * self.num_pages
         self._n_cached = 0
+        # logical pages currently living in the host tier (their
+        # device pages were freed by swap_out), split by kind: a
+        # preempted REQUEST's KV is an obligation that must drain
+        # before shutdown, a SPILLED prefix page is legitimate
+        # long-lived cache state
+        self._n_swapped = 0       # preempted-request pages
+        self._n_spilled = 0       # prefix-cache spilled pages
 
     # -- introspection -----------------------------------------------------
     @property
@@ -76,6 +97,14 @@ class PagePool:
     def used_pages(self) -> int:
         """Pages referenced by at least one live request."""
         return (self.num_pages - 1) - len(self._free) - self._n_cached
+
+    @property
+    def swapped_pages(self) -> int:
+        """Outstanding logical pages whose KV lives in the host tier
+        (swap_out'ed, not yet restored or dropped), both kinds. Their
+        device pages are FREE — this counter tracks the host-side
+        obligation."""
+        return self._n_swapped + self._n_spilled
 
     def refcount(self, page: int) -> int:
         self._check_range(page)
@@ -154,6 +183,73 @@ class PagePool:
             self._is_cached[p] = True
             self._n_cached += 1
 
+    # -- host-tier swap (overload preemption / prefix spill) ---------------
+    def swap_out(self, pages: Iterable[int], spill: bool = False):
+        """Declare each page's KV content moved to the host tier: the
+        device page returns to the free list (HBM reclaimed — the
+        whole point of preemption) and the pool records one
+        outstanding SWAPPED logical page per entry. Only a privately
+        held page (refcount exactly 1 — a preempted request's own
+        page) or a parked cache-resident page (refcount 0, CACHED — a
+        spilled prefix page) may swap out; a shared page would be
+        swapped out from under its other holders, and swapping a FREE
+        page is a double-swap-out / use-after-free. `spill=True`
+        marks the page as prefix-cache spill (legitimate long-lived
+        cache state) rather than a preempted request's obligation."""
+        pages = list(pages)
+        for p in pages:
+            self._check_range(p)
+            if p in self._free_set:
+                raise ValueError(
+                    f"swap_out of free page {p} (double swap-out or "
+                    "use-after-free)")
+            if self._ref[p] > 1:
+                raise ValueError(
+                    f"swap_out of page {p} still shared "
+                    f"(refcount {self._ref[p]}); a shared page cannot "
+                    "leave the device")
+            if self._ref[p] == 0 and not self._is_cached[p]:
+                raise ValueError(
+                    f"swap_out of unowned page {p} (neither held nor "
+                    "cache-resident)")
+        for p in pages:
+            if self._is_cached[p]:
+                self._is_cached[p] = False
+                self._n_cached -= 1
+            self._ref[p] = 0
+            self._free.append(p)
+            self._free_set.add(p)
+        if spill:
+            self._n_spilled += len(pages)
+        else:
+            self._n_swapped += len(pages)
+
+    def swapped_restored(self, n: int, spill: bool = False):
+        """`n` host-resident pages were swapped back in (their content
+        restored into freshly allocated device pages): the host-side
+        obligation shrinks."""
+        self._drain_swapped(n, spill, "restore")
+
+    def drop_swapped(self, n: int, spill: bool = False):
+        """`n` host-resident pages were discarded without restore (the
+        preempted request was cancelled / timed out / aborted, or a
+        spilled prefix page was evicted from the host tier)."""
+        self._drain_swapped(n, spill, "drop")
+
+    def _drain_swapped(self, n: int, spill: bool, what: str):
+        n = int(n)
+        if n < 0:
+            raise ValueError("n must be >= 0")
+        have = self._n_spilled if spill else self._n_swapped
+        if n > have:
+            raise ValueError(
+                f"{what} of {n} swapped pages but only "
+                f"{have} are outstanding")
+        if spill:
+            self._n_spilled -= n
+        else:
+            self._n_swapped -= n
+
     # -- freeing -----------------------------------------------------------
     def free(self, pages: Iterable[int]):
         """Return pages to the free list. Raises on double free and on
@@ -180,19 +276,88 @@ class PagePool:
     # -- invariants --------------------------------------------------------
     def assert_quiesced(self):
         """Engine-shutdown leak check: every page FREE or CACHED (no
-        request reference survived retirement), and the accounting
-        closes: free + cached == allocatable pool size."""
+        request reference survived retirement), no preempted REQUEST's
+        KV stranded in the host tier (every request-kind SWAPPED page
+        restored or dropped — the prefix cache's deliberately SPILLED
+        pages are legitimate long-lived cache state and may remain),
+        and the accounting closes: free + cached == allocatable pool
+        size."""
         leaked = [p for p in range(1, self.num_pages) if self._ref[p] > 0]
         if leaked:
             raise RuntimeError(
                 f"page leak: pages {leaked} still referenced after "
                 "shutdown (refcounts "
                 f"{[self._ref[p] for p in leaked]})")
+        if self._n_swapped:
+            raise RuntimeError(
+                f"host-tier leak: {self._n_swapped} preempted "
+                "request page(s) neither restored nor dropped after "
+                "shutdown")
         if len(self._free) + self._n_cached != self.num_pages - 1:
             raise RuntimeError(
                 f"page accounting broken: free {len(self._free)} + "
                 f"cached {self._n_cached} != pool size "
                 f"{self.num_pages - 1}")
+
+
+class HostPagePool:
+    """The HOST-RAM page tier: a capacity-bounded store of whole-page
+    KV payloads (one opaque array per page — the engine stores
+    `[n_layers, 2, page_size, H, D]` blocks).
+
+    This is stage 1 of the ROADMAP's fleet-scale prefix cache: cache /
+    preemption capacity becomes host RAM, not HBM. `store` admits a
+    payload and returns a host slot id (or None when full — the caller
+    falls back to recompute-on-resume or plain eviction); `load`
+    returns the payload for swap-in; `free` releases the slot. Slot
+    invariants mirror PagePool's: loading or freeing a slot that is
+    not live raises (a swap-in of a freed page is a use-after-free,
+    never silent garbage)."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 0:
+            raise ValueError("num_pages must be >= 0")
+        self.num_pages = int(num_pages)
+        self._data: Dict[int, object] = {}
+        self._next = 0
+        self._free: List[int] = []
+
+    @property
+    def used_pages(self) -> int:
+        return len(self._data)
+
+    @property
+    def free_pages(self) -> int:
+        return self.num_pages - len(self._data)
+
+    def store(self, payload) -> Optional[int]:
+        """Admit one page payload; returns its host slot id, or None
+        (no side effects) when the tier is full."""
+        if len(self._data) >= self.num_pages:
+            return None
+        if self._free:
+            slot = self._free.pop()
+        else:
+            slot = self._next
+            self._next += 1
+        self._data[slot] = payload
+        return slot
+
+    def load(self, slot: int):
+        """Payload of a live slot (the swap-in read). Raises on a slot
+        that was never stored or already freed."""
+        if slot not in self._data:
+            raise ValueError(
+                f"load of dead host page {slot} (swap-in of a freed "
+                "page)")
+        return self._data[slot]
+
+    def free(self, slot: int):
+        """Release a live slot. Raises on double free."""
+        if slot not in self._data:
+            raise ValueError(f"double free of host page {slot}")
+        del self._data[slot]
+        self._free.append(slot)
 
 
 def pages_needed(prompt_len: int, max_new_tokens: int,

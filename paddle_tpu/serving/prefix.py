@@ -46,11 +46,19 @@ Lifecycle
   PARKED as cache-resident rather than freed.
 - `release(pages)` — retirement of cancelled/aborted/timed-out
   requests: refcount--; tree pages park, private pages free.
+- `spill(need)` — the HOST-RAM tier (stage 1 of the ROADMAP's
+  fleet-scale prefix cache): under page pressure, unreferenced parked
+  pages are SPILLED to host memory before anything is dropped — the
+  device page frees (PagePool.swap_out), the node stays in the tree
+  with a host slot instead of a device page, and a later match
+  RESTORES it (swap-in into a freshly allocated page) instead of
+  re-prefilling. Wired by the engine via `set_host_tier`; without it
+  spill is a no-op and eviction behaves exactly as before.
 - `evict(need)` — leaf-to-root LRU: only unreferenced leaves (and
   partial pages) are freed, oldest last-use first; a node referenced by
   any running request is never touched. Eviction happens inside
-  `acquire` before admission backpressure, so a cold or thrashing cache
-  behaves exactly like no cache at all.
+  `acquire` AFTER spilling and before admission backpressure, so a
+  cold or thrashing cache behaves exactly like no cache at all.
 
 The compiled decode/prefill programs never see any of this: hits, COW
 and eviction only change which page ids the host page tables carry.
@@ -91,19 +99,22 @@ def resolve_prefix_cache_flag(override=None) -> bool:
 
 
 class _Node:
-    """One radix edge: a full page of `page_size` token ids."""
+    """One radix edge: a full page of `page_size` token ids. A node
+    whose content was spilled to the host tier keeps `page=None` and a
+    `host` slot id until a match restores it."""
 
     __slots__ = ("tokens", "page", "parent", "children", "partials",
-                 "last_used")
+                 "last_used", "host")
 
     def __init__(self, tokens: Optional[np.ndarray], page: Optional[int],
                  parent: Optional["_Node"]):
         self.tokens = tokens          # int64 [page_size]; None at root
-        self.page = page              # pool page id; None at root
+        self.page = page              # pool page id; None at root/spilled
         self.parent = parent
         self.children: Dict[bytes, "_Node"] = {}
         self.partials: List["_Partial"] = []
         self.last_used = 0
+        self.host = None              # host-tier slot id when spilled
 
 
 class _Partial:
@@ -170,6 +181,19 @@ class RadixPrefixCache:
         self.evicted_pages_total = 0
         self.cow_copies_total = 0
         self.inserted_pages_total = 0
+        self.spilled_pages_total = 0
+        self.restored_pages_total = 0
+        # host tier callbacks (engine-wired; None = no host tier):
+        # _host_store(page) -> host slot or None (copies the device
+        # page's KV to host RAM; the cache then swap_out's the page),
+        # _host_load(host_slot) -> device page or None (allocates a
+        # fresh page, restores into it, returns it PARKED cache-
+        # resident), _host_drop(host_slot) (discard a spilled page's
+        # host copy — evicted from the tree while swapped)
+        self._host_store = None
+        self._host_load = None
+        self._host_drop = None
+        self._n_spilled = 0
 
     # -- introspection -----------------------------------------------------
     @property
@@ -177,6 +201,20 @@ class RadixPrefixCache:
         """Pages the radix tree currently indexes (referenced or
         cache-resident)."""
         return len(self._owner)
+
+    @property
+    def spilled_nodes(self) -> int:
+        """Tree nodes whose page currently lives in the host tier."""
+        return self._n_spilled
+
+    def set_host_tier(self, store, load, drop):
+        """Wire the host-RAM page tier (engine callbacks — see the
+        attribute docs in __init__). With these set, page pressure
+        SPILLS parked pages to host before evicting, and a match on a
+        spilled node swap-ins instead of falling back to prefill."""
+        self._host_store = store
+        self._host_load = load
+        self._host_drop = drop
 
     def stats(self) -> dict:
         return {
@@ -186,6 +224,9 @@ class RadixPrefixCache:
             "evicted_pages": self.evicted_pages_total,
             "cow_copies": self.cow_copies_total,
             "inserted_pages": self.inserted_pages_total,
+            "spilled_pages": self.spilled_pages_total,
+            "restored_pages": self.restored_pages_total,
+            "spilled_nodes": self._n_spilled,
             "tree_pages": self.tree_pages,
             "resident_pages": self.pool.cached_pages,
             "hit_rate": (self.hits / self.lookups) if self.lookups
@@ -196,23 +237,58 @@ class RadixPrefixCache:
         obj.last_used = next(self._tick)
 
     # -- matching ----------------------------------------------------------
-    def _match_full(self, tok: np.ndarray, limit: int
-                    ) -> Tuple[_Node, List[int], int]:
+    def _match_full(self, tok: np.ndarray, limit: int, acquire: bool
+                    = True) -> Tuple[_Node, List[int], int]:
         """Walk full-page edges: returns (last node, matched page ids,
         matched token count). Only whole pages match here; `limit`
         caps the match so at least one prompt token always prefills
-        (the sampler needs the last token's logits)."""
+        (the sampler needs the last token's logits). With `acquire`
+        (the reservation path) each matched page is RETAINED as it is
+        walked — so the restore/spill machinery below can never touch
+        the match in progress — and a SPILLED node on the path is
+        RESTORED from the host tier (swap-in into a fresh device
+        page, spilling another LRU page to make room if needed); if
+        restore fails (host tier gone / truly no page) the walk stops
+        there and the tail simply prefills. `acquire=False` (the
+        side-effect-free lookup probe) counts spilled spans as
+        matchable without touching anything."""
         ps = self.page_size
         node, pages, depth = self.root, [], 0
         while depth + ps <= limit:
             child = node.children.get(tok[depth:depth + ps].tobytes())
             if child is None:
                 break
+            if child.page is None:            # spilled to host
+                if not acquire:
+                    node = child
+                    depth += ps
+                    continue
+                if not self._restore(child):
+                    break
             node = child
+            if acquire:
+                self.pool.retain([child.page])
             pages.append(child.page)
             depth += ps
             self._touch(child)
         return node, pages, depth
+
+    def _restore(self, node: _Node) -> bool:
+        """Swap a spilled node's page back in from the host tier. The
+        engine's load callback returns the restored device page
+        already PARKED (cache-resident, refcount 0) so the caller's
+        retain path treats it exactly like any other tree page."""
+        if self._host_load is None:
+            return False
+        page = self._host_load(node.host)
+        if page is None:
+            return False
+        node.page = page
+        node.host = None
+        self._owner[page] = node
+        self._n_spilled -= 1
+        self.restored_pages_total += 1
+        return True
 
     def _best_tail(self, node: _Node, tail: np.ndarray
                    ) -> Tuple[int, Optional[int]]:
@@ -226,6 +302,8 @@ class RadixPrefixCache:
             if k > best_k:
                 best_k, best_page, best_obj = k, part.page, part
         for child in node.children.values():
+            if child.page is None:
+                continue      # spilled: not a COW source on device
             k = _common_prefix(tail, child.tokens)
             if k > best_k:
                 best_k, best_page, best_obj = k, child.page, child
@@ -235,10 +313,11 @@ class RadixPrefixCache:
 
     def lookup(self, prompt) -> int:
         """Side-effect-free probe: how many tokens of `prompt` the
-        cache could serve right now (full pages + best COW tail)."""
+        cache could serve right now (full pages — device or spilled —
+        plus the best COW tail)."""
         tok = _tok(prompt)
         limit = max(0, tok.size - 1)
-        node, _, depth = self._match_full(tok, limit)
+        node, _, depth = self._match_full(tok, limit, acquire=False)
         k, _ = self._best_tail(node, tok[depth:limit])
         return depth + k
 
@@ -260,20 +339,40 @@ class RadixPrefixCache:
         cow_k, cow_src = self._best_tail(node, tok[depth:limit])
         total = pages_needed(plen, max_new_tokens, ps)
         need_fresh = total - len(shared)
-        # protect the match from the eviction below (and from evictions
-        # by admissions later in this same step boundary)
-        self.pool.retain(shared)
+        # the matched pages are already retained (the walk retains as
+        # it goes, protecting them from the spill/eviction below and
+        # from later admissions at this same boundary); only the COW
+        # source still needs its protection reference
         if cow_src is not None:
             self.pool.retain([cow_src])
         fresh = self.pool.alloc(need_fresh)
         if fresh is None:
-            self.evict(need_fresh - self.pool.free_pages)
+            # page pressure: SPILL parked pages to the host tier first
+            # (their KV survives, a later match swap-ins instead of
+            # re-prefilling), then EVICT whatever pressure remains
+            short = need_fresh - self.pool.free_pages
+            short -= self.spill(short)
+            if short > 0:
+                self.evict(short)
+            fresh = self.pool.alloc(need_fresh)
+        if fresh is None and cow_src is not None:
+            # the COW claim can be the very page blocking admission: a
+            # request whose budget spans the whole pool retains its
+            # COW source, which spill/evict then must skip — a
+            # permanent self-deadlock at the queue head. A partial-
+            # page match is never worth a refusal: forfeit the claim
+            # (the page parks, becoming spillable/evictable again) and
+            # admit with the shorter full-page match instead.
+            self.release([cow_src])
+            cow_src, cow_k = None, 0
+            short = need_fresh - self.pool.free_pages
+            short -= self.spill(short)
+            if short > 0:
+                self.evict(short)
             fresh = self.pool.alloc(need_fresh)
         if fresh is None:
             # roll back: the match returns to exactly its prior state
             self.release(shared)
-            if cow_src is not None:
-                self.release([cow_src])
             return None
         cached = depth + cow_k
         if cached:
@@ -363,12 +462,51 @@ class RadixPrefixCache:
                 return False
         return True
 
+    # -- spill (host tier) -------------------------------------------------
+    def spill(self, need: int) -> int:
+        """Move up to `need` unreferenced parked FULL pages to the
+        host tier, LRU first: the device page frees
+        (PagePool.swap_out) but the tree node survives with a host
+        slot — a later match restores it instead of re-prefilling.
+        Any node (leaf or interior) may spill; only its PAGE moves,
+        the tree structure stays walkable. Returns the number of
+        device pages actually freed (0 without a wired host tier)."""
+        if need <= 0 or self._host_store is None:
+            return 0
+        heap = []
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if (node is not self.root and node.page is not None
+                    and self.pool.refcount(node.page) == 0):
+                heapq.heappush(heap, (node.last_used, id(node), node))
+        spilled = 0
+        while spilled < need and heap:
+            _, _, node = heapq.heappop(heap)
+            if node.page is None or self.pool.refcount(node.page) != 0:
+                continue
+            slot = self._host_store(node.page)
+            if slot is None:
+                break                       # host tier full: stop
+            self.pool.swap_out([node.page], spill=True)
+            del self._owner[node.page]
+            node.host = slot
+            node.page = None
+            self._n_spilled += 1
+            self.spilled_pages_total += 1
+            spilled += 1
+        return spilled
+
     # -- eviction ----------------------------------------------------------
     def _evictable(self, obj) -> bool:
         if isinstance(obj, _Partial):
             return self.pool.refcount(obj.page) == 0
-        return (not obj.children and not obj.partials
-                and self.pool.refcount(obj.page) == 0)
+        if obj.children or obj.partials:
+            return False
+        if obj.page is None:
+            return True       # spilled leaf: only a host copy to drop
+        return self.pool.refcount(obj.page) == 0
 
     def evict(self, need: int) -> int:
         """Free at least `need` unreferenced cached pages, LRU leaves
@@ -403,10 +541,17 @@ class RadixPrefixCache:
                     continue
                 del parent.children[obj.tokens.tobytes()]
                 obj.parent = None
-            del self._owner[obj.page]
-            self.pool.free([obj.page])
-            self.evicted_pages_total += 1
-            freed += 1
+            if getattr(obj, "page", None) is None:
+                # spilled node: only its host copy exists — drop it.
+                # Frees no device page, but may unblock the parent.
+                self._host_drop(obj.host)
+                obj.host = None
+                self._n_spilled -= 1
+            else:
+                del self._owner[obj.page]
+                self.pool.free([obj.page])
+                self.evicted_pages_total += 1
+                freed += 1
             # the parent may have just become an evictable leaf
             if parent is not self.root and self._evictable(parent):
                 heapq.heappush(heap, (parent.last_used, id(parent),
@@ -414,6 +559,7 @@ class RadixPrefixCache:
         return freed
 
     def clear(self) -> int:
-        """Drop every unreferenced cached page (e.g. tests forcing a
-        cold cache). Referenced nodes survive."""
-        return self.evict(self.tree_pages)
+        """Drop every unreferenced cached page — device-resident AND
+        spilled (e.g. tests forcing a cold cache). Referenced nodes
+        survive."""
+        return self.evict(self.tree_pages + self._n_spilled)

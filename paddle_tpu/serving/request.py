@@ -4,6 +4,9 @@ A `Request` is one user generation job moving through the continuous-
 batching lifecycle:
 
     QUEUED -> PREFILL -> DECODE -> FINISHED | CANCELLED
+                 ^          |
+                 +- PREEMPTED (overload: banked + swapped to host,
+                    re-queued; resumes via swap-in)
 
 PREFILL now spans MULTIPLE engine steps for long prompts: the engine
 feeds the prompt through one fixed-shape chunk program per step
@@ -38,6 +41,10 @@ class RequestState(Enum):
     DECODE = 2
     FINISHED = 3
     CANCELLED = 4
+    # preempted under overload: its emitted tokens are banked, its KV
+    # pages swapped to the host tier, and it waits in the queue to
+    # resume (swap-in restores pos; the stream continues untouched)
+    PREEMPTED = 5
 
 
 @dataclass
@@ -55,10 +62,21 @@ class SamplingParams:
     greedy: bool = True
     eos_token_id: Optional[int] = None
     timeout_s: Optional[float] = None
+    # overload scheduling (lower value = more important, 0 default):
+    # the queue orders by (priority, deadline, arrival) and a blocked
+    # higher-priority request may PREEMPT the lowest-priority resident
+    priority: int = 0
+    # placement deadline in seconds from arrival: if it expires while
+    # the request is still QUEUED it fails fast as "deadline" (HTTP
+    # 504) instead of burning a queue slot. Runtime limits stay
+    # timeout_s's job — a started request is never deadline-failed.
+    deadline_s: Optional[float] = None
 
     def __post_init__(self):
         if self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0")
         if self.top_k is not None and self.top_k < 1:
             raise ValueError("top_k must be >= 1")
         if self.top_p is not None and not (0.0 < self.top_p <= 1.0):
@@ -86,7 +104,8 @@ class Request:
         self.on_token = on_token
         self.state = RequestState.QUEUED
         self.output_tokens: List[int] = []
-        # stop|length|cancelled|timeout|replica_failure|poisoned|aborted
+        # stop|length|cancelled|timeout|deadline|replica_failure|
+        # poisoned|aborted
         self.finish_reason: Optional[str] = None
         # typed terminal error, when the finish reason carries one
         # (today: PoisonedRequest attached by the engine's quarantine)
@@ -103,6 +122,18 @@ class Request:
         # VERIFIED drafts (each one skipped a full decode step; 0 with
         # speculation off) — usage.accepted_draft_tokens over HTTP
         self.accepted_draft_tokens: int = 0
+        # overload preemption: how many times this request was
+        # preempted (banked + swapped to host + resumed) on this
+        # engine — usage.preemptions over HTTP
+        self.preemptions: int = 0
+        # preemption swap handle (engine-owned): host-tier slots +
+        # coverage of the banked KV while the request waits to resume;
+        # None whenever the request is not preempted-with-swapped-KV
+        self._swap = None
+        # committed token sequence frozen at the last preemption
+        # (prompt + every emitted token): the resume prefill source —
+        # None until first preempted
+        self._resume_ids = None
         # timeline (engine clock): arrival -> admitted (slot granted,
         # prefill) -> first token -> finished
         self.arrival_t = time.monotonic() if arrival_t is None else arrival_t
@@ -136,6 +167,24 @@ class Request:
         if self.sampling.timeout_s is None:
             return None
         return self.arrival_t + self.sampling.timeout_s
+
+    @property
+    def place_deadline(self) -> Optional[float]:
+        """Absolute time by which the request must have been ADMITTED
+        (deadline_s from arrival); None = no placement deadline."""
+        if self.sampling.deadline_s is None:
+            return None
+        return self.arrival_t + self.sampling.deadline_s
+
+    @property
+    def prefill_ids(self) -> np.ndarray:
+        """The token sequence the engine prefills for this request:
+        the original prompt, or — after a preemption — the committed
+        sequence frozen at preempt time (prompt + banked emitted
+        tokens), so the resume re-prefill regenerates exactly the
+        state the preempted slot held."""
+        return (self._resume_ids if self._resume_ids is not None
+                else self.prompt_ids)
 
     # -- user-facing ------------------------------------------------------
     @property
@@ -176,6 +225,7 @@ class Request:
             finish_reason=self.finish_reason,
             cached_tokens=self.cached_tokens,
             accepted_draft_tokens=self.accepted_draft_tokens,
+            preemptions=self.preemptions,
             ttft_s=(None if self.first_token_t is None
                     else self.first_token_t - self.arrival_t),
             queue_wait_s=(None if self.admitted_t is None
@@ -207,6 +257,10 @@ class RequestOutput:
     # replica after its host died (usage.migrations over HTTP); only
     # the router's merged Ticket view sets it nonzero
     migrations: int = 0
+    # how many times this request was PREEMPTED under overload (banked
+    # + swapped to the host tier + resumed, token-identically) —
+    # usage.preemptions over HTTP
+    preemptions: int = 0
     ttft_s: Optional[float] = None
     queue_wait_s: Optional[float] = None
     e2e_s: Optional[float] = None

@@ -2,28 +2,36 @@
 
 The scheduler owns WHICH request occupies WHICH slot; the engine owns
 the device state. All membership changes (admit into a free slot, evict
-on EOS / max-tokens / timeout / cancel) happen here, between compiled
-steps, so the compiled decode step itself never changes shape — the
-slot-based analogue of Ragged Paged Attention's "requests of uneven
-lengths share one kernel invocation" (PAPERS.md).
+on EOS / max-tokens / timeout / cancel, preempt under overload) happen
+here, between compiled steps, so the compiled decode step itself never
+changes shape — the slot-based analogue of Ragged Paged Attention's
+"requests of uneven lengths share one kernel invocation" (PAPERS.md).
 
-Policy: plain FIFO fairness by arrival order. A freed slot is refilled
-by the longest-waiting queued request at the next step boundary —
-subject to the engine's resource check (`assign(reserve=...)`): with a
-paged KV pool a free slot alone is not admission, the request's whole
-page budget must be free too. With the prefix cache the reserve
-callback is MATCH-THEN-RESERVE: it longest-prefix-matches the prompt
-against the radix tree (shared pages need no fresh allocation) and
-evicts LRU unreferenced cached pages before refusing — so head-of-line
-backpressure only engages once genuinely referenced pages exhaust the
-pool, and a cold cache degrades to exactly the cache-off admission
-order. Backpressure stays head-of-line: when the oldest queued
-request's pages don't fit, nothing behind it is admitted either, so a
-large request can't be starved by a stream of small ones.
+Policy: the queue is ordered by (priority, deadline, arrival) — lower
+`priority` value is more important; within a priority class an earlier
+placement deadline goes first; FIFO arrival order breaks the remaining
+ties, so a priority-flat workload degrades to exactly the old FIFO
+fairness. A freed slot is refilled by the queue HEAD at the next step
+boundary — subject to the engine's resource check
+(`assign(reserve=...)`): with a paged KV pool a free slot alone is not
+admission, the request's whole page budget must be free too. With the
+prefix cache the reserve callback is MATCH-THEN-RESERVE: it
+longest-prefix-matches the prompt against the radix tree (shared pages
+need no fresh allocation) and spills/evicts LRU unreferenced cached
+pages before refusing. Backpressure stays head-of-line ON THE ORDERED
+QUEUE: when the head's pages don't fit, nothing behind it is admitted
+either, so a large high-priority request can't be starved by a stream
+of small low-priority ones — but a blocked head may now PREEMPT: the
+engine picks the least-important resident (`preemption_victim`), banks
+its tokens, swaps its KV to the host tier, and `requeue`s it
+(re-inserted by its ORIGINAL arrival key, bypassing max_queue — a
+preempted resident is never shed).
 """
 from __future__ import annotations
 
-from collections import deque
+import bisect
+import itertools
+import math
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .errors import QueueFull
@@ -38,16 +46,38 @@ class Scheduler:
             raise ValueError("num_slots must be >= 1")
         self.num_slots = num_slots
         self.max_queue = max_queue
-        self._queue: deque = deque()        # FIFO arrival order
+        # ordered by _queue_key: (priority, deadline, arrival, seq)
+        self._queue: List[Request] = []
+        self._seq = itertools.count()
         self.running: Dict[int, Request] = {}   # slot -> request
 
     # -- queue side -------------------------------------------------------
+    @staticmethod
+    def _queue_key(req: Request) -> Tuple:
+        dl = req.place_deadline
+        return (req.sampling.priority,
+                math.inf if dl is None else dl,
+                req.arrival_t,
+                getattr(req, "_queue_seq", 0))
+
+    def _insert(self, req: Request):
+        if not hasattr(req, "_queue_seq"):
+            req._queue_seq = next(self._seq)
+        bisect.insort(self._queue, req, key=self._queue_key)
+
     def submit(self, req: Request):
         if self.max_queue is not None and len(self._queue) >= self.max_queue:
             raise QueueFull(
                 f"admission queue full ({self.max_queue}); shed load or "
                 "raise max_queue")
-        self._queue.append(req)
+        self._insert(req)
+
+    def requeue(self, req: Request):
+        """Put a PREEMPTED resident back in line. Bypasses max_queue —
+        a request that already holds banked progress must never be
+        shed by its own preemption — and keeps the request's ORIGINAL
+        ordering key, so it resumes as soon as its class allows."""
+        self._insert(req)
 
     def drop_queued(self, req: Request) -> bool:
         try:
@@ -63,6 +93,14 @@ class Scheduler:
         self._queue.clear()
         return out
 
+    def peek_queued(self) -> Optional[Request]:
+        """The first non-cancelled queued request (the admission
+        head), without removing it."""
+        for req in self._queue:
+            if req.state is not RequestState.CANCELLED:
+                return req
+        return None
+
     @property
     def queue_depth(self) -> int:
         return len(self._queue)
@@ -77,11 +115,13 @@ class Scheduler:
     # -- membership changes (between compiled steps only) -----------------
     def assign(self, reserve: Optional[Callable[[Request], bool]] = None
                ) -> List[Tuple[int, Request]]:
-        """Join policy: fill free slots from the queue in arrival order.
-        `reserve(req)` (optional) must claim the request's resources
-        (KV pages) and return True, or refuse without side effects —
-        a refusal stops admission at the queue head (FIFO
-        backpressure). Returns the (slot, request) pairs granted this
+        """Join policy: fill free slots from the queue in
+        (priority, deadline, arrival) order. `reserve(req)` (optional)
+        must claim the request's resources (KV pages) and return True,
+        or refuse without side effects — a refusal stops admission at
+        the queue head (ordered head-of-line backpressure; the engine
+        may then preempt a lower-priority resident on the head's
+        behalf). Returns the (slot, request) pairs granted this
         boundary; the engine prefills each one across the following
         steps."""
         grants = []
@@ -90,17 +130,38 @@ class Scheduler:
                     self._queue[0].state is RequestState.CANCELLED:
                 # cancel raced admission (marked between the boundary's
                 # evict pass and this assign): never grant it resources
-                self._queue.popleft()
+                self._queue.pop(0)
             if not self._queue:
                 break
             req = self._queue[0]
             if reserve is not None and not reserve(req):
                 break
-            self._queue.popleft()
+            self._queue.pop(0)
             req.slot = slot
             self.running[slot] = req
             grants.append((slot, req))
         return grants
+
+    def preemption_victim(self, than: Request) -> Optional[Tuple[int,
+                                                                 Request]]:
+        """The least-important resident STRICTLY below `than`'s
+        priority class, or None. "Least important" = highest priority
+        value, then latest (or no) placement deadline, then latest
+        arrival — the mirror image of the admission order, so the
+        request that would have been admitted last is the one evicted
+        first. Strict inequality means equal-priority traffic can
+        never preempt itself into a thrash loop."""
+        victim = None
+        for slot, req in self.running.items():
+            if req.sampling.priority <= than.sampling.priority:
+                continue
+            if req.state not in (RequestState.PREFILL,
+                                 RequestState.DECODE):
+                continue
+            key = self._queue_key(req)
+            if victim is None or key > victim[2]:
+                victim = (slot, req, key)
+        return None if victim is None else (victim[0], victim[1])
 
     def pack_tokens(self, budget: int, width: int,
                     prefill_remaining: Dict[int, int],
@@ -151,19 +212,32 @@ class Scheduler:
 
     def retire(self, slot: int) -> Optional[Request]:
         """Evict policy endpoint: free a slot (EOS / max-tokens /
-        timeout / cancel all land here, decided by the engine)."""
+        timeout / cancel / preemption all land here, decided by the
+        engine)."""
         req = self.running.pop(slot, None)
         if req is not None:
             req.slot = None
         return req
 
     def expired(self, now: float) -> List[Request]:
-        """Queued or running requests past their deadline."""
+        """Queued or running requests past their runtime deadline
+        (timeout_s)."""
         out = [r for r in self._queue
                if r.deadline is not None and now >= r.deadline]
         out += [r for r in self.running.values()
                 if r.deadline is not None and now >= r.deadline]
         return out
+
+    def deadline_expired(self, now: float) -> List[Request]:
+        """Queued NEVER-ADMITTED requests whose placement deadline
+        (deadline_s) has passed — the fail-fast 504 set. A preempted
+        request waiting to resume already met its placement deadline
+        and is never in this list."""
+        return [r for r in self._queue
+                if r.admitted_t is None
+                and r.place_deadline is not None
+                and now >= r.place_deadline
+                and r.state is not RequestState.CANCELLED]
 
     def cancelled_running(self) -> List[Request]:
         return [r for r in self.running.values()

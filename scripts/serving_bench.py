@@ -11,7 +11,7 @@ in the bench trajectory. Prints ONE JSON line and writes the same
 stable-schema report to BENCH_serving.json (override with --out,
 suppress with --out -):
 
-    {"bench": "serving", "schema_version": 7, "attn_impl": "kernel",
+    {"bench": "serving", "schema_version": 8, "attn_impl": "kernel",
      "requests": ..., "ttft_p50_s": ..., "tokens_per_sec": ...,
      "decode_step_ms_p50": ..., "ab": {"kernel": {...},
      "gather": {...}}, "prefix_stats": {...}, "unified": {...},
@@ -53,6 +53,23 @@ not data loss; mid-stream requests MIGRATE to the survivor). The
 report's "chaos" section records truncated/migrated stream counts,
 recovery p99 (worst client-observed inter-token gap across migrated
 streams) and goodput vs the fault-free run.
+
+`--overload` adds the graceful-degradation A/B: a DETERMINISTIC
+virtual-time replay (the engine runs on a harness-driven clock that
+advances a fixed dt per step, so the same numbers come out on any
+machine) of a 3x-oversubscribed trace — a wave of long low-priority
+requests saturating every slot, then a burst of high-priority
+requests with tight placement deadlines — once with preemption ON
+(the default: the blocked high-priority head preempts the
+least-important resident, whose KV swaps to the host-RAM tier and
+resumes later token-identically) and once OFF (pure backpressure).
+The report's "overload" section records per-class goodput, deadline
+misses, preemption/swap traffic and swap-in latency p99 — and the
+script ASSERTS zero high-priority deadline misses with preemption on,
+strictly better high-priority goodput than the off arm, and that a
+priority-flat fault-free replay is bit-identical (same tokens, same
+step count) with preemption on vs off (the machinery costs nothing
+when it never fires).
 
 `--prefix-share P` builds a shared-prefix trace instead of fully
 random prompts: fraction P of the requests prepend one of K
@@ -151,6 +168,14 @@ def main():
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft budget per slot per step for "
                     "--spec-ab (the SpecConfig k knob)")
+    ap.add_argument("--overload", action="store_true",
+                    help="run the deterministic virtual-time 3x "
+                    "overload trace (mixed priorities + deadlines) "
+                    "with preemption on vs off and record the "
+                    "graceful-degradation A/B")
+    ap.add_argument("--overload-scale", type=int, default=1,
+                    help="multiply the overload trace's request "
+                    "counts (the slow soak uses > 1)")
     ap.add_argument("--http", action="store_true",
                     help="also drive the serving/http front-end over "
                     "loopback with the same Poisson trace")
@@ -391,7 +416,7 @@ def main():
 
     report = {
         "bench": "serving",
-        "schema_version": 7,
+        "schema_version": 8,
         "platform": jax.devices()[0].platform,
         "attn_impl": "kernel",
         "requests": n_req,
@@ -460,6 +485,10 @@ def main():
             **{flag: _prefix_summary(run)
                for flag, run in prefix_runs.items()},
         }
+    if args.overload:
+        report["overload"] = overload_trace(
+            model, cfg, slots=args.slots, seed=args.seed + 3,
+            scale=max(1, args.overload_scale))
     if args.http:
         report["http"] = http_trace(
             model, cfg, n_req=n_req, rate=rate, max_new=max_new,
@@ -531,6 +560,29 @@ def main():
         assert chaos["completed"] == n_req, chaos
         if chaos["kills_fired"]:
             assert chaos["migrated_streams"] >= 1, chaos
+    if args.overload:
+        ov = report["overload"]
+        on, off = ov["on"], ov["off"]
+        # the acceptance numbers (exact — the virtual clock makes the
+        # replay deterministic): with preemption ON no high-priority
+        # request misses its deadline and all complete; OFF strands
+        # them behind the full house until every deadline expires, so
+        # high-priority goodput is STRICTLY better with preemption on;
+        # low-priority requests still finish either way (degradation,
+        # not starvation); and the priority-flat fault-free replay is
+        # bit-identical with the machinery on vs off
+        assert on["high_priority"]["deadline_misses"] == 0, ov
+        assert on["high_priority"]["completed"] == \
+            ov["requests_high"], ov
+        assert off["high_priority"]["deadline_misses"] >= 1, ov
+        assert ov["high_goodput_tokens_per_virtual_s"]["on"] > \
+            ov["high_goodput_tokens_per_virtual_s"]["off"], ov
+        assert on["preemptions"] >= 1 and off["preemptions"] == 0, ov
+        assert on["swapped_out_pages"] >= 1, ov
+        assert on["swapped_in_pages"] == on["swapped_out_pages"], ov
+        assert on["low_priority"]["completed"] == \
+            ov["requests_low"], ov
+        assert ov["fault_free"]["identical"], ov
 
 
 def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
@@ -592,6 +644,146 @@ def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
     if collect_tokens:
         out["tokens"] = [list(r.output_tokens) for r in reqs]
     return out
+
+
+def overload_trace(model, cfg, *, slots, seed, scale=1):
+    """--overload: the graceful-degradation A/B on a DETERMINISTIC
+    virtual clock. The engine's injected clock advances a fixed `dt`
+    per scheduler round, so admission, deadline expiry and preemption
+    decisions are bit-reproducible on any machine — the assertions
+    below are exact, not statistical. The trace is 3x oversubscribed:
+    `2 * slots` long LOW-priority requests (priority 5) arrive at 3x
+    the sustainable service rate and saturate every slot, then a burst
+    of HIGH-priority requests (priority 0) lands with a placement
+    deadline far shorter than any resident's remaining runtime. With
+    preemption ON the blocked high-priority head preempts the
+    least-important residents (KV swapped to the host tier; they
+    resume later, token-identically — the engine suite asserts the
+    oracle) and every deadline is met; with preemption OFF every
+    high-priority request waits behind a full house and deadline-fails
+    (504). A third, priority-flat FAULT-FREE replay runs with
+    preemption on vs off and must be bit-identical (same tokens, same
+    step count): the machinery costs nothing when it never fires."""
+    from paddle_tpu.serving import SamplingParams, ServingEngine
+
+    dt = 0.01                     # virtual seconds per engine round
+    high_new, plen = 8, 8
+    n_low, n_high = 2 * slots * scale, slots * scale
+    # the margins must stay wide AND deterministic at any scale: the
+    # high burst is `scale` waves deep (slots per wave), so wave w's
+    # placement deadline covers the queueing among the highs
+    # themselves — w waves of high service — while every deadline
+    # stays far below the OFF arm's wait (slots turn over only as low
+    # residents finish, one every ~low_new/slots rounds deep into the
+    # backlog, so all but the luckiest first-wave highs wait far past
+    # their deadline without preemption)
+    low_new = min(40 + 40 * scale, 200)
+    deadline_base = 16 * dt
+    # sustainable ~= slots finishing every low_new steps; 3x that
+    low_gap = (low_new * dt) / (3.0 * slots)
+    rng = np.random.RandomState(seed)
+    prompts, arrivals, budgets, priorities, deadlines = [], [], [], [], []
+    for i in range(n_low):
+        prompts.append(rng.randint(0, cfg.vocab_size, size=plen)
+                       .astype(np.int64))
+        arrivals.append(i * low_gap)
+        budgets.append(low_new)
+        priorities.append(5)
+        deadlines.append(None)
+    t_high = n_low * low_gap + 10 * dt      # every slot saturated
+    for i in range(n_high):
+        prompts.append(rng.randint(0, cfg.vocab_size, size=plen)
+                       .astype(np.int64))
+        arrivals.append(t_high + i * dt)
+        budgets.append(high_new)
+        priorities.append(0)
+        deadlines.append(deadline_base
+                         + (i // slots) * (high_new + 6) * dt)
+
+    def run(preempt, with_high=True):
+        vt = [0.0]
+        n = len(prompts) if with_high else n_low
+        eng = ServingEngine(model, num_slots=slots, max_len=256,
+                            page_size=8, chunk_len=16,
+                            clock=lambda: vt[0], preempt=preempt)
+        eng.add_request(np.arange(1, plen + 1, dtype=np.int64),
+                        SamplingParams(max_new_tokens=2))
+        eng.run()                  # compile-warm outside the clock
+        eng.metrics.__init__()
+        eng.metrics.attn_impl = eng.attn_impl
+        eng.metrics.unified = eng.unified
+        wall0 = time.monotonic()
+        reqs, submitted = [], 0
+        while submitted < n or eng.has_work:
+            while submitted < n and arrivals[submitted] <= vt[0]:
+                reqs.append(eng.add_request(
+                    prompts[submitted],
+                    SamplingParams(
+                        max_new_tokens=int(budgets[submitted]),
+                        priority=int(priorities[submitted]),
+                        deadline_s=deadlines[submitted])))
+                submitted += 1
+            if eng.has_work:
+                eng.step()
+            vt[0] += dt
+        snap = eng.metrics.snapshot()
+        eng.drain()
+        hi = [r for r in reqs if r.sampling.priority == 0]
+        lo = [r for r in reqs if r.sampling.priority != 0]
+
+        def cls(rs):
+            return {
+                "requests": len(rs),
+                "completed": sum(1 for r in rs
+                                 if r.finish_reason in ("stop",
+                                                        "length")),
+                "deadline_misses": sum(1 for r in rs
+                                       if r.finish_reason
+                                       == "deadline"),
+                "tokens": sum(len(r.output_tokens) for r in rs),
+            }
+
+        return {
+            "virtual_s": round(vt[0], 4),
+            "wall_s": round(time.monotonic() - wall0, 4),
+            "steps": snap["decode_steps"],
+            "tokens_generated": snap["tokens_generated"],
+            "preemptions": snap["preemptions"],
+            "swapped_out_pages": snap["swapped_out_pages"],
+            "swapped_in_pages": snap["swapped_in_pages"],
+            "swap_in_p99_s": snap["swap_in_s"]["p99"],
+            "high_priority": cls(hi),
+            "low_priority": cls(lo),
+            "token_streams": [list(r.output_tokens) for r in reqs],
+        }
+
+    on, off = run(True), run(False)
+    flat_on, flat_off = run(True, with_high=False), \
+        run(False, with_high=False)
+    fault_free_identical = (
+        flat_on["token_streams"] == flat_off["token_streams"]
+        and flat_on["steps"] == flat_off["steps"])
+    # goodput = completed high-priority tokens per virtual second
+    def goodput(r):
+        return r["high_priority"]["tokens"] / r["virtual_s"]
+    for r in (on, off, flat_on, flat_off):
+        del r["token_streams"]    # evidence, not report payload
+    return {
+        "slots": slots,
+        "scale": scale,
+        "virtual_dt_s": dt,
+        "rate_multiplier": 3.0,
+        "deadline_s": deadline_base,
+        "deadline_max_s": max(d for d in deadlines if d is not None),
+        "requests_low": n_low,
+        "requests_high": n_high,
+        "on": on,
+        "off": off,
+        "high_goodput_tokens_per_virtual_s": {
+            "on": goodput(on), "off": goodput(off)},
+        "fault_free": {"on": flat_on, "off": flat_off,
+                       "identical": fault_free_identical},
+    }
 
 
 def http_trace(model, cfg, *, n_req, rate, max_new, max_len, chunk,

@@ -43,8 +43,21 @@ def main():
                     help="condemn a replica whose pump heartbeat is "
                     "stale this long (hung-step detector); size it "
                     "ABOVE the worst-case step time incl. first-use "
-                    "compilation. Residents of a condemned replica "
-                    "migrate to survivors")
+                    "compilation (a huge packed step additionally "
+                    "earns token-scaled grace). Residents of a "
+                    "condemned replica migrate to survivors")
+    ap.add_argument("--no-preempt", action="store_true",
+                    help="disable overload preemption: a blocked "
+                    "higher-priority request backpressures instead "
+                    "of displacing the lowest-priority resident")
+    ap.add_argument("--host-pages", type=int, default=None,
+                    help="host-RAM KV tier capacity in pages "
+                    "(default mirrors the device pool; 0 disables "
+                    "swap — preemption then recomputes on resume)")
+    ap.add_argument("--max-migrations", type=int, default=8,
+                    help="per-request bound on mid-stream "
+                    "migrations before the typed replica error "
+                    "surfaces")
     args = ap.parse_args()
 
     import jax
@@ -59,13 +72,16 @@ def main():
 
     engines = [ServingEngine(model, num_slots=args.slots,
                              max_len=max_len, page_size=args.page_size,
-                             chunk_len=chunk, max_queue=args.max_queue)
+                             chunk_len=chunk, max_queue=args.max_queue,
+                             preempt=not args.no_preempt,
+                             host_pages=args.host_pages)
                for _ in range(args.replicas)]
     # PADDLE_TPU_FAULTS (chaos spec, serving/faults.py) is parsed by
-    # serve() itself — export it to rehearse kills/hangs/poisons
+    # serve() itself — export it to rehearse kills/hangs/poisons/spikes
     server = serve(engines, args.host, args.port,
                    default_timeout_s=args.timeout,
-                   watchdog_timeout_s=args.watchdog_timeout)
+                   watchdog_timeout_s=args.watchdog_timeout,
+                   max_migrations=args.max_migrations)
     server.install_signal_handlers()
     print(f"serving {args.replicas} replica(s) of "
           f"{type(model).__name__} (vocab={cfg.vocab_size}) on "

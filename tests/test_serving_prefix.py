@@ -360,7 +360,11 @@ class TestEngineEquivalence:
                             request_id=f"miss-{i}")
             eng.run()
         assert eng.prefix_cache.hits > 0
-        assert eng.prefix_cache.evicted_pages_total > 0
+        # page pressure fired: since the host tier (PR 9) parked pages
+        # SPILL to host RAM before anything is dropped, pressure shows
+        # up as spills first and evictions only once the tier is full
+        assert (eng.prefix_cache.evicted_pages_total
+                + eng.prefix_cache.spilled_pages_total) > 0
         assert eng._decode_fn._cache_size() == 1
         bound = int(math.log2(eng.chunk_len)) + 1
         assert len(eng._prefill_fns) <= bound
@@ -368,6 +372,9 @@ class TestEngineEquivalence:
                    for fn in eng._prefill_fns.values())
         if eng._copy_page_fn is not None:
             assert eng._copy_page_fn._cache_size() == 1
+        for fn in (eng._swap_out_fn, eng._swap_in_fn):
+            if fn is not None:      # spill/restore traffic happened
+                assert fn._cache_size() == 1
         assert accounting_closes(eng)
 
     def test_cancel_while_holding_shared_pages(self):
