@@ -32,13 +32,16 @@ from ..core.dispatch import register_op
 from ..core.tensor import Tensor
 from ..core import dtype as dtypes
 from ..ops._helpers import apply_op, as_tensor
-from ..ops.pallas.paged_attention import (gqa_attend_reference,
+from ..ops.pallas.paged_attention import (dequantize_paged_q8,
+                                          gqa_attend_reference,
                                           paged_decode_attention,
-                                          ragged_paged_attention)
+                                          ragged_paged_attention,
+                                          ragged_paged_attention_q8)
 
 __all__ = ["DecodeCache", "init_decode_caches", "update_and_attend",
            "CompiledGenerator", "decode_model_step", "sample_logits",
-           "resolve_paged_attn_impl", "PAGED_ATTN_IMPLS"]
+           "resolve_paged_attn_impl", "PAGED_ATTN_IMPLS",
+           "quantize_kv_rowwise"]
 
 PAGED_ATTN_IMPLS = ("kernel", "gather")
 
@@ -99,10 +102,17 @@ class DecodeCache:
         # queries past q_len are dead padding. None = every row uses
         # the full width l (the classic prefill/decode shapes).
         self.q_len = q_len
-        # int8 cache mode: k/v hold int8 codes laid out
-        # [B, H_kv, max_len, D]; *_scale are per-head [H_kv] f32
-        # CONSTANTS from calibration (layout + constant scales are what
-        # let XLA fuse the dequant — see _kv_update_q8_fwd)
+        # int8 cache modes, told apart by the scale SHAPE:
+        # - dense (page_table None): k/v hold int8 codes laid out
+        #   [B, H_kv, max_len, D]; *_scale are per-head [H_kv] f32
+        #   CONSTANTS from calibration (layout + constant scales are
+        #   what let XLA fuse the dequant — see _kv_update_q8_fwd);
+        # - paged (page_table set): k/v are int8 CODE POOLS
+        #   [num_pages, page_size, H_kv, D] and *_scale are rowwise
+        #   SCALE POOLS [num_pages, page_size, H_kv] f32 — one scale
+        #   per (position, kv head), written at scatter time
+        #   (quantize_kv_rowwise; no calibration pass), so a page and
+        #   its scales always travel together (COW/swap/prefix share).
         self.k_scale = k_scale
         self.v_scale = v_scale
         # True only on caches straight out of init_decode_caches (pos
@@ -183,6 +193,74 @@ def _paged_gather_fwd(pool, page_table):
 
 register_op("paged_kv_gather", _paged_gather_fwd, nondiff=True)
 
+
+def quantize_kv_rowwise(u):
+    """Rowwise int8 quantization of K/V values [..., D]: one f32 scale
+    per leading row (per (token, kv head) in the paged pool), codes =
+    round(u / scale) clipped to [-127, 127]. Unlike the dense cache's
+    calibrated per-head CONSTANT scales (see _kv_update_q8_fwd), the
+    paged pool quantizes at WRITE time with the row's own absmax —
+    serving admits arbitrary traffic with no calibration pass, and the
+    scale rides in the page right next to its codes, so preemption
+    swap, COW copies and prefix sharing move (codes, scale) as one
+    unit and a later reader dequantizes to exactly the same floats.
+    Returns (codes int8 same shape, scales f32 u.shape[:-1])."""
+    uf = u.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(uf), axis=-1)
+    # written as a multiply by the f32 constant 1/127 (not a divide):
+    # XLA rewrites x / 127 into exactly this under jit, so spelling it
+    # out keeps eager and jitted scales BIT-identical — the roundtrip
+    # bit-exactness tests depend on it
+    scale = jnp.maximum(amax, jnp.float32(1e-8)) \
+        * jnp.float32(1.0 / 127.0)
+    codes = jnp.clip(jnp.round(uf / scale[..., None]),
+                     -127, 127).astype(jnp.int8)
+    return codes, scale
+
+
+def _kv_update_paged_q8_fwd(pool, scale_pool, upd, pos, page_table):
+    """Quantize-then-scatter in ONE jitted program: upd [B, l, H, D]
+    is rowwise-int8 quantized (quantize_kv_rowwise) and its codes land
+    in the int8 pool [num_pages, page_size, H, D] while the per-row
+    scales land at the SAME flat slots of the scale pool
+    [num_pages, page_size, H] — the int8 branch of
+    `kv_cache_update_paged`. Address math (including the trash-page-0
+    redirect for positions past the row's addressable window) is
+    identical to the float scatter, so the one-fixed-shape-program
+    discipline carries over unchanged. Returns (pool, scale_pool)."""
+    ps = pool.shape[1]
+    addressable = page_table.shape[1] * ps
+    l = upd.shape[1]
+    p = pos.astype(jnp.int32)[:, None] + \
+        jnp.arange(l, dtype=jnp.int32)[None, :]          # [B, l] logical
+    pidx = jnp.clip(p // ps, 0, page_table.shape[1] - 1)
+    ids = jnp.take_along_axis(page_table.astype(jnp.int32), pidx,
+                              axis=1)                    # [B, l] pages
+    flat = ids * ps + p % ps
+    flat = jnp.where(p < addressable, flat, p % ps)      # OOB -> trash
+    codes, scales = quantize_kv_rowwise(upd)   # [B,l,H,D] i8 / [B,l,H]
+    flat_pool = pool.reshape((-1,) + pool.shape[2:])
+    flat_pool = flat_pool.at[flat.reshape(-1)].set(
+        codes.reshape((-1,) + codes.shape[2:]))
+    flat_sc = scale_pool.reshape((-1,) + scale_pool.shape[2:])
+    flat_sc = flat_sc.at[flat.reshape(-1)].set(
+        scales.reshape((-1,) + scales.shape[2:]))
+    return (flat_pool.reshape(pool.shape),
+            flat_sc.reshape(scale_pool.shape))
+
+
+register_op("kv_cache_update_paged_q8", _kv_update_paged_q8_fwd,
+            nondiff=True)
+
+# Dequantizing multi-token gather over the int8 pool: codes + rowwise
+# scales -> the dense f32 logical view (the layout paged_kv_gather
+# yields), so chunked prefill and the gather A/B impl attend over the
+# int8 cache through the unchanged window-mask + SDPA path. The fwd is
+# pallas/paged_attention.dequantize_paged_q8 — the SAME elementwise
+# dequant the q8 ragged reference uses, which is what keeps the kernel
+# lane and this gather path bit-identical on CPU.
+register_op("paged_kv_gather_q8", dequantize_paged_q8, nondiff=True)
+
 # Pallas ragged paged-attention decode: reads KV pages in place (walks
 # the page table, streams only pages below ceil((pos+1)/page_size)) —
 # no [B, max_pages * page_size, H, D] gather materialized. Off-TPU the
@@ -196,6 +274,14 @@ register_op("paged_decode_attention", paged_decode_attention,
 # engine's unified step (PADDLE_TPU_UNIFIED_STEP) attends through this
 # op; off-TPU the fwd runs the pure-JAX ragged reference.
 register_op("ragged_paged_attention", ragged_paged_attention,
+            nondiff=True)
+
+# int8 lane of the ragged kernel: code pages + rowwise scale pages
+# stream into VMEM together, dequant fused into the online-softmax
+# loop — decode's dominant HBM stream at half the bytes. Off-TPU the
+# fwd runs the q8 reference (dequantize_paged_q8 + the fp reference's
+# ragged mask math), bit-identical to the quantized-gather path.
+register_op("ragged_paged_attention_q8", ragged_paged_attention_q8,
             nondiff=True)
 
 
@@ -339,23 +425,50 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
     mask over the CACHE axis (last dim must equal the cache max_len);
     combined with the window-causal validity mask. Returns
     (out [B, l, H, D], advanced cache).
+
+    Dispatch matrix: dense fp, dense int8 (calibrated per-head
+    constant scales; single-token / fresh-prefill only), paged fp
+    (scatter + ragged kernel or gather), and paged int8 — rowwise
+    code+scale pools, quantize-then-scatter, reads through the ragged
+    kernel's fused-dequant q8 lane (impl "kernel") or the
+    dequantizing gather (impl "gather" / multi-token chunked
+    prefill). The paged int8 mode has none of the dense int8 mode's
+    write-pattern limits.
     """
     from ..nn import functional as F
     from ..ops import manipulation
     quant = cache.k_scale is not None
     paged = cache.page_table is not None
     l = int(q.shape[1])
+    k_sc = v_sc = None
     if quant and paged:
-        raise NotImplementedError(
-            "int8 KV cache: the paged pool path is float-only — a "
-            "quantized paged scatter/gather is future work")
-    if quant and getattr(cache.pos._value, "ndim", 0) == 1 and l != 1:
-        raise NotImplementedError(
-            "int8 KV cache: per-row position vectors support "
-            "single-token (decode) writes only; multi-token chunks "
-            "need the dequantized read path — use the bf16/f32 cache "
-            "for chunked prefill")
-    if quant:
+        # int8 PAGED pool: rowwise scale pools ride in k_scale/v_scale
+        # — quantize-then-scatter in one program, dequantizing
+        # gather / fused-dequant kernel on the read side (the dispatch
+        # below). The dense calibrated mode's per-head constants make
+        # no sense against a shared pool: reject the mix loudly.
+        if getattr(cache.k_scale._value, "ndim", 0) != 3:
+            raise ValueError(
+                "int8 paged KV pool needs rowwise scale pools "
+                "[num_pages, page_size, n_kv_heads] in "
+                "k_scale/v_scale, one scale per (position, kv head); "
+                "got the dense cache's calibrated per-head constants "
+                "— the dense int8 mode and the paged pool cannot mix "
+                "(build pools via ServingEngine(kv_dtype='int8'))")
+        k_buf, k_sc = apply_op("kv_cache_update_paged_q8", cache.k,
+                               cache.k_scale, k_new, cache.pos,
+                               cache.page_table)
+        v_buf, v_sc = apply_op("kv_cache_update_paged_q8", cache.v,
+                               cache.v_scale, v_new, cache.pos,
+                               cache.page_table)
+    elif quant:
+        if getattr(cache.pos._value, "ndim", 0) == 1 and l != 1:
+            raise NotImplementedError(
+                "int8 KV cache: per-row position vectors support "
+                "single-token (decode) writes only; multi-token "
+                "chunks need the dequantized read path — use the "
+                "bf16/f32 cache (or the int8 PAGED pool, which "
+                "dequantizes multi-token reads) for chunked prefill")
         k_buf = apply_op("kv_cache_update_q8", cache.k, k_new,
                          cache.pos, cache.k_scale)
         v_buf = apply_op("kv_cache_update_q8", cache.v, v_new,
@@ -397,7 +510,20 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
         # Pallas ragged paged-attention: walks page_table[b, :] and
         # streams only live pages (flash-style online softmax across
         # page blocks, GQA grouped in-kernel) — the dense logical view
-        # is never materialized and the user mask composes in-kernel
+        # is never materialized and the user mask composes in-kernel.
+        # The int8 pool rides the ragged kernel's q8 lane at q_len 1
+        # (identical attend window: query 0 sees keys j <= pos).
+        if quant:
+            ones = Tensor(jnp.ones((int(q.shape[0]),), jnp.int32))
+            args = [q, k_buf, v_buf, k_sc, v_sc, cache.page_table,
+                    cache.pos, ones]
+            if user_m is not None:
+                args.append(user_m)
+            out = apply_op("ragged_paged_attention_q8", *args)
+            return out, DecodeCache(k_buf, v_buf, cache.pos + l,
+                                    k_sc, v_sc,
+                                    page_table=cache.page_table,
+                                    attn_impl=cache.attn_impl)
         args = [q, k_buf, v_buf, cache.page_table, cache.pos]
         if user_m is not None:
             args.append(user_m)
@@ -412,13 +538,20 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
         # and mid-prefill rows (q_len up to l) together — query i of
         # row b attends keys j <= pos[b] + i, dead queries past q_len
         # are masked in-kernel (outputs unspecified, the engine drops
-        # them)
-        args = [q, k_buf, v_buf, cache.page_table, cache.pos,
-                cache.q_len]
+        # them). The int8 pool takes the q8 lane: code + scale pages
+        # stream together, dequant fused into the softmax loop.
+        if quant:
+            args = [q, k_buf, v_buf, k_sc, v_sc, cache.page_table,
+                    cache.pos, cache.q_len]
+        else:
+            args = [q, k_buf, v_buf, cache.page_table, cache.pos,
+                    cache.q_len]
         if user_m is not None:
             args.append(user_m)
-        out = apply_op("ragged_paged_attention", *args)
+        out = apply_op("ragged_paged_attention_q8" if quant
+                       else "ragged_paged_attention", *args)
         return out, DecodeCache(k_buf, v_buf, cache.pos + cache.q_len,
+                                k_sc, v_sc,
                                 page_table=cache.page_table,
                                 attn_impl=cache.attn_impl,
                                 q_len=cache.q_len)
@@ -426,7 +559,25 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
                     attrs=dict(l=int(l), lmax=int(lmax)))
     if user_m is not None:
         mask = apply_op("decode_merge_mask", mask, user_m)
-    if quant and l == 1:
+    if quant and paged:
+        # int8 paged READ path — multi-token chunked prefill and the
+        # "gather" A/B impl: dequantize the rows' code+scale pages
+        # into the dense f32 logical view (paged_kv_gather_q8, the
+        # same elementwise dequant the q8 kernel reference fuses
+        # in-VMEM) and attend through the unchanged window-mask path.
+        # Ragged rows (q_len set, gather impl) ride the same window
+        # mask: dead queries past q_len produce unspecified outputs
+        # the engine drops, exactly like the fp gather path.
+        kf = apply_op("paged_kv_gather_q8", k_buf, k_sc,
+                      cache.page_table)
+        vf = apply_op("paged_kv_gather_q8", v_buf, v_sc,
+                      cache.page_table)
+        new_cache = DecodeCache(k_buf, v_buf, cache.pos + l,
+                                k_sc, v_sc,
+                                page_table=cache.page_table,
+                                attn_impl=cache.attn_impl,
+                                q_len=cache.q_len)
+    elif quant and l == 1:
         # decode step over the int8 cache: the dequant (convert x
         # constant per-head scale) fuses into the attention reads
         # (decode_roofline probes 9-11)
@@ -434,20 +585,23 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
                        cache.k_scale, cache.v_scale, mask)
         return out, DecodeCache(k_buf, v_buf, cache.pos + l,
                                 cache.k_scale, cache.v_scale)
-    if quant:
-        # multi-token PREFILL on the int8 cache: attend over the raw
-        # float K/V of this chunk. Routing prefill through the int8
-        # cache read makes XLA lower the l x L einsum over dequantized
-        # operands as a serial wide-while loop (measured 46 GB accessed
-        # per generate). Attending only the chunk is exact ONLY when
-        # the cache holds nothing yet — reject chunked prefill rather
-        # than silently dropping cached context.
+    elif quant:
+        # multi-token PREFILL on the DENSE int8 cache: attend over the
+        # raw float K/V of this chunk. Routing prefill through the
+        # int8 cache read makes XLA lower the l x L einsum over
+        # dequantized operands as a serial wide-while loop (measured
+        # 46 GB accessed per generate). Attending only the chunk is
+        # exact ONLY when the cache holds nothing yet — reject chunked
+        # prefill rather than silently dropping cached context. (The
+        # PAGED int8 pool has no such limit: its dequantizing gather
+        # branch above serves any multi-token read.)
         if not (cache.fresh or _is_zero_pos(cache.pos)):
             raise NotImplementedError(
-                "int8 KV cache: multi-token writes are only supported "
-                "at pos==0 (single prefill). Chunked prefill / "
-                "multi-token continuation needs the dequantized read "
-                "path — use the bf16 cache for that call pattern.")
+                "dense int8 KV cache: multi-token writes are only "
+                "supported at pos==0 (single prefill). Chunked "
+                "prefill / multi-token continuation needs the "
+                "dequantized read path — use the bf16 cache or the "
+                "int8 PAGED pool for that call pattern.")
         kf, vf = k_new, v_new
         # first l cache slots ARE this chunk: slice the merged mask
         mask = mask[:, :, :, :l]

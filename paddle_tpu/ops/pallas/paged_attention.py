@@ -51,6 +51,22 @@ predicate compute off, so both HBM traffic and MXU work scale with the
 tokens actually packed, not with the padded step shape. Outputs at
 query positions >= q_len[b] are unspecified-but-finite (the engine
 discards them).
+
+INT8 LANE (`ragged_paged_attention_q8`): the same walk over an int8
+POOL — code pages [P, page_size, H_kv, D] int8 plus rowwise scale
+pages [P, page_size, H_kv] f32 (one scale per (position, kv head),
+written by generation.py's quantized paged scatter). Code and scale
+blocks stream into VMEM together and the dequant (convert x rowwise
+scale) is FUSED into the online-softmax loop — no HBM-side
+dequantized copy is ever materialized, which is the whole point:
+decode is HBM-bandwidth-bound, and halving the KV byte stream halves
+the dominant HBM traffic (the fused low-precision-primitive idiom of
+Tensor Processing Primitives, PAPERS.md). Dead-page / dead-row
+clamping is unchanged. Off-TPU the op runs
+`ragged_attention_reference_q8`, which dequantizes through EXACTLY the
+same elementwise expression as generation.py's `paged_kv_gather_q8`
+(`dequantize_paged_q8` is shared), so the CPU kernel lane stays
+bit-identical to the quantized-gather path through update_and_attend.
 """
 from __future__ import annotations
 
@@ -65,7 +81,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["paged_decode_attention", "paged_attention_reference",
            "gqa_attend_reference", "ragged_paged_attention",
-           "ragged_attention_reference"]
+           "ragged_attention_reference", "ragged_paged_attention_q8",
+           "ragged_attention_reference_q8", "dequantize_paged_q8"]
 
 # interpret mode: run the kernel on CPU for testing (tests set this)
 _INTERPRET = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "0") == "1"
@@ -225,7 +242,16 @@ def _paged_attention_kernel(q, k_pool, v_pool, page_table, pos, mask):
 
 
 def _ragged_kernel(tab_ref, pos_ref, qlen_ref, q_ref, k_ref, v_ref,
-                   *rest, ps, qblk, rep, scale, has_mask):
+                   *rest, ps, qblk, rep, scale, has_mask,
+                   has_scale=False):
+    rest = list(rest)
+    if has_scale:
+        # int8 lane: rowwise dequant scales ride next to the code
+        # pages — one (ps,)-wide f32 block per streamed K/V page
+        ks_ref, vs_ref = rest[0], rest[1]
+        rest = rest[2:]
+    else:
+        ks_ref = vs_ref = None
     if has_mask:
         mask_ref, o_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -237,7 +263,7 @@ def _ragged_kernel(tab_ref, pos_ref, qlen_ref, q_ref, k_ref, v_ref,
     n_p = pl.num_programs(3)
     pos_b = pos_ref[b]
     qlen_b = qlen_ref[b]
-    prec = _prec(q_ref.dtype)
+    prec = _prec(jnp.float32 if has_scale else q_ref.dtype)
     scale32 = jnp.float32(scale)
     # last valid query of THIS block (block-dead when t*qblk >= q_len)
     last_qi = jnp.minimum((t + 1) * qblk, qlen_b) - 1
@@ -254,6 +280,11 @@ def _ragged_kernel(tab_ref, pos_ref, qlen_ref, q_ref, k_ref, v_ref,
     def _compute():
         q = q_ref[0, 0, :, 0].reshape(qblk * rep, q_ref.shape[-1])
         k = k_ref[0, :, 0, :]                      # [ps, D]
+        if has_scale:
+            # fused in-VMEM dequant: int8 codes x rowwise scale — the
+            # dequantized page never round-trips through HBM
+            q = q.astype(jnp.float32)
+            k = k.astype(jnp.float32) * ks_ref[0, :, 0][:, None]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -278,6 +309,8 @@ def _ragged_kernel(tab_ref, pos_ref, qlen_ref, q_ref, k_ref, v_ref,
             alpha * l_prev + jnp.sum(pexp, axis=1, keepdims=True),
             l_ref.shape)
         v = v_ref[0, :, 0, :]                      # [ps, D]
+        if has_scale:
+            v = v.astype(jnp.float32) * vs_ref[0, :, 0][:, None]
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             pexp.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -293,11 +326,14 @@ def _ragged_kernel(tab_ref, pos_ref, qlen_ref, q_ref, k_ref, v_ref,
 
 
 def _ragged_attention_kernel(q, k_pool, v_pool, page_table, pos, q_len,
-                             mask):
+                             mask, k_scale=None, v_scale=None):
     """q [B, lq, H, D]; pools [P, ps, H_kv, D]; page_table
     [B, max_pages] int32; pos/q_len [B] int32; mask None | additive f32
     [B, H, lq, lmax]. lq is padded up to a multiple of the query block
-    so the grid tiles evenly; padded queries are dead by q_len."""
+    so the grid tiles evenly; padded queries are dead by q_len.
+    k_scale/v_scale (int8 lane): rowwise dequant scale pages
+    [P, ps, H_kv] f32 streamed next to the int8 code pools — dequant
+    fuses into the in-VMEM compute."""
     b, lq, h, d = q.shape
     _, ps, hkv, _ = k_pool.shape
     mp = page_table.shape[1]
@@ -330,6 +366,18 @@ def _ragged_attention_kernel(q, k_pool, v_pool, page_table, pos, q_len,
         pl.BlockSpec((1, ps, 1, d), kv_idx),
     ]
     ops = [q6, k_pool, v_pool]
+    has_scale = k_scale is not None
+    if has_scale:
+        # int8 lane: the scale pages chase the SAME clamped page-table
+        # walk as the code pages, so dead grid steps skip their DMA too
+        def ks_idx(bi, g, t, p, tab, posr, qlr):
+            last_qi = jnp.minimum((t + 1) * qblk, qlr[bi]) - 1
+            lp = jnp.clip((posr[bi] + last_qi) // ps, 0, mp - 1)
+            return (tab[bi, jnp.minimum(p, lp)], 0, g)
+
+        ops.extend([k_scale, v_scale])
+        in_specs.extend([pl.BlockSpec((1, ps, 1), ks_idx),
+                         pl.BlockSpec((1, ps, 1), ks_idx)])
     if mask is not None:
         # [B, H, lq, lmax] -> [B*hkv, lq, rep, lmax]: block rows match
         # the kernel's (qblk, rep) score layout
@@ -343,7 +391,8 @@ def _ragged_attention_kernel(q, k_pool, v_pool, page_table, pos, q_len,
 
     kernel = functools.partial(_ragged_kernel, ps=ps, qblk=qblk,
                                rep=rep, scale=scale,
-                               has_mask=mask is not None)
+                               has_mask=mask is not None,
+                               has_scale=has_scale)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(b, hkv, nqb, mp),
@@ -456,24 +505,13 @@ def paged_decode_attention(q, k_pool, v_pool, page_table, pos,
                                      posv, mask)
 
 
-def ragged_attention_reference(q, k_pool, v_pool, page_table, pos,
-                               q_len, mask=None):
-    """Pure-JAX ragged reference: gather the rows' pages into the dense
-    logical view and run the grouped softmax under the ragged causal
-    window — query i of row b attends keys j <= pos[b] + i, queries at
-    i >= q_len[b] are fully masked (their outputs are unspecified). At
-    lq == 1 this is EXACTLY `paged_attention_reference`'s math (same
-    gather, same mask, same grouped dots), so l==1 rows stay
-    bit-identical to the gather path; for l > 1 rows the grouped unroll
-    reproduces the dense repeat_interleave + SDPA oracle (the same
-    per-group shape argument as gqa_attend_reference)."""
-    b, lq, h, d = q.shape
-    ps, hkv = k_pool.shape[1], k_pool.shape[2]
-    mp = page_table.shape[1]
-    lmax = mp * ps
-    tab = page_table.astype(jnp.int32)
-    kf = jnp.take(k_pool, tab, axis=0).reshape(b, lmax, hkv, d)
-    vf = jnp.take(v_pool, tab, axis=0).reshape(b, lmax, hkv, d)
+def _ragged_mask_attend(q, kf, vf, pos, q_len, mask):
+    """Shared tail of the ragged references: grouped softmax over the
+    dense logical K/V views under the ragged causal window — query i of
+    row b attends keys j <= pos[b] + i, queries at i >= q_len[b] are
+    fully masked (their outputs are unspecified)."""
+    b, lq, h, _ = q.shape
+    lmax = kf.shape[1]
     i = jnp.arange(lq, dtype=jnp.int32)[None, :, None]
     j = jnp.arange(lmax, dtype=jnp.int32)[None, None, :]
     live = (i < q_len.astype(jnp.int32)[:, None, None]) & \
@@ -483,6 +521,53 @@ def ragged_attention_reference(q, k_pool, v_pool, page_table, pos,
     if mask is not None:
         add = add + mask.reshape(b, h, lq, lmax)
     return gqa_attend_reference(q, kf, vf, add)
+
+
+def ragged_attention_reference(q, k_pool, v_pool, page_table, pos,
+                               q_len, mask=None):
+    """Pure-JAX ragged reference: gather the rows' pages into the dense
+    logical view and run the grouped softmax under the ragged causal
+    window. At lq == 1 this is EXACTLY `paged_attention_reference`'s
+    math (same gather, same mask, same grouped dots), so l==1 rows stay
+    bit-identical to the gather path; for l > 1 rows the grouped unroll
+    reproduces the dense repeat_interleave + SDPA oracle (the same
+    per-group shape argument as gqa_attend_reference)."""
+    b, lq, h, d = q.shape
+    ps, hkv = k_pool.shape[1], k_pool.shape[2]
+    lmax = page_table.shape[1] * ps
+    tab = page_table.astype(jnp.int32)
+    kf = jnp.take(k_pool, tab, axis=0).reshape(b, lmax, hkv, d)
+    vf = jnp.take(v_pool, tab, axis=0).reshape(b, lmax, hkv, d)
+    return _ragged_mask_attend(q, kf, vf, pos, q_len, mask)
+
+
+def dequantize_paged_q8(pool, scale_pool, page_table):
+    """int8 code pool [P, ps, H_kv, D] + rowwise scale pool
+    [P, ps, H_kv] f32 -> each row's dense DEQUANTIZED f32 logical view
+    [B, max_pages * ps, H_kv, D]. This is also the forward of
+    generation.py's `paged_kv_gather_q8` op (the multi-token read path
+    chunked prefill and the gather A/B impl run on) — the q8 ragged
+    reference dequantizes through this SAME elementwise expression, so
+    kernel-lane (reference) and gather-path results stay bit-identical
+    on CPU."""
+    tab = page_table.astype(jnp.int32)
+    g = jnp.take(pool, tab, axis=0)               # [B, mp, ps, H, D]
+    s = jnp.take(scale_pool, tab, axis=0)         # [B, mp, ps, H]
+    deq = g.astype(jnp.float32) * s[..., None]
+    b, m, ps = deq.shape[0], deq.shape[1], deq.shape[2]
+    return deq.reshape((b, m * ps) + deq.shape[3:])
+
+
+def ragged_attention_reference_q8(q, k_pool, v_pool, k_scale, v_scale,
+                                  page_table, pos, q_len, mask=None):
+    """Pure-JAX int8 ragged reference: dequantize the rows' code+scale
+    pages into the dense f32 logical view (via `dequantize_paged_q8`,
+    shared with the quantized-gather op so the two CPU paths cannot
+    drift) and run the same ragged grouped softmax as the fp
+    reference."""
+    kf = dequantize_paged_q8(k_pool, k_scale, page_table)
+    vf = dequantize_paged_q8(v_pool, v_scale, page_table)
+    return _ragged_mask_attend(q, kf, vf, pos, q_len, mask)
 
 
 def ragged_paged_attention(q, k_pool, v_pool, page_table, pos, q_len,
@@ -515,3 +600,37 @@ def ragged_paged_attention(q, k_pool, v_pool, page_table, pos, q_len,
             mask)
     return ragged_attention_reference(q, k_pool, v_pool, page_table,
                                       posv, qlv, mask)
+
+
+def ragged_paged_attention_q8(q, k_pool, v_pool, k_scale, v_scale,
+                              page_table, pos, q_len, mask=None):
+    """Ragged paged attention over an INT8 paged KV pool (the
+    registered op's forward): same per-row q_len semantics as
+    `ragged_paged_attention`, but k/v are int8 code pools
+    [P, page_size, H_kv, D] with rowwise scale pools [P, page_size,
+    H_kv] f32 — one scale per (position, kv head), written by the
+    quantized paged scatter. On TPU (and in interpret mode) the code
+    and scale pages stream into VMEM together and dequant fuses into
+    the online-softmax loop; off-TPU the reference dequantizes through
+    the same expression as `paged_kv_gather_q8`, keeping the kernel
+    lane bit-identical to the quantized-gather path on CPU."""
+    b, lq, h, d = q.shape
+    lmax = page_table.shape[1] * k_pool.shape[1]
+    posv = pos.astype(jnp.int32)
+    if posv.ndim == 0:
+        posv = jnp.broadcast_to(posv[None], (b,))
+    qlv = q_len.astype(jnp.int32)
+    if qlv.ndim == 0:
+        qlv = jnp.broadcast_to(qlv[None], (b,))
+    if mask is not None:
+        mask = _mask_to_additive(mask, b, h, lmax, lq)
+        if lq == 1:
+            mask = mask.reshape(b, h, 1, lmax)
+    ks = k_scale.astype(jnp.float32)
+    vs = v_scale.astype(jnp.float32)
+    if _use_kernel():
+        return _ragged_attention_kernel(
+            q, k_pool, v_pool, page_table.astype(jnp.int32), posv, qlv,
+            mask, k_scale=ks, v_scale=vs)
+    return ragged_attention_reference_q8(q, k_pool, v_pool, ks, vs,
+                                         page_table, posv, qlv, mask)

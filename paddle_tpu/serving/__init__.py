@@ -27,6 +27,15 @@ greedy decode:
             print(out.request_id, out.token_ids, out.finish_reason)
     print(eng.metrics.snapshot()["pool"])
 
+The paged pools can run QUANTIZED (PADDLE_TPU_KV_DTYPE=fp|int8 /
+ServingEngine(kv_dtype=...), default fp): int8 code pages + per-page
+rowwise scale pages hold ~2x the resident tokens per HBM byte, the
+ragged kernel dequantizes in-VMEM (fused into the softmax loop), and
+every whole-page move — prefix COW, preemption swap, host spill —
+carries codes and scales together, so int8 serving stays
+deterministic and feature-on/off token-identical (fp drift bounded,
+benched via serving_bench --quant-ab).
+
 OVERLOAD degrades gracefully instead of refusing (default on,
 PADDLE_TPU_PREEMPT / ServingEngine(preempt=...)): requests carry
 `priority` + placement `deadline_s`, the queue orders by (priority,
@@ -39,8 +48,8 @@ Greedy requests are bit-identical to offline CompiledGenerator decode
 (tested); `scripts/serving_bench.py` drives a Poisson arrival trace and
 reports TTFT/throughput/pool utilization into BENCH_serving.json.
 """
-from .engine import (ServingEngine, resolve_preempt_flag,  # noqa: F401
-                     resolve_unified_flag)
+from .engine import (ServingEngine, resolve_kv_dtype,  # noqa: F401
+                     resolve_preempt_flag, resolve_unified_flag)
 from .errors import (DeadlineExceeded, EngineClosed,  # noqa: F401
                      PoisonedRequest, QueueFull, RateLimited,
                      ServingError)
@@ -59,7 +68,7 @@ from .spec import (Drafter, NgramDrafter, SpecConfig,  # noqa: F401
                    resolve_spec_config)
 
 __all__ = ["ServingEngine", "resolve_unified_flag",
-           "resolve_preempt_flag", "Scheduler",
+           "resolve_preempt_flag", "resolve_kv_dtype", "Scheduler",
            "ServingMetrics", "Histogram",
            "prometheus_render", "PagePool", "HostPagePool",
            "pages_needed",

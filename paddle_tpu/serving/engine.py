@@ -92,11 +92,25 @@ queue slots. Parked prefix-cache pages may also SPILL to the host
 tier under page pressure (restored on the next match) — stage 1 of
 the ROADMAP's fleet-scale prefix cache.
 
+QUANTIZED SERVING (default off, gated `kv_dtype=...` /
+PADDLE_TPU_KV_DTYPE=fp|int8): with "int8" the per-layer pools hold
+rowwise-int8 CODE pages plus per-page f32 SCALE pages — ~half the HBM
+bytes per resident token, so the same HBM budget admits ~2x the
+residents AND the decode step's dominant HBM stream halves. Writes
+quantize-then-scatter in the same one-trace program; reads dequantize
+in the ragged kernel's fused int8 lane (or the dequantizing gather on
+the A/B path). Every whole-page move — COW, preemption swap, prefix
+spill — carries code and scale pages together, so int8 streams stay
+DETERMINISTIC and feature-on/off token-identical; int8 vs fp output
+drift is bounded and benched (serving_bench --quant-ab).
+
 Correctness contract (tests/test_serving.py): a request decoded greedily
 through the engine emits tokens bit-identical to running it ALONE
 through CompiledGenerator greedy decode — through chunked prefill,
 page-table indirection, page reuse after eviction, and
-preempt-swap-resume cycles.
+preempt-swap-resume cycles. (With kv_dtype="int8" the oracle is the
+int8 engine itself: feature gates stay token-identical, fp drift is
+bounded, not zero.)
 
 Weights enter both programs as closed-over constants (the measured
 layout win of generation.py's _build); construct the engine AFTER any
@@ -130,10 +144,33 @@ from .scheduler import Scheduler
 from .spec import Drafter, resolve_spec_config
 
 __all__ = ["ServingEngine", "resolve_unified_flag",
-           "resolve_preempt_flag"]
+           "resolve_preempt_flag", "resolve_kv_dtype"]
 
 UNIFIED_STEP_MODES = ("on", "off")
 PREEMPT_MODES = ("on", "off")
+KV_DTYPE_MODES = ("fp", "int8")
+
+
+def resolve_kv_dtype(override=None) -> str:
+    """Which dtype the paged KV pool holds: "fp" (the model's float
+    dtype, the default) or "int8" — rowwise-quantized code pages plus
+    per-page scale pages, ~half the HBM bytes per resident token, so
+    the same HBM budget admits ~2x the residents AND decode's
+    dominant HBM stream halves. Quantization is lossy: greedy outputs
+    with int8 on are NOT bit-identical to fp (drift is bounded and
+    benched — serving_bench --quant-ab), but every serving feature
+    (prefix cache, COW, preemption swap, spec decode, migration) stays
+    deterministic and self-consistent at int8. An explicit override
+    wins; otherwise PADDLE_TPU_KV_DTYPE=fp|int8 (read at engine
+    construction — the compiled programs keep the pool dtype they
+    were traced with)."""
+    v = override or os.environ.get("PADDLE_TPU_KV_DTYPE", "fp")
+    if v not in KV_DTYPE_MODES:
+        raise ValueError(
+            f"kv_dtype must be one of {KV_DTYPE_MODES} "
+            f"(PADDLE_TPU_KV_DTYPE / ServingEngine(kv_dtype=...)), "
+            f"got {v!r}")
+    return v
 
 
 def resolve_preempt_flag(override=None) -> bool:
@@ -232,7 +269,8 @@ class ServingEngine:
                  attn_impl: Optional[str] = None,
                  prefix_cache=None, unified=None,
                  token_budget: Optional[int] = None, spec=None,
-                 preempt=None, host_pages: Optional[int] = None):
+                 preempt=None, host_pages: Optional[int] = None,
+                 kv_dtype: Optional[str] = None):
         if cache_spec is None:
             if not hasattr(model, "_decode_cache_spec"):
                 raise ValueError(
@@ -322,16 +360,50 @@ class ServingEngine:
             (t._value.dtype for t in self._state_tensors
              if jnp.issubdtype(t._value.dtype, jnp.floating)),
             dtypes.get_default_dtype().np_dtype)
+        # paged-pool dtype (PADDLE_TPU_KV_DTYPE / kv_dtype=, default
+        # "fp"): "int8" swaps every layer's float pools for int8 CODE
+        # pages plus rowwise f32 SCALE pages [num_pages, page_size,
+        # H_kv] — ~2x residents per HBM byte, and every whole-page
+        # move (COW, preemption swap, prefix spill) carries
+        # code + scale pages together so int8 streams stay
+        # deterministic across all of them.
+        self.kv_dtype = resolve_kv_dtype(kv_dtype)
         # device state: per-layer shared K/V pools, per-slot positions,
         # per-slot held next-token logits (filled by the final prefill
         # chunk, advanced by decode)
-        self._ct = tuple(
-            (jnp.zeros((self.num_pages, self.page_size, self.n_kv,
-                        self.head_dim), self._fp),
-             jnp.zeros((self.num_pages, self.page_size, self.n_kv,
-                        self.head_dim), self._fp),
-             None, None)
-            for _ in range(self.n_layers))
+        if self.kv_dtype == "int8":
+            self._ct = tuple(
+                (jnp.zeros((self.num_pages, self.page_size, self.n_kv,
+                            self.head_dim), jnp.int8),
+                 jnp.zeros((self.num_pages, self.page_size, self.n_kv,
+                            self.head_dim), jnp.int8),
+                 # zero scales: the trash page dequantizes to exact 0.0
+                 jnp.zeros((self.num_pages, self.page_size,
+                            self.n_kv), jnp.float32),
+                 jnp.zeros((self.num_pages, self.page_size,
+                            self.n_kv), jnp.float32))
+                for _ in range(self.n_layers))
+        else:
+            self._ct = tuple(
+                (jnp.zeros((self.num_pages, self.page_size, self.n_kv,
+                            self.head_dim), self._fp),
+                 jnp.zeros((self.num_pages, self.page_size, self.n_kv,
+                            self.head_dim), self._fp),
+                 None, None)
+                for _ in range(self.n_layers))
+        # HBM bytes one page costs across all layers (K and V, codes
+        # + scale pages for int8) — the denominator of the
+        # residents-per-HBM-byte economics serving_bench --quant-ab
+        # measures, and the byte gauges' unit
+        kv_itemsize = (1 if self.kv_dtype == "int8"
+                       else jnp.dtype(self._fp).itemsize)
+        scale_bytes = 4 if self.kv_dtype == "int8" else 0
+        self.page_bytes = (self.n_layers * 2 * self.page_size
+                           * self.n_kv
+                           * (self.head_dim * kv_itemsize
+                              + scale_bytes))
+        self.metrics.kv_dtype = self.kv_dtype
+        self.metrics.pool_bytes_per_page = self.page_bytes
         self._pos = jnp.zeros((self.num_slots,), jnp.int32)
         self._last_logits = None      # [S, V] f32, lazy (V from prefill)
         # host page state: allocator, per-slot page lists, page tables
@@ -358,6 +430,10 @@ class ServingEngine:
         self.host_pages = (self.num_pages - 1 if host_pages is None
                            else int(host_pages))
         self.host_pool = HostPagePool(self.host_pages)
+        # seed the capacity gauges so a scrape before the first step
+        # already shows the tier's (byte) size
+        self.metrics.host_pages_total = self.host_pages
+        self.metrics.pool_pages_total = self.num_pages - 1
         # overload preemption gate (PADDLE_TPU_PREEMPT, default on)
         self.preempt = resolve_preempt_flag(preempt)
         if self.prefix_cache is not None and self.host_pages > 0:
@@ -571,12 +647,19 @@ class ServingEngine:
         """ONE compiled single-page pool copy for copy-on-write: src and
         dst page ids are traced scalars, so every COW across every
         layer's K and V pools reuses this one program (no retrace across
-        cache hit/miss/eviction transitions)."""
+        cache hit/miss/eviction transitions). On the int8 pool the
+        rowwise SCALE pages copy alongside the code pages — a COW'd
+        partial page dequantizes to exactly the floats its source
+        held (the None check is pytree-static: still one program)."""
         def cp(ct, src, dst):
             out = []
             for k, v, ks, vs in ct:
                 out.append((k.at[dst].set(k[src]),
-                            v.at[dst].set(v[src]), ks, vs))
+                            v.at[dst].set(v[src]),
+                            ks if ks is None else
+                            ks.at[dst].set(ks[src]),
+                            vs if vs is None else
+                            vs.at[dst].set(vs[src])))
             return tuple(out)
         return jax.jit(cp)
 
@@ -590,35 +673,63 @@ class ServingEngine:
     def _build_swap_out(self):
         """ONE compiled device->host page read: stacks one page's K and
         V across every layer into a [n_layers, 2, page_size, H, D]
-        block. The page id is a traced scalar, so every swap-out of
-        every page reuses this single program (no retrace ever — the
-        COW-copy discipline)."""
-        def so(ct, src):
-            return jnp.stack([jnp.stack((k[src], v[src]))
-                              for k, v, _, _ in ct])
+        block — on the int8 pool, PLUS the matching
+        [n_layers, 2, page_size, H] scale block (codes without their
+        scales are meaningless; the pair is the page). The page id is
+        a traced scalar, so every swap-out of every page reuses this
+        single program (no retrace ever — the COW-copy discipline).
+        int8 pages being half the bytes means swap traffic halves
+        too."""
+        if self.kv_dtype == "int8":
+            def so(ct, src):
+                codes = jnp.stack([jnp.stack((k[src], v[src]))
+                                   for k, v, _, _ in ct])
+                scales = jnp.stack([jnp.stack((ks[src], vs[src]))
+                                    for _, _, ks, vs in ct])
+                return codes, scales
+        else:
+            def so(ct, src):
+                return jnp.stack([jnp.stack((k[src], v[src]))
+                                  for k, v, _, _ in ct])
         return jax.jit(so)
 
     def _build_swap_in(self):
         """ONE compiled host->device page write: scatters a
-        [n_layers, 2, page_size, H, D] block back into page `dst` of
-        every layer's pools. dst is a traced scalar — one trace serves
-        every restore."""
-        def si(ct, data, dst):
-            out = []
-            for i, (k, v, ks, vs) in enumerate(ct):
-                out.append((k.at[dst].set(data[i, 0].astype(k.dtype)),
-                            v.at[dst].set(data[i, 1].astype(v.dtype)),
-                            ks, vs))
-            return tuple(out)
+        [n_layers, 2, page_size, H, D] block (plus, on the int8 pool,
+        its scale block) back into page `dst` of every layer's pools.
+        dst is a traced scalar — one trace serves every restore."""
+        if self.kv_dtype == "int8":
+            def si(ct, codes, scales, dst):
+                out = []
+                for i, (k, v, ks, vs) in enumerate(ct):
+                    out.append((
+                        k.at[dst].set(codes[i, 0].astype(k.dtype)),
+                        v.at[dst].set(codes[i, 1].astype(v.dtype)),
+                        ks.at[dst].set(scales[i, 0]),
+                        vs.at[dst].set(scales[i, 1])))
+                return tuple(out)
+        else:
+            def si(ct, data, dst):
+                out = []
+                for i, (k, v, ks, vs) in enumerate(ct):
+                    out.append((
+                        k.at[dst].set(data[i, 0].astype(k.dtype)),
+                        v.at[dst].set(data[i, 1].astype(v.dtype)),
+                        ks, vs))
+                return tuple(out)
         return jax.jit(si)
 
-    def _extract_page(self, src: int) -> np.ndarray:
-        """Read one device page's KV (all layers) to host RAM."""
+    def _extract_page(self, src: int):
+        """Read one device page's KV (all layers) to host RAM: an
+        ndarray block, or a (codes, scales) ndarray pair on the int8
+        pool (HostPagePool payloads are opaque either way)."""
         if self._swap_out_fn is None:
             self._swap_out_fn = self._build_swap_out()
         with RecordEvent(f"serving::swap_out[{src}]"):
-            return np.asarray(self._swap_out_fn(self._ct,
-                                                jnp.int32(src)))
+            out = self._swap_out_fn(self._ct, jnp.int32(src))
+            if self.kv_dtype == "int8":
+                return (np.asarray(out[0]), np.asarray(out[1]))
+            return np.asarray(out)
 
     def _restore_page(self, data, dst: int):
         """Write one host-RAM page payload back into device page
@@ -626,8 +737,15 @@ class ServingEngine:
         if self._swap_in_fn is None:
             self._swap_in_fn = self._build_swap_in()
         with RecordEvent(f"serving::swap_in[{dst}]"):
-            self._ct = self._swap_in_fn(self._ct, jnp.asarray(data),
-                                        jnp.int32(dst))
+            if self.kv_dtype == "int8":
+                codes, scales = data
+                self._ct = self._swap_in_fn(
+                    self._ct, jnp.asarray(codes), jnp.asarray(scales),
+                    jnp.int32(dst))
+            else:
+                self._ct = self._swap_in_fn(self._ct,
+                                            jnp.asarray(data),
+                                            jnp.int32(dst))
 
     # -- host tier callbacks (prefix-cache spill) --------------------------
     def _host_store_page(self, page: int):

@@ -171,6 +171,13 @@ class ServingMetrics:
         # ("kernel" | "gather"); set by the engine at construction so
         # benches/dashboards can attribute latency to the impl
         self.attn_impl: Optional[str] = None
+        # paged-pool dtype tag ("fp" | "int8") + the per-page HBM cost
+        # (all layers, K+V, codes+scales for int8) — the fourth A/B
+        # label in engine_info, and the byte unit behind the
+        # pool/host-tier byte gauges (quantized serving economics:
+        # residents per HBM byte)
+        self.kv_dtype: Optional[str] = None
+        self.pool_bytes_per_page = 0
         # whether the engine runs the unified ragged prefill+decode
         # step (True) or the legacy alternating program families
         # (False); set by the engine at construction — the second A/B
@@ -387,6 +394,7 @@ class ServingMetrics:
             "prefill_chunk_tokens": self.prefill_chunk_tokens,
             "decode_steps": self.decode_steps,
             "attn_impl": self.attn_impl,
+            "kv_dtype": self.kv_dtype,
             "unified": self.unified,
             "unified_steps": self.unified_steps,
             "packed_prefill_tokens": self.packed_prefill_tokens,
@@ -409,11 +417,16 @@ class ServingMetrics:
                 "pages_total": self.pool_pages_total,
                 "pages_cached": self.pool_pages_cached,
                 "pages_swapped": self.pool_pages_swapped,
+                "bytes_per_page": self.pool_bytes_per_page,
                 "utilization": self.pool_utilization_hist.snapshot(),
             },
             "host_pool": {
                 "pages_used": self.host_pages_used,
                 "pages_total": self.host_pages_total,
+                "bytes_used": (self.host_pages_used
+                               * self.pool_bytes_per_page),
+                "bytes_total": (self.host_pages_total
+                                * self.pool_bytes_per_page),
             },
             "prefix": (None if self.prefix is None else {
                 **self.prefix,
@@ -487,8 +500,11 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                        ("swapped_out_pages_total", "counter"),
                        ("swapped_in_pages_total", "counter"),
                        ("pool_pages_swapped", "gauge"),
+                       ("pool_bytes_per_page", "gauge"),
                        ("host_pages_used", "gauge"),
                        ("host_pages_total", "gauge"),
+                       ("host_bytes_used", "gauge"),
+                       ("host_bytes_total", "gauge"),
                        ("swap_in_seconds", "histogram"),
                        ("unified_steps_total", "counter"),
                        ("prefill_stall_steps_total", "counter"),
@@ -502,13 +518,15 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
     for replica, snap in sorted(snapshots.items()):
         lab = {"replica": str(replica)}
         # info-style gauge: the A/B tags (which attention impl, unified
-        # vs alternating step) ride as labels so scrapes from an A/B
-        # fleet are distinguishable without relabeling
+        # vs alternating step, spec mode, paged-pool dtype) ride as
+        # labels so scrapes from an A/B fleet are distinguishable
+        # without relabeling
         lines.append(
             f"{namespace}_engine_info" + _fmt_labels({
                 **lab, "attn_impl": snap.get("attn_impl") or "unknown",
                 "unified": ("on" if snap.get("unified") else "off"),
-                "spec": snap.get("spec") or "off"})
+                "spec": snap.get("spec") or "off",
+                "kv_dtype": snap.get("kv_dtype") or "fp"})
             + " 1")
         lines.append(f"{namespace}_unified_steps_total"
                      + _fmt_labels(lab)
@@ -568,11 +586,18 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
         lines.append(f"{namespace}_pool_pages_swapped"
                      + _fmt_labels(lab)
                      + f" {pool.get('pages_swapped', 0)}")
+        lines.append(f"{namespace}_pool_bytes_per_page"
+                     + _fmt_labels(lab)
+                     + f" {pool.get('bytes_per_page', 0)}")
         host = snap.get("host_pool") or {}
         lines.append(f"{namespace}_host_pages_used" + _fmt_labels(lab)
                      + f" {host.get('pages_used', 0)}")
         lines.append(f"{namespace}_host_pages_total" + _fmt_labels(lab)
                      + f" {host.get('pages_total', 0)}")
+        lines.append(f"{namespace}_host_bytes_used" + _fmt_labels(lab)
+                     + f" {host.get('bytes_used', 0)}")
+        lines.append(f"{namespace}_host_bytes_total" + _fmt_labels(lab)
+                     + f" {host.get('bytes_total', 0)}")
         prefix = snap.get("prefix")
         if prefix is not None:
             for metric, key in [("prefix_lookups_total", "lookups"),

@@ -579,6 +579,22 @@ def main():
     t = timeit(jax.jit(alternating), qrag, kpool, vpool, ptab, posv)
     note("ragged_mix_alternating_ms", round(t * 1e3, 3))
 
+    # (14) quantized-pool A/B at the same ragged mix: the int8 lane
+    # streams HALF the KV bytes per page (codes + rowwise scales vs
+    # fp16/32 values) with dequant fused into the softmax loop — on
+    # HBM-bound hardware the decode step's dominant stream halves. On
+    # CPU this times the pure-JAX q8 reference (gather + dequantize),
+    # so treat the CPU delta as op overhead, not the HBM win; run on
+    # the chip for the real number.
+    from paddle_tpu.ops.pallas.paged_attention import \
+        ragged_paged_attention_q8
+    from paddle_tpu.nlp.generation import quantize_kv_rowwise
+    kcodes, kscales = quantize_kv_rowwise(kpool)
+    vcodes, vscales = quantize_kv_rowwise(vpool)
+    t = timeit(jax.jit(ragged_paged_attention_q8), qrag, kcodes,
+               vcodes, kscales, vscales, ptab, posv, qlen_mixv)
+    note("ragged_mix_unified_int8_ms", round(t * 1e3, 3))
+
     # roofline bookkeeping
     wbytes = sum(int(np.prod(w.shape)) for w in Wqkv + Wout + W1 + W2) * 2
     ebytes = int(np.prod(E.shape)) * 2

@@ -146,6 +146,35 @@ def _cases():
         # k=4 here) next to plain q_len-1 decode rows — the per-step
         # hot mix `ServingEngine(spec=...)` runs, tracked so the
         # verify pass keeps a perf number of its own
+        # int8 lane of the ragged op over the SAME mixed batch: code
+        # pools + rowwise scale pools, dequant fused in-kernel — the
+        # serving hot path with PADDLE_TPU_KV_DTYPE=int8 (on CPU this
+        # times the q8 reference; the HBM halving shows on the chip)
+        "ragged_paged_attention_q8": lambda: (
+            lambda q, kp, vp, ks, vs, pt, pos, ql: apply_op(
+                "ragged_paged_attention_q8", q, kp, vp, ks, vs, pt,
+                pos, ql),
+            (t(8, 16, 8, 64),
+             paddle.to_tensor((np.random.RandomState(7)
+                               .randint(-127, 128, size=(65, 16, 8,
+                                                         64)))
+                              .astype(np.int8)),
+             paddle.to_tensor((np.random.RandomState(8)
+                               .randint(-127, 128, size=(65, 16, 8,
+                                                         64)))
+                              .astype(np.int8)),
+             paddle.to_tensor(np.abs(np.random.RandomState(9)
+                                     .randn(65, 16, 8))
+                              .astype(np.float32) / 127.0),
+             paddle.to_tensor(np.abs(np.random.RandomState(10)
+                                     .randn(65, 16, 8))
+                              .astype(np.float32) / 127.0),
+             paddle.to_tensor(np.arange(1, 65, dtype=np.int32)
+                              .reshape(8, 8)),
+             paddle.to_tensor(np.asarray(
+                 [100, 96, 88, 100, 40, 16, 0, 64], np.int32)),
+             paddle.to_tensor(np.asarray(
+                 [1, 1, 1, 1, 16, 16, 8, 3], np.int32)))),
         "ragged_paged_attention_verify": lambda: (
             lambda q, kp, vp, pt, pos, ql: apply_op(
                 "ragged_paged_attention", q, kp, vp, pt, pos, ql),

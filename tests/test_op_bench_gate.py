@@ -93,6 +93,25 @@ def test_paged_decode_attention_is_benched():
     assert tuple(out.shape) == (8, 1, 8, 64)
 
 
+def test_ragged_q8_lane_is_benched():
+    """The quantized-serving hot path — the ragged op's int8 lane over
+    code + rowwise-scale pools — must keep its own tracked perf
+    number next to the fp ragged entry: with PADDLE_TPU_KV_DTYPE=int8
+    every serving step runs this shape, and the whole point of the
+    lane (half the KV bytes per step) dies silently without a
+    number."""
+    import numpy as np
+    cases = _op_bench_cases()
+    assert "ragged_paged_attention_q8" in cases
+    fn, args = cases["ragged_paged_attention_q8"]()
+    # pools really are int8 codes + f32 rowwise scales
+    assert args[1].numpy().dtype == np.int8
+    assert args[3].numpy().dtype == np.float32
+    assert args[3].numpy().shape == args[1].numpy().shape[:3]
+    out = fn(*args)
+    assert tuple(out.shape) == (8, 16, 8, 64)
+
+
 def test_ragged_verify_shape_is_benched():
     """Speculative decoding's VERIFY pass — mixed per-row q_len with
     1 + k draft rows next to plain q_len-1 decode rows through

@@ -901,6 +901,15 @@ ELSEWHERE = {
     # page-table scatter/gather, chunked prefill, page reuse
     **{n: EW("test_serving.py", "Paged|chunked") for n in [
         "kv_cache_update_paged", "paged_kv_gather"]},
+    # quantized paged pool (int8 serving) — rowwise quantize-then-
+    # scatter / dequantizing gather roundtrip bit-exact vs the dense
+    # rowwise reference, int8 kernel lane vs quantized-gather
+    # bit-identity, int8 engine feature-matrix oracles
+    # (tests/test_serving_quant.py)
+    **{n: EW("test_serving_quant.py",
+             "q8|int8|quantize_kv_rowwise") for n in [
+        "kv_cache_update_paged_q8", "paged_kv_gather_q8",
+        "ragged_paged_attention_q8"]},
     # ragged paged-attention decode kernel + grouped-GQA decode —
     # kernel vs gather bit-identity, interpret-mode kernel vs
     # reference, ServingEngine A/B (tests/test_paged_attention.py)
