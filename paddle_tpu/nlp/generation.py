@@ -36,7 +36,10 @@ from ..ops.pallas.paged_attention import (dequantize_paged_q8,
                                           gqa_attend_reference,
                                           paged_decode_attention,
                                           ragged_paged_attention,
-                                          ragged_paged_attention_q8)
+                                          ragged_paged_attention_q8,
+                                          ragged_paged_attention_grouped,
+                                          ragged_paged_attention_grouped_q8,
+                                          FP8_DTYPE)
 
 __all__ = ["DecodeCache", "init_decode_caches", "update_and_attend",
            "CompiledGenerator", "decode_model_step", "sample_logits",
@@ -83,11 +86,11 @@ class DecodeCache:
     """
 
     __slots__ = ("k", "v", "pos", "k_scale", "v_scale", "fresh",
-                 "page_table", "attn_impl", "q_len")
+                 "page_table", "attn_impl", "q_len", "group")
 
     def __init__(self, k, v, pos, k_scale=None, v_scale=None,
                  fresh=False, page_table=None, attn_impl=None,
-                 q_len=None):
+                 q_len=None, group=None):
         self.k = k
         self.v = v
         self.pos = pos
@@ -102,6 +105,12 @@ class DecodeCache:
         # queries past q_len are dead padding. None = every row uses
         # the full width l (the classic prefill/decode shapes).
         self.q_len = q_len
+        # prefix-sharing groups (the serving engine's grouped walk,
+        # PADDLE_TPU_GROUPED_ATTN): a (group_id, group_leader,
+        # group_cnt) triple of [B] int32 Tensors declaring which rows
+        # share a physical-page prefix — pure HBM-traffic hint, None =
+        # the per-row walk
+        self.group = group
         # int8 cache modes, told apart by the scale SHAPE:
         # - dense (page_table None): k/v hold int8 codes laid out
         #   [B, H_kv, max_len, D]; *_scale are per-head [H_kv] f32
@@ -168,6 +177,11 @@ def _kv_update_paged_fwd(pool, upd, pos, page_table):
                               axis=1)                    # [B, l] pages
     flat = ids * ps + p % ps
     flat = jnp.where(p < addressable, flat, p % ps)      # OOB -> trash
+    if jnp.dtype(pool.dtype) == jnp.dtype(FP8_DTYPE):
+        # fp8 lane: XLA's f32->e4m3 convert yields NaN past the
+        # format's range, not a saturate — clip to +-448 first so a
+        # pathological activation can never poison the pool
+        upd = jnp.clip(upd.astype(jnp.float32), -448.0, 448.0)
     flat_pool = pool.reshape((-1,) + pool.shape[2:])
     flat_pool = flat_pool.at[flat.reshape(-1)].set(
         upd.astype(pool.dtype).reshape((-1,) + upd.shape[2:]))
@@ -187,6 +201,11 @@ def _paged_gather_fwd(pool, page_table):
     >= pos hides them exactly (trash is finite, never NaN: pools are
     zero-init and only ever written with real K/V)."""
     g = jnp.take(pool, page_table.astype(jnp.int32), axis=0)
+    if jnp.dtype(pool.dtype) == jnp.dtype(FP8_DTYPE):
+        # fp8 KV lane (PADDLE_TPU_KV_DTYPE=fp8): the gather IS the
+        # dequant — a pure convert, no scale pages exist — so chunked
+        # prefill and the gather A/B impl attend over f32 as usual
+        g = g.astype(jnp.float32)
     b, m, ps = g.shape[0], g.shape[1], g.shape[2]
     return g.reshape((b, m * ps) + pool.shape[2:])
 
@@ -283,6 +302,20 @@ register_op("ragged_paged_attention", ragged_paged_attention,
 # ragged mask math), bit-identical to the quantized-gather path.
 register_op("ragged_paged_attention_q8", ragged_paged_attention_q8,
             nondiff=True)
+
+# Prefix-sharing-aware grouped walk: rows whose page tables share a
+# physical-page prefix declare it via (group_id, group_leader,
+# group_cnt) scalar operands and the TPU kernel streams each shared
+# page from HBM once per GROUP (two-phase walk) instead of once per
+# row — the dominant shared-prefix decode traffic drops ~Nx. Output
+# identical to the ungrouped op; off-TPU the fwd IS the ungrouped
+# reference, so the grouped/flat engine A/B stays bit-token-identical
+# on CPU by construction. The q8 variant moves code + scale pages
+# through the same grouped stream.
+register_op("ragged_paged_attention_grouped",
+            ragged_paged_attention_grouped, nondiff=True)
+register_op("ragged_paged_attention_grouped_q8",
+            ragged_paged_attention_grouped_q8, nondiff=True)
 
 
 # Grouped-query decode attention: attends q [B, l, H, D] over the full
@@ -539,22 +572,31 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
         # row b attends keys j <= pos[b] + i, dead queries past q_len
         # are masked in-kernel (outputs unspecified, the engine drops
         # them). The int8 pool takes the q8 lane: code + scale pages
-        # stream together, dequant fused into the softmax loop.
+        # stream together, dequant fused into the softmax loop. With
+        # prefix-sharing groups attached (cache.group — the engine's
+        # grouped walk) the grouped op streams each shared page once
+        # per group; same output, less HBM.
+        grouped = cache.group is not None
         if quant:
             args = [q, k_buf, v_buf, k_sc, v_sc, cache.page_table,
                     cache.pos, cache.q_len]
+            op = ("ragged_paged_attention_grouped_q8" if grouped
+                  else "ragged_paged_attention_q8")
         else:
             args = [q, k_buf, v_buf, cache.page_table, cache.pos,
                     cache.q_len]
+            op = ("ragged_paged_attention_grouped" if grouped
+                  else "ragged_paged_attention")
+        if grouped:
+            args.extend(cache.group)
         if user_m is not None:
             args.append(user_m)
-        out = apply_op("ragged_paged_attention_q8" if quant
-                       else "ragged_paged_attention", *args)
+        out = apply_op(op, *args)
         return out, DecodeCache(k_buf, v_buf, cache.pos + cache.q_len,
                                 k_sc, v_sc,
                                 page_table=cache.page_table,
                                 attn_impl=cache.attn_impl,
-                                q_len=cache.q_len)
+                                q_len=cache.q_len, group=cache.group)
     mask = apply_op("window_causal_mask", cache.pos,
                     attrs=dict(l=int(l), lmax=int(lmax)))
     if user_m is not None:
@@ -658,7 +700,7 @@ def _pack_caches(caches):
 
 
 def _unpack_caches(ct, pos, page_table=None, attn_impl=None,
-                   q_len=None):
+                   q_len=None, group=None):
     """page_table (optional [B, max_pages] raw int32 array) switches
     every layer's cache into paged-pool mode; the table is shared
     across layers (one page id addresses the same page in each
@@ -666,13 +708,19 @@ def _unpack_caches(ct, pos, page_table=None, attn_impl=None,
     ("kernel"/"gather") for the trace being built. q_len (optional
     [B] raw int32 array) switches the paged caches into RAGGED mode —
     the serving engine's unified prefill+decode step, where each row
-    carries its own live query count over a shared padded width."""
+    carries its own live query count over a shared padded width.
+    group (optional (group_id, group_leader, group_cnt) triple of [B]
+    raw int32 arrays) attaches prefix-sharing groups: the ragged read
+    takes the GROUPED walk — each physically shared page streamed
+    once per group — with identical outputs."""
     pt = None if page_table is None else Tensor(page_table)
     ql = None if q_len is None else Tensor(q_len)
+    grp = None if group is None else tuple(Tensor(g) for g in group)
     return [DecodeCache(Tensor(k), Tensor(v), Tensor(pos),
                         None if ks is None else Tensor(ks),
                         None if vs is None else Tensor(vs),
-                        page_table=pt, attn_impl=attn_impl, q_len=ql)
+                        page_table=pt, attn_impl=attn_impl, q_len=ql,
+                        group=grp)
             for k, v, ks, vs in ct]
 
 

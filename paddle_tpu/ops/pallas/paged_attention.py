@@ -33,6 +33,47 @@ gather path (gather pages -> masked grouped softmax), kept around both
 as the CPU tier-1 path and as the oracle the kernel is tested against
 (tests/test_paged_attention.py runs the kernel in interpret mode).
 
+GROUPED PAGE WALK (`ragged_paged_attention_grouped`): under high
+prefix share, N resident rows attend the SAME physical system-prompt
+pages, and the per-row walk above streams those pages from HBM N
+times per step. The grouped op is the cascade/hydragen-style fix:
+rows whose page tables share a physical-page prefix carry a group id,
+and three extra scalar-prefetch operands — `group_id` [B] (row ->
+group), `group_leader` [B] (group -> a representative row) and
+`group_cnt` [B] (group -> shared page count; 0 for singletons) — ride
+next to `page_table`/`pos`/`q_len` and drive a TWO-PHASE kernel:
+
+- phase 1 walks each group's shared pages via the LEADER's page table
+  (grid (kv_head, q_block, group x page)), streaming every shared
+  page from HBM ONCE PER GROUP while updating the online-softmax
+  partials (m, l, acc) of EVERY member row in VMEM (non-member rows
+  are masked out of the update, so their partials stay bit-exact);
+- phase 2 is exactly the per-row walk above, except each row STARTS
+  from its phase-1 partials and its page sweep clamps to
+  [group_cnt[group_id[b]], last_live] — private tail pages stream
+  once per row, shared pages are never re-read.
+
+A group of 1 (group_cnt 0) degenerates to the ungrouped walk: phase 1
+never touches the row and phase 2 starts at page 0 with the virgin
+(-inf, 0, 0) partials. Page order per row is IDENTICAL to the
+ungrouped kernel (shared pages 0..cnt-1 then private cnt..last, the
+same online-softmax recurrence), so outputs match the ungrouped walk;
+off-TPU the op runs the SAME `ragged_attention_reference` as the
+ungrouped op — grouping is a pure HBM-traffic hint, bit-identical by
+construction. `count_page_block_reads` is the host-side model of both
+walks' DMA behavior (the number the serving bench and metrics
+report). The q8 lane (`ragged_paged_attention_grouped_q8`) streams
+the rowwise scale pages through the same grouped walk.
+
+FP8 LANE: pools may hold float8_e4m3fn — a PURE-CONVERT quantized
+cache (no scale pages at all: the e4m3 value IS the number, saturating
+round-to-nearest on write). Every kernel and reference detects the
+pool dtype and upconverts to f32 in VMEM before the dot — half the
+fp16/bf16 HBM bytes (a quarter of f32) with zero extra operands, the
+cheapest possible quantized lane. Unlike int8's rowwise codes+scales
+there is nothing to keep paired, so COW/swap/spill move fp8 pages
+exactly like fp pages.
+
 RAGGED GENERALIZATION (`ragged_paged_attention`): the same walk, but
 every row carries its own query length — grid
 (batch_row, kv_head, q_block, page), with `q_len` [B] riding next to
@@ -74,6 +115,7 @@ import functools
 import math
 import os
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -82,13 +124,24 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ["paged_decode_attention", "paged_attention_reference",
            "gqa_attend_reference", "ragged_paged_attention",
            "ragged_attention_reference", "ragged_paged_attention_q8",
-           "ragged_attention_reference_q8", "dequantize_paged_q8"]
+           "ragged_attention_reference_q8", "dequantize_paged_q8",
+           "ragged_paged_attention_grouped",
+           "ragged_paged_attention_grouped_q8",
+           "count_page_block_reads", "FP8_DTYPE"]
 
 # interpret mode: run the kernel on CPU for testing (tests set this)
 _INTERPRET = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "0") == "1"
 
 _NEG_INF = -1e30
 _LANES = 128
+
+# the pure-convert fp8 KV lane's storage dtype: e4m3 "fn" (finite —
+# saturates instead of overflowing to inf), the standard KV-cache fp8
+FP8_DTYPE = jnp.float8_e4m3fn
+
+
+def _is_fp8(dt) -> bool:
+    return jnp.dtype(dt) == jnp.dtype(FP8_DTYPE)
 
 
 def _prec(dt):
@@ -118,7 +171,7 @@ def _mask_to_additive(mask, b, h, lmax, lq=1):
 
 
 def _pa_kernel(tab_ref, pos_ref, q_ref, k_ref, v_ref, *rest, ps, rep,
-               scale, has_mask):
+               scale, has_mask, fp8=False):
     if has_mask:
         mask_ref, o_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -128,7 +181,7 @@ def _pa_kernel(tab_ref, pos_ref, q_ref, k_ref, v_ref, *rest, ps, rep,
     p = pl.program_id(2)
     n_p = pl.num_programs(2)
     pos_b = pos_ref[b]
-    prec = _prec(q_ref.dtype)
+    prec = _prec(jnp.float32 if fp8 else q_ref.dtype)
     scale32 = jnp.float32(scale)
 
     @pl.when(p == 0)
@@ -144,6 +197,11 @@ def _pa_kernel(tab_ref, pos_ref, q_ref, k_ref, v_ref, *rest, ps, rep,
     def _compute():
         q = q_ref[0, 0]                     # [rep, D]
         k = k_ref[0, :, 0, :]               # [ps, D]
+        if fp8:
+            # pure-convert fp8 lane: the e4m3 value IS the number —
+            # upconvert in VMEM, no scale operand exists
+            q = q.astype(jnp.float32)
+            k = k.astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -165,6 +223,8 @@ def _pa_kernel(tab_ref, pos_ref, q_ref, k_ref, v_ref, *rest, ps, rep,
             alpha * l_prev + jnp.sum(pexp, axis=1, keepdims=True),
             l_ref.shape)
         v = v_ref[0, :, 0, :]               # [ps, D]
+        if fp8:
+            v = v.astype(jnp.float32)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             pexp.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -211,7 +271,8 @@ def _paged_attention_kernel(q, k_pool, v_pool, page_table, pos, mask):
             lambda bi, g, p, tab, posr: (bi * hkv + g, 0, p)))
 
     kernel = functools.partial(_pa_kernel, ps=ps, rep=rep, scale=scale,
-                               has_mask=mask is not None)
+                               has_mask=mask is not None,
+                               fp8=_is_fp8(k_pool.dtype))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, hkv, mp),
@@ -243,7 +304,7 @@ def _paged_attention_kernel(q, k_pool, v_pool, page_table, pos, mask):
 
 def _ragged_kernel(tab_ref, pos_ref, qlen_ref, q_ref, k_ref, v_ref,
                    *rest, ps, qblk, rep, scale, has_mask,
-                   has_scale=False):
+                   has_scale=False, fp8=False):
     rest = list(rest)
     if has_scale:
         # int8 lane: rowwise dequant scales ride next to the code
@@ -263,7 +324,7 @@ def _ragged_kernel(tab_ref, pos_ref, qlen_ref, q_ref, k_ref, v_ref,
     n_p = pl.num_programs(3)
     pos_b = pos_ref[b]
     qlen_b = qlen_ref[b]
-    prec = _prec(jnp.float32 if has_scale else q_ref.dtype)
+    prec = _prec(jnp.float32 if (has_scale or fp8) else q_ref.dtype)
     scale32 = jnp.float32(scale)
     # last valid query of THIS block (block-dead when t*qblk >= q_len)
     last_qi = jnp.minimum((t + 1) * qblk, qlen_b) - 1
@@ -285,6 +346,10 @@ def _ragged_kernel(tab_ref, pos_ref, qlen_ref, q_ref, k_ref, v_ref,
             # dequantized page never round-trips through HBM
             q = q.astype(jnp.float32)
             k = k.astype(jnp.float32) * ks_ref[0, :, 0][:, None]
+        elif fp8:
+            # pure-convert fp8 lane: upconvert in VMEM, no scales
+            q = q.astype(jnp.float32)
+            k = k.astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -311,6 +376,8 @@ def _ragged_kernel(tab_ref, pos_ref, qlen_ref, q_ref, k_ref, v_ref,
         v = v_ref[0, :, 0, :]                      # [ps, D]
         if has_scale:
             v = v.astype(jnp.float32) * vs_ref[0, :, 0][:, None]
+        elif fp8:
+            v = v.astype(jnp.float32)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             pexp.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -392,7 +459,8 @@ def _ragged_attention_kernel(q, k_pool, v_pool, page_table, pos, q_len,
     kernel = functools.partial(_ragged_kernel, ps=ps, qblk=qblk,
                                rep=rep, scale=scale,
                                has_mask=mask is not None,
-                               has_scale=has_scale)
+                               has_scale=has_scale,
+                               fp8=_is_fp8(k_pool.dtype))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(b, hkv, nqb, mp),
@@ -418,6 +486,355 @@ def _ragged_attention_kernel(q, k_pool, v_pool, page_table, pos, q_len,
                                      "arbitrary", "arbitrary")),
             interpret=_INTERPRET,
         )(page_table, pos, q_len, *ops)
+    return out.reshape(b, lq_pad, h, d)[:, :lq]
+
+
+def _grouped_phase1_kernel(tab_ref, pos_ref, qlen_ref, gid_ref,
+                           gldr_ref, gcnt_ref, q_ref, k_ref, v_ref,
+                           *rest, b, mp, ps, qblk, rep, scale,
+                           has_scale, fp8):
+    """Phase 1 of the grouped walk — grid (kv_head, q_block,
+    group x shared_page): each grid step streams ONE shared page of
+    ONE group (via the group leader's page table; the index map clamps
+    dead steps so their DMA is skipped) and folds it into the
+    online-softmax partials of EVERY member row at once. Non-member
+    rows (and groups with no shared span) are masked out of the
+    update, so their partials leave this phase exactly as they
+    entered: (-inf, 0, 0) — the virgin state phase 2 would have
+    initialized anyway."""
+    rest = list(rest)
+    if has_scale:
+        ks_ref, vs_ref = rest[0], rest[1]
+        rest = rest[2:]
+    else:
+        ks_ref = vs_ref = None
+    meta_ref, m_out, l_out, acc_out, m_sc, l_sc, acc_sc = rest
+    t = pl.program_id(1)
+    u = pl.program_id(2)
+    n_u = pl.num_programs(2)
+    grp = u // mp
+    sp = u % mp
+    cnt = gcnt_ref[grp]
+    prec = _prec(jnp.float32 if (has_scale or fp8) else q_ref.dtype)
+    scale32 = jnp.float32(scale)
+
+    @pl.when(u == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, jnp.float32(_NEG_INF))
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    # a step is live iff its group really has this shared page
+    @pl.when(sp < cnt)
+    def _compute():
+        d = q_ref.shape[-1]
+        q = q_ref[:, 0, :, 0].reshape(b * qblk * rep, d)
+        k = k_ref[0, :, 0, :]                      # [ps, D]
+        if has_scale:
+            q = q.astype(jnp.float32)
+            k = k.astype(jnp.float32) * ks_ref[0, :, 0][:, None]
+        elif fp8:
+            q = q.astype(jnp.float32)
+            k = k.astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec) * scale32              # [b*qblk*rep, ps]
+        # per-(row, query, key) liveness: the row must belong to THIS
+        # group, the query must be live (i < q_len) and the key within
+        # its causal window (j <= pos + i). meta rows: (pos, q_len,
+        # group_id) — a VMEM mirror of the scalar operands so the mask
+        # builds from plain vector reads.
+        pos4 = meta_ref[0, :][:, None, None, None]
+        qlen4 = meta_ref[1, :][:, None, None, None]
+        member4 = (meta_ref[2, :][:, None, None, None] == grp)
+        qi = t * qblk + jax.lax.broadcasted_iota(
+            jnp.int32, (b, qblk, rep, ps), 1)
+        k_pos = sp * ps + jax.lax.broadcasted_iota(
+            jnp.int32, (b, qblk, rep, ps), 3)
+        live = member4 & (qi < qlen4) & (k_pos <= pos4 + qi)
+        s = jnp.where(live.reshape(b * qblk * rep, ps), s,
+                      jnp.float32(_NEG_INF))
+        member = jnp.broadcast_to(member4, (b, qblk, rep, 1)) \
+            .reshape(b * qblk * rep, 1)
+        m_prev = m_sc[:, :1]
+        l_prev = l_sc[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        # NON-member rows take the no-op branch of every update below:
+        # their partials must stay BIT-exact through a phase that
+        # computes garbage scores for them
+        m_new = jnp.where(member, jnp.maximum(m_prev, m_cur), m_prev)
+        alpha = jnp.exp(m_prev - m_new)
+        pexp = jnp.exp(s - m_new)
+        l_sc[:] = jnp.broadcast_to(
+            jnp.where(member,
+                      alpha * l_prev + jnp.sum(pexp, axis=1,
+                                               keepdims=True),
+                      l_prev), l_sc.shape)
+        v = v_ref[0, :, 0, :]                      # [ps, D]
+        if has_scale:
+            v = v.astype(jnp.float32) * vs_ref[0, :, 0][:, None]
+        elif fp8:
+            v = v.astype(jnp.float32)
+        upd = acc_sc[:] * alpha + jax.lax.dot_general(
+            pexp.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec)
+        acc_sc[:] = jnp.where(member, upd, acc_sc[:])
+        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+
+    @pl.when(u == n_u - 1)
+    def _flush():
+        m_out[0, 0] = m_sc[:]
+        l_out[0, 0] = l_sc[:]
+        acc_out[0, 0] = acc_sc[:]
+
+
+def _grouped_phase2_kernel(tab_ref, pos_ref, qlen_ref, gid_ref,
+                           gldr_ref, gcnt_ref, q_ref, k_ref, v_ref,
+                           *rest, ps, qblk, rep, scale, has_scale,
+                           fp8):
+    """Phase 2 of the grouped walk: the per-row page sweep of
+    `_ragged_kernel`, except each row initializes from its phase-1
+    partials and skips pages below its group's shared span (their
+    contribution is already folded in) — private tail pages stream
+    once per row, shared pages are never re-read. The merge IS the
+    online-softmax recurrence continuing where phase 1 stopped, so the
+    page order per row matches the ungrouped kernel exactly."""
+    rest = list(rest)
+    if has_scale:
+        ks_ref, vs_ref = rest[0], rest[1]
+        rest = rest[2:]
+    else:
+        ks_ref = vs_ref = None
+    m_in, l_in, acc_in, o_ref, m_ref, l_ref, acc_ref = rest
+    b = pl.program_id(0)
+    t = pl.program_id(2)
+    p = pl.program_id(3)
+    n_p = pl.num_programs(3)
+    pos_b = pos_ref[b]
+    qlen_b = qlen_ref[b]
+    shared_b = gcnt_ref[gid_ref[b]]
+    prec = _prec(jnp.float32 if (has_scale or fp8) else q_ref.dtype)
+    scale32 = jnp.float32(scale)
+    last_qi = jnp.minimum((t + 1) * qblk, qlen_b) - 1
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = m_in[0, 0]
+        l_ref[:] = l_in[0, 0]
+        acc_ref[:] = acc_in[0, 0]
+
+    @pl.when((t * qblk < qlen_b) & (p * ps <= pos_b + last_qi)
+             & (p >= shared_b))
+    def _compute():
+        q = q_ref[0, 0, :, 0].reshape(qblk * rep, q_ref.shape[-1])
+        k = k_ref[0, :, 0, :]                      # [ps, D]
+        if has_scale:
+            q = q.astype(jnp.float32)
+            k = k.astype(jnp.float32) * ks_ref[0, :, 0][:, None]
+        elif fp8:
+            q = q.astype(jnp.float32)
+            k = k.astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec) * scale32              # [qblk*rep, ps]
+        qi = t * qblk + jax.lax.broadcasted_iota(
+            jnp.int32, (qblk, rep, ps), 0).reshape(qblk * rep, ps)
+        k_pos = p * ps + jax.lax.broadcasted_iota(
+            jnp.int32, (qblk, rep, ps), 2).reshape(qblk * rep, ps)
+        live = (qi < qlen_b) & (k_pos <= pos_b + qi)
+        s = jnp.where(live, s, jnp.float32(_NEG_INF))
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        pexp = jnp.exp(s - m_new)
+        l_ref[:] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(pexp, axis=1, keepdims=True),
+            l_ref.shape)
+        v = v_ref[0, :, 0, :]                      # [ps, D]
+        if has_scale:
+            v = v.astype(jnp.float32) * vs_ref[0, :, 0][:, None]
+        elif fp8:
+            v = v.astype(jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            pexp.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(p == n_p - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], jnp.float32(1e-30))
+        d = o_ref.shape[-1]
+        o_ref[0, 0, :, 0] = (acc_ref[:] / l).reshape(
+            qblk, rep, d).astype(o_ref.dtype)
+
+
+def _grouped_attention_kernel(q, k_pool, v_pool, page_table, pos,
+                              q_len, group_id, group_leader,
+                              group_cnt, k_scale=None, v_scale=None):
+    """The grouped two-phase page walk (see the module doc). Operand
+    contract (engine-enforced, host side): rows of one group carry
+    IDENTICAL page-table entries for indices [0, group_cnt) — the
+    physically shared prefix — and every member's pos already covers
+    the span (shared pages hold committed KV). group_leader[g] names a
+    member row whose table phase 1 walks; singleton rows ride with
+    group_cnt 0 and take phase 2 only, which is exactly the ungrouped
+    walk."""
+    b, lq, h, d = q.shape
+    _, ps, hkv, _ = k_pool.shape
+    mp = page_table.shape[1]
+    rep = h // hkv
+    scale = 1.0 / math.sqrt(d)
+    qblk = min(lq, 8)
+    nqb = -(-lq // qblk)
+    lq_pad = nqb * qblk
+    if lq_pad != lq:
+        padq = jnp.zeros((b, lq_pad - lq, h, d), q.dtype)
+        q = jnp.concatenate([q, padq], axis=1)
+    q6 = q.reshape(b, nqb, qblk, hkv, rep, d)
+    has_scale = k_scale is not None
+    fp8 = _is_fp8(k_pool.dtype)
+    rows = b * qblk * rep
+    # VMEM mirror of (pos, q_len, group_id): the phase-1 mask builds
+    # from plain vector reads instead of per-row SMEM gathers
+    meta = jnp.stack([pos, q_len, group_id]).astype(jnp.int32)
+
+    def kv1(g, t, u, tab, posr, qlr, gid, gld, gcn):
+        # shared page sp of group grp via the LEADER's page table;
+        # dead steps (groups with fewer shared pages, or none) clamp
+        # to the last live shared page — unchanged block index, DMA
+        # skipped — and empty groups to the trash page 0
+        grp = u // mp
+        sp = u % mp
+        cnt = gcn[grp]
+        live = jnp.clip(sp, 0, jnp.maximum(cnt - 1, 0))
+        return (jnp.where(cnt > 0, tab[gld[grp], live], 0), 0, g, 0)
+
+    def ks1(g, t, u, tab, posr, qlr, gid, gld, gcn):
+        grp = u // mp
+        sp = u % mp
+        cnt = gcn[grp]
+        live = jnp.clip(sp, 0, jnp.maximum(cnt - 1, 0))
+        return (jnp.where(cnt > 0, tab[gld[grp], live], 0), 0, g)
+
+    p1_in = [
+        pl.BlockSpec((b, 1, qblk, 1, rep, d),
+                     lambda g, t, u, *_: (0, t, 0, g, 0, 0)),
+        pl.BlockSpec((1, ps, 1, d), kv1),
+        pl.BlockSpec((1, ps, 1, d), kv1),
+    ]
+    p1_ops = [q6, k_pool, v_pool]
+    if has_scale:
+        p1_ops.extend([k_scale, v_scale])
+        p1_in.extend([pl.BlockSpec((1, ps, 1), ks1),
+                      pl.BlockSpec((1, ps, 1), ks1)])
+    p1_ops.append(meta)
+    p1_in.append(pl.BlockSpec((3, b), lambda g, t, u, *_: (0, 0)))
+
+    kernel1 = functools.partial(
+        _grouped_phase1_kernel, b=b, mp=mp, ps=ps, qblk=qblk, rep=rep,
+        scale=scale, has_scale=has_scale, fp8=fp8)
+    grid1 = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(hkv, nqb, b * mp),
+        in_specs=p1_in,
+        out_specs=[
+            pl.BlockSpec((1, 1, rows, _LANES),
+                         lambda g, t, u, *_: (g, t, 0, 0)),
+            pl.BlockSpec((1, 1, rows, _LANES),
+                         lambda g, t, u, *_: (g, t, 0, 0)),
+            pl.BlockSpec((1, 1, rows, d),
+                         lambda g, t, u, *_: (g, t, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((rows, _LANES), jnp.float32),
+            pltpu.VMEM((rows, _LANES), jnp.float32),
+            pltpu.VMEM((rows, d), jnp.float32),
+        ],
+    )
+
+    def kv2(bi, g, t, p, tab, posr, qlr, gid, gld, gcn):
+        # per-row private sweep: clamp into [shared span, last live] —
+        # steps below the span (phase-1 territory) and past the
+        # horizon re-fetch nothing
+        last_qi = jnp.minimum((t + 1) * qblk, qlr[bi]) - 1
+        lp = jnp.clip((posr[bi] + last_qi) // ps, 0, mp - 1)
+        s0 = jnp.minimum(gcn[gid[bi]], lp)
+        return (tab[bi, jnp.clip(p, s0, lp)], 0, g, 0)
+
+    def ks2(bi, g, t, p, tab, posr, qlr, gid, gld, gcn):
+        last_qi = jnp.minimum((t + 1) * qblk, qlr[bi]) - 1
+        lp = jnp.clip((posr[bi] + last_qi) // ps, 0, mp - 1)
+        s0 = jnp.minimum(gcn[gid[bi]], lp)
+        return (tab[bi, jnp.clip(p, s0, lp)], 0, g)
+
+    p2_in = [
+        pl.BlockSpec((1, 1, qblk, 1, rep, d),
+                     lambda bi, g, t, p, *_: (bi, t, 0, g, 0, 0)),
+        pl.BlockSpec((1, ps, 1, d), kv2),
+        pl.BlockSpec((1, ps, 1, d), kv2),
+    ]
+    if has_scale:
+        p2_in.extend([pl.BlockSpec((1, ps, 1), ks2),
+                      pl.BlockSpec((1, ps, 1), ks2)])
+    p2_in.extend([
+        pl.BlockSpec((1, 1, qblk * rep, _LANES),
+                     lambda bi, g, t, p, *_: (g, t, bi, 0)),
+        pl.BlockSpec((1, 1, qblk * rep, _LANES),
+                     lambda bi, g, t, p, *_: (g, t, bi, 0)),
+        pl.BlockSpec((1, 1, qblk * rep, d),
+                     lambda bi, g, t, p, *_: (g, t, bi, 0)),
+    ])
+    kernel2 = functools.partial(
+        _grouped_phase2_kernel, ps=ps, qblk=qblk, rep=rep, scale=scale,
+        has_scale=has_scale, fp8=fp8)
+    grid2 = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(b, hkv, nqb, mp),
+        in_specs=p2_in,
+        out_specs=pl.BlockSpec((1, 1, qblk, 1, rep, d),
+                               lambda bi, g, t, p, *_:
+                               (bi, t, 0, g, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((qblk * rep, _LANES), jnp.float32),
+            pltpu.VMEM((qblk * rep, _LANES), jnp.float32),
+            pltpu.VMEM((qblk * rep, d), jnp.float32),
+        ],
+    )
+    from jax.experimental import disable_x64
+    with disable_x64():
+        prefetch = (page_table, pos, q_len, group_id, group_leader,
+                    group_cnt)
+        m1, l1, a1 = pl.pallas_call(
+            kernel1,
+            grid_spec=grid1,
+            out_shape=[
+                jax.ShapeDtypeStruct((hkv, nqb, rows, _LANES),
+                                     jnp.float32),
+                jax.ShapeDtypeStruct((hkv, nqb, rows, _LANES),
+                                     jnp.float32),
+                jax.ShapeDtypeStruct((hkv, nqb, rows, d), jnp.float32),
+            ],
+            compiler_params=pltpu.TPUCompilerParams(
+                dimension_semantics=("parallel", "arbitrary",
+                                     "arbitrary")),
+            interpret=_INTERPRET,
+        )(*prefetch, *p1_ops)
+        out = pl.pallas_call(
+            kernel2,
+            grid_spec=grid2,
+            out_shape=jax.ShapeDtypeStruct((b, nqb, qblk, hkv, rep, d),
+                                           q.dtype),
+            compiler_params=pltpu.TPUCompilerParams(
+                dimension_semantics=("parallel", "parallel",
+                                     "arbitrary", "arbitrary")),
+            interpret=_INTERPRET,
+        )(*prefetch, q6, *p1_ops[1:-1], m1, l1, a1)
     return out.reshape(b, lq_pad, h, d)[:, :lq]
 
 
@@ -467,6 +884,11 @@ def paged_attention_reference(q, k_pool, v_pool, page_table, pos,
     tab = page_table.astype(jnp.int32)
     kf = jnp.take(k_pool, tab, axis=0).reshape(b, lmax, hkv, d)
     vf = jnp.take(v_pool, tab, axis=0).reshape(b, lmax, hkv, d)
+    if _is_fp8(k_pool.dtype):
+        # fp8 lane: pure-convert dequant of the gathered view — the
+        # same upconvert the kernel fuses in VMEM
+        kf = kf.astype(jnp.float32)
+        vf = vf.astype(jnp.float32)
     j = jnp.arange(lmax, dtype=jnp.int32)[None, :]
     add = jnp.where(j <= pos.astype(jnp.int32)[:, None],
                     jnp.float32(0.0), jnp.float32(_NEG_INF))
@@ -538,6 +960,10 @@ def ragged_attention_reference(q, k_pool, v_pool, page_table, pos,
     tab = page_table.astype(jnp.int32)
     kf = jnp.take(k_pool, tab, axis=0).reshape(b, lmax, hkv, d)
     vf = jnp.take(v_pool, tab, axis=0).reshape(b, lmax, hkv, d)
+    if _is_fp8(k_pool.dtype):
+        # fp8 lane: pure-convert dequant of the gathered view
+        kf = kf.astype(jnp.float32)
+        vf = vf.astype(jnp.float32)
     return _ragged_mask_attend(q, kf, vf, pos, q_len, mask)
 
 
@@ -634,3 +1060,104 @@ def ragged_paged_attention_q8(q, k_pool, v_pool, k_scale, v_scale,
             mask, k_scale=ks, v_scale=vs)
     return ragged_attention_reference_q8(q, k_pool, v_pool, ks, vs,
                                          page_table, posv, qlv, mask)
+
+
+def _grouped_operands(b, pos, q_len, group_id, group_leader,
+                      group_cnt):
+    """Normalize the grouped op's scalar operands to int32 [B]."""
+    out = []
+    for v in (pos, q_len, group_id, group_leader, group_cnt):
+        v = v.astype(jnp.int32)
+        if v.ndim == 0:
+            v = jnp.broadcast_to(v[None], (b,))
+        out.append(v)
+    return out
+
+
+def ragged_paged_attention_grouped(q, k_pool, v_pool, page_table, pos,
+                                   q_len, group_id, group_leader,
+                                   group_cnt, mask=None):
+    """Prefix-sharing-aware ragged paged attention (the registered
+    op's forward): same per-row `pos`/`q_len` semantics and the same
+    OUTPUT as `ragged_paged_attention`, but rows whose page tables
+    share a physical-page prefix declare it via `group_id` [B] (row ->
+    group), `group_leader` [B] (group -> a member row whose table
+    holds the shared prefix) and `group_cnt` [B] (group -> shared page
+    count, 0 for singletons), and the TPU kernel streams each shared
+    page from HBM once per GROUP instead of once per row (the
+    two-phase grouped walk — see the module doc). Grouping is a pure
+    HBM-traffic hint: off-TPU the op runs the SAME ungrouped
+    reference, so grouped and ungrouped results are bit-identical on
+    CPU by construction. A user mask falls back to the ungrouped
+    kernel (the engine never passes one on this path; the outputs are
+    identical either way, only the walk differs)."""
+    b = q.shape[0]
+    posv, qlv, gid, gld, gcn = _grouped_operands(
+        b, pos, q_len, group_id, group_leader, group_cnt)
+    if _use_kernel() and mask is None:
+        return _grouped_attention_kernel(
+            q, k_pool, v_pool, page_table.astype(jnp.int32), posv, qlv,
+            gid, gld, gcn)
+    return ragged_paged_attention(q, k_pool, v_pool, page_table, posv,
+                                  qlv, mask)
+
+
+def ragged_paged_attention_grouped_q8(q, k_pool, v_pool, k_scale,
+                                      v_scale, page_table, pos, q_len,
+                                      group_id, group_leader,
+                                      group_cnt, mask=None):
+    """int8 lane of the grouped walk: code pages AND their rowwise
+    scale pages chase the same two-phase page stream (a page and its
+    scales are one unit — exactly the q8 contract everywhere else),
+    dequant fused into the in-VMEM softmax loop. Output identical to
+    `ragged_paged_attention_q8`; off-TPU it IS the q8 reference."""
+    b = q.shape[0]
+    posv, qlv, gid, gld, gcn = _grouped_operands(
+        b, pos, q_len, group_id, group_leader, group_cnt)
+    ks = k_scale.astype(jnp.float32)
+    vs = v_scale.astype(jnp.float32)
+    if _use_kernel() and mask is None:
+        return _grouped_attention_kernel(
+            q, k_pool, v_pool, page_table.astype(jnp.int32), posv, qlv,
+            gid, gld, gcn, k_scale=ks, v_scale=vs)
+    return ragged_paged_attention_q8(q, k_pool, v_pool, ks, vs,
+                                     page_table, posv, qlv, mask)
+
+
+def count_page_block_reads(page_table, pos, q_len, group_id=None,
+                           group_cnt=None, *, page_size):
+    """Host-side (numpy) model of the kernels' page-block DMA traffic
+    for ONE (kv_head, layer) walk — the number the serving metrics and
+    the `--prefix-share` bench A/B report, and what tests pin.
+
+    Per live row (q_len > 0) the ungrouped walk streams its pages
+    0..floor((pos + q_len - 1)/page_size); the grouped walk streams
+    each group's shared span ONCE (per the leader's table) plus each
+    member's private tail. Returns
+    (flat_reads, grouped_reads, group_sizes) where group_sizes lists
+    the member count of every group that actually shares (>= 2 live
+    members); without group operands grouped_reads == flat_reads."""
+    pos = np.asarray(pos, np.int64)
+    q_len = np.asarray(q_len, np.int64)
+    ps = int(page_size)
+    live = q_len > 0
+    row_pages = np.where(live, (pos + np.maximum(q_len, 1) - 1) // ps
+                         + 1, 0)
+    flat = int(row_pages.sum())
+    if group_id is None or group_cnt is None:
+        return flat, flat, []
+    group_id = np.asarray(group_id, np.int64)
+    group_cnt = np.asarray(group_cnt, np.int64)
+    grouped = 0
+    sizes = []
+    for g in np.unique(group_id[live]):
+        members = np.nonzero(live & (group_id == g))[0]
+        cnt = int(group_cnt[g])
+        shared = min(cnt, int(row_pages[members].min())) \
+            if members.size else 0
+        # the shared span streams once; each member walks its tail
+        grouped += shared
+        grouped += int((row_pages[members] - shared).sum())
+        if members.size >= 2 and shared > 0:
+            sizes.append(int(members.size))
+    return flat, grouped, sizes

@@ -27,14 +27,24 @@ greedy decode:
             print(out.request_id, out.token_ids, out.finish_reason)
     print(eng.metrics.snapshot()["pool"])
 
-The paged pools can run QUANTIZED (PADDLE_TPU_KV_DTYPE=fp|int8 /
+The paged pools can run QUANTIZED (PADDLE_TPU_KV_DTYPE=fp|int8|fp8 /
 ServingEngine(kv_dtype=...), default fp): int8 code pages + per-page
 rowwise scale pages hold ~2x the resident tokens per HBM byte, the
 ragged kernel dequantizes in-VMEM (fused into the softmax loop), and
 every whole-page move — prefix COW, preemption swap, host spill —
 carries codes and scales together, so int8 serving stays
 deterministic and feature-on/off token-identical (fp drift bounded,
-benched via serving_bench --quant-ab).
+benched via serving_bench --quant-ab). fp8 is the pure-convert
+f8_e4m3 lane: no scale pages at all, one byte per element, pages
+move like fp pages (drift pinned in tests/test_serving_fp8.py).
+
+Attention is PREFIX-SHARING-AWARE (PADDLE_TPU_GROUPED_ATTN /
+ServingEngine(grouped=...), default on): rows whose page tables
+share a physical-page prefix — the radix cache attached the same
+pages — are grouped host-side each step and the kernel streams each
+shared page from HBM once per GROUP instead of once per row, outputs
+bit-identical either way (serving_bench --prefix-share runs the
+grouped-vs-flat A/B).
 
 OVERLOAD degrades gracefully instead of refusing (default on,
 PADDLE_TPU_PREEMPT / ServingEngine(preempt=...)): requests carry
@@ -48,8 +58,9 @@ Greedy requests are bit-identical to offline CompiledGenerator decode
 (tested); `scripts/serving_bench.py` drives a Poisson arrival trace and
 reports TTFT/throughput/pool utilization into BENCH_serving.json.
 """
-from .engine import (ServingEngine, resolve_kv_dtype,  # noqa: F401
-                     resolve_preempt_flag, resolve_unified_flag)
+from .engine import (ServingEngine, resolve_grouped_flag,  # noqa: F401
+                     resolve_kv_dtype, resolve_preempt_flag,
+                     resolve_unified_flag)
 from .errors import (DeadlineExceeded, EngineClosed,  # noqa: F401
                      PoisonedRequest, QueueFull, RateLimited,
                      ServingError)
@@ -60,7 +71,7 @@ from .metrics import (Histogram, ServingMetrics,  # noqa: F401
 from .paging import (HostPagePool, PagePool, chunk_bucket,  # noqa: F401
                      pages_needed)
 from .prefix import (PrefixGrant, RadixPrefixCache,  # noqa: F401
-                     resolve_prefix_cache_flag)
+                     resolve_prefix_cache_flag, shared_prefix_groups)
 from .request import (Request, RequestOutput, RequestState,  # noqa: F401
                       SamplingParams)
 from .scheduler import Scheduler  # noqa: F401
@@ -68,7 +79,8 @@ from .spec import (Drafter, NgramDrafter, SpecConfig,  # noqa: F401
                    resolve_spec_config)
 
 __all__ = ["ServingEngine", "resolve_unified_flag",
-           "resolve_preempt_flag", "resolve_kv_dtype", "Scheduler",
+           "resolve_preempt_flag", "resolve_kv_dtype",
+           "resolve_grouped_flag", "shared_prefix_groups", "Scheduler",
            "ServingMetrics", "Histogram",
            "prometheus_render", "PagePool", "HostPagePool",
            "pages_needed",

@@ -93,16 +93,43 @@ tier under page pressure (restored on the next match) — stage 1 of
 the ROADMAP's fleet-scale prefix cache.
 
 QUANTIZED SERVING (default off, gated `kv_dtype=...` /
-PADDLE_TPU_KV_DTYPE=fp|int8): with "int8" the per-layer pools hold
-rowwise-int8 CODE pages plus per-page f32 SCALE pages — ~half the HBM
-bytes per resident token, so the same HBM budget admits ~2x the
-residents AND the decode step's dominant HBM stream halves. Writes
-quantize-then-scatter in the same one-trace program; reads dequantize
-in the ragged kernel's fused int8 lane (or the dequantizing gather on
-the A/B path). Every whole-page move — COW, preemption swap, prefix
-spill — carries code and scale pages together, so int8 streams stay
-DETERMINISTIC and feature-on/off token-identical; int8 vs fp output
-drift is bounded and benched (serving_bench --quant-ab).
+PADDLE_TPU_KV_DTYPE=fp|int8|fp8): with "int8" the per-layer pools
+hold rowwise-int8 CODE pages plus per-page f32 SCALE pages — ~half
+the HBM bytes per resident token, so the same HBM budget admits ~2x
+the residents AND the decode step's dominant HBM stream halves.
+Writes quantize-then-scatter in the same one-trace program; reads
+dequantize in the ragged kernel's fused int8 lane (or the
+dequantizing gather on the A/B path). Every whole-page move — COW,
+preemption swap, prefix spill — carries code and scale pages
+together, so int8 streams stay DETERMINISTIC and feature-on/off
+token-identical; int8 vs fp output drift is bounded and benched
+(serving_bench --quant-ab). "fp8" is the PURE-CONVERT lane: f8_e4m3
+pages with NO scale pages (writes clip to +-448 and round; reads
+upconvert in VMEM / in the gather) — one byte per element, strictly
+fewer bytes than int8's codes+scales, and pages move through
+COW/swap/spill exactly like fp pages. Lossier per read than rowwise
+int8 but operand-free; deterministic, drift pinned
+(tests/test_serving_fp8.py).
+
+PREFIX-SHARING-AWARE GROUPED ATTENTION (default on, gated
+`grouped=...` / PADDLE_TPU_GROUPED_ATTN): under high prefix share N
+residents' page tables point at the SAME physical system-prompt
+pages, yet the per-row kernel walk streams them from HBM N times per
+step. Each step the engine groups rows whose page tables share a
+physical-page prefix (serving/prefix.py's `shared_prefix_groups` —
+host-side, from the very page tables the cache built; a COW'd page
+splits its row out at the divergence, eviction and retirement shrink
+groups between steps) and passes (group_id, group_leader, group_cnt)
+as three extra [S] operands next to pos/q_len — operand DATA, so the
+ONE unified trace never retraces. On TPU the grouped op's two-phase
+walk streams each shared page once per GROUP (phase 1: all member
+rows' online-softmax partials fold in VMEM; phase 2: private tails
+merge per row — same page order, bit-identical outputs); on CPU it
+IS the ungrouped reference, so grouped on/off stays bit-token-
+identical by construction. `count_page_block_reads` models the DMA
+traffic host-side each step, feeding the page_block_reads /
+shared_page_reads_saved counters and the group-size histogram the
+`--prefix-share` A/B asserts on.
 
 Correctness contract (tests/test_serving.py): a request decoded greedily
 through the engine emits tokens bit-identical to running it ALONE
@@ -133,37 +160,68 @@ from ..core.tensor import Tensor
 from ..profiler import RecordEvent
 from ..nlp.generation import (_pack_caches, _top_p_filter,
                               _unpack_caches, decode_model_step,
-                              resolve_paged_attn_impl)
+                              resolve_paged_attn_impl, FP8_DTYPE)
+from ..ops.pallas.paged_attention import count_page_block_reads
 from .errors import DeadlineExceeded, EngineClosed, PoisonedRequest
 from .metrics import ServingMetrics
 from .paging import (HostPagePool, PagePool, TRASH_PAGE, chunk_bucket,
                      pages_needed)
-from .prefix import RadixPrefixCache, resolve_prefix_cache_flag
+from .prefix import (RadixPrefixCache, resolve_prefix_cache_flag,
+                     shared_prefix_groups)
 from .request import Request, RequestOutput, RequestState, SamplingParams
 from .scheduler import Scheduler
 from .spec import Drafter, resolve_spec_config
 
 __all__ = ["ServingEngine", "resolve_unified_flag",
-           "resolve_preempt_flag", "resolve_kv_dtype"]
+           "resolve_preempt_flag", "resolve_kv_dtype",
+           "resolve_grouped_flag"]
 
 UNIFIED_STEP_MODES = ("on", "off")
 PREEMPT_MODES = ("on", "off")
-KV_DTYPE_MODES = ("fp", "int8")
+KV_DTYPE_MODES = ("fp", "int8", "fp8")
+GROUPED_ATTN_MODES = ("on", "off")
+
+
+def resolve_grouped_flag(override=None) -> bool:
+    """Whether the unified step runs the PREFIX-SHARING-AWARE grouped
+    page walk (default on): rows whose page tables share a
+    physical-page prefix (the radix cache attached the same pages)
+    are grouped host-side each step, and the ragged kernel streams
+    each shared page from HBM once per GROUP instead of once per row
+    — under high prefix share the dominant decode HBM stream drops
+    ~Nx. Outputs are bit-identical either way (on CPU the grouped op
+    IS the ungrouped reference); groups are operand DATA, so the one
+    unified trace never retraces. An explicit override wins;
+    otherwise PADDLE_TPU_GROUPED_ATTN=on|off (read at engine
+    construction — the compiled step keeps the op it was traced
+    with)."""
+    if override is not None:
+        return bool(override)
+    v = os.environ.get("PADDLE_TPU_GROUPED_ATTN", "on")
+    if v not in GROUPED_ATTN_MODES:
+        raise ValueError(
+            f"PADDLE_TPU_GROUPED_ATTN must be one of "
+            f"{GROUPED_ATTN_MODES}, got {v!r}")
+    return v == "on"
 
 
 def resolve_kv_dtype(override=None) -> str:
     """Which dtype the paged KV pool holds: "fp" (the model's float
-    dtype, the default) or "int8" — rowwise-quantized code pages plus
+    dtype, the default), "int8" — rowwise-quantized code pages plus
     per-page scale pages, ~half the HBM bytes per resident token, so
     the same HBM budget admits ~2x the residents AND decode's
-    dominant HBM stream halves. Quantization is lossy: greedy outputs
-    with int8 on are NOT bit-identical to fp (drift is bounded and
-    benched — serving_bench --quant-ab), but every serving feature
-    (prefix cache, COW, preemption swap, spec decode, migration) stays
-    deterministic and self-consistent at int8. An explicit override
-    wins; otherwise PADDLE_TPU_KV_DTYPE=fp|int8 (read at engine
-    construction — the compiled programs keep the pool dtype they
-    were traced with)."""
+    dominant HBM stream halves — or "fp8": PURE-CONVERT f8_e4m3
+    pages, NO scale pages at all (the e4m3 value is the number,
+    saturating round-to-nearest on write), one byte per element with
+    zero extra operands — the cheapest quantized lane, and pages move
+    through COW/swap/spill exactly like fp pages. Quantization is
+    lossy: greedy outputs with int8/fp8 on are NOT bit-identical to
+    fp (drift is bounded and pinned), but every serving feature
+    (prefix cache, COW, preemption swap, spec decode, migration)
+    stays deterministic and self-consistent at either lane. An
+    explicit override wins; otherwise PADDLE_TPU_KV_DTYPE=fp|int8|fp8
+    (read at engine construction — the compiled programs keep the
+    pool dtype they were traced with)."""
     v = override or os.environ.get("PADDLE_TPU_KV_DTYPE", "fp")
     if v not in KV_DTYPE_MODES:
         raise ValueError(
@@ -270,7 +328,7 @@ class ServingEngine:
                  prefix_cache=None, unified=None,
                  token_budget: Optional[int] = None, spec=None,
                  preempt=None, host_pages: Optional[int] = None,
-                 kv_dtype: Optional[str] = None):
+                 kv_dtype: Optional[str] = None, grouped=None):
         if cache_spec is None:
             if not hasattr(model, "_decode_cache_spec"):
                 raise ValueError(
@@ -343,9 +401,17 @@ class ServingEngine:
         # per-request drafters, created at admission for greedy
         # requests and dropped at retirement (request_id -> Drafter)
         self._drafters: Dict[str, Drafter] = {}
+        # prefix-sharing-aware grouped page walk (default on, gated
+        # PADDLE_TPU_GROUPED_ATTN / ServingEngine(grouped=...)): the
+        # unified kernel step streams each physically shared page once
+        # per GROUP. Only the unified + kernel path has a grouped
+        # walk; on the legacy/gather paths the flag is inert.
+        self.grouped = (resolve_grouped_flag(grouped) and self.unified
+                        and self.attn_impl == "kernel")
         self.metrics = metrics or ServingMetrics()
         self.metrics.attn_impl = self.attn_impl
         self.metrics.unified = self.unified
+        self.metrics.grouped = self.grouped
         self.metrics.spec = (None if self.spec is None
                              else self.spec.mode)
         self._clock = clock
@@ -384,18 +450,24 @@ class ServingEngine:
                             self.n_kv), jnp.float32))
                 for _ in range(self.n_layers))
         else:
+            # fp8: pure-convert e4m3 pages ride the fp container shape
+            # (no scale pools) — every whole-page program (COW, swap,
+            # spill) works on them unchanged
+            pool_dt = (FP8_DTYPE if self.kv_dtype == "fp8"
+                       else self._fp)
             self._ct = tuple(
                 (jnp.zeros((self.num_pages, self.page_size, self.n_kv,
-                            self.head_dim), self._fp),
+                            self.head_dim), pool_dt),
                  jnp.zeros((self.num_pages, self.page_size, self.n_kv,
-                            self.head_dim), self._fp),
+                            self.head_dim), pool_dt),
                  None, None)
                 for _ in range(self.n_layers))
         # HBM bytes one page costs across all layers (K and V, codes
-        # + scale pages for int8) — the denominator of the
-        # residents-per-HBM-byte economics serving_bench --quant-ab
-        # measures, and the byte gauges' unit
-        kv_itemsize = (1 if self.kv_dtype == "int8"
+        # + scale pages for int8; fp8 is one byte per element, no
+        # scales) — the denominator of the residents-per-HBM-byte
+        # economics serving_bench --quant-ab measures, and the byte
+        # gauges' unit
+        kv_itemsize = (1 if self.kv_dtype in ("int8", "fp8")
                        else jnp.dtype(self._fp).itemsize)
         scale_bytes = 4 if self.kv_dtype == "int8" else 0
         self.page_bytes = (self.n_layers * 2 * self.page_size
@@ -595,7 +667,8 @@ class ServingEngine:
         state_vals = [t._value for t in self._state_tensors]
 
         def ustep(state_vals, ct, pos, last_logits, page_table, tokens,
-                  q_len, is_decode, key, temps, top_k, top_p, greedy):
+                  q_len, is_decode, key, temps, top_k, top_p, greedy,
+                  group=None):
             originals = self._swap_state(state_vals)
             try:
                 nxt = _sample_rows(last_logits, key, temps, top_k,
@@ -607,7 +680,7 @@ class ServingEngine:
                                  nxt[:, None], tokens)
                 caches = _unpack_caches(ct, pos, page_table,
                                         attn_impl=self.attn_impl,
-                                        q_len=q_len)
+                                        q_len=q_len, group=group)
                 logits_t, caches = model(Tensor(toks), caches=caches)
                 lg = logits_t._value.astype(jnp.float32)   # [S, W, V]
                 # greedy draft verification: column i's argmax is the
@@ -638,6 +711,16 @@ class ServingEngine:
             finally:
                 self._restore_state(originals)
 
+        if self.grouped:
+            # prefix-sharing groups ride as three extra [S] int32
+            # operands (group_id, group_leader, group_cnt) — operand
+            # DATA next to pos/q_len, so regrouping between steps
+            # never retraces the one program
+            return jax.jit(
+                lambda ct, pos, ll, pt, tokens, q_len, isd, key, t, k,
+                p, g, gid, gld, gcn: ustep(
+                    state_vals, ct, pos, ll, pt, tokens, q_len, isd,
+                    key, t, k, p, g, group=(gid, gld, gcn)))
         return jax.jit(
             lambda ct, pos, ll, pt, tokens, q_len, isd, key, t, k, p,
             g: ustep(state_vals, ct, pos, ll, pt, tokens, q_len, isd,
@@ -1428,6 +1511,26 @@ class ServingEngine:
         if self._vec_dirty:
             self._refresh_vectors()
         pt_full, _ = self._page_tables()
+        # prefix-sharing groups for this step's walk (host-side, from
+        # the page tables — pure operand data) + the modeled page-block
+        # read count both walks would issue (the CPU-reference number
+        # the --prefix-share A/B and the saved-reads counter report)
+        pos_host = np.asarray(self._pos)
+        group_args = ()
+        if self.grouped:
+            gid, gld, gcn = shared_prefix_groups(self._pt_host, q_len)
+            group_args = (jnp.asarray(gid), jnp.asarray(gld),
+                          jnp.asarray(gcn))
+            flat_reads, step_reads, group_sizes = \
+                count_page_block_reads(self._pt_host, pos_host, q_len,
+                                       gid, gcn,
+                                       page_size=self.page_size)
+        else:
+            flat_reads, step_reads, group_sizes = \
+                count_page_block_reads(self._pt_host, pos_host, q_len,
+                                       page_size=self.page_size)
+        self.metrics.on_grouped_step(flat_reads, step_reads,
+                                     group_sizes)
         key = random_mod.next_key_host()
         # beat the watchdog heartbeat around the compiled launch and
         # expose the packed size: a legitimately huge packed step gets
@@ -1442,7 +1545,8 @@ class ServingEngine:
                     jnp.asarray(tokens), jnp.asarray(q_len),
                     jnp.asarray(is_decode), key,
                     jnp.asarray(self._temps), jnp.asarray(self._topk),
-                    jnp.asarray(self._topp), jnp.asarray(self._greedy))
+                    jnp.asarray(self._topp), jnp.asarray(self._greedy),
+                    *group_args)
             toks = np.asarray(toks)   # sync point: host sees the tokens
             accept = np.asarray(accept)
         self.step_tokens_inflight = 0

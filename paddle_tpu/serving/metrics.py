@@ -26,7 +26,7 @@ from typing import Optional, Sequence
 
 __all__ = ["Histogram", "ServingMetrics", "prometheus_render",
            "TTFT_BUCKETS", "LATENCY_BUCKETS", "PACKED_TOKEN_BUCKETS",
-           "SPEC_TOKEN_BUCKETS"]
+           "SPEC_TOKEN_BUCKETS", "GROUP_SIZE_BUCKETS"]
 
 # fixed Prometheus-style bucket upper bounds (seconds). Fixed — not
 # adaptive — so series stay comparable across scrapes and restarts.
@@ -40,6 +40,10 @@ PACKED_TOKEN_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
 # tokens a decode row emitted in ONE step with speculation on
 # (1 sampled + accepted drafts; 1 == nothing accepted/drafted)
 SPEC_TOKEN_BUCKETS = (1, 2, 3, 4, 6, 8, 12, 16)
+# members per prefix-sharing GROUP that actually shared pages in one
+# unified step (>= 2 by construction — singletons don't group); the
+# mean is the ~Nx of the grouped walk's HBM claim
+GROUP_SIZE_BUCKETS = (2, 3, 4, 6, 8, 12, 16, 32)
 
 
 class Histogram:
@@ -188,6 +192,14 @@ class ServingMetrics:
         self.packed_prefill_tokens = 0
         self.packed_decode_tokens = 0
         self.packed_draft_tokens = 0
+        # prefix-sharing grouped walk (the fifth A/B tag): whether the
+        # engine runs it, the modeled page-block reads the step's walk
+        # issues (CPU-reference count, one (layer, kv-head) sweep per
+        # step), and how many reads grouping saved vs the flat walk
+        # (flat - grouped; 0 with grouping off)
+        self.grouped: Optional[bool] = None
+        self.page_block_reads = 0
+        self.shared_page_reads_saved = 0
         # speculative decoding (serving/spec.py): the drafter mode tag
         # ("ngram"; None = off) — third A/B label next to
         # attn_impl/unified — plus the drafted-vs-accepted economics:
@@ -221,6 +233,9 @@ class ServingMetrics:
         # accepted-tokens-per-step number the spec A/B reports)
         self.spec_tokens_per_step = Histogram(
             buckets=SPEC_TOKEN_BUCKETS)
+        # members per sharing group per unified step (only groups that
+        # actually deduplicated >= 1 shared page read)
+        self.group_size_hist = Histogram(buckets=GROUP_SIZE_BUCKETS)
         self.queue_wait_s = Histogram()
         self.e2e_s = Histogram()
         self.queue_depth_hist = Histogram()
@@ -312,6 +327,20 @@ class ServingMetrics:
                 int(prefill_tokens) + int(decode_tokens)
                 + int(draft_tokens))
             self.decode_step_s.record(wall_s)
+
+    def on_grouped_step(self, flat_reads: int, actual_reads: int,
+                        group_sizes: Sequence[int]):
+        """One unified step's modeled page-block DMA traffic: the flat
+        (per-row) walk would issue `flat_reads`, the step actually
+        issued `actual_reads` (== flat with grouping off), and
+        `group_sizes` lists the member count of every group that
+        shared at least one page read."""
+        with self._lock:
+            self.page_block_reads += int(actual_reads)
+            self.shared_page_reads_saved += \
+                int(flat_reads) - int(actual_reads)
+            for n in group_sizes:
+                self.group_size_hist.record(int(n))
 
     def on_spec(self, drafted: int, accepted: int,
                 burst_sizes: Sequence[int]):
@@ -406,6 +435,11 @@ class ServingMetrics:
             "spec_accepted_tokens": self.spec_accepted_tokens,
             "spec_tokens_per_step":
                 self.spec_tokens_per_step.snapshot(),
+            "grouped": self.grouped,
+            "page_block_reads_total": self.page_block_reads,
+            "shared_page_reads_saved_total":
+                self.shared_page_reads_saved,
+            "group_size_per_step": self.group_size_hist.snapshot(),
             "prefill_stall_steps": self.prefill_stall_steps,
             "decode_step_s": self.decode_step_s.snapshot(),
             "tokens_per_sec": self.tokens_per_sec,
@@ -511,6 +545,9 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                        ("spec_drafted_total", "counter"),
                        ("spec_accepted_total", "counter"),
                        ("spec_tokens_per_step", "histogram"),
+                       ("page_block_reads_total", "counter"),
+                       ("shared_page_reads_saved_total", "counter"),
+                       ("group_size_per_step", "histogram"),
                        ("packed_tokens_per_step", "histogram"),
                        ("ttft_seconds", "histogram"),
                        ("inter_token_seconds", "histogram")]:
@@ -526,8 +563,19 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                 **lab, "attn_impl": snap.get("attn_impl") or "unknown",
                 "unified": ("on" if snap.get("unified") else "off"),
                 "spec": snap.get("spec") or "off",
-                "kv_dtype": snap.get("kv_dtype") or "fp"})
+                "kv_dtype": snap.get("kv_dtype") or "fp",
+                "grouped": ("on" if snap.get("grouped") else "off")})
             + " 1")
+        lines.append(f"{namespace}_page_block_reads_total"
+                     + _fmt_labels(lab)
+                     + f" {snap.get('page_block_reads_total', 0)}")
+        lines.append(
+            f"{namespace}_shared_page_reads_saved_total"
+            + _fmt_labels(lab)
+            + f" {snap.get('shared_page_reads_saved_total', 0)}")
+        if snap.get("group_size_per_step") is not None:
+            _hist_lines(f"{namespace}_group_size_per_step",
+                        snap["group_size_per_step"], lab, lines)
         lines.append(f"{namespace}_unified_steps_total"
                      + _fmt_labels(lab)
                      + f" {snap.get('unified_steps', 0)}")

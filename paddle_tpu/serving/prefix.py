@@ -72,9 +72,103 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .paging import PagePool, pages_needed
+from .paging import PagePool, TRASH_PAGE, pages_needed
 
-__all__ = ["RadixPrefixCache", "PrefixGrant", "resolve_prefix_cache_flag"]
+__all__ = ["RadixPrefixCache", "PrefixGrant",
+           "resolve_prefix_cache_flag", "shared_prefix_groups"]
+
+
+def shared_prefix_groups(page_tables, q_len):
+    """Prefix-sharing groups for one engine step (the grouped-walk
+    operands of `ragged_paged_attention_grouped`): rows whose page
+    tables carry IDENTICAL page ids for a leading span are attending
+    the same physical pages — the radix cache attached them — and the
+    kernel can stream that span once per group instead of once per
+    row.
+
+    `page_tables` is the host page table [S, max_pages] int32 (trash
+    page 0 marks unallocated entries), `q_len` [S] the step's per-row
+    live query counts (rows at q_len 0 idle this step and are never
+    grouped). Rows are partitioned by recursive refinement: all rows
+    sharing page 0, split at the first column where they diverge (a
+    mid-span COW page is private by construction, so the COW'd row
+    falls out of the group exactly at its divergence point). Returns
+    (group_id [S], group_leader [S], group_cnt [S]) int32 — row ->
+    group, group -> representative row, group -> shared page count
+    (0 for singletons; group ids are compact but arbitrary). Shared
+    pages always hold committed KV at or below every member's pos (a
+    prefix match never exceeds the prompt), which is the operand
+    contract the two-phase kernel assumes."""
+    pt = np.asarray(page_tables)
+    q_len = np.asarray(q_len)
+    S, mp = pt.shape
+    group_id = np.arange(S, dtype=np.int32)
+    group_leader = np.zeros(S, dtype=np.int32)
+    group_cnt = np.zeros(S, dtype=np.int32)
+    next_gid = [0]
+
+    def close(rows, depth):
+        g = next_gid[0]
+        next_gid[0] += 1
+        for r in rows:
+            group_id[r] = g
+        group_leader[g] = rows[0]
+        group_cnt[g] = depth if len(rows) >= 2 else 0
+
+    def best(rows, depth):
+        """Best grouping of `rows` (which share pages [0, depth)):
+        either keep them ONE group closed at this depth, or split at
+        the first divergence and group the sub-buckets deeper —
+        whichever saves more page reads ((members - 1) * shared_span
+        per group). Returns (savings, [(rows, span), ...])."""
+        if len(rows) == 1:
+            return 0, [(rows, 0)]
+        if depth >= mp:
+            return (len(rows) - 1) * depth, [(rows, depth)]
+        buckets: Dict[int, List[int]] = {}
+        for r in rows:
+            buckets.setdefault(int(pt[r, depth]), []).append(r)
+        if len(buckets) == 1:
+            page = next(iter(buckets))
+            if page != TRASH_PAGE:
+                return best(rows, depth + 1)   # still together
+            return (len(rows) - 1) * depth, [(rows, depth)]
+        keep = (len(rows) - 1) * depth         # one group, close here
+        split_sav, split_plan = 0, []
+        for page, sub in sorted(buckets.items()):
+            if page == TRASH_PAGE:
+                s, p = ((len(sub) - 1) * depth, [(sub, depth)])
+            elif len(sub) == 1:
+                s, p = 0, [(sub, 0)]
+            else:
+                s, p = best(sub, depth + 1)
+            split_sav += s
+            split_plan.extend(p)
+        if keep >= split_sav:
+            return keep, [(rows, depth)]
+        return split_sav, split_plan
+
+    live = [r for r in range(S)
+            if q_len[r] > 0 and pt[r, 0] != TRASH_PAGE]
+    buckets: Dict[int, List[int]] = {}
+    for r in live:
+        buckets.setdefault(int(pt[r, 0]), []).append(r)
+    for page, rows in sorted(buckets.items()):
+        if len(rows) == 1:
+            close(rows, 0)
+        else:
+            _, plan = best(rows, 1)
+            for sub, span in plan:
+                close(sub, span)
+    live_set = set(live)
+    for r in range(S):
+        if r not in live_set:
+            g = next_gid[0]
+            next_gid[0] += 1
+            group_id[r] = g
+            group_leader[g] = r
+            group_cnt[g] = 0
+    return group_id, group_leader, group_cnt
 
 
 def resolve_prefix_cache_flag(override=None) -> bool:

@@ -595,6 +595,32 @@ def main():
                vcodes, kscales, vscales, ptab, posv, qlen_mixv)
     note("ragged_mix_unified_int8_ms", round(t * 1e3, 3))
 
+    # (15) grouped-vs-flat walk at a HIGH-PREFIX-SHARE decode mix:
+    # every row decodes (q_len 1) and ALL rows share their first
+    # MP//2 physical pages (one group — the system-prompt shape). The
+    # flat walk streams the shared span B times per step, the grouped
+    # walk once: on HBM-bound hardware the delta approaches
+    # (B-1)/B x shared-fraction of the KV stream. On CPU both time
+    # the SAME pure-JAX reference (grouping is an HBM hint, not a
+    # math change), so the CPU delta is op overhead; run on the chip
+    # for the real number.
+    from paddle_tpu.ops.pallas.paged_attention import \
+        ragged_paged_attention_grouped
+    SHARED = MP // 2
+    ptab_sh = np.asarray(ptab).copy()
+    ptab_sh[:, :SHARED] = ptab_sh[0, :SHARED]
+    ptab_shv = jnp.asarray(ptab_sh)
+    qlen_dec = jnp.ones((B,), jnp.int32)
+    gid = jnp.zeros((B,), jnp.int32)
+    gld = jnp.zeros((B,), jnp.int32)
+    gcn = jnp.asarray([SHARED] + [0] * (B - 1), jnp.int32)
+    t = timeit(jax.jit(ragged_paged_attention), qrag[:, :1], kpool,
+               vpool, ptab_shv, posv, qlen_dec)
+    note("shared_prefix_flat_ms", round(t * 1e3, 3))
+    t = timeit(jax.jit(ragged_paged_attention_grouped), qrag[:, :1],
+               kpool, vpool, ptab_shv, posv, qlen_dec, gid, gld, gcn)
+    note("shared_prefix_grouped_ms", round(t * 1e3, 3))
+
     # roofline bookkeeping
     wbytes = sum(int(np.prod(w.shape)) for w in Wqkv + Wout + W1 + W2) * 2
     ebytes = int(np.prod(E.shape)) * 2

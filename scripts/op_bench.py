@@ -185,8 +185,80 @@ def _cases():
                  [100, 96, 88, 75, 40, 16, 9, 64], np.int32)),
              paddle.to_tensor(np.asarray(
                  [5, 5, 5, 5, 1, 1, 5, 3], np.int32)))),
+        # prefix-sharing-aware GROUPED walk over the same pools: the
+        # first four decode rows share a 4-page physical prefix (one
+        # group — the system-prompt shape), the rest walk privately.
+        # On the chip the shared pages stream once per group; on CPU
+        # this times the reference — the entry exists so the grouped
+        # op keeps a tracked perf number next to the flat ragged one.
+        "ragged_paged_attention_grouped": lambda: (
+            lambda q, kp, vp, pt, pos, ql, gid, gld, gcn: apply_op(
+                "ragged_paged_attention_grouped", q, kp, vp, pt, pos,
+                ql, gid, gld, gcn),
+            (t(8, 16, 8, 64), t(65, 16, 8, 64), t(65, 16, 8, 64),
+             paddle.to_tensor(_grouped_page_table()),
+             paddle.to_tensor(np.asarray(
+                 [100, 96, 88, 100, 40, 16, 0, 64], np.int32)),
+             paddle.to_tensor(np.asarray(
+                 [1, 1, 1, 1, 16, 16, 8, 3], np.int32)),
+             paddle.to_tensor(np.asarray(
+                 [0, 0, 0, 0, 1, 2, 3, 4], np.int32)),
+             paddle.to_tensor(np.asarray(
+                 [0, 4, 5, 6, 7, 0, 0, 0], np.int32)),
+             paddle.to_tensor(np.asarray(
+                 [4, 0, 0, 0, 0, 0, 0, 0], np.int32)))),
+        # ...and its int8 lane: code + rowwise scale pages chase the
+        # same grouped stream (the quantized shared-prefix hot path)
+        "ragged_paged_attention_grouped_q8": lambda: (
+            lambda q, kp, vp, ks, vs, pt, pos, ql, gid, gld, gcn:
+            apply_op(
+                "ragged_paged_attention_grouped_q8", q, kp, vp, ks,
+                vs, pt, pos, ql, gid, gld, gcn),
+            (t(8, 16, 8, 64),
+             paddle.to_tensor((np.random.RandomState(17)
+                               .randint(-127, 128, size=(65, 16, 8,
+                                                         64)))
+                              .astype(np.int8)),
+             paddle.to_tensor((np.random.RandomState(18)
+                               .randint(-127, 128, size=(65, 16, 8,
+                                                         64)))
+                              .astype(np.int8)),
+             paddle.to_tensor(np.abs(np.random.RandomState(19)
+                                     .randn(65, 16, 8))
+                              .astype(np.float32) / 127.0),
+             paddle.to_tensor(np.abs(np.random.RandomState(20)
+                                     .randn(65, 16, 8))
+                              .astype(np.float32) / 127.0),
+             paddle.to_tensor(_grouped_page_table()),
+             paddle.to_tensor(np.asarray(
+                 [100, 96, 88, 100, 40, 16, 0, 64], np.int32)),
+             paddle.to_tensor(np.asarray(
+                 [1, 1, 1, 1, 16, 16, 8, 3], np.int32)),
+             paddle.to_tensor(np.asarray(
+                 [0, 0, 0, 0, 1, 2, 3, 4], np.int32)),
+             paddle.to_tensor(np.asarray(
+                 [0, 4, 5, 6, 7, 0, 0, 0], np.int32)),
+             paddle.to_tensor(np.asarray(
+                 [4, 0, 0, 0, 0, 0, 0, 0], np.int32)))),
     }
     return cases
+
+
+def _grouped_page_table():
+    """Page table for the grouped op-bench entries: rows 0-3 share a
+    4-page physical prefix (one group), every row owns a private
+    tail — the operand contract of the grouped walk."""
+    pt = np.zeros((8, 8), np.int32)
+    nxt = 5
+    for r in range(8):
+        start = 0
+        if r < 4:
+            pt[r, :4] = [1, 2, 3, 4]
+            start = 4
+        for i in range(start, 8):
+            pt[r, i] = nxt
+            nxt += 1
+    return pt
 
 
 def _sync(v):

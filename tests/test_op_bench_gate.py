@@ -129,3 +129,25 @@ def test_ragged_verify_shape_is_benched():
     assert 1 in ql and max(ql) > 1
     out = fn(*args)
     assert tuple(out.shape) == (8, 16, 8, 64)
+
+
+def test_grouped_walk_is_benched():
+    """The prefix-sharing-aware grouped walk (+ its q8 lane) must
+    keep tracked perf numbers next to the flat ragged entries: under
+    high prefix share every serving step runs this shape, and the
+    once-per-group HBM claim dies silently without a number."""
+    import numpy as np
+    cases = _op_bench_cases()
+    for name in ("ragged_paged_attention_grouped",
+                 "ragged_paged_attention_grouped_q8"):
+        assert name in cases, name
+        fn, args = cases[name]()
+        # the page tables really share a physical prefix (one group
+        # of 4 rows over 4 pages — the operand contract)
+        pt = args[5 if name.endswith("q8") else 3].numpy()
+        assert (pt[:4, :4] == pt[0, :4]).all()
+        assert len(set(pt[:, 4:].ravel().tolist())) > 8  # private tails
+        gcnt = args[-1].numpy()
+        assert gcnt[0] == 4 and (gcnt[1:] == 0).all()
+        out = fn(*args)
+        assert tuple(out.shape) == (8, 16, 8, 64)

@@ -923,6 +923,13 @@ ELSEWHERE = {
     # (tests/test_serving_unified.py)
     "ragged_paged_attention": EW("test_paged_attention.py",
                                  "ragged_paged_attention|Ragged"),
+    # prefix-sharing-aware grouped walk (+ its q8 lane) — interpret-
+    # mode kernel vs reference AND bit-identity vs the ungrouped
+    # kernel, group-computation edge cases, engine on/off token
+    # identity under COW/eviction (tests/test_grouped_attention.py)
+    **{n: EW("test_grouped_attention.py", "grouped|Grouped") for n in [
+        "ragged_paged_attention_grouped",
+        "ragged_paged_attention_grouped_q8"]},
     # rotary embedding — tests/test_nlp_models.py (Llama family)
     "rope": EW("test_nlp_models.py", "Llama|rope"),
     "rope_dyn": EW("test_nlp_models.py", "Llama|rope"),

@@ -657,7 +657,7 @@ def test_serving_bench_smoke_writes_stable_schema(tmp_path,
     with open(out) as f:
         report = json.load(f)
     assert report["bench"] == "serving"
-    assert report["schema_version"] == 9
+    assert report["schema_version"] == 10
     for key in ("tokens_per_sec", "ttft_p50_s", "ttft_p99_s",
                 "pool_utilization_mean", "pool_utilization_max",
                 "prefill_chunks", "page_size", "num_pages",
@@ -706,6 +706,17 @@ def test_serving_bench_prefix_share_smoke(tmp_path, monkeypatch):
         < off["prefill_chunks_per_request"]
     assert on["hit_rate"] > 0 and on["cached_tokens"] > 0
     assert off["cached_tokens"] == 0
+    # the grouped-vs-flat attention A/B rides the same trace: tokens
+    # bit-identical across the gate, the grouped arm's modeled
+    # page-block reads per step strictly below the flat arm's, and
+    # real groups formed (mean member count > 1)
+    gr = report["grouped"]
+    assert gr["token_identical"] is True
+    assert gr["on"]["page_block_reads_per_step"] \
+        < gr["off"]["page_block_reads_per_step"]
+    assert gr["on"]["shared_page_reads_saved_total"] > 0
+    assert gr["off"]["shared_page_reads_saved_total"] == 0
+    assert gr["on"]["group_size_mean"] > 1.0
 
 
 @pytest.mark.slow
