@@ -21,8 +21,29 @@ from enum import Enum
 from typing import Callable, Optional
 
 __all__ = ["Profiler", "RecordEvent", "ProfilerState", "ProfilerTarget",
-           "make_scheduler", "export_chrome_tracing", "SortedKeys",
-           "SummaryView"]
+           "make_scheduler", "export_chrome_tracing", "chrome_trace",
+           "SortedKeys", "SummaryView"]
+
+
+def chrome_trace(events, pid: int = None) -> dict:
+    """THE chrome-tracing writer: `(name, tid, t0_ns, t1_ns)` span
+    tuples -> the Chrome-trace JSON dict (openable in Perfetto /
+    chrome://tracing; reference: chrometracing_logger.cc). Shared by
+    `Profiler.export` (host op/RecordEvent spans) and the serving
+    observability layer (request-lifecycle timelines,
+    serving/obs.py), so both render into the same trace format and
+    one Perfetto window can show them side by side. Timestamps are
+    ns; the earliest t0 becomes the trace origin."""
+    base = min((e[2] for e in events), default=0)
+    return {
+        "traceEvents": [
+            {"name": name, "ph": "X", "cat": "host",
+             "ts": (t0 - base) / 1e3, "dur": (t1 - t0) / 1e3,
+             "pid": os.getpid() if pid is None else pid, "tid": tid}
+            for name, tid, t0, t1 in events
+        ],
+        "displayTimeUnit": "ms",
+    }
 
 
 class ProfilerState(Enum):
@@ -301,16 +322,7 @@ class Profiler:
         # inside on_trace_ready: the current window; after stop(): all
         # flushed windows
         events = self._events or self._all_events
-        base = min((e[2] for e in events), default=0)
-        trace = {
-            "traceEvents": [
-                {"name": name, "ph": "X", "cat": "host",
-                 "ts": (t0 - base) / 1e3, "dur": (t1 - t0) / 1e3,
-                 "pid": os.getpid(), "tid": tid}
-                for name, tid, t0, t1 in events
-            ],
-            "displayTimeUnit": "ms",
-        }
+        trace = chrome_trace(events)
         if self._device_trace_dir:
             trace["otherData"] = {
                 "xla_device_trace_dir": self._device_trace_dir}

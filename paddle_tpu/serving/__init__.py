@@ -68,6 +68,10 @@ from .faults import (FaultInjector, InjectedFault,  # noqa: F401
                      resolve_faults)
 from .metrics import (Histogram, ServingMetrics,  # noqa: F401
                       prometheus_render)
+from .obs import (EngineObs, FlightRecorder,  # noqa: F401
+                  RequestTracer, resolve_debug_flag,
+                  resolve_flight_steps, resolve_obs_flag,
+                  timeline_to_chrome)
 from .paging import (HostPagePool, PagePool, chunk_bucket,  # noqa: F401
                      pages_needed)
 from .prefix import (PrefixGrant, RadixPrefixCache,  # noqa: F401
@@ -90,4 +94,7 @@ __all__ = ["ServingEngine", "resolve_unified_flag",
            "QueueFull", "EngineClosed", "RateLimited",
            "PoisonedRequest", "DeadlineExceeded", "FaultInjector",
            "InjectedFault", "resolve_faults", "Drafter",
-           "NgramDrafter", "SpecConfig", "resolve_spec_config"]
+           "NgramDrafter", "SpecConfig", "resolve_spec_config",
+           "EngineObs", "FlightRecorder", "RequestTracer",
+           "resolve_obs_flag", "resolve_debug_flag",
+           "resolve_flight_steps", "timeline_to_chrome"]

@@ -164,6 +164,7 @@ from ..nlp.generation import (_pack_caches, _top_p_filter,
 from ..ops.pallas.paged_attention import count_page_block_reads
 from .errors import DeadlineExceeded, EngineClosed, PoisonedRequest
 from .metrics import ServingMetrics
+from .obs import EngineObs, resolve_obs_flag
 from .paging import (HostPagePool, PagePool, TRASH_PAGE, chunk_bucket,
                      pages_needed)
 from .prefix import (RadixPrefixCache, resolve_prefix_cache_flag,
@@ -174,7 +175,14 @@ from .spec import Drafter, resolve_spec_config
 
 __all__ = ["ServingEngine", "resolve_unified_flag",
            "resolve_preempt_flag", "resolve_kv_dtype",
-           "resolve_grouped_flag"]
+           "resolve_grouped_flag", "resolve_obs_flag"]
+
+# finish reason -> timeline event kind (the 5xx/4xx taxonomy keeps
+# its own event names so a timeline's last event says WHY at a
+# glance; everything else rides its raw reason)
+_TERMINAL_EVENT = {"stop": "finish", "length": "finish",
+                   "deadline": "deadline", "poisoned": "poison",
+                   "replica_failure": "replica_death"}
 
 UNIFIED_STEP_MODES = ("on", "off")
 PREEMPT_MODES = ("on", "off")
@@ -328,7 +336,8 @@ class ServingEngine:
                  prefix_cache=None, unified=None,
                  token_budget: Optional[int] = None, spec=None,
                  preempt=None, host_pages: Optional[int] = None,
-                 kv_dtype: Optional[str] = None, grouped=None):
+                 kv_dtype: Optional[str] = None, grouped=None,
+                 obs=None, flight_steps: Optional[int] = None):
         if cache_spec is None:
             if not hasattr(model, "_decode_cache_spec"):
                 raise ValueError(
@@ -552,9 +561,32 @@ class ServingEngine:
         # a hook that raises deterministically for one request id IS a
         # poisoned request. None (the default) costs nothing.
         self.step_fault_hook = None
+        # observability (serving/obs.py, default on, gated
+        # ServingEngine(obs=...) / PADDLE_TPU_OBS): request-lifecycle
+        # tracer + per-step flight recorder, fed at the same call
+        # sites as ServingMetrics. Pure host bookkeeping — no
+        # compiled program changes, obs-on/off is token-identical
+        # (serving_bench --obs-ab pins the cost within noise).
+        self.obs = (EngineObs(flight_steps=flight_steps,
+                              clock=self._clock)
+                    if resolve_obs_flag(obs) else None)
+        # engine step counter (timeline/flight step index) + the
+        # running round's token-split stats the flight record reads
+        self._step_idx = 0
+        self._round_stats = {"prefill_tokens": 0, "decode_tokens": 0,
+                             "draft_tokens": 0, "accepted_tokens": 0,
+                             "reads_saved": 0, "wall_s": 0.0}
         # shutdown latch: flipped by drain()/abort_all(); add_request
         # raises EngineClosed once set
         self._closed = False
+
+    def _obs_event(self, req: "Request", kind: str, **detail):
+        """Record one request-timeline event (no-op with obs off)."""
+        if self.obs is not None:
+            detail.setdefault("slot", req.slot)
+            self.obs.tracer.record(req.request_id, kind,
+                                   t=self._clock(),
+                                   step=self._step_idx, **detail)
 
     # -- compiled programs -------------------------------------------------
     def _swap_state(self, state_vals):
@@ -908,6 +940,9 @@ class ServingEngine:
         self.scheduler.submit(req)     # may shed load (max_queue)
         self._requests[request_id] = req
         self.metrics.on_submit(req)
+        self._obs_event(req, "submit", prompt_len=int(prompt.size),
+                        priority=int(sampling.priority),
+                        queue_depth=self.scheduler.queue_depth)
         return req
 
     def cancel(self, request_id: str) -> bool:
@@ -919,9 +954,10 @@ class ServingEngine:
             return False
         if req.state in (RequestState.QUEUED, RequestState.PREEMPTED):
             self.scheduler.drop_queued(req)
-            self._release_swap(req)      # host-tier KV, if preempted
-            req._finish("cancelled", self._clock())
-            self.metrics.on_finish(req, self._clock())
+            # the shared terminal path: releases host-tier KV, retires
+            # the id, closes any span — a queued cancel used to leave
+            # its _requests entry behind, permanently blocking id reuse
+            self._finish_and_free(req, "cancelled", self._clock(), [])
             return True
         req.state = RequestState.CANCELLED
         return True
@@ -940,8 +976,27 @@ class ServingEngine:
         return self._pt_full, self._pt_decode
 
     # -- step boundary: retire / admit / prefill / decode ------------------
+    def _finalize_request(self, req: Request, *, keep_id: bool = False):
+        """The ONE host-side cleanup every path that takes a request
+        off a slot/queue must run: drop its prefill cursor and
+        drafter, close its profiler span (replica-death and
+        quarantine paths used to leak spans that were opened at
+        admission and never end()ed), and retire its id from
+        `_requests` unless it stays live (`keep_id=True` — the
+        preemption path: a preempted request resumes under the same
+        id and must keep its duplicate-id guard)."""
+        self._prefill_cursor.pop(req.request_id, None)
+        self._drafters.pop(req.request_id, None)
+        span = self._spans.pop(req.request_id, None)
+        if span is not None:
+            span.end()
+        if not keep_id:
+            self._requests.pop(req.request_id, None)
+
     def _finish_and_free(self, req: Request, reason: str, now: float,
                          finished: List[RequestOutput]):
+        self._obs_event(req, _TERMINAL_EVENT.get(reason, reason),
+                        cause=reason, tokens=len(req.output_tokens))
         if req.slot is not None:
             slot = req.slot
             self.scheduler.retire(slot)
@@ -955,18 +1010,13 @@ class ServingEngine:
             self._pt_host[slot, :] = TRASH_PAGE
             self._pt_dirty = True
         self._release_swap(req)   # preempted-and-never-resumed cleanup
-        self._prefill_cursor.pop(req.request_id, None)
-        self._drafters.pop(req.request_id, None)
         # retire the id: duplicate detection guards LIVE requests only,
         # and a router re-placing a migrated request may legitimately
         # reuse its id on this engine later (also caps _requests growth
         # over a long-running server's lifetime)
-        self._requests.pop(req.request_id, None)
+        self._finalize_request(req)
         req._finish(reason, now)
         self.metrics.on_finish(req, now)
-        span = self._spans.pop(req.request_id, None)
-        if span is not None:
-            span.end()
         finished.append(req.output())
 
     def _retire_pages(self, req: Request, reason: str,
@@ -1001,6 +1051,12 @@ class ServingEngine:
                 f"request {req.request_id} missed its placement "
                 f"deadline ({req.sampling.deadline_s}s) while queued")
             self._finish_and_free(req, "deadline", now, finished)
+            if self.obs is not None:
+                # 504 fail-fast: freeze the ring so the postmortem
+                # shows what the engine was doing while it starved
+                self.obs.flight.incident(
+                    "deadline", detail=req.request_id,
+                    step=self._step_idx)
         for req in self.scheduler.expired(now):
             if req.state in (RequestState.QUEUED,
                              RequestState.PREEMPTED):
@@ -1160,11 +1216,8 @@ class ServingEngine:
             kv_len = int(req.prompt_ids.size) + len(req.output_tokens)
         else:
             kv_len = int(self._prefill_cursor.get(req.request_id, 0))
-        self._prefill_cursor.pop(req.request_id, None)
-        self._drafters.pop(req.request_id, None)
-        span = self._spans.pop(req.request_id, None)
-        if span is not None:
-            span.end()
+        # keep_id: the preempted request is still live under its id
+        self._finalize_request(req, keep_id=True)
         grant = req._prefix_grant
         base = grant.matched_full_pages if grant is not None else 0
         shared, private = pages[:base], pages[base:]
@@ -1195,6 +1248,9 @@ class ServingEngine:
         req.preemptions += 1
         self.scheduler.requeue(req)
         self.metrics.on_preempt(len(kept))
+        self._obs_event(req, "preempt", slot=slot, cause="overload",
+                        pages=len(kept), kv_len=kv_len,
+                        tokens=len(req.output_tokens))
 
     def _preempt_for_overload(self, now: float):
         """The overload policy: after admission, a still-queued head
@@ -1228,10 +1284,15 @@ class ServingEngine:
             self._pt_host[slot, :] = TRASH_PAGE
             self._pt_host[slot, :len(req.pages)] = req.pages
             self._pt_dirty = True
+            self._obs_event(req, "admit", pages=len(req.pages or ()),
+                            cached_tokens=int(req.cached_tokens),
+                            resumed=req._swap is not None)
             # preemption resume: swap the banked KV pages back in from
             # the host tier before any prefill touches the slot
             if req._swap is not None:
+                n_restore = len(req._swap.restores)
                 self._apply_swap_in(req)
+                self._obs_event(req, "swap_in", pages=n_restore)
             # the slot's write position starts at the first uncached
             # token (0 on a prefix miss): the unified step reads it as
             # the row's pos; the old path's prefill program passes the
@@ -1292,6 +1353,7 @@ class ServingEngine:
                 self._active[slot] = True
                 self._vec_dirty = True
                 self._pt_dirty = True    # row goes live for decode
+                self._obs_event(req, "decode")
         return chunks
 
     def _prefill_chunk(self, slot: int, req: Request):
@@ -1320,6 +1382,9 @@ class ServingEngine:
         self._beat()
         self._prefill_cursor[req.request_id] = cursor + real
         self.metrics.on_prefill_chunk(real)
+        self._round_stats["prefill_tokens"] += real
+        self._obs_event(req, "prefill_chunk", tokens=real,
+                        cursor=cursor + real)
 
     def _refresh_vectors(self):
         for s in range(self.num_slots):
@@ -1385,7 +1450,10 @@ class ServingEngine:
             # wall time of the synchronized step (the attn_impl A/B
             # metric); real perf_counter regardless of an injected
             # test clock
-            self.metrics.on_decode_step(time.perf_counter() - t0)
+            wall = time.perf_counter() - t0
+            self.metrics.on_decode_step(wall)
+            self._round_stats["decode_tokens"] += int(self._active.sum())
+            self._round_stats["wall_s"] += wall
             now = now_fn()
             for slot, req in list(self.scheduler.running.items()):
                 if req.state is not RequestState.DECODE \
@@ -1396,7 +1464,10 @@ class ServingEngine:
                 req._emit(tok, now)
                 self.metrics.on_token(req, now)
                 if prev_t is not None:
-                    self.metrics.on_inter_token(now - prev_t)
+                    self.metrics.on_inter_token(
+                        now - prev_t, priority=req.sampling.priority)
+                elif self.obs is not None:
+                    self._obs_event(req, "first_token")
                 sp = req.sampling
                 if sp.eos_token_id is not None \
                         and tok == sp.eos_token_id:
@@ -1531,6 +1602,8 @@ class ServingEngine:
                                        page_size=self.page_size)
         self.metrics.on_grouped_step(flat_reads, step_reads,
                                      group_sizes)
+        self._round_stats["reads_saved"] += \
+            int(flat_reads) - int(step_reads)
         key = random_mod.next_key_host()
         # beat the watchdog heartbeat around the compiled launch and
         # expose the packed size: a legitimately huge packed step gets
@@ -1553,9 +1626,14 @@ class ServingEngine:
         self._beat()
         n_prefill = int(sum(grants.values()))
         n_drafts = int(sum(draft_grants.values()))
+        wall = time.perf_counter() - t0
         self.metrics.on_unified_step(n_prefill, len(decode_slots),
-                                     time.perf_counter() - t0,
-                                     draft_tokens=n_drafts)
+                                     wall, draft_tokens=n_drafts)
+        rs = self._round_stats
+        rs["prefill_tokens"] += n_prefill
+        rs["decode_tokens"] += len(decode_slots)
+        rs["draft_tokens"] += n_drafts
+        rs["wall_s"] += wall
         now = self._clock()
         # prefill bookkeeping: advance cursors, flip finished rows to
         # DECODE (their last real token's logits are now held — they
@@ -1565,12 +1643,15 @@ class ServingEngine:
             cur = self._prefill_cursor[req.request_id] + take
             self._prefill_cursor[req.request_id] = cur
             self.metrics.on_prefill_chunk(take)
+            self._obs_event(req, "prefill_chunk", tokens=take,
+                            cursor=cur)
             if cur >= req.prefill_ids.size:
                 self._prefill_cursor.pop(req.request_id, None)
                 req.state = RequestState.DECODE
                 self._active[slot] = True
                 self._vec_dirty = True
                 self._pt_dirty = True
+                self._obs_event(req, "decode")
         # decode emission: the old decode step's retirement, token by
         # token over the verified burst — EOS or the token budget can
         # end the request mid-burst, and the sequential semantics
@@ -1608,7 +1689,10 @@ class ServingEngine:
             if prev_t is not None and emitted:
                 dt = (now - prev_t) / emitted
                 for _ in range(emitted):
-                    self.metrics.on_inter_token(dt)
+                    self.metrics.on_inter_token(
+                        dt, priority=sp.priority)
+            elif emitted and self.obs is not None:
+                self._obs_event(req, "first_token")
             if m:
                 acc_emitted = max(0, emitted - 1)
                 spec_drafted += m
@@ -1621,6 +1705,7 @@ class ServingEngine:
         if spec_burst_sizes:
             self.metrics.on_spec(spec_drafted, spec_accepted,
                                  spec_burst_sizes)
+            self._round_stats["accepted_tokens"] += spec_accepted
         return n_prefill
 
     def _run_round(self, finished: List[RequestOutput],
@@ -1700,6 +1785,10 @@ class ServingEngine:
         (replica death). Returns requests that finished this round."""
         finished: List[RequestOutput] = []
         self._beat()
+        self._step_idx += 1
+        self._round_stats = {"prefill_tokens": 0, "decode_tokens": 0,
+                             "draft_tokens": 0, "accepted_tokens": 0,
+                             "reads_saved": 0, "wall_s": 0.0}
         now = self._clock()
         self._evict(now, finished)
         self._admit(now)
@@ -1707,9 +1796,24 @@ class ServingEngine:
         chunks = 0
         try:
             chunks = self._run_round(finished)
-        except Exception:
+        except Exception as exc:
+            # the black box freezes BEFORE recovery runs: whatever
+            # quarantine decides, the postmortem keeps the steps that
+            # led here
+            if self.obs is not None:
+                self.obs.flight.incident("step_fault",
+                                         detail=repr(exc),
+                                         step=self._step_idx)
             if not self._quarantine_poison(finished):
+                if self.obs is not None:
+                    self.obs.flight.incident("replica_death",
+                                             detail=repr(exc),
+                                             step=self._step_idx)
                 raise
+            if self.obs is not None:
+                self.obs.flight.incident("poison_quarantine",
+                                         detail=repr(exc),
+                                         step=self._step_idx)
         self.metrics.on_step(self.scheduler.queue_depth,
                              self.scheduler.occupancy, self.num_slots,
                              pages_used=self.pool.used_pages,
@@ -1723,6 +1827,26 @@ class ServingEngine:
                                  self.prefix_cache.stats()
                                  if self.prefix_cache is not None
                                  else None))
+        if self.obs is not None:
+            rs = self._round_stats
+            self.obs.flight.on_step({
+                "step": self._step_idx, "t": self._clock(),
+                "queue_depth": self.scheduler.queue_depth,
+                "residents": len(self.scheduler.running),
+                "slots": [[s, r.request_id, r.state.name]
+                          for s, r in
+                          sorted(self.scheduler.running.items())],
+                "prefill_tokens": rs["prefill_tokens"],
+                "decode_tokens": rs["decode_tokens"],
+                "draft_tokens": rs["draft_tokens"],
+                "accepted_tokens": rs["accepted_tokens"],
+                "reads_saved": rs["reads_saved"],
+                "pages_used": self.pool.used_pages,
+                "pages_total": self.num_pages - 1,
+                "pages_cached": self.pool.cached_pages,
+                "pages_swapped": self.pool.swapped_pages,
+                "host_pages_used": self.host_pool.used_pages,
+                "step_wall_ms": round(rs["wall_s"] * 1e3, 4)})
         return finished
 
     # -- shutdown ----------------------------------------------------------
@@ -1763,13 +1887,71 @@ class ServingEngine:
         self._closed = True
         finished: List[RequestOutput] = []
         now = self._clock()
-        for req in self.scheduler.pop_queued():
-            self._finish_and_free(req, reason, now, finished)
-        for slot in sorted(list(self.scheduler.running)):
-            self._finish_and_free(self.scheduler.running[slot], reason,
-                                  now, finished)
+        try:
+            for req in self.scheduler.pop_queued():
+                self._finish_and_free(req, reason, now, finished)
+            for slot in sorted(list(self.scheduler.running)):
+                self._finish_and_free(self.scheduler.running[slot],
+                                      reason, now, finished)
+        finally:
+            # replica-death hardening: a teardown that raises midway
+            # (a torn pool after a mid-step fault) must still close
+            # every open profiler span — the driver's _do_die swallows
+            # the raise, so this finally is the only place left
+            for span in self._spans.values():
+                span.end()
+            self._spans.clear()
         self.pool.assert_quiesced()
         return finished
+
+    # -- debug introspection ----------------------------------------------
+    def debug_state(self) -> dict:
+        """Host-side live-state snapshot for `GET /debug/state`:
+        residents, queue summary, pools, prefix-cache summary, the
+        engine's A/B flags. Pure dict reads — safe to call from a
+        scrape thread while the pump steps (the HTTP layer retries
+        the rare torn read); never touches device state."""
+        sched = self.scheduler
+        residents = []
+        for slot, req in sorted(sched.running.items()):
+            residents.append({
+                "slot": slot, "request_id": req.request_id,
+                "state": req.state.name,
+                "prompt_len": int(req.prompt_ids.size),
+                "emitted": len(req.output_tokens),
+                "pages": len(self._slot_pages.get(slot) or ()),
+                "cached_tokens": int(req.cached_tokens),
+                "priority": int(req.sampling.priority)})
+        return {
+            "closed": self._closed,
+            "step": self._step_idx,
+            "num_slots": self.num_slots,
+            "residents": residents,
+            "queue": sched.queue_summary(),
+            "pool": {"pages_total": self.num_pages - 1,
+                     "pages_used": self.pool.used_pages,
+                     "pages_cached": self.pool.cached_pages,
+                     "pages_swapped": self.pool.swapped_pages,
+                     "pages_free": self.pool.free_pages,
+                     "bytes_per_page": self.page_bytes},
+            "host_pool": {"pages_used": self.host_pool.used_pages,
+                          "pages_total": self.host_pages},
+            "prefix_cache": (None if self.prefix_cache is None
+                             else self.prefix_cache.stats()),
+            "config": {"unified": self.unified,
+                       "grouped": self.grouped,
+                       "attn_impl": self.attn_impl,
+                       "kv_dtype": self.kv_dtype,
+                       "preempt": self.preempt,
+                       "spec": (None if self.spec is None
+                                else self.spec.mode),
+                       "num_pages": self.num_pages,
+                       "page_size": self.page_size,
+                       "chunk_len": self.chunk_len,
+                       "max_len": self.max_len,
+                       "token_budget": self.token_budget},
+            "obs": None if self.obs is None else self.obs.stats(),
+        }
 
     # -- conveniences ------------------------------------------------------
     @property

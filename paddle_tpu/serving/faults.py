@@ -101,6 +101,27 @@ class FaultInjector:
         self.add_fails_fired = 0
         self.poison_hits = 0
         self.spikes_fired = 0
+        # fired-fault listeners (serving/obs.py): each fn(kind,
+        # replica, detail) is called — outside the lock, exceptions
+        # swallowed — whenever a scheduled fault actually fires, so a
+        # replica's flight recorder shows the injected fault IN the
+        # step stream a postmortem reads
+        self._listeners: List = []
+
+    def subscribe(self, fn) -> "FaultInjector":
+        """Register fn(kind, replica, detail) to be told when any
+        fault fires (EngineDriver subscribes the replica's flight
+        recorder)."""
+        with self._lock:
+            self._listeners.append(fn)
+        return self
+
+    def _notify(self, kind: str, replica: str, detail: str):
+        for fn in list(self._listeners):
+            try:
+                fn(kind, replica, detail)
+            except Exception:
+                pass            # a broken listener must not mask the fault
 
     # -- scheduling --------------------------------------------------------
     def kill_at_step(self, replica: str, step: int) -> "FaultInjector":
@@ -144,6 +165,9 @@ class FaultInjector:
             due = self._pop_due(self._spikes, replica, step)
             if due is not None:
                 self.spikes_fired += 1
+        if due is not None:
+            self._notify("spike", replica,
+                         f"{due[1]} junk requests at step {step}")
         return 0 if due is None else due[1]
 
     def fail_add_request(self, k: int,
@@ -228,8 +252,11 @@ class FaultInjector:
             if kill is not None:
                 self.kills_fired += 1
         if hang is not None:
+            self._notify("hang", replica,
+                         f"step {step} hangs {hang[1]}s")
             self._unhang.wait(hang[1])
         if kill is not None:
+            self._notify("kill", replica, f"pump raises at step {step}")
             raise InjectedFault(
                 f"injected kill of {replica} at step {step}",
                 kind="kill")
@@ -247,6 +274,8 @@ class FaultInjector:
             if fire:
                 self.add_fails_fired += 1
         if fire:
+            self._notify("add_request", replica,
+                         f"admission of {request_id!r} fails")
             raise InjectedFault(
                 f"injected add_request failure on {replica}",
                 kind="add_request", request_id=request_id)
@@ -261,6 +290,8 @@ class FaultInjector:
             if hit is not None:
                 self.poison_hits += 1
         if hit is not None:
+            self._notify("poison", replica,
+                         f"request {hit} kills the step")
             raise InjectedFault(
                 f"injected poison: request {hit} kills the step on "
                 f"{replica}", kind="poison", request_id=hit)

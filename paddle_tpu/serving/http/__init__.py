@@ -48,7 +48,8 @@ def serve(engines: Sequence, host: str = "127.0.0.1", port: int = 0,
           watchdog_timeout_s: Optional[float] = None,
           breaker_failures: int = 3,
           breaker_open_s: float = 1.0,
-          faults: Optional[FaultInjector] = None) -> ServingHTTPServer:
+          faults: Optional[FaultInjector] = None,
+          debug_endpoints=None) -> ServingHTTPServer:
     """One-call assembly: wrap each engine in a driver, front them with
     a router, start the HTTP server on (host, port) — port 0 picks a
     free one (see `server.url`). `rate_limit`/`rate_limit_burst` turn
@@ -59,8 +60,11 @@ def serve(engines: Sequence, host: str = "127.0.0.1", port: int = 0,
     including first-use compilation). `faults` injects a deterministic
     fault schedule (serving/faults.py) — when omitted, the
     PADDLE_TPU_FAULTS env spec is parsed (unset = no injection).
-    Returns the STARTED server; call `drain()` (or
-    `install_signal_handlers()` for SIGTERM) to stop."""
+    `debug_endpoints=True` (or PADDLE_TPU_DEBUG=on) exposes the
+    `/debug/state`, `/debug/requests/<id>` and `/debug/flight`
+    introspection routes (serving/obs.py) — off by default, they
+    carry prompt metadata. Returns the STARTED server; call `drain()`
+    (or `install_signal_handlers()` for SIGTERM) to stop."""
     if faults is None:
         faults = resolve_faults()
     drivers = [EngineDriver(e, name=f"replica-{i}", faults=faults)
@@ -75,5 +79,6 @@ def serve(engines: Sequence, host: str = "127.0.0.1", port: int = 0,
                                model_name=model_name,
                                poll_interval_s=poll_interval_s,
                                rate_limit=rate_limit,
-                               rate_limit_burst=rate_limit_burst)
+                               rate_limit_burst=rate_limit_burst,
+                               debug_endpoints=debug_endpoints)
     return server.start()

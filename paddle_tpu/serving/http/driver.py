@@ -115,6 +115,11 @@ class EngineDriver:
             engine.step_fault_hook = (
                 lambda ids, _f=faults, _n=name: _f.on_engine_step(_n,
                                                                   ids))
+            # flight-recorder note: a fault that FIRES on this replica
+            # lands in its step stream, so the postmortem dump shows
+            # the injected kill/hang/poison in context
+            if hasattr(faults, "subscribe"):
+                faults.subscribe(self._on_fault_fired)
         self._thread = threading.Thread(target=self._pump,
                                         name=f"engine-driver[{name}]",
                                         daemon=True)
@@ -132,6 +137,13 @@ class EngineDriver:
 
     def _on_beat(self):
         self.last_beat = time.monotonic()
+
+    def _on_fault_fired(self, kind: str, replica: str, detail):
+        if replica != self.name:
+            return
+        obs = getattr(self.engine, "obs", None)
+        if obs is not None:
+            obs.flight.note(f"fault:{kind}", detail)
 
     @property
     def watchdog_grace_s(self) -> float:
@@ -357,6 +369,14 @@ class EngineDriver:
                 return
             self.death_exc = exc
             self._dead = True
+        # freeze the flight recorder FIRST: the ring's last N steps
+        # are the postmortem; abort_all below only adds teardown
+        obs = getattr(self.engine, "obs", None)
+        if obs is not None:
+            try:
+                obs.flight.incident("replica_death", detail=repr(exc))
+            except Exception:
+                pass
         self._fail_pending(ReplicaDead(f"{self.name} died: {exc!r}"))
         try:
             self.engine.abort_all("replica_failure")

@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import json
 import math
+import re
 from dataclasses import dataclass
 from typing import Optional
 
@@ -76,6 +77,16 @@ class CompletionRequest:
     prompt_ids: np.ndarray
     sampling: SamplingParams
     stream: bool
+    # optional CLIENT-named ticket id (observability: the id is the
+    # engine request id on every replica, so a client that names its
+    # request can pull `GET /debug/requests/<id>` afterwards without
+    # parsing the response first); None = server-assigned `cmpl-N`
+    request_id: Optional[str] = None
+
+
+# client-supplied request ids: URL-safe, bounded (they ride in debug
+# paths and Prometheus-adjacent surfaces — no exotic bytes)
+_REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9_.:-]{1,128}$")
 
 
 def _get(payload: dict, key: str, types, default=None):
@@ -111,6 +122,10 @@ def parse_completion_request(raw: bytes) -> CompletionRequest:
     priority = _get(payload, "priority", int, 0)
     deadline = _get(payload, "deadline", (int, float))
     stream = bool(_get(payload, "stream", bool, False))
+    request_id = _get(payload, "request_id", str)
+    if request_id is not None and not _REQUEST_ID_RE.match(request_id):
+        raise ProtocolError(
+            400, "\"request_id\" must match [A-Za-z0-9_.:-]{1,128}")
     if timeout is not None and (timeout <= 0
                                 or not math.isfinite(timeout)):
         raise ProtocolError(400, "\"timeout\" must be a positive "
@@ -134,7 +149,7 @@ def parse_completion_request(raw: bytes) -> CompletionRequest:
         raise ProtocolError(400, str(e))
     return CompletionRequest(
         prompt_ids=np.asarray(prompt, dtype=np.int64),
-        sampling=sampling, stream=stream)
+        sampling=sampling, stream=stream, request_id=request_id)
 
 
 # -- responses -------------------------------------------------------------

@@ -322,6 +322,7 @@ class Ticket:
         same amount — greedy decode is deterministic, so the survivor
         continues the exact sequence (token-identical to an
         uninterrupted run; asserted against the solo oracle)."""
+        dead_replica = self.driver.name
         if self._ttft_s is None and dead.output_tokens:
             self._ttft_s = dead.output().ttft_s
         self._history.extend(dead.output_tokens)
@@ -354,6 +355,16 @@ class Ticket:
         self.migrations += 1
         with self._router._lock:
             self._router.migrations_total += 1
+        # timeline continuity: the ticket id IS the engine request id
+        # on every replica, so the new replica's tracer already holds
+        # the re-placement's submit/admit — this marks WHY it appeared
+        # there (the merged /debug/requests/<id> view shows one
+        # timeline spanning both replicas)
+        obs = getattr(self.driver.engine, "obs", None)
+        if obs is not None:
+            obs.tracer.record(self.id, "migrate",
+                              cause=f"replica_death:{dead_replica}",
+                              tokens=len(self._history))
 
     def _retry(self, prompt_ids, sampling):
         """Re-place on another replica. Attempt 0 fires IMMEDIATELY —
@@ -573,3 +584,51 @@ class Router:
         """{replica name: engine metrics snapshot} for /metrics."""
         return {d.name: d.engine.metrics.snapshot()
                 for d in self.drivers}
+
+    # -- debug introspection (serving/obs.py; env-gated in server.py) ------
+    def debug_state(self) -> dict:
+        """`GET /debug/state`: the router's own stats plus every
+        replica's live engine state. Reads race the pump threads by
+        design (a wedged replica must still answer) — the rare torn
+        dict read is retried, then reported instead of raised."""
+        replicas = {}
+        for d in self.drivers:
+            for _ in range(3):
+                try:
+                    replicas[d.name] = d.engine.debug_state()
+                    break
+                except RuntimeError:
+                    continue        # dict mutated mid-read: retry
+            else:
+                replicas[d.name] = {"error": "state unstable (engine "
+                                             "mutating during read)"}
+        return {"router": self.stats(), "replicas": replicas}
+
+    def request_timeline(self, request_id: str) -> Optional[List[dict]]:
+        """ONE merged lifecycle timeline for `request_id` across every
+        replica it touched (the ticket id is stable across
+        migration), each event tagged with its replica, ordered by
+        timestamp. None = no replica has ever seen the id."""
+        merged: List[dict] = []
+        for d in self.drivers:
+            obs = getattr(d.engine, "obs", None)
+            if obs is None:
+                continue
+            tl = obs.tracer.timeline(request_id)
+            if tl:
+                merged.extend({**ev, "replica": d.name} for ev in tl)
+        if not merged:
+            return None
+        merged.sort(key=lambda ev: ev["t"])
+        return merged
+
+    def flight_dumps(self) -> dict:
+        """`GET /debug/flight`: {replica: flight snapshot} — the live
+        ring plus retained incident dumps of every replica (dead ones
+        included: their ring holds the final steps)."""
+        out = {}
+        for d in self.drivers:
+            obs = getattr(d.engine, "obs", None)
+            out[d.name] = (None if obs is None
+                           else obs.flight.snapshot())
+        return out

@@ -14,6 +14,13 @@ Endpoints:
   balancer stops routing here before residents finish).
 - `GET /metrics` — Prometheus text exposition, one labelled series set
   per replica (`serving.metrics.prometheus_render`).
+- `GET /debug/state` / `/debug/requests/<id>` / `/debug/flight` —
+  live debug introspection (serving/obs.py): per-replica engine state
+  (residents, queue, pools, prefix cache), one merged request
+  lifecycle timeline (`?format=chrome` for a Perfetto-openable
+  trace), and the flight-recorder ring + incident dumps. OFF by
+  default — gated by `debug_endpoints=` / PADDLE_TPU_DEBUG=on — since
+  timelines expose prompt metadata (lengths, priorities, ids).
 
 Backpressure and failure map to status codes via typed errors
 (serving/errors.py): full queue -> 429 + Retry-After, draining/closed
@@ -59,6 +66,7 @@ from typing import Optional
 from ..errors import (EngineClosed, QueueFull, RateLimited,
                       ServingError)
 from ..metrics import prometheus_render
+from ..obs import resolve_debug_flag, timeline_to_chrome
 from .protocol import (ProtocolError, completion_body, error_body,
                        parse_completion_request, sse, SSE_DONE,
                        status_for_error, status_for_output,
@@ -78,10 +86,14 @@ class ServingHTTPServer(ThreadingHTTPServer):
                  poll_interval_s: float = 0.05,
                  rate_limit: Optional[float] = None,
                  rate_limit_burst: Optional[float] = None,
-                 rate_limit_max_clients: int = 4096):
+                 rate_limit_max_clients: int = 4096,
+                 debug_endpoints=None):
         self.router = router
         self.model_name = model_name
         self.poll_interval_s = float(poll_interval_s)
+        # /debug/* gate (default OFF — request timelines expose prompt
+        # metadata); explicit ctor arg wins, else PADDLE_TPU_DEBUG
+        self.debug_endpoints = resolve_debug_flag(debug_endpoints)
         # per-client token buckets (None = unlimited): keyed by API key
         # (Authorization header) falling back to the remote address
         self.rate_limiter = (
@@ -219,6 +231,43 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path.startswith("/debug/"):
+            self._respond_debug()
+        else:
+            self._send_error_json(404, f"no route {self.path!r}",
+                                  "not_found")
+
+    def _respond_debug(self):
+        """`/debug/state` | `/debug/flight` | `/debug/requests/<id>`
+        (+ `?format=chrome`): live introspection over serving/obs.py.
+        403 unless the server was built with debug endpoints on."""
+        if not self.server.debug_endpoints:
+            self._send_error_json(
+                403, "debug endpoints are disabled: start the server "
+                "with debug_endpoints=True or PADDLE_TPU_DEBUG=on",
+                "forbidden")
+            return
+        from urllib.parse import parse_qs, unquote, urlparse
+        parsed = urlparse(self.path)
+        router = self.server.router
+        if parsed.path == "/debug/state":
+            self._send_json(200, router.debug_state())
+        elif parsed.path == "/debug/flight":
+            self._send_json(200, router.flight_dumps())
+        elif parsed.path.startswith("/debug/requests/"):
+            rid = unquote(parsed.path[len("/debug/requests/"):])
+            timeline = router.request_timeline(rid)
+            if timeline is None:
+                self._send_error_json(
+                    404, f"no timeline for request {rid!r} (unknown "
+                    "id, obs off, or evicted from the bounded "
+                    "tracer)", "not_found")
+            elif parse_qs(parsed.query).get("format",
+                                            [""])[0] == "chrome":
+                self._send_json(200, timeline_to_chrome(timeline, rid))
+            else:
+                self._send_json(200, {"request_id": rid,
+                                      "events": timeline})
         else:
             self._send_error_json(404, f"no route {self.path!r}",
                                   "not_found")
@@ -251,8 +300,14 @@ class _Handler(BaseHTTPRequestHandler):
                                   "service_unavailable")
             return
         try:
-            ticket = self.server.router.submit(creq.prompt_ids,
-                                               creq.sampling)
+            ticket = self.server.router.submit(
+                creq.prompt_ids, creq.sampling,
+                ticket_id=creq.request_id)
+        except ValueError as e:
+            # a client-named request_id colliding with a LIVE request
+            # surfaces as the engine's duplicate-id ValueError
+            self._send_error_json(409, str(e), "conflict")
+            return
         except QueueFull as e:
             retry_after = max(1, math.ceil(e.retry_after_s))
             self._send_error_json(

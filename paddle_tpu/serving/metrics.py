@@ -45,6 +45,11 @@ SPEC_TOKEN_BUCKETS = (1, 2, 3, 4, 6, 8, 12, 16)
 # mean is the ~Nx of the grouped walk's HBM claim
 GROUP_SIZE_BUCKETS = (2, 3, 4, 6, 8, 12, 16, 32)
 
+# distinct per-priority-class label values kept before overflow
+# traffic folds into the "other" class (priority is client-supplied
+# and unbounded — a label-cardinality bomb without a cap)
+PRIORITY_CLASSES_MAX = 8
+
 
 class Histogram:
     """Bounded-reservoir histogram: running count/sum/min/max over all
@@ -134,6 +139,11 @@ class ServingMetrics:
         # were failed fast ("deadline", HTTP 504) — the overload
         # fail-fast path, distinct from the runtime timeout above
         self.requests_deadline = 0
+        # deadline goodput: of the requests that CARRIED a placement
+        # deadline, how many finished normally (met) vs deadline-
+        # failed 504 (missed = requests_deadline). The pair is the
+        # "did the overload scheduler actually deliver" number.
+        self.deadline_met = 0
         # requests quarantined by the engine's poison bisection (they
         # deterministically killed the step; HTTP 422, never retried)
         self.requests_poisoned = 0
@@ -238,6 +248,13 @@ class ServingMetrics:
         self.group_size_hist = Histogram(buckets=GROUP_SIZE_BUCKETS)
         self.queue_wait_s = Histogram()
         self.e2e_s = Histogram()
+        # per-priority-class latency histograms (label = str(priority),
+        # capped at PRIORITY_CLASSES_MAX distinct classes, overflow ->
+        # "other"): TTFT / inter-token / e2e per class, rendered as
+        # labelled Prometheus series next to the aggregates — the
+        # overload scheduler's promise ("high priority stays fast
+        # under load") as a per-class percentile, not a guess
+        self._by_priority: dict = {}
         self.queue_depth_hist = Histogram()
         self.occupancy_hist = Histogram()
         self.pool_utilization_hist = Histogram()
@@ -248,6 +265,29 @@ class ServingMetrics:
         # busy window for throughput
         self._first_admit_t: Optional[float] = None
         self._last_token_t: Optional[float] = None
+
+    @staticmethod
+    def _priority_of(req) -> int:
+        """Priority class of a request-shaped object (duck-typed
+        fakes without sampling params land in class 0)."""
+        sampling = getattr(req, "sampling", None)
+        return 0 if sampling is None else sampling.priority
+
+    def _priority_class(self, priority) -> dict:
+        """The per-class histogram trio for `priority`, creating it on
+        first sight (callers hold self._lock)."""
+        lbl = str(int(priority))
+        cls = self._by_priority.get(lbl)
+        if cls is None and len(self._by_priority) >= \
+                PRIORITY_CLASSES_MAX:
+            lbl = "other"
+            cls = self._by_priority.get(lbl)
+        if cls is None:
+            cls = self._by_priority[lbl] = {
+                "ttft_s": Histogram(buckets=TTFT_BUCKETS),
+                "inter_token_s": Histogram(buckets=LATENCY_BUCKETS),
+                "e2e_s": Histogram(buckets=TTFT_BUCKETS)}
+        return cls
 
     # -- recording hooks (called by the engine) ---------------------------
     def on_submit(self, req):
@@ -270,14 +310,23 @@ class ServingMetrics:
             self.tokens_generated += 1
             self._last_token_t = now
             if len(req.output_tokens) == 1:
-                self.ttft_s.record(now - req.arrival_t)
+                ttft = now - req.arrival_t
+                self.ttft_s.record(ttft)
+                self._priority_class(self._priority_of(req))[
+                    "ttft_s"].record(ttft)
 
-    def on_inter_token(self, dt: float):
+    def on_inter_token(self, dt: float, priority: int = 0):
         with self._lock:
             self.inter_token_s.record(dt)
+            self._priority_class(priority)["inter_token_s"].record(dt)
 
     def on_finish(self, req, now: float):
         with self._lock:
+            sampling = getattr(req, "sampling", None)
+            if sampling is not None \
+                    and sampling.deadline_s is not None \
+                    and req.finish_reason in ("stop", "length"):
+                self.deadline_met += 1
             if req.finish_reason == "cancelled":
                 self.requests_cancelled += 1
             elif req.finish_reason == "timeout":
@@ -290,7 +339,10 @@ class ServingMetrics:
                 self.requests_poisoned += 1
             else:                 # "aborted", "replica_failure", ...
                 self.requests_aborted += 1
-            self.e2e_s.record(now - req.arrival_t)
+            e2e = now - req.arrival_t
+            self.e2e_s.record(e2e)
+            self._priority_class(self._priority_of(req))[
+                "e2e_s"].record(e2e)
 
     def on_decode_step(self, wall_s: float):
         with self._lock:
@@ -475,14 +527,28 @@ class ServingMetrics:
             "e2e_s": self.e2e_s.snapshot(),
             "queue_depth_hist": self.queue_depth_hist.snapshot(),
             "occupancy_hist": self.occupancy_hist.snapshot(),
+            "deadline_goodput": {"met": self.deadline_met,
+                                 "missed": self.requests_deadline},
+            "by_priority": {
+                lbl: {name: h.snapshot() for name, h in cls.items()}
+                for lbl, cls in sorted(self._by_priority.items())},
         }
 
 
 # -- Prometheus text exposition -------------------------------------------
+def _esc_label(v) -> str:
+    """Escape a label VALUE per the exposition format: backslash,
+    double-quote and newline must be escaped or the line is invalid
+    (replica names are caller-supplied strings)."""
+    return (str(v).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
 def _fmt_labels(labels: dict) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(f'{k}="{_esc_label(v)}"'
+                     for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
 
@@ -550,7 +616,9 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                        ("group_size_per_step", "histogram"),
                        ("packed_tokens_per_step", "histogram"),
                        ("ttft_seconds", "histogram"),
-                       ("inter_token_seconds", "histogram")]:
+                       ("inter_token_seconds", "histogram"),
+                       ("e2e_seconds", "histogram"),
+                       ("deadline_goodput_total", "counter")]:
         lines.append(f"# TYPE {namespace}_{name} {kind}")
     for replica, snap in sorted(snapshots.items()):
         lab = {"replica": str(replica)}
@@ -667,6 +735,24 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                     lines)
         _hist_lines(f"{namespace}_inter_token_seconds",
                     snap["inter_token_s"], lab, lines)
+        # per-priority-class latency series: same metric names, one
+        # extra `priority` label per class (the unlabelled aggregates
+        # above stay for dashboards that predate priorities)
+        for lbl, cls in sorted((snap.get("by_priority") or {}).items()):
+            plab = {**lab, "priority": lbl}
+            _hist_lines(f"{namespace}_ttft_seconds", cls["ttft_s"],
+                        plab, lines)
+            _hist_lines(f"{namespace}_inter_token_seconds",
+                        cls["inter_token_s"], plab, lines)
+            _hist_lines(f"{namespace}_e2e_seconds", cls["e2e_s"],
+                        plab, lines)
+        dg = snap.get("deadline_goodput")
+        if dg is not None:
+            for outcome in ("met", "missed"):
+                lines.append(
+                    f"{namespace}_deadline_goodput_total"
+                    + _fmt_labels({**lab, "outcome": outcome})
+                    + f" {dg.get(outcome, 0)}")
     if router is not None:
         for name in ("retries_total", "migrations_total",
                      "watchdog_kills_total"):

@@ -109,6 +109,25 @@ class Scheduler:
     def occupancy(self) -> float:
         return len(self.running) / self.num_slots
 
+    def queue_summary(self, max_items: int = 16) -> dict:
+        """Debug-introspection view of the ordered queue (serving/
+        obs.py -> `GET /debug/state`): depth, per-priority-class
+        counts, and the first `max_items` entries in admission order
+        — enough to see WHO is blocked behind WHAT without walking
+        the whole backlog over HTTP."""
+        by_prio: Dict[str, int] = {}
+        head: List[dict] = []
+        for req in self._queue:
+            p = str(req.sampling.priority)
+            by_prio[p] = by_prio.get(p, 0) + 1
+            if len(head) < max_items:
+                head.append({"request_id": req.request_id,
+                             "priority": req.sampling.priority,
+                             "state": req.state.name,
+                             "deadline_s": req.sampling.deadline_s})
+        return {"depth": len(self._queue), "by_priority": by_prio,
+                "head": head}
+
     def free_slots(self) -> List[int]:
         return [s for s in range(self.num_slots) if s not in self.running]
 

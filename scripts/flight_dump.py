@@ -1,0 +1,129 @@
+"""Pretty-print a serving flight-recorder dump as a per-step table.
+
+The flight recorder (paddle_tpu/serving/obs.py) keeps the last N
+engine steps — batch composition, queue depth, pool occupancy, step
+wall time — and freezes the ring into an incident dump on poison
+quarantine, deadline fail-fast and replica death. This script renders
+those dumps for a human postmortem:
+
+    python scripts/flight_dump.py http://127.0.0.1:8000
+        # fetch a live server's GET /debug/flight (needs the server
+        # started with debug_endpoints=True / PADDLE_TPU_DEBUG=on)
+    python scripts/flight_dump.py dump.json
+        # a saved /debug/flight payload ({replica: snapshot}) or a
+        # single FlightRecorder.snapshot() dict
+    python scripts/flight_dump.py dump.json --incidents-only
+    python scripts/flight_dump.py dump.json --last 40
+
+`serving_bench.py --obs-ab` runs `render_flight` over the obs arm's
+recorder as its smoke check, so this renderer is exercised by CI, not
+just by humans at 3am.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+COLUMNS = [
+    # (header, record key, width)
+    ("step", "step", 6),
+    ("queue", "queue_depth", 5),
+    ("res", "residents", 4),
+    ("prefill", "prefill_tokens", 7),
+    ("decode", "decode_tokens", 6),
+    ("draft", "draft_tokens", 5),
+    ("acc", "accepted_tokens", 4),
+    ("saved", "reads_saved", 5),
+    ("pages", "pages_used", 5),
+    ("cache", "pages_cached", 5),
+    ("swap", "pages_swapped", 4),
+    ("host", "host_pages_used", 4),
+    ("wall_ms", "step_wall_ms", 8),
+]
+
+
+def _fmt_row(cells, widths):
+    return "  ".join(str(c).rjust(w) for c, w in zip(cells, widths))
+
+
+def render_steps(steps, last=None):
+    """One ring (or incident) step list -> table lines. Non-step
+    `note` entries (fired faults) render inline where they landed."""
+    widths = [w for _, _, w in COLUMNS]
+    lines = [_fmt_row([h for h, _, _ in COLUMNS], widths)]
+    if last is not None:
+        steps = steps[-int(last):]
+    for rec in steps:
+        if "note" in rec:
+            lines.append(f"  ** {rec['note']}: {rec.get('detail')}")
+            continue
+        lines.append(_fmt_row(
+            [rec.get(key, "-") for _, key, _ in COLUMNS], widths))
+    return lines
+
+
+def render_flight(snapshot, name="replica", last=None,
+                  incidents_only=False):
+    """One replica's FlightRecorder.snapshot() -> printable text."""
+    lines = [f"== {name}: {snapshot['steps_recorded']} steps recorded "
+             f"(ring capacity {snapshot['capacity']}), "
+             f"{snapshot['incidents_total']} incidents =="]
+    if not incidents_only:
+        if snapshot["steps"]:
+            lines.extend(render_steps(snapshot["steps"], last=last))
+        else:
+            lines.append("  (ring empty)")
+    for i, inc in enumerate(snapshot.get("incidents", [])):
+        lines.append(
+            f"-- incident {i}: {inc['kind']} at step {inc['step']} "
+            f"(detail: {inc.get('detail')}) — last "
+            f"{len(inc['steps'])} steps before it --")
+        lines.extend(render_steps(inc["steps"], last=last))
+    return "\n".join(lines)
+
+
+def render(payload, last=None, incidents_only=False) -> str:
+    """A `/debug/flight` payload ({replica: snapshot}) or a bare
+    snapshot dict -> printable text."""
+    if "steps" in payload and "capacity" in payload:
+        return render_flight(payload, last=last,
+                             incidents_only=incidents_only)
+    parts = []
+    for name, snap in sorted(payload.items()):
+        if snap is None:
+            parts.append(f"== {name}: observability off ==")
+        else:
+            parts.append(render_flight(snap, name=name, last=last,
+                                       incidents_only=incidents_only))
+    return "\n\n".join(parts)
+
+
+def load(source: str):
+    if source.startswith("http://") or source.startswith("https://"):
+        from urllib.request import urlopen
+        url = source.rstrip("/")
+        if not url.endswith("/debug/flight"):
+            url += "/debug/flight"
+        with urlopen(url, timeout=30) as resp:
+            return json.load(resp)
+    with open(source) as f:
+        return json.load(f)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="pretty-print a serving flight-recorder dump")
+    ap.add_argument("source", help="server base URL (fetches "
+                    "/debug/flight) or a dump JSON file")
+    ap.add_argument("--last", type=int, default=None,
+                    help="only the last N steps of each ring/dump")
+    ap.add_argument("--incidents-only", action="store_true",
+                    help="skip the live ring, print incident dumps")
+    args = ap.parse_args(argv)
+    print(render(load(args.source), last=args.last,
+                 incidents_only=args.incidents_only))
+
+
+if __name__ == "__main__":
+    main()

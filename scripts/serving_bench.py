@@ -11,7 +11,7 @@ in the bench trajectory. Prints ONE JSON line and writes the same
 stable-schema report to BENCH_serving.json (override with --out,
 suppress with --out -):
 
-    {"bench": "serving", "schema_version": 10, "attn_impl": "kernel",
+    {"bench": "serving", "schema_version": 11, "attn_impl": "kernel",
      "requests": ..., "ttft_p50_s": ..., "tokens_per_sec": ...,
      "decode_step_ms_p50": ..., "ab": {"kernel": {...},
      "gather": {...}}, "prefix_stats": {...}, "unified": {...},
@@ -83,6 +83,17 @@ residents-at-peak, tokens-per-s-per-HBM-GB, the arms' token agreement
 and the max next-token logit drift of an int8 vs fp paged prefill
 through the model — and ASSERTS >= 1.5x residents at peak with int8
 on, drift under the pinned epsilon, and no tokens/s regression.
+
+`--obs-ab` adds the observability A/B (schema v11): the SAME Poisson
+trace once with the obs layer (serving/obs.py: request-lifecycle
+tracer + flight recorder) OFF and once ON. Both arms collect every
+emitted token; the report's "obs" section records per-arm tokens/s
+and the recorder's step/timeline counts — and the script ASSERTS the
+arms are token-identical, the on arm's tokens/s is within the 3%
+noise pin of the off arm's (observability must be free), the flight
+ring actually recorded the trace's steps, and that
+`scripts/flight_dump.py` renders the on arm's ring into a non-empty
+per-step table (the CI smoke of the postmortem tooling).
 
 `--prefix-share P` builds a shared-prefix trace instead of fully
 random prompts: fraction P of the requests prepend one of K
@@ -202,6 +213,12 @@ def main():
                     "residents-per-HBM-byte / tokens-per-s / "
                     "logit-drift A/B; asserts >= 1.5x residents at "
                     "peak with int8 on and bounded drift")
+    ap.add_argument("--obs-ab", action="store_true",
+                    help="run the SAME Poisson trace with the "
+                    "observability layer (request tracer + flight "
+                    "recorder) off vs on; asserts token identity, "
+                    "tokens/s within the 3%% noise pin, and that "
+                    "flight_dump.py renders the recorded ring")
     ap.add_argument("--overload", action="store_true",
                     help="run the deterministic virtual-time 3x "
                     "overload trace (mixed priorities + deadlines) "
@@ -368,6 +385,35 @@ def main():
                 attempts,
                 key=lambda r: r["snap"]["tokens_per_sec"] or 0.0)
 
+    # the observability A/B: a DETERMINISTIC burst replay (every
+    # request arrives at t=0, so both arms run the exact same engine
+    # steps — a wall-clock Poisson replay would let arrival jitter
+    # change the step count between arms) with the obs layer off vs
+    # on. Tokens collected so the "observability never changes
+    # output" claim is asserted; best-of-5 per arm by TRACE wall time
+    # (the min absorbs OS hiccups in a sub-second CPU replay) so the
+    # 3% cost pin measures the layer, not scheduler noise.
+    obs_runs = {}
+    obs_n = 0
+    if args.obs_ab:
+        obs_n = max(n_req, 4 * args.slots)
+        obs_arrivals = np.zeros(obs_n)
+        obs_prompts = [prompts[i % len(prompts)] for i in range(obs_n)]
+        obs_budgets = np.asarray([budgets[i % len(budgets)]
+                                  for i in range(obs_n)])
+        for mode in ("off", "on"):
+            attempts = [run_trace(
+                model, obs_arrivals, obs_prompts, obs_budgets,
+                slots=args.slots, max_len=max_len,
+                page_size=args.page_size, pages=args.pages,
+                chunk=chunk, attn_impl="kernel", obs=(mode == "on"),
+                collect_tokens=True) for _ in range(5)]
+            for a in attempts[1:]:
+                assert a["tokens"] == attempts[0]["tokens"], \
+                    "obs arm not deterministic across repeats"
+            obs_runs[mode] = min(attempts,
+                                 key=lambda r: r["wall_s"])
+
     # the prefix-cache A/B: the SAME shared-prefix trace with the
     # radix cache on vs off (cache pre-warmed with the K system
     # prompts — steady-state behavior, not cold-start compile noise)
@@ -471,7 +517,7 @@ def main():
 
     report = {
         "bench": "serving",
-        "schema_version": 10,
+        "schema_version": 11,
         "platform": jax.devices()[0].platform,
         "attn_impl": "kernel",
         "requests": n_req,
@@ -531,6 +577,54 @@ def main():
             "tokens_per_sec_ratio": ratio,
             "token_identical": (spec_runs["on"]["tokens"]
                                 == spec_runs["off"]["tokens"]),
+        }
+    if obs_runs:
+        def _obs_summary(run):
+            s = run["snap"]
+            # trace-level throughput (tokens over the replay wall):
+            # both arms emit identical tokens over identical steps,
+            # so the ratio is a pure wall-time comparison
+            trace_tps = (s["tokens_generated"] / run["wall_s"]
+                         if run["wall_s"] > 0 else 0.0)
+            return {
+                "wall_s": round(run["wall_s"], 4),
+                "tokens_per_sec": trace_tps,
+                "ttft_p50_s": s["ttft_s"]["p50"],
+                "decode_steps": s["decode_steps"],
+                "completed": s["requests"]["completed"],
+            }
+
+        on_o, off_o = (_obs_summary(obs_runs["on"]),
+                       _obs_summary(obs_runs["off"]))
+        flight = obs_runs["on"]["flight"]
+        tracer = obs_runs["on"]["obs_stats"]["tracer"]
+        # the flight-dump smoke: the postmortem renderer must turn the
+        # on arm's ring into a real per-step table (CI exercises the
+        # 3am tooling, not just the recorder)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from flight_dump import render_flight
+        dump_text = render_flight(flight, name="obs-ab")
+        dump_rows = [ln for ln in dump_text.splitlines()
+                     if ln and ln.lstrip()[:1].isdigit()]
+        report["obs"] = {
+            "requests": obs_n,
+            "trace": "burst",
+            "repeats": 5,
+            "off": off_o,
+            "on": on_o,
+            "tokens_per_sec_ratio": (
+                None if not off_o["tokens_per_sec"]
+                else (on_o["tokens_per_sec"] or 0.0)
+                / off_o["tokens_per_sec"]),
+            "noise_pin": 0.03,
+            "token_identical": (obs_runs["on"]["tokens"]
+                                == obs_runs["off"]["tokens"]),
+            "flight_steps_recorded": flight["steps_recorded"],
+            "flight_ring_capacity": flight["capacity"],
+            "timelines_recorded": tracer["timelines"]
+            + tracer["timelines_evicted"],
+            "timeline_events_recorded": tracer["events_recorded"],
+            "flight_dump_rows": len(dump_rows),
         }
     if share > 0.0:
         report["prefix"] = {
@@ -638,6 +732,27 @@ def main():
             and sp["accepted_tokens_per_step"] > 1.0, sp
         assert sp["on"]["tokens_per_sec"] >= \
             sp["off"]["tokens_per_sec"], sp
+    if obs_runs:
+        ob = report["obs"]
+        # the acceptance numbers: observability NEVER changes output
+        # (bit-token-identical on vs off), both arms served the whole
+        # trace, the throughput cost stays inside the 3% noise pin
+        # (host-side dict work — if this trips, the layer got onto a
+        # hot path), the ring really recorded the trace's steps and
+        # every request got a timeline, and the flight-dump renderer
+        # produced a row per recorded step
+        assert ob["token_identical"], "obs on/off token mismatch"
+        assert ob["on"]["completed"] == ob["off"]["completed"] \
+            == ob["requests"], ob
+        # the burst replay runs the same steps in both arms, so the
+        # arms really are comparable — then the cost pin holds
+        assert ob["on"]["decode_steps"] == ob["off"]["decode_steps"], ob
+        assert ob["tokens_per_sec_ratio"] is not None \
+            and ob["tokens_per_sec_ratio"] >= 1.0 - ob["noise_pin"], ob
+        assert ob["flight_steps_recorded"] >= ob["on"]["decode_steps"], ob
+        assert ob["timelines_recorded"] >= ob["requests"], ob
+        assert ob["flight_dump_rows"] >= min(
+            ob["flight_steps_recorded"], ob["flight_ring_capacity"]), ob
     if share > 0.0:
         on, off = report["prefix"]["on"], report["prefix"]["off"]
         # the acceptance number: a warm cache must do strictly less
@@ -724,7 +839,8 @@ def main():
 def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
               page_size, pages, chunk, attn_impl, prefix_cache=None,
               warm_prompts=(), unified=None, spec=None,
-              collect_tokens=False, kv_dtype=None, grouped=None):
+              collect_tokens=False, kv_dtype=None, grouped=None,
+              obs=None):
     """One Poisson-trace replay through a fresh engine pinned to
     `attn_impl` (and, for the prefix A/B, to `prefix_cache` on/off;
     for the unified-step A/B, to `unified` on/off; for the spec A/B,
@@ -744,7 +860,8 @@ def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
                         page_size=page_size, num_pages=pages,
                         chunk_len=chunk, attn_impl=attn_impl,
                         prefix_cache=prefix_cache, unified=unified,
-                        spec=spec, kv_dtype=kv_dtype, grouped=grouped)
+                        spec=spec, kv_dtype=kv_dtype, grouped=grouped,
+                        obs=obs)
 
     # warm the compiled programs so the trace measures steady state, not
     # XLA compile time: one request per distinct prompt length (chunk
@@ -757,6 +874,8 @@ def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
                         SamplingParams(max_new_tokens=2))
     eng.run()
     eng.metrics.__init__()   # drop warmup from the report
+    if eng.obs is not None:
+        eng.obs.reset()      # ... and from the flight ring/timelines
     eng.metrics.attn_impl = eng.attn_impl
     eng.metrics.unified = eng.unified
     eng.metrics.grouped = eng.grouped
@@ -784,6 +903,9 @@ def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
            "chunk_len": eng.chunk_len, "page_bytes": eng.page_bytes}
     if collect_tokens:
         out["tokens"] = [list(r.output_tokens) for r in reqs]
+    if eng.obs is not None:
+        out["flight"] = eng.obs.flight.snapshot()
+        out["obs_stats"] = eng.obs.stats()
     return out
 
 

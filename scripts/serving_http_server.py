@@ -11,6 +11,10 @@ graceful drain (stop admitting -> finish residents -> exit 0):
     curl -sN localhost:8000/v1/completions \
          -d '{"prompt": [3, 14, 15, 9], "max_tokens": 8, "stream": true}'
     curl -s localhost:8000/metrics | head
+    # with --debug (or PADDLE_TPU_DEBUG=on):
+    curl -s localhost:8000/debug/state | python -m json.tool | head
+    curl -s localhost:8000/debug/requests/cmpl-0   # one timeline
+    python scripts/flight_dump.py http://localhost:8000  # ring table
     kill -TERM <pid>       # graceful drain
 """
 from __future__ import annotations
@@ -58,6 +62,12 @@ def main():
                     help="per-request bound on mid-stream "
                     "migrations before the typed replica error "
                     "surfaces")
+    ap.add_argument("--debug", action="store_true",
+                    help="expose the /debug/state, "
+                    "/debug/requests/<id> and /debug/flight "
+                    "introspection endpoints (serving/obs.py) — off "
+                    "by default, they carry prompt metadata; "
+                    "equivalent to PADDLE_TPU_DEBUG=on")
     args = ap.parse_args()
 
     import jax
@@ -81,7 +91,8 @@ def main():
     server = serve(engines, args.host, args.port,
                    default_timeout_s=args.timeout,
                    watchdog_timeout_s=args.watchdog_timeout,
-                   max_migrations=args.max_migrations)
+                   max_migrations=args.max_migrations,
+                   debug_endpoints=args.debug or None)
     server.install_signal_handlers()
     print(f"serving {args.replicas} replica(s) of "
           f"{type(model).__name__} (vocab={cfg.vocab_size}) on "
