@@ -59,10 +59,7 @@ import warnings
 import numpy as np
 import jax
 import jax.numpy as jnp
-try:
-    from jax import shard_map
-except ImportError:  # jax<0.5: not yet promoted out of experimental
-    from jax.experimental.shard_map import shard_map
+from ..ring_attention import shard_map  # jax-version shim (check_vma)
 from jax.sharding import PartitionSpec as P
 
 from ...nn.layer.layers import Layer

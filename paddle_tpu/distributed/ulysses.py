@@ -18,10 +18,7 @@ from __future__ import annotations
 import math
 
 import jax
-try:
-    from jax import shard_map
-except ImportError:  # jax<0.5: not yet promoted out of experimental
-    from jax.experimental.shard_map import shard_map
+from .ring_attention import shard_map  # jax-version shim (check_vma)
 from jax.sharding import PartitionSpec, NamedSharding
 
 __all__ = ["ulysses_attention", "ulysses_attention_sharded"]

@@ -86,11 +86,12 @@ class DecodeCache:
     """
 
     __slots__ = ("k", "v", "pos", "k_scale", "v_scale", "fresh",
-                 "page_table", "attn_impl", "q_len", "group")
+                 "page_table", "attn_impl", "q_len", "group",
+                 "out_shard")
 
     def __init__(self, k, v, pos, k_scale=None, v_scale=None,
                  fresh=False, page_table=None, attn_impl=None,
-                 q_len=None, group=None):
+                 q_len=None, group=None, out_shard=None):
         self.k = k
         self.v = v
         self.pos = pos
@@ -111,6 +112,18 @@ class DecodeCache:
         # share a physical-page prefix — pure HBM-traffic hint, None =
         # the per-row walk
         self.group = group
+        # tensor-parallel serving (ServingEngine(mesh=...)): a
+        # jax.sharding.NamedSharding the ATTENTION OUTPUT is
+        # constrained to before it leaves update_and_attend. With the
+        # KV pools and QKV projections sharded over the mesh's "mp"
+        # axis (kv-head / head dim), every upstream op is either
+        # replicated or head-sharded compute with NO cross-shard
+        # reduction; this one constraint makes GSPMD materialize the
+        # single bit-exact output ALL-GATHER per layer (never a
+        # partial-sum all-reduce, which would reassociate the fp math
+        # and break the mp=1 token-identity oracle). None = no
+        # constraint (single-device serving, the default).
+        self.out_shard = out_shard
         # int8 cache modes, told apart by the scale SHAPE:
         # - dense (page_table None): k/v hold int8 codes laid out
         #   [B, H_kv, max_len, D]; *_scale are per-head [H_kv] f32
@@ -449,6 +462,19 @@ def _merge_mask_fwd(window, user):
 register_op("decode_merge_mask", _merge_mask_fwd, nondiff=True)
 
 
+def _tp_gather_out(out, cache):
+    """Tensor-parallel serving: constrain the attention output to the
+    cache's `out_shard` (normally: replicated over the engine mesh).
+    With pools/projections sharded over kv-heads, the output is the
+    ONE tensor still head-sharded here — the constraint is where GSPMD
+    inserts the single bit-exact per-layer all-gather. No-op (and zero
+    cost) without a mesh."""
+    if cache.out_shard is None:
+        return out
+    return Tensor(jax.lax.with_sharding_constraint(out._value,
+                                                   cache.out_shard))
+
+
 def update_and_attend(q, k_new, v_new, cache: DecodeCache,
                       dropout_p=0.0, training=False, attn_mask=None):
     """Write k_new/v_new at cache.pos, attend q over the valid prefix.
@@ -552,7 +578,8 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
                     cache.pos, ones]
             if user_m is not None:
                 args.append(user_m)
-            out = apply_op("ragged_paged_attention_q8", *args)
+            out = _tp_gather_out(
+                apply_op("ragged_paged_attention_q8", *args), cache)
             return out, DecodeCache(k_buf, v_buf, cache.pos + l,
                                     k_sc, v_sc,
                                     page_table=cache.page_table,
@@ -560,7 +587,8 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
         args = [q, k_buf, v_buf, cache.page_table, cache.pos]
         if user_m is not None:
             args.append(user_m)
-        out = apply_op("paged_decode_attention", *args)
+        out = _tp_gather_out(
+            apply_op("paged_decode_attention", *args), cache)
         return out, DecodeCache(k_buf, v_buf, cache.pos + l,
                                 page_table=cache.page_table,
                                 attn_impl=cache.attn_impl)
@@ -591,7 +619,7 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
             args.extend(cache.group)
         if user_m is not None:
             args.append(user_m)
-        out = apply_op(op, *args)
+        out = _tp_gather_out(apply_op(op, *args), cache)
         return out, DecodeCache(k_buf, v_buf, cache.pos + cache.q_len,
                                 k_sc, v_sc,
                                 page_table=cache.page_table,
@@ -666,7 +694,8 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
         # decode-step GQA without materializing the cache H -> H_kv
         # fold: queries grouped per kv head (bit-compatible with the
         # repeat_interleave path — tests/test_paged_attention.py)
-        out = apply_op("gqa_decode_attend", q, kf, vf, mask)
+        out = _tp_gather_out(
+            apply_op("gqa_decode_attend", q, kf, vf, mask), cache)
         return out, new_cache
     if n_rep > 1:
         kf = manipulation.repeat_interleave(kf, n_rep, axis=2)
@@ -674,7 +703,7 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
     out = F.scaled_dot_product_attention(
         q, kf, vf, attn_mask=mask, dropout_p=dropout_p, is_causal=False,
         training=training)
-    return out, new_cache
+    return _tp_gather_out(out, cache), new_cache
 
 
 def _is_zero_pos(pos):
@@ -700,7 +729,7 @@ def _pack_caches(caches):
 
 
 def _unpack_caches(ct, pos, page_table=None, attn_impl=None,
-                   q_len=None, group=None):
+                   q_len=None, group=None, out_shard=None):
     """page_table (optional [B, max_pages] raw int32 array) switches
     every layer's cache into paged-pool mode; the table is shared
     across layers (one page id addresses the same page in each
@@ -720,7 +749,7 @@ def _unpack_caches(ct, pos, page_table=None, attn_impl=None,
                         None if ks is None else Tensor(ks),
                         None if vs is None else Tensor(vs),
                         page_table=pt, attn_impl=attn_impl, q_len=ql,
-                        group=grp)
+                        group=grp, out_shard=out_shard)
             for k, v, ks, vs in ct]
 
 

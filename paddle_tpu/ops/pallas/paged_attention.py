@@ -1125,7 +1125,8 @@ def ragged_paged_attention_grouped_q8(q, k_pool, v_pool, k_scale,
 
 
 def count_page_block_reads(page_table, pos, q_len, group_id=None,
-                           group_cnt=None, *, page_size):
+                           group_cnt=None, *, page_size, n_kv=1,
+                           mp=1):
     """Host-side (numpy) model of the kernels' page-block DMA traffic
     for ONE (kv_head, layer) walk — the number the serving metrics and
     the `--prefix-share` bench A/B report, and what tests pin.
@@ -1136,14 +1137,24 @@ def count_page_block_reads(page_table, pos, q_len, group_id=None,
     member's private tail. Returns
     (flat_reads, grouped_reads, group_sizes) where group_sizes lists
     the member count of every group that actually shares (>= 2 live
-    members); without group operands grouped_reads == flat_reads."""
+    members); without group operands grouped_reads == flat_reads.
+
+    Tensor-parallel serving (ServingEngine(mesh=...)): pass the
+    model's `n_kv` and the mesh's `mp` degree and the counts become
+    what ONE CHIP issues per layer — each of the mp shards walks only
+    its n_kv/mp local heads (the kernel's kv_head grid axis is what
+    shards), and each block read moves a 1/mp page slice, so per-chip
+    reads (and the grouped walk's per-chip reads SAVED) drop by mp.
+    The defaults (n_kv=1, mp=1) keep the single-walk numbers every
+    pre-mesh pin was written against."""
     pos = np.asarray(pos, np.int64)
     q_len = np.asarray(q_len, np.int64)
     ps = int(page_size)
     live = q_len > 0
     row_pages = np.where(live, (pos + np.maximum(q_len, 1) - 1) // ps
                          + 1, 0)
-    flat = int(row_pages.sum())
+    local_heads = max(1, int(n_kv) // max(1, int(mp)))
+    flat = int(row_pages.sum()) * local_heads
     if group_id is None or group_cnt is None:
         return flat, flat, []
     group_id = np.asarray(group_id, np.int64)
@@ -1160,4 +1171,4 @@ def count_page_block_reads(page_table, pos, q_len, group_id=None,
         grouped += int((row_pages[members] - shared).sum())
         if members.size >= 2 and shared > 0:
             sizes.append(int(members.size))
-    return flat, grouped, sizes
+    return flat, grouped * local_heads, sizes

@@ -46,6 +46,17 @@ shared page from HBM once per GROUP instead of once per row, outputs
 bit-identical either way (serving_bench --prefix-share runs the
 grouped-vs-flat A/B).
 
+One replica can span a MULTI-CHIP MESH (serving/tp.py, default off,
+PADDLE_TPU_MESH=dpXmpY / ServingEngine(mesh=...)): the per-layer KV
+pools shard over their kv-head axis and the QKV projections over
+whole heads across the mesh's mp degree — mp x the residents per
+chip-HBM byte — while page tables, scheduler, prefix cache,
+preemption and spec decode stay replicated and unchanged, the step
+stays ONE compiled program, and the only collective is a single
+bit-exact output all-gather per layer (mp>1 is bit-token-identical
+to the mp=1 oracle; serving_bench --tp-ab pins the collective count
+and the residents-per-chip win).
+
 OVERLOAD degrades gracefully instead of refusing (default on,
 PADDLE_TPU_PREEMPT / ServingEngine(preempt=...)): requests carry
 `priority` + placement `deadline_s`, the queue orders by (priority,
@@ -61,6 +72,8 @@ reports TTFT/throughput/pool utilization into BENCH_serving.json.
 from .engine import (ServingEngine, resolve_grouped_flag,  # noqa: F401
                      resolve_kv_dtype, resolve_preempt_flag,
                      resolve_unified_flag)
+from .tp import (ServingTP, collective_counts,  # noqa: F401
+                 parse_mesh_spec, resolve_serving_mesh)
 from .errors import (DeadlineExceeded, EngineClosed,  # noqa: F401
                      PoisonedRequest, QueueFull, RateLimited,
                      ServingError)
@@ -97,4 +110,6 @@ __all__ = ["ServingEngine", "resolve_unified_flag",
            "NgramDrafter", "SpecConfig", "resolve_spec_config",
            "EngineObs", "FlightRecorder", "RequestTracer",
            "resolve_obs_flag", "resolve_debug_flag",
-           "resolve_flight_steps", "timeline_to_chrome"]
+           "resolve_flight_steps", "timeline_to_chrome",
+           "ServingTP", "resolve_serving_mesh", "parse_mesh_spec",
+           "collective_counts"]

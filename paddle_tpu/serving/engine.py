@@ -131,6 +131,19 @@ traffic host-side each step, feeding the page_block_reads /
 shared_page_reads_saved counters and the group-size histogram the
 `--prefix-share` A/B asserts on.
 
+MULTI-CHIP TENSOR PARALLELISM (serving/tp.py, default off, gated
+`mesh=...` / PADDLE_TPU_MESH=dpXmpY): one engine spans a (dp, mp)
+device mesh while compiling the SAME one unified step — per-layer KV
+pools shard over their kv-head axis (each chip holds a 1/mp slice of
+every page: mp x the residents per chip-HBM byte), q/k/v projections
+shard column-parallel over whole heads, and everything else — page
+tables, pos/q_len, group operands, sampling vectors, scheduler,
+prefix cache, preemption, spec decode — stays replicated and
+UNCHANGED. The only collective is one bit-exact attention-output
+all-gather per layer (zero all-reduces: fp math never reassociates),
+so an mp>1 engine is bit-token-identical to the mp=1 oracle;
+`collective_counts()` pins that against compiled HLO.
+
 Correctness contract (tests/test_serving.py): a request decoded greedily
 through the engine emits tokens bit-identical to running it ALONE
 through CompiledGenerator greedy decode — through chunked prefill,
@@ -172,10 +185,12 @@ from .prefix import (RadixPrefixCache, resolve_prefix_cache_flag,
 from .request import Request, RequestOutput, RequestState, SamplingParams
 from .scheduler import Scheduler
 from .spec import Drafter, resolve_spec_config
+from .tp import ServingTP, collective_counts, resolve_serving_mesh
 
 __all__ = ["ServingEngine", "resolve_unified_flag",
            "resolve_preempt_flag", "resolve_kv_dtype",
-           "resolve_grouped_flag", "resolve_obs_flag"]
+           "resolve_grouped_flag", "resolve_obs_flag",
+           "resolve_serving_mesh", "ServingTP"]
 
 # finish reason -> timeline event kind (the 5xx/4xx taxonomy keeps
 # its own event names so a timeline's last event says WHY at a
@@ -337,7 +352,8 @@ class ServingEngine:
                  token_budget: Optional[int] = None, spec=None,
                  preempt=None, host_pages: Optional[int] = None,
                  kv_dtype: Optional[str] = None, grouped=None,
-                 obs=None, flight_steps: Optional[int] = None):
+                 obs=None, flight_steps: Optional[int] = None,
+                 mesh=None):
         if cache_spec is None:
             if not hasattr(model, "_decode_cache_spec"):
                 raise ValueError(
@@ -372,6 +388,29 @@ class ServingEngine:
         # here — the compiled decode step keeps the impl it was traced
         # with; flipping PADDLE_TPU_PAGED_ATTN later needs a new engine.
         self.attn_impl = resolve_paged_attn_impl(attn_impl)
+        # multi-chip tensor-parallel replica (serving/tp.py, default
+        # off, gated ServingEngine(mesh=...) / PADDLE_TPU_MESH=dpXmpY):
+        # ONE engine spans a (dp, mp) device mesh while compiling the
+        # SAME one unified step — the per-layer KV pools shard over
+        # their kv-head axis (each chip holds a 1/mp slice of every
+        # page: mp x the residents per chip-HBM byte), the q/k/v
+        # projections shard column-parallel over whole heads, and the
+        # attention output all-gathers back to replicated ONCE per
+        # layer (zero all-reduces — no fp reassociation, so mp>1 is
+        # bit-token-identical to the mp=1 oracle). Page tables,
+        # pos/q_len/group operands, scheduler, prefix cache,
+        # preemption, spec decode: replicated and UNCHANGED.
+        self.tp = resolve_serving_mesh(mesh)
+        self.mp = self.tp.mp if self.tp is not None else 1
+        self.dp = self.tp.dp if self.tp is not None else 1
+        if self.tp is not None:
+            cfgm = getattr(model, "config", None)
+            self.tp.validate_geometry(
+                n_kv=self.n_kv,
+                n_heads=int(getattr(cfgm, "num_attention_heads",
+                                    self.n_kv)),
+                hidden=int(getattr(cfgm, "hidden_size",
+                                   self.n_kv * self.head_dim)))
         # unified ragged prefill+decode step (default on): ONE compiled
         # program of width chunk_len serves every prefill/decode mix
         # per step — decode rows at q_len 1 (1 + k with speculative
@@ -431,6 +470,15 @@ class ServingEngine:
         params = list(model.parameters())
         buffers = [b for _, b in model.named_buffers()]
         self._state_tensors = params + buffers
+        # the weight values the compiled programs close over: on a
+        # mesh, the engine's OWN sharded copies (QKV projections
+        # column-parallel over heads, the rest replicated) — the
+        # model's tensors are never rebound, so oracles and other
+        # engines sharing the model see single-device values as ever
+        self._state_vals = (
+            self.tp.place_state(model, self._state_tensors)
+            if self.tp is not None
+            else [t._value for t in self._state_tensors])
         self._fp = next(
             (t._value.dtype for t in self._state_tensors
              if jnp.issubdtype(t._value.dtype, jnp.floating)),
@@ -471,6 +519,15 @@ class ServingEngine:
                             self.head_dim), pool_dt),
                  None, None)
                 for _ in range(self.n_layers))
+        if self.tp is not None:
+            # shard every pool over its kv-head axis (scale pools
+            # alongside their code pools: a page and its scales are
+            # one unit on every path, sharding included)
+            self._ct = tuple(
+                (self.tp.place_pool(k), self.tp.place_pool(v),
+                 None if ks is None else self.tp.place_scale(ks),
+                 None if vs is None else self.tp.place_scale(vs))
+                for k, v, ks, vs in self._ct)
         # HBM bytes one page costs across all layers (K and V, codes
         # + scale pages for int8; fp8 is one byte per element, no
         # scales) — the denominator of the residents-per-HBM-byte
@@ -485,7 +542,22 @@ class ServingEngine:
                               + scale_bytes))
         self.metrics.kv_dtype = self.kv_dtype
         self.metrics.pool_bytes_per_page = self.page_bytes
+        # per-CHIP page cost: each of the mp shards holds a 1/mp
+        # kv-head slice of every page — the denominator of the
+        # residents-per-chip-HBM economics the --tp-ab bench reports
+        self.page_bytes_per_chip = self.page_bytes // self.mp
+        self.metrics.mesh = (None if self.tp is None
+                             else self.tp.shape)
+        self.metrics.mp = self.mp
+        self.metrics.dp = self.dp
+        self.metrics.pool_shard_bytes_per_page = self.page_bytes_per_chip
+        # the attention-output constraint the sharded step carries
+        # through _unpack_caches (see serving/tp.py): replicate — the
+        # single per-layer all-gather point
+        self._out_shard = None if self.tp is None else self.tp.rep
         self._pos = jnp.zeros((self.num_slots,), jnp.int32)
+        if self.tp is not None:
+            self._pos = self.tp.replicate(self._pos)
         self._last_logits = None      # [S, V] f32, lazy (V from prefill)
         # host page state: allocator, per-slot page lists, page tables
         # (full for prefill; decode variant trash-masks non-DECODE rows
@@ -538,6 +610,10 @@ class ServingEngine:
         self._prefill_fns: Dict[int, object] = {}   # chunk bucket -> fn
         self._decode_fn = None
         self._unified_fn = None      # the ONE compiled ragged step
+        # mesh engines: the last unified launch's operand tail, kept
+        # so collective_counts() can lower the SAME trace and census
+        # its collectives against compiled HLO
+        self._unified_args_tail = None
         self._copy_page_fn = None    # COW single-page copy, jitted once
         # host-tier swap programs, each jitted ONCE over traced page
         # ids (the PR 5 COW no-retrace discipline): device->host reads
@@ -575,7 +651,8 @@ class ServingEngine:
         self._step_idx = 0
         self._round_stats = {"prefill_tokens": 0, "decode_tokens": 0,
                              "draft_tokens": 0, "accepted_tokens": 0,
-                             "reads_saved": 0, "wall_s": 0.0}
+                             "reads_saved": 0, "collectives": 0,
+                             "wall_s": 0.0}
         # shutdown latch: flipped by drain()/abort_all(); add_request
         # raises EngineClosed once set
         self._closed = False
@@ -607,7 +684,7 @@ class ServingEngine:
         held-logits row. Host-side padding of the tail chunk rides on
         the trash-page write redirect, so the padded tokens are inert."""
         model = self.model
-        state_vals = [t._value for t in self._state_tensors]
+        state_vals = self._state_vals
 
         def prefill(state_vals, ct, pos, last_logits, page_table,
                     tokens, slot, start, new_pos, last_idx):
@@ -618,7 +695,8 @@ class ServingEngine:
                 pt_row = jax.lax.dynamic_slice(
                     page_table, (s, z), (1, page_table.shape[1]))
                 caches = _unpack_caches(ct, start, pt_row,
-                                        attn_impl=self.attn_impl)
+                                        attn_impl=self.attn_impl,
+                                        out_shard=self._out_shard)
                 logits_t, caches = model(Tensor(tokens), caches=caches)
                 v = logits_t._value.shape[-1]
                 row = jax.lax.dynamic_slice(
@@ -643,7 +721,7 @@ class ServingEngine:
         with per-slot params, batched forward with per-row positions
         through the paged pool."""
         model = self.model
-        state_vals = [t._value for t in self._state_tensors]
+        state_vals = self._state_vals
 
         def step(state_vals, ct, pos, last_logits, page_table, key,
                  temps, top_k, top_p, greedy, active):
@@ -653,7 +731,8 @@ class ServingEngine:
                                    top_p, greedy)
                 nxt = jnp.where(active, nxt, 0).astype(jnp.int32)
                 caches = _unpack_caches(ct, pos, page_table,
-                                        attn_impl=self.attn_impl)
+                                        attn_impl=self.attn_impl,
+                                        out_shard=self._out_shard)
                 last, caches = decode_model_step(model, nxt[:, None],
                                                  caches)
                 # only occupied slots advance; free/prefilling rows stay
@@ -696,7 +775,7 @@ class ServingEngine:
         per-bucket prefill programs AND the separate decode program
         collapse into this)."""
         model = self.model
-        state_vals = [t._value for t in self._state_tensors]
+        state_vals = self._state_vals
 
         def ustep(state_vals, ct, pos, last_logits, page_table, tokens,
                   q_len, is_decode, key, temps, top_k, top_p, greedy,
@@ -712,7 +791,8 @@ class ServingEngine:
                                  nxt[:, None], tokens)
                 caches = _unpack_caches(ct, pos, page_table,
                                         attn_impl=self.attn_impl,
-                                        q_len=q_len, group=group)
+                                        q_len=q_len, group=group,
+                                        out_shard=self._out_shard)
                 logits_t, caches = model(Tensor(toks), caches=caches)
                 lg = logits_t._value.astype(jnp.float32)   # [S, W, V]
                 # greedy draft verification: column i's argmax is the
@@ -962,14 +1042,24 @@ class ServingEngine:
         req.state = RequestState.CANCELLED
         return True
 
+    def _dev(self, x):
+        """Host array -> device step operand: committed REPLICATED on
+        the mesh (page tables, tokens, q_len, sampling vectors — the
+        control plane never shards), plain jnp.asarray without one.
+        Committed placement keeps the jit cache key stable, so the
+        one-trace discipline holds on the mesh too."""
+        if self.tp is not None:
+            return self.tp.replicate(np.asarray(x))
+        return jnp.asarray(x)
+
     # -- page-table device views -------------------------------------------
     def _page_tables(self):
         """(full, decode) device page tables. The decode variant points
         every non-DECODE row at the trash page so the fixed-shape
         decode scatter can't touch a mid-prefill slot's live pages."""
         if self._pt_dirty or self._pt_full is None:
-            self._pt_full = jnp.asarray(self._pt_host)
-            self._pt_decode = jnp.asarray(
+            self._pt_full = self._dev(self._pt_host)
+            self._pt_decode = self._dev(
                 np.where(self._active[:, None], self._pt_host,
                          TRASH_PAGE).astype(np.int32))
             self._pt_dirty = False
@@ -1331,6 +1421,8 @@ class ServingEngine:
             vocab = int(lg.shape[-1])
         self._last_logits = jnp.zeros((self.num_slots, vocab),
                                       jnp.float32)
+        if self.tp is not None:
+            self._last_logits = self.tp.replicate(self._last_logits)
 
     def _advance_prefills(self, suppress=frozenset()) -> int:
         """One chunk for EACH mid-prefill slot, then back to decode —
@@ -1375,14 +1467,17 @@ class ServingEngine:
                          f"@{cursor}+{bucket}]"):
             self._ct, self._pos, self._last_logits = fn(
                 self._ct, self._pos, self._last_logits, pt_full,
-                jnp.asarray(tokens), jnp.int32(slot),
-                jnp.asarray([cursor], jnp.int32),
+                self._dev(tokens), jnp.int32(slot),
+                self._dev(np.asarray([cursor], np.int32)),
                 jnp.int32(cursor + real), jnp.int32(real - 1))
         self.step_tokens_inflight = 0
         self._beat()
         self._prefill_cursor[req.request_id] = cursor + real
         self.metrics.on_prefill_chunk(real)
         self._round_stats["prefill_tokens"] += real
+        if self.tp is not None:
+            self._round_stats["collectives"] += \
+                self.tp.step_collectives(self.n_layers)
         self._obs_event(req, "prefill_chunk", tokens=real,
                         cursor=cursor + real)
 
@@ -1438,11 +1533,11 @@ class ServingEngine:
                     self._decode_fn(
                         self._ct, self._pos, self._last_logits,
                         pt_decode, key,
-                        jnp.asarray(self._temps),
-                        jnp.asarray(self._topk),
-                        jnp.asarray(self._topp),
-                        jnp.asarray(self._greedy),
-                        jnp.asarray(self._active))
+                        self._dev(self._temps),
+                        self._dev(self._topk),
+                        self._dev(self._topp),
+                        self._dev(self._greedy),
+                        self._dev(self._active))
                 toks = np.asarray(toks)   # sync: host sees the tokens
             self.step_tokens_inflight = 0
             self._beat()
@@ -1454,6 +1549,9 @@ class ServingEngine:
             self.metrics.on_decode_step(wall)
             self._round_stats["decode_tokens"] += int(self._active.sum())
             self._round_stats["wall_s"] += wall
+            if self.tp is not None:
+                self._round_stats["collectives"] += \
+                    self.tp.step_collectives(self.n_layers)
             now = now_fn()
             for slot, req in list(self.scheduler.running.items()):
                 if req.state is not RequestState.DECODE \
@@ -1587,19 +1685,26 @@ class ServingEngine:
         # read count both walks would issue (the CPU-reference number
         # the --prefix-share A/B and the saved-reads counter report)
         pos_host = np.asarray(self._pos)
+        # on a mesh the DMA model counts what ONE CHIP issues per
+        # layer (n_kv/mp local head walks over 1/mp page slices) —
+        # per-chip reads AND per-chip reads saved drop by mp
+        shard = dict(n_kv=self.n_kv, mp=self.mp) \
+            if self.tp is not None else {}
         group_args = ()
         if self.grouped:
             gid, gld, gcn = shared_prefix_groups(self._pt_host, q_len)
-            group_args = (jnp.asarray(gid), jnp.asarray(gld),
-                          jnp.asarray(gcn))
+            group_args = (self._dev(gid), self._dev(gld),
+                          self._dev(gcn))
             flat_reads, step_reads, group_sizes = \
                 count_page_block_reads(self._pt_host, pos_host, q_len,
                                        gid, gcn,
-                                       page_size=self.page_size)
+                                       page_size=self.page_size,
+                                       **shard)
         else:
             flat_reads, step_reads, group_sizes = \
                 count_page_block_reads(self._pt_host, pos_host, q_len,
-                                       page_size=self.page_size)
+                                       page_size=self.page_size,
+                                       **shard)
         self.metrics.on_grouped_step(flat_reads, step_reads,
                                      group_sizes)
         self._round_stats["reads_saved"] += \
@@ -1611,15 +1716,20 @@ class ServingEngine:
         self.step_tokens_inflight = int(q_len.sum())
         self._beat()
         t0 = time.perf_counter()
+        args_tail = (self._pos, self._last_logits, pt_full,
+                     self._dev(tokens), self._dev(q_len),
+                     self._dev(is_decode), key,
+                     self._dev(self._temps), self._dev(self._topk),
+                     self._dev(self._topp), self._dev(self._greedy),
+                     *group_args)
+        if self.tp is not None:
+            # kept for collective_counts(): the exact operand pytree
+            # (the live self._ct stands in for the pools) the one
+            # trace lowers against — [S]-sized arrays, not pools
+            self._unified_args_tail = args_tail
         with RecordEvent("serving::unified_step"):
             self._ct, self._pos, self._last_logits, toks, accept = \
-                self._unified_fn(
-                    self._ct, self._pos, self._last_logits, pt_full,
-                    jnp.asarray(tokens), jnp.asarray(q_len),
-                    jnp.asarray(is_decode), key,
-                    jnp.asarray(self._temps), jnp.asarray(self._topk),
-                    jnp.asarray(self._topp), jnp.asarray(self._greedy),
-                    *group_args)
+                self._unified_fn(self._ct, *args_tail)
             toks = np.asarray(toks)   # sync point: host sees the tokens
             accept = np.asarray(accept)
         self.step_tokens_inflight = 0
@@ -1634,6 +1744,11 @@ class ServingEngine:
         rs["decode_tokens"] += len(decode_slots)
         rs["draft_tokens"] += n_drafts
         rs["wall_s"] += wall
+        if self.tp is not None:
+            # per-launch collective census (the flight recorder's
+            # per-step number; collective_counts() checks the model
+            # against compiled HLO): one output all-gather per layer
+            rs["collectives"] += self.tp.step_collectives(self.n_layers)
         now = self._clock()
         # prefill bookkeeping: advance cursors, flip finished rows to
         # DECODE (their last real token's logits are now held — they
@@ -1788,7 +1903,8 @@ class ServingEngine:
         self._step_idx += 1
         self._round_stats = {"prefill_tokens": 0, "decode_tokens": 0,
                              "draft_tokens": 0, "accepted_tokens": 0,
-                             "reads_saved": 0, "wall_s": 0.0}
+                             "reads_saved": 0, "collectives": 0,
+                             "wall_s": 0.0}
         now = self._clock()
         self._evict(now, finished)
         self._admit(now)
@@ -1846,6 +1962,7 @@ class ServingEngine:
                 "pages_cached": self.pool.cached_pages,
                 "pages_swapped": self.pool.swapped_pages,
                 "host_pages_used": self.host_pool.used_pages,
+                "collectives": rs["collectives"],
                 "step_wall_ms": round(rs["wall_s"] * 1e3, 4)})
         return finished
 
@@ -1942,6 +2059,9 @@ class ServingEngine:
                        "grouped": self.grouped,
                        "attn_impl": self.attn_impl,
                        "kv_dtype": self.kv_dtype,
+                       "mesh": (None if self.tp is None
+                                else self.tp.shape),
+                       "mp": self.mp, "dp": self.dp,
                        "preempt": self.preempt,
                        "spec": (None if self.spec is None
                                 else self.spec.mode),
@@ -1952,6 +2072,28 @@ class ServingEngine:
                        "token_budget": self.token_budget},
             "obs": None if self.obs is None else self.obs.stats(),
         }
+
+    def collective_counts(self) -> dict:
+        """Ground-truth collective census of THE one unified trace
+        (mesh engines only): lower the step against the exact operand
+        shardings the live trace used and count collective ops in the
+        optimized HLO. The multi-chip serving contract the tests and
+        `--tp-ab` pin: ZERO all-reduce / reduce-scatter (no
+        partial-sum fp reassociation ever — that is what keeps mp>1
+        bit-token-identical to the mp=1 oracle) and exactly ONE
+        output all-gather per layer per step. Requires a mesh engine
+        that has run at least one unified step."""
+        if self.tp is None:
+            raise ValueError(
+                "collective_counts() needs a mesh engine "
+                "(ServingEngine(mesh=...) / PADDLE_TPU_MESH)")
+        if self._unified_fn is None or self._unified_args_tail is None:
+            raise ValueError(
+                "collective_counts(): no unified step has run yet — "
+                "serve at least one request first")
+        txt = self._unified_fn.lower(
+            self._ct, *self._unified_args_tail).compile().as_text()
+        return collective_counts(txt)
 
     # -- conveniences ------------------------------------------------------
     @property

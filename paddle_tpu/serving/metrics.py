@@ -192,6 +192,16 @@ class ServingMetrics:
         # residents per HBM byte)
         self.kv_dtype: Optional[str] = None
         self.pool_bytes_per_page = 0
+        # multi-chip tensor-parallel replica (serving/tp.py): the mesh
+        # shape tag ("dp1xmp2", None = single device) plus its dp/mp
+        # degrees — engine_info labels so an A/B fleet's scrapes are
+        # distinguishable — and the per-CHIP page cost (each of the mp
+        # shards holds a 1/mp kv-head slice of every page), the byte
+        # unit of the residents-per-chip-HBM economics --tp-ab reports
+        self.mesh: Optional[str] = None
+        self.mp = 1
+        self.dp = 1
+        self.pool_shard_bytes_per_page = 0
         # whether the engine runs the unified ragged prefill+decode
         # step (True) or the legacy alternating program families
         # (False); set by the engine at construction — the second A/B
@@ -476,6 +486,9 @@ class ServingMetrics:
             "decode_steps": self.decode_steps,
             "attn_impl": self.attn_impl,
             "kv_dtype": self.kv_dtype,
+            "mesh": self.mesh,
+            "mp": self.mp,
+            "dp": self.dp,
             "unified": self.unified,
             "unified_steps": self.unified_steps,
             "packed_prefill_tokens": self.packed_prefill_tokens,
@@ -504,6 +517,7 @@ class ServingMetrics:
                 "pages_cached": self.pool_pages_cached,
                 "pages_swapped": self.pool_pages_swapped,
                 "bytes_per_page": self.pool_bytes_per_page,
+                "shard_bytes_per_page": self.pool_shard_bytes_per_page,
                 "utilization": self.pool_utilization_hist.snapshot(),
             },
             "host_pool": {
@@ -601,6 +615,7 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                        ("swapped_in_pages_total", "counter"),
                        ("pool_pages_swapped", "gauge"),
                        ("pool_bytes_per_page", "gauge"),
+                       ("pool_shard_bytes_per_page", "gauge"),
                        ("host_pages_used", "gauge"),
                        ("host_pages_total", "gauge"),
                        ("host_bytes_used", "gauge"),
@@ -632,7 +647,10 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                 "unified": ("on" if snap.get("unified") else "off"),
                 "spec": snap.get("spec") or "off",
                 "kv_dtype": snap.get("kv_dtype") or "fp",
-                "grouped": ("on" if snap.get("grouped") else "off")})
+                "grouped": ("on" if snap.get("grouped") else "off"),
+                "mesh": snap.get("mesh") or "off",
+                "mp": snap.get("mp", 1) or 1,
+                "dp": snap.get("dp", 1) or 1})
             + " 1")
         lines.append(f"{namespace}_page_block_reads_total"
                      + _fmt_labels(lab)
@@ -705,6 +723,9 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
         lines.append(f"{namespace}_pool_bytes_per_page"
                      + _fmt_labels(lab)
                      + f" {pool.get('bytes_per_page', 0)}")
+        lines.append(f"{namespace}_pool_shard_bytes_per_page"
+                     + _fmt_labels(lab)
+                     + f" {pool.get('shard_bytes_per_page', 0)}")
         host = snap.get("host_pool") or {}
         lines.append(f"{namespace}_host_pages_used" + _fmt_labels(lab)
                      + f" {host.get('pages_used', 0)}")

@@ -26,7 +26,9 @@ ground truth:
   1024 steps, env `PADDLE_TPU_FLIGHT_STEPS`) of per-unified-step
   records: batch composition (prefill/decode/draft token split,
   resident slots), queue depth, page-pool and host-tier occupancy,
-  grouped-attention reads saved, spec drafted/accepted, step wall
+  grouped-attention reads saved, spec drafted/accepted, the sharded
+  step's per-step collective count (mesh engines — serving/tp.py:
+  one output all-gather per layer, zero otherwise), step wall
   time. `incident()` snapshots the ring into a bounded dump list —
   the engine calls it on poison quarantine, deadline fail-fast and
   any raising round, the driver on replica death — so a postmortem

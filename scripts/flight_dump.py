@@ -35,6 +35,7 @@ COLUMNS = [
     ("draft", "draft_tokens", 5),
     ("acc", "accepted_tokens", 4),
     ("saved", "reads_saved", 5),
+    ("coll", "collectives", 4),
     ("pages", "pages_used", 5),
     ("cache", "pages_cached", 5),
     ("swap", "pages_swapped", 4),

@@ -11,7 +11,7 @@ in the bench trajectory. Prints ONE JSON line and writes the same
 stable-schema report to BENCH_serving.json (override with --out,
 suppress with --out -):
 
-    {"bench": "serving", "schema_version": 11, "attn_impl": "kernel",
+    {"bench": "serving", "schema_version": 12, "attn_impl": "kernel",
      "requests": ..., "ttft_p50_s": ..., "tokens_per_sec": ...,
      "decode_step_ms_p50": ..., "ab": {"kernel": {...},
      "gather": {...}}, "prefix_stats": {...}, "unified": {...},
@@ -94,6 +94,25 @@ noise pin of the off arm's (observability must be free), the flight
 ring actually recorded the trace's steps, and that
 `scripts/flight_dump.py` renders the on arm's ring into a non-empty
 per-step table (the CI smoke of the postmortem tooling).
+
+`--tp-ab` adds the multi-chip tensor-parallel A/B (schema v12): the
+SAME burst trace through ONE replica on one device (mp=1, the oracle)
+and through ONE replica spanning a dp1xmp2 mesh of simulated devices
+(serving/tp.py: KV pools sharded over the kv-head axis, QKV
+projections over whole heads, control plane replicated — the step
+stays ONE compiled program). Both arms are sized to the SAME
+PER-CHIP page-byte budget: each mp=2 chip holds a 1/mp slice of
+every page, so the same per-chip bytes buy 2x the pages — more
+concurrent residents per chip-HBM byte, the whole point of spanning
+chips. The report's "tp" section records per-arm tokens/s,
+residents-at-peak, the per-chip page bytes, and the sharded step's
+compiled-HLO collective census — and the script ASSERTS the arms are
+bit-token-identical (all-gathers never reassociate fp math), >= 1.5x
+residents at the same per-chip budget, zero all-reduces, and exactly
+ONE output all-gather per layer per step. CPU simulation caveat: the
+mesh, shardings, collectives and token identity are real; per-chip
+HBM bandwidth is modeled, the real-chip multi-host run is the
+ROADMAP's open measurement.
 
 `--prefix-share P` builds a shared-prefix trace instead of fully
 random prompts: fraction P of the requests prepend one of K
@@ -213,6 +232,15 @@ def main():
                     "residents-per-HBM-byte / tokens-per-s / "
                     "logit-drift A/B; asserts >= 1.5x residents at "
                     "peak with int8 on and bounded drift")
+    ap.add_argument("--tp-ab", action="store_true",
+                    help="run the SAME burst trace through one "
+                    "single-device replica (mp=1 oracle) and one "
+                    "replica spanning a dp1xmp2 mesh of simulated "
+                    "devices under the SAME per-chip page-byte "
+                    "budget; asserts bit-token identity, >= 1.5x "
+                    "residents per chip, zero all-reduces and one "
+                    "output all-gather per layer in the compiled "
+                    "step")
     ap.add_argument("--obs-ab", action="store_true",
                     help="run the SAME Poisson trace with the "
                     "observability layer (request tracer + flight "
@@ -239,6 +267,17 @@ def main():
     ap.add_argument("--out", default="BENCH_serving.json",
                     help="report path ('-' = print only)")
     args = ap.parse_args()
+
+    if args.tp_ab:
+        # the TP arm needs >= 2 devices; on a CPU-only machine force
+        # the virtual 8-device mesh BEFORE jax initializes (the
+        # tests/conftest.py strategy — a no-op when the flag is
+        # already set, e.g. under pytest)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
 
     import jax
     import paddle_tpu as paddle  # noqa: F401
@@ -517,7 +556,7 @@ def main():
 
     report = {
         "bench": "serving",
-        "schema_version": 11,
+        "schema_version": 12,
         "platform": jax.devices()[0].platform,
         "attn_impl": "kernel",
         "requests": n_req,
@@ -674,6 +713,10 @@ def main():
     if args.quant_ab:
         report["quant"] = quant_trace(
             model, cfg, slots=args.slots, seed=args.seed + 4,
+            on_tpu=on_tpu)
+    if args.tp_ab:
+        report["tp"] = tp_trace(
+            model, cfg, slots=args.slots, seed=args.seed + 5,
             on_tpu=on_tpu)
     if args.overload:
         report["overload"] = overload_trace(
@@ -834,13 +877,32 @@ def main():
         assert qt["max_logit_drift"] <= qt["drift_epsilon"], qt
         assert qt["tokens_per_sec_ratio"] is not None \
             and qt["tokens_per_sec_ratio"] >= 1.0, qt
+    if args.tp_ab:
+        tp = report["tp"]
+        # the acceptance numbers: the mesh arm emitted EXACTLY the
+        # oracle's tokens (all-gathers never reassociate fp math —
+        # spanning chips is a capacity move, never a quality knob),
+        # the same per-chip page-byte budget admitted >= 1.5x the
+        # residents at mp=2 (each chip holds 1/mp of every page),
+        # and the compiled step's collective census matches the
+        # model: ZERO all-reduces / reduce-scatters, exactly ONE
+        # output all-gather per layer per step
+        assert tp["token_identical"], "tp mp1/mp2 token mismatch"
+        assert tp["mp1"]["completed"] == tp["mp2"]["completed"] \
+            == tp["requests"], tp
+        assert tp["residents_ratio"] is not None \
+            and tp["residents_ratio"] >= 1.5, tp
+        assert tp["collectives"]["all_reduce"] == 0, tp
+        assert tp["collectives"]["reduce_scatter"] == 0, tp
+        assert tp["output_collectives_per_layer_step"] == 1.0, tp
+        assert tp["collectives"]["all_gather"] == tp["n_layers"], tp
 
 
 def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
               page_size, pages, chunk, attn_impl, prefix_cache=None,
               warm_prompts=(), unified=None, spec=None,
               collect_tokens=False, kv_dtype=None, grouped=None,
-              obs=None):
+              obs=None, mesh=None, collect_collectives=False):
     """One Poisson-trace replay through a fresh engine pinned to
     `attn_impl` (and, for the prefix A/B, to `prefix_cache` on/off;
     for the unified-step A/B, to `unified` on/off; for the spec A/B,
@@ -861,7 +923,7 @@ def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
                         chunk_len=chunk, attn_impl=attn_impl,
                         prefix_cache=prefix_cache, unified=unified,
                         spec=spec, kv_dtype=kv_dtype, grouped=grouped,
-                        obs=obs)
+                        obs=obs, mesh=mesh)
 
     # warm the compiled programs so the trace measures steady state, not
     # XLA compile time: one request per distinct prompt length (chunk
@@ -882,6 +944,9 @@ def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
     eng.metrics.spec = None if eng.spec is None else eng.spec.mode
     eng.metrics.kv_dtype = eng.kv_dtype
     eng.metrics.pool_bytes_per_page = eng.page_bytes
+    eng.metrics.mesh = None if eng.tp is None else eng.tp.shape
+    eng.metrics.mp, eng.metrics.dp = eng.mp, eng.dp
+    eng.metrics.pool_shard_bytes_per_page = eng.page_bytes_per_chip
 
     t0 = time.monotonic()
     submitted = 0
@@ -900,13 +965,126 @@ def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
     wall = time.monotonic() - t0
     out = {"snap": eng.metrics.snapshot(), "wall_s": wall,
            "page_size": eng.page_size, "num_pages": eng.num_pages,
-           "chunk_len": eng.chunk_len, "page_bytes": eng.page_bytes}
+           "chunk_len": eng.chunk_len, "page_bytes": eng.page_bytes,
+           "page_bytes_per_chip": eng.page_bytes_per_chip}
     if collect_tokens:
         out["tokens"] = [list(r.output_tokens) for r in reqs]
+    if collect_collectives and eng.tp is not None:
+        # compiled-HLO ground truth of the sharded step's collectives
+        out["collectives"] = eng.collective_counts()
     if eng.obs is not None:
         out["flight"] = eng.obs.flight.snapshot()
         out["obs_stats"] = eng.obs.stats()
     return out
+
+
+def tp_trace(model, cfg, *, slots, seed, on_tpu, repeats=2):
+    """--tp-ab: one single-device replica (mp=1, the oracle) vs ONE
+    replica spanning a dp1xmp2 mesh, the SAME burst trace, both arms
+    under the SAME PER-CHIP page-byte budget. An mp=2 chip holds a
+    1/mp kv-head slice of every page, so its per-page cost halves and
+    the same per-chip bytes buy 2x the pages — the mp=1 arm is
+    page-starved at the budget, the mesh arm admits ~2x the
+    residents. Tokens are collected and must be BIT-identical (the
+    sharded step's only collective is the bit-exact per-layer output
+    all-gather — the compiled-HLO census in the report proves it:
+    zero all-reduces, exactly one output all-gather per layer)."""
+    from paddle_tpu.serving import ServingEngine
+
+    slots = max(int(slots), 8)
+    if on_tpu:
+        plen, max_new, page_size, max_len, chunk = 64, 64, 16, 256, 64
+    else:
+        plen, max_new, page_size, max_len, chunk = 12, 8, 8, 64, 16
+    n_layers = int(cfg.num_hidden_layers)
+    n_req = 3 * slots
+    req_pages = -(-(plen + max_new) // page_size)
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, cfg.vocab_size, size=plen)
+               .astype(np.int64) for _ in range(n_req)]
+    arrivals = np.zeros(n_req)                 # burst: page-limited
+    budgets = np.full(n_req, max_new)
+
+    # the SAME per-chip byte budget for both arms: enough mp=1 pages
+    # for a third of the slots to hold a full request each; the mesh
+    # arm's per-chip page cost is 1/mp of that, so the same budget
+    # buys mp x the pages
+    probe = ServingEngine(model, num_slots=2, max_len=max_len,
+                          page_size=page_size, num_pages=2,
+                          chunk_len=chunk)
+    chip_page_bytes = {1: probe.page_bytes_per_chip,
+                       2: probe.page_bytes_per_chip // 2}
+    fp_alloc = req_pages * max(2, slots // 3)
+    budget_bytes = fp_alloc * chip_page_bytes[1]
+    pages = {1: fp_alloc + 1,
+             2: int(budget_bytes // chip_page_bytes[2]) + 1}
+
+    runs = {}
+    for mp in (1, 2):
+        attempts = [run_trace(
+            model, arrivals, prompts, budgets, slots=slots,
+            max_len=max_len, page_size=page_size, pages=pages[mp],
+            chunk=chunk, attn_impl="kernel",
+            mesh=(None if mp == 1 else f"dp1mp{mp}"),
+            collect_tokens=True, collect_collectives=True)
+            for _ in range(max(1, repeats))]
+        for a in attempts[1:]:
+            assert a["tokens"] == attempts[0]["tokens"], \
+                "tp arm not deterministic across repeats"
+        runs[mp] = max(attempts,
+                       key=lambda r: r["snap"]["tokens_per_sec"] or 0.0)
+
+    def arm(run):
+        s = run["snap"]
+        occ = s.get("occupancy_hist") or {}
+        peak = int(round((occ.get("max") or 0.0) * slots))
+        trace_tps = (s["tokens_generated"] / run["wall_s"]
+                     if run["wall_s"] > 0 else 0.0)
+        return {
+            "wall_s": round(run["wall_s"], 4),
+            "mesh": s.get("mesh") or "off",
+            "num_pages": run["num_pages"],
+            "page_bytes": run["page_bytes"],
+            "page_bytes_per_chip": run["page_bytes_per_chip"],
+            "chip_pool_bytes": ((run["num_pages"] - 1)
+                                * run["page_bytes_per_chip"]),
+            "tokens_per_sec": trace_tps,
+            "engine_window_tokens_per_sec": s["tokens_per_sec"],
+            "residents_at_peak": peak,
+            "residents_per_chip_hbm_gb":
+                peak / (budget_bytes / 2**30),
+            "ttft_p50_s": s["ttft_s"]["p50"],
+            "ttft_p99_s": s["ttft_s"]["p99"],
+            "completed": s["requests"]["completed"],
+        }
+
+    a1, a2 = arm(runs[1]), arm(runs[2])
+    coll = runs[2]["collectives"]
+    return {
+        "slots": slots,
+        "requests": n_req,
+        "prompt_len": plen,
+        "max_new": max_new,
+        "page_size": page_size,
+        "mesh": "dp1xmp2",
+        "mp": 2,
+        "n_layers": n_layers,
+        "per_chip_budget_bytes": int(budget_bytes),
+        "token_identical": (runs[1]["tokens"] == runs[2]["tokens"]),
+        "residents_ratio": (
+            None if not a1["residents_at_peak"]
+            else a2["residents_at_peak"] / a1["residents_at_peak"]),
+        "tokens_per_sec_ratio": (
+            None if not a1["tokens_per_sec"]
+            else a2["tokens_per_sec"] / a1["tokens_per_sec"]),
+        # compiled-HLO census of the sharded step (the modeled pin:
+        # one output all-gather per layer, nothing else)
+        "collectives": coll,
+        "output_collectives_per_layer_step":
+            coll["all_gather"] / max(1, n_layers),
+        "mp1": a1,
+        "mp2": a2,
+    }
 
 
 def kv_logit_drift(model, cfg, plen, page_size):

@@ -525,12 +525,20 @@ class TestNewModelFamilies:
         paddle.seed(0)
         self._smoke(mobilenet_v3_small(num_classes=10))
 
+    @pytest.mark.slow
     def test_inception_v3(self):
+        # ~45s: the 299x299 forward is the heaviest smoke in the
+        # family — slow lane keeps tier-1 inside its 870s budget
+        # (densenet/googlenet/mobilenet/... forwards stay tier-1)
         from paddle_tpu.vision.models import inception_v3
         paddle.seed(0)
         self._smoke(inception_v3(num_classes=10), size=299)
 
+    @pytest.mark.slow
     def test_densenet_trains(self):
+        # ~70s of eager densenet121 train steps — the convergence
+        # check rides the slow lane; tier-1 keeps the densenet121
+        # forward smoke (test_densenet121)
         from paddle_tpu.vision.models import densenet121
         import paddle_tpu.optimizer as opt
         import paddle_tpu.nn.functional as F
