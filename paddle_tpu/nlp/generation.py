@@ -87,11 +87,11 @@ class DecodeCache:
 
     __slots__ = ("k", "v", "pos", "k_scale", "v_scale", "fresh",
                  "page_table", "attn_impl", "q_len", "group",
-                 "out_shard")
+                 "out_shard", "lora")
 
     def __init__(self, k, v, pos, k_scale=None, v_scale=None,
                  fresh=False, page_table=None, attn_impl=None,
-                 q_len=None, group=None, out_shard=None):
+                 q_len=None, group=None, out_shard=None, lora=None):
         self.k = k
         self.v = v
         self.pos = pos
@@ -137,6 +137,16 @@ class DecodeCache:
         #   its scales always travel together (COW/swap/prefix share).
         self.k_scale = k_scale
         self.v_scale = v_scale
+        # multi-tenant LoRA serving (serving/adapters.py): this
+        # layer's PER-ROW gathered low-rank weights — a 9-tuple of
+        # Tensors (Aq [B, h, R], Bq [B, R, Hq*D], Ak, Bk, Av, Bv
+        # [B, ..., H_kv*D], Ao [B, Hq*D, R], Bo [B, R, h],
+        # scale [B]) the attention module fuses into its q/k/v/o
+        # projections via the `lora_delta` op. None (the default) =
+        # no adapter path traced at all — the base engine's program
+        # is unchanged. Rows at page 0 / scale 0 (base model, idle)
+        # see an exactly-zero delta.
+        self.lora = lora
         # True only on caches straight out of init_decode_caches (pos
         # is provably 0 even when it traces as a jit constant): the
         # int8 multi-token prefill guard keys on this
@@ -164,6 +174,23 @@ def _kv_update_fwd(buf, upd, pos):
 
 
 register_op("kv_cache_update", _kv_update_fwd)
+
+
+def _lora_delta_fwd(x, a, b, scale):
+    """Per-row batched LoRA delta (multi-tenant adapter serving):
+    x [B, W, in] hidden states, a [B, in, R] / b [B, R, out] the rows'
+    GATHERED low-rank pairs (each row carries ITS OWN adapter's
+    weights — tenant identity is operand data, not a trace), scale [B]
+    the per-row LoRA scaling (alpha/r; 0 for base-model rows). Returns
+    `(x @ a) @ b * scale` in x's dtype — rank-R zero padding and the
+    all-zero base page contribute exactly 0, so base rows degenerate
+    bit-exactly."""
+    t = jnp.einsum("bwi,bir->bwr", x, a.astype(x.dtype))
+    d = jnp.einsum("bwr,bro->bwo", t, b.astype(x.dtype))
+    return (d * scale[:, None, None].astype(x.dtype)).astype(x.dtype)
+
+
+register_op("lora_delta", _lora_delta_fwd)
 
 
 def _kv_update_paged_fwd(pool, upd, pos, page_table):
@@ -729,7 +756,7 @@ def _pack_caches(caches):
 
 
 def _unpack_caches(ct, pos, page_table=None, attn_impl=None,
-                   q_len=None, group=None, out_shard=None):
+                   q_len=None, group=None, out_shard=None, lora=None):
     """page_table (optional [B, max_pages] raw int32 array) switches
     every layer's cache into paged-pool mode; the table is shared
     across layers (one page id addresses the same page in each
@@ -741,16 +768,23 @@ def _unpack_caches(ct, pos, page_table=None, attn_impl=None,
     group (optional (group_id, group_leader, group_cnt) triple of [B]
     raw int32 arrays) attaches prefix-sharing groups: the ragged read
     takes the GROUPED walk — each physically shared page streamed
-    once per group — with identical outputs."""
+    once per group — with identical outputs. lora (optional, one
+    entry PER LAYER: a 9-tuple of raw arrays — the per-row gathered
+    A/B pairs for q/k/v/o plus the per-row scale, see
+    serving/adapters.py) attaches that layer's multi-tenant LoRA
+    weights; the attention modules fuse the per-row delta into their
+    projections."""
     pt = None if page_table is None else Tensor(page_table)
     ql = None if q_len is None else Tensor(q_len)
     grp = None if group is None else tuple(Tensor(g) for g in group)
+    lora = ([None] * len(ct) if lora is None
+            else [tuple(Tensor(a) for a in layer) for layer in lora])
     return [DecodeCache(Tensor(k), Tensor(v), Tensor(pos),
                         None if ks is None else Tensor(ks),
                         None if vs is None else Tensor(vs),
                         page_table=pt, attn_impl=attn_impl, q_len=ql,
-                        group=grp, out_shard=out_shard)
-            for k, v, ks, vs in ct]
+                        group=grp, out_shard=out_shard, lora=lo)
+            for (k, v, ks, vs), lo in zip(ct, lora)]
 
 
 def decode_model_step(model, tokens, caches):

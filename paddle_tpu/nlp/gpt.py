@@ -95,17 +95,35 @@ class GPTAttention(nn.Layer):
 
     def forward(self, x, cache=None):
         from ..ops import manipulation
+        from ..ops._helpers import apply_op
         b, l, h = x.shape[0], x.shape[1], self.hidden_size
         qkv = self.qkv_proj(x)
         qkv = manipulation.reshape(qkv, [b, l, self.num_heads,
                                          3 * self.head_dim])
         q, k, v = manipulation.split(qkv, 3, axis=-1)
         from .generation import DecodeCache, update_and_attend
+        # multi-tenant LoRA (serving/adapters.py): per-row low-rank
+        # deltas add AFTER the fused-QKV split (the delta pools are
+        # stored per projection, not in the fused interleaved layout)
+        lora = (cache.lora if isinstance(cache, DecodeCache)
+                else None)
+        if lora is not None:
+            aq, bq, ak, bk, av, bv, ao, bo, sc = lora
+            hd = [b, l, self.num_heads, self.head_dim]
+            q = q + manipulation.reshape(
+                apply_op("lora_delta", x, aq, bq, sc), hd)
+            k = k + manipulation.reshape(
+                apply_op("lora_delta", x, ak, bk, sc), hd)
+            v = v + manipulation.reshape(
+                apply_op("lora_delta", x, av, bv, sc), hd)
         if isinstance(cache, DecodeCache):
             out, new_cache = update_and_attend(q, k, v, cache,
                                                training=False)
             out = manipulation.reshape(out, [b, l, h])
-            return self.out_proj(out), new_cache
+            o = self.out_proj(out)
+            if lora is not None:
+                o = o + apply_op("lora_delta", out, ao, bo, sc)
+            return o, new_cache
         if cache is not None:
             k = manipulation.concat([cache[0], k], axis=1)
             v = manipulation.concat([cache[1], v], axis=1)

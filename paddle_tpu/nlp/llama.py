@@ -119,13 +119,23 @@ class LlamaAttention(nn.Layer):
     def forward(self, x, cache=None):
         from ..ops import manipulation
         b, l = x.shape[0], x.shape[1]
-        q = manipulation.reshape(self.q_proj(x),
-                                 [b, l, self.n_heads, self.head_dim])
-        k = manipulation.reshape(self.k_proj(x),
-                                 [b, l, self.n_kv, self.head_dim])
-        v = manipulation.reshape(self.v_proj(x),
-                                 [b, l, self.n_kv, self.head_dim])
         from .generation import DecodeCache, update_and_attend
+        # multi-tenant LoRA (serving/adapters.py): the cache carries
+        # this layer's PER-ROW gathered A/B pairs; the low-rank delta
+        # adds to each projection BEFORE rope (merged-weight
+        # equivalence: rope((W + BA)x) == rope(Wx + BAx))
+        lora = (cache.lora if isinstance(cache, DecodeCache)
+                else None)
+        qf, kf, vf = self.q_proj(x), self.k_proj(x), self.v_proj(x)
+        if lora is not None:
+            aq, bq, ak, bk, av, bv, ao, bo, sc = lora
+            qf = qf + apply_op("lora_delta", x, aq, bq, sc)
+            kf = kf + apply_op("lora_delta", x, ak, bk, sc)
+            vf = vf + apply_op("lora_delta", x, av, bv, sc)
+        q = manipulation.reshape(qf,
+                                 [b, l, self.n_heads, self.head_dim])
+        k = manipulation.reshape(kf, [b, l, self.n_kv, self.head_dim])
+        v = manipulation.reshape(vf, [b, l, self.n_kv, self.head_dim])
         if isinstance(cache, DecodeCache):
             q = apply_rotary(q, cache.pos, self.theta)
             k = apply_rotary(k, cache.pos, self.theta)
@@ -133,7 +143,10 @@ class LlamaAttention(nn.Layer):
                                                training=False)
             out = manipulation.reshape(
                 out, [b, l, self.n_heads * self.head_dim])
-            return self.o_proj(out), new_cache
+            o = self.o_proj(out)
+            if lora is not None:
+                o = o + apply_op("lora_delta", out, ao, bo, sc)
+            return o, new_cache
         offset = cache[0].shape[1] if cache is not None else 0
         q = apply_rotary(q, offset, self.theta)
         k = apply_rotary(k, offset, self.theta)

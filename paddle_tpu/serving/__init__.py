@@ -57,6 +57,19 @@ bit-exact output all-gather per layer (mp>1 is bit-token-identical
 to the mp=1 oracle; serving_bench --tp-ab pins the collective count
 and the residents-per-chip win).
 
+One fleet can serve MANY TENANTS (serving/adapters.py, default off,
+PADDLE_TPU_ADAPTERS=on / ServingEngine(adapters=...)): registered
+LoRA fine-tunes (per-layer A/B pairs, rank-bucketed) live in a paged
+ADAPTER pool under the same PagePool refcount/park/evict/spill
+discipline as the KV pages, per-slot adapter ids ride the unified
+step as operand data, and each row's low-rank delta fuses into the
+q/k/v/o projections in-trace — a batch mixing N tenants plus
+base-model rows is still the ONE compiled program, and each tenant's
+stream is bit-token-identical to a solo dense-merged (W + B·A)
+engine. HTTP picks tenants via the OpenAI-style `model=` field; the
+prefix cache is tenant-namespaced; the router places by adapter
+affinity.
+
 OVERLOAD degrades gracefully instead of refusing (default on,
 PADDLE_TPU_PREEMPT / ServingEngine(preempt=...)): requests carry
 `priority` + placement `deadline_s`, the queue orders by (priority,
@@ -69,6 +82,9 @@ Greedy requests are bit-identical to offline CompiledGenerator decode
 (tested); `scripts/serving_bench.py` drives a Poisson arrival trace and
 reports TTFT/throughput/pool utilization into BENCH_serving.json.
 """
+from .adapters import (AdapterStore, LoRAWeights,  # noqa: F401
+                       make_random_lora, resolve_adapters_flag,
+                       BASE_ADAPTER)
 from .engine import (ServingEngine, resolve_grouped_flag,  # noqa: F401
                      resolve_kv_dtype, resolve_preempt_flag,
                      resolve_unified_flag)
@@ -95,7 +111,9 @@ from .scheduler import Scheduler  # noqa: F401
 from .spec import (Drafter, NgramDrafter, SpecConfig,  # noqa: F401
                    resolve_spec_config)
 
-__all__ = ["ServingEngine", "resolve_unified_flag",
+__all__ = ["AdapterStore", "LoRAWeights", "make_random_lora",
+           "resolve_adapters_flag", "BASE_ADAPTER",
+           "ServingEngine", "resolve_unified_flag",
            "resolve_preempt_flag", "resolve_kv_dtype",
            "resolve_grouped_flag", "shared_prefix_groups", "Scheduler",
            "ServingMetrics", "Histogram",

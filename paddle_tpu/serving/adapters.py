@@ -1,0 +1,485 @@
+"""Multi-tenant LoRA adapter serving: a paged adapter pool.
+
+One fleet serving thousands of cheap fine-tunes is the actual shape of
+"millions of users": most tenants share one base model and differ only
+by a low-rank delta on the attention projections (LoRA — per layer a
+pair A [in, r], B [r, out] per projection, applied as
+`y = x @ W + (x @ A) @ B * scale`). Serving them as separate models
+would cost a full weight set per tenant; serving them as per-request
+weight SWAPS would retrace or reload on every tenant switch. This
+module makes tenant identity pure OPERAND DATA instead:
+
+- **AdapterStore** — the registry plus a PAGED ADAPTER POOL holding
+  device-resident A/B weights with exactly the `PagePool` discipline
+  the KV pages already live under. One pool page = one adapter's
+  whole per-layer A/B block (all layers, q/k/v/o projections, padded
+  to the pool rank); page 0 is the reserved ZERO page — all-zero A/B,
+  so `adapter_id 0` (the base model) degenerates to a bit-exact
+  no-op delta. An adapter is REFCOUNTED while any resident slot uses
+  it (eviction can never touch it), PARKS hot (cache-resident) when
+  its last user retires, and under page pressure is SPILLED
+  whole-page to a host-RAM tier (`HostPagePool`) or EVICTED LRU —
+  either way it restores on demand (from the host copy, else
+  re-uploaded from the registry: adapter weights are immutable, so
+  eviction loses residency, never data).
+
+- **Rank buckets** — registered ranks are rounded UP to a fixed small
+  set (`rank_buckets`, default (2, 4, 8)) and zero-padded; the device
+  pool itself carries ONE fixed rank (the largest bucket), so the
+  per-row gathered A/B shapes never change and the ONE unified step
+  never retraces across tenants, ranks, loads, evictions or
+  restores. Zero padding is exact: padded rows/columns contribute
+  exactly 0 to `x @ A @ B`.
+
+- **Batched multi-adapter execution** — the engine rides a per-slot
+  `adapter_page` vector (plus a per-slot `scale`) next to
+  `pos`/`q_len` as step operands; inside the one compiled step each
+  layer gathers its rows' A/B pages from the pool and the attention
+  modules fuse the low-rank delta into the q/k/v (and o) projections
+  (`lora_delta` op, nlp/generation.py). A batch mixing N tenants and
+  base-model rows compiles to the SAME single program.
+
+Upload/restore run through ONE jitted write program over a traced
+page id (the COW-copy discipline of serving/engine.py), so adapter
+churn never adds a trace either.
+
+Correctness contract (tests/test_serving_adapters.py): a request
+served under adapter `i` in a mixed-tenant batch emits tokens
+bit-identical to serving it alone on a DENSE-MERGED model
+(`W + B·A·scale` folded into the projection weights) — through prefix
+caching (tenant-namespaced), eviction/spill churn, preemption,
+speculation and tensor-parallel meshes.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from .paging import HostPagePool, PagePool
+
+__all__ = ["AdapterStore", "LoRAWeights", "resolve_adapters_flag",
+           "make_random_lora", "BASE_ADAPTER", "ADAPTER_PROJS"]
+
+# adapter_id 0 IS the base model: its pool page is the reserved
+# all-zero page 0, so base rows ride the same gathered-delta path and
+# degenerate exactly (x @ 0 @ 0 * 0 == 0)
+BASE_ADAPTER = 0
+
+# the projections an adapter patches, in pool order (A then B each)
+ADAPTER_PROJS = ("q", "k", "v", "o")
+
+ADAPTER_MODES = ("on", "off")
+
+
+def resolve_adapters_flag(override=None) -> bool:
+    """Whether the engine builds the multi-tenant adapter subsystem
+    (default off: engines that never see a `model=`/adapter_id keep
+    their exact pre-adapter trace — zero extra operands or compute).
+    An explicit override wins (None defers; True/False/an
+    AdapterStore-shaped config forces); otherwise
+    PADDLE_TPU_ADAPTERS=on|off, read at engine construction like
+    every other serving gate."""
+    if override is not None:
+        return bool(override)
+    v = os.environ.get("PADDLE_TPU_ADAPTERS", "off")
+    if v not in ADAPTER_MODES:
+        raise ValueError(
+            f"PADDLE_TPU_ADAPTERS must be one of {ADAPTER_MODES}, "
+            f"got {v!r}")
+    return v == "on"
+
+
+class LoRAWeights:
+    """One adapter's host-side weights: per layer, per projection
+    (q/k/v/o) a pair (A [in, r], B [r, out]). `layers` is a list of
+    dicts `{"q": (A, B), "k": ..., "v": ..., "o": ...}`; missing
+    projections mean "no delta" (all-zero)."""
+
+    def __init__(self, layers: Sequence[Dict[str, Tuple]], rank: int,
+                 alpha: Optional[float] = None):
+        self.layers = list(layers)
+        self.rank = int(rank)
+        if self.rank < 1:
+            raise ValueError("LoRA rank must be >= 1")
+        # the standard LoRA scaling alpha / r (alpha defaults to r:
+        # scale 1.0 — the delta as registered)
+        self.alpha = float(alpha) if alpha is not None else float(rank)
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def make_random_lora(n_layers: int, hidden: int, q_out: int,
+                     kv_out: int, rank: int, rng, amp: float = 0.05
+                     ) -> LoRAWeights:
+    """A random adapter for tests/benches: every projection patched,
+    N(0, amp) entries — big enough to change greedy argmax on tiny
+    models, small enough to keep logits finite."""
+    def pair(in_f, out_f):
+        return (rng.normal(0.0, amp, size=(in_f, rank)),
+                rng.normal(0.0, amp, size=(rank, out_f)))
+    layers = []
+    for _ in range(n_layers):
+        layers.append({"q": pair(hidden, q_out),
+                       "k": pair(hidden, kv_out),
+                       "v": pair(hidden, kv_out),
+                       "o": pair(q_out, hidden)})
+    return LoRAWeights(layers, rank=rank)
+
+
+class _Adapter:
+    """One registered adapter's lifecycle record."""
+
+    __slots__ = ("adapter_id", "name", "rank", "bucket", "scale",
+                 "payload", "page", "host_slot", "last_used")
+
+    def __init__(self, adapter_id: int, name: str, rank: int,
+                 bucket: int, scale: float, payload: List[np.ndarray]):
+        self.adapter_id = adapter_id
+        self.name = name
+        self.rank = rank            # registered rank
+        self.bucket = bucket        # rank bucket it was padded to
+        self.scale = scale
+        # pool-shaped (padded to the POOL rank) per-layer arrays, in
+        # pool order [Aq, Bq, Ak, Bk, Av, Bv, Ao, Bo] x n_layers —
+        # the upload source AND (immutable) the restore-of-last-resort
+        self.payload = payload
+        self.page: Optional[int] = None       # device pool page
+        self.host_slot: Optional[int] = None  # host tier slot
+        self.last_used = 0
+
+    @property
+    def state(self) -> str:
+        if self.page is not None:
+            return "resident"
+        if self.host_slot is not None:
+            return "spilled"
+        return "registered"
+
+
+class AdapterStore:
+    """Registry + paged device pool of LoRA adapters for ONE engine.
+
+    Device state: per layer a tuple of eight pool tensors
+    (Aq [P, hidden, R], Bq [P, R, q_out], Ak/Av [P, hidden, R],
+    Bk/Bv [P, R, kv_out], Ao [P, q_out, R], Bo [P, R, hidden]) — page
+    p of every tensor holds one adapter's block for that layer, R is
+    the pool rank (max rank bucket). Page 0 is the reserved zero page
+    (the base model / idle rows). The pools are STEP ARGUMENTS of the
+    engine's one compiled program, never closed-over constants, so
+    uploads and evictions swap data, not traces.
+
+    Host state: `PagePool` bookkeeping (FREE/USED/CACHED/SWAPPED — an
+    adapter referenced by a resident slot can never be evicted), a
+    `HostPagePool` spill tier, and the registry of host payloads.
+
+    Thread-safety: mutations happen on the engine's pump thread
+    between compiled steps, like the KV pool; `stats()`/`debug()`
+    take a lock only against torn scrape reads.
+    """
+
+    def __init__(self, n_layers: int, hidden: int, q_out: int,
+                 kv_out: int, *, num_pages: int = 9,
+                 rank_buckets: Sequence[int] = (2, 4, 8),
+                 dtype=np.float32, host_pages: Optional[int] = None,
+                 tp=None):
+        self.n_layers = int(n_layers)
+        self.hidden = int(hidden)
+        self.q_out = int(q_out)
+        self.kv_out = int(kv_out)
+        self.rank_buckets = tuple(sorted(int(b) for b in rank_buckets))
+        if not self.rank_buckets or self.rank_buckets[0] < 1:
+            raise ValueError("rank_buckets must be >= 1")
+        self.rank = self.rank_buckets[-1]      # the pool rank R
+        self.num_pages = int(num_pages)
+        self.dtype = dtype
+        self.tp = tp
+        self.pool = PagePool(self.num_pages)
+        self.host_pool = HostPagePool(
+            self.num_pages - 1 if host_pages is None else int(host_pages))
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)         # 0 is the base model
+        self._by_name: Dict[str, int] = {}
+        self._recs: Dict[int, _Adapter] = {}
+        self._tick = itertools.count(1)
+        # traffic counters (mirrored into ServingMetrics each step)
+        self.loads_total = 0          # registry -> device uploads
+        self.evictions_total = 0      # device copy dropped outright
+        self.spills_total = 0         # device -> host tier moves
+        self.restores_total = 0       # host tier -> device moves
+        self._write_fn = None         # ONE jitted upload per store
+        # device pools: page 0 all zeros = the base model's "delta"
+        P, R = self.num_pages, self.rank
+        self.pools = tuple(
+            (jnp.zeros((P, self.hidden, R), dtype),
+             jnp.zeros((P, R, self.q_out), dtype),
+             jnp.zeros((P, self.hidden, R), dtype),
+             jnp.zeros((P, R, self.kv_out), dtype),
+             jnp.zeros((P, self.hidden, R), dtype),
+             jnp.zeros((P, R, self.kv_out), dtype),
+             jnp.zeros((P, self.q_out, R), dtype),
+             jnp.zeros((P, R, self.hidden), dtype))
+            for _ in range(self.n_layers))
+        if tp is not None:
+            # mesh placement mirrors the engine's column-parallel head
+            # sharding: the B matrices feeding q/k/v shard over their
+            # head-grouped OUTPUT dim (their delta adds to a sharded
+            # projection — no collective), everything else replicates
+            # (the o-side delta applies after the output all-gather)
+            self.pools = tuple(
+                (tp.replicate(aq), tp.place_adapter_col(bq),
+                 tp.replicate(ak), tp.place_adapter_col(bk),
+                 tp.replicate(av), tp.place_adapter_col(bv),
+                 tp.replicate(ao), tp.replicate(bo))
+                for (aq, bq, ak, bk, av, bv, ao, bo) in self.pools)
+
+    # -- registry ----------------------------------------------------------
+    def bucket_for(self, rank: int) -> int:
+        """Smallest rank bucket >= rank; a rank above every bucket is
+        a registration error (the pool's compiled shapes cap it)."""
+        for b in self.rank_buckets:
+            if rank <= b:
+                return b
+        raise ValueError(
+            f"LoRA rank {rank} exceeds the largest rank bucket "
+            f"{self.rank_buckets[-1]}; legal buckets: "
+            f"{self.rank_buckets} (grow rank_buckets at engine "
+            "construction)")
+
+    def _pad_payload(self, w: LoRAWeights) -> List[np.ndarray]:
+        """Registered (A, B) pairs -> pool-shaped arrays: zero-padded
+        from the registered rank to the POOL rank R (exact — padded
+        rows/cols contribute 0 to x @ A @ B), missing projections
+        all-zero."""
+        if len(w.layers) != self.n_layers:
+            raise ValueError(
+                f"adapter patches {len(w.layers)} layers but the "
+                f"model has {self.n_layers}")
+        R = self.rank
+        shapes = {"q": (self.hidden, self.q_out),
+                  "k": (self.hidden, self.kv_out),
+                  "v": (self.hidden, self.kv_out),
+                  "o": (self.q_out, self.hidden)}
+        out: List[np.ndarray] = []
+        for li, layer in enumerate(w.layers):
+            for proj in ADAPTER_PROJS:
+                in_f, out_f = shapes[proj]
+                a_pad = np.zeros((in_f, R), np.float64)
+                b_pad = np.zeros((R, out_f), np.float64)
+                pair = layer.get(proj)
+                if pair is not None:
+                    a, b = (np.asarray(pair[0]), np.asarray(pair[1]))
+                    if a.shape != (in_f, w.rank) or \
+                            b.shape != (w.rank, out_f):
+                        raise ValueError(
+                            f"layer {li} proj {proj!r}: A/B shapes "
+                            f"{a.shape}/{b.shape} do not match "
+                            f"(in={in_f}, rank={w.rank}, out={out_f})")
+                    a_pad[:, :w.rank] = a
+                    b_pad[:w.rank, :] = b
+                out.append(a_pad.astype(self.dtype))
+                out.append(b_pad.astype(self.dtype))
+        return out
+
+    def register(self, name: str, weights: LoRAWeights) -> int:
+        """Register one adapter under `name`; returns its adapter_id
+        (stable for the store's lifetime — replicas registering the
+        same adapters in the same order agree on ids). Registration is
+        host-side only: nothing touches the device until a request
+        under this id is admitted."""
+        with self._lock:
+            if name in self._by_name:
+                raise ValueError(f"adapter {name!r} already registered")
+            bucket = self.bucket_for(weights.rank)
+            # ids are unbounded — PAGES are the bounded resource; a
+            # fleet may register far more adapters than fit resident
+            aid = next(self._ids)
+            rec = _Adapter(aid, name, weights.rank, bucket,
+                           weights.scale, self._pad_payload(weights))
+            self._recs[aid] = rec
+            self._by_name[name] = aid
+        return aid
+
+    def id_for(self, name: str) -> Optional[int]:
+        """adapter_id registered under `name`; None if unknown."""
+        with self._lock:
+            return self._by_name.get(name)
+
+    def name_of(self, adapter_id: int) -> str:
+        if adapter_id == BASE_ADAPTER:
+            return "base"
+        return self._recs[adapter_id].name
+
+    def known(self, adapter_id: int) -> bool:
+        return adapter_id == BASE_ADAPTER or adapter_id in self._recs
+
+    @property
+    def registered(self) -> int:
+        return len(self._recs)
+
+    def scale_of(self, adapter_id: int) -> float:
+        if adapter_id == BASE_ADAPTER:
+            return 0.0
+        return self._recs[adapter_id].scale
+
+    # -- device upload (ONE trace) -----------------------------------------
+    def _build_write(self):
+        import jax
+
+        def wr(pools, page, payload):
+            out = []
+            i = 0
+            for layer in pools:
+                out.append(tuple(
+                    t.at[page].set(payload[i + j].astype(t.dtype))
+                    for j, t in enumerate(layer)))
+                i += len(layer)
+            return tuple(out)
+        return jax.jit(wr)
+
+    def _upload(self, rec: _Adapter, page: int):
+        if self._write_fn is None:
+            self._write_fn = self._build_write()
+        payload = [jnp.asarray(a) for a in rec.payload]
+        self.pools = self._write_fn(self.pools, jnp.int32(page),
+                                    payload)
+
+    # -- residency (the paged-pool lifecycle) ------------------------------
+    def _free_one_page(self) -> bool:
+        """Make room: SPILL the LRU parked adapter to the host tier
+        (device page frees, host copy restores cheaper than a
+        re-upload accounting-wise), else EVICT it outright (the
+        registry still holds the weights — eviction loses residency,
+        never data). Returns False when every resident adapter is
+        referenced by a running slot (nothing may be touched)."""
+        victim = None
+        for rec in self._recs.values():
+            if rec.page is None or self.pool.refcount(rec.page) != 0:
+                continue
+            if victim is None or rec.last_used < victim.last_used:
+                victim = rec
+        if victim is None:
+            return False
+        slot = self.host_pool.store(victim.payload)
+        if slot is not None:
+            self.pool.swap_out([victim.page], spill=True)
+            victim.host_slot = slot
+            self.spills_total += 1
+        else:
+            self.pool.free([victim.page])
+            self.evictions_total += 1
+        victim.page = None
+        return True
+
+    def acquire(self, adapter_id: int
+                ) -> Optional[Tuple[int, float]]:
+        """Admission-side residency claim: make `adapter_id` device-
+        resident (upload / restore, spilling or evicting LRU parked
+        adapters under pressure) and take one reference on its page —
+        a referenced adapter can never be evicted from under a
+        resident slot. Returns (pool page, LoRA scale), or None when
+        the pool is full of REFERENCED adapters (the engine's
+        admission backpressure: the request waits). adapter_id 0 (the
+        base model) is always (page 0, 0.0) at zero cost."""
+        if adapter_id == BASE_ADAPTER:
+            return (0, 0.0)
+        rec = self._recs.get(adapter_id)
+        if rec is None:
+            raise ValueError(f"unknown adapter_id {adapter_id}")
+        if rec.page is None:
+            pages = self.pool.alloc(1)
+            if pages is None and self._free_one_page():
+                pages = self.pool.alloc(1)
+            if pages is None:
+                return None
+            page = pages[0]
+            if rec.host_slot is not None:
+                # restore the spilled copy (and close the host-tier
+                # obligation the spill opened)
+                self._upload(rec, page)
+                self.host_pool.free(rec.host_slot)
+                self.pool.swapped_restored(1, spill=True)
+                rec.host_slot = None
+                self.restores_total += 1
+            else:
+                self._upload(rec, page)
+                self.loads_total += 1
+            rec.page = page
+            # alloc() granted the first reference — no retain needed
+        else:
+            # already resident (parked or shared): take one more ref;
+            # a parked page leaves the cache-resident state here
+            self.pool.retain([rec.page])
+        rec.last_used = next(self._tick)
+        return (rec.page, rec.scale)
+
+    def release(self, adapter_id: int):
+        """A resident slot retired: drop one reference; an adapter
+        nobody uses PARKS hot (cache-resident — the next request
+        under it pays nothing) instead of freeing."""
+        if adapter_id == BASE_ADAPTER:
+            return
+        rec = self._recs[adapter_id]
+        zeroed = self.pool.release([rec.page])
+        if zeroed:
+            self.pool.park(zeroed)
+
+    def hot_ids(self) -> List[int]:
+        """Adapter ids currently device-resident (referenced or
+        parked) — the router's affinity signal."""
+        return [aid for aid, rec in self._recs.items()
+                if rec.page is not None]
+
+    def is_hot(self, adapter_id: int) -> bool:
+        if adapter_id == BASE_ADAPTER:
+            return True
+        rec = self._recs.get(adapter_id)
+        return rec is not None and rec.page is not None
+
+    # -- introspection ------------------------------------------------------
+    def assert_quiesced(self):
+        """Engine-shutdown leak check (rides the KV pool's): every
+        adapter page FREE or parked CACHED — no slot reference
+        survived retirement. Spilled pages are legitimate long-lived
+        state (PagePool's spill kind)."""
+        self.pool.assert_quiesced()
+
+    def stats(self) -> dict:
+        with self._lock:
+            states = [r.state for r in self._recs.values()]
+            return {
+                "registered": len(self._recs),
+                "resident": states.count("resident"),
+                "spilled": states.count("spilled"),
+                "pages_used": self.pool.used_pages,
+                "pages_cached": self.pool.cached_pages,
+                "pages_swapped": self.pool.swapped_pages,
+                "pages_total": self.num_pages - 1,
+                "host_pages_used": self.host_pool.used_pages,
+                "loads_total": self.loads_total,
+                "evictions_total": self.evictions_total,
+                "spills_total": self.spills_total,
+                "restores_total": self.restores_total,
+            }
+
+    def debug(self) -> List[dict]:
+        """Per-adapter rows for `GET /debug/state`: id, name, rank
+        (registered and bucket), refcount, residency state."""
+        with self._lock:
+            out = []
+            for aid in sorted(self._recs):
+                rec = self._recs[aid]
+                out.append({
+                    "adapter_id": aid, "name": rec.name,
+                    "rank": rec.rank, "rank_bucket": rec.bucket,
+                    "scale": rec.scale, "state": rec.state,
+                    "page": rec.page,
+                    "refcount": (0 if rec.page is None
+                                 else self.pool.refcount(rec.page))})
+            return out

@@ -131,6 +131,24 @@ traffic host-side each step, feeding the page_block_reads /
 shared_page_reads_saved counters and the group-size histogram the
 `--prefix-share` A/B asserts on.
 
+MULTI-TENANT ADAPTERS (serving/adapters.py, default off, gated
+`adapters=...` / PADDLE_TPU_ADAPTERS): thousands of LoRA fine-tunes
+of one base model share this engine. Registered per-layer A/B pairs
+(rank-bucketed, zero-padded to one pool rank so shapes never change)
+live in a PAGED ADAPTER POOL with the KV pool's exact PagePool
+discipline — refcounted while a resident slot decodes under them,
+parked hot when idle, spilled to a host tier or evicted LRU under
+pressure, restored on demand. A per-slot adapter-page vector (+
+scale) rides next to pos/q_len as operand data; inside the ONE
+unified step each layer gathers its rows' A/B pages and the
+attention modules fuse the per-row low-rank delta into the q/k/v/o
+projections (`lora_delta`). adapter_id 0 is the base model (the
+all-zero page 0 — exact degeneration), so mixed-tenant batches
+compile to the same single program, and every tenant's stream is
+bit-token-identical to a solo engine running the dense-merged
+(W + B·A·scale) weights. The prefix cache namespaces its radix tree
+by adapter id — tenants never share KV pages.
+
 MULTI-CHIP TENSOR PARALLELISM (serving/tp.py, default off, gated
 `mesh=...` / PADDLE_TPU_MESH=dpXmpY): one engine spans a (dp, mp)
 device mesh while compiling the SAME one unified step — per-layer KV
@@ -175,6 +193,8 @@ from ..nlp.generation import (_pack_caches, _top_p_filter,
                               _unpack_caches, decode_model_step,
                               resolve_paged_attn_impl, FP8_DTYPE)
 from ..ops.pallas.paged_attention import count_page_block_reads
+from .adapters import (AdapterStore, BASE_ADAPTER,
+                       resolve_adapters_flag)
 from .errors import DeadlineExceeded, EngineClosed, PoisonedRequest
 from .metrics import ServingMetrics
 from .obs import EngineObs, resolve_obs_flag
@@ -190,7 +210,8 @@ from .tp import ServingTP, collective_counts, resolve_serving_mesh
 __all__ = ["ServingEngine", "resolve_unified_flag",
            "resolve_preempt_flag", "resolve_kv_dtype",
            "resolve_grouped_flag", "resolve_obs_flag",
-           "resolve_serving_mesh", "ServingTP"]
+           "resolve_adapters_flag", "resolve_serving_mesh",
+           "ServingTP"]
 
 # finish reason -> timeline event kind (the 5xx/4xx taxonomy keeps
 # its own event names so a timeline's last event says WHY at a
@@ -353,7 +374,9 @@ class ServingEngine:
                  preempt=None, host_pages: Optional[int] = None,
                  kv_dtype: Optional[str] = None, grouped=None,
                  obs=None, flight_steps: Optional[int] = None,
-                 mesh=None):
+                 mesh=None, adapters=None,
+                 adapter_pages: Optional[int] = None,
+                 adapter_ranks: Optional[Sequence[int]] = None):
         if cache_spec is None:
             if not hasattr(model, "_decode_cache_spec"):
                 raise ValueError(
@@ -483,6 +506,49 @@ class ServingEngine:
             (t._value.dtype for t in self._state_tensors
              if jnp.issubdtype(t._value.dtype, jnp.floating)),
             dtypes.get_default_dtype().np_dtype)
+        # multi-tenant LoRA adapters (serving/adapters.py, default
+        # off, gated ServingEngine(adapters=...) /
+        # PADDLE_TPU_ADAPTERS=on): a paged ADAPTER pool next to the
+        # paged KV pool — registered LoRA A/B weights live in
+        # device-resident pool pages under the PagePool
+        # refcount/park/evict/spill discipline, a per-slot
+        # adapter-page vector rides next to pos/q_len as step operand
+        # data, and each layer's attention fuses the per-row low-rank
+        # delta into its q/k/v/o projections inside the ONE unified
+        # step. adapter_id 0 is the base model (the all-zero page 0 —
+        # exact degeneration), so mixed-tenant batches and pure base
+        # traffic compile to the same single program.
+        adapters_on = (isinstance(adapters, AdapterStore)
+                       or resolve_adapters_flag(adapters))
+        if adapters_on and not self.unified:
+            raise ValueError(
+                "multi-tenant adapters require the unified ragged "
+                "step: the per-row gathered LoRA delta rides the ONE "
+                "compiled program (set unified=True / "
+                "PADDLE_TPU_UNIFIED_STEP=on or drop adapters)")
+        if isinstance(adapters, AdapterStore):
+            self.adapters: Optional[AdapterStore] = adapters
+        elif adapters_on:
+            cfgm = getattr(model, "config", None)
+            hidden = int(getattr(cfgm, "hidden_size",
+                                 self.n_kv * self.head_dim))
+            n_heads = int(getattr(cfgm, "num_attention_heads",
+                                  self.n_kv))
+            self.adapters = AdapterStore(
+                self.n_layers, hidden, n_heads * self.head_dim,
+                self.n_kv * self.head_dim,
+                num_pages=(8 if adapter_pages is None
+                           else int(adapter_pages)) + 1,
+                rank_buckets=(adapter_ranks or (2, 4, 8)),
+                dtype=self._fp, tp=self.tp)
+        else:
+            self.adapters = None
+        # per-slot adapter operands (step DATA, like pos/q_len): the
+        # slot's adapter-pool page and LoRA scale — page 0 / scale 0
+        # for base-model and idle rows
+        self._apage = np.zeros((self.num_slots,), np.int32)
+        self._ascale = np.zeros((self.num_slots,), np.float32)
+        self._slot_adapter: Dict[int, int] = {}
         # paged-pool dtype (PADDLE_TPU_KV_DTYPE / kv_dtype=, default
         # "fp"): "int8" swaps every layer's float pools for int8 CODE
         # pages plus rowwise f32 SCALE pages [num_pages, page_size,
@@ -542,6 +608,12 @@ class ServingEngine:
                               + scale_bytes))
         self.metrics.kv_dtype = self.kv_dtype
         self.metrics.pool_bytes_per_page = self.page_bytes
+        self.metrics.adapters_enabled = self.adapters is not None
+        if self.adapters is not None:
+            # seed the pool gauges so a scrape before the first step
+            # already shows the adapter tier (same pattern as the
+            # host-tier capacity gauges below)
+            self.metrics.adapter_stats = self.adapters.stats()
         # per-CHIP page cost: each of the mp shards holds a 1/mp
         # kv-head slice of every page — the denominator of the
         # residents-per-chip-HBM economics the --tp-ab bench reports
@@ -779,7 +851,7 @@ class ServingEngine:
 
         def ustep(state_vals, ct, pos, last_logits, page_table, tokens,
                   q_len, is_decode, key, temps, top_k, top_p, greedy,
-                  group=None):
+                  group=None, lora=None):
             originals = self._swap_state(state_vals)
             try:
                 nxt = _sample_rows(last_logits, key, temps, top_k,
@@ -789,10 +861,23 @@ class ServingEngine:
                         == 0)[None, :]
                 toks = jnp.where(is_decode[:, None] & col0,
                                  nxt[:, None], tokens)
+                # multi-tenant adapters: gather each row's A/B block
+                # from the paged adapter pool by the per-slot page
+                # operand — pure data movement inside the one trace,
+                # so tenant churn/eviction/restore never retraces.
+                # Base-model and idle rows gather the all-zero page 0
+                # at scale 0: an exactly-zero delta.
+                lora_layers = None
+                if lora is not None:
+                    apools, apage, ascale = lora
+                    lora_layers = [
+                        tuple(t[apage] for t in layer) + (ascale,)
+                        for layer in apools]
                 caches = _unpack_caches(ct, pos, page_table,
                                         attn_impl=self.attn_impl,
                                         q_len=q_len, group=group,
-                                        out_shard=self._out_shard)
+                                        out_shard=self._out_shard,
+                                        lora=lora_layers)
                 logits_t, caches = model(Tensor(toks), caches=caches)
                 lg = logits_t._value.astype(jnp.float32)   # [S, W, V]
                 # greedy draft verification: column i's argmax is the
@@ -823,20 +908,25 @@ class ServingEngine:
             finally:
                 self._restore_state(originals)
 
-        if self.grouped:
-            # prefix-sharing groups ride as three extra [S] int32
-            # operands (group_id, group_leader, group_cnt) — operand
-            # DATA next to pos/q_len, so regrouping between steps
-            # never retraces the one program
-            return jax.jit(
-                lambda ct, pos, ll, pt, tokens, q_len, isd, key, t, k,
-                p, g, gid, gld, gcn: ustep(
-                    state_vals, ct, pos, ll, pt, tokens, q_len, isd,
-                    key, t, k, p, g, group=(gid, gld, gcn)))
-        return jax.jit(
-            lambda ct, pos, ll, pt, tokens, q_len, isd, key, t, k, p,
-            g: ustep(state_vals, ct, pos, ll, pt, tokens, q_len, isd,
-                     key, t, k, p, g))
+        # operand-tail layout (matches _unified_step's args_tail):
+        # the 11 base operands, then — each optional, resolved at
+        # trace-build time from the engine's gates — the 3 adapter
+        # operands (pool pytree, per-slot page, per-slot scale) and
+        # the 3 grouped-walk operands. Adapter pools/pages and groups
+        # are DATA next to pos/q_len: churn never retraces.
+        lora_on, grouped = self.adapters is not None, self.grouped
+
+        def call(ct, *args):
+            base, rest = args[:11], args[11:]
+            i = 0
+            lora = None
+            if lora_on:
+                lora = (rest[0], rest[1], rest[2])
+                i = 3
+            group = tuple(rest[i:i + 3]) if grouped else None
+            return ustep(state_vals, ct, *base, group=group,
+                         lora=lora)
+        return jax.jit(call)
 
     def _build_copy_page(self):
         """ONE compiled single-page pool copy for copy-on-write: src and
@@ -1011,6 +1101,20 @@ class ServingEngine:
                 f"request needs {need} pages but the pool only has "
                 f"{self.num_pages - 1} allocatable pages; grow "
                 "num_pages or lower max_new_tokens")
+        aid = int(getattr(sampling, "adapter_id", 0) or 0)
+        if aid != BASE_ADAPTER:
+            if self.adapters is None:
+                raise ValueError(
+                    f"request carries adapter_id {aid} but this "
+                    "engine has no adapter subsystem (enable it via "
+                    "ServingEngine(adapters=True) / "
+                    "PADDLE_TPU_ADAPTERS=on and register the "
+                    "adapter first)")
+            if not self.adapters.known(aid):
+                raise ValueError(
+                    f"unknown adapter_id {aid}: register the adapter "
+                    "on this engine's AdapterStore before submitting "
+                    "requests under it")
         if request_id is None:
             request_id = f"req-{next(self._id_counter)}"
         if request_id in self._requests:
@@ -1020,6 +1124,8 @@ class ServingEngine:
         self.scheduler.submit(req)     # may shed load (max_queue)
         self._requests[request_id] = req
         self.metrics.on_submit(req)
+        if self.adapters is not None:
+            self.metrics.on_adapter_request(aid)
         self._obs_event(req, "submit", prompt_len=int(prompt.size),
                         priority=int(sampling.priority),
                         queue_depth=self.scheduler.queue_depth)
@@ -1099,6 +1205,15 @@ class ServingEngine:
             req._prefix_grant = None
             self._pt_host[slot, :] = TRASH_PAGE
             self._pt_dirty = True
+            self._apage[slot] = 0
+            self._ascale[slot] = 0.0
+            self._slot_adapter.pop(slot, None)
+        if req._adapter_held:
+            # drop the adapter reference: nobody else using it parks
+            # it hot in the pool (the next tenant request pays zero)
+            self.adapters.release(
+                int(getattr(req.sampling, "adapter_id", 0) or 0))
+            req._adapter_held = False
         self._release_swap(req)   # preempted-and-never-resumed cleanup
         # retire the id: duplicate detection guards LIVE requests only,
         # and a router re-placing a migrated request may legitimately
@@ -1127,7 +1242,10 @@ class ServingEngine:
                 req.prompt_ids.astype(np.int64),
                 np.asarray(req.output_tokens, np.int64)])
             self.prefix_cache.insert(
-                seq, pages, req.prompt_ids.size + len(req.output_tokens))
+                seq, pages,
+                req.prompt_ids.size + len(req.output_tokens),
+                adapter_id=int(getattr(req.sampling, "adapter_id", 0)
+                               or 0))
         else:
             self.prefix_cache.release(pages)
 
@@ -1168,9 +1286,31 @@ class ServingEngine:
         and LRU cached pages are spilled to the host tier / evicted
         before the head is held back, so backpressure only fires when
         genuinely referenced pages exhaust the pool. A PREEMPTED
-        request re-admits through `_reserve_resume` (swap-in) instead."""
-        if req._swap is not None:
-            return self._reserve_resume(req)
+        request re-admits through `_reserve_resume` (swap-in) instead.
+
+        With the adapter subsystem on, the request's LoRA adapter is
+        claimed FIRST (made device-resident in the paged adapter
+        pool, one reference taken — eviction can never touch it while
+        this request runs); an adapter pool full of slot-referenced
+        adapters refuses exactly like KV page pressure, and a KV
+        refusal releases the adapter claim (it parks hot)."""
+        aid = int(getattr(req.sampling, "adapter_id", 0) or 0)
+        if self.adapters is not None:
+            binding = self.adapters.acquire(aid)
+            if binding is None:
+                return False     # every adapter page is referenced
+            req._adapter_binding = binding
+            req._adapter_held = True
+        ok = (self._reserve_resume(req) if req._swap is not None
+              else self._reserve_kv(req))
+        if not ok and req._adapter_held:
+            self.adapters.release(aid)
+            req._adapter_held = False
+        return ok
+
+    def _reserve_kv(self, req: Request) -> bool:
+        """The KV-page half of `_reserve` (fresh admission)."""
+        aid = int(getattr(req.sampling, "adapter_id", 0) or 0)
         if self.prefix_cache is None:
             pages = self.pool.alloc(pages_needed(
                 req.prompt_ids.size, req.sampling.max_new_tokens,
@@ -1180,7 +1320,8 @@ class ServingEngine:
             req.pages = pages
             return True
         grant = self.prefix_cache.acquire(req.prompt_ids,
-                                          req.sampling.max_new_tokens)
+                                          req.sampling.max_new_tokens,
+                                          adapter_id=aid)
         if grant is None:
             return False
         req.pages = grant.pages
@@ -1203,7 +1344,10 @@ class ServingEngine:
         remaining = req.sampling.max_new_tokens - len(req.output_tokens)
         ps = self.page_size
         if self.prefix_cache is not None:
-            grant = self.prefix_cache.acquire(seq, remaining)
+            grant = self.prefix_cache.acquire(
+                seq, remaining,
+                adapter_id=int(getattr(req.sampling, "adapter_id", 0)
+                               or 0))
             if grant is None:
                 return False
             pages = grant.pages
@@ -1300,6 +1444,16 @@ class ServingEngine:
         self._vec_dirty = True
         self._pt_host[slot, :] = TRASH_PAGE
         self._pt_dirty = True
+        if req._adapter_held:
+            # the adapter reference drops with the slot (the pool may
+            # evict/spill it while the request waits); resume
+            # re-acquires through the normal reserve path
+            self.adapters.release(
+                int(getattr(req.sampling, "adapter_id", 0) or 0))
+            req._adapter_held = False
+        self._apage[slot] = 0
+        self._ascale[slot] = 0.0
+        self._slot_adapter.pop(slot, None)
         # committed KV: a decode row holds prompt + every emitted
         # token; a mid-prefill row exactly its prefill cursor
         if req.state is RequestState.DECODE:
@@ -1374,6 +1528,15 @@ class ServingEngine:
             self._pt_host[slot, :] = TRASH_PAGE
             self._pt_host[slot, :len(req.pages)] = req.pages
             self._pt_dirty = True
+            if self.adapters is not None:
+                # the slot's adapter operands: pool page + LoRA scale
+                # (page is stable while the slot holds its reference)
+                page, scale = req._adapter_binding
+                self._apage[slot] = page
+                self._ascale[slot] = scale
+                aid = int(getattr(req.sampling, "adapter_id", 0) or 0)
+                if aid != BASE_ADAPTER:
+                    self._slot_adapter[slot] = aid
             self._obs_event(req, "admit", pages=len(req.pages or ()),
                             cached_tokens=int(req.cached_tokens),
                             resumed=req._swap is not None)
@@ -1716,12 +1879,21 @@ class ServingEngine:
         self.step_tokens_inflight = int(q_len.sum())
         self._beat()
         t0 = time.perf_counter()
+        adapter_args = ()
+        if self.adapters is not None:
+            # the paged adapter pool rides as an ARGUMENT (like the KV
+            # pools), so uploads/evictions swap data under the same
+            # trace; the per-slot page + scale vectors are operand
+            # data next to pos/q_len
+            adapter_args = (self.adapters.pools,
+                            self._dev(self._apage),
+                            self._dev(self._ascale))
         args_tail = (self._pos, self._last_logits, pt_full,
                      self._dev(tokens), self._dev(q_len),
                      self._dev(is_decode), key,
                      self._dev(self._temps), self._dev(self._topk),
                      self._dev(self._topp), self._dev(self._greedy),
-                     *group_args)
+                     *adapter_args, *group_args)
         if self.tp is not None:
             # kept for collective_counts(): the exact operand pytree
             # (the live self._ct stands in for the pools) the one
@@ -1942,6 +2114,10 @@ class ServingEngine:
                              prefix_stats=(
                                  self.prefix_cache.stats()
                                  if self.prefix_cache is not None
+                                 else None),
+                             adapter_stats=(
+                                 self.adapters.stats()
+                                 if self.adapters is not None
                                  else None))
         if self.obs is not None:
             rs = self._round_stats
@@ -1963,7 +2139,16 @@ class ServingEngine:
                 "pages_swapped": self.pool.swapped_pages,
                 "host_pages_used": self.host_pool.used_pages,
                 "collectives": rs["collectives"],
-                "step_wall_ms": round(rs["wall_s"] * 1e3, 4)})
+                "step_wall_ms": round(rs["wall_s"] * 1e3, 4),
+                **({} if self.adapters is None else {
+                    # resident slot -> adapter id map + adapter-pool
+                    # occupancy (the flight_dump "adpt" column)
+                    "slot_adapters": sorted(
+                        [s, a] for s, a
+                        in self._slot_adapter.items()),
+                    "adapters_resident":
+                        self.adapters.pool.used_pages
+                        + self.adapters.pool.cached_pages})})
         return finished
 
     # -- shutdown ----------------------------------------------------------
@@ -1993,6 +2178,8 @@ class ServingEngine:
             self.scheduler.requeue(req)
         finished.extend(self.run())
         self.pool.assert_quiesced()
+        if self.adapters is not None:
+            self.adapters.assert_quiesced()
         return finished
 
     def abort_all(self, reason: str = "aborted") -> List[RequestOutput]:
@@ -2019,6 +2206,8 @@ class ServingEngine:
                 span.end()
             self._spans.clear()
         self.pool.assert_quiesced()
+        if self.adapters is not None:
+            self.adapters.assert_quiesced()
         return finished
 
     # -- debug introspection ----------------------------------------------
@@ -2038,7 +2227,9 @@ class ServingEngine:
                 "emitted": len(req.output_tokens),
                 "pages": len(self._slot_pages.get(slot) or ()),
                 "cached_tokens": int(req.cached_tokens),
-                "priority": int(req.sampling.priority)})
+                "priority": int(req.sampling.priority),
+                "adapter_id": int(getattr(req.sampling, "adapter_id",
+                                          0) or 0)})
         return {
             "closed": self._closed,
             "step": self._step_idx,
@@ -2055,6 +2246,9 @@ class ServingEngine:
                           "pages_total": self.host_pages},
             "prefix_cache": (None if self.prefix_cache is None
                              else self.prefix_cache.stats()),
+            "adapters": (None if self.adapters is None else {
+                "pool": self.adapters.stats(),
+                "registered": self.adapters.debug()}),
             "config": {"unified": self.unified,
                        "grouped": self.grouped,
                        "attn_impl": self.attn_impl,

@@ -265,6 +265,10 @@ class EngineDriver:
             "inflight": queued + residents + self._inbox.qsize(),
             "steps": self.steps,
             "last_beat": self.last_beat,
+            # device-resident adapter ids (multi-tenant LoRA): the
+            # router's placement affinity signal — hot beats cold
+            "adapters_hot": (sorted(eng.adapters.hot_ids())
+                             if eng.adapters is not None else []),
         }
 
     # -- pump thread -------------------------------------------------------

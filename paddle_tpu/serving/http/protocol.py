@@ -82,6 +82,12 @@ class CompletionRequest:
     # request can pull `GET /debug/requests/<id>` afterwards without
     # parsing the response first); None = server-assigned `cmpl-N`
     request_id: Optional[str] = None
+    # multi-tenant LoRA serving: which registered fine-tune to decode
+    # under (the OpenAI-style `model` field). None = the base model;
+    # the server maps the name through the fleet's adapter registry
+    # (404 on an unknown name) and sets sampling.adapter_id, so the
+    # tenant identity rides migration/preemption with the sampling.
+    model: Optional[str] = None
 
 
 # client-supplied request ids: URL-safe, bounded (they ride in debug
@@ -123,6 +129,7 @@ def parse_completion_request(raw: bytes) -> CompletionRequest:
     deadline = _get(payload, "deadline", (int, float))
     stream = bool(_get(payload, "stream", bool, False))
     request_id = _get(payload, "request_id", str)
+    model = _get(payload, "model", str)
     if request_id is not None and not _REQUEST_ID_RE.match(request_id):
         raise ProtocolError(
             400, "\"request_id\" must match [A-Za-z0-9_.:-]{1,128}")
@@ -149,7 +156,8 @@ def parse_completion_request(raw: bytes) -> CompletionRequest:
         raise ProtocolError(400, str(e))
     return CompletionRequest(
         prompt_ids=np.asarray(prompt, dtype=np.int64),
-        sampling=sampling, stream=stream, request_id=request_id)
+        sampling=sampling, stream=stream, request_id=request_id,
+        model=model)
 
 
 # -- responses -------------------------------------------------------------

@@ -538,7 +538,14 @@ class Router:
         # every survivor already tried: allow re-tries on them rather
         # than failing a retryable request outright
         cands = [d for d in pool if d not in exclude] or pool
-        cands.sort(key=self._load_key)
+        # adapter affinity (multi-tenant LoRA serving): a replica
+        # whose adapter pool already holds this request's adapter
+        # resident (hot) beats a cold one — placement warmth for
+        # weights, exactly like prefix affinity for KV — ranked right
+        # after breaker health and before load
+        aid = int(getattr(sampling, "adapter_id", 0) or 0) \
+            if sampling is not None else 0
+        cands.sort(key=lambda d: self._load_key(d, aid))
         last: Optional[ServingError] = None
         for d in cands:
             try:
@@ -560,11 +567,30 @@ class Router:
             raise last
         raise EngineClosed("no replica accepted the request") from last
 
-    def _load_key(self, d: EngineDriver):
+    def _load_key(self, d: EngineDriver, adapter_id: int = 0):
         s = d.stats()
         rank = CircuitBreaker.PLACEMENT_RANK[
             self.breakers[d.name].state(self._clock())]
-        return (rank, s["queue_depth"], s["inflight"], -s["free_pages"])
+        cold = 0
+        if adapter_id:
+            store = getattr(d.engine, "adapters", None)
+            cold = 0 if (store is not None
+                         and store.is_hot(adapter_id)) else 1
+        return (rank, cold, s["queue_depth"], s["inflight"],
+                -s["free_pages"])
+
+    # -- multi-tenant adapter registry --------------------------------------
+    def resolve_model(self, name: str) -> Optional[int]:
+        """Map an HTTP `model=` name to its adapter_id through the
+        fleet's registries (replicas register the same adapters in
+        the same order, so ids agree). None = unknown name."""
+        for d in self.drivers:
+            store = getattr(d.engine, "adapters", None)
+            if store is not None:
+                aid = store.id_for(name)
+                if aid is not None:
+                    return aid
+        return None
 
     # -- observability -----------------------------------------------------
     def stats(self) -> dict:

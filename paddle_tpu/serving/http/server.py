@@ -299,6 +299,21 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error_json(503, "server is draining",
                                   "service_unavailable")
             return
+        # multi-tenant LoRA: map the OpenAI-style `model` name through
+        # the fleet's adapter registry. The base model answers to the
+        # server's own model_name (and "base"); anything else must be
+        # a registered adapter -> sampling.adapter_id, which then
+        # rides migration/preemption with the sampling params.
+        if creq.model is not None and \
+                creq.model not in (self.server.model_name, "base"):
+            aid = self.server.router.resolve_model(creq.model)
+            if aid is None:
+                self._send_error_json(
+                    404, f"unknown model {creq.model!r}: not the base "
+                    "model and no adapter registered under that name",
+                    "model_not_found")
+                return
+            creq.sampling.adapter_id = aid
         try:
             ticket = self.server.router.submit(
                 creq.prompt_ids, creq.sampling,
@@ -318,12 +333,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error_json(status_for_error(e), str(e))
             return
         if creq.stream:
-            self._respond_stream(ticket)
+            self._respond_stream(ticket, creq.model)
         else:
-            self._respond_blocking(ticket)
+            self._respond_blocking(ticket, creq.model)
 
     # -- completion paths --------------------------------------------------
-    def _respond_blocking(self, ticket):
+    def _respond_blocking(self, ticket, model=None):
         poll = self.server.poll_interval_s
         for kind, val in ticket.events(poll_s=poll):
             if kind in ("idle", "token"):
@@ -348,17 +363,18 @@ class _Handler(BaseHTTPRequestHandler):
                 "the request never started", "deadline_exceeded")
             return
         self._send_json(status,
-                        completion_body(ticket.id,
-                                        self.server.model_name, out))
+                        completion_body(
+                            ticket.id,
+                            model or self.server.model_name, out))
 
-    def _respond_stream(self, ticket):
+    def _respond_stream(self, ticket, model=None):
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
         self.send_header("Connection", "close")
         self.end_headers()
         poll = self.server.poll_interval_s
-        model = self.server.model_name
+        model = model or self.server.model_name
         try:
             for kind, val in ticket.events(poll_s=poll):
                 if kind == "token":

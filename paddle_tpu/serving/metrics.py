@@ -50,6 +50,11 @@ GROUP_SIZE_BUCKETS = (2, 3, 4, 6, 8, 12, 16, 32)
 # and unbounded — a label-cardinality bomb without a cap)
 PRIORITY_CLASSES_MAX = 8
 
+# distinct per-adapter label values kept before overflow traffic
+# folds into "other" (a fleet may register thousands of adapters —
+# same cardinality-cap pattern as the per-priority labels)
+ADAPTER_IDS_MAX = 8
+
 
 class Histogram:
     """Bounded-reservoir histogram: running count/sum/min/max over all
@@ -265,6 +270,15 @@ class ServingMetrics:
         # overload scheduler's promise ("high priority stays fast
         # under load") as a per-class percentile, not a guess
         self._by_priority: dict = {}
+        # multi-tenant adapter serving (serving/adapters.py): whether
+        # the engine runs the subsystem (the `adapters` engine_info
+        # tag), the adapter-pool occupancy/traffic mirror the engine
+        # pushes each step (source of truth: AdapterStore.stats()),
+        # and per-adapter request counters capped at ADAPTER_IDS_MAX
+        # distinct ids + "other"
+        self.adapters_enabled: Optional[bool] = None
+        self.adapter_stats: Optional[dict] = None
+        self._by_adapter: dict = {}
         self.queue_depth_hist = Histogram()
         self.occupancy_hist = Histogram()
         self.pool_utilization_hist = Histogram()
@@ -303,6 +317,17 @@ class ServingMetrics:
     def on_submit(self, req):
         with self._lock:
             self.requests_received += 1
+
+    def on_adapter_request(self, adapter_id: int):
+        """One request submitted under `adapter_id` (0 = base model).
+        Label cardinality capped: the first ADAPTER_IDS_MAX distinct
+        ids keep their own counter, the rest fold into "other"."""
+        with self._lock:
+            lbl = str(int(adapter_id))
+            if lbl not in self._by_adapter and \
+                    len(self._by_adapter) >= ADAPTER_IDS_MAX:
+                lbl = "other"
+            self._by_adapter[lbl] = self._by_adapter.get(lbl, 0) + 1
 
     def on_admit(self, req, now: float):
         with self._lock:
@@ -426,8 +451,11 @@ class ServingMetrics:
                 stall_chunks: int = 0, pages_cached: int = 0,
                 pages_swapped: int = 0, host_pages_used: int = 0,
                 host_pages_total: int = 0,
-                prefix_stats: Optional[dict] = None):
+                prefix_stats: Optional[dict] = None,
+                adapter_stats: Optional[dict] = None):
         with self._lock:
+            if adapter_stats is not None:
+                self.adapter_stats = dict(adapter_stats)
             self.decode_steps += 1
             self.queue_depth = queue_depth
             self.slot_occupancy = occupancy
@@ -541,6 +569,12 @@ class ServingMetrics:
             "e2e_s": self.e2e_s.snapshot(),
             "queue_depth_hist": self.queue_depth_hist.snapshot(),
             "occupancy_hist": self.occupancy_hist.snapshot(),
+            "adapters_enabled": self.adapters_enabled,
+            "adapters": (None if self.adapter_stats is None else {
+                **self.adapter_stats,
+                "requests_by_adapter": dict(
+                    sorted(self._by_adapter.items())),
+            }),
             "deadline_goodput": {"met": self.deadline_met,
                                  "missed": self.requests_deadline},
             "by_priority": {
@@ -633,7 +667,16 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                        ("ttft_seconds", "histogram"),
                        ("inter_token_seconds", "histogram"),
                        ("e2e_seconds", "histogram"),
-                       ("deadline_goodput_total", "counter")]:
+                       ("deadline_goodput_total", "counter"),
+                       ("adapter_pool_pages_used", "gauge"),
+                       ("adapter_pool_pages_cached", "gauge"),
+                       ("adapter_pool_pages_swapped", "gauge"),
+                       ("adapter_pool_pages_total", "gauge"),
+                       ("adapter_loads_total", "counter"),
+                       ("adapter_evictions_total", "counter"),
+                       ("adapter_spills_total", "counter"),
+                       ("adapter_restores_total", "counter"),
+                       ("adapter_requests_total", "counter")]:
         lines.append(f"# TYPE {namespace}_{name} {kind}")
     for replica, snap in sorted(snapshots.items()):
         lab = {"replica": str(replica)}
@@ -650,8 +693,30 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                 "grouped": ("on" if snap.get("grouped") else "off"),
                 "mesh": snap.get("mesh") or "off",
                 "mp": snap.get("mp", 1) or 1,
-                "dp": snap.get("dp", 1) or 1})
+                "dp": snap.get("dp", 1) or 1,
+                "adapters": ("on" if snap.get("adapters_enabled")
+                             else "off")})
             + " 1")
+        ad = snap.get("adapters")
+        if ad is not None:
+            for metric, key in [
+                    ("adapter_pool_pages_used", "pages_used"),
+                    ("adapter_pool_pages_cached", "pages_cached"),
+                    ("adapter_pool_pages_swapped", "pages_swapped"),
+                    ("adapter_pool_pages_total", "pages_total"),
+                    ("adapter_loads_total", "loads_total"),
+                    ("adapter_evictions_total", "evictions_total"),
+                    ("adapter_spills_total", "spills_total"),
+                    ("adapter_restores_total", "restores_total")]:
+                lines.append(f"{namespace}_{metric}"
+                             + _fmt_labels(lab)
+                             + f" {ad.get(key, 0)}")
+            for aid, n in sorted(
+                    (ad.get("requests_by_adapter") or {}).items()):
+                lines.append(
+                    f"{namespace}_adapter_requests_total"
+                    + _fmt_labels({**lab, "adapter": aid})
+                    + f" {n}")
         lines.append(f"{namespace}_page_block_reads_total"
                      + _fmt_labels(lab)
                      + f" {snap.get('page_block_reads_total', 0)}")

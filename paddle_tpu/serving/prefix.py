@@ -264,6 +264,12 @@ class RadixPrefixCache:
         self.pool = pool
         self.page_size = int(page_size)
         self.root = _Node(None, None, None)
+        # TENANT ISOLATION (multi-tenant LoRA serving): the tree is
+        # namespaced by adapter id — KV written under adapter i is a
+        # function of (tokens, adapter i's weights), so an identical
+        # prompt under adapter j must MISS it. One root per adapter
+        # id; adapter 0 (the base model) keeps the classic root.
+        self._roots: Dict[int, _Node] = {0: self.root}
         # page id -> owning _Node/_Partial, for release() routing and
         # O(1) "is this page tree-resident"
         self._owner: Dict[int, object] = {}
@@ -330,9 +336,20 @@ class RadixPrefixCache:
     def _touch(self, obj):
         obj.last_used = next(self._tick)
 
+    def _root_for(self, adapter_id: int) -> _Node:
+        """The adapter's namespace root (created on first use —
+        adapter id joins the match key, so tenant A's pages are
+        unreachable from tenant B's walks by construction)."""
+        root = self._roots.get(int(adapter_id))
+        if root is None:
+            root = self._roots[int(adapter_id)] = _Node(None, None,
+                                                        None)
+        return root
+
     # -- matching ----------------------------------------------------------
     def _match_full(self, tok: np.ndarray, limit: int, acquire: bool
-                    = True) -> Tuple[_Node, List[int], int]:
+                    = True, root: Optional[_Node] = None
+                    ) -> Tuple[_Node, List[int], int]:
         """Walk full-page edges: returns (last node, matched page ids,
         matched token count). Only whole pages match here; `limit`
         caps the match so at least one prompt token always prefills
@@ -347,7 +364,8 @@ class RadixPrefixCache:
         side-effect-free lookup probe) counts spilled spans as
         matchable without touching anything."""
         ps = self.page_size
-        node, pages, depth = self.root, [], 0
+        node, pages, depth = (self.root if root is None else root,
+                              [], 0)
         while depth + ps <= limit:
             child = node.children.get(tok[depth:depth + ps].tobytes())
             if child is None:
@@ -405,19 +423,20 @@ class RadixPrefixCache:
             self._touch(best_obj)
         return best_k, best_page
 
-    def lookup(self, prompt) -> int:
+    def lookup(self, prompt, adapter_id: int = 0) -> int:
         """Side-effect-free probe: how many tokens of `prompt` the
         cache could serve right now (full pages — device or spilled —
-        plus the best COW tail)."""
+        plus the best COW tail) within `adapter_id`'s namespace."""
         tok = _tok(prompt)
         limit = max(0, tok.size - 1)
-        node, _, depth = self._match_full(tok, limit, acquire=False)
+        node, _, depth = self._match_full(
+            tok, limit, acquire=False, root=self._root_for(adapter_id))
         k, _ = self._best_tail(node, tok[depth:limit])
         return depth + k
 
     # -- admission ---------------------------------------------------------
-    def acquire(self, prompt, max_new_tokens: int
-                ) -> Optional[PrefixGrant]:
+    def acquire(self, prompt, max_new_tokens: int,
+                adapter_id: int = 0) -> Optional[PrefixGrant]:
         """Longest-prefix match + page reservation for one request.
         On success every page in the grant holds one reference for the
         request (shared pages refcount++, fresh pages refcount 1, the
@@ -429,7 +448,8 @@ class RadixPrefixCache:
         plen = tok.size
         self.lookups += 1
         limit = plen - 1        # >= 1 token must prefill for logits
-        node, shared, depth = self._match_full(tok, limit)
+        node, shared, depth = self._match_full(
+            tok, limit, root=self._root_for(adapter_id))
         cow_k, cow_src = self._best_tail(node, tok[depth:limit])
         total = pages_needed(plen, max_new_tokens, ps)
         need_fresh = total - len(shared)
@@ -502,14 +522,17 @@ class RadixPrefixCache:
         if gone:
             self.pool.free(gone)
 
-    def insert(self, tokens, pages: List[int], valid: int):
+    def insert(self, tokens, pages: List[int], valid: int,
+               adapter_id: int = 0):
         """Index a finished request's written pages so future prompts
-        hit. `tokens` is its prompt + generated ids, `valid` how many
-        positions actually hold KV (prompt_len + emitted tokens);
-        trailing unconsumed budget pages are simply freed. Duplicates
-        (another request cached the same span first) are freed, the
-        tree keeps its original. Finally drops ALL of the request's
-        page references."""
+        hit — within `adapter_id`'s namespace: the KV is a function
+        of the adapter's weights too, so tenants never see each
+        other's pages. `tokens` is its prompt + generated ids,
+        `valid` how many positions actually hold KV (prompt_len +
+        emitted tokens); trailing unconsumed budget pages are simply
+        freed. Duplicates (another request cached the same span
+        first) are freed, the tree keeps its original. Finally drops
+        ALL of the request's page references."""
         ps = self.page_size
         tok = _tok(tokens)
         valid = int(valid)
@@ -517,7 +540,7 @@ class RadixPrefixCache:
             raise ValueError(
                 f"valid={valid} exceeds tokens ({tok.size}) or page "
                 f"capacity ({len(pages) * ps})")
-        node = self.root
+        node = self._root_for(adapter_id)
         n_full = valid // ps
         for i in range(n_full):
             span = tok[i * ps:(i + 1) * ps]
@@ -568,11 +591,11 @@ class RadixPrefixCache:
         if need <= 0 or self._host_store is None:
             return 0
         heap = []
-        stack = [self.root]
+        stack = list(self._roots.values())   # every tenant namespace
         while stack:
             node = stack.pop()
             stack.extend(node.children.values())
-            if (node is not self.root and node.page is not None
+            if (node.tokens is not None and node.page is not None
                     and self.pool.refcount(node.page) == 0):
                 heapq.heappush(heap, (node.last_used, id(node), node))
         spilled = 0
@@ -609,16 +632,18 @@ class RadixPrefixCache:
         number of pages actually freed."""
         if need <= 0:
             return 0
-        # seed the heap with every current leaf candidate
+        # seed the heap with every current leaf candidate (across
+        # every tenant namespace — eviction is global LRU; isolation
+        # is a MATCHING property, not a placement one)
         heap = []
-        stack = [self.root]
+        stack = list(self._roots.values())
         while stack:
             node = stack.pop()
             stack.extend(node.children.values())
             for part in node.partials:
                 heapq.heappush(heap, (part.last_used, id(part), part,
                                       node))
-            if node is not self.root and self._evictable(node):
+            if node.tokens is not None and self._evictable(node):
                 heapq.heappush(heap, (node.last_used, id(node), node,
                                       node.parent))
         freed = 0
@@ -647,7 +672,8 @@ class RadixPrefixCache:
                 self.evicted_pages_total += 1
                 freed += 1
             # the parent may have just become an evictable leaf
-            if parent is not self.root and self._evictable(parent):
+            # (tokens None = a namespace root, never evictable)
+            if parent.tokens is not None and self._evictable(parent):
                 heapq.heappush(heap, (parent.last_used, id(parent),
                                       parent, parent.parent))
         return freed

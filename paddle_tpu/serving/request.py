@@ -71,10 +71,18 @@ class SamplingParams:
     # 504) instead of burning a queue slot. Runtime limits stay
     # timeout_s's job — a started request is never deadline-failed.
     deadline_s: Optional[float] = None
+    # multi-tenant LoRA serving (serving/adapters.py): which
+    # registered adapter this request decodes under; 0 = the base
+    # model. Riding on the sampling params keeps tenant identity
+    # attached through migration (the Ticket re-places the same
+    # sampling) and preemption-resume for free.
+    adapter_id: int = 0
 
     def __post_init__(self):
         if self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if self.adapter_id < 0:
+            raise ValueError("adapter_id must be >= 0")
         if self.deadline_s is not None and self.deadline_s <= 0:
             raise ValueError("deadline_s must be > 0")
         if self.top_k is not None and self.top_k < 1:
@@ -126,6 +134,12 @@ class Request:
         # preempted (banked + swapped to host + resumed) on this
         # engine — usage.preemptions over HTTP
         self.preemptions: int = 0
+        # multi-tenant adapter claim (engine-owned): the (pool page,
+        # LoRA scale) binding granted at reserve time, and whether
+        # the request currently holds a reference on its adapter's
+        # pool page (released at retirement/preemption)
+        self._adapter_binding = (0, 0.0)
+        self._adapter_held = False
         # preemption swap handle (engine-owned): host-tier slots +
         # coverage of the banked KV while the request waits to resume;
         # None whenever the request is not preempted-with-swapped-KV

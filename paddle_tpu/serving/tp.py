@@ -192,6 +192,18 @@ class ServingTP:
         """Place one int8 rowwise scale pool (kv-head axis sharded)."""
         return jax.device_put(arr, self.scale_shard)
 
+    def place_adapter_col(self, arr):
+        """Place one adapter-pool B tensor [P, R, out] with its
+        head-grouped OUTPUT dim sharded over mp — matching the
+        column-parallel q/k/v projections its delta adds to (the add
+        is shard-local: no collective). Falls back to replicated when
+        the out dim does not divide (the engine's geometry validation
+        makes that unreachable for q/k/v)."""
+        if self.mp > 1 and arr.shape[-1] % self.mp == 0:
+            return jax.device_put(
+                arr, NamedSharding(self.mesh, P(None, None, "mp")))
+        return jax.device_put(arr, self.rep)
+
     def replicate(self, arr):
         """Place a host/step operand replicated over the whole mesh
         (page tables, pos, tokens, q_len, sampling vectors, ...)."""

@@ -36,6 +36,9 @@ COLUMNS = [
     ("acc", "accepted_tokens", 4),
     ("saved", "reads_saved", 5),
     ("coll", "collectives", 4),
+    # resident adapter-pool pages (multi-tenant LoRA; "-" without the
+    # subsystem — the per-slot adapter map rides in "slot_adapters")
+    ("adapter", "adapters_resident", 7),
     ("pages", "pages_used", 5),
     ("cache", "pages_cached", 5),
     ("swap", "pages_swapped", 4),

@@ -11,7 +11,7 @@ in the bench trajectory. Prints ONE JSON line and writes the same
 stable-schema report to BENCH_serving.json (override with --out,
 suppress with --out -):
 
-    {"bench": "serving", "schema_version": 12, "attn_impl": "kernel",
+    {"bench": "serving", "schema_version": 13, "attn_impl": "kernel",
      "requests": ..., "ttft_p50_s": ..., "tokens_per_sec": ...,
      "decode_step_ms_p50": ..., "ab": {"kernel": {...},
      "gather": {...}}, "prefix_stats": {...}, "unified": {...},
@@ -94,6 +94,18 @@ noise pin of the off arm's (observability must be free), the flight
 ring actually recorded the trace's steps, and that
 `scripts/flight_dump.py` renders the on arm's ring into a non-empty
 per-step table (the CI smoke of the postmortem tooling).
+
+`--lora-ab` adds the multi-tenant LoRA A/B (schema v13): a
+mixed-tenant Poisson trace — K registered adapters under zipf
+popularity plus base-model rows — runs (a) BATCHED through one
+adapters-enabled engine (every tenant in the same unified step,
+per-row gathered A/B deltas, a deliberately undersized paged adapter
+pool so evict/spill churn is exercised) vs (b) the naive
+merge-weights-per-tenant SERIAL fleet. The report's "lora" section
+records per-arm tokens/s, the pool's load/evict/spill traffic and
+the throughput ratio — and asserts every tenant's stream is
+bit-token-identical to its dense-merged oracle and that the batched
+arm strictly beats the serial fleet on tokens/s.
 
 `--tp-ab` adds the multi-chip tensor-parallel A/B (schema v12): the
 SAME burst trace through ONE replica on one device (mp=1, the oracle)
@@ -241,6 +253,21 @@ def main():
                     "residents per chip, zero all-reduces and one "
                     "output all-gather per layer in the compiled "
                     "step")
+    ap.add_argument("--lora-ab", action="store_true",
+                    help="run the multi-tenant LoRA A/B: a mixed-"
+                    "tenant Poisson trace (K adapters, zipf "
+                    "popularity, plus base-model rows) served (a) "
+                    "BATCHED through one adapters-enabled engine — "
+                    "every tenant in the same unified step — vs (b) "
+                    "the naive merge-weights-per-tenant SERIAL "
+                    "fleet; asserts per-tenant token identity to "
+                    "the dense-merged oracle, strictly better "
+                    "tokens/s than the serial arm, and records the "
+                    "adapter-pool load/evict/spill traffic")
+    ap.add_argument("--lora-adapters", type=int, default=4,
+                    help="K: distinct adapters in the --lora-ab trace")
+    ap.add_argument("--lora-rank", type=int, default=4,
+                    help="LoRA rank of the --lora-ab adapters")
     ap.add_argument("--obs-ab", action="store_true",
                     help="run the SAME Poisson trace with the "
                     "observability layer (request tracer + flight "
@@ -556,7 +583,7 @@ def main():
 
     report = {
         "bench": "serving",
-        "schema_version": 12,
+        "schema_version": 13,
         "platform": jax.devices()[0].platform,
         "attn_impl": "kernel",
         "requests": n_req,
@@ -714,6 +741,11 @@ def main():
         report["quant"] = quant_trace(
             model, cfg, slots=args.slots, seed=args.seed + 4,
             on_tpu=on_tpu)
+    if args.lora_ab:
+        report["lora"] = lora_trace(
+            model, cfg, slots=args.slots, seed=args.seed + 6,
+            on_tpu=on_tpu, k_adapters=args.lora_adapters,
+            rank=args.lora_rank)
     if args.tp_ab:
         report["tp"] = tp_trace(
             model, cfg, slots=args.slots, seed=args.seed + 5,
@@ -877,6 +909,23 @@ def main():
         assert qt["max_logit_drift"] <= qt["drift_epsilon"], qt
         assert qt["tokens_per_sec_ratio"] is not None \
             and qt["tokens_per_sec_ratio"] >= 1.0, qt
+    if args.lora_ab:
+        lr = report["lora"]
+        # the acceptance numbers: every tenant's stream from the
+        # BATCHED mixed-adapter engine is bit-token-identical to the
+        # serial DENSE-MERGED (W + B·A) oracle fleet (multi-tenancy is
+        # a packing move, never a quality knob), the batched arm's
+        # trace throughput strictly beats serving the tenants one
+        # merged engine at a time, and the paged adapter pool really
+        # cycled (loads recorded; evict/spill traffic under the
+        # deliberately undersized pool)
+        assert lr["token_identical"], "lora batched/merged mismatch"
+        assert lr["batched"]["completed"] == lr["requests"], lr
+        assert lr["tokens_per_sec_ratio"] is not None \
+            and lr["tokens_per_sec_ratio"] > 1.0, lr
+        assert lr["adapter_pool"]["loads_total"] >= lr["adapters"], lr
+        assert (lr["adapter_pool"]["evictions_total"]
+                + lr["adapter_pool"]["spills_total"]) >= 1, lr
     if args.tp_ab:
         tp = report["tp"]
         # the acceptance numbers: the mesh arm emitted EXACTLY the
@@ -1253,6 +1302,185 @@ def quant_trace(model, cfg, *, slots, seed, on_tpu, repeats=2):
             else q8_a["tokens_per_sec"] / fp_a["tokens_per_sec"]),
         "fp": fp_a,
         "int8": q8_a,
+    }
+
+
+def _merged_gpt(cfg, weights):
+    """The dense-merged oracle model for one adapter: rebuild the
+    bench GPT from the same seed, then fold `scale * A @ B` into the
+    projection weights — q/k/v into the fused qkv_proj's interleaved
+    per-head [h, H, 3D] layout, o into out_proj. Serving the merge is
+    the naive per-tenant fleet; its greedy tokens are the ground
+    truth the batched multi-adapter engine must reproduce bit-for-
+    bit."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import GPTForCausalLM
+
+    paddle.seed(0)                  # the build_model seed
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    h = cfg.hidden_size
+    H = cfg.num_attention_heads
+    D = h // H
+    for li, layer in enumerate(m.gpt.layers):
+        att = layer.attn
+        w = att.qkv_proj.weight.numpy().copy().reshape(h, H, 3 * D)
+        for j, proj in enumerate(("q", "k", "v")):
+            A, B = weights.layers[li][proj]
+            delta = weights.scale * (np.asarray(A) @ np.asarray(B))
+            w[:, :, j * D:(j + 1) * D] += delta.reshape(h, H, D)
+        att.qkv_proj.weight.set_value(w.reshape(h, 3 * h))
+        A, B = weights.layers[li]["o"]
+        att.out_proj.weight.set_value(
+            att.out_proj.weight.numpy().copy()
+            + weights.scale * (np.asarray(A) @ np.asarray(B)))
+    return m
+
+
+def lora_trace(model, cfg, *, slots, seed, on_tpu, k_adapters=4,
+               rank=4):
+    """The multi-tenant LoRA A/B (`--lora-ab`): ONE mixed-tenant
+    Poisson trace — K adapters under zipf popularity plus base-model
+    rows — served two ways:
+
+    (a) BATCHED: one adapters-enabled engine; every request carries
+        its adapter_id and all tenants share the ONE unified step
+        (per-row gathered A/B deltas). The adapter pool is
+        deliberately UNDERSIZED (K/2 pages) so the trace exercises
+        park/evict/spill churn, not just steady state.
+    (b) SERIAL MERGED: the naive fleet — per tenant, fold the adapter
+        into the dense weights (W + B·A·scale) and run that tenant's
+        requests through its own plain engine, one tenant at a time.
+
+    The serial arm IS the correctness oracle: the batched arm must
+    emit bit-identical tokens per request. The performance claim is
+    trace throughput — one engine packing every tenant into shared
+    steps beats serving tenants back-to-back."""
+    from paddle_tpu.serving import (SamplingParams, ServingEngine,
+                                    make_random_lora)
+
+    if on_tpu:
+        n_req, max_new, plens = 64, 32, [16, 32, 64]
+    else:
+        n_req, max_new, plens = 24, 10, [4, 6, 10]
+    rng = np.random.RandomState(seed)
+    h = cfg.hidden_size
+    H = cfg.num_attention_heads
+    D = h // H
+    weights = [make_random_lora(cfg.num_hidden_layers, h, H * D,
+                                H * D, rank=rank, rng=rng, amp=0.2)
+               for _ in range(k_adapters)]
+    # zipf-ish popularity over {base, adapter 1..K}: tenant i drawn
+    # with weight 1/(i+1); the first K requests hit each adapter once
+    # so every tenant (and the pool churn) is exercised even on the
+    # smoke trace
+    zipf = np.array([1.0 / (i + 1) for i in range(k_adapters + 1)])
+    zipf /= zipf.sum()
+    assign = [1 + (i % k_adapters) if i < k_adapters
+              else int(rng.choice(k_adapters + 1, p=zipf))
+              for i in range(n_req)]
+    prompts = [rng.randint(0, cfg.vocab_size,
+                           size=int(rng.choice(plens))).astype(np.int64)
+               for _ in range(n_req)]
+    # burst arrivals for BOTH arms: the claim is structural (one
+    # engine packs every tenant into shared steps; the serial fleet
+    # pays a low-occupancy replay per tenant), so neither arm should
+    # carry Poisson gap noise
+    arrivals = np.zeros(n_req)
+    budgets = np.full(n_req, max_new)
+
+    def replay(eng, idxs, arrs, adapter_ids=None):
+        t0 = time.monotonic()
+        submitted, reqs = 0, []
+        while submitted < len(idxs) or eng.has_work:
+            now = time.monotonic() - t0
+            while submitted < len(idxs) and arrs[submitted] <= now:
+                i = idxs[submitted]
+                aid = (adapter_ids[i] if adapter_ids is not None
+                       else 0)
+                reqs.append(eng.add_request(
+                    prompts[i],
+                    SamplingParams(max_new_tokens=int(budgets[i]),
+                                   adapter_id=aid)))
+                submitted += 1
+            if eng.has_work:
+                eng.step()
+            elif submitted < len(idxs):
+                time.sleep(min(0.001, arrs[submitted] - now))
+        wall = time.monotonic() - t0
+        return wall, [list(r.output_tokens) for r in reqs]
+
+    # -- arm (a): one batched multi-adapter engine ------------------------
+    # pool holds K-1 adapters: enough that tenant packing is the
+    # common case, small enough that the K-th tenant forces real
+    # park/evict/spill churn through the trace
+    pool_pages = max(2, k_adapters - 1)
+    eng = ServingEngine(model, num_slots=slots, max_len=128,
+                        adapters=True, adapter_pages=pool_pages,
+                        adapter_ranks=(rank,))
+    aids = [eng.adapters.register(f"tenant-{i}", w)
+            for i, w in enumerate(weights)]
+    assert aids == list(range(1, k_adapters + 1))
+    # warm the compiled step + the one-trace adapter upload (steady
+    # state, not compile time); warmup requests drain before t0
+    for pl in sorted({p.size for p in prompts}):
+        eng.add_request(np.arange(1, pl + 1, dtype=np.int64),
+                        SamplingParams(max_new_tokens=2, adapter_id=1))
+    eng.run()
+    eng.metrics.__init__()
+    wall_b, tokens_b = replay(eng, list(range(n_req)), arrivals,
+                              adapter_ids=assign)
+    snap_b = eng.metrics.snapshot()
+    pool_stats = eng.adapters.stats()
+    tokens_total = sum(len(t) for t in tokens_b)
+    eng.drain()
+
+    # -- arm (b): serial merged-weights fleet (the oracle) ----------------
+    wall_s = 0.0
+    tokens_s: dict = {}
+    for tenant in range(k_adapters + 1):
+        idxs = [i for i in range(n_req) if assign[i] == tenant]
+        if not idxs:
+            continue
+        m = model if tenant == 0 else _merged_gpt(cfg,
+                                                  weights[tenant - 1])
+        e = ServingEngine(m, num_slots=slots, max_len=128)
+        for pl in sorted({prompts[i].size for i in idxs}):
+            e.add_request(np.arange(1, pl + 1, dtype=np.int64),
+                          SamplingParams(max_new_tokens=2))
+        e.run()
+        # tenants replay back-to-back: each group's arrivals restart
+        # at 0 (generous to the serial arm — no cross-tenant waiting)
+        arrs = [0.0] * len(idxs)
+        w, toks = replay(e, idxs, arrs)
+        wall_s += w
+        for i, t in zip(idxs, toks):
+            tokens_s[i] = t
+        e.drain()
+    identical = all(tokens_b[i] == tokens_s[i] for i in range(n_req))
+    tps_b = tokens_total / wall_b if wall_b > 0 else 0.0
+    total_s = sum(len(t) for t in tokens_s.values())
+    tps_s = total_s / wall_s if wall_s > 0 else 0.0
+    return {
+        "requests": n_req,
+        "adapters": k_adapters,
+        "rank": rank,
+        "adapter_pool_pages": pool_pages,
+        "popularity": "zipf",
+        "batched": {
+            "wall_s": round(wall_b, 4),
+            "tokens_per_sec": tps_b,
+            "ttft_p50_s": snap_b["ttft_s"]["p50"],
+            "completed": snap_b["requests"]["completed"],
+        },
+        "serial_merged": {
+            "wall_s": round(wall_s, 4),
+            "tokens_per_sec": tps_s,
+            "engines": k_adapters + 1,
+        },
+        "tokens_per_sec_ratio": (tps_b / tps_s) if tps_s else None,
+        "token_identical": identical,
+        "adapter_pool": pool_stats,
     }
 
 

@@ -8,6 +8,10 @@ graceful drain (stop admitting -> finish residents -> exit 0):
     python scripts/serving_http_server.py --port 8000 --replicas 2
     curl -s localhost:8000/v1/completions \
          -d '{"prompt": [3, 14, 15, 9], "max_tokens": 8}'
+    # with --adapters K: pick a tenant fine-tune by model name
+    curl -s localhost:8000/v1/completions \
+         -d '{"prompt": [3, 14, 15, 9], "max_tokens": 8,
+              "model": "lora-0"}'
     curl -sN localhost:8000/v1/completions \
          -d '{"prompt": [3, 14, 15, 9], "max_tokens": 8, "stream": true}'
     curl -s localhost:8000/metrics | head
@@ -62,6 +66,17 @@ def main():
                     help="per-request bound on mid-stream "
                     "migrations before the typed replica error "
                     "surfaces")
+    ap.add_argument("--adapters", type=int, default=0,
+                    help="register K random LoRA adapters (rank "
+                    "--adapter-rank) named lora-0..lora-K-1 on every "
+                    "replica — multi-tenant serving: clients pick a "
+                    "tenant with the completions 'model' field "
+                    "(unknown names 404)")
+    ap.add_argument("--adapter-rank", type=int, default=4)
+    ap.add_argument("--adapter-pages", type=int, default=8,
+                    help="device adapter-pool capacity in adapters; "
+                    "cold tenants load on demand, idle ones park, "
+                    "pressure spills to host RAM / evicts LRU")
     ap.add_argument("--debug", action="store_true",
                     help="expose the /debug/state, "
                     "/debug/requests/<id> and /debug/flight "
@@ -84,8 +99,27 @@ def main():
                              max_len=max_len, page_size=args.page_size,
                              chunk_len=chunk, max_queue=args.max_queue,
                              preempt=not args.no_preempt,
-                             host_pages=args.host_pages)
+                             host_pages=args.host_pages,
+                             adapters=args.adapters > 0 or None,
+                             adapter_pages=args.adapter_pages,
+                             adapter_ranks=(args.adapter_rank,))
                for _ in range(args.replicas)]
+    if args.adapters:
+        # identical registration order on every replica -> identical
+        # adapter ids fleet-wide (the router's model-name registry)
+        import numpy as np
+        from paddle_tpu.serving import make_random_lora
+        h = cfg.hidden_size
+        hd = h // cfg.num_attention_heads
+        rng = np.random.RandomState(0)
+        weights = [make_random_lora(
+            cfg.num_hidden_layers, h,
+            cfg.num_attention_heads * hd,
+            cfg.num_attention_heads * hd, rank=args.adapter_rank,
+            rng=rng, amp=0.1) for _ in range(args.adapters)]
+        for e in engines:
+            for i, w in enumerate(weights):
+                e.adapters.register(f"lora-{i}", w)
     # PADDLE_TPU_FAULTS (chaos spec, serving/faults.py) is parsed by
     # serve() itself — export it to rehearse kills/hangs/poisons/spikes
     server = serve(engines, args.host, args.port,

@@ -930,6 +930,11 @@ ELSEWHERE = {
     **{n: EW("test_grouped_attention.py", "grouped|Grouped") for n in [
         "ragged_paged_attention_grouped",
         "ragged_paged_attention_grouped_q8"]},
+    # per-row batched LoRA delta (multi-tenant adapter serving) —
+    # mixed-tenant engine output bit-identical to the dense-merged
+    # (W + B·A) oracle across churn/eviction/spill, both model
+    # families (tests/test_serving_adapters.py)
+    "lora_delta": EW("test_serving_adapters.py", "lora|merged"),
     # rotary embedding — tests/test_nlp_models.py (Llama family)
     "rope": EW("test_nlp_models.py", "Llama|rope"),
     "rope_dyn": EW("test_nlp_models.py", "Llama|rope"),
