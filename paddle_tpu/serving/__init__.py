@@ -78,9 +78,21 @@ least-important resident (tokens banked, KV swapped whole-page to the
 host-RAM tier, resumed later token-identically), and queued requests
 past their deadline fail fast as typed DeadlineExceeded (HTTP 504).
 
+The fleet is OBSERVABLE as one system (serving/obs.py +
+serving/slo.py, default on): request-lifecycle timelines + a
+per-step flight recorder, a burn-rate SLO tracker (TTFT p99 /
+inter-token p99 / deadline goodput over fast+slow sliding windows,
+per priority class and per tenant, ok|warn|page states exported as
+Prometheus gauges and noted into the flight ring), a once-per-compile
+cost census of the ONE unified step (PADDLE_TPU_COST_CENSUS) with
+per-step `achieved_util`, and a router-level fleet view
+(`GET /debug/fleet`, `scripts/fleet_top.py`). All host-side work —
+`serving_bench --obs-ab` pins it on/off token-identical within 3%.
+
 Greedy requests are bit-identical to offline CompiledGenerator decode
 (tested); `scripts/serving_bench.py` drives a Poisson arrival trace and
-reports TTFT/throughput/pool utilization into BENCH_serving.json.
+reports TTFT/throughput/pool utilization into BENCH_serving.json
+(every run also appends its headline tokens/s to BENCH_history.jsonl).
 """
 from .adapters import (AdapterStore, LoRAWeights,  # noqa: F401
                        make_random_lora, resolve_adapters_flag,
@@ -108,6 +120,9 @@ from .prefix import (PrefixGrant, RadixPrefixCache,  # noqa: F401
 from .request import (Request, RequestOutput, RequestState,  # noqa: F401
                       SamplingParams)
 from .scheduler import Scheduler  # noqa: F401
+from .slo import (SLOConfig, SLOTracker,  # noqa: F401
+                  model_cost_census, resolve_cost_census,
+                  resolve_slo_config)
 from .spec import (Drafter, NgramDrafter, SpecConfig,  # noqa: F401
                    resolve_spec_config)
 
@@ -130,4 +145,6 @@ __all__ = ["AdapterStore", "LoRAWeights", "make_random_lora",
            "resolve_obs_flag", "resolve_debug_flag",
            "resolve_flight_steps", "timeline_to_chrome",
            "ServingTP", "resolve_serving_mesh", "parse_mesh_spec",
-           "collective_counts"]
+           "collective_counts", "SLOConfig", "SLOTracker",
+           "resolve_slo_config", "resolve_cost_census",
+           "model_cost_census"]

@@ -178,6 +178,7 @@ from __future__ import annotations
 
 import itertools
 import os
+import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -204,6 +205,8 @@ from .prefix import (RadixPrefixCache, resolve_prefix_cache_flag,
                      shared_prefix_groups)
 from .request import Request, RequestOutput, RequestState, SamplingParams
 from .scheduler import Scheduler
+from .slo import (SLOTracker, capture_cost_census, model_cost_census,
+                  resolve_cost_census, resolve_slo_config)
 from .spec import Drafter, resolve_spec_config
 from .tp import ServingTP, collective_counts, resolve_serving_mesh
 
@@ -211,6 +214,7 @@ __all__ = ["ServingEngine", "resolve_unified_flag",
            "resolve_preempt_flag", "resolve_kv_dtype",
            "resolve_grouped_flag", "resolve_obs_flag",
            "resolve_adapters_flag", "resolve_serving_mesh",
+           "resolve_slo_config", "resolve_cost_census",
            "ServingTP"]
 
 # finish reason -> timeline event kind (the 5xx/4xx taxonomy keeps
@@ -376,7 +380,8 @@ class ServingEngine:
                  obs=None, flight_steps: Optional[int] = None,
                  mesh=None, adapters=None,
                  adapter_pages: Optional[int] = None,
-                 adapter_ranks: Optional[Sequence[int]] = None):
+                 adapter_ranks: Optional[Sequence[int]] = None,
+                 slo=None, cost_census=None):
         if cache_spec is None:
             if not hasattr(model, "_decode_cache_spec"):
                 raise ValueError(
@@ -718,6 +723,36 @@ class ServingEngine:
         self.obs = (EngineObs(flight_steps=flight_steps,
                               clock=self._clock)
                     if resolve_obs_flag(obs) else None)
+        # fleet SLO tracker (serving/slo.py, default on, gated
+        # ServingEngine(slo=...) / PADDLE_TPU_SLO="off"|"on"|spec):
+        # burn-rate evaluation of TTFT p99 / inter-token p99 /
+        # deadline-goodput targets over fast+slow sliding windows,
+        # per priority class and per adapter id, fed by the SAME
+        # metrics hooks that record the histograms. State transitions
+        # land as flight-recorder notes, so incident dumps carry
+        # "the SLO was already burning" context. Host-only work —
+        # the --obs-ab pin covers its cost.
+        slo_cfg = resolve_slo_config(slo)
+        self.slo = (SLOTracker(slo_cfg, clock=self._clock,
+                               on_transition=self._on_slo_transition,
+                               track_adapters=self.adapters is not None)
+                    if slo_cfg is not None else None)
+        self.metrics.slo = self.slo
+        # compiled-step COST CENSUS (serving/slo.py, default "model",
+        # gated ServingEngine(cost_census=...) /
+        # PADDLE_TPU_COST_CENSUS=off|model|lowered|xla): one record
+        # per compiled unified step — FLOPs + bytes accessed of the
+        # program capacity — captured AT MOST ONCE per compile
+        # (lazily for the XLA-backed sources; the jit dispatch cache
+        # is never touched, retrace probes stay at cache_size 1).
+        # `achieved_util` = packed tokens / capacity tokens is the
+        # census's live numerator on every flight-recorder record.
+        self.census_mode = resolve_cost_census(cost_census)
+        self._census: Optional[dict] = None
+        self._census_captures = 0
+        self._census_lock = threading.Lock()
+        self.step_capacity_tokens = self.num_slots * self.chunk_len
+        self.metrics.step_capacity_tokens = self.step_capacity_tokens
         # engine step counter (timeline/flight step index) + the
         # running round's token-split stats the flight record reads
         self._step_idx = 0
@@ -736,6 +771,65 @@ class ServingEngine:
             self.obs.tracer.record(req.request_id, kind,
                                    t=self._clock(),
                                    step=self._step_idx, **detail)
+
+    def _on_slo_transition(self, tr: dict):
+        """An SLO series changed alert state: note it in the flight
+        recorder's step stream, so an incident dump read at 3am shows
+        "SLO was already burning" inline with the steps."""
+        if self.obs is not None:
+            where = tr["scope"] if not tr["label"] \
+                else f"{tr['scope']}:{tr['label']}"
+            self.obs.flight.note(
+                f"slo:{tr['to']}",
+                f"{tr['slo']}[{where}] {tr['from']}->{tr['to']} "
+                f"burn fast={tr['fast_burn']} slow={tr['slow_burn']}")
+
+    def _slo_snap(self) -> Optional[dict]:
+        return None if self.slo is None else self.slo.snapshot()
+
+    def cost_census(self) -> Optional[dict]:
+        """The compiled-step cost census (None with the gate off):
+        FLOPs + bytes accessed of THE one unified program's capacity,
+        captured AT MOST ONCE per compiled step — "model" computes
+        the analytical estimate immediately, "lowered"/"xla" ask the
+        step's HLO/executable cost analysis on first access (AOT
+        lower/compile: the jit dispatch cache is untouched, so the
+        retrace probes still see cache_size 1). The captured record
+        is also pushed into the metrics snapshot for /metrics."""
+        if self.census_mode == "off":
+            return None
+        with self._census_lock:
+            if self._census is None:
+                self._capture_census()
+        self.metrics.cost_census = self._census
+        return self._census
+
+    def _capture_census(self):
+        """Build the census record (callers hold _census_lock)."""
+        cfgm = getattr(self.model, "config", None)
+        n_params = sum(int(np.prod(t._value.shape))
+                       for t in self._state_tensors)
+        param_bytes = sum(
+            int(np.prod(t._value.shape))
+            * jnp.dtype(t._value.dtype).itemsize
+            for t in self._state_tensors)
+        fallback = model_cost_census(
+            n_params=n_params, param_bytes=param_bytes,
+            num_slots=self.num_slots, chunk_len=self.chunk_len,
+            max_pages=self.max_pages,
+            page_bytes=self.page_bytes,
+            n_heads=int(getattr(cfgm, "num_attention_heads",
+                                self.n_kv)),
+            head_dim=self.head_dim, page_size=self.page_size,
+            mp=self.mp)
+        self._census = capture_cost_census(
+            self.census_mode,
+            self._unified_fn if self.unified else None,
+            ((self._ct, *self._unified_args_tail)
+             if self._unified_args_tail is not None else None),
+            capacity_tokens=self.step_capacity_tokens,
+            fallback=fallback)
+        self._census_captures += 1
 
     # -- compiled programs -------------------------------------------------
     def _swap_state(self, state_vals):
@@ -1264,7 +1358,7 @@ class ServingEngine:
                 # shows what the engine was doing while it starved
                 self.obs.flight.incident(
                     "deadline", detail=req.request_id,
-                    step=self._step_idx)
+                    step=self._step_idx, slo=self._slo_snap())
         for req in self.scheduler.expired(now):
             if req.state in (RequestState.QUEUED,
                              RequestState.PREEMPTED):
@@ -1726,7 +1820,10 @@ class ServingEngine:
                 self.metrics.on_token(req, now)
                 if prev_t is not None:
                     self.metrics.on_inter_token(
-                        now - prev_t, priority=req.sampling.priority)
+                        now - prev_t, priority=req.sampling.priority,
+                        adapter_id=int(getattr(
+                            req.sampling, "adapter_id", 0) or 0),
+                        now=now)
                 elif self.obs is not None:
                     self._obs_event(req, "first_token")
                 sp = req.sampling
@@ -1894,11 +1991,10 @@ class ServingEngine:
                      self._dev(self._temps), self._dev(self._topk),
                      self._dev(self._topp), self._dev(self._greedy),
                      *adapter_args, *group_args)
-        if self.tp is not None:
-            # kept for collective_counts(): the exact operand pytree
-            # (the live self._ct stands in for the pools) the one
-            # trace lowers against — [S]-sized arrays, not pools
-            self._unified_args_tail = args_tail
+        # kept for collective_counts() AND the cost census: the exact
+        # operand pytree (the live self._ct stands in for the pools)
+        # the one trace lowers against — [S]-sized arrays, not pools
+        self._unified_args_tail = args_tail
         with RecordEvent("serving::unified_step"):
             self._ct, self._pos, self._last_logits, toks, accept = \
                 self._unified_fn(self._ct, *args_tail)
@@ -1977,7 +2073,10 @@ class ServingEngine:
                 dt = (now - prev_t) / emitted
                 for _ in range(emitted):
                     self.metrics.on_inter_token(
-                        dt, priority=sp.priority)
+                        dt, priority=sp.priority,
+                        adapter_id=int(getattr(sp, "adapter_id", 0)
+                                       or 0),
+                        now=now)
             elif emitted and self.obs is not None:
                 self._obs_event(req, "first_token")
             if m:
@@ -2091,17 +2190,20 @@ class ServingEngine:
             if self.obs is not None:
                 self.obs.flight.incident("step_fault",
                                          detail=repr(exc),
-                                         step=self._step_idx)
+                                         step=self._step_idx,
+                                         slo=self._slo_snap())
             if not self._quarantine_poison(finished):
                 if self.obs is not None:
                     self.obs.flight.incident("replica_death",
                                              detail=repr(exc),
-                                             step=self._step_idx)
+                                             step=self._step_idx,
+                                             slo=self._slo_snap())
                 raise
             if self.obs is not None:
                 self.obs.flight.incident("poison_quarantine",
                                          detail=repr(exc),
-                                         step=self._step_idx)
+                                         step=self._step_idx,
+                                         slo=self._slo_snap())
         self.metrics.on_step(self.scheduler.queue_depth,
                              self.scheduler.occupancy, self.num_slots,
                              pages_used=self.pool.used_pages,
@@ -2119,8 +2221,16 @@ class ServingEngine:
                                  self.adapters.stats()
                                  if self.adapters is not None
                                  else None))
+        # capture the free analytical census right after the first
+        # round (the XLA-backed sources stay lazy — cost_census());
+        # metrics/flight consumers then see it from step 1 on
+        if self._census is None \
+                and self.census_mode not in ("off", "lowered", "xla"):
+            self.cost_census()
         if self.obs is not None:
             rs = self._round_stats
+            packed = (rs["prefill_tokens"] + rs["decode_tokens"]
+                      + rs["draft_tokens"])
             self.obs.flight.on_step({
                 "step": self._step_idx, "t": self._clock(),
                 "queue_depth": self.scheduler.queue_depth,
@@ -2132,6 +2242,13 @@ class ServingEngine:
                 "decode_tokens": rs["decode_tokens"],
                 "draft_tokens": rs["draft_tokens"],
                 "accepted_tokens": rs["accepted_tokens"],
+                # packed-token work / program-capacity work — the
+                # per-step MFU-style utilization the cost census
+                # anchors (flight_dump's "util" column)
+                "achieved_util": round(
+                    packed / self.step_capacity_tokens, 4),
+                **({} if self.slo is None
+                   else {"slo": self.slo.worst_state()}),
                 "reads_saved": rs["reads_saved"],
                 "pages_used": self.pool.used_pages,
                 "pages_total": self.num_pages - 1,
@@ -2265,6 +2382,8 @@ class ServingEngine:
                        "max_len": self.max_len,
                        "token_budget": self.token_budget},
             "obs": None if self.obs is None else self.obs.stats(),
+            "slo": self._slo_snap(),
+            "cost_census": self.cost_census(),
         }
 
     def collective_counts(self) -> dict:

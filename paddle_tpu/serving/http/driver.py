@@ -269,6 +269,11 @@ class EngineDriver:
             # router's placement affinity signal — hot beats cold
             "adapters_hot": (sorted(eng.adapters.hot_ids())
                              if eng.adapters is not None else []),
+            # worst live SLO alert state (serving/slo.py; None = SLO
+            # tracking off) — the fleet view's per-replica column
+            "slo_state": (eng.slo.worst_state()
+                          if getattr(eng, "slo", None) is not None
+                          else None),
         }
 
     # -- pump thread -------------------------------------------------------
@@ -374,11 +379,16 @@ class EngineDriver:
             self.death_exc = exc
             self._dead = True
         # freeze the flight recorder FIRST: the ring's last N steps
-        # are the postmortem; abort_all below only adds teardown
+        # are the postmortem; abort_all below only adds teardown.
+        # The final SLO state rides in the dump — a postmortem of a
+        # dead replica still shows whether it was already burning.
         obs = getattr(self.engine, "obs", None)
         if obs is not None:
             try:
-                obs.flight.incident("replica_death", detail=repr(exc))
+                slo = getattr(self.engine, "slo", None)
+                obs.flight.incident(
+                    "replica_death", detail=repr(exc),
+                    slo=None if slo is None else slo.snapshot())
             except Exception:
                 pass
         self._fail_pending(ReplicaDead(f"{self.name} died: {exc!r}"))

@@ -658,3 +658,67 @@ class Router:
             out[d.name] = (None if obs is None
                            else obs.flight.snapshot())
         return out
+
+    def fleet_snapshot(self) -> dict:
+        """`GET /debug/fleet`: the whole fleet as ONE document — per
+        replica its health (driver liveness + breaker state), load
+        (queue/residents/pool/host-tier occupancy), throughput, the
+        compiled-step cost census + achieved-utilization summary, the
+        live SLO state (burn rates per class/tenant), and the
+        incident count; plus the router's own stats and the
+        fleet-worst SLO state at the top. Dead replicas stay listed —
+        their engine objects survive the pump, so their final SLO
+        state and census remain readable (the incident dump carries
+        them too). Reads race the pumps by design (torn dict reads
+        retried, then reported instead of raised) — a wedged fleet
+        must still answer."""
+        from ..slo import SLO_STATE_CODES
+        now = self._clock()
+        replicas = {}
+        worst = "ok"
+        for d in self.drivers:
+            eng = d.engine
+            entry = None
+            for _ in range(3):
+                try:
+                    obs = getattr(eng, "obs", None)
+                    slo = getattr(eng, "slo", None)
+                    slo_snap = None if slo is None else slo.snapshot()
+                    m = eng.metrics
+                    entry = {
+                        "healthy": d.healthy,
+                        "dead": d.dead,
+                        "draining": d.draining,
+                        "breaker": self.breakers[d.name].state(now),
+                        "steps": d.steps,
+                        "queue_depth": eng.scheduler.queue_depth,
+                        "residents": len(eng.scheduler.running),
+                        "num_slots": eng.num_slots,
+                        "pool": {
+                            "pages_used": eng.pool.used_pages,
+                            "pages_total": eng.num_pages - 1,
+                            "pages_cached": eng.pool.cached_pages,
+                            "pages_swapped": eng.pool.swapped_pages},
+                        "host_pages_used": eng.host_pool.used_pages,
+                        "tokens_generated": m.tokens_generated,
+                        "tokens_per_sec": m.tokens_per_sec,
+                        "achieved_util":
+                            m.achieved_util_hist.snapshot(),
+                        "cost_census": eng.cost_census(),
+                        "slo": slo_snap,
+                        "incidents_total": (
+                            None if obs is None
+                            else obs.flight.incidents_total),
+                    }
+                    break
+                except RuntimeError:
+                    continue        # dict mutated mid-read: retry
+            if entry is None:
+                entry = {"error": "state unstable (engine mutating "
+                                  "during read)"}
+            st = ((entry.get("slo") or {}).get("worst")) or "ok"
+            if SLO_STATE_CODES.get(st, 0) > SLO_STATE_CODES[worst]:
+                worst = st
+            replicas[d.name] = entry
+        return {"router": self.stats(), "slo_worst": worst,
+                "replicas": replicas}

@@ -14,13 +14,17 @@ Endpoints:
   balancer stops routing here before residents finish).
 - `GET /metrics` — Prometheus text exposition, one labelled series set
   per replica (`serving.metrics.prometheus_render`).
-- `GET /debug/state` / `/debug/requests/<id>` / `/debug/flight` —
-  live debug introspection (serving/obs.py): per-replica engine state
-  (residents, queue, pools, prefix cache), one merged request
-  lifecycle timeline (`?format=chrome` for a Perfetto-openable
-  trace), and the flight-recorder ring + incident dumps. OFF by
-  default — gated by `debug_endpoints=` / PADDLE_TPU_DEBUG=on — since
-  timelines expose prompt metadata (lengths, priorities, ids).
+- `GET /debug/state` / `/debug/requests/<id>` / `/debug/flight` /
+  `/debug/fleet` — live debug introspection (serving/obs.py +
+  serving/slo.py): per-replica engine state (residents, queue,
+  pools, prefix cache), one merged request lifecycle timeline
+  (`?format=chrome` for a Perfetto-openable trace), the
+  flight-recorder ring + incident dumps, and the ONE-document fleet
+  view (health/breaker, pool occupancy, SLO burn states, cost
+  census, achieved utilization per replica —
+  `scripts/fleet_top.py` renders it). OFF by default — gated by
+  `debug_endpoints=` / PADDLE_TPU_DEBUG=on — since timelines expose
+  prompt metadata (lengths, priorities, ids).
 
 Backpressure and failure map to status codes via typed errors
 (serving/errors.py): full queue -> 429 + Retry-After, draining/closed
@@ -252,6 +256,8 @@ class _Handler(BaseHTTPRequestHandler):
         router = self.server.router
         if parsed.path == "/debug/state":
             self._send_json(200, router.debug_state())
+        elif parsed.path == "/debug/fleet":
+            self._send_json(200, router.fleet_snapshot())
         elif parsed.path == "/debug/flight":
             self._send_json(200, router.flight_dumps())
         elif parsed.path.startswith("/debug/requests/"):

@@ -26,7 +26,7 @@ from typing import Optional, Sequence
 
 __all__ = ["Histogram", "ServingMetrics", "prometheus_render",
            "TTFT_BUCKETS", "LATENCY_BUCKETS", "PACKED_TOKEN_BUCKETS",
-           "SPEC_TOKEN_BUCKETS", "GROUP_SIZE_BUCKETS"]
+           "SPEC_TOKEN_BUCKETS", "GROUP_SIZE_BUCKETS", "UTIL_BUCKETS"]
 
 # fixed Prometheus-style bucket upper bounds (seconds). Fixed — not
 # adaptive — so series stay comparable across scrapes and restarts.
@@ -44,6 +44,11 @@ SPEC_TOKEN_BUCKETS = (1, 2, 3, 4, 6, 8, 12, 16)
 # unified step (>= 2 by construction — singletons don't group); the
 # mean is the ~Nx of the grouped walk's HBM claim
 GROUP_SIZE_BUCKETS = (2, 3, 4, 6, 8, 12, 16, 32)
+# achieved utilization of one unified step: packed tokens / the
+# compiled program's capacity (num_slots * chunk_len) — the
+# MFU-style "is packing earning the hardware" fraction the cost
+# census anchors (1.0 = the step shape is completely full)
+UTIL_BUCKETS = (0.05, 0.1, 0.2, 0.3, 0.5, 0.7, 0.85, 0.95, 1.0)
 
 # distinct per-priority-class label values kept before overflow
 # traffic folds into the "other" class (priority is client-supplied
@@ -279,6 +284,24 @@ class ServingMetrics:
         self.adapters_enabled: Optional[bool] = None
         self.adapter_stats: Optional[dict] = None
         self._by_adapter: dict = {}
+        # per-TENANT latency/goodput labels (the PR 14 follow-up's
+        # measurement half — the numbers the coming fairness
+        # scheduler will be judged by): TTFT / inter-token / e2e
+        # histograms plus deadline-goodput counters per adapter id,
+        # recorded only on adapters-enabled engines, sharing ONE
+        # capped label space with the request counters above
+        self._by_adapter_lat: dict = {}
+        self._adapter_labels: set = set()
+        # fleet SLO tracker (serving/slo.py) riding the same hooks:
+        # on_token/on_inter_token/on_finish feed it the exact values
+        # the histograms record (engine-injected; None = SLO off).
+        # Lock order: metrics lock -> tracker lock, never reversed.
+        self.slo = None
+        # compiled-step cost census (engine-pushed once per compile)
+        # + the per-step achieved-utilization histogram it anchors
+        self.cost_census: Optional[dict] = None
+        self.step_capacity_tokens = 0
+        self.achieved_util_hist = Histogram(buckets=UTIL_BUCKETS)
         self.queue_depth_hist = Histogram()
         self.occupancy_hist = Histogram()
         self.pool_utilization_hist = Histogram()
@@ -296,6 +319,38 @@ class ServingMetrics:
         fakes without sampling params land in class 0)."""
         sampling = getattr(req, "sampling", None)
         return 0 if sampling is None else sampling.priority
+
+    @staticmethod
+    def _adapter_of(req) -> int:
+        sampling = getattr(req, "sampling", None)
+        return int(getattr(sampling, "adapter_id", 0) or 0)
+
+    def _adapter_label(self, adapter_id) -> str:
+        """ONE capped label space shared by every per-adapter series
+        (request counters AND latency/goodput): the first
+        ADAPTER_IDS_MAX distinct ids keep their own label, the rest
+        fold into "other" (callers hold self._lock)."""
+        lbl = str(int(adapter_id))
+        if lbl in self._adapter_labels:
+            return lbl
+        if len(self._adapter_labels) >= ADAPTER_IDS_MAX:
+            return "other"
+        self._adapter_labels.add(lbl)
+        return lbl
+
+    def _adapter_class(self, adapter_id) -> dict:
+        """The per-tenant histogram trio + goodput counters for
+        `adapter_id`, created on first sight (callers hold
+        self._lock; only called on adapters-enabled engines)."""
+        lbl = self._adapter_label(adapter_id)
+        cls = self._by_adapter_lat.get(lbl)
+        if cls is None:
+            cls = self._by_adapter_lat[lbl] = {
+                "ttft_s": Histogram(buckets=TTFT_BUCKETS),
+                "inter_token_s": Histogram(buckets=LATENCY_BUCKETS),
+                "e2e_s": Histogram(buckets=TTFT_BUCKETS),
+                "goodput": {"met": 0, "missed": 0}}
+        return cls
 
     def _priority_class(self, priority) -> dict:
         """The per-class histogram trio for `priority`, creating it on
@@ -323,10 +378,7 @@ class ServingMetrics:
         Label cardinality capped: the first ADAPTER_IDS_MAX distinct
         ids keep their own counter, the rest fold into "other"."""
         with self._lock:
-            lbl = str(int(adapter_id))
-            if lbl not in self._by_adapter and \
-                    len(self._by_adapter) >= ADAPTER_IDS_MAX:
-                lbl = "other"
+            lbl = self._adapter_label(adapter_id)
             self._by_adapter[lbl] = self._by_adapter.get(lbl, 0) + 1
 
     def on_admit(self, req, now: float):
@@ -346,18 +398,51 @@ class ServingMetrics:
             self._last_token_t = now
             if len(req.output_tokens) == 1:
                 ttft = now - req.arrival_t
+                pr, aid = self._priority_of(req), self._adapter_of(req)
                 self.ttft_s.record(ttft)
-                self._priority_class(self._priority_of(req))[
-                    "ttft_s"].record(ttft)
+                self._priority_class(pr)["ttft_s"].record(ttft)
+                if self.adapters_enabled:
+                    self._adapter_class(aid)["ttft_s"].record(ttft)
+                if self.slo is not None:
+                    self.slo.on_ttft(ttft, priority=pr,
+                                     adapter_id=aid, t=now)
 
-    def on_inter_token(self, dt: float, priority: int = 0):
+    def on_inter_token(self, dt: float, priority: int = 0,
+                       adapter_id: int = 0,
+                       now: Optional[float] = None):
         with self._lock:
             self.inter_token_s.record(dt)
             self._priority_class(priority)["inter_token_s"].record(dt)
+            if self.adapters_enabled:
+                self._adapter_class(adapter_id)[
+                    "inter_token_s"].record(dt)
+            if self.slo is not None:
+                self.slo.on_inter_token(dt, priority=priority,
+                                        adapter_id=adapter_id, t=now)
 
     def on_finish(self, req, now: float):
         with self._lock:
             sampling = getattr(req, "sampling", None)
+            pr, aid = self._priority_of(req), self._adapter_of(req)
+            if sampling is not None \
+                    and sampling.deadline_s is not None:
+                # deadline-goodput event: of the requests that CARRIED
+                # a deadline, a normal finish met it, a queued 504
+                # ("deadline") missed it; other terminal causes
+                # (cancel, replica death) judge neither way
+                if req.finish_reason in ("stop", "length"):
+                    met = True
+                elif req.finish_reason == "deadline":
+                    met = False
+                else:
+                    met = None
+                if met is not None:
+                    if self.adapters_enabled:
+                        self._adapter_class(aid)["goodput"][
+                            "met" if met else "missed"] += 1
+                    if self.slo is not None:
+                        self.slo.on_goodput(met, priority=pr,
+                                            adapter_id=aid, t=now)
             if sampling is not None \
                     and sampling.deadline_s is not None \
                     and req.finish_reason in ("stop", "length"):
@@ -376,8 +461,9 @@ class ServingMetrics:
                 self.requests_aborted += 1
             e2e = now - req.arrival_t
             self.e2e_s.record(e2e)
-            self._priority_class(self._priority_of(req))[
-                "e2e_s"].record(e2e)
+            self._priority_class(pr)["e2e_s"].record(e2e)
+            if self.adapters_enabled:
+                self._adapter_class(aid)["e2e_s"].record(e2e)
 
     def on_decode_step(self, wall_s: float):
         with self._lock:
@@ -410,9 +496,12 @@ class ServingMetrics:
             self.packed_prefill_tokens += int(prefill_tokens)
             self.packed_decode_tokens += int(decode_tokens)
             self.packed_draft_tokens += int(draft_tokens)
-            self.packed_tokens_hist.record(
-                int(prefill_tokens) + int(decode_tokens)
-                + int(draft_tokens))
+            packed = (int(prefill_tokens) + int(decode_tokens)
+                      + int(draft_tokens))
+            self.packed_tokens_hist.record(packed)
+            if self.step_capacity_tokens:
+                self.achieved_util_hist.record(
+                    packed / self.step_capacity_tokens)
             self.decode_step_s.record(wall_s)
 
     def on_grouped_step(self, flat_reads: int, actual_reads: int,
@@ -580,6 +669,18 @@ class ServingMetrics:
             "by_priority": {
                 lbl: {name: h.snapshot() for name, h in cls.items()}
                 for lbl, cls in sorted(self._by_priority.items())},
+            "by_adapter": {
+                lbl: {"ttft_s": cls["ttft_s"].snapshot(),
+                      "inter_token_s":
+                          cls["inter_token_s"].snapshot(),
+                      "e2e_s": cls["e2e_s"].snapshot(),
+                      "deadline_goodput": dict(cls["goodput"])}
+                for lbl, cls in sorted(self._by_adapter_lat.items())},
+            "achieved_util": self.achieved_util_hist.snapshot(),
+            "cost_census": (None if self.cost_census is None
+                            else dict(self.cost_census)),
+            "slo": (None if self.slo is None
+                    else self.slo.snapshot()),
         }
 
 
@@ -676,7 +777,13 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                        ("adapter_evictions_total", "counter"),
                        ("adapter_spills_total", "counter"),
                        ("adapter_restores_total", "counter"),
-                       ("adapter_requests_total", "counter")]:
+                       ("adapter_requests_total", "counter"),
+                       ("achieved_util", "histogram"),
+                       ("cost_census_flops", "gauge"),
+                       ("cost_census_bytes", "gauge"),
+                       ("cost_census_capacity_tokens", "gauge"),
+                       ("slo_state", "gauge"),
+                       ("slo_burn_rate", "gauge")]:
         lines.append(f"# TYPE {namespace}_{name} {kind}")
     for replica, snap in sorted(snapshots.items()):
         lab = {"replica": str(replica)}
@@ -832,6 +939,22 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                         cls["inter_token_s"], plab, lines)
             _hist_lines(f"{namespace}_e2e_seconds", cls["e2e_s"],
                         plab, lines)
+        # per-tenant latency/goodput series: same metric names, one
+        # extra `adapter` label per tenant (adapters-enabled engines
+        # only — the capped label space the request counters use)
+        for lbl, cls in sorted((snap.get("by_adapter") or {}).items()):
+            alab = {**lab, "adapter": lbl}
+            _hist_lines(f"{namespace}_ttft_seconds", cls["ttft_s"],
+                        alab, lines)
+            _hist_lines(f"{namespace}_inter_token_seconds",
+                        cls["inter_token_s"], alab, lines)
+            _hist_lines(f"{namespace}_e2e_seconds", cls["e2e_s"],
+                        alab, lines)
+            for outcome in ("met", "missed"):
+                lines.append(
+                    f"{namespace}_deadline_goodput_total"
+                    + _fmt_labels({**alab, "outcome": outcome})
+                    + f" {cls['deadline_goodput'].get(outcome, 0)}")
         dg = snap.get("deadline_goodput")
         if dg is not None:
             for outcome in ("met", "missed"):
@@ -839,6 +962,46 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                     f"{namespace}_deadline_goodput_total"
                     + _fmt_labels({**lab, "outcome": outcome})
                     + f" {dg.get(outcome, 0)}")
+        # achieved utilization of the unified step (packed tokens /
+        # program capacity — the cost census's live numerator)
+        if snap.get("achieved_util") is not None:
+            _hist_lines(f"{namespace}_achieved_util",
+                        snap["achieved_util"], lab, lines)
+        census = snap.get("cost_census")
+        if census is not None:
+            clab = {**lab, "source": census.get("source", "model")}
+            lines.append(f"{namespace}_cost_census_flops"
+                         + _fmt_labels(clab)
+                         + f" {census.get('flops', 0.0)}")
+            lines.append(f"{namespace}_cost_census_bytes"
+                         + _fmt_labels(clab)
+                         + f" {census.get('bytes_accessed', 0.0)}")
+            lines.append(f"{namespace}_cost_census_capacity_tokens"
+                         + _fmt_labels(lab)
+                         + f" {census.get('capacity_tokens', 0)}")
+        # SLO alert states + burn rates (serving/slo.py): one gauge
+        # per (slo, scope) series — value 0 ok / 1 warn / 2 page,
+        # with the state name riding as a label like breaker_state
+        slo = snap.get("slo")
+        if slo is not None:
+            from .slo import SLO_STATE_CODES
+            for slo_name, per in sorted(
+                    (slo.get("series") or {}).items()):
+                for key, s in sorted(per.items()):
+                    scope, _, label = key.partition(":")
+                    slab = {**lab, "slo": slo_name, "scope": scope,
+                            "label": label}
+                    lines.append(
+                        f"{namespace}_slo_state"
+                        + _fmt_labels({**slab,
+                                       "state": s["state"]})
+                        + f" {SLO_STATE_CODES.get(s['state'], -1)}")
+                    for window in ("fast", "slow"):
+                        lines.append(
+                            f"{namespace}_slo_burn_rate"
+                            + _fmt_labels({**slab,
+                                           "window": window})
+                            + f" {s[f'{window}_burn']}")
     if router is not None:
         for name in ("retries_total", "migrations_total",
                      "watchdog_kills_total"):

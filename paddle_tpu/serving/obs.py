@@ -232,15 +232,20 @@ class FlightRecorder:
                                "detail": detail})
 
     def incident(self, kind: str, detail: Optional[str] = None,
-                 step: Optional[int] = None) -> dict:
+                 step: Optional[int] = None,
+                 slo: Optional[dict] = None) -> dict:
         """Freeze the ring into a dump. Called on the existing
         fault/error paths: poison quarantine, deadline fail-fast,
-        raising rounds, replica death."""
+        raising rounds, replica death. `slo` (an SLOTracker snapshot)
+        rides in the dump so a postmortem of a dead replica still
+        shows whether the SLO was already burning when it died."""
         with self._lock:
             dump = {"kind": str(kind), "detail": detail,
                     "t": self._clock(),
                     "step": None if step is None else int(step),
                     "steps": [dict(r) for r in self._ring]}
+            if slo is not None:
+                dump["slo"] = slo
             self._incidents.append(dump)
             self.incidents_total += 1
             return dump

@@ -1,0 +1,122 @@
+"""Live one-row-per-replica fleet table over `GET /debug/fleet`.
+
+The fleet snapshot (paddle_tpu/serving/http/router.py
+`Router.fleet_snapshot()`) merges every replica's health, breaker
+state, load, pool occupancy, compiled-step cost census, achieved
+utilization and SLO burn state into one document. This script renders
+it as the `top`-style table a human scans during an incident:
+
+    python scripts/fleet_top.py http://127.0.0.1:8000
+        # fetch a live server's GET /debug/fleet (needs the server
+        # started with debug_endpoints=True / PADDLE_TPU_DEBUG=on)
+    python scripts/fleet_top.py fleet.json        # a saved snapshot
+    python scripts/fleet_top.py http://127.0.0.1:8000 --watch 2
+        # redraw every 2s until interrupted
+
+Columns: replica | up (ok/DEAD/drain) | brk (breaker) | steps | queue
+| res/slots | pages used/total | host | util (mean achieved
+utilization of the unified step) | tok/s | slo (worst burn state) |
+inc (incident dumps). A `page` SLO state or a DEAD row is where to
+start reading `flight_dump.py`.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+COLUMNS = ["replica", "up", "brk", "steps", "queue", "res", "pages",
+           "host", "util", "tok/s", "slo", "inc"]
+WIDTHS = [12, 6, 6, 7, 5, 7, 11, 5, 6, 8, 5, 4]
+
+
+def _fmt_row(cells):
+    return "  ".join(str(c).ljust(w) if i == 0 else str(c).rjust(w)
+                     for i, (c, w) in enumerate(zip(cells, WIDTHS)))
+
+
+def _replica_row(name, e):
+    if "error" in e:
+        return _fmt_row([name, "?", "-", "-", "-", "-", "-", "-",
+                         "-", "-", "-", "-"]) + f"  ({e['error']})"
+    up = ("drain" if e.get("draining")
+          else "DEAD" if e.get("dead")
+          else "ok" if e.get("healthy") else "down")
+    pool = e.get("pool") or {}
+    util = (e.get("achieved_util") or {}).get("mean")
+    tps = e.get("tokens_per_sec")
+    slo = (e.get("slo") or {}).get("worst", "-")
+    return _fmt_row([
+        name, up, e.get("breaker", "-"), e.get("steps", "-"),
+        e.get("queue_depth", "-"),
+        f"{e.get('residents', '-')}/{e.get('num_slots', '-')}",
+        f"{pool.get('pages_used', '-')}/{pool.get('pages_total', '-')}",
+        e.get("host_pages_used", "-"),
+        "-" if util is None else f"{util:.2f}",
+        "-" if tps is None else f"{tps:.1f}",
+        slo, e.get("incidents_total", "-")])
+
+
+def render_fleet(snapshot: dict) -> str:
+    """One fleet snapshot -> printable table (header: router state +
+    fleet-worst SLO; one row per replica; footer: each replica's
+    census, since FLOPs/bytes don't fit a column)."""
+    router = snapshot.get("router") or {}
+    lines = [
+        f"== fleet: {len(snapshot.get('replicas') or {})} replicas, "
+        f"ready={router.get('ready')} "
+        f"retries={router.get('retries_total', 0)} "
+        f"migrations={router.get('migrations_total', 0)} "
+        f"watchdog_kills={router.get('watchdog_kills_total', 0)} "
+        f"slo_worst={snapshot.get('slo_worst', '-')} ==",
+        _fmt_row(COLUMNS)]
+    replicas = snapshot.get("replicas") or {}
+    for name in sorted(replicas):
+        lines.append(_replica_row(name, replicas[name]))
+    for name in sorted(replicas):
+        census = (replicas[name] or {}).get("cost_census")
+        if census:
+            lines.append(
+                f"   {name} census[{census.get('source')}]: "
+                f"{census.get('flops', 0):.3g} flops/step, "
+                f"{census.get('bytes_accessed', 0):.3g} bytes/step, "
+                f"capacity {census.get('capacity_tokens')} tokens")
+    return "\n".join(lines)
+
+
+def load(source: str):
+    if source.startswith("http://") or source.startswith("https://"):
+        from urllib.request import urlopen
+        url = source.rstrip("/")
+        if not url.endswith("/debug/fleet"):
+            url += "/debug/fleet"
+        with urlopen(url, timeout=30) as resp:
+            return json.load(resp)
+    with open(source) as f:
+        return json.load(f)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="one-row-per-replica live fleet table")
+    ap.add_argument("source", help="server base URL (fetches "
+                    "/debug/fleet) or a snapshot JSON file")
+    ap.add_argument("--watch", type=float, default=None, metavar="S",
+                    help="redraw every S seconds until interrupted")
+    args = ap.parse_args(argv)
+    try:
+        while True:
+            text = render_fleet(load(args.source))
+            if args.watch is not None:
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(text)
+            if args.watch is None:
+                return
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,18 @@ those dumps for a human postmortem:
         # single FlightRecorder.snapshot() dict
     python scripts/flight_dump.py dump.json --incidents-only
     python scripts/flight_dump.py dump.json --last 40
+    python scripts/flight_dump.py http://127.0.0.1:8000 --fleet
+        # the one-row-per-replica fleet table instead (fetches
+        # GET /debug/fleet, rendered by scripts/fleet_top.py)
+
+Per-step columns include `util` — achieved utilization, the packed
+tokens of the step over the compiled program's capacity
+(num_slots * chunk_len; the cost census's live numerator) — and
+`slo`, the worst SLO burn state (ok/warn/page) at that step; SLO
+state TRANSITIONS appear inline as `** slo:<state>` note rows, so a
+postmortem shows "the SLO started burning HERE" between steps. An
+incident dump that carries the dead replica's final SLO snapshot
+prints its worst state in the incident header.
 
 `serving_bench.py --obs-ab` runs `render_flight` over the obs arm's
 recorder as its smoke check, so this renderer is exercised by CI, not
@@ -36,6 +48,10 @@ COLUMNS = [
     ("acc", "accepted_tokens", 4),
     ("saved", "reads_saved", 5),
     ("coll", "collectives", 4),
+    # packed tokens / program capacity (the cost census's live
+    # numerator) + the worst SLO burn state at this step
+    ("util", "achieved_util", 6),
+    ("slo", "slo", 5),
     # resident adapter-pool pages (multi-tenant LoRA; "-" without the
     # subsystem — the per-slot adapter map rides in "slot_adapters")
     ("adapter", "adapters_resident", 7),
@@ -79,17 +95,24 @@ def render_flight(snapshot, name="replica", last=None,
         else:
             lines.append("  (ring empty)")
     for i, inc in enumerate(snapshot.get("incidents", [])):
+        slo = inc.get("slo")
+        slo_txt = ("" if slo is None
+                   else f", slo at death: {slo.get('worst', '-')}")
         lines.append(
             f"-- incident {i}: {inc['kind']} at step {inc['step']} "
-            f"(detail: {inc.get('detail')}) — last "
+            f"(detail: {inc.get('detail')}{slo_txt}) — last "
             f"{len(inc['steps'])} steps before it --")
         lines.extend(render_steps(inc["steps"], last=last))
     return "\n".join(lines)
 
 
 def render(payload, last=None, incidents_only=False) -> str:
-    """A `/debug/flight` payload ({replica: snapshot}) or a bare
-    snapshot dict -> printable text."""
+    """A `/debug/flight` payload ({replica: snapshot}), a bare
+    snapshot dict, or a `/debug/fleet` document (rendered as the
+    fleet table) -> printable text."""
+    if "replicas" in payload and "router" in payload:
+        from fleet_top import render_fleet
+        return render_fleet(payload)
     if "steps" in payload and "capacity" in payload:
         return render_flight(payload, last=last,
                              incidents_only=incidents_only)
@@ -103,12 +126,12 @@ def render(payload, last=None, incidents_only=False) -> str:
     return "\n\n".join(parts)
 
 
-def load(source: str):
+def load(source: str, endpoint: str = "/debug/flight"):
     if source.startswith("http://") or source.startswith("https://"):
         from urllib.request import urlopen
         url = source.rstrip("/")
-        if not url.endswith("/debug/flight"):
-            url += "/debug/flight"
+        if not url.endswith(endpoint):
+            url += endpoint
         with urlopen(url, timeout=30) as resp:
             return json.load(resp)
     with open(source) as f:
@@ -124,9 +147,13 @@ def main(argv=None):
                     help="only the last N steps of each ring/dump")
     ap.add_argument("--incidents-only", action="store_true",
                     help="skip the live ring, print incident dumps")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fetch/render the /debug/fleet one-row-per-"
+                    "replica table instead of the step rings")
     args = ap.parse_args(argv)
-    print(render(load(args.source), last=args.last,
-                 incidents_only=args.incidents_only))
+    endpoint = "/debug/fleet" if args.fleet else "/debug/flight"
+    print(render(load(args.source, endpoint=endpoint),
+                 last=args.last, incidents_only=args.incidents_only))
 
 
 if __name__ == "__main__":

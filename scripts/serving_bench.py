@@ -11,7 +11,7 @@ in the bench trajectory. Prints ONE JSON line and writes the same
 stable-schema report to BENCH_serving.json (override with --out,
 suppress with --out -):
 
-    {"bench": "serving", "schema_version": 13, "attn_impl": "kernel",
+    {"bench": "serving", "schema_version": 14, "attn_impl": "kernel",
      "requests": ..., "ttft_p50_s": ..., "tokens_per_sec": ...,
      "decode_step_ms_p50": ..., "ab": {"kernel": {...},
      "gather": {...}}, "prefix_stats": {...}, "unified": {...},
@@ -84,16 +84,26 @@ and the max next-token logit drift of an int8 vs fp paged prefill
 through the model — and ASSERTS >= 1.5x residents at peak with int8
 on, drift under the pinned epsilon, and no tokens/s regression.
 
-`--obs-ab` adds the observability A/B (schema v11): the SAME Poisson
-trace once with the obs layer (serving/obs.py: request-lifecycle
-tracer + flight recorder) OFF and once ON. Both arms collect every
-emitted token; the report's "obs" section records per-arm tokens/s
-and the recorder's step/timeline counts — and the script ASSERTS the
-arms are token-identical, the on arm's tokens/s is within the 3%
-noise pin of the off arm's (observability must be free), the flight
-ring actually recorded the trace's steps, and that
+`--obs-ab` adds the observability A/B (schema v14): the SAME Poisson
+trace once with the WHOLE observability stack — the obs layer
+(serving/obs.py: request-lifecycle tracer + flight recorder) AND the
+PR-15 SLO tracker + cost census (serving/slo.py) — OFF and once ON.
+Both arms collect every emitted token; the report's "obs" section
+records per-arm tokens/s, the recorder's step/timeline counts, the
+on arm's cost census (captured exactly once per compile, asserted),
+its mean/max achieved utilization and its worst SLO state — and the
+script ASSERTS the arms are token-identical, the on arm's tokens/s
+is within the 3% noise pin of the off arm's (observability must be
+free), the flight ring actually recorded the trace's steps, and that
 `scripts/flight_dump.py` renders the on arm's ring into a non-empty
 per-step table (the CI smoke of the postmortem tooling).
+
+Every non-`--out -` run also APPENDS one line to
+`BENCH_history.jsonl` next to the report — timestamp, git rev,
+schema, and each produced section's headline tokens/s — so the
+bench trajectory is an append-only series, with a stderr warning
+when a section's headline drops > 10% vs the previous entry (the
+regression sentinel).
 
 `--lora-ab` adds the multi-tenant LoRA A/B (schema v13): a
 mixed-tenant Poisson trace — K registered adapters under zipf
@@ -198,6 +208,116 @@ def build_model(on_tpu: bool):
         model.to(dtype="bfloat16")
     model.eval()
     return model, cfg
+
+
+# -- bench trajectory (BENCH_history.jsonl) ---------------------------------
+# one line per bench run: timestamp, git rev, schema, platform, and
+# the headline tokens/s of every section the run produced — so the
+# bench trajectory is an append-only series instead of a single
+# overwritten report, and a regression shows up as a dip in the file
+# rather than a vanished number.
+_SECTION_HEADLINES = {
+    # section -> headline extractor (tokens/s-shaped number); missing
+    # sections are simply absent from the entry
+    "serving": lambda r: r.get("tokens_per_sec"),
+    "unified": lambda r: r["unified"]["on"]["tokens_per_sec"],
+    "spec": lambda r: r["spec"]["on"]["tokens_per_sec"],
+    "obs": lambda r: r["obs"]["on"]["tokens_per_sec"],
+    "grouped": lambda r: r["grouped"]["on"]["tokens_per_sec"],
+    "quant": lambda r: r["quant"]["int8"]["tokens_per_sec"],
+    "lora": lambda r: r["lora"]["batched"]["tokens_per_sec"],
+    "tp": lambda r: r["tp"]["mp2"]["tokens_per_sec"],
+    "http": lambda r: r["http"]["tokens_per_sec"],
+    "chaos": lambda r: r["chaos"]["goodput_tokens_per_sec"],
+}
+
+# a section's headline dropping more than this vs the PREVIOUS entry
+# trips the regression sentinel (a stderr warning, not a hard fail —
+# CPU smoke numbers are noisy; the trajectory is the evidence)
+HISTORY_REGRESSION_FRACTION = 0.10
+
+
+def _git_rev() -> str:
+    import subprocess
+    try:
+        return subprocess.check_output(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            stderr=subprocess.DEVNULL).decode().strip()
+    except Exception:
+        return "unknown"
+
+
+def bench_history_entry(report: dict, *, t: float = None) -> dict:
+    """One append-only trajectory line for `report`: schema, git rev,
+    timestamp, and each produced section's headline tokens/s."""
+    sections = {}
+    for name, get in _SECTION_HEADLINES.items():
+        if name != "serving" and name not in report:
+            continue
+        try:
+            v = get(report)
+        except (KeyError, TypeError):
+            continue
+        if v is not None:
+            sections[name] = round(float(v), 4)
+    t = time.time() if t is None else t
+    return {"t": round(t, 3),
+            "iso": time.strftime("%Y-%m-%dT%H:%M:%S",
+                                 time.gmtime(t)) + "Z",
+            "git_rev": _git_rev(),
+            "schema_version": report.get("schema_version"),
+            "platform": report.get("platform"),
+            "requests": report.get("requests"),
+            "sections": sections}
+
+
+def check_history_regression(prev: dict, entry: dict,
+                             threshold: float =
+                             HISTORY_REGRESSION_FRACTION) -> list:
+    """Warnings for every section whose headline dropped more than
+    `threshold` vs `prev` (same-schema comparisons only would be too
+    strict — the headline meaning is stable across schemas)."""
+    warnings = []
+    prev_s = prev.get("sections") or {}
+    for name, v in (entry.get("sections") or {}).items():
+        old = prev_s.get(name)
+        if not old or old <= 0:
+            continue
+        drop = 1.0 - v / old
+        if drop > threshold:
+            warnings.append(
+                f"bench section '{name}' headline dropped "
+                f"{drop:.1%} vs previous entry "
+                f"({old} -> {v} tokens/s; rev "
+                f"{prev.get('git_rev')} -> {entry.get('git_rev')})")
+    return warnings
+
+
+def append_bench_history(path: str, entry: dict) -> list:
+    """Append `entry` to the JSONL trajectory at `path` and return
+    regression warnings vs the last prior entry (corrupt/missing
+    lines are skipped, never fatal — history must not break the
+    bench)."""
+    prev = None
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    prev = json.loads(line)
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    warnings = (check_history_regression(prev, entry)
+                if prev is not None else [])
+    with open(path, "a") as f:
+        f.write(json.dumps(entry, sort_keys=True) + "\n")
+    return warnings
 
 
 def main():
@@ -468,11 +588,16 @@ def main():
         obs_budgets = np.asarray([budgets[i % len(budgets)]
                                   for i in range(obs_n)])
         for mode in ("off", "on"):
+            # the off arm turns the WHOLE observability stack off —
+            # obs layer, SLO tracker AND cost census — so the pin
+            # prices everything PR 12 + PR 15 added to the hot path
             attempts = [run_trace(
                 model, obs_arrivals, obs_prompts, obs_budgets,
                 slots=args.slots, max_len=max_len,
                 page_size=args.page_size, pages=args.pages,
                 chunk=chunk, attn_impl="kernel", obs=(mode == "on"),
+                slo=(None if mode == "on" else False),
+                cost_census=(None if mode == "on" else False),
                 collect_tokens=True) for _ in range(5)]
             for a in attempts[1:]:
                 assert a["tokens"] == attempts[0]["tokens"], \
@@ -583,7 +708,7 @@ def main():
 
     report = {
         "bench": "serving",
-        "schema_version": 13,
+        "schema_version": 14,
         "platform": jax.devices()[0].platform,
         "attn_impl": "kernel",
         "requests": n_req,
@@ -664,6 +789,8 @@ def main():
                        _obs_summary(obs_runs["off"]))
         flight = obs_runs["on"]["flight"]
         tracer = obs_runs["on"]["obs_stats"]["tracer"]
+        on_snap = obs_runs["on"]["snap"]
+        util = on_snap.get("achieved_util") or {}
         # the flight-dump smoke: the postmortem renderer must turn the
         # on arm's ring into a real per-step table (CI exercises the
         # 3am tooling, not just the recorder)
@@ -691,6 +818,17 @@ def main():
             + tracer["timelines_evicted"],
             "timeline_events_recorded": tracer["events_recorded"],
             "flight_dump_rows": len(dump_rows),
+            # PR 15: the on arm also ran the SLO tracker + cost
+            # census (the off arm ran neither — the pin above prices
+            # the whole observability stack)
+            "cost_census": obs_runs["on"]["census"],
+            "census_captures": obs_runs["on"]["census_captures"],
+            "achieved_util_mean": util.get("mean"),
+            "achieved_util_max": util.get("max"),
+            "slo_worst": (obs_runs["on"].get("slo") or {}).get(
+                "worst"),
+            "slo_events": (obs_runs["on"].get("slo") or {}).get(
+                "events_total"),
         }
     if share > 0.0:
         report["prefix"] = {
@@ -773,6 +911,15 @@ def main():
         with open(args.out, "w") as f:
             json.dump(report, f, indent=1, sort_keys=True)
             f.write("\n")
+        # append this run to the bench trajectory next to the report
+        # and warn (stderr, non-fatal) when a section's headline
+        # dropped > 10% vs the previous entry
+        hist_path = os.path.join(
+            os.path.dirname(os.path.abspath(args.out)),
+            "BENCH_history.jsonl")
+        for w in append_bench_history(hist_path,
+                                      bench_history_entry(report)):
+            print(f"WARNING: {w}", file=sys.stderr)
     for impl, run in runs.items():
         assert run["snap"]["requests"]["completed"] == n_req, \
             (impl, run["snap"]["requests"], n_req)
@@ -828,6 +975,16 @@ def main():
         assert ob["timelines_recorded"] >= ob["requests"], ob
         assert ob["flight_dump_rows"] >= min(
             ob["flight_steps_recorded"], ob["flight_ring_capacity"]), ob
+        # PR 15 acceptance: the cost census was captured EXACTLY once
+        # per compiled step, achieved_util landed on every recorded
+        # step (0 < mean <= 1), and the SLO tracker really evaluated
+        # the trace's events (generous default targets: worst "ok")
+        assert ob["cost_census"] is not None \
+            and ob["cost_census"]["flops"] > 0, ob
+        assert ob["census_captures"] == 1, ob
+        assert ob["achieved_util_mean"] is not None \
+            and 0.0 < ob["achieved_util_mean"] <= 1.0, ob
+        assert ob["slo_events"] and ob["slo_worst"] == "ok", ob
     if share > 0.0:
         on, off = report["prefix"]["on"], report["prefix"]["off"]
         # the acceptance number: a warm cache must do strictly less
@@ -951,7 +1108,8 @@ def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
               page_size, pages, chunk, attn_impl, prefix_cache=None,
               warm_prompts=(), unified=None, spec=None,
               collect_tokens=False, kv_dtype=None, grouped=None,
-              obs=None, mesh=None, collect_collectives=False):
+              obs=None, mesh=None, collect_collectives=False,
+              slo=None, cost_census=None):
     """One Poisson-trace replay through a fresh engine pinned to
     `attn_impl` (and, for the prefix A/B, to `prefix_cache` on/off;
     for the unified-step A/B, to `unified` on/off; for the spec A/B,
@@ -972,7 +1130,8 @@ def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
                         chunk_len=chunk, attn_impl=attn_impl,
                         prefix_cache=prefix_cache, unified=unified,
                         spec=spec, kv_dtype=kv_dtype, grouped=grouped,
-                        obs=obs, mesh=mesh)
+                        obs=obs, mesh=mesh, slo=slo,
+                        cost_census=cost_census)
 
     # warm the compiled programs so the trace measures steady state, not
     # XLA compile time: one request per distinct prompt length (chunk
@@ -987,6 +1146,13 @@ def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
     eng.metrics.__init__()   # drop warmup from the report
     if eng.obs is not None:
         eng.obs.reset()      # ... and from the flight ring/timelines
+    if eng.slo is not None:
+        eng.slo.reset()      # ... and from the SLO burn windows
+    # metrics.__init__ dropped the engine-wired fields: restore the
+    # SLO hook + the census/capacity anchors next to the A/B tags
+    eng.metrics.slo = eng.slo
+    eng.metrics.step_capacity_tokens = eng.step_capacity_tokens
+    eng.metrics.cost_census = eng._census
     eng.metrics.attn_impl = eng.attn_impl
     eng.metrics.unified = eng.unified
     eng.metrics.grouped = eng.grouped
@@ -1024,6 +1190,10 @@ def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
     if eng.obs is not None:
         out["flight"] = eng.obs.flight.snapshot()
         out["obs_stats"] = eng.obs.stats()
+    out["census"] = eng.cost_census()
+    out["census_captures"] = eng._census_captures
+    if eng.slo is not None:
+        out["slo"] = eng.slo.snapshot()
     return out
 
 

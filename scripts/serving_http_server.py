@@ -19,6 +19,9 @@ graceful drain (stop admitting -> finish residents -> exit 0):
     curl -s localhost:8000/debug/state | python -m json.tool | head
     curl -s localhost:8000/debug/requests/cmpl-0   # one timeline
     python scripts/flight_dump.py http://localhost:8000  # ring table
+    python scripts/fleet_top.py http://localhost:8000 --watch 2
+        # one-row-per-replica fleet view (SLO burn state, cost
+        # census, achieved utilization; GET /debug/fleet)
     kill -TERM <pid>       # graceful drain
 """
 from __future__ import annotations
@@ -77,6 +80,12 @@ def main():
                     help="device adapter-pool capacity in adapters; "
                     "cold tenants load on demand, idle ones park, "
                     "pressure spills to host RAM / evicts LRU")
+    ap.add_argument("--slo", default=None, metavar="SPEC",
+                    help="SLO targets for the burn-rate tracker "
+                    "(serving/slo.py), e.g. "
+                    "'ttft_p99=0.5,itl_p99=0.1,goodput=0.99' — "
+                    "'off' disables; default = the generous "
+                    "defaults / PADDLE_TPU_SLO")
     ap.add_argument("--debug", action="store_true",
                     help="expose the /debug/state, "
                     "/debug/requests/<id> and /debug/flight "
@@ -102,7 +111,8 @@ def main():
                              host_pages=args.host_pages,
                              adapters=args.adapters > 0 or None,
                              adapter_pages=args.adapter_pages,
-                             adapter_ranks=(args.adapter_rank,))
+                             adapter_ranks=(args.adapter_rank,),
+                             slo=args.slo)
                for _ in range(args.replicas)]
     if args.adapters:
         # identical registration order on every replica -> identical
