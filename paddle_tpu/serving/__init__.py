@@ -89,6 +89,24 @@ per-step `achieved_util`, and a router-level fleet view
 (`GET /debug/fleet`, `scripts/fleet_top.py`). All host-side work —
 `serving_bench --obs-ab` pins it on/off token-identical within 3%.
 
+The fleet STEERS ITSELF from those signals (serving/controlplane.py,
+default off, PADDLE_TPU_CONTROLPLANE=on / Router(controller=...) /
+serve(controller=...)): a pure host-side FleetController turns the
+PR-15 telemetry into three actuators — SLO-aware placement (the
+router ranks warn-state replicas below ok and page below warn, after
+the breaker, before load), deadline-aware admission (a request whose
+deadline is infeasible given queue depth x census-predicted step cost
+is shed AT THE DOOR with 429 + Retry-After, type
+`deadline_infeasible`, instead of timing out after burning pages),
+and reactive burn-rate autoscaling (double-window burn => scale up,
+sustained idle => drain one surplus replica gracefully, with
+hysteresis + per-direction cool-downs; `Router.add_replica` /
+`remove_replica` grow and shrink the live fleet). Zero compiled-
+program changes — controller on/off is bit-token-identical at fixed
+fleet size; `serving_bench --autoscale-ab` drives a diurnal trace
+where reactive scaling holds TTFT p99 within SLO at roughly half the
+fixed fleet's replica-seconds.
+
 Greedy requests are bit-identical to offline CompiledGenerator decode
 (tested); `scripts/serving_bench.py` drives a Poisson arrival trace and
 reports TTFT/throughput/pool utilization into BENCH_serving.json
@@ -97,6 +115,10 @@ reports TTFT/throughput/pool utilization into BENCH_serving.json
 from .adapters import (AdapterStore, LoRAWeights,  # noqa: F401
                        make_random_lora, resolve_adapters_flag,
                        BASE_ADAPTER)
+from .controlplane import (ControlPlaneConfig, Decision,  # noqa: F401
+                           DeadlineInfeasible, FleetController,
+                           FleetSignals, parse_controlplane_spec,
+                           resolve_controlplane, slo_placement_rank)
 from .engine import (ServingEngine, resolve_grouped_flag,  # noqa: F401
                      resolve_kv_dtype, resolve_preempt_flag,
                      resolve_unified_flag)
@@ -147,4 +169,7 @@ __all__ = ["AdapterStore", "LoRAWeights", "make_random_lora",
            "ServingTP", "resolve_serving_mesh", "parse_mesh_spec",
            "collective_counts", "SLOConfig", "SLOTracker",
            "resolve_slo_config", "resolve_cost_census",
-           "model_cost_census"]
+           "model_cost_census", "ControlPlaneConfig", "Decision",
+           "DeadlineInfeasible", "FleetController", "FleetSignals",
+           "parse_controlplane_spec", "resolve_controlplane",
+           "slo_placement_rank"]

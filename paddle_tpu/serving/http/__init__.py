@@ -21,6 +21,8 @@ replicas, and `ServingHTTPServer` exposes OpenAI-style
 """
 from typing import Optional, Sequence
 
+from ..controlplane import (DeadlineInfeasible, FleetController,  # noqa: F401,E501
+                            resolve_controlplane)
 from ..faults import FaultInjector, InjectedFault, resolve_faults  # noqa: F401,E501
 from .driver import EngineDriver, ReplicaDead, ReplicaHung  # noqa: F401
 from .protocol import (CompletionRequest, ProtocolError,  # noqa: F401
@@ -34,7 +36,9 @@ __all__ = ["EngineDriver", "ReplicaDead", "ReplicaHung", "Router",
            "Ticket", "CircuitBreaker", "ReplicaWatchdog",
            "ServingHTTPServer", "ProtocolError", "CompletionRequest",
            "parse_completion_request", "RateLimiter", "TokenBucket",
-           "FaultInjector", "InjectedFault", "resolve_faults", "serve"]
+           "FaultInjector", "InjectedFault", "resolve_faults",
+           "FleetController", "DeadlineInfeasible",
+           "resolve_controlplane", "serve"]
 
 
 def serve(engines: Sequence, host: str = "127.0.0.1", port: int = 0,
@@ -49,6 +53,7 @@ def serve(engines: Sequence, host: str = "127.0.0.1", port: int = 0,
           breaker_failures: int = 3,
           breaker_open_s: float = 1.0,
           faults: Optional[FaultInjector] = None,
+          controller=None,
           debug_endpoints=None) -> ServingHTTPServer:
     """One-call assembly: wrap each engine in a driver, front them with
     a router, start the HTTP server on (host, port) — port 0 picks a
@@ -63,10 +68,19 @@ def serve(engines: Sequence, host: str = "127.0.0.1", port: int = 0,
     `debug_endpoints=True` (or PADDLE_TPU_DEBUG=on) exposes the
     `/debug/state`, `/debug/requests/<id>` and `/debug/flight`
     introspection routes (serving/obs.py) — off by default, they
-    carry prompt metadata. Returns the STARTED server; call `drain()`
+    carry prompt metadata. `controller` attaches a fleet control
+    plane (serving/controlplane.py: SLO-aware placement,
+    deadline-aware admission, burn-rate autoscaling) — pass a
+    `FleetController`, True/False, or a spec string; when omitted,
+    the PADDLE_TPU_CONTROLPLANE env spec is resolved (unset = off).
+    Returns the STARTED server; call `drain()`
     (or `install_signal_handlers()` for SIGTERM) to stop."""
     if faults is None:
         faults = resolve_faults()
+    if not isinstance(controller, FleetController):
+        cp_cfg = resolve_controlplane(controller)
+        controller = (None if cp_cfg is None
+                      else FleetController(cp_cfg))
     drivers = [EngineDriver(e, name=f"replica-{i}", faults=faults)
                for i, e in enumerate(engines)]
     router = Router(drivers, max_retries=max_retries,
@@ -74,7 +88,8 @@ def serve(engines: Sequence, host: str = "127.0.0.1", port: int = 0,
                     default_timeout_s=default_timeout_s,
                     watchdog_timeout_s=watchdog_timeout_s,
                     breaker_failures=breaker_failures,
-                    breaker_open_s=breaker_open_s)
+                    breaker_open_s=breaker_open_s,
+                    controller=controller)
     server = ServingHTTPServer(router, host, port,
                                model_name=model_name,
                                poll_interval_s=poll_interval_s,

@@ -270,10 +270,21 @@ class EngineDriver:
             "adapters_hot": (sorted(eng.adapters.hot_ids())
                              if eng.adapters is not None else []),
             # worst live SLO alert state (serving/slo.py; None = SLO
-            # tracking off) — the fleet view's per-replica column
+            # tracking off) — the fleet view's per-replica column AND
+            # the router's SLO-aware placement rank (controlplane on:
+            # warn ranks below ok, page below warn)
             "slo_state": (eng.slo.worst_state()
                           if getattr(eng, "slo", None) is not None
                           else None),
+            # fleet-worst (fast, slow) burn rates + recent achieved
+            # utilization: the control plane's scale signals
+            # (serving/controlplane.py)
+            "slo_burns": (eng.slo.worst_burns()
+                          if getattr(eng, "slo", None) is not None
+                          else None),
+            "util_recent": (eng.metrics.achieved_util_recent
+                            if getattr(eng, "metrics", None) is not None
+                            else None),
         }
 
     # -- pump thread -------------------------------------------------------

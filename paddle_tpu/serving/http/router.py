@@ -37,6 +37,22 @@ Fronts N `EngineDriver` replicas with:
 - **Graceful drain**: `drain()` stops admission, drains every replica
   in parallel (residents finish, queued are aborted), and joins the
   driver threads. `/readyz` flips to 503 the moment drain begins.
+- **Fleet control plane** (`controller=`, serving/controlplane.py,
+  gated PADDLE_TPU_CONTROLPLANE, default off): placement becomes
+  SLO-aware (a replica whose burn state is `warn` ranks below `ok`
+  and `page` below `warn` — after breaker health, before load, so
+  traffic drains away from a burning replica before it pages),
+  `submit` sheds deadline-infeasible requests at the door (429 +
+  Retry-After), and the controller resizes the fleet at runtime
+  through `add_replica` / `remove_replica`: registration and
+  removal happen under the router lock — the same discipline
+  `Ticket._retry`/`cancel` use — so a retry or cancel racing a
+  removal always acts on a live (driver, request) pair, and removal
+  drains the replica gracefully (residents finish, streams complete).
+  Dead replicas stay listed in `fleet_snapshot()` with their frozen
+  SLO state, capped at the last `dead_replica_cap` (default 16;
+  older tombstones are evicted and counted by
+  `fleet_dead_evicted_total`).
 """
 from __future__ import annotations
 
@@ -49,6 +65,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..controlplane import DeadlineInfeasible, slo_placement_rank
 from ..errors import EngineClosed, QueueFull, ServingError
 from ..faults import InjectedFault
 from ..request import Request, RequestOutput, SamplingParams
@@ -412,6 +429,8 @@ class Router:
                  watchdog_interval_s: Optional[float] = None,
                  breaker_failures: int = 3,
                  breaker_open_s: float = 1.0,
+                 controller=None,
+                 dead_replica_cap: int = 16,
                  clock=time.monotonic):
         if not drivers:
             raise ValueError("router needs at least one driver")
@@ -435,9 +454,29 @@ class Router:
         self._ids = itertools.count()
         self.retries_total = 0
         self.migrations_total = 0
+        self._breaker_failures = int(breaker_failures)
+        self._breaker_open_s = float(breaker_open_s)
         self.breakers: Dict[str, CircuitBreaker] = {
             d.name: CircuitBreaker(breaker_failures, breaker_open_s)
             for d in self.drivers}
+        # fleet control plane (serving/controlplane.py; None = off):
+        # SLO-aware placement, deadline-aware admission, and — when
+        # the controller carries a replica_factory — autoscaling over
+        # add_replica/remove_replica
+        self.controller = controller
+        self._controller_stop = threading.Event()
+        self._controller_thread: Optional[threading.Thread] = None
+        # runtime registration: monotonically increasing name seq
+        # (never reuses a tombstoned name) + dead-replica tombstone cap
+        self._started = False
+        self._names_ever = set(names)
+        self._replica_seq = len(self.drivers)
+        self.dead_replica_cap = int(dead_replica_cap)
+        self.fleet_dead_evicted_total = 0
+        self._death_seen: List[str] = []
+        # per-replica count of placements steered AROUND it because
+        # its SLO was burning (fleet_top's burn-avoidance column)
+        self._avoided_by: Dict[str, int] = {}
         self.watchdog: Optional[ReplicaWatchdog] = None
         self._watchdog_stop = threading.Event()
         self._watchdog_thread: Optional[threading.Thread] = None
@@ -459,14 +498,33 @@ class Router:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "Router":
-        for d in self.drivers:
+        self._started = True
+        for d in list(self.drivers):
             d.start()
         if self.watchdog is not None and self._watchdog_thread is None:
             self._watchdog_thread = threading.Thread(
                 target=self._watchdog_loop, name="router-watchdog",
                 daemon=True)
             self._watchdog_thread.start()
+        if (self.controller is not None
+                and self._controller_thread is None
+                and getattr(self.controller.config, "interval_s", 0)
+                > 0):
+            self._controller_thread = threading.Thread(
+                target=self._controller_loop,
+                name="router-controlplane", daemon=True)
+            self._controller_thread.start()
         return self
+
+    def _controller_loop(self):
+        interval = float(self.controller.config.interval_s)
+        while not self._controller_stop.wait(interval):
+            if self._draining:
+                return
+            try:
+                self.controller.poll(self)
+            except Exception:
+                pass    # a torn stats read must not kill the loop
 
     def _watchdog_loop(self):
         while not self._watchdog_stop.wait(self._watchdog_interval_s):
@@ -484,7 +542,7 @@ class Router:
     @property
     def healthy(self) -> bool:
         """Liveness: at least one replica pump thread is serving."""
-        return any(d.healthy for d in self.drivers)
+        return any(d.healthy for d in list(self.drivers))
 
     @property
     def ready(self) -> bool:
@@ -496,13 +554,121 @@ class Router:
         join the driver threads. Safe to call more than once."""
         self._draining = True
         self._watchdog_stop.set()
+        self._controller_stop.set()
         threads = [threading.Thread(target=d.drain, args=(timeout,),
                                     daemon=True)
-                   for d in self.drivers]
+                   for d in list(self.drivers)]
         for t in threads:
             t.start()
         for t in threads:
             t.join(timeout)
+
+    # -- runtime replica registration (the controller's actuators) ---------
+    def add_replica(self, engine=None, *, driver: Optional[EngineDriver]
+                    = None, name: Optional[str] = None,
+                    start: bool = True) -> EngineDriver:
+        """Register a replica at runtime (the autoscaler's scale-up
+        path): wrap `engine` in an EngineDriver (or take a prepared
+        `driver`), create its breaker, extend the watchdog's scan
+        list, and start the pump if the router is running. Names are
+        auto-assigned from a monotonically increasing sequence and
+        NEVER reuse a name this router has ever seen (a tombstoned
+        replica keeps its identity in postmortems). All membership
+        mutation happens under the router lock — the same discipline
+        `Ticket._retry`/`cancel` take — so placement snapshots are
+        always consistent."""
+        if engine is not None and isinstance(engine, EngineDriver):
+            driver, engine = engine, None           # positional driver
+        if (engine is None) == (driver is None):
+            raise ValueError("pass exactly one of engine= or driver=")
+        if self._draining:
+            raise EngineClosed("router is draining")
+        with self._lock:
+            if driver is None:
+                while name is None or name in self._names_ever:
+                    name = f"replica-{self._replica_seq}"
+                    self._replica_seq += 1
+                driver = EngineDriver(engine, name=name)
+            if driver.name in self._names_ever:
+                raise ValueError(
+                    f"replica name {driver.name!r} already used")
+            self._names_ever.add(driver.name)
+            self.drivers.append(driver)
+            self.breakers[driver.name] = CircuitBreaker(
+                self._breaker_failures, self._breaker_open_s)
+            if self.watchdog is not None:
+                self.watchdog.drivers.append(driver)
+            started = self._started
+        if start and started:
+            driver.start()
+        return driver
+
+    def remove_replica(self, name: str, *,
+                       timeout: Optional[float] = None,
+                       wait: bool = True) -> EngineDriver:
+        """Deregister a replica at runtime (the autoscaler's
+        scale-down path): remove it from placement and the watchdog
+        under the router lock, then GRACEFULLY drain it — residents
+        finish and in-flight streams complete. A Ticket retry racing
+        the removal re-snapshots `self.drivers` under the same lock,
+        so it can never re-place onto the removed replica; a cancel
+        racing it still targets the live driver object (removal never
+        invalidates the (driver, request) pair, it only stops new
+        placements). `wait=False` drains on a daemon thread (the
+        controller's non-blocking path). Refuses to remove the last
+        live replica — `drain()` is how the fleet stops."""
+        with self._lock:
+            target = next((d for d in self.drivers if d.name == name),
+                          None)
+            if target is None:
+                raise ValueError(f"no replica named {name!r}")
+            live = [d for d in self.drivers
+                    if d.healthy and not d.draining]
+            if target in live and len(live) <= 1:
+                raise ValueError(
+                    f"refusing to remove {name!r}: last live replica "
+                    "(use drain() to stop the fleet)")
+            self.drivers.remove(target)
+            if self.watchdog is not None \
+                    and target in self.watchdog.drivers:
+                self.watchdog.drivers.remove(target)
+            # the breaker entry stays: an in-flight placement may
+            # still read it; tombstone pruning reaps it later
+        if wait:
+            target.drain(timeout)
+        else:
+            threading.Thread(target=target.drain, args=(timeout,),
+                             daemon=True).start()
+        return target
+
+    def _prune_dead(self):
+        """Dead-replica tombstone cap: dead replicas stay listed in
+        `fleet_snapshot()` with their frozen SLO state — but only the
+        last `dead_replica_cap` of them. Older tombstones are evicted
+        (removed from every router structure) and counted by
+        `fleet_dead_evicted_total`, so a chaos fleet cannot grow the
+        snapshot without bound."""
+        with self._lock:
+            dead_names = {d.name for d in self.drivers if d.dead}
+            for d in self.drivers:
+                if d.dead and d.name not in self._death_seen:
+                    self._death_seen.append(d.name)
+            self._death_seen = [n for n in self._death_seen
+                                if n in dead_names]
+            excess = len(self._death_seen) - self.dead_replica_cap
+            if excess <= 0:
+                return
+            for name in self._death_seen[:excess]:
+                target = next(d for d in self.drivers
+                              if d.name == name)
+                self.drivers.remove(target)
+                if self.watchdog is not None \
+                        and target in self.watchdog.drivers:
+                    self.watchdog.drivers.remove(target)
+                self.breakers.pop(name, None)
+                self._avoided_by.pop(name, None)
+                self.fleet_dead_evicted_total += 1
+            self._death_seen = self._death_seen[excess:]
 
     # -- submission --------------------------------------------------------
     def submit(self, prompt_ids, sampling: Optional[SamplingParams] = None,
@@ -515,6 +681,24 @@ class Router:
         if sampling is not None and sampling.timeout_s is None \
                 and self.default_timeout_s is not None:
             sampling.timeout_s = self.default_timeout_s
+        # deadline-aware admission (controlplane on): a request whose
+        # placement deadline is already infeasible at the current
+        # backlog is shed AT THE DOOR (429 + Retry-After) before it
+        # wastes a queue slot and KV pages
+        ctrl = self.controller
+        if (ctrl is not None and sampling is not None
+                and sampling.deadline_s is not None):
+            retry = ctrl.check_admission(ctrl.observe(self),
+                                         sampling.deadline_s)
+            if retry is not None:
+                ctrl._note(self, "shed",
+                           {"deadline_s": sampling.deadline_s,
+                            "retry_after_s": round(retry, 3)})
+                raise DeadlineInfeasible(
+                    f"deadline {sampling.deadline_s}s is infeasible "
+                    "at the current backlog (predicted queue wait "
+                    "exceeds it); shed at admission",
+                    retry_after_s=retry)
         if ticket_id is None:
             ticket_id = f"cmpl-{next(self._ids)}"
         return Ticket(self, ticket_id, prompt_ids, sampling)
@@ -526,14 +710,19 @@ class Router:
         if self._draining:
             raise EngineClosed("router is draining")
         now = self._clock()
-        healthy = [d for d in self.drivers if d.healthy]
+        # membership snapshot under the lock: add/remove_replica
+        # mutate self.drivers under the same lock, so a placement
+        # racing a removal never walks a half-updated list
+        with self._lock:
+            drivers = list(self.drivers)
+        healthy = [d for d in drivers if d.healthy]
         if not healthy:
             raise EngineClosed("no healthy replica")
         # breaker gate, with a last-resort fallback: if EVERY healthy
         # replica's breaker is open, shunning them all would turn a
         # flap into a total outage — use them anyway
         allowed = [d for d in healthy
-                   if self.breakers[d.name].allow(now)]
+                   if self._breaker_for(d.name).allow(now)]
         pool = allowed or healthy
         # every survivor already tried: allow re-tries on them rather
         # than failing a retryable request outright
@@ -545,7 +734,8 @@ class Router:
         # after breaker health and before load
         aid = int(getattr(sampling, "adapter_id", 0) or 0) \
             if sampling is not None else 0
-        cands.sort(key=lambda d: self._load_key(d, aid))
+        keys = {id(d): self._load_key(d, aid) for d in cands}
+        cands.sort(key=lambda d: keys[id(d)])
         last: Optional[ServingError] = None
         for d in cands:
             try:
@@ -558,25 +748,55 @@ class Router:
                 # raced into death/drain between the health check and
                 # the submit (or an injected admission fault): charge
                 # the breaker, try the next candidate
-                self.breakers[d.name].record_failure(self._clock())
+                self._breaker_for(d.name).record_failure(self._clock())
                 last = exc
             else:
-                self.breakers[d.name].record_success(self._clock())
+                self._breaker_for(d.name).record_success(self._clock())
+                # burn-avoidance accounting (controlplane on): this
+                # placement steered around every candidate whose SLO
+                # rank was worse than the chosen replica's
+                if self.controller is not None:
+                    chosen_slo = keys[id(d)][1]
+                    avoided = [c for c in cands
+                               if keys[id(c)][1] > chosen_slo]
+                    if avoided:
+                        with self._lock:
+                            for c in avoided:
+                                self._avoided_by[c.name] = \
+                                    self._avoided_by.get(c.name, 0) + 1
+                        self.controller.on_placement_avoided()
                 return d, req
         if isinstance(last, QueueFull):
             raise last
         raise EngineClosed("no replica accepted the request") from last
 
+    def _breaker_for(self, name: str) -> CircuitBreaker:
+        """Breaker lookup that survives a racing remove/prune: a
+        replica evicted mid-placement gets a throwaway closed breaker
+        (its verdict no longer matters)."""
+        b = self.breakers.get(name)
+        if b is None:
+            b = CircuitBreaker(self._breaker_failures,
+                               self._breaker_open_s)
+        return b
+
     def _load_key(self, d: EngineDriver, adapter_id: int = 0):
         s = d.stats()
         rank = CircuitBreaker.PLACEMENT_RANK[
-            self.breakers[d.name].state(self._clock())]
+            self._breaker_for(d.name).state(self._clock())]
+        # SLO-aware placement (controlplane on): a replica whose burn
+        # state is `warn` ranks below `ok` and `page` below `warn` —
+        # after breaker health (a tripped replica is worse than a
+        # burning one), before adapter warmth and load — so traffic
+        # drains away from a burning replica before it pages
+        slo_rank = (slo_placement_rank(s.get("slo_state"))
+                    if self.controller is not None else 0)
         cold = 0
         if adapter_id:
             store = getattr(d.engine, "adapters", None)
             cold = 0 if (store is not None
                          and store.is_hot(adapter_id)) else 1
-        return (rank, cold, s["queue_depth"], s["inflight"],
+        return (rank, slo_rank, cold, s["queue_depth"], s["inflight"],
                 -s["free_pages"])
 
     # -- multi-tenant adapter registry --------------------------------------
@@ -598,18 +818,21 @@ class Router:
         return {
             "ready": self.ready,
             "draining": self._draining,
-            "replicas": [d.stats() for d in self.drivers],
+            "replicas": [d.stats() for d in list(self.drivers)],
             "retries_total": self.retries_total,
             "migrations_total": self.migrations_total,
             "watchdog_kills_total": self.watchdog_kills_total,
+            "fleet_dead_evicted_total": self.fleet_dead_evicted_total,
             "breakers": {name: b.state(now)
-                         for name, b in self.breakers.items()},
+                         for name, b in dict(self.breakers).items()},
+            "controlplane": (None if self.controller is None
+                             else self.controller.stats()),
         }
 
     def metrics_snapshots(self) -> dict:
         """{replica name: engine metrics snapshot} for /metrics."""
         return {d.name: d.engine.metrics.snapshot()
-                for d in self.drivers}
+                for d in list(self.drivers)}
 
     # -- debug introspection (serving/obs.py; env-gated in server.py) ------
     def debug_state(self) -> dict:
@@ -618,7 +841,7 @@ class Router:
         design (a wedged replica must still answer) — the rare torn
         dict read is retried, then reported instead of raised."""
         replicas = {}
-        for d in self.drivers:
+        for d in list(self.drivers):
             for _ in range(3):
                 try:
                     replicas[d.name] = d.engine.debug_state()
@@ -636,7 +859,7 @@ class Router:
         migration), each event tagged with its replica, ordered by
         timestamp. None = no replica has ever seen the id."""
         merged: List[dict] = []
-        for d in self.drivers:
+        for d in list(self.drivers):
             obs = getattr(d.engine, "obs", None)
             if obs is None:
                 continue
@@ -653,7 +876,7 @@ class Router:
         ring plus retained incident dumps of every replica (dead ones
         included: their ring holds the final steps)."""
         out = {}
-        for d in self.drivers:
+        for d in list(self.drivers):
             obs = getattr(d.engine, "obs", None)
             out[d.name] = (None if obs is None
                            else obs.flight.snapshot())
@@ -671,12 +894,15 @@ class Router:
         state and census remain readable (the incident dump carries
         them too). Reads race the pumps by design (torn dict reads
         retried, then reported instead of raised) — a wedged fleet
-        must still answer."""
+        must still answer. Dead replicas are tombstones: they stay
+        listed with their frozen SLO state, capped at the last
+        `dead_replica_cap` (older ones evicted + counted)."""
         from ..slo import SLO_STATE_CODES
+        self._prune_dead()
         now = self._clock()
         replicas = {}
         worst = "ok"
-        for d in self.drivers:
+        for d in list(self.drivers):
             eng = d.engine
             entry = None
             for _ in range(3):
@@ -709,6 +935,8 @@ class Router:
                         "incidents_total": (
                             None if obs is None
                             else obs.flight.incidents_total),
+                        "placement_avoided":
+                            self._avoided_by.get(d.name, 0),
                     }
                     break
                 except RuntimeError:
@@ -721,4 +949,6 @@ class Router:
                 worst = st
             replicas[d.name] = entry
         return {"router": self.stats(), "slo_worst": worst,
+                "controlplane": (None if self.controller is None
+                                 else self.controller.stats()),
                 "replicas": replicas}

@@ -27,7 +27,11 @@ Endpoints:
   prompt metadata (lengths, priorities, ids).
 
 Backpressure and failure map to status codes via typed errors
-(serving/errors.py): full queue -> 429 + Retry-After, draining/closed
+(serving/errors.py): full queue -> 429 + Retry-After (error type
+`rate_limit_exceeded`; when the fleet control plane sheds a request
+whose deadline is infeasible at the current backlog, the same 429 +
+Retry-After path carries type `deadline_infeasible` so clients can
+tell "slow down" from "your deadline cannot be met"), draining/closed
 -> 503, a poisoned request (it deterministically kills the serving
 step; quarantined by the engine, never retried) -> 422, replica death
 -> 502 — and a 502 surfaces only after failover AND mid-stream
@@ -67,6 +71,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..controlplane import DeadlineInfeasible
 from ..errors import (EngineClosed, QueueFull, RateLimited,
                       ServingError)
 from ..metrics import prometheus_render
@@ -331,8 +336,11 @@ class _Handler(BaseHTTPRequestHandler):
             return
         except QueueFull as e:
             retry_after = max(1, math.ceil(e.retry_after_s))
+            err_type = ("deadline_infeasible"
+                        if isinstance(e, DeadlineInfeasible)
+                        else "rate_limit_exceeded")
             self._send_error_json(
-                429, str(e), "rate_limit_exceeded",
+                429, str(e), err_type,
                 headers=[("Retry-After", str(retry_after))])
             return
         except ServingError as e:

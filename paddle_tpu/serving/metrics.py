@@ -302,6 +302,10 @@ class ServingMetrics:
         self.cost_census: Optional[dict] = None
         self.step_capacity_tokens = 0
         self.achieved_util_hist = Histogram(buckets=UTIL_BUCKETS)
+        # sliding window of the last N steps' achieved utilization:
+        # the control plane's capacity signal (the lifetime histogram
+        # mean is too sluggish to steer scaling through load phases)
+        self._util_recent: deque = deque(maxlen=32)
         self.queue_depth_hist = Histogram()
         self.occupancy_hist = Histogram()
         self.pool_utilization_hist = Histogram()
@@ -500,8 +504,9 @@ class ServingMetrics:
                       + int(draft_tokens))
             self.packed_tokens_hist.record(packed)
             if self.step_capacity_tokens:
-                self.achieved_util_hist.record(
-                    packed / self.step_capacity_tokens)
+                util = packed / self.step_capacity_tokens
+                self.achieved_util_hist.record(util)
+                self._util_recent.append(util)
             self.decode_step_s.record(wall_s)
 
     def on_grouped_step(self, flat_reads: int, actual_reads: int,
@@ -567,6 +572,17 @@ class ServingMetrics:
             self.prefill_stall_hist.record(stall_chunks)
 
     # -- reading ----------------------------------------------------------
+    @property
+    def achieved_util_recent(self) -> Optional[float]:
+        """Mean achieved utilization over the last few steps (None
+        before the first capacity-bearing step) — the control plane's
+        fresh load signal, windowed so a diurnal trough is seen as a
+        trough instead of being averaged away by the busy lifetime."""
+        with self._lock:
+            if not self._util_recent:
+                return None
+            return sum(self._util_recent) / len(self._util_recent)
+
     @property
     def tokens_per_sec(self) -> Optional[float]:
         if (self._first_admit_t is None or self._last_token_t is None
@@ -724,9 +740,12 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
     `extra_gauges` adds unlabelled router-level gauges
     (`{name: value}`). `router` (a `Router.stats()` dict) adds the
     resilience series: `retries_total` / `migrations_total` /
-    `watchdog_kills_total` counters and a per-replica `breaker_state`
-    gauge (value 0 closed / 1 half_open / 2 open, with the state name
-    also riding as a label)."""
+    `watchdog_kills_total` / `fleet_dead_evicted_total` counters and a
+    per-replica `breaker_state` gauge (value 0 closed / 1 half_open /
+    2 open, with the state name also riding as a label). A
+    `controlplane` block inside it (attached controller —
+    serving/controlplane.py) adds the `fleet_desired_replicas` gauge
+    and the scale/shed/placement-avoidance counters."""
     lines = []
     for name, kind in [("requests_total", "counter"),
                        ("tokens_generated_total", "counter"),
@@ -1004,9 +1023,25 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                             + f" {s[f'{window}_burn']}")
     if router is not None:
         for name in ("retries_total", "migrations_total",
-                     "watchdog_kills_total"):
+                     "watchdog_kills_total",
+                     "fleet_dead_evicted_total"):
             lines.append(f"# TYPE {namespace}_{name} counter")
             lines.append(f"{namespace}_{name} {router.get(name, 0)}")
+        # fleet control plane (serving/controlplane.py): the desired-
+        # replica gauge + the actuator counters, present only when a
+        # controller is attached (the gate is off by default)
+        cp = router.get("controlplane")
+        if cp is not None:
+            for name in ("scale_up_total", "scale_down_total",
+                         "admission_shed_total",
+                         "placement_avoided_total"):
+                lines.append(f"# TYPE {namespace}_{name} counter")
+                lines.append(f"{namespace}_{name} {cp.get(name, 0)}")
+            lines.append(
+                f"# TYPE {namespace}_fleet_desired_replicas gauge")
+            lines.append(
+                f"{namespace}_fleet_desired_replicas "
+                f"{cp.get('desired_replicas') or 0}")
         breakers = router.get("breakers") or {}
         if breakers:
             lines.append(f"# TYPE {namespace}_breaker_state gauge")

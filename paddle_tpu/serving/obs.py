@@ -223,10 +223,16 @@ class FlightRecorder:
             self._ring.append(record)
             self.steps_recorded += 1
 
-    def note(self, kind: str, detail: Optional[str] = None):
+    def note(self, kind: str, detail=None):
         """Ride a non-step event (an injected fault firing, a watchdog
-        verdict) in the step stream, where a postmortem reads it in
-        context."""
+        verdict, a control-plane decision) in the step stream, where a
+        postmortem reads it in context. `detail` is stored verbatim —
+        the control plane (serving/controlplane.py) passes dicts
+        (`controlplane:scale_up` / `:scale_down` / `:shed` with the
+        signals behind the decision), and `incident()` freezes those
+        notes into the dump with the surrounding steps, so a
+        postmortem shows WHAT the fleet decided right before the
+        event, not just what the engine did."""
         with self._lock:
             self._ring.append({"t": self._clock(), "note": str(kind),
                                "detail": detail})

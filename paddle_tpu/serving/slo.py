@@ -384,6 +384,21 @@ class SLOTracker:
                     worst = st
         return worst
 
+    def worst_burns(self, now: Optional[float] = None
+                    ) -> Tuple[float, float]:
+        """(fast, slow): the worst burn rate in each window across
+        every series — the control plane's scale-up signal (it
+        applies the same double-window rule the alerts use, so a
+        noisy fast window alone never grows the fleet)."""
+        now = self._clock() if now is None else float(now)
+        fast = slow = 0.0
+        with self._lock:
+            for (slo, _scope, _label), s in self._series.items():
+                f, sl, _n = s.burns(now, self.config.budget(slo))
+                fast = max(fast, f)
+                slow = max(slow, sl)
+        return fast, slow
+
     def snapshot(self, now: Optional[float] = None) -> dict:
         """Plain-dict view for /debug/fleet, the metrics snapshot and
         incident dumps: per-series state + burn rates, the config

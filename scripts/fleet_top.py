@@ -16,8 +16,14 @@ it as the `top`-style table a human scans during an incident:
 Columns: replica | up (ok/DEAD/drain) | brk (breaker) | steps | queue
 | res/slots | pages used/total | host | util (mean achieved
 utilization of the unified step) | tok/s | slo (worst burn state) |
-inc (incident dumps). A `page` SLO state or a DEAD row is where to
-start reading `flight_dump.py`.
+avoid (placements the SLO-aware router steered AWAY from this
+replica while it was burning) | inc (incident dumps). A `page` SLO
+state or a DEAD row is where to start reading `flight_dump.py`.
+
+When the fleet control plane is attached (PADDLE_TPU_CONTROLPLANE=on,
+serving/controlplane.py), the `==` header also shows desired-vs-actual
+replicas plus the autoscaler's up/down/shed counters, so one glance
+answers "is the fleet the size the controller wants it to be".
 """
 from __future__ import annotations
 
@@ -27,8 +33,8 @@ import sys
 import time
 
 COLUMNS = ["replica", "up", "brk", "steps", "queue", "res", "pages",
-           "host", "util", "tok/s", "slo", "inc"]
-WIDTHS = [12, 6, 6, 7, 5, 7, 11, 5, 6, 8, 5, 4]
+           "host", "util", "tok/s", "slo", "avoid", "inc"]
+WIDTHS = [12, 6, 6, 7, 5, 7, 11, 5, 6, 8, 5, 5, 4]
 
 
 def _fmt_row(cells):
@@ -39,7 +45,7 @@ def _fmt_row(cells):
 def _replica_row(name, e):
     if "error" in e:
         return _fmt_row([name, "?", "-", "-", "-", "-", "-", "-",
-                         "-", "-", "-", "-"]) + f"  ({e['error']})"
+                         "-", "-", "-", "-", "-"]) + f"  ({e['error']})"
     up = ("drain" if e.get("draining")
           else "DEAD" if e.get("dead")
           else "ok" if e.get("healthy") else "down")
@@ -55,7 +61,8 @@ def _replica_row(name, e):
         e.get("host_pages_used", "-"),
         "-" if util is None else f"{util:.2f}",
         "-" if tps is None else f"{tps:.1f}",
-        slo, e.get("incidents_total", "-")])
+        slo, e.get("placement_avoided", "-"),
+        e.get("incidents_total", "-")])
 
 
 def render_fleet(snapshot: dict) -> str:
@@ -63,12 +70,26 @@ def render_fleet(snapshot: dict) -> str:
     fleet-worst SLO; one row per replica; footer: each replica's
     census, since FLOPs/bytes don't fit a column)."""
     router = snapshot.get("router") or {}
+    n_replicas = len(snapshot.get("replicas") or {})
+    cp = snapshot.get("controlplane")
+    if cp:
+        desired = cp.get("desired_replicas")
+        fleet = (f"{n_replicas} replicas "
+                 f"(desired={'-' if desired is None else desired})")
+        cp_bits = (f"scale_up={cp.get('scale_up_total', 0)} "
+                   f"scale_down={cp.get('scale_down_total', 0)} "
+                   f"shed={cp.get('admission_shed_total', 0)} "
+                   f"avoided={cp.get('placement_avoided_total', 0)} ")
+    else:
+        fleet = f"{n_replicas} replicas"
+        cp_bits = ""
     lines = [
-        f"== fleet: {len(snapshot.get('replicas') or {})} replicas, "
+        f"== fleet: {fleet}, "
         f"ready={router.get('ready')} "
         f"retries={router.get('retries_total', 0)} "
         f"migrations={router.get('migrations_total', 0)} "
         f"watchdog_kills={router.get('watchdog_kills_total', 0)} "
+        f"{cp_bits}"
         f"slo_worst={snapshot.get('slo_worst', '-')} ==",
         _fmt_row(COLUMNS)]
     replicas = snapshot.get("replicas") or {}

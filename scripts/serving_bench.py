@@ -11,7 +11,7 @@ in the bench trajectory. Prints ONE JSON line and writes the same
 stable-schema report to BENCH_serving.json (override with --out,
 suppress with --out -):
 
-    {"bench": "serving", "schema_version": 14, "attn_impl": "kernel",
+    {"bench": "serving", "schema_version": 15, "attn_impl": "kernel",
      "requests": ..., "ttft_p50_s": ..., "tokens_per_sec": ...,
      "decode_step_ms_p50": ..., "ab": {"kernel": {...},
      "gather": {...}}, "prefix_stats": {...}, "unified": {...},
@@ -70,6 +70,22 @@ strictly better high-priority goodput than the off arm, and that a
 priority-flat fault-free replay is bit-identical (same tokens, same
 step count) with preemption on vs off (the machinery costs nothing
 when it never fires).
+
+`--autoscale-ab` adds the fleet-autoscaling A/B (schema v15): a
+DETERMINISTIC diurnal wave — trough, peak, trough — replayed on one
+shared virtual clock through (a) a fleet steered by the REAL
+FleetController (serving/controlplane.py: util/queue/burn signals in,
+scale-up at the peak, graceful drain back down, hysteresis +
+cool-downs) starting from 1 replica, and (b) a peak-provisioned
+FIXED fleet of n_max replicas. The report's "autoscale" section
+records per-arm TTFT p50/p99, replica-seconds, the scaling decision
+log and the replica-seconds ratio — and the script ASSERTS every
+stream in both arms is exactly its token budget, the auto arm's TTFT
+p99 stays within the SLO target at <= ~0.6x the fixed arm's
+replica-seconds, scaling happened without flapping, and a steady
+fixed-size trace is bit-token-identical with the controller attached
+vs detached (the control plane steers placement and fleet size, never
+math).
 
 `--quant-ab` adds the quantized-serving A/B: the SAME burst trace
 (every request arrives at t=0 — admission is page-limited, the shape
@@ -229,6 +245,8 @@ _SECTION_HEADLINES = {
     "tp": lambda r: r["tp"]["mp2"]["tokens_per_sec"],
     "http": lambda r: r["http"]["tokens_per_sec"],
     "chaos": lambda r: r["chaos"]["goodput_tokens_per_sec"],
+    "autoscale": lambda r: r["autoscale"]["auto"][
+        "tokens_per_virtual_s"],
 }
 
 # a section's headline dropping more than this vs the PREVIOUS entry
@@ -402,6 +420,18 @@ def main():
     ap.add_argument("--overload-scale", type=int, default=1,
                     help="multiply the overload trace's request "
                     "counts (the slow soak uses > 1)")
+    ap.add_argument("--autoscale-ab", action="store_true",
+                    help="run the deterministic diurnal virtual-time "
+                    "autoscaling A/B: a FleetController-steered fleet "
+                    "(1..n replicas, graceful drain on the way down) "
+                    "vs a peak-provisioned fixed fleet on the SAME "
+                    "wave; asserts TTFT p99 within SLO at <= ~0.6x "
+                    "the fixed fleet's replica-seconds, no flapping, "
+                    "exact token streams, and controller on/off "
+                    "bit-identity on a steady trace")
+    ap.add_argument("--autoscale-max", type=int, default=4,
+                    help="fleet ceiling (and the fixed arm's size) "
+                    "for --autoscale-ab")
     ap.add_argument("--http", action="store_true",
                     help="also drive the serving/http front-end over "
                     "loopback with the same Poisson trace")
@@ -708,7 +738,7 @@ def main():
 
     report = {
         "bench": "serving",
-        "schema_version": 14,
+        "schema_version": 15,
         "platform": jax.devices()[0].platform,
         "attn_impl": "kernel",
         "requests": n_req,
@@ -892,6 +922,10 @@ def main():
         report["overload"] = overload_trace(
             model, cfg, slots=args.slots, seed=args.seed + 3,
             scale=max(1, args.overload_scale))
+    if args.autoscale_ab:
+        report["autoscale"] = autoscale_trace(
+            model, cfg, slots=args.slots, seed=args.seed + 7,
+            n_max=max(2, args.autoscale_max))
     if args.http:
         report["http"] = http_trace(
             model, cfg, n_req=n_req, rate=rate, max_new=max_new,
@@ -1026,6 +1060,28 @@ def main():
         assert chaos["completed"] == n_req, chaos
         if chaos["kills_fired"]:
             assert chaos["migrated_streams"] >= 1, chaos
+    if args.autoscale_ab:
+        az = report["autoscale"]
+        # the acceptance numbers (exact — the shared virtual clock
+        # makes both arms deterministic): every request in BOTH arms
+        # finished with its exact token budget (autoscaling is a
+        # capacity move, never a quality knob); the auto arm held
+        # TTFT p99 within the SLO target while spending <= ~0.6x the
+        # peak-provisioned fleet's replica-seconds; the controller
+        # really scaled (up at the peak, back down after) without
+        # flapping; and the steady fixed-size trace is bit-token-
+        # identical with the controller attached vs detached
+        assert az["auto"]["exact_streams"], az["auto"]
+        assert az["fixed"]["exact_streams"], az["fixed"]
+        assert az["auto"]["completed"] == az["fixed"]["completed"] \
+            == az["requests"], az
+        assert az["auto"]["ttft_p99_s"] <= az["slo_ttft_p99_s"], az
+        assert az["replica_seconds_ratio"] <= 0.6, az
+        assert len(az["auto"]["scale_ups"]) >= 1, az
+        assert len(az["auto"]["scale_downs"]) >= 1, az
+        assert az["flaps"] <= 8, az
+        assert az["auto"]["peak_replicas"] <= az["n_max"], az
+        assert az["steady"]["identical"], az["steady"]
     if args.overload:
         ov = report["overload"]
         on, off = ov["on"], ov["off"]
@@ -1791,6 +1847,238 @@ def overload_trace(model, cfg, *, slots, seed, scale=1):
             "on": goodput(on), "off": goodput(off)},
         "fault_free": {"on": flat_on, "off": flat_off,
                        "identical": fault_free_identical},
+    }
+
+
+def autoscale_trace(model, cfg, *, slots, seed, n_max=4):
+    """--autoscale-ab (schema v15): reactive burn-rate autoscaling vs
+    a peak-provisioned fixed fleet on a DETERMINISTIC diurnal
+    virtual-time trace. The whole fleet shares one harness-driven
+    clock advancing a fixed `dt` per round, so arrivals, placement,
+    every scaling decision and every token are bit-reproducible on
+    any machine. The trace is a diurnal wave: a trough one replica
+    serves at ~30% utilization, a peak needing the whole fleet, and a
+    long trough back down. The AUTO arm starts at 1 replica and lets
+    a REAL FleetController (serving/controlplane.py — the same
+    decide() the router's control loop calls, fed the same
+    util/queue/burn signals, on the same virtual clock) grow and
+    shrink the fleet between 1 and n_max with graceful drain on the
+    way down; the FIXED arm keeps all n_max replicas up the whole
+    time (peak provisioning). Both arms must complete every request
+    with its exact token budget; the auto arm must hold TTFT p99
+    within the SLO target while spending <= ~0.6x the fixed arm's
+    replica-seconds, without flapping. A STEADY trough-rate trace
+    also runs at fixed fleet size with the controller attached
+    (min == max, so it can observe but never actuate) vs detached,
+    and must be bit-token-identical with the same step count — the
+    control plane is pure host-side steering, never math."""
+    from paddle_tpu.serving import (ControlPlaneConfig, FleetController,
+                                    FleetSignals, SLOConfig,
+                                    SamplingParams, ServingEngine,
+                                    slo_placement_rank)
+
+    dt = 0.01                     # virtual seconds per fleet round
+    plen, n_new = 6, 8
+    chunk = 16
+    # one request holds a slot for ~(1 prefill chunk + n_new decode)
+    # rounds, so one replica sustains ~slots/(1+n_new) requests per
+    # round; phase rates are fractions of that one-replica capacity
+    cap_rps = slots / float(1 + n_new) / dt
+    phases = [(0.8, 0.30 * cap_rps),       # trough: 1 replica, ~30%
+              (1.2, 2.50 * cap_rps),       # peak: needs the fleet
+              (1.6, 0.30 * cap_rps)]       # trough: scale back down
+    slo_cfg = SLOConfig(ttft_p99_s=0.30, itl_p99_s=0.5,
+                        fast_window_s=0.5, slow_window_s=2.5,
+                        min_events=8)
+    rng = np.random.RandomState(seed)
+    arrivals, t0 = [], 0.0
+    for dur, phase_rate in phases:
+        k = int(round(dur * phase_rate))
+        # deterministic uniform spacing inside each phase — the wave
+        # shape is the experiment, Poisson jitter would just blur it
+        arrivals.extend(t0 + (j + 1) * (dur / k) for j in range(k))
+        t0 += dur
+    prompts = [rng.randint(0, cfg.vocab_size, size=plen)
+               .astype(np.int64) for _ in arrivals]
+    n = len(arrivals)
+
+    def run(n_engines, n_start, cp_cfg, arrival_list, prompt_list):
+        """One virtual-time fleet replay. `cp_cfg=None` detaches the
+        controller entirely (fixed fleet, load-only placement)."""
+        vt = [0.0]
+        engines = []
+        for _ in range(n_engines):
+            eng = ServingEngine(model, num_slots=slots, max_len=64,
+                                page_size=8, chunk_len=chunk,
+                                clock=lambda: vt[0], slo=slo_cfg)
+            eng.add_request(np.arange(1, plen + 1, dtype=np.int64),
+                            SamplingParams(max_new_tokens=2))
+            eng.run()              # compile-warm outside the clock
+            engines.append(eng)
+        ctrl = (None if cp_cfg is None
+                else FleetController(cp_cfg, clock=lambda: vt[0]))
+        active = list(range(n_start))
+        parked = list(range(n_start, n_engines))
+        draining: list = []
+        census = engines[0].cost_census() or {}
+        wall0 = time.monotonic()
+        reqs, submitted = [], 0
+        replica_seconds = steps_total = 0.0
+        peak_replicas = len(active)
+        ups, downs = [], []
+
+        def live():
+            return [i for i in active if i not in draining]
+
+        def place(prompt):
+            # the router's ranking mirrored on the sim fleet: SLO
+            # state first (controller attached), then load, then a
+            # stable index tie-break
+            cands = live() or active
+            key = {}
+            for i in cands:
+                e = engines[i]
+                sr = (slo_placement_rank(e.slo.worst_state())
+                      if ctrl is not None else 0)
+                key[i] = (sr, e.scheduler.queue_depth,
+                          len(e.scheduler.running), i)
+            best = min(cands, key=lambda i: key[i])
+            return engines[best].add_request(
+                prompt, SamplingParams(max_new_tokens=n_new))
+
+        def signals():
+            ids = live()
+            fb = sb = 0.0
+            for i in ids:
+                f, s = engines[i].slo.worst_burns(now=vt[0])
+                fb, sb = max(fb, f), max(sb, s)
+            mu = (sum(len(engines[i].scheduler.running)
+                      for i in ids) / (len(ids) * slots)
+                  if ids else 0.0)
+            return FleetSignals(
+                replicas=len(ids), fast_burn=fb, slow_burn=sb,
+                mean_util=mu,
+                queue_depth=sum(engines[i].scheduler.queue_depth
+                                for i in ids),
+                capacity_tokens=int(census.get("capacity_tokens")
+                                    or slots * chunk),
+                flops_per_token=float(
+                    census.get("flops_per_token") or 0.0))
+
+        def actuate(decision, want):
+            nonlocal peak_replicas
+            if decision.action == "scale_up":
+                added = 0
+                while len(live()) < want:
+                    if draining:           # cancel an in-flight drain
+                        draining.pop(0)
+                    elif parked:
+                        active.append(parked.pop(0))
+                    else:
+                        break
+                    added += 1
+                if added:
+                    ups.append({"t": round(vt[0], 3), "n": added,
+                                "reason": decision.reason})
+                peak_replicas = max(peak_replicas, len(active))
+            elif decision.action == "scale_down":
+                ids = live()
+                if len(ids) > 1:
+                    victim = min(ids, key=lambda i: (
+                        len(engines[i].scheduler.running)
+                        + engines[i].scheduler.queue_depth, i))
+                    draining.append(victim)
+                    downs.append({"t": round(vt[0], 3),
+                                  "reason": decision.reason})
+
+        scaling = ctrl is not None and \
+            cp_cfg.min_replicas < cp_cfg.max_replicas
+        n_arm = len(arrival_list)
+        while submitted < n_arm or any(engines[i].has_work
+                                       for i in active):
+            while submitted < n_arm \
+                    and arrival_list[submitted] <= vt[0]:
+                reqs.append(place(prompt_list[submitted]))
+                submitted += 1
+            if ctrl is not None:
+                d = ctrl.decide(signals())
+                if scaling:
+                    actuate(d, d.desired)
+            for i in list(active):
+                if engines[i].has_work:
+                    engines[i].step()
+                    steps_total += 1
+            for i in list(draining):
+                if not engines[i].has_work:
+                    draining.remove(i)
+                    active.remove(i)
+                    parked.append(i)
+            replica_seconds += len(active) * dt
+            vt[0] += dt
+        for eng in engines:
+            eng.drain()
+        ttfts = sorted(r.first_token_t - r.arrival_t for r in reqs)
+        return {
+            "virtual_s": round(vt[0], 4),
+            "wall_s": round(time.monotonic() - wall0, 4),
+            "completed": sum(1 for r in reqs
+                             if r.finish_reason == "length"),
+            "exact_streams": all(
+                r.finish_reason == "length"
+                and len(r.output_tokens) == n_new for r in reqs),
+            "token_streams": [list(r.output_tokens) for r in reqs],
+            "steps": int(steps_total),
+            "replica_seconds": round(replica_seconds, 4),
+            "tokens_per_virtual_s": round(
+                sum(len(r.output_tokens) for r in reqs) / vt[0], 4),
+            "ttft_p50_s": round(ttfts[len(ttfts) // 2], 4),
+            "ttft_p99_s": round(
+                ttfts[min(len(ttfts) - 1,
+                          int(0.99 * len(ttfts)))], 4),
+            "peak_replicas": peak_replicas,
+            "scale_ups": ups,
+            "scale_downs": downs,
+            "desired_final": (None if ctrl is None
+                              else ctrl.desired_replicas),
+        }
+
+    cp_auto = ControlPlaneConfig(
+        min_replicas=1, max_replicas=n_max, target_util=0.70,
+        scale_down_util=0.35, scale_up_cooldown_s=0.05,
+        scale_down_cooldown_s=0.15, est_request_tokens=plen + n_new)
+    auto = run(n_max, 1, cp_auto, arrivals, prompts)
+    fixed = run(n_max, n_max, None, arrivals, prompts)
+
+    # the steady identity pair: constant trough rate, fixed 2-replica
+    # fleet, controller attached-but-clamped vs detached
+    steady_rate = 0.30 * cap_rps
+    k = int(round(0.8 * steady_rate))
+    steady_arrivals = [(j + 1) * (0.8 / k) for j in range(k)]
+    steady_prompts = [rng.randint(0, cfg.vocab_size, size=plen)
+                      .astype(np.int64) for _ in steady_arrivals]
+    cp_clamped = ControlPlaneConfig(min_replicas=2, max_replicas=2)
+    steady_cp = run(2, 2, cp_clamped, steady_arrivals, steady_prompts)
+    steady_plain = run(2, 2, None, steady_arrivals, steady_prompts)
+    steady_identical = (
+        steady_cp["token_streams"] == steady_plain["token_streams"]
+        and steady_cp["steps"] == steady_plain["steps"])
+    for r in (auto, fixed, steady_cp, steady_plain):
+        del r["token_streams"]    # evidence, not report payload
+    return {
+        "virtual_dt_s": dt,
+        "n_max": n_max,
+        "slots": slots,
+        "requests": n,
+        "phases": [[round(dur, 3), round(r_, 2)] for dur, r_ in phases],
+        "slo_ttft_p99_s": slo_cfg.ttft_p99_s,
+        "auto": auto,
+        "fixed": fixed,
+        "replica_seconds_ratio": round(
+            auto["replica_seconds"] / fixed["replica_seconds"], 4),
+        "flaps": len(auto["scale_ups"]) + len(auto["scale_downs"]),
+        "steady": {"requests": k, "controller_on": steady_cp,
+                   "controller_off": steady_plain,
+                   "identical": steady_identical},
     }
 
 

@@ -1,0 +1,554 @@
+"""Fleet control plane (serving/controlplane.py): decision core,
+admission math, runtime replica registration, actuation, exposition.
+
+The load-bearing properties (ISSUE 16 acceptance):
+- the decision core is pure + fake-clock driven: double-window burn
+  scales up, cool-downs suppress, the hysteresis band never flaps;
+- deadline-aware admission sheds AT THE DOOR with the predicted-wait
+  math (measured rate when warm, census fallback when cold) and a
+  typed DeadlineInfeasible (429 + Retry-After);
+- `add_replica` / `remove_replica` resize a LIVE router under the
+  router lock: names never reused, the last live replica is refused,
+  a replica removed mid-stream still completes token-identically;
+- dead replicas are tombstones capped at `dead_replica_cap` (older
+  evicted + counted by `fleet_dead_evicted_total`);
+- SLO-aware placement ranks warn below ok and page below warn — after
+  the breaker, before load — and counts avoided placements;
+- every scaling decision lands as a flight-recorder note; the
+  Prometheus render carries the controller gauge + counters through
+  the strict exposition parser; fleet_top shows desired-vs-actual.
+"""
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
+from paddle_tpu.serving import (ControlPlaneConfig, DeadlineInfeasible,
+                                FleetController, FleetSignals,
+                                QueueFull, SamplingParams,
+                                ServingEngine, SLOConfig,
+                                parse_controlplane_spec,
+                                prometheus_render,
+                                resolve_controlplane,
+                                slo_placement_rank)
+from paddle_tpu.serving.http import EngineDriver, Router, serve
+
+from test_serving_obs import check_histograms, parse_exposition
+
+_MODELS = {}
+
+
+def tiny_gpt():
+    m = _MODELS.get("gpt")
+    if m is None:
+        paddle.seed(7)
+        cfg = GPTConfig(vocab_size=97, hidden_size=32,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        intermediate_size=64,
+                        max_position_embeddings=128,
+                        hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+        m = _MODELS["gpt"] = GPTForCausalLM(cfg)
+        m.eval()
+    return m
+
+
+def make_engine(**kw):
+    opts = dict(num_slots=2, max_len=64)
+    opts.update(kw)
+    return ServingEngine(tiny_gpt(), **opts)
+
+
+def flight_notes(eng, kind):
+    snap = eng.obs.flight.snapshot()
+    return [e for e in snap["steps"] if e.get("note") == kind]
+
+
+# -- gate: spec parsing + resolution (no engine) ----------------------------
+class TestSpecAndResolve:
+    def test_off_on_defaults(self):
+        assert parse_controlplane_spec("off") is None
+        assert parse_controlplane_spec("0") is None
+        assert parse_controlplane_spec("on") == ControlPlaneConfig()
+        assert parse_controlplane_spec("") == ControlPlaneConfig()
+
+    def test_kv_spec(self):
+        cfg = parse_controlplane_spec(
+            "min=2,max=5,target_util=0.6,up_burn=3.5,down_util=0.2,"
+            "up_cooldown=1,down_cooldown=2,interval=0.5,"
+            "est_tokens=32,hw_flops=1e9,slack=1.5")
+        assert cfg.min_replicas == 2 and cfg.max_replicas == 5
+        assert cfg.target_util == 0.6 and cfg.scale_up_burn == 3.5
+        assert cfg.scale_down_util == 0.2
+        assert cfg.scale_up_cooldown_s == 1.0
+        assert cfg.scale_down_cooldown_s == 2.0
+        assert cfg.interval_s == 0.5 and cfg.est_request_tokens == 32
+        assert cfg.hw_flops_per_s == 1e9 and cfg.admission_slack == 1.5
+
+    def test_spec_errors(self):
+        with pytest.raises(ValueError, match="expected k=v"):
+            parse_controlplane_spec("bogus_key=1")
+        with pytest.raises(ValueError, match="expected k=v"):
+            parse_controlplane_spec("min")
+        with pytest.raises(ValueError, match="value"):
+            parse_controlplane_spec("min=lots")
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="min_replicas"):
+            ControlPlaneConfig(min_replicas=0)
+        with pytest.raises(ValueError, match="max_replicas"):
+            ControlPlaneConfig(min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError, match="target_util"):
+            ControlPlaneConfig(target_util=0.0)
+        # the hysteresis band must exist: low-water >= target is flap
+        with pytest.raises(ValueError, match="hysteresis"):
+            ControlPlaneConfig(target_util=0.5, scale_down_util=0.5)
+        with pytest.raises(ValueError, match="cool-downs"):
+            ControlPlaneConfig(scale_up_cooldown_s=-1)
+        with pytest.raises(ValueError, match="admission_slack"):
+            ControlPlaneConfig(admission_slack=0)
+
+    def test_resolve_override_wins_over_env(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_CONTROLPLANE", "on")
+        assert resolve_controlplane(False) is None
+        monkeypatch.setenv("PADDLE_TPU_CONTROLPLANE", "off")
+        assert resolve_controlplane(True) == ControlPlaneConfig()
+        cfg = ControlPlaneConfig(min_replicas=2)
+        assert resolve_controlplane(cfg) is cfg
+        assert resolve_controlplane("min=3").min_replicas == 3
+
+    def test_resolve_env_default_off(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_CONTROLPLANE", raising=False)
+        assert resolve_controlplane() is None
+        monkeypatch.setenv("PADDLE_TPU_CONTROLPLANE", "min=2,max=4")
+        cfg = resolve_controlplane()
+        assert cfg.min_replicas == 2 and cfg.max_replicas == 4
+
+    def test_slo_placement_rank(self):
+        assert slo_placement_rank("ok") == 0
+        assert slo_placement_rank("warn") == 1
+        assert slo_placement_rank("page") == 2
+        assert slo_placement_rank(None) == 0   # SLO tracking off
+
+
+# -- decision core (pure, fake clock, no threads) ---------------------------
+class TestDecide:
+    def mk(self, **kw):
+        return FleetController(ControlPlaneConfig(**kw),
+                               clock=lambda: 0.0)
+
+    def test_double_window_burn_scales_up(self):
+        ctrl = self.mk()
+        d = ctrl.decide(FleetSignals(replicas=2, fast_burn=5.0,
+                                     slow_burn=5.0, mean_util=0.5),
+                        now=0.0)
+        assert d.action == "scale_up" and d.desired == 3
+        assert d.reason == "double-window burn"
+        assert ctrl.desired_replicas == 3
+
+    def test_single_window_burn_holds(self):
+        # fast window alone is noise; the slow window must agree —
+        # the same multi-window discipline the SLO tracker alerts on
+        ctrl = self.mk()
+        d = ctrl.decide(FleetSignals(replicas=2, fast_burn=50.0,
+                                     slow_burn=0.0, mean_util=0.5),
+                        now=0.0)
+        assert d.action == "hold" and d.reason == "steady"
+
+    def test_util_scale_up_then_cooldown_suppresses(self):
+        ctrl = self.mk(scale_up_cooldown_s=15.0)
+        hot = FleetSignals(replicas=2, mean_util=0.9)
+        d = ctrl.decide(hot, now=100.0)
+        assert d.action == "scale_up" and d.desired == 3
+        assert d.reason == "util 0.90 over target"
+        # still hot 1s later: the up-cooldown holds the fleet
+        d = ctrl.decide(hot, now=101.0)
+        assert d.action == "hold" and d.reason.startswith("cooldown")
+        # cooldown elapsed: free to act again
+        d = ctrl.decide(hot, now=116.0)
+        assert d.action == "scale_up"
+
+    def test_hysteresis_band_never_flaps(self):
+        # utilization oscillating between the low-water mark (0.45)
+        # and the planning target (0.75) must produce ZERO actions
+        ctrl = self.mk()
+        for i, util in enumerate([0.5, 0.7, 0.5, 0.7, 0.5]):
+            d = ctrl.decide(FleetSignals(replicas=3, mean_util=util),
+                            now=float(i))
+            assert d.action == "hold", (util, d)
+        reasons = {rec["reason"] for rec in ctrl.decisions}
+        assert "hysteresis" in reasons
+
+    def test_idle_scale_down_one_at_a_time_with_cooldown(self):
+        ctrl = self.mk(scale_down_cooldown_s=60.0)
+        idle3 = FleetSignals(replicas=3, mean_util=0.1)
+        d = ctrl.decide(idle3, now=0.0)
+        assert d.action == "scale_down" and d.desired == 2  # ONE step
+        d = ctrl.decide(FleetSignals(replicas=2, mean_util=0.1),
+                        now=1.0)
+        assert d.action == "hold" and d.reason.startswith("cooldown")
+        d = ctrl.decide(FleetSignals(replicas=2, mean_util=0.1),
+                        now=61.0)
+        assert d.action == "scale_down" and d.desired == 1
+        # at min_replicas the fleet holds steady
+        d = ctrl.decide(FleetSignals(replicas=1, mean_util=0.1),
+                        now=122.0)
+        assert d.action == "hold" and d.reason == "steady"
+
+    def test_scale_down_blocked_by_queue_or_burn(self):
+        ctrl = self.mk()
+        # idle util but a queued backlog: hold (hysteresis), not drain
+        d = ctrl.decide(FleetSignals(replicas=3, mean_util=0.1,
+                                     queue_depth=4, capacity_tokens=0),
+                        now=0.0)
+        assert d.action == "hold" and d.reason == "hysteresis"
+
+    def test_clamps_at_max_and_min(self):
+        ctrl = self.mk(min_replicas=2, max_replicas=3)
+        # burn-hot at max: desired clamps to live -> hold, not grow
+        d = ctrl.decide(FleetSignals(replicas=3, fast_burn=99.0,
+                                     slow_burn=99.0, mean_util=1.0),
+                        now=0.0)
+        assert d.action == "hold" and d.desired == 3
+        # fully idle at min: hold
+        d = ctrl.decide(FleetSignals(replicas=2, mean_util=0.0),
+                        now=1.0)
+        assert d.action == "hold" and d.desired == 2
+
+    def test_queue_backlog_feeds_capacity_model(self):
+        # 8 queued * 64 est tokens / 64-token steps = 8 replica-steps
+        # of backlog on a single idle replica -> wants max_replicas
+        ctrl = self.mk(max_replicas=4)
+        d = ctrl.decide(FleetSignals(replicas=1, mean_util=0.0,
+                                     queue_depth=8, capacity_tokens=64),
+                        now=0.0)
+        assert d.action == "scale_up" and d.desired == 4
+
+    def test_decisions_recorded_with_clock(self):
+        ctrl = self.mk()
+        ctrl.decide(FleetSignals(replicas=1), now=42.0)
+        rec = ctrl.decisions[-1]
+        assert rec["t"] == 42.0 and rec["action"] == "hold"
+        assert ctrl.stats()["last_decision"] == rec
+
+
+# -- deadline-aware admission (pure math) -----------------------------------
+class TestAdmission:
+    def test_measured_rate_shed_math(self):
+        ctrl = FleetController()       # est_request_tokens=64
+        s = FleetSignals(replicas=2, queue_depth=10,
+                         tokens_per_sec=100.0)
+        assert ctrl.predicted_wait_s(s) == pytest.approx(6.4)
+        retry = ctrl.check_admission(s, 5.0)
+        assert retry == pytest.approx(1.4)     # wait - deadline
+        assert ctrl.admission_shed_total == 1
+        # a deadline past the predicted wait admits
+        assert ctrl.check_admission(s, 10.0) is None
+        assert ctrl.admission_shed_total == 1
+
+    def test_retry_after_floor_is_one_second(self):
+        ctrl = FleetController()
+        s = FleetSignals(replicas=1, queue_depth=1,
+                         tokens_per_sec=100.0)    # wait 0.64s
+        assert ctrl.check_admission(s, 0.5) == 1.0
+
+    def test_census_fallback_predicts_before_throughput(self):
+        # cold fleet: no measured tokens/s yet — the census predicts
+        # the rate: step_s = flops/step / hw, tokens/step = cap * util
+        ctrl = FleetController(ControlPlaneConfig(hw_flops_per_s=1e6))
+        s = FleetSignals(replicas=1, queue_depth=5, mean_util=0.5,
+                         capacity_tokens=64, flops_per_token=1000.0)
+        # step 64e3 flops / 1e6 = 0.064s; 32 tok/step -> 500 tok/s
+        assert ctrl.predicted_wait_s(s) == pytest.approx(0.64)
+        # idle util floors at 10% (an idle fleet is about to speed
+        # up, not shed everything): 6.4 tok/step -> 100 tok/s
+        s0 = FleetSignals(replicas=1, queue_depth=5, mean_util=0.0,
+                          capacity_tokens=64, flops_per_token=1000.0)
+        assert ctrl.predicted_wait_s(s0) == pytest.approx(3.2)
+
+    def test_admit_paths(self):
+        ctrl = FleetController()
+        busy = FleetSignals(replicas=1, queue_depth=50,
+                            tokens_per_sec=10.0)
+        assert ctrl.check_admission(busy, None) is None  # no deadline
+        empty = FleetSignals(replicas=1, tokens_per_sec=10.0)
+        assert ctrl.check_admission(empty, 0.001) is None  # no backlog
+        blind = FleetSignals(replicas=1, queue_depth=50)
+        assert ctrl.check_admission(blind, 0.001) is None  # no model
+        assert ctrl.admission_shed_total == 0
+
+    def test_admission_slack_relaxes_the_bar(self):
+        ctrl = FleetController(ControlPlaneConfig(admission_slack=2.0))
+        s = FleetSignals(replicas=2, queue_depth=10,
+                         tokens_per_sec=100.0)    # wait 6.4s
+        assert ctrl.check_admission(s, 4.0) is None    # 6.4 <= 2*4
+        assert ctrl.check_admission(s, 3.0) is not None
+
+
+# -- live router runtime (engines) ------------------------------------------
+class TestRouterRuntime:
+    def test_add_remove_replica_lifecycle(self):
+        d0 = EngineDriver(make_engine(), name="replica-0")
+        r = Router([d0], watchdog_timeout_s=120.0).start()
+        try:
+            d1 = r.add_replica(make_engine())
+            assert d1.name == "replica-1" and d1 in r.drivers
+            assert d1 in r.watchdog.drivers
+            assert "replica-1" in r.breakers
+            with pytest.raises(ValueError, match="already used"):
+                r.add_replica(driver=EngineDriver(make_engine(),
+                                                  name="replica-0"))
+            with pytest.raises(ValueError, match="exactly one"):
+                r.add_replica()
+            removed = r.remove_replica("replica-1", wait=True)
+            assert removed is d1 and d1 not in r.drivers
+            assert d1 not in r.watchdog.drivers
+            # a tombstoned name is never reused
+            d2 = r.add_replica(make_engine())
+            assert d2.name == "replica-2"
+            with pytest.raises(ValueError, match="no replica named"):
+                r.remove_replica("nope")
+            r.remove_replica("replica-2", wait=True)
+            with pytest.raises(ValueError, match="last live"):
+                r.remove_replica("replica-0")
+        finally:
+            r.drain(10.0)
+
+    def test_remove_mid_stream_completes_token_identically(self):
+        prompt = np.arange(1, 7)
+        oracle = make_engine().generate(
+            [prompt], SamplingParams(max_new_tokens=8))[0]
+        drivers = [EngineDriver(make_engine(), name=f"replica-{i}")
+                   for i in range(2)]
+        r = Router(drivers).start()
+        try:
+            t = r.submit(prompt, SamplingParams(max_new_tokens=8))
+            # deregister the serving replica mid-stream: graceful
+            # drain finishes residents, the stream completes
+            r.remove_replica(t.driver.name, wait=False)
+            out = t.result()
+            assert out.finish_reason == "length"
+            assert out.token_ids == oracle.token_ids
+            assert len(r.drivers) == 1
+        finally:
+            r.drain(10.0)
+
+    def test_dead_tombstone_cap_evicts_oldest(self):
+        eng = make_engine()
+        drivers = [EngineDriver(eng, name=f"r{i}") for i in range(5)]
+        r = Router(drivers, dead_replica_cap=2)
+        for d in drivers[:4]:
+            d.condemn()
+        snap = r.fleet_snapshot()
+        # only the LAST 2 tombstones survive; older evicted + counted
+        assert set(snap["replicas"]) == {"r2", "r3", "r4"}
+        assert snap["replicas"]["r2"]["dead"]
+        assert snap["replicas"]["r3"]["dead"]
+        assert not snap["replicas"]["r4"]["dead"]
+        assert r.fleet_dead_evicted_total == 2
+        assert snap["router"]["fleet_dead_evicted_total"] == 2
+        assert "r0" not in r.breakers and "r1" not in r.breakers
+
+    def test_slo_aware_placement_and_breaker_dominance(self):
+        slo_cfg = SLOConfig(min_events=5)
+        drivers = [EngineDriver(make_engine(slo=slo_cfg),
+                                name=f"replica-{i}") for i in range(2)]
+        ctrl = FleetController()
+        r = Router(drivers, controller=ctrl).start()
+        try:
+            # replica-0's tracker burns to `page` in both windows
+            for _ in range(10):
+                drivers[0].engine.slo.on_ttft(5.0)
+            assert drivers[0].engine.slo.worst_state() == "page"
+            assert r._load_key(drivers[0])[1] == 2
+            assert r._load_key(drivers[1])[1] == 0
+            # traffic steers to the ok replica, and the steer counts
+            t = r.submit(np.arange(1, 5),
+                         SamplingParams(max_new_tokens=4))
+            assert t.driver is drivers[1]
+            assert t.result().finish_reason == "length"
+            assert ctrl.placement_avoided_total >= 1
+            snap = r.fleet_snapshot()
+            assert snap["replicas"]["replica-0"][
+                "placement_avoided"] >= 1
+            assert snap["controlplane"][
+                "placement_avoided_total"] >= 1
+            # breaker health DOMINATES the SLO rank: a tripped ok
+            # replica is worse than a burning closed one
+            r.breakers["replica-1"].trip(time.monotonic())
+            assert r._load_key(drivers[0]) < r._load_key(drivers[1])
+        finally:
+            r.drain(10.0)
+
+    def test_slo_rank_inert_with_controller_off(self):
+        slo_cfg = SLOConfig(min_events=5)
+        d0 = EngineDriver(make_engine(slo=slo_cfg), name="replica-0")
+        r = Router([d0])           # no controller: rank stays 0
+        for _ in range(10):
+            d0.engine.slo.on_ttft(5.0)
+        assert r._load_key(d0)[1] == 0
+
+    def test_poll_actuates_scale_up_then_down_with_notes(self):
+        clk = [0.0]
+        e0 = make_engine()
+        cfg = ControlPlaneConfig(min_replicas=1, max_replicas=3,
+                                 scale_up_cooldown_s=0.0,
+                                 scale_down_cooldown_s=0.0)
+        ctrl = FleetController(cfg, replica_factory=make_engine,
+                               clock=lambda: clk[0])
+        r = Router([EngineDriver(e0, name="replica-0")],
+                   controller=ctrl).start()
+        try:
+            ctrl.observe = lambda router: FleetSignals(
+                replicas=1, fast_burn=9.0, slow_burn=9.0)
+            d = ctrl.poll(r)
+            assert d.action == "scale_up" and len(r.drivers) == 2
+            assert ctrl.scale_up_total == 1
+            assert flight_notes(e0, "controlplane:scale_up")
+            clk[0] = 100.0
+            ctrl.observe = lambda router: FleetSignals(
+                replicas=2, mean_util=0.0)
+            d = ctrl.poll(r)
+            assert d.action == "scale_down" and len(r.drivers) == 1
+            assert ctrl.scale_down_total == 1
+            st = r.stats()["controlplane"]
+            assert st["scale_up_total"] == 1
+            assert st["scale_down_total"] == 1
+            assert st["desired_replicas"] == 1
+        finally:
+            r.drain(10.0)
+
+    def test_poll_without_factory_cannot_grow(self):
+        ctrl = FleetController(clock=lambda: 0.0)
+        r = Router([EngineDriver(make_engine(), name="replica-0")],
+                   controller=ctrl)
+        ctrl.observe = lambda router: FleetSignals(
+            replicas=1, fast_burn=9.0, slow_burn=9.0)
+        d = ctrl.poll(r)
+        assert d.action == "scale_up"      # decided, but no factory:
+        assert len(r.drivers) == 1         # the fleet cannot grow
+        assert ctrl.scale_up_total == 0    # counters count ACTUATION
+
+    def test_deadline_infeasible_shed_at_submit(self):
+        e = make_engine()
+        ctrl = FleetController()
+        r = Router([EngineDriver(e, name="replica-0")],
+                   controller=ctrl).start()
+        try:
+            ctrl.observe = lambda router: FleetSignals(
+                replicas=1, queue_depth=50, tokens_per_sec=10.0)
+            with pytest.raises(DeadlineInfeasible) as ei:
+                r.submit(np.arange(1, 5),
+                         SamplingParams(max_new_tokens=4,
+                                        deadline_s=1.0))
+            assert isinstance(ei.value, QueueFull)   # HTTP 429 path
+            assert ei.value.retry_after_s == pytest.approx(319.0)
+            assert ctrl.admission_shed_total == 1
+            assert flight_notes(e, "controlplane:shed")
+            # no deadline -> admission never consulted, served fine
+            t = r.submit(np.arange(1, 5),
+                         SamplingParams(max_new_tokens=4))
+            assert t.result().finish_reason == "length"
+        finally:
+            r.drain(10.0)
+
+
+# -- exposition + fleet_top + serve gate ------------------------------------
+class TestExposition:
+    def test_controlplane_series_through_strict_parser(self):
+        eng = make_engine()
+        ctrl = FleetController()
+        ctrl.decide(FleetSignals(replicas=2, mean_util=0.9), now=0.0)
+        ctrl.on_placement_avoided(3)
+        r = Router([EngineDriver(eng, name="replica-0")],
+                   controller=ctrl)
+        text = prometheus_render({"replica-0": eng.metrics.snapshot()},
+                                 router=r.stats())
+        series = parse_exposition(text)
+        check_histograms(series)
+        vals = {name: v for name, labels, v in series if not labels}
+        assert vals["paddle_serving_fleet_desired_replicas"] == 3
+        assert vals["paddle_serving_scale_up_total"] == 0
+        assert vals["paddle_serving_scale_down_total"] == 0
+        assert vals["paddle_serving_admission_shed_total"] == 0
+        assert vals["paddle_serving_placement_avoided_total"] == 3
+        assert "paddle_serving_fleet_dead_evicted_total" in vals
+
+    def test_controller_off_renders_no_series(self):
+        eng = make_engine()
+        r = Router([EngineDriver(eng, name="replica-0")])
+        text = prometheus_render({"replica-0": eng.metrics.snapshot()},
+                                 router=r.stats())
+        assert "fleet_desired_replicas" not in text
+        assert "admission_shed_total" not in text
+
+
+class TestFleetTop:
+    def render(self, snapshot):
+        sys.path.insert(0, "scripts")
+        try:
+            import fleet_top
+        finally:
+            sys.path.pop(0)
+        return fleet_top.render_fleet(snapshot)
+
+    def snap(self, controlplane=None):
+        return {
+            "router": {"ready": True, "retries_total": 0,
+                       "migrations_total": 0,
+                       "watchdog_kills_total": 0},
+            "slo_worst": "ok",
+            "controlplane": controlplane,
+            "replicas": {"replica-0": {
+                "healthy": True, "dead": False, "draining": False,
+                "breaker": "closed", "steps": 10, "queue_depth": 0,
+                "residents": 1, "num_slots": 2,
+                "pool": {"pages_used": 1, "pages_total": 7},
+                "host_pages_used": 0, "tokens_per_sec": 5.0,
+                "achieved_util": {"mean": 0.5},
+                "slo": {"worst": "ok"}, "placement_avoided": 7,
+                "incidents_total": 0}}}
+
+    def test_header_shows_desired_vs_actual_and_counters(self):
+        text = self.render(self.snap(controlplane={
+            "desired_replicas": 3, "scale_up_total": 2,
+            "scale_down_total": 1, "admission_shed_total": 4,
+            "placement_avoided_total": 7}))
+        assert "1 replicas (desired=3)" in text
+        assert "scale_up=2" in text and "scale_down=1" in text
+        assert "shed=4" in text and "avoided=7" in text
+        assert "avoid" in text.splitlines()[1]
+
+    def test_avoid_column_and_plain_header_without_controller(self):
+        text = self.render(self.snap())
+        assert "desired=" not in text and "shed=" not in text
+        row = next(ln for ln in text.splitlines()
+                   if ln.startswith("replica-0"))
+        assert " 7 " in row + " "     # the avoid column value
+
+    def test_error_row_still_renders(self):
+        s = self.snap()
+        s["replicas"]["replica-0"] = {"error": "boom"}
+        assert "(boom)" in self.render(s)
+
+
+class TestServeGate:
+    def test_serve_default_off_env_spec_on(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_CONTROLPLANE", raising=False)
+        server = serve([make_engine()])
+        try:
+            assert server.router.controller is None
+        finally:
+            server.drain(10.0)
+        monkeypatch.setenv("PADDLE_TPU_CONTROLPLANE", "min=2,max=5")
+        server = serve([make_engine()])
+        try:
+            ctrl = server.router.controller
+            assert isinstance(ctrl, FleetController)
+            assert ctrl.config.min_replicas == 2
+            assert ctrl.config.max_replicas == 5
+        finally:
+            server.drain(10.0)
