@@ -107,6 +107,23 @@ fleet size; `serving_bench --autoscale-ab` drives a diurnal trace
 where reactive scaling holds TTFT p99 within SLO at roughly half the
 fixed fleet's replica-seconds.
 
+N replicas behave as ONE LOGICAL KV CACHE (serving/fabric.py,
+default off, PADDLE_TPU_KV_FABRIC=on / Router(fabric=...)): committed
+prefix pages serialize into a versioned transfer frame (int8 ships
+codes+scales at ~half the f32 wire bytes, fp8 a quarter) and graft
+into another replica's radix tree, so role-configured fleets run
+DISAGGREGATED — long prompts prefill on prefill specialists at a
+1-token budget, pages transfer, decode specialists continue the
+stream token-identically; `RadixPrefixCache.snapshot()/load()` move
+the whole tree (host tier included) across engine restarts so
+rolling deploys start warm with zero re-prefill; and placement ranks
+longest-prefix-affinity against per-replica fingerprint summaries
+(refreshed on the controller poll) after breaker/SLO rank and before
+load. All host-side: fabric off is bit-token-identical, fabric on is
+token-identical to cold recompute (pages are exact quantized codes);
+`serving_bench --disagg-ab` pins TTFT p99 + inter-token p99
+improving together plus the restart-warmth win.
+
 Greedy requests are bit-identical to offline CompiledGenerator decode
 (tested); `scripts/serving_bench.py` drives a Poisson arrival trace and
 reports TTFT/throughput/pool utilization into BENCH_serving.json
@@ -127,6 +144,9 @@ from .tp import (ServingTP, collective_counts,  # noqa: F401
 from .errors import (DeadlineExceeded, EngineClosed,  # noqa: F401
                      PoisonedRequest, QueueFull, RateLimited,
                      ServingError)
+from .fabric import (FabricConfig, decode_frame,  # noqa: F401
+                     encode_frame, frame_header, parse_fabric_spec,
+                     prompt_fingerprints, resolve_fabric)
 from .faults import (FaultInjector, InjectedFault,  # noqa: F401
                      resolve_faults)
 from .metrics import (Histogram, ServingMetrics,  # noqa: F401
@@ -172,4 +192,6 @@ __all__ = ["AdapterStore", "LoRAWeights", "make_random_lora",
            "model_cost_census", "ControlPlaneConfig", "Decision",
            "DeadlineInfeasible", "FleetController", "FleetSignals",
            "parse_controlplane_spec", "resolve_controlplane",
-           "slo_placement_rank"]
+           "slo_placement_rank", "FabricConfig", "resolve_fabric",
+           "parse_fabric_spec", "encode_frame", "decode_frame",
+           "frame_header", "prompt_fingerprints"]

@@ -385,6 +385,16 @@ class FleetController:
         work, the fleet just can't grow); scale-down gracefully
         drains the least-loaded live replica. The `scale_*_total`
         counters count ACTUATED events."""
+        # fleet KV fabric: the poll doubles as the prefix-affinity
+        # refresh tick — each live replica's tree summary is re-read
+        # so placement ranks against a recent view (stale summaries
+        # survive a failed refresh; mis-ranking is the only cost)
+        refresh = getattr(router, "refresh_fabric_summaries", None)
+        if refresh is not None:
+            try:
+                refresh()
+            except Exception:
+                pass
         s = self.observe(router)
         d = self.decide(s)
         if d.action == "scale_up" and self.replica_factory is not None:

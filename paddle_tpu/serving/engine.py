@@ -197,6 +197,7 @@ from ..ops.pallas.paged_attention import count_page_block_reads
 from .adapters import (AdapterStore, BASE_ADAPTER,
                        resolve_adapters_flag)
 from .errors import DeadlineExceeded, EngineClosed, PoisonedRequest
+from .fabric import decode_frame, encode_frame, frame_header
 from .metrics import ServingMetrics
 from .obs import EngineObs, resolve_obs_flag
 from .paging import (HostPagePool, PagePool, TRASH_PAGE, chunk_bucket,
@@ -664,6 +665,14 @@ class ServingEngine:
         # already shows the tier's (byte) size
         self.metrics.host_pages_total = self.host_pages
         self.metrics.pool_pages_total = self.num_pages - 1
+        # fleet KV fabric traffic (serving/fabric.py): committed
+        # prefix pages shipped to / grafted from other replicas —
+        # mirrored into the metrics counters and folded into the cost
+        # census so transfer bytes sit next to compute bytes
+        self._fabric_pages_sent = 0
+        self._fabric_bytes_sent = 0
+        self._fabric_pages_recv = 0
+        self._fabric_bytes_recv = 0
         # overload preemption gate (PADDLE_TPU_PREEMPT, default on)
         self.preempt = resolve_preempt_flag(preempt)
         if self.prefix_cache is not None and self.host_pages > 0:
@@ -801,6 +810,16 @@ class ServingEngine:
         with self._census_lock:
             if self._census is None:
                 self._capture_census()
+            # fabric wire traffic rides the census record so transfer
+            # bytes sit next to compute bytes-accessed in every dump
+            # (cumulative counters, refreshed on each read — the
+            # per-compile FLOPs/bytes fields above stay immutable)
+            self._census["fabric"] = {
+                "pages_sent": self._fabric_pages_sent,
+                "bytes_sent": self._fabric_bytes_sent,
+                "pages_recv": self._fabric_pages_recv,
+                "bytes_recv": self._fabric_bytes_recv,
+            }
         self.metrics.cost_census = self._census
         return self._census
 
@@ -1161,6 +1180,141 @@ class ServingEngine:
         """A spilled page was evicted from the tree while on host."""
         self.host_pool.free(host_slot)
         self.pool.drop_swapped(1, spill=True)
+
+    # -- fleet KV fabric (serving/fabric.py) -------------------------------
+    @property
+    def fabric_geometry(self) -> dict:
+        """The page geometry a transfer frame must match to be
+        graftable here: pages are raw pool blocks, so every axis has
+        to agree bit-for-bit."""
+        return {"kv_dtype": self.kv_dtype,
+                "page_size": self.page_size,
+                "n_layers": self.n_layers, "n_kv": self.n_kv,
+                "head_dim": self.head_dim}
+
+    def _fabric_fp_dtype(self):
+        """The fp/fp8 pool element dtype a frame's blob reinterprets
+        as on this engine (int8 frames never need it)."""
+        return FP8_DTYPE if self.kv_dtype == "fp8" else \
+            np.dtype(self._fp)
+
+    def _fabric_alloc_restore(self, payload):
+        """graft/load callback: allocate one device page (spilling a
+        parked LRU page to the host tier under pressure — never
+        EVICTING, which could tear down the very chain being grafted),
+        write the payload into it, hand it back PARKED. None = no
+        page; the graft stops cleanly at that depth."""
+        pages = self.pool.alloc(1)
+        if pages is None and self.prefix_cache is not None \
+                and self.prefix_cache.spill(1) >= 1:
+            pages = self.pool.alloc(1)
+        if pages is None:
+            return None
+        self._restore_page(payload, pages[0])
+        self.pool.release(pages)
+        self.pool.park(pages)
+        return pages[0]
+
+    def export_prefix_frame(self, tokens, adapter_id: int = 0
+                            ) -> Optional[bytes]:
+        """Serialize the committed page chain covering `tokens` into
+        one transfer frame (None when the tree holds no full page of
+        it, or the cache is off). Device pages are read with the same
+        swap-out program the host tier uses; spilled pages ship
+        straight from host RAM without a device round-trip. Called
+        between steps via EngineDriver.call, like every page-table
+        touch."""
+        if self.prefix_cache is None:
+            return None
+        depth, refs = self.prefix_cache.collect_chain(
+            tokens, adapter_id)
+        if depth <= 0:
+            return None
+        payloads = [self._extract_page(ref) if kind == "page"
+                    else self.host_pool.load(ref)
+                    for kind, ref in refs]
+        tok = np.ascontiguousarray(
+            np.asarray(tokens).reshape(-1)[:depth], dtype=np.int64)
+        frame = encode_frame(
+            kv_dtype=self.kv_dtype, page_size=self.page_size,
+            n_layers=self.n_layers, n_kv=self.n_kv,
+            head_dim=self.head_dim, tokens=tok, payloads=payloads,
+            valid=depth, adapter_id=adapter_id,
+            fp_itemsize=(1 if self.kv_dtype in ("int8", "fp8")
+                         else jnp.dtype(self._fp).itemsize))
+        self._fabric_pages_sent += len(payloads)
+        self._fabric_bytes_sent += len(frame)
+        self.metrics.on_fabric(sent_pages=len(payloads),
+                               sent_bytes=len(frame))
+        self.obs.flight.note(
+            "fabric:send",
+            f"{len(payloads)}p/{depth}tok/{len(frame)}B "
+            f"adapter={adapter_id} dtype={self.kv_dtype}")
+        return frame
+
+    def import_prefix_frame(self, frame: bytes) -> int:
+        """Graft a transfer frame from another replica into this
+        engine's tree so the very next admission hits it. The frame's
+        geometry header must match `fabric_geometry` exactly — a
+        mismatched frame is rejected whole, never half-grafted.
+        Returns pages actually grafted (spans already cached cost
+        nothing)."""
+        if self.prefix_cache is None:
+            return 0
+        header = frame_header(frame)
+        for key, want in self.fabric_geometry.items():
+            if header.get(key) != want:
+                raise ValueError(
+                    f"fabric frame geometry mismatch: {key}="
+                    f"{header.get(key)!r}, this engine has {want!r}")
+        _, tokens, payloads = decode_frame(
+            frame, fp_dtype=self._fabric_fp_dtype())
+        grafted = self.prefix_cache.graft(
+            tokens, payloads, int(header["valid"]),
+            int(header["adapter_id"]),
+            alloc_restore=self._fabric_alloc_restore)
+        self._fabric_pages_recv += grafted
+        self._fabric_bytes_recv += len(frame)
+        self.metrics.on_fabric(recv_pages=grafted,
+                               recv_bytes=len(frame))
+        self.obs.flight.note(
+            "fabric:recv",
+            f"{grafted}/{header['n_pages']}p grafted "
+            f"{len(frame)}B adapter={header['adapter_id']}")
+        return grafted
+
+    def export_prefix_state(self) -> Optional[dict]:
+        """The whole radix tree — structure + page payloads, device
+        AND host tier — as one host-side record, for warm restarts
+        (the router snapshots a drained replica before teardown)."""
+        if self.prefix_cache is None:
+            return None
+        snap = self.prefix_cache.snapshot(
+            self._extract_page, self.host_pool.load)
+        snap["geometry"] = self.fabric_geometry
+        self.obs.flight.note(
+            "fabric:snapshot", f"{len(snap['nodes'])} nodes")
+        return snap
+
+    def import_prefix_state(self, snap: Optional[dict]) -> int:
+        """Warm-start this engine from a predecessor's
+        `export_prefix_state` record (geometry must match; pages that
+        no longer fit are dropped with their subtrees). Returns pages
+        restored."""
+        if snap is None or self.prefix_cache is None:
+            return 0
+        geo = snap.get("geometry")
+        if geo is not None and dict(geo) != self.fabric_geometry:
+            raise ValueError(
+                f"prefix snapshot geometry {geo} does not match "
+                f"this engine ({self.fabric_geometry})")
+        restored = self.prefix_cache.load(
+            snap, alloc_restore=self._fabric_alloc_restore)
+        self.metrics.on_fabric(restored_pages=restored)
+        self.obs.flight.note(
+            "fabric:restore",
+            f"{restored}/{len(snap['nodes'])} pages warm")
+        return restored
 
     def _beat(self):
         hook = self.heartbeat_hook

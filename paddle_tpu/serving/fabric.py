@@ -1,0 +1,311 @@
+"""Fleet KV fabric: KV pages that MOVE between replicas.
+
+PRs 1-16 built every per-replica mechanism — paged (int8/fp8) KV,
+the radix prefix cache with its host-RAM spill tier, migration, and
+an SLO control plane that decides WHERE work runs — but a cache hit
+on replica A was still a cold re-prefill on replica B. This module
+is the missing piece: committed KV pages serialized into a versioned
+wire frame and grafted into another replica's `RadixPrefixCache`, so
+N replicas behave as ONE logical prefix cache. Three coupled
+mechanisms ride the gate (`PADDLE_TPU_KV_FABRIC` / Router(fabric=...),
+default OFF — fabric off is bit-token-identical to fabric absent):
+
+1. **Page transfer** (disaggregated prefill/decode): a
+   prefill-specialist replica runs the prompt at a 1-token budget,
+   its committed pages are read with the engine's existing swap-out
+   program (`_extract_page` — the same opaque payloads the host tier
+   stores), framed by `encode_frame`, shipped router-side, and
+   grafted into the decode specialist's radix tree
+   (`RadixPrefixCache.graft` -> `ServingEngine.import_prefix_frame`).
+   The decode replica then continues `prompt + [t1]` with a full-
+   prefix cache hit: zero re-prefill, and — because quantized pages
+   are EXACT codes — token-identical to cold recompute. int8 pages
+   ship codes + rowwise scales (~half the f32 wire bytes), fp8 pure-
+   convert pages one byte per element (a quarter); the frame header
+   carries the byte accounting that `fabric_bytes_sent_total`
+   exports.
+2. **Radix persist/restore** (warm deploys):
+   `RadixPrefixCache.snapshot()` serializes the whole tree — token
+   spans, device pages AND spilled host-tier pages — into a plain
+   host-side record; `load()` rebuilds it page by page on a fresh
+   engine. `Router.remove_replica` snapshots after the graceful
+   drain, `Router.add_replica` restores before the pump starts, so a
+   rolling deploy's turn-2 TTFT is a warm hit, not a re-prefill.
+3. **Prefix-affinity routing**: each replica's tree is summarized as
+   a set of hashed page-aligned prefix fingerprints (CRC chain over
+   token spans, seeded by adapter id — `prompt_fingerprints` computes
+   the same chain router-side). `Router._place` ranks candidates by
+   longest fingerprint match AFTER breaker/SLO rank and BEFORE load,
+   and the summaries refresh on the controller poll.
+
+Frame format (version 1): magic ``PKVF`` + u32 header length + a JSON
+header (version, kv_dtype lane, page geometry, adapter id, valid
+token count, per-page payload bytes) + the token ids as raw int64 +
+the concatenated fixed-stride page payloads. Geometry is validated on
+import — a frame from a mismatched engine (different page size,
+kv dtype, layer count...) is rejected whole, never half-grafted.
+Everything here is pure host-side numpy; no compiled program changes.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import struct
+import zlib
+from typing import List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["FabricConfig", "resolve_fabric", "parse_fabric_spec",
+           "FABRIC_ENV", "FRAME_VERSION", "FRAME_MAGIC",
+           "encode_frame", "decode_frame", "frame_header",
+           "fp_seed", "fp_step", "prompt_fingerprints"]
+
+FABRIC_ENV = "PADDLE_TPU_KV_FABRIC"
+FRAME_MAGIC = b"PKVF"
+FRAME_VERSION = 1
+
+
+# -- gate -----------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class FabricConfig:
+    """Tuning for the fleet KV fabric (constructed = fabric ON).
+
+    `roles` maps replica name -> "prefill" | "decode": with at least
+    one of each, the router runs DISAGGREGATED placement — a long
+    prompt prefills on a prefill specialist at a 1-token budget, its
+    pages transfer, and a decode specialist continues the stream.
+    Without roles (the default) only warm restore + prefix-affinity
+    ranking are active. `handoff_min_pages` is the minimum full
+    prompt pages worth shipping (a short prompt re-prefills cheaper
+    than it transfers); `summary_limit` caps each replica's
+    fingerprint summary; `restore_on_add` gates the warm-deploy
+    restore in `Router.add_replica`."""
+
+    handoff_min_pages: int = 2
+    summary_limit: int = 4096
+    restore_on_add: bool = True
+    roles: Optional[Mapping[str, str]] = None
+
+
+def parse_fabric_spec(spec: str) -> Optional[FabricConfig]:
+    """"off" -> None; "on" -> defaults; else "k=v,k=v" over
+    min_pages / summary / restore (e.g. "min_pages=3,restore=off")."""
+    low = spec.strip().lower()
+    if low in ("off", "0", "false", "no", ""):
+        return None
+    if low in ("on", "1", "true", "yes"):
+        return FabricConfig()
+    kw = {}
+    for part in low.split(","):
+        k, sep, v = part.partition("=")
+        k = k.strip()
+        if not sep:
+            raise ValueError(
+                f"{FABRIC_ENV}: expected k=v, got {part!r}")
+        if k == "min_pages":
+            kw["handoff_min_pages"] = int(v)
+        elif k == "summary":
+            kw["summary_limit"] = int(v)
+        elif k == "restore":
+            kw["restore_on_add"] = v.strip() in ("on", "1", "true",
+                                                 "yes")
+        else:
+            raise ValueError(
+                f"{FABRIC_ENV}: unknown key {k!r} "
+                "(want min_pages|summary|restore)")
+    return FabricConfig(**kw)
+
+
+def resolve_fabric(override=None) -> Optional[FabricConfig]:
+    """The fabric gate: an explicit Router(fabric=...) wins (bool,
+    spec string, or a FabricConfig); otherwise PADDLE_TPU_KV_FABRIC
+    (default off). Returns None (off) or the active FabricConfig."""
+    if override is not None:
+        if isinstance(override, FabricConfig):
+            return override
+        if isinstance(override, bool):
+            return FabricConfig() if override else None
+        return parse_fabric_spec(str(override))
+    return parse_fabric_spec(os.environ.get(FABRIC_ENV, "off"))
+
+
+# -- prefix fingerprints --------------------------------------------------
+def fp_seed(adapter_id: int = 0) -> int:
+    """Chain seed: the adapter id joins the hash, so tenant A's
+    fingerprints can never match tenant B's tree (the same isolation
+    property the radix tree's per-adapter roots enforce)."""
+    return zlib.crc32(struct.pack("<q", int(adapter_id)))
+
+def fp_step(fp: int, span) -> int:
+    """One page-edge hop: fold a full page's token ids into the
+    running fingerprint. Must match byte-for-byte between the tree
+    walk (RadixPrefixCache.fingerprints) and the prompt walk below."""
+    return zlib.crc32(
+        np.ascontiguousarray(np.asarray(span).reshape(-1),
+                             dtype=np.int64).tobytes(), fp)
+
+
+def prompt_fingerprints(prompt_ids, page_size: int,
+                        adapter_id: int = 0
+                        ) -> List[Tuple[int, int]]:
+    """Fingerprints of every page-aligned prefix of `prompt_ids` the
+    cache could serve — [(depth_pages, fp), ...] for depths 1..n.
+    Capped at prompt_len - 1 tokens, matching the tree's own match
+    limit (at least one token always prefills for logits)."""
+    tok = np.ascontiguousarray(np.asarray(prompt_ids).reshape(-1),
+                               dtype=np.int64)
+    ps = int(page_size)
+    limit = max(0, tok.size - 1)
+    fp = fp_seed(adapter_id)
+    out: List[Tuple[int, int]] = []
+    depth = 0
+    while depth + ps <= limit:
+        fp = fp_step(fp, tok[depth:depth + ps])
+        out.append((depth // ps + 1, fp))
+        depth += ps
+    return out
+
+
+# -- transfer frame -------------------------------------------------------
+def _payload_blob(payload) -> bytes:
+    """One page payload -> wire bytes. Payloads are exactly what
+    `ServingEngine._extract_page` produces (and the host tier
+    stores): an ndarray block [n_layers, 2, page_size, H, D] for the
+    fp/fp8 lanes, or an (int8 codes, f32 scales) pair for int8 —
+    codes and scales ship together (codes without scales are
+    meaningless; the pair IS the page)."""
+    if isinstance(payload, tuple):
+        codes, scales = payload
+        return (np.ascontiguousarray(codes, dtype=np.int8).tobytes()
+                + np.ascontiguousarray(scales,
+                                       dtype=np.float32).tobytes())
+    return np.ascontiguousarray(payload).tobytes()
+
+
+def encode_frame(*, kv_dtype: str, page_size: int, n_layers: int,
+                 n_kv: int, head_dim: int, tokens,
+                 payloads: Sequence, valid: int, adapter_id: int = 0,
+                 fp_itemsize: Optional[int] = None) -> bytes:
+    """Serialize a committed page chain into one versioned frame.
+
+    `tokens` are the (at least `valid`) token ids the pages hold KV
+    for, `payloads` one `_extract_page` payload per page covering
+    them. `fp_itemsize` is the fp/fp8 lane's per-element byte width
+    (inferred from the first payload when omitted) — recorded in the
+    header so the receiver can validate its pool dtype agrees before
+    reinterpreting the blob."""
+    tok = np.ascontiguousarray(np.asarray(tokens).reshape(-1),
+                               dtype=np.int64)
+    valid = int(valid)
+    if valid > tok.size:
+        raise ValueError(f"valid={valid} exceeds tokens ({tok.size})")
+    if valid > len(payloads) * int(page_size):
+        raise ValueError(
+            f"valid={valid} exceeds page capacity "
+            f"({len(payloads)} pages x {page_size})")
+    blob = b"".join(_payload_blob(p) for p in payloads)
+    if kv_dtype == "int8":
+        itemsize = 1
+    elif fp_itemsize is not None:
+        itemsize = int(fp_itemsize)
+    elif payloads:
+        first = payloads[0]
+        itemsize = int(np.asarray(
+            first[0] if isinstance(first, tuple) else first
+        ).dtype.itemsize)
+    else:
+        itemsize = 4
+    header = {
+        "version": FRAME_VERSION,
+        "kv_dtype": str(kv_dtype),
+        "page_size": int(page_size),
+        "n_layers": int(n_layers),
+        "n_kv": int(n_kv),
+        "head_dim": int(head_dim),
+        "itemsize": itemsize,
+        "adapter_id": int(adapter_id),
+        "valid": valid,
+        "n_tokens": int(tok.size),
+        "n_pages": len(payloads),
+        "payload_bytes": len(blob),
+    }
+    hdr = json.dumps(header, sort_keys=True,
+                     separators=(",", ":")).encode("utf-8")
+    return (FRAME_MAGIC + struct.pack("<I", len(hdr)) + hdr
+            + tok.tobytes() + blob)
+
+
+def frame_header(data: bytes) -> dict:
+    """Parse and validate just the frame header (cheap: no payload
+    copy) — the wire-byte accounting and geometry-check entry point."""
+    if len(data) < 8 or data[:4] != FRAME_MAGIC:
+        raise ValueError("not a KV fabric frame (bad magic)")
+    (hlen,) = struct.unpack_from("<I", data, 4)
+    try:
+        header = json.loads(data[8:8 + hlen].decode("utf-8"))
+    except Exception as exc:
+        raise ValueError(f"corrupt fabric frame header: {exc!r}")
+    version = header.get("version")
+    if version != FRAME_VERSION:
+        raise ValueError(
+            f"fabric frame version {version!r} not supported "
+            f"(this build speaks {FRAME_VERSION})")
+    expect = (8 + hlen + 8 * int(header["n_tokens"])
+              + int(header["payload_bytes"]))
+    if len(data) != expect:
+        raise ValueError(
+            f"truncated fabric frame: {len(data)} bytes, header "
+            f"promises {expect}")
+    return header
+
+
+def decode_frame(data: bytes, fp_dtype=None
+                 ) -> Tuple[dict, np.ndarray, List]:
+    """Frame bytes -> (header, tokens int64, per-page payloads).
+
+    int8 payloads come back as (codes, scales) pairs; fp/fp8 lanes
+    need the receiver's pool element dtype (`fp_dtype`, e.g. float32
+    or the ml_dtypes e4m3 type) to reinterpret the blob — its
+    itemsize must match the header's or the frame is rejected (a
+    bf16 pool cannot adopt an f32 frame byte-for-byte)."""
+    header = frame_header(data)
+    hlen = struct.unpack_from("<I", data, 4)[0]
+    off = 8 + hlen
+    n_tok = int(header["n_tokens"])
+    tokens = np.frombuffer(data, dtype=np.int64, count=n_tok,
+                           offset=off).copy()
+    off += 8 * n_tok
+    ps = int(header["page_size"])
+    nl, nh, hd = (int(header["n_layers"]), int(header["n_kv"]),
+                  int(header["head_dim"]))
+    shape = (nl, 2, ps, nh, hd)
+    n_elem = int(np.prod(shape))
+    payloads: List = []
+    if header["kv_dtype"] == "int8":
+        scale_shape = (nl, 2, ps, nh)
+        n_scale = int(np.prod(scale_shape))
+        for _ in range(int(header["n_pages"])):
+            codes = np.frombuffer(data, dtype=np.int8, count=n_elem,
+                                  offset=off).reshape(shape).copy()
+            off += n_elem
+            scales = np.frombuffer(data, dtype=np.float32,
+                                   count=n_scale,
+                                   offset=off).reshape(
+                                       scale_shape).copy()
+            off += 4 * n_scale
+            payloads.append((codes, scales))
+    else:
+        dt = np.dtype(np.float32 if fp_dtype is None else fp_dtype)
+        if dt.itemsize != int(header["itemsize"]):
+            raise ValueError(
+                f"fabric frame element width {header['itemsize']}B "
+                f"does not match receiver pool dtype {dt} "
+                f"({dt.itemsize}B)")
+        for _ in range(int(header["n_pages"])):
+            arr = np.frombuffer(data, dtype=dt, count=n_elem,
+                                offset=off).reshape(shape).copy()
+            off += n_elem * dt.itemsize
+            payloads.append(arr)
+    return header, tokens, payloads

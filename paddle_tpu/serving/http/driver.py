@@ -69,6 +69,20 @@ class _Submission:
         self.error: Optional[BaseException] = None
 
 
+class _Call:
+    """An arbitrary engine function waiting for the driver thread —
+    the fabric's page export/graft ride this (same between-steps
+    guarantee the submission inbox gives mutations)."""
+
+    __slots__ = ("fn", "done", "result", "error")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.done = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+
 class EngineDriver:
     """Pump thread + thread-safe intake for one ServingEngine replica."""
 
@@ -248,6 +262,33 @@ class EngineDriver:
         self._inbox.put(("cancel", request_id))
         self._wake.set()
 
+    def call(self, fn, timeout: Optional[float] = None):
+        """Run `fn(engine)` on the driver thread BETWEEN compiled
+        steps and return its result — thread-safe engine access for
+        everything that is not a submission (the KV fabric's page
+        export / frame graft / tree snapshot all ride this). On a
+        driver whose pump is not running (never started, or already
+        drained and joined) the call runs inline under the mutate
+        lock — the single-threaded invariant holds either way.
+        Raises whatever `fn` raises, ReplicaDead if the replica is
+        gone, EngineClosed if it drains before servicing."""
+        if self._dead:
+            raise ReplicaDead(f"{self.name} is dead") \
+                from self.death_exc
+        if not self._started or not self._thread.is_alive():
+            with self._mutate_lock:
+                return fn(self.engine)
+        c = _Call(fn)
+        self._inbox.put(("call", c))
+        self._wake.set()
+        wait_s = self.submit_timeout_s if timeout is None else timeout
+        if not c.done.wait(wait_s):
+            raise TimeoutError(
+                f"{self.name}: call not serviced within {wait_s}s")
+        if c.error is not None:
+            raise c.error
+        return c.result
+
     def stats(self) -> dict:
         """Racy-but-consistent-enough load snapshot for placement (every
         field is a single atomic read)."""
@@ -367,6 +408,13 @@ class EngineDriver:
                     payload.done.set()
             elif kind == "cancel":
                 self.engine.cancel(payload)
+            elif kind == "call":
+                try:
+                    payload.result = payload.fn(self.engine)
+                except BaseException as e:
+                    payload.error = e
+                finally:
+                    payload.done.set()
 
     def _fail_pending(self, exc: BaseException):
         while True:
@@ -374,7 +422,7 @@ class EngineDriver:
                 kind, payload = self._inbox.get_nowait()
             except queue.Empty:
                 return
-            if kind == "submit":
+            if kind in ("submit", "call"):
                 payload.error = exc
                 payload.done.set()
 

@@ -53,6 +53,18 @@ Fronts N `EngineDriver` replicas with:
   SLO state, capped at the last `dead_replica_cap` (default 16;
   older tombstones are evicted and counted by
   `fleet_dead_evicted_total`).
+- **Fleet KV fabric** (`fabric=`, serving/fabric.py, gated
+  PADDLE_TPU_KV_FABRIC, default off): N replicas behave as ONE
+  logical prefix cache. Placement gains prefix-affinity ranking
+  (longest fingerprint match against per-replica tree summaries,
+  refreshed on the controller poll — after breaker/SLO rank, before
+  load); with `roles=` configured, long prompts run DISAGGREGATED —
+  phase 1 prefills on a prefill specialist at a 1-token budget, the
+  committed pages transfer as a versioned frame, and a decode
+  specialist continues the stream token-identically
+  (`Ticket._complete_handoff`); and `remove_replica` stashes the
+  drained replica's whole tree so the next `add_replica` starts
+  warm (zero re-prefill after a rolling deploy).
 """
 from __future__ import annotations
 
@@ -67,6 +79,7 @@ import numpy as np
 
 from ..controlplane import DeadlineInfeasible, slo_placement_rank
 from ..errors import EngineClosed, QueueFull, ServingError
+from ..fabric import prompt_fingerprints, resolve_fabric
 from ..faults import InjectedFault
 from ..request import Request, RequestOutput, SamplingParams
 from .driver import EngineDriver, ReplicaDead, ReplicaHung
@@ -230,6 +243,14 @@ class Ticket:
         self._banked: Optional[Request] = None
         self._cancelled = False
         self._ttft_s: Optional[float] = None   # first attempt's, if any
+        # disaggregated prefill/decode (fleet KV fabric, default
+        # off): when the plan names a (prefill, decode) pair, phase 1
+        # runs the prompt on the prefill specialist at a ONE-token
+        # budget; its committed pages then transfer and the stream
+        # continues on the decode specialist (`_complete_handoff`).
+        # None = classic single-replica placement.
+        self._fabric_dst: Optional[EngineDriver] = None
+        plan = router._fabric_plan(self._prompt_ids, self._sampling)
         # the engine-level request id is the TICKET id — stable across
         # every attempt, never the engines' own per-replica counters:
         # replicas number requests independently, so engine-issued ids
@@ -237,9 +258,21 @@ class Ticket:
         # globally (fault injection, logs, traces) must follow the
         # request when it migrates
         # may raise QueueFull/EngineClosed straight to the HTTP layer
-        self.driver, self.request = router._place(
-            self._prompt_ids, self._sampling, exclude=(),
-            request_id=self.id)
+        if plan is not None:
+            pre, dst = plan
+            try:
+                self.driver, self.request = router._place_on(
+                    pre, self._prompt_ids,
+                    dataclasses.replace(self._sampling,
+                                        max_new_tokens=1),
+                    request_id=self.id)
+                self._fabric_dst = dst
+            except ServingError:
+                plan = None     # prefill side refused: classic path
+        if plan is None:
+            self.driver, self.request = router._place(
+                self._prompt_ids, self._sampling, exclude=(),
+                request_id=self.id)
         self._tried = [self.driver]
 
     # -- consumption -------------------------------------------------------
@@ -261,6 +294,24 @@ class Ticket:
                 yield ("token", val)
             elif kind == "idle":
                 yield ("idle", None)
+            elif (self._fabric_dst is not None and val == "length"
+                    and not self._cancelled):
+                # phase 1 of a disaggregated placement ran out its
+                # 1-token budget on the prefill specialist: hand off
+                # to the decode specialist (pages transfer, stream
+                # continues). Any other phase-1 reason (stop token,
+                # timeout, replica death) takes its normal path.
+                dst, self._fabric_dst = self._fabric_dst, None
+                try:
+                    if self._complete_handoff(req, dst):
+                        continue
+                    yield ("done", val)   # budget genuinely exhausted
+                except ServingError as exc:
+                    self.error = exc
+                    # phase 1 delivered its token, so the stream
+                    # closes as a partial rather than erroring
+                    yield ("done", val)
+                return
             elif val == _RETRYABLE_REASON and not self._cancelled:
                 try:
                     self._failover(req)
@@ -340,6 +391,10 @@ class Ticket:
         continues the exact sequence (token-identical to an
         uninterrupted run; asserted against the solo oracle)."""
         dead_replica = self.driver.name
+        # a pending disaggregated handoff dies with its prefill
+        # replica: the migration below re-places the whole request
+        # with its FULL remaining budget, so nothing is lost
+        self._fabric_dst = None
         if self._ttft_s is None and dead.output_tokens:
             self._ttft_s = dead.output().ttft_s
         self._history.extend(dead.output_tokens)
@@ -382,6 +437,61 @@ class Ticket:
             obs.tracer.record(self.id, "migrate",
                               cause=f"replica_death:{dead_replica}",
                               tokens=len(self._history))
+
+    def _complete_handoff(self, done: Request, dst: EngineDriver
+                          ) -> bool:
+        """Phase 2 of a disaggregated placement: the prefill
+        specialist finished the prompt and emitted exactly its
+        1-token budget. Bank that token (migration-style), ship the
+        committed prompt pages to the decode specialist (best-effort:
+        a failed transfer just means the decode side re-prefills —
+        the prefix cache makes that its only cost), then continue
+        `prompt + banked` there with the remaining budget. Greedy
+        decode is deterministic AND the transferred pages hold exact
+        quantized codes, so the merged stream is token-identical to
+        an undisaggregated run. Returns False when no budget remains
+        (the stream was genuinely done at 1 token)."""
+        r = self._router
+        src_name = self.driver.name
+        if self._ttft_s is None and done.output_tokens:
+            self._ttft_s = done.output().ttft_s
+        self._history.extend(done.output_tokens)
+        self._accepted_drafts += done.accepted_draft_tokens
+        self._preemptions += done.preemptions
+        self._banked = done
+        remaining = self._sampling.max_new_tokens - len(self._history)
+        if remaining <= 0:
+            return False
+        aid = int(getattr(self._sampling, "adapter_id", 0) or 0)
+        r._fabric_transfer(self.driver, dst, self._prompt_ids, aid)
+        prompt = np.concatenate(
+            [self._prompt_ids,
+             np.asarray(self._history, dtype=self._prompt_ids.dtype)])
+        sampling = dataclasses.replace(self._sampling,
+                                       max_new_tokens=remaining)
+        try:
+            driver, request = r._place_on(dst, prompt, sampling,
+                                          request_id=self.id)
+        except ServingError:
+            # decode side refused (shed/dying): any survivor can
+            # finish the stream — the classic failover re-place
+            driver, request = r._place(prompt, sampling, exclude=(),
+                                       request_id=self.id)
+        with r._lock:
+            self.driver, self.request = driver, request
+            self._banked = None
+            self._tried = [driver]
+            self.attempts += 1
+            r.fabric_handoffs_total += 1
+            cancelled = self._cancelled
+        if cancelled:     # cancel raced the handoff: honor it
+            driver.cancel(request.request_id)
+        obs = getattr(driver.engine, "obs", None)
+        if obs is not None:
+            obs.tracer.record(self.id, "fabric_handoff",
+                              cause=f"prefill:{src_name}",
+                              tokens=len(self._history))
+        return True
 
     def _retry(self, prompt_ids, sampling):
         """Re-place on another replica. Attempt 0 fires IMMEDIATELY —
@@ -431,6 +541,7 @@ class Router:
                  breaker_open_s: float = 1.0,
                  controller=None,
                  dead_replica_cap: int = 16,
+                 fabric=None,
                  clock=time.monotonic):
         if not drivers:
             raise ValueError("router needs at least one driver")
@@ -477,6 +588,17 @@ class Router:
         # per-replica count of placements steered AROUND it because
         # its SLO was burning (fleet_top's burn-avoidance column)
         self._avoided_by: Dict[str, int] = {}
+        # fleet KV fabric (serving/fabric.py; None = off, gated
+        # PADDLE_TPU_KV_FABRIC / fabric=): prefix-affinity placement
+        # over per-replica fingerprint summaries, disaggregated
+        # prefill->decode page handoff, and warm restarts over the
+        # stashed tree snapshot of the last drained replica
+        self.fabric = resolve_fabric(fabric)
+        self._fabric_fps: Dict[str, set] = {}
+        self._fabric_snapshot: Optional[dict] = None
+        self.fabric_handoffs_total = 0
+        self.fabric_pages_moved_total = 0
+        self.fabric_transfer_failures_total = 0
         self.watchdog: Optional[ReplicaWatchdog] = None
         self._watchdog_stop = threading.Event()
         self._watchdog_thread: Optional[threading.Thread] = None
@@ -599,6 +721,10 @@ class Router:
             if self.watchdog is not None:
                 self.watchdog.drivers.append(driver)
             started = self._started
+        # warm start (fleet KV fabric): restore the stashed tree
+        # snapshot of the last drained replica BEFORE the pump starts
+        # stepping, so the new replica's first admission already hits
+        self._fabric_restore(driver)
         if start and started:
             driver.start()
         return driver
@@ -632,12 +758,25 @@ class Router:
             if self.watchdog is not None \
                     and target in self.watchdog.drivers:
                 self.watchdog.drivers.remove(target)
-            # the breaker entry stays: an in-flight placement may
-            # still read it; tombstone pruning reaps it later
+            # drop the removed replica's router-side state NOW: a
+            # gracefully removed replica is never reaped by the dead-
+            # tombstone pruner, so leaving these would leak forever —
+            # and a breaker entry that outlives its replica poisons
+            # `stats()["breakers"]` with stale (possibly open) state.
+            # An in-flight placement racing this read gets a
+            # throwaway closed breaker from `_breaker_for` — its
+            # verdict no longer matters.
+            self.breakers.pop(name, None)
+            self._avoided_by.pop(name, None)
+            self._fabric_fps.pop(name, None)
         if wait:
             target.drain(timeout)
+            self._fabric_stash(target)
         else:
-            threading.Thread(target=target.drain, args=(timeout,),
+            def _drain_then_stash():
+                target.drain(timeout)
+                self._fabric_stash(target)
+            threading.Thread(target=_drain_then_stash,
                              daemon=True).start()
         return target
 
@@ -735,6 +874,24 @@ class Router:
         aid = int(getattr(sampling, "adapter_id", 0) or 0) \
             if sampling is not None else 0
         keys = {id(d): self._load_key(d, aid) for d in cands}
+        if self.fabric is not None:
+            # prefix-affinity routing (fleet KV fabric): the replica
+            # whose tree summary covers the longest page-aligned
+            # prefix of THIS prompt wins among equals — spliced in at
+            # index 2, after breaker health and SLO rank (a burning
+            # warm replica still loses to a clean cold one), before
+            # adapter warmth and load. Index 1 stays the SLO rank:
+            # the burn-avoidance accounting below depends on it.
+            fps_by_ps: Dict[int, list] = {}
+            for d in cands:
+                ps = int(getattr(d.engine, "page_size", 0) or 0)
+                if ps > 0 and ps not in fps_by_ps:
+                    fps_by_ps[ps] = prompt_fingerprints(
+                        prompt_ids, ps, aid)
+                aff = self._fabric_affinity(
+                    d.name, fps_by_ps.get(ps, ()))
+                k = keys[id(d)]
+                keys[id(d)] = k[:2] + (-aff,) + k[2:]
         cands.sort(key=lambda d: keys[id(d)])
         last: Optional[ServingError] = None
         for d in cands:
@@ -770,6 +927,26 @@ class Router:
             raise last
         raise EngineClosed("no replica accepted the request") from last
 
+    def _place_on(self, d: EngineDriver, prompt_ids, sampling,
+                  request_id: Optional[str] = None
+                  ) -> Tuple[EngineDriver, Request]:
+        """Place on ONE specific replica (the fabric's role-pinned
+        placements) with the same breaker accounting as `_place`:
+        QueueFull is load (no charge), death/drain charges the
+        breaker. No fallback here — the caller decides whether a
+        refusal means `_place` normally or fail."""
+        if self._draining:
+            raise EngineClosed("router is draining")
+        try:
+            req = d.submit(prompt_ids, sampling, request_id=request_id)
+        except QueueFull:
+            raise
+        except (ReplicaDead, EngineClosed, InjectedFault):
+            self._breaker_for(d.name).record_failure(self._clock())
+            raise
+        self._breaker_for(d.name).record_success(self._clock())
+        return d, req
+
     def _breaker_for(self, name: str) -> CircuitBreaker:
         """Breaker lookup that survives a racing remove/prune: a
         replica evicted mid-placement gets a throwaway closed breaker
@@ -799,6 +976,147 @@ class Router:
         return (rank, slo_rank, cold, s["queue_depth"], s["inflight"],
                 -s["free_pages"])
 
+    # -- fleet KV fabric (serving/fabric.py) -------------------------------
+    def refresh_fabric_summaries(self):
+        """Refresh every live replica's prefix-fingerprint summary
+        (the affinity ranking's input) — called on the controller
+        poll; cheap enough for benches/tests to call directly. A
+        replica that cannot answer keeps its stale summary: stale
+        affinity is a mis-ranked placement, not an error."""
+        if self.fabric is None:
+            return
+        limit = self.fabric.summary_limit
+        for d in list(self.drivers):
+            if d.dead or d.draining:
+                continue
+            try:
+                fps = d.call(lambda eng: (
+                    set() if eng.prefix_cache is None
+                    else eng.prefix_cache.fingerprints(limit)))
+            except Exception:
+                continue
+            with self._lock:
+                self._fabric_fps[d.name] = fps
+
+    def _fabric_affinity(self, name: str, prompt_fps) -> int:
+        """Longest page-aligned prefix of the prompt this replica's
+        last summary can serve, in pages. The fingerprint is a chain
+        (depth d+1 folds depth d), so the first miss ends the walk."""
+        fps = self._fabric_fps.get(name)
+        if not fps or not prompt_fps:
+            return 0
+        depth = 0
+        for d, fp in prompt_fps:
+            if fp not in fps:
+                break
+            depth = d
+        return depth
+
+    def _fabric_plan(self, prompt_ids, sampling
+                     ) -> Optional[Tuple[EngineDriver, EngineDriver]]:
+        """Disaggregated placement decision: (prefill specialist,
+        decode specialist) for this prompt, or None for the classic
+        path. Requires role-configured fabric, both roles live, a
+        token budget > 1 (phase 1 spends exactly 1), and a prompt
+        spanning at least `handoff_min_pages` full pages (short
+        prompts re-prefill cheaper than they transfer). Skipped when
+        the best decode replica already holds the whole prefix —
+        affinity routing alone lands it there with zero transfer."""
+        fab = self.fabric
+        if fab is None or not fab.roles or self._draining:
+            return None
+        budget = int(getattr(sampling, "max_new_tokens", 16) or 16) \
+            if sampling is not None else 16
+        if budget < 2:
+            return None
+        with self._lock:
+            drivers = list(self.drivers)
+        roles = fab.roles
+        pre = [d for d in drivers
+               if d.healthy and roles.get(d.name) == "prefill"]
+        dec = [d for d in drivers
+               if d.healthy and roles.get(d.name) == "decode"]
+        if not pre or not dec:
+            return None
+        aid = int(getattr(sampling, "adapter_id", 0) or 0) \
+            if sampling is not None else 0
+        ps = int(getattr(dec[0].engine, "page_size", 0) or 0)
+        if ps <= 0:
+            return None
+        prompt = np.asarray(prompt_ids).reshape(-1)
+        n_pages = prompt.size // ps
+        if n_pages < fab.handoff_min_pages:
+            return None
+        fps = prompt_fingerprints(prompt, ps, aid)
+        src = min(pre, key=lambda d: self._load_key(d, aid))
+        dst = min(dec, key=lambda d: (
+            -self._fabric_affinity(d.name, fps),
+            self._load_key(d, aid)))
+        if self._fabric_affinity(dst.name, fps) >= n_pages:
+            return None   # already warm there: no transfer needed
+        obs = getattr(src.engine, "obs", None)
+        if obs is not None:     # placement decision, in the flight ring
+            obs.flight.note(
+                "fabric:plan",
+                f"prefill={src.name} decode={dst.name} "
+                f"pages={n_pages} adapter={aid}")
+        return src, dst
+
+    def _fabric_transfer(self, src: EngineDriver, dst: EngineDriver,
+                         tokens, adapter_id: int = 0) -> int:
+        """Ship the committed page chain covering `tokens` from `src`
+        to `dst` (export -> frame -> graft, each on its own driver
+        thread between steps). Best-effort by design: on ANY failure
+        the decode side simply re-prefills — correctness never rides
+        the transfer. Returns pages grafted."""
+        if self.fabric is None:
+            return 0
+        try:
+            frame = src.call(
+                lambda eng: eng.export_prefix_frame(tokens,
+                                                    adapter_id))
+            if frame is None:
+                return 0
+            grafted = dst.call(
+                lambda eng: eng.import_prefix_frame(frame))
+        except Exception:
+            with self._lock:
+                self.fabric_transfer_failures_total += 1
+            return 0
+        with self._lock:
+            self.fabric_pages_moved_total += int(grafted)
+        return int(grafted)
+
+    def _fabric_stash(self, target: EngineDriver):
+        """Snapshot a just-drained replica's whole prefix tree so the
+        next `add_replica` starts warm (kept, not consumed: every
+        subsequent add warms from the newest stash)."""
+        if self.fabric is None or not self.fabric.restore_on_add:
+            return
+        try:
+            snap = target.call(lambda eng: eng.export_prefix_state())
+        except Exception:
+            return
+        if snap and snap.get("nodes"):
+            with self._lock:
+                self._fabric_snapshot = snap
+
+    def _fabric_restore(self, driver: EngineDriver) -> int:
+        """Warm a newly registered replica from the stashed snapshot
+        (geometry-checked engine-side; any failure degrades to a cold
+        start). Returns pages restored."""
+        if self.fabric is None or not self.fabric.restore_on_add:
+            return 0
+        with self._lock:
+            snap = self._fabric_snapshot
+        if snap is None:
+            return 0
+        try:
+            return int(driver.call(
+                lambda eng: eng.import_prefix_state(snap)))
+        except Exception:
+            return 0
+
     # -- multi-tenant adapter registry --------------------------------------
     def resolve_model(self, name: str) -> Optional[int]:
         """Map an HTTP `model=` name to its adapter_id through the
@@ -825,6 +1143,17 @@ class Router:
             "fleet_dead_evicted_total": self.fleet_dead_evicted_total,
             "breakers": {name: b.state(now)
                          for name, b in dict(self.breakers).items()},
+            "fabric": (None if self.fabric is None else {
+                "handoffs_total": self.fabric_handoffs_total,
+                "pages_moved_total": self.fabric_pages_moved_total,
+                "transfer_failures_total":
+                    self.fabric_transfer_failures_total,
+                "stashed_nodes": (
+                    0 if self._fabric_snapshot is None
+                    else len(self._fabric_snapshot["nodes"])),
+                "summary_fps": {n: len(f) for n, f in
+                                sorted(self._fabric_fps.items())},
+            }),
             "controlplane": (None if self.controller is None
                              else self.controller.stats()),
         }
@@ -915,7 +1244,7 @@ class Router:
                         "healthy": d.healthy,
                         "dead": d.dead,
                         "draining": d.draining,
-                        "breaker": self.breakers[d.name].state(now),
+                        "breaker": self._breaker_for(d.name).state(now),
                         "steps": d.steps,
                         "queue_depth": eng.scheduler.queue_depth,
                         "residents": len(eng.scheduler.running),
@@ -926,6 +1255,18 @@ class Router:
                             "pages_cached": eng.pool.cached_pages,
                             "pages_swapped": eng.pool.swapped_pages},
                         "host_pages_used": eng.host_pool.used_pages,
+                        # cache warmth (fleet_top's warm column) +
+                        # fabric wire traffic, per replica
+                        "prefix": (None if eng.prefix_cache is None
+                                   else eng.prefix_cache.stats()),
+                        "fabric": {
+                            "pages_sent": m.fabric_pages_sent,
+                            "bytes_sent": m.fabric_bytes_sent,
+                            "pages_recv": m.fabric_pages_recv,
+                            "bytes_recv": m.fabric_bytes_recv,
+                            "restored_pages":
+                                m.fabric_restored_pages,
+                        },
                         "tokens_generated": m.tokens_generated,
                         "tokens_per_sec": m.tokens_per_sec,
                         "achieved_util":

@@ -163,6 +163,16 @@ class ServingMetrics:
         self.preemptions = 0
         self.swapped_out_pages = 0
         self.swapped_in_pages = 0
+        # fleet KV fabric (serving/fabric.py): committed prefix pages
+        # shipped to / grafted from OTHER replicas over the versioned
+        # transfer frame (sent/recv pages + wire bytes — the
+        # int8-halves / fp8-quarters economics), plus warm-restart
+        # pages restored from a predecessor's tree snapshot
+        self.fabric_pages_sent = 0
+        self.fabric_bytes_sent = 0
+        self.fabric_pages_recv = 0
+        self.fabric_bytes_recv = 0
+        self.fabric_restored_pages = 0
         self.tokens_generated = 0
         self.prompt_tokens = 0
         self.prefills = 0
@@ -488,6 +498,19 @@ class ServingMetrics:
             if pages_in and wall_s > 0:
                 self.swap_in_s.record(wall_s)
 
+    def on_fabric(self, sent_pages: int = 0, sent_bytes: int = 0,
+                  recv_pages: int = 0, recv_bytes: int = 0,
+                  restored_pages: int = 0):
+        """KV fabric traffic: one transfer frame left (sent) or was
+        grafted into (recv) this replica's tree, or a warm restart
+        restored `restored_pages` from a predecessor's snapshot."""
+        with self._lock:
+            self.fabric_pages_sent += int(sent_pages)
+            self.fabric_bytes_sent += int(sent_bytes)
+            self.fabric_pages_recv += int(recv_pages)
+            self.fabric_bytes_recv += int(recv_bytes)
+            self.fabric_restored_pages += int(restored_pages)
+
     def on_unified_step(self, prefill_tokens: int, decode_tokens: int,
                         wall_s: float, draft_tokens: int = 0):
         """One unified ragged step ran, packing `prefill_tokens` prompt
@@ -666,6 +689,13 @@ class ServingMetrics:
                 "cached_tokens_per_request":
                     self.prefix_cached_tokens_hist.snapshot(),
             }),
+            "fabric": {
+                "pages_sent": self.fabric_pages_sent,
+                "bytes_sent": self.fabric_bytes_sent,
+                "pages_recv": self.fabric_pages_recv,
+                "bytes_recv": self.fabric_bytes_recv,
+                "restored_pages": self.fabric_restored_pages,
+            },
             "prefill_stall": self.prefill_stall,
             "prefill_stall_hist": self.prefill_stall_hist.snapshot(),
             "ttft_s": self.ttft_s.snapshot(),
@@ -760,7 +790,14 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                        ("prefix_evicted_pages_total", "counter"),
                        ("prefix_cow_copies_total", "counter"),
                        ("prefix_resident_pages", "gauge"),
+                       ("prefix_tree_pages", "gauge"),
+                       ("prefix_spilled_nodes", "gauge"),
                        ("prefix_hit_rate", "gauge"),
+                       ("fabric_pages_sent_total", "counter"),
+                       ("fabric_bytes_sent_total", "counter"),
+                       ("fabric_pages_recv_total", "counter"),
+                       ("fabric_bytes_recv_total", "counter"),
+                       ("fabric_restored_pages_total", "counter"),
                        ("engine_info", "gauge"),
                        ("poisoned_total", "counter"),
                        ("preemptions_total", "counter"),
@@ -937,12 +974,26 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                                 ("prefix_cow_copies_total",
                                  "cow_copies"),
                                 ("prefix_resident_pages",
-                                 "resident_pages")]:
+                                 "resident_pages"),
+                                ("prefix_tree_pages", "tree_pages"),
+                                ("prefix_spilled_nodes",
+                                 "spilled_nodes")]:
                 lines.append(f"{namespace}_{metric}" + _fmt_labels(lab)
-                             + f" {prefix[key]}")
+                             + f" {prefix.get(key, 0)}")
             lines.append(f"{namespace}_prefix_hit_rate"
                          + _fmt_labels(lab)
                          + f" {prefix['hit_rate'] or 0.0}")
+        fabric = snap.get("fabric")
+        if fabric is not None:
+            for metric, key in [
+                    ("fabric_pages_sent_total", "pages_sent"),
+                    ("fabric_bytes_sent_total", "bytes_sent"),
+                    ("fabric_pages_recv_total", "pages_recv"),
+                    ("fabric_bytes_recv_total", "bytes_recv"),
+                    ("fabric_restored_pages_total",
+                     "restored_pages")]:
+                lines.append(f"{namespace}_{metric}" + _fmt_labels(lab)
+                             + f" {fabric.get(key, 0)}")
         _hist_lines(f"{namespace}_ttft_seconds", snap["ttft_s"], lab,
                     lines)
         _hist_lines(f"{namespace}_inter_token_seconds",

@@ -62,6 +62,15 @@ Lifecycle
 
 The compiled decode/prefill programs never see any of this: hits, COW
 and eviction only change which page ids the host page tables carry.
+
+Fleet fabric (serving/fabric.py) extends the same tree across
+replicas: `collect_chain`/`graft` move one committed page chain
+between two trees (disaggregated prefill handoff), `snapshot`/`load`
+move the WHOLE tree across an engine restart (warm deploys), and
+`fingerprints` summarizes the tree as hashed page-aligned prefixes
+for the router's affinity ranking. All of them speak the engine's
+opaque page payloads (`_extract_page` blocks) — the tree never looks
+inside a page.
 """
 from __future__ import annotations
 
@@ -683,3 +692,213 @@ class RadixPrefixCache:
         spilled (e.g. tests forcing a cold cache). Referenced nodes
         survive."""
         return self.evict(self.tree_pages + self._n_spilled)
+
+    # -- fleet fabric (serving/fabric.py) ----------------------------------
+    def fingerprints(self, limit: int = 4096) -> set:
+        """Hashed summary of every page-aligned prefix this tree can
+        serve — the per-replica summary the router ranks prefix
+        affinity against. Each full-page edge contributes one CRC
+        chained from its ancestors' spans and seeded by the adapter id
+        (`fabric.fp_step`/`fp_seed` — byte-identical to the router's
+        `prompt_fingerprints` walk over a prompt). Spilled nodes count:
+        a match restores them, which is the whole point. BFS so a
+        `limit` cap keeps the SHALLOW prefixes — the ones most prompts
+        share — when the tree outgrows the summary budget."""
+        from collections import deque
+
+        from .fabric import fp_seed, fp_step
+        out: set = set()
+        queue = deque((root, fp_seed(aid))
+                      for aid, root in self._roots.items())
+        while queue and len(out) < limit:
+            node, fp = queue.popleft()
+            for child in node.children.values():
+                cfp = fp_step(fp, child.tokens)
+                out.add(cfp)
+                if len(out) >= limit:
+                    break
+                queue.append((child, cfp))
+        return out
+
+    def collect_chain(self, tokens, adapter_id: int = 0
+                      ) -> Tuple[int, List[Tuple[str, int]]]:
+        """The resident page chain covering `tokens`' full pages, for
+        the transfer path: walks full-page edges WITHOUT acquiring or
+        restoring, returning (covered token count, [("page", id) |
+        ("host", slot), ...]) — the engine reads device pages with its
+        swap-out program and host slots straight from the host pool,
+        so a spilled node ships without a device round-trip. Stops at
+        the first miss (a transfer is one contiguous chain or
+        nothing). Single-threaded like every other tree call: the
+        chain stays valid until the next engine step."""
+        ps = self.page_size
+        tok = _tok(tokens)
+        node = self._root_for(adapter_id)
+        refs: List[Tuple[str, int]] = []
+        depth = 0
+        while depth + ps <= tok.size:
+            child = node.children.get(tok[depth:depth + ps].tobytes())
+            if child is None:
+                break
+            if child.page is not None:
+                refs.append(("page", child.page))
+            elif child.host is not None:
+                refs.append(("host", child.host))
+            else:
+                break
+            node = child
+            depth += ps
+            self._touch(child)
+        return depth, refs
+
+    def graft(self, tokens, payloads: List, valid: int,
+              adapter_id: int = 0, *, alloc_restore) -> int:
+        """`insert`'s twin for pages arriving from ANOTHER replica:
+        index a transferred chain so the very next `acquire` hits it.
+        `payloads` are opaque engine page payloads (one per page of
+        `tokens[:valid]`); `alloc_restore(payload)` is the engine
+        callback that allocates a device page (spilling/evicting under
+        pressure), writes the payload into it, and returns it PARKED —
+        or None, which ends the graft at that depth (a partial graft
+        is still a valid shorter prefix; the chain property holds
+        because grafting proceeds root-ward first). Spans the tree
+        already holds are deduplicated without spending a page —
+        re-transfer of a popular prefix costs nothing device-side.
+        Returns the number of pages actually grafted."""
+        ps = self.page_size
+        tok = _tok(tokens)
+        valid = int(valid)
+        if valid > tok.size or valid > len(payloads) * ps:
+            raise ValueError(
+                f"valid={valid} exceeds tokens ({tok.size}) or "
+                f"payload capacity ({len(payloads) * ps})")
+        node = self._root_for(adapter_id)
+        n_full = valid // ps
+        grafted = 0
+        for i in range(n_full):
+            span = tok[i * ps:(i + 1) * ps]
+            key = span.tobytes()
+            child = node.children.get(key)
+            if child is None:
+                page = alloc_restore(payloads[i])
+                if page is None:
+                    return grafted
+                child = _Node(np.array(span), page, node)
+                node.children[key] = child
+                self._owner[page] = child
+                self.inserted_pages_total += 1
+                grafted += 1
+            node = child
+            self._touch(node)
+        rem = valid - n_full * ps
+        if rem > 0 and n_full < len(payloads) and \
+                self._tail_is_new(node, tok[n_full * ps:valid]):
+            page = alloc_restore(payloads[n_full])
+            if page is not None:
+                part = _Partial(np.array(tok[n_full * ps:valid]), page)
+                node.partials.append(part)
+                self._owner[page] = part
+                self.inserted_pages_total += 1
+                self._touch(part)
+                grafted += 1
+        return grafted
+
+    def snapshot(self, extract_page, host_payload=None) -> dict:
+        """Serialize the whole tree — structure AND page contents —
+        into a plain host-side record for warm restarts. Every node
+        (device-resident via `extract_page(page)`, spilled via
+        `host_payload(slot)`) becomes one entry {adapter, parent
+        index, token span, opaque payload}; parents always precede
+        children so `load` rebuilds in one pass. A node whose payload
+        is unreachable (host tier dropped it) is skipped WITH its
+        subtree — a chain with a hole is not a prefix. Meant for
+        quiesced engines (the router snapshots after drain), but only
+        reads pages, so a live snapshot is merely a stale one."""
+        nodes: List[dict] = []
+        for aid, root in sorted(self._roots.items()):
+            stack: List[Tuple[object, int]] = [(root, -1)]
+            while stack:
+                node, pidx = stack.pop()
+                if node.tokens is None:
+                    midx = -1
+                else:
+                    if node.page is not None:
+                        payload = extract_page(node.page)
+                    elif node.host is not None and \
+                            host_payload is not None:
+                        payload = host_payload(node.host)
+                    else:
+                        continue
+                    if payload is None:
+                        continue
+                    midx = len(nodes)
+                    nodes.append({"adapter": aid, "parent": pidx,
+                                  "tokens": np.array(node.tokens),
+                                  "payload": payload,
+                                  "partial": False})
+                for part in node.partials:
+                    pay = extract_page(part.page)
+                    if pay is not None:
+                        nodes.append({"adapter": aid, "parent": midx,
+                                      "tokens": np.array(part.tokens),
+                                      "payload": pay, "partial": True})
+                for child in node.children.values():
+                    stack.append((child, midx))
+        return {"version": 1, "page_size": self.page_size,
+                "nodes": nodes}
+
+    def load(self, snap: dict, *, alloc_restore) -> int:
+        """Rebuild a `snapshot` into THIS tree (typically empty — a
+        fresh engine warming from its predecessor), parent-first, with
+        the same `alloc_restore` contract and dedup as `graft`. An
+        entry whose page cannot be allocated is dropped with its
+        descendants (they never find their parent placed); everything
+        restored is parked cache-resident, so the first prompts after
+        a deploy hit instead of re-prefilling. Returns pages
+        restored."""
+        if snap.get("version") != 1:
+            raise ValueError(
+                f"prefix snapshot version {snap.get('version')!r} "
+                "not supported")
+        if int(snap.get("page_size", -1)) != self.page_size:
+            raise ValueError(
+                f"prefix snapshot page_size {snap.get('page_size')} "
+                f"!= cache page_size {self.page_size}")
+        restored = 0
+        placed: Dict[int, _Node] = {}
+        for i, ent in enumerate(snap["nodes"]):
+            pidx = int(ent["parent"])
+            if pidx < 0:
+                parent = self._root_for(int(ent["adapter"]))
+            else:
+                parent = placed.get(pidx)
+                if parent is None:
+                    continue
+            toks = _tok(ent["tokens"])
+            if ent.get("partial"):
+                if not self._tail_is_new(parent, toks):
+                    continue
+                page = alloc_restore(ent["payload"])
+                if page is None:
+                    continue
+                part = _Partial(np.array(toks), page)
+                parent.partials.append(part)
+                self._owner[page] = part
+                self.inserted_pages_total += 1
+                self._touch(part)
+                restored += 1
+            else:
+                key = toks.tobytes()
+                child = parent.children.get(key)
+                if child is None:
+                    page = alloc_restore(ent["payload"])
+                    if page is None:
+                        continue
+                    child = _Node(np.array(toks), page, parent)
+                    parent.children[key] = child
+                    self._owner[page] = child
+                    self.inserted_pages_total += 1
+                    restored += 1
+                placed[i] = child
+                self._touch(child)
+        return restored

@@ -14,7 +14,10 @@ it as the `top`-style table a human scans during an incident:
         # redraw every 2s until interrupted
 
 Columns: replica | up (ok/DEAD/drain) | brk (breaker) | steps | queue
-| res/slots | pages used/total | host | util (mean achieved
+| res/slots | pages used/total | host | warm (prefix-cache warmth:
+resident tree pages / lifetime hit rate — the fleet KV fabric's
+restore + affinity machinery is working when a freshly added replica
+shows warm pages before its first request) | util (mean achieved
 utilization of the unified step) | tok/s | slo (worst burn state) |
 avoid (placements the SLO-aware router steered AWAY from this
 replica while it was burning) | inc (incident dumps). A `page` SLO
@@ -33,8 +36,8 @@ import sys
 import time
 
 COLUMNS = ["replica", "up", "brk", "steps", "queue", "res", "pages",
-           "host", "util", "tok/s", "slo", "avoid", "inc"]
-WIDTHS = [12, 6, 6, 7, 5, 7, 11, 5, 6, 8, 5, 5, 4]
+           "host", "warm", "util", "tok/s", "slo", "avoid", "inc"]
+WIDTHS = [12, 6, 6, 7, 5, 7, 11, 5, 8, 6, 8, 5, 5, 4]
 
 
 def _fmt_row(cells):
@@ -44,7 +47,7 @@ def _fmt_row(cells):
 
 def _replica_row(name, e):
     if "error" in e:
-        return _fmt_row([name, "?", "-", "-", "-", "-", "-", "-",
+        return _fmt_row([name, "?", "-", "-", "-", "-", "-", "-", "-",
                          "-", "-", "-", "-", "-"]) + f"  ({e['error']})"
     up = ("drain" if e.get("draining")
           else "DEAD" if e.get("dead")
@@ -53,12 +56,19 @@ def _replica_row(name, e):
     util = (e.get("achieved_util") or {}).get("mean")
     tps = e.get("tokens_per_sec")
     slo = (e.get("slo") or {}).get("worst", "-")
+    prefix = e.get("prefix")
+    if prefix is None:
+        warm = "-"
+    else:
+        hr = prefix.get("hit_rate")
+        warm = (f"{prefix.get('tree_pages', 0)}p/"
+                + ("-" if hr is None else f"{hr:.2f}"))
     return _fmt_row([
         name, up, e.get("breaker", "-"), e.get("steps", "-"),
         e.get("queue_depth", "-"),
         f"{e.get('residents', '-')}/{e.get('num_slots', '-')}",
         f"{pool.get('pages_used', '-')}/{pool.get('pages_total', '-')}",
-        e.get("host_pages_used", "-"),
+        e.get("host_pages_used", "-"), warm,
         "-" if util is None else f"{util:.2f}",
         "-" if tps is None else f"{tps:.1f}",
         slo, e.get("placement_avoided", "-"),
@@ -83,13 +93,18 @@ def render_fleet(snapshot: dict) -> str:
     else:
         fleet = f"{n_replicas} replicas"
         cp_bits = ""
+    fab = router.get("fabric")
+    fab_bits = ("" if not fab else
+                f"fabric[handoffs={fab.get('handoffs_total', 0)} "
+                f"pages={fab.get('pages_moved_total', 0)} "
+                f"fail={fab.get('transfer_failures_total', 0)}] ")
     lines = [
         f"== fleet: {fleet}, "
         f"ready={router.get('ready')} "
         f"retries={router.get('retries_total', 0)} "
         f"migrations={router.get('migrations_total', 0)} "
         f"watchdog_kills={router.get('watchdog_kills_total', 0)} "
-        f"{cp_bits}"
+        f"{cp_bits}{fab_bits}"
         f"slo_worst={snapshot.get('slo_worst', '-')} ==",
         _fmt_row(COLUMNS)]
     replicas = snapshot.get("replicas") or {}

@@ -1,0 +1,122 @@
+"""Tier-1 wall-clock budget check over a pytest log.
+
+The tier-1 gate runs `pytest tests/ -q -m 'not slow'` under a hard
+`timeout 870` — a suite that creeps past it is killed mid-run and
+every test after the cut silently stops counting. This script makes
+the creep VISIBLE before it kills a run: point it at a tier-1 log
+(`/tmp/_t1.log`, the `tee` target in ROADMAP.md's verify line) and it
+
+- reads the pytest trailer (`... in 806.42s`) as the measured suite
+  time, failing (exit 1) when it exceeds the budget (default 840s —
+  30s of headroom under the 870s kill);
+- aggregates any `--durations=N` lines (`12.34s call
+  tests/test_x.py::test_y`) into per-FILE totals and prints the top
+  offenders, so "which lane do I trim" has an answer;
+- with `--new-lane S` adds a projected new test lane on top of the
+  measured time (the pre-merge question: "does my PR's lane still
+  fit?").
+
+    python scripts/t1_budget.py /tmp/_t1.log
+    python scripts/t1_budget.py /tmp/_t1.log --budget 840 --top 10
+    python scripts/t1_budget.py /tmp/_t1.log --new-lane 25
+
+Exit codes: 0 within budget, 1 over budget, 2 unparseable log.
+Pure text parsing — safe to run anywhere, wired into tier-1 itself
+as a fast unit lane (tests/test_t1_budget.py) over synthetic logs.
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# `== 123 passed, 4 failed, 1 skipped in 806.42s (0:13:26) ==` and the
+# bare `no tests ran in 0.01s` both end with "in <seconds>s"
+TRAILER_RE = re.compile(
+    r"\bin (\d+(?:\.\d+)?)s(?: \(\d+:\d+:\d+\))?\s*=*\s*$")
+# `12.34s call     tests/test_x.py::TestY::test_z` (--durations=N)
+DURATION_RE = re.compile(
+    r"^\s*(\d+(?:\.\d+)?)s\s+(call|setup|teardown)\s+"
+    r"([^\s:]+)::(\S+)")
+
+
+def parse_log(text: str) -> Tuple[Optional[float], Dict[str, float]]:
+    """-> (trailer seconds or None, per-file duration totals)."""
+    total: Optional[float] = None
+    per_file: Dict[str, float] = {}
+    for line in text.splitlines():
+        m = DURATION_RE.match(line)
+        if m:
+            secs, _phase, path = float(m[1]), m[2], m[3]
+            per_file[path] = per_file.get(path, 0.0) + secs
+            continue
+        m = TRAILER_RE.search(line)
+        if m:
+            total = float(m[1])     # last trailer wins (reruns)
+    return total, per_file
+
+
+def top_offenders(per_file: Dict[str, float], n: int
+                  ) -> List[Tuple[str, float]]:
+    return sorted(per_file.items(), key=lambda kv: -kv[1])[:n]
+
+
+def check_budget(text: str, budget: float, new_lane: float = 0.0,
+                 top: int = 8) -> Tuple[int, str]:
+    """-> (exit code, human report)."""
+    total, per_file = parse_log(text)
+    lines: List[str] = []
+    if total is None:
+        return 2, ("t1_budget: no pytest trailer ('in <N>s') found — "
+                   "is this a tier-1 log?")
+    projected = total + new_lane
+    verdict = "OK" if projected <= budget else "OVER BUDGET"
+    lines.append(
+        f"t1_budget: measured {total:.1f}s"
+        + (f" + new lane {new_lane:.1f}s = {projected:.1f}s"
+           if new_lane else "")
+        + f" vs budget {budget:.0f}s -> {verdict}"
+        + (f" ({budget - projected:+.1f}s headroom)"))
+    if per_file:
+        lines.append(f"  slowest files (of {len(per_file)} timed):")
+        for path, secs in top_offenders(per_file, top):
+            lines.append(f"    {secs:8.1f}s  {path}")
+        accounted = sum(per_file.values())
+        lines.append(f"  durations account for {accounted:.1f}s "
+                     f"({100.0 * accounted / max(total, 1e-9):.0f}% "
+                     "of the trailer; run with --durations=0 for "
+                     "full attribution)")
+    return (0 if projected <= budget else 1), "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fail when the tier-1 suite outgrows its "
+        "wall-clock budget")
+    ap.add_argument("log", help="tier-1 pytest log (the verify "
+                    "line tees /tmp/_t1.log)")
+    ap.add_argument("--budget", type=float, default=840.0,
+                    metavar="S", help="suite budget in seconds "
+                    "(default 840 = 870s kill minus headroom)")
+    ap.add_argument("--new-lane", type=float, default=0.0,
+                    metavar="S", help="projected seconds a new test "
+                    "lane adds on top of the measured time")
+    ap.add_argument("--top", type=int, default=8, metavar="N",
+                    help="slowest files to list (default 8)")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.log) as f:
+            text = f.read()
+    except OSError as exc:
+        print(f"t1_budget: cannot read {args.log}: {exc}",
+              file=sys.stderr)
+        return 2
+    code, report = check_budget(text, args.budget, args.new_lane,
+                                args.top)
+    print(report)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

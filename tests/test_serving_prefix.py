@@ -16,9 +16,10 @@ import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.nlp import GPTConfig, GPTForCausalLM
-from paddle_tpu.serving import (PagePool, RadixPrefixCache,
+from paddle_tpu.serving import (HostPagePool, PagePool,
+                                RadixPrefixCache,
                                 RequestState, SamplingParams,
-                                ServingEngine,
+                                ServingEngine, chunk_bucket,
                                 resolve_prefix_cache_flag)
 
 _MODELS = {}
@@ -122,6 +123,87 @@ class TestPagePoolInvariants:
         assert pool.alloc(4) is None     # only 3 allocatable
         assert pool.free_pages == 3
         assert pool.alloc(3) is not None
+
+
+class TestChunkBucket:
+    """Satellite: prefill-chunk bucketing boundaries — the compiled-
+    program-count bound depends on the min-chunk clamp being exact."""
+
+    def test_large_remainder_is_full_chunk(self):
+        assert chunk_bucket(100, 32) == 32
+        assert chunk_bucket(32, 32) == 32      # exact boundary
+
+    def test_tail_rounds_to_power_of_two_bucket(self):
+        assert chunk_bucket(9, 32) == 16
+        assert chunk_bucket(16, 32) == 16      # exact bucket fit
+        assert chunk_bucket(17, 32) == 32      # next bucket == chunk
+
+    def test_min_chunk_boundary(self):
+        """Everything at or below min_chunk clamps UP to min_chunk —
+        including remaining == 1 and remaining == min_chunk exactly —
+        and one past it doubles."""
+        assert chunk_bucket(1, 32) == 8
+        assert chunk_bucket(8, 32) == 8
+        assert chunk_bucket(9, 32, min_chunk=8) == 16
+        assert chunk_bucket(3, 32, min_chunk=4) == 4
+        assert chunk_bucket(5, 32, min_chunk=4) == 8
+
+    def test_min_chunk_never_exceeds_chunk_len(self):
+        """A min_chunk above chunk_len clamps DOWN: the bucket set
+        must stay inside [min_chunk, chunk_len]."""
+        assert chunk_bucket(3, 8, min_chunk=16) == 8
+        assert chunk_bucket(7, 8, min_chunk=8) == 8
+
+    def test_bucket_set_is_logarithmic(self):
+        """Distinct values over every prompt length: {chunk_len} ∪
+        {min_chunk * 2**i} — the O(log chunk_len) program bound."""
+        got = {chunk_bucket(r, 32) for r in range(1, 200)}
+        assert got == {8, 16, 32}
+
+    def test_zero_remaining_raises(self):
+        with pytest.raises(ValueError, match="remaining"):
+            chunk_bucket(0, 32)
+
+
+class TestHostPagePool:
+    """Satellite: host-RAM tier slot invariants at the edges."""
+
+    def test_store_until_full_then_none(self):
+        host = HostPagePool(2)
+        a, b = host.store("pay-a"), host.store("pay-b")
+        assert a is not None and b is not None and a != b
+        assert host.store("pay-c") is None     # full: no side effects
+        assert host.used_pages == 2 and host.free_pages == 0
+
+    def test_slot_reuse_after_free(self):
+        host = HostPagePool(1)
+        slot = host.store("x")
+        host.free(slot)
+        assert host.free_pages == 1
+        slot2 = host.store("y")
+        assert host.load(slot2) == "y"         # reused slot, new data
+
+    def test_load_dead_slot_raises(self):
+        host = HostPagePool(2)
+        slot = host.store("x")
+        host.free(slot)
+        with pytest.raises(ValueError, match="dead host page"):
+            host.load(slot)
+        with pytest.raises(ValueError, match="dead host page"):
+            host.load(99)                      # never stored
+
+    def test_double_free_raises(self):
+        host = HostPagePool(2)
+        slot = host.store("x")
+        host.free(slot)
+        with pytest.raises(ValueError, match="double free"):
+            host.free(slot)
+
+    def test_zero_capacity_tier(self):
+        host = HostPagePool(0)
+        assert host.store("x") is None         # spill path degrades
+        with pytest.raises(ValueError):
+            HostPagePool(-1)
 
 
 class TestRadixTreeUnit:
@@ -239,6 +321,32 @@ class TestRadixTreeUnit:
         assert grant is None
         assert pool.cached_pages == 2             # match re-parked
         assert all(pool.refcount(p) == 0 for p in shared)
+        pool.assert_quiesced()
+
+    def test_restore_of_dropped_host_page_degrades_to_prefill(self):
+        """Satellite: a spilled node whose host payload was dropped
+        behind the cache's back. The acquire walk stops at the failed
+        restore and the tail prefills — a shorter hit, never a stale
+        or torn page."""
+        pool, cache = self.make()
+        host = HostPagePool(4)
+        alive = {"load": True}
+        cache.set_host_tier(
+            store=lambda page: host.store(("kv", page)),
+            load=lambda slot: (pool.alloc(1) or [None])[0]
+            if alive["load"] else None,
+            drop=host.free)
+        seq = np.arange(100, 112)                 # 3 full pages
+        self.insert_seq(pool, cache, seq)
+        assert cache.spill(1) == 1                # LRU = root page
+        assert cache.stats()["spilled_nodes"] == 1
+        alive["load"] = False                     # tier lost the page
+        prompt = np.concatenate([seq, [1, 2]])
+        grant = cache.acquire(prompt, max_new_tokens=2)
+        # the ROOT page was the spilled one: restore fails at depth 0
+        assert grant.cached_len == 0
+        assert cache.stats()["spilled_nodes"] == 1  # still marked
+        cache.release(grant.pages)
         pool.assert_quiesced()
 
 

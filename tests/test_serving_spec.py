@@ -576,7 +576,7 @@ def test_serving_bench_spec_ab_smoke(tmp_path, monkeypatch):
     with accepted-tokens-per-step > 1.0 and no tokens/s regression."""
     report = _run_bench(tmp_path, monkeypatch,
                         ["--smoke", "--requests", "4", "--spec-ab"])
-    assert report["schema_version"] == 15
+    assert report["schema_version"] == 16
     sp = report["spec"]
     assert set(sp) >= {"on", "off", "accepted_tokens_per_step",
                        "tokens_per_sec_ratio", "token_identical"}
@@ -609,5 +609,5 @@ def test_bench_default_run_has_no_spec_section(tmp_path, monkeypatch):
     keeps the key optional), and the default path still completes."""
     report = _run_bench(tmp_path, monkeypatch,
                         ["--smoke", "--requests", "3"])
-    assert report["schema_version"] == 15
+    assert report["schema_version"] == 16
     assert "spec" not in report

@@ -1,0 +1,82 @@
+"""scripts/t1_budget.py: tier-1 wall-clock budget check.
+
+Satellite: the tier-1 gate dies at a hard `timeout 870`; this lane
+pins the parser + verdict logic that warns BEFORE the kill — trailer
+parsing, per-file duration attribution, the budget/new-lane math and
+the exit-code contract — on synthetic logs (never the live suite:
+the check must stay milliseconds)."""
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                       / "scripts"))
+import t1_budget  # noqa: E402
+
+
+GOOD_LOG = """\
+........................................................................ [ 12%]
+..s.....F............................................................... [ 25%]
+======================== slowest durations ========================
+102.51s call     tests/test_serving.py::TestE2E::test_streaming
+41.20s call     tests/test_serving.py::TestE2E::test_migration
+12.00s setup    tests/test_nlp_models.py::test_gpt_forward
+0.30s teardown tests/test_nlp_models.py::test_gpt_forward
+= 1 failed, 1390 passed, 8 skipped in 806.42s (0:13:26) =
+"""
+
+
+class TestParse:
+    def test_trailer_and_durations(self):
+        total, per_file = t1_budget.parse_log(GOOD_LOG)
+        assert total == 806.42
+        assert per_file["tests/test_serving.py"] == \
+            pytest.approx(143.71)
+        assert per_file["tests/test_nlp_models.py"] == \
+            pytest.approx(12.30)
+
+    def test_last_trailer_wins(self):
+        text = "= 3 passed in 10.00s =\n= 3 passed in 12.50s =\n"
+        total, _ = t1_budget.parse_log(text)
+        assert total == 12.50
+
+    def test_progress_lines_never_parse_as_durations(self):
+        _, per_file = t1_budget.parse_log(
+            "...................... [ 93%]\nno tests ran in 0.01s\n")
+        assert per_file == {}
+
+    def test_no_trailer_is_unparseable(self):
+        code, report = t1_budget.check_budget("garbage\n", 840.0)
+        assert code == 2 and "no pytest trailer" in report
+
+
+class TestVerdict:
+    def test_within_budget_passes(self):
+        code, report = t1_budget.check_budget(GOOD_LOG, 840.0)
+        assert code == 0
+        assert "OK" in report and "806.4s" in report
+        # offenders ranked worst-first
+        assert report.index("test_serving.py") < \
+            report.index("test_nlp_models.py")
+
+    def test_over_budget_fails(self):
+        code, report = t1_budget.check_budget(GOOD_LOG, 800.0)
+        assert code == 1 and "OVER BUDGET" in report
+
+    def test_new_lane_projection_tips_the_verdict(self):
+        code, _ = t1_budget.check_budget(GOOD_LOG, 840.0,
+                                         new_lane=30.0)
+        assert code == 0                       # 836.4 still fits
+        code, report = t1_budget.check_budget(GOOD_LOG, 840.0,
+                                              new_lane=40.0)
+        assert code == 1 and "846.4s" in report
+
+    def test_main_exit_codes(self, tmp_path, capsys):
+        log = tmp_path / "t1.log"
+        log.write_text(GOOD_LOG)
+        assert t1_budget.main([str(log)]) == 0
+        assert t1_budget.main([str(log), "--budget", "100"]) == 1
+        assert t1_budget.main([str(tmp_path / "missing.log")]) == 2
+        out = capsys.readouterr()
+        assert "slowest files" in out.out
