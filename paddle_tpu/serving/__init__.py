@@ -149,6 +149,9 @@ from .fabric import (FabricConfig, decode_frame,  # noqa: F401
                      prompt_fingerprints, resolve_fabric)
 from .faults import (FaultInjector, InjectedFault,  # noqa: F401
                      resolve_faults)
+from .grammar import (ChoiceGrammar, GrammarSpec,  # noqa: F401
+                      JsonGrammar, RegexGrammar, TokenGrammar,
+                      resolve_grammar_flag)
 from .metrics import (Histogram, ServingMetrics,  # noqa: F401
                       prometheus_render)
 from .obs import (EngineObs, FlightRecorder,  # noqa: F401
@@ -194,4 +197,6 @@ __all__ = ["AdapterStore", "LoRAWeights", "make_random_lora",
            "parse_controlplane_spec", "resolve_controlplane",
            "slo_placement_rank", "FabricConfig", "resolve_fabric",
            "parse_fabric_spec", "encode_frame", "decode_frame",
-           "frame_header", "prompt_fingerprints"]
+           "frame_header", "prompt_fingerprints",
+           "TokenGrammar", "JsonGrammar", "ChoiceGrammar",
+           "RegexGrammar", "GrammarSpec", "resolve_grammar_flag"]

@@ -198,6 +198,7 @@ from .adapters import (AdapterStore, BASE_ADAPTER,
                        resolve_adapters_flag)
 from .errors import DeadlineExceeded, EngineClosed, PoisonedRequest
 from .fabric import decode_frame, encode_frame, frame_header
+from .grammar import (NEG_BIAS, TokenGrammar, resolve_grammar_flag)
 from .metrics import ServingMetrics
 from .obs import EngineObs, resolve_obs_flag
 from .paging import (HostPagePool, PagePool, TRASH_PAGE, chunk_bucket,
@@ -382,7 +383,8 @@ class ServingEngine:
                  mesh=None, adapters=None,
                  adapter_pages: Optional[int] = None,
                  adapter_ranks: Optional[Sequence[int]] = None,
-                 slo=None, cost_census=None):
+                 slo=None, cost_census=None, grammar=None,
+                 session_ttl_s: float = 30.0):
         if cache_spec is None:
             if not hasattr(model, "_decode_cache_spec"):
                 raise ValueError(
@@ -478,6 +480,31 @@ class ServingEngine:
         # per-request drafters, created at admission for greedy
         # requests and dropped at retirement (request_id -> Drafter)
         self._drafters: Dict[str, Drafter] = {}
+        # grammar-constrained decoding (serving/grammar.py, default
+        # off, gated ServingEngine(grammar=...) / PADDLE_TPU_GRAMMAR):
+        # constrained requests carry a host-side token automaton (the
+        # Drafter lifecycle) whose per-step allow-mask rides as a
+        # [S, V] additive-bias operand next to pos/q_len into the ONE
+        # unified step. The gate is a BUILD-TIME program shape: with
+        # it off, the compiled step carries no bias operand at all and
+        # is byte-identical to a pre-grammar engine (the
+        # bit-token-identity oracle); with it on, unconstrained rows
+        # ride all-zero bias rows, so mixed batches stay one program.
+        self.grammar_on = resolve_grammar_flag(grammar)
+        if self.grammar_on and not self.unified:
+            raise ValueError(
+                "grammar-constrained decoding requires the unified "
+                "ragged step: the mask operand rides the ONE compiled "
+                "program (set unified=True / PADDLE_TPU_UNIFIED_STEP"
+                "=on or turn PADDLE_TPU_GRAMMAR off)")
+        # per-request automatons, request_id -> TokenGrammar (created
+        # at admission, advanced on every committed token, dropped at
+        # retirement; preemption/migration re-creates and replays —
+        # the committed token history IS the banked state)
+        self._grammars: Dict[str, TokenGrammar] = {}
+        # session pinning TTL: how long a finished `session=` request
+        # keeps its radix prefix pages pinned above LRU
+        self.session_ttl_s = float(session_ttl_s)
         # prefix-sharing-aware grouped page walk (default on, gated
         # PADDLE_TPU_GROUPED_ATTN / ServingEngine(grouped=...)): the
         # unified kernel step streams each physically shared page once
@@ -491,6 +518,7 @@ class ServingEngine:
         self.metrics.grouped = self.grouped
         self.metrics.spec = (None if self.spec is None
                              else self.spec.mode)
+        self.metrics.grammar = self.grammar_on
         self._clock = clock
         self._id_counter = itertools.count()
         self._requests: Dict[str, Request] = {}
@@ -649,7 +677,8 @@ class ServingEngine:
         # (default on). Greedy outputs are token-identical either way —
         # only the page ids in the host page tables differ.
         self.prefix_cache = (
-            RadixPrefixCache(self.pool, self.page_size)
+            RadixPrefixCache(self.pool, self.page_size,
+                             clock=self._clock)
             if resolve_prefix_cache_flag(prefix_cache) else None)
         # HOST-RAM page tier (graceful overload degradation + stage 1
         # of the fleet-scale prefix cache): whole-page KV payloads of
@@ -696,6 +725,14 @@ class ServingEngine:
         self._prefill_fns: Dict[int, object] = {}   # chunk bucket -> fn
         self._decode_fn = None
         self._unified_fn = None      # the ONE compiled ragged step
+        # embeddings-lane epilogue (satellite): a pure-READ batched
+        # one-token forward through the model BACKBONE (hidden states,
+        # no LM head) that recomputes each retiring embed row's
+        # last-position hidden state from its already-written KV
+        # pages. Jitted once, lazily; a separate small program like
+        # the COW/swap helpers — the unified step's cache_size-1
+        # probe is untouched.
+        self._embed_fn = None
         # mesh engines: the last unified launch's operand tail, kept
         # so collective_counts() can lower the SAME trace and census
         # its collectives against compiled HLO
@@ -768,7 +805,8 @@ class ServingEngine:
         self._round_stats = {"prefill_tokens": 0, "decode_tokens": 0,
                              "draft_tokens": 0, "accepted_tokens": 0,
                              "reads_saved": 0, "collectives": 0,
-                             "wall_s": 0.0}
+                             "constrained_rows": 0,
+                             "grammar_rejected": 0, "wall_s": 0.0}
         # shutdown latch: flipped by drain()/abort_all(); add_request
         # raises EngineClosed once set
         self._closed = False
@@ -964,10 +1002,22 @@ class ServingEngine:
 
         def ustep(state_vals, ct, pos, last_logits, page_table, tokens,
                   q_len, is_decode, key, temps, top_k, top_p, greedy,
-                  group=None, lora=None):
+                  group=None, lora=None, gsamp=None, gver=None):
             originals = self._swap_state(state_vals)
             try:
-                nxt = _sample_rows(last_logits, key, temps, top_k,
+                # grammar mask (build-time gated operand): an additive
+                # f32 bias [S, V] — 0 allowed, -1e30 forbidden —
+                # applied to the HELD logits right where they feed the
+                # sampling epilogue, so the masked greedy argmax and
+                # the -inf-before-top_p sampled path fall out of the
+                # SAME _sample_rows with zero new ops. The bias never
+                # touches `lg`/`row_last`: held logits stay pure model
+                # output, and the fresh committed-state mask is
+                # re-applied at the NEXT sample site (stale per-path
+                # biases must not bank).
+                samp_in = (last_logits if gsamp is None
+                           else last_logits + gsamp)
+                nxt = _sample_rows(samp_in, key, temps, top_k,
                                    top_p, greedy)
                 nxt = jnp.where(is_decode, nxt, 0).astype(jnp.int32)
                 col0 = (jnp.arange(tokens.shape[1], dtype=jnp.int32)
@@ -999,7 +1049,15 @@ class ServingEngine:
                 # matching that chain (cumprod kills everything after
                 # the first mismatch). Rows without drafts (q_len 1,
                 # prefill, idle) get accept 0 for free.
-                preds = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                # grammar x spec (build-time gated): each verify
+                # column's argmax is masked with the automaton state
+                # REACHED ALONG THE DRAFTED PATH (host-computed walk),
+                # so a grammar-violating draft loses the argmax match
+                # and is rejected by this same fused greedy acceptance
+                # — no second program. Only `preds` sees the bias;
+                # row_last below reads the unbiased lg.
+                lg_v = lg if gver is None else lg + gver
+                preds = jnp.argmax(lg_v, axis=-1).astype(jnp.int32)
                 match = (toks[:, 1:] == preds[:, :-1])
                 dcol = jnp.arange(tokens.shape[1] - 1,
                                   dtype=jnp.int32)[None, :]
@@ -1024,10 +1082,16 @@ class ServingEngine:
         # operand-tail layout (matches _unified_step's args_tail):
         # the 11 base operands, then — each optional, resolved at
         # trace-build time from the engine's gates — the 3 adapter
-        # operands (pool pytree, per-slot page, per-slot scale) and
-        # the 3 grouped-walk operands. Adapter pools/pages and groups
-        # are DATA next to pos/q_len: churn never retraces.
+        # operands (pool pytree, per-slot page, per-slot scale), the
+        # 3 grouped-walk operands, the [S, V] grammar sample bias and
+        # (with spec also on) the [S, W, V] grammar verify bias.
+        # Adapter pools/pages, groups and grammar masks are DATA next
+        # to pos/q_len: churn never retraces, and with the grammar
+        # gate OFF the program carries no bias operand at all —
+        # byte-identical to a pre-grammar engine.
         lora_on, grouped = self.adapters is not None, self.grouped
+        gram_on = self.grammar_on
+        gram_ver = self.grammar_on and self.spec is not None
 
         def call(ct, *args):
             base, rest = args[:11], args[11:]
@@ -1036,10 +1100,83 @@ class ServingEngine:
             if lora_on:
                 lora = (rest[0], rest[1], rest[2])
                 i = 3
-            group = tuple(rest[i:i + 3]) if grouped else None
+            group = None
+            if grouped:
+                group = tuple(rest[i:i + 3])
+                i += 3
+            gsamp = gver = None
+            if gram_on:
+                gsamp = rest[i]
+                i += 1
+            if gram_ver:
+                gver = rest[i]
             return ustep(state_vals, ct, *base, group=group,
-                         lora=lora)
+                         lora=lora, gsamp=gsamp, gver=gver)
         return jax.jit(call)
+
+    def _build_embed(self):
+        """Embeddings-lane epilogue: ONE jitted batched single-token
+        forward through the model BACKBONE (hidden states before the
+        LM head) against the paged KV. An embed row finished its
+        chunked prefill, so positions 0..plen-1 hold committed KV;
+        re-feeding the LAST prompt token at pos plen-1 recomputes
+        exactly the final position's post-norm hidden state — the
+        pooled last-hidden-state — at one token of compute, reusing
+        the pages the prefill already wrote. The returned caches are
+        DISCARDED (this is a pure read: `self._ct` is never
+        reassigned), and non-embed rows ride trash-masked page-table
+        rows, so the fixed [S, 1] shape serves any retiring subset
+        with zero retrace and zero state mutation."""
+        backbone = self._model_backbone()
+        state_vals = self._state_vals
+
+        def estep(state_vals, ct, pos, page_table, tokens):
+            originals = self._swap_state(state_vals)
+            try:
+                caches = _unpack_caches(ct, pos, page_table,
+                                        attn_impl=self.attn_impl,
+                                        out_shard=self._out_shard)
+                h, _ = backbone(Tensor(tokens), caches=caches)
+                return h._value[:, -1, :].astype(jnp.float32)
+            finally:
+                self._restore_state(originals)
+
+        return jax.jit(lambda ct, pos, pt, tokens: estep(
+            state_vals, ct, pos, pt, tokens))
+
+    def _model_backbone(self):
+        """The hidden-state trunk under the causal-LM wrapper (GPT:
+        `.gpt`, Llama: `.llama`); falls back to the wrapper itself
+        for models that already return hidden states."""
+        for attr in ("gpt", "llama", "transformer", "backbone"):
+            core = getattr(self.model, attr, None)
+            if core is not None and callable(core):
+                return core
+        return self.model
+
+    def _embed_rows(self, rows):
+        """Compute pooled last-hidden-state embeddings for retiring
+        embed rows ([(slot, req)]): batched through the one jitted
+        epilogue, results stored on each request before retirement."""
+        if not rows:
+            return
+        if self._embed_fn is None:
+            self._embed_fn = self._build_embed()
+        S = self.num_slots
+        tok = np.zeros((S, 1), np.int32)
+        pos = np.zeros((S,), np.int32)
+        pt = np.full((S, self.max_pages), TRASH_PAGE, np.int32)
+        for slot, req in rows:
+            tok[slot, 0] = int(req.prefill_ids[-1])
+            pos[slot] = int(req.prefill_ids.size) - 1
+            pt[slot] = self._pt_host[slot]
+        with RecordEvent("serving::embed_epilogue"):
+            h = np.asarray(self._embed_fn(
+                self._ct, self._dev(pos), self._dev(pt),
+                self._dev(tok)))
+        for slot, req in rows:
+            req.embedding = h[slot].copy()
+            self._obs_event(req, "embed", hidden=int(h.shape[-1]))
 
     def _build_copy_page(self):
         """ONE compiled single-page pool copy for copy-on-write: src and
@@ -1322,6 +1459,15 @@ class ServingEngine:
             hook()
 
     # -- request intake ----------------------------------------------------
+    @staticmethod
+    def _budget_new(sampling: SamplingParams) -> int:
+        """Generated-token budget a request reserves KV for: embed
+        rows run prefill-only and retire at cursor end, so their page
+        budget covers the prompt alone (max_new_tokens is ignored —
+        the token-budget packing math is unchanged either way)."""
+        return (0 if getattr(sampling, "embed", False)
+                else sampling.max_new_tokens)
+
     def add_request(self, prompt_ids, sampling: Optional[SamplingParams]
                     = None, request_id: Optional[str] = None,
                     on_token=None) -> Request:
@@ -1336,13 +1482,24 @@ class ServingEngine:
             raise ValueError(
                 f"prompt length {prompt.size} >= engine max_len "
                 f"{self.max_len}")
-        if prompt.size + sampling.max_new_tokens > self.max_len:
+        if prompt.size + self._budget_new(sampling) > self.max_len:
             raise ValueError(
                 f"prompt_len {prompt.size} + max_new_tokens "
                 f"{sampling.max_new_tokens} exceeds engine max_len "
                 f"{self.max_len}; lower max_new_tokens or grow the "
                 "engine's cache")
-        need = pages_needed(prompt.size, sampling.max_new_tokens,
+        if getattr(sampling, "grammar", None) is not None \
+                and not self.grammar_on:
+            raise ValueError(
+                "request carries a grammar constraint but this "
+                "engine's grammar gate is off (enable it via "
+                "ServingEngine(grammar=True) / PADDLE_TPU_GRAMMAR=on)")
+        if getattr(sampling, "embed", False) and not self.unified:
+            raise ValueError(
+                "the embeddings lane rides the unified ragged step's "
+                "prefill packing (set unified=True / "
+                "PADDLE_TPU_UNIFIED_STEP=on)")
+        need = pages_needed(prompt.size, self._budget_new(sampling),
                             self.page_size)
         if need > self.num_pages - 1:
             raise ValueError(
@@ -1374,6 +1531,8 @@ class ServingEngine:
         self.metrics.on_submit(req)
         if self.adapters is not None:
             self.metrics.on_adapter_request(aid)
+        if getattr(sampling, "grammar", None) is not None:
+            self.metrics.on_grammar_request()
         self._obs_event(req, "submit", prompt_len=int(prompt.size),
                         priority=int(sampling.priority),
                         queue_depth=self.scheduler.queue_depth)
@@ -1431,6 +1590,7 @@ class ServingEngine:
         id and must keep its duplicate-id guard)."""
         self._prefill_cursor.pop(req.request_id, None)
         self._drafters.pop(req.request_id, None)
+        self._grammars.pop(req.request_id, None)
         span = self._spans.pop(req.request_id, None)
         if span is not None:
             span.end()
@@ -1486,14 +1646,20 @@ class ServingEngine:
         if reason in ("stop", "length"):
             # every emitted token's KV was written by the decode step
             # that sampled it, so prompt + output positions are valid
+            aid = int(getattr(req.sampling, "adapter_id", 0) or 0)
             seq = np.concatenate([
                 req.prompt_ids.astype(np.int64),
                 np.asarray(req.output_tokens, np.int64)])
             self.prefix_cache.insert(
                 seq, pages,
                 req.prompt_ids.size + len(req.output_tokens),
-                adapter_id=int(getattr(req.sampling, "adapter_id", 0)
-                               or 0))
+                adapter_id=aid)
+            # session pinning: a `session=` request's inserted nodes
+            # get a TTL tier above LRU — the conversation's next turn
+            # hits warm KV by contract, not by eviction luck
+            if getattr(req.sampling, "session", None):
+                self.prefix_cache.pin(seq, self.session_ttl_s,
+                                      adapter_id=aid)
         else:
             self.prefix_cache.release(pages)
 
@@ -1561,15 +1727,15 @@ class ServingEngine:
         aid = int(getattr(req.sampling, "adapter_id", 0) or 0)
         if self.prefix_cache is None:
             pages = self.pool.alloc(pages_needed(
-                req.prompt_ids.size, req.sampling.max_new_tokens,
+                req.prompt_ids.size, self._budget_new(req.sampling),
                 self.page_size))
             if pages is None:
                 return False
             req.pages = pages
             return True
-        grant = self.prefix_cache.acquire(req.prompt_ids,
-                                          req.sampling.max_new_tokens,
-                                          adapter_id=aid)
+        grant = self.prefix_cache.acquire(
+            req.prompt_ids, self._budget_new(req.sampling),
+            adapter_id=aid)
         if grant is None:
             return False
         req.pages = grant.pages
@@ -1589,7 +1755,8 @@ class ServingEngine:
         waiting."""
         swap = req._swap
         seq = req.prefill_ids
-        remaining = req.sampling.max_new_tokens - len(req.output_tokens)
+        remaining = (self._budget_new(req.sampling)
+                     - len(req.output_tokens))
         ps = self.page_size
         if self.prefix_cache is not None:
             grant = self.prefix_cache.acquire(
@@ -1818,6 +1985,27 @@ class ServingEngine:
             if self.spec is not None and req.sampling.greedy:
                 self._drafters[req.request_id] = \
                     self.spec.make_drafter()
+            # grammar automaton: one per constrained request, the
+            # drafter lifecycle — nothing device-side banks grammar
+            # state. Re-seeding replays the committed OUTPUT history:
+            # after preemption that is req.output_tokens; after a
+            # mid-stream migration the banked output arrived as the
+            # tail of the new PROMPT, which sampling.grammar_prefix
+            # counts (the router bumps it at re-placement).
+            if self.grammar_on and \
+                    getattr(req.sampling, "grammar", None) is not None:
+                self._ensure_last_logits(req)
+                g = req.sampling.grammar.make(
+                    int(self._last_logits.shape[-1]))
+                eos = req.sampling.eos_token_id
+                k = int(getattr(req.sampling, "grammar_prefix", 0)
+                        or 0)
+                replay = list(req.prompt_ids[-k:]) if k else []
+                replay.extend(req.output_tokens)
+                for t in replay:
+                    if eos is None or int(t) != eos:
+                        g.advance(int(t))
+                self._grammars[req.request_id] = g
             self.metrics.on_admit(req, self._clock())
 
     def _ensure_last_logits(self, req: Request):
@@ -2000,6 +2188,32 @@ class ServingEngine:
                         ll[s] = old[s]
                     self._last_logits = jnp.asarray(ll)
 
+    @staticmethod
+    def _grammar_bias(g, left, eos, V) -> np.ndarray:
+        """One [V] row of the additive-bias grammar operand from an
+        automaton state: 0.0 where the grammar allows the token,
+        NEG_BIAS where it forbids it. Budget-aware — with only `left`
+        emission slots remaining, one is reserved for EOS, so tokens
+        are restricted to those from which an accepting state is still
+        reachable within left-1 (the automaton degrades to its
+        unrestricted allow-set if acceptance is unreachable: a
+        "length"-truncated stream beats steering into a dead end). EOS
+        composes in here: allowed iff the automaton accepts now, and
+        FORCED (the only allowed token) when the grammar allows
+        nothing else — a structurally complete, token-exhausted state
+        must terminate rather than emit arbitrary tokens."""
+        allow = g.budget_allowed(max(0, left - 1))
+        bias = np.where(allow, np.float32(0.0),
+                        np.float32(NEG_BIAS)).astype(np.float32)
+        if eos is not None and 0 <= eos < V:
+            bias[eos] = 0.0 if g.accepting() else NEG_BIAS
+        if not (bias == 0.0).any():
+            if eos is not None and 0 <= eos < V:
+                bias[eos] = 0.0
+            else:           # unreachable: SamplingParams requires EOS
+                bias[:] = 0.0
+        return bias
+
     def _propose_drafts(self, running, suppress) -> Dict[int, np.ndarray]:
         """Host-side drafting (speculative decoding): ask each greedy
         DECODE slot's drafter for up to k next tokens over the
@@ -2139,12 +2353,85 @@ class ServingEngine:
             adapter_args = (self.adapters.pools,
                             self._dev(self._apage),
                             self._dev(self._ascale))
+        grammar_args = ()
+        if self.grammar_on:
+            # per-slot grammar bias operands — DATA, not shape: every
+            # row always carries a [V] additive-bias row (all-zero for
+            # unconstrained rows), and with spec on every verify
+            # column carries one too, so mixed batches stay ONE
+            # compiled program
+            V = int(self._last_logits.shape[-1])
+            gsamp = np.zeros((self.num_slots, V), np.float32)
+            gver = (np.zeros((self.num_slots, W, V), np.float32)
+                    if self.spec is not None else None)
+            ll_host = None
+            n_con = n_rej = 0
+            for slot in decode_slots:
+                req = running.get(slot)
+                if req is None:
+                    continue
+                g = self._grammars.get(req.request_id)
+                if g is None:
+                    continue
+                sp = req.sampling
+                eos = sp.eos_token_id
+                left = sp.max_new_tokens - len(req.output_tokens)
+                bias0 = self._grammar_bias(g, left, eos, V)
+                gsamp[slot] = bias0
+                n_con += 1
+                m = draft_grants.get(slot, 0)
+                if m:
+                    # walk a FORK down the drafted path [t0, p0, p1,
+                    # ...] and give each verify column the bias of the
+                    # state it verifies FROM. t0 is recomputed on the
+                    # host as the masked argmax over the held logits —
+                    # bit-exact with the device's greedy pick (same
+                    # f32 elementwise add, same first-occurrence
+                    # tie-break), and drafts only exist on greedy rows
+                    if ll_host is None:
+                        ll_host = np.asarray(self._last_logits)
+                    t0 = int(np.argmax(ll_host[slot] + bias0))
+                    walk = g.fork()
+                    alive = eos is None or t0 != eos
+                    if alive:
+                        walk.advance(t0)
+                    props = proposals[slot]
+                    for j in range(m):
+                        if not alive:
+                            # dead path (EOS or a violating draft
+                            # upstream): the acceptance cumprod
+                            # already kills these columns — leave
+                            # them unconstrained
+                            break
+                        bias_j = self._grammar_bias(
+                            walk, left - 1 - j, eos, V)
+                        gver[slot, j] = bias_j
+                        p = int(props[j])
+                        if eos is not None and p == eos:
+                            alive = False
+                        elif bias_j[p] < 0.0:
+                            # grammar-violating draft: the masked
+                            # argmax in this column cannot equal it,
+                            # so the SAME fused greedy acceptance
+                            # rejects it in-trace
+                            n_rej += 1
+                            alive = False
+                        else:
+                            walk.advance(p)
+            rs = self._round_stats
+            rs["constrained_rows"] += n_con
+            rs["grammar_rejected"] += n_rej
+            if n_con:
+                self.metrics.on_grammar_step(n_con, n_rej)
+            grammar_args = (self._dev(gsamp),)
+            if gver is not None:
+                grammar_args += (self._dev(gver),)
         args_tail = (self._pos, self._last_logits, pt_full,
                      self._dev(tokens), self._dev(q_len),
                      self._dev(is_decode), key,
                      self._dev(self._temps), self._dev(self._topk),
                      self._dev(self._topp), self._dev(self._greedy),
-                     *adapter_args, *group_args)
+                     *adapter_args, *group_args, *grammar_args)
         # kept for collective_counts() AND the cost census: the exact
         # operand pytree (the live self._ct stands in for the pools)
         # the one trace lowers against — [S]-sized arrays, not pools
@@ -2174,7 +2461,10 @@ class ServingEngine:
         now = self._clock()
         # prefill bookkeeping: advance cursors, flip finished rows to
         # DECODE (their last real token's logits are now held — they
-        # sample their first token next step)
+        # sample their first token next step). Embed rows never flip:
+        # at cursor end they take the pooled last-hidden-state through
+        # the embed epilogue and retire on the spot (prefill-only).
+        embed_rows = []
         for slot, take in grants.items():
             req = running[slot]
             cur = self._prefill_cursor[req.request_id] + take
@@ -2184,11 +2474,21 @@ class ServingEngine:
                             cursor=cur)
             if cur >= req.prefill_ids.size:
                 self._prefill_cursor.pop(req.request_id, None)
+                if getattr(req.sampling, "embed", False):
+                    embed_rows.append((slot, req))
+                    continue
                 req.state = RequestState.DECODE
                 self._active[slot] = True
                 self._vec_dirty = True
                 self._pt_dirty = True
                 self._obs_event(req, "decode")
+        if embed_rows:
+            # embedding BEFORE retirement: the epilogue reads the
+            # row's still-attached pages; _finish_and_free then
+            # routes them through the prefix cache as usual
+            self._embed_rows(embed_rows)
+            for slot, req in embed_rows:
+                self._finish_and_free(req, "stop", now, finished)
         # decode emission: the old decode step's retirement, token by
         # token over the verified burst — EOS or the token budget can
         # end the request mid-burst, and the sequential semantics
@@ -2208,6 +2508,7 @@ class ServingEngine:
             prev_t = req._last_token_t
             emitted, reason = 0, None
             sp = req.sampling
+            gram = self._grammars.get(req.request_id)
             for tok in burst:
                 req._emit(tok, now)
                 emitted += 1
@@ -2216,6 +2517,11 @@ class ServingEngine:
                         and tok == sp.eos_token_id:
                     reason = "stop"
                     break
+                if gram is not None:
+                    # commit the automaton along the emitted burst
+                    # (EOS broke out above — it is terminal, never a
+                    # grammar character)
+                    gram.advance(tok)
                 if len(req.output_tokens) >= sp.max_new_tokens:
                     reason = "length"
                     break
@@ -2329,7 +2635,8 @@ class ServingEngine:
         self._round_stats = {"prefill_tokens": 0, "decode_tokens": 0,
                              "draft_tokens": 0, "accepted_tokens": 0,
                              "reads_saved": 0, "collectives": 0,
-                             "wall_s": 0.0}
+                             "constrained_rows": 0,
+                             "grammar_rejected": 0, "wall_s": 0.0}
         now = self._clock()
         self._evict(now, finished)
         self._admit(now)
@@ -2404,6 +2711,12 @@ class ServingEngine:
                 **({} if self.slo is None
                    else {"slo": self.slo.worst_state()}),
                 "reads_saved": rs["reads_saved"],
+                **({} if not self.grammar_on else {
+                    # per-step constrained-row count (+ drafts the
+                    # host walk flagged as grammar-violating) — the
+                    # flight_dump's structured-output columns
+                    "constrained_rows": rs["constrained_rows"],
+                    "grammar_rejected": rs["grammar_rejected"]}),
                 "pages_used": self.pool.used_pages,
                 "pages_total": self.num_pages - 1,
                 "pages_cached": self.pool.cached_pages,
@@ -2530,6 +2843,7 @@ class ServingEngine:
                        "preempt": self.preempt,
                        "spec": (None if self.spec is None
                                 else self.spec.mode),
+                       "grammar": self.grammar_on,
                        "num_pages": self.num_pages,
                        "page_size": self.page_size,
                        "chunk_len": self.chunk_len,

@@ -1,0 +1,726 @@
+"""Grammar-constrained decoding as operand data through the ONE step.
+
+Structured output (JSON mode, tool-call schemas, enum choices) is the
+workload every tool-calling client needs: the server must GUARANTEE
+that a stream parses under a grammar, not hope the model cooperates.
+The mechanism here is the same "everything is operand data" move that
+let LoRA tenants and speculative drafts share one compiled program:
+each constrained request carries a host-side token-level automaton
+(one instance per request, exactly like `Drafter`), and each engine
+step packs the automaton's current allow-set into a fixed-shape
+per-slot operand that rides next to `pos`/`q_len` into the unified
+program. The compiled step never knows what a grammar is — it adds a
+bias tensor to the logits it was already sampling from.
+
+Mask representation — additive f32 bias, not a packed bitmask
+------------------------------------------------------------
+The per-slot operand is `[num_slots, vocab]` float32: 0.0 where the
+automaton allows a token, -1e30 where it forbids one. The alternative
+— a `[num_slots, ceil(vocab/32)]` uint32 bitmask — is 32x smaller on
+the wire, but must be UNPACKED inside the program (shift/and/select)
+before it can touch the logits. The additive form fuses into the
+existing sampling epilogue with zero new ops: `logits + bias` feeds
+the SAME `argmax` (greedy) and the SAME `_top_p_filter` chain
+(sampled) that unconstrained rows use, and an unconstrained row simply
+rides an all-zeros row of the operand. Model logits are finite and
+tiny compared to 1e30, so a masked argmax always lands on an allowed
+token. At serving vocab sizes the operand is ~128KB/slot/step of
+host->device traffic — the packed bitmask is the production follow-up
+if that ever shows up in a profile, and changes only the packing site
+and one unpack expression, not the architecture.
+
+Token-level lift of character-level machines
+--------------------------------------------
+All built-in grammars are CHARACTER-level machines (a JSON pushdown
+automaton, a literal-set trie, a Thompson-NFA regex subset) lifted to
+the token vocabulary through a `token_strings` table (token id -> the
+text it decodes to). A token is allowed in a state iff feeding its
+characters one-by-one keeps the machine alive — so multi-character
+tokens that span structure (`"},"`) work with no special casing, and
+tokens that decode to nothing are never allowed. Per-state allow-masks
+and per-(state, token) transitions are memoized in tables SHARED
+across `fork()` clones, so the speculative verify walk (which forks
+the automaton down the drafted path) reuses every mask the committed
+path already paid for. Without a real tokenizer in the repo the
+default table maps token id i to `chr(i)` — tests exercise exactly the
+same lift a production tokenizer table would.
+
+Budget-aware closing
+--------------------
+A grammar guarantee is vacuous if the stream can be cut by
+`max_new_tokens` mid-structure. `budget_allowed(left)` restricts the
+allow-set to tokens from which an ACCEPTING state stays reachable
+within the remaining budget (memoized bounded search over the token
+graph): once the budget tightens, an open JSON array is forced toward
+`]` instead of another element. If acceptance is unreachable within
+the budget at all (the caller under-budgeted from the start), the
+unrestricted set is returned — emitting freely and truncating is
+strictly better than dead-ending the stream early.
+
+Constrained requests REQUIRE `eos_token_id`: EOS is the only way to
+terminate a structurally complete stream, and the engine composes it
+in at mask time (EOS allowed iff the automaton accepts — "EOS only in
+accepting states" is the oracle, not a hope).
+"""
+from __future__ import annotations
+
+import os
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "GRAMMAR_ENV", "resolve_grammar_flag", "GrammarSpec", "TokenGrammar",
+    "JsonGrammar", "ChoiceGrammar", "RegexGrammar",
+    "default_token_strings", "NEG_BIAS",
+]
+
+GRAMMAR_ENV = "PADDLE_TPU_GRAMMAR"
+
+# The "minus infinity" the mask adds to forbidden logits. Matches the
+# top-k mask constant in the sampling epilogue: finite, so the softmax
+# math never sees an actual inf/nan, but astronomically below any real
+# model logit.
+NEG_BIAS = -1e30
+
+
+def resolve_grammar_flag(override=None) -> bool:
+    """Whether the engine accepts grammar-constrained requests: an
+    explicit `ServingEngine(grammar=...)` wins; otherwise the
+    PADDLE_TPU_GRAMMAR env var (default off — the grammar-off program
+    is byte-identical to an engine built before this module existed,
+    which is the bit-token-identity oracle)."""
+    if override is not None:
+        if isinstance(override, bool):
+            return override
+        flag = str(override)
+    else:
+        flag = os.environ.get(GRAMMAR_ENV, "off")
+    low = flag.strip().lower()
+    if low in ("on", "1", "true", "yes"):
+        return True
+    if low in ("off", "0", "false", "no"):
+        return False
+    raise ValueError(
+        f"{GRAMMAR_ENV} / grammar must be on|off, got {flag!r}")
+
+
+def default_token_strings(vocab_size: int) -> Tuple[str, ...]:
+    """The identity byte-vocab table: token id i decodes to chr(i).
+    Stands in for a tokenizer's id->text table; the lift is the same."""
+    return tuple(chr(i) for i in range(int(vocab_size)))
+
+
+# ---------------------------------------------------------------------------
+# character-level machines (internal): start() -> state, step(state, ch)
+# -> state | None, accepting(state) -> bool. States are small hashable
+# values so the token lift can memoize per-state tables.
+# ---------------------------------------------------------------------------
+
+_WS = " \t\n\r"
+_DIGITS = "0123456789"
+_HEX = "0123456789abcdefABCDEF"
+# number sub-states in which the digits read so far already form a
+# complete JSON number (a non-number char ends the literal there)
+_NUM_DONE = ("zero", "int", "frac", "exp")
+
+
+class _JsonMachine:
+    """Character-level JSON value machine (RFC 8259 values: object,
+    array, string, number, true/false/null), with the container stack
+    folded into the state tuple. Accepts exactly the strings
+    `json.loads` accepts for the supported escapes (\\uXXXX included),
+    including the leading-zero number rule."""
+
+    def start(self):
+        return ("val", ())
+
+    def accepting(self, state) -> bool:
+        mode = state[0]
+        if mode == "after":
+            return not state[1]
+        if mode == "num":
+            return state[1] in _NUM_DONE and not state[2]
+        return False
+
+    def step(self, state, ch):
+        mode = state[0]
+        if mode in ("val", "elem0"):
+            stack = state[1]
+            if ch in _WS:
+                return state
+            if ch == '"':
+                return ("instr", stack)
+            if ch == "-":
+                return ("num", "sign", stack)
+            if ch == "0":
+                return ("num", "zero", stack)
+            if ch in "123456789":
+                return ("num", "int", stack)
+            if ch == "[":
+                return ("elem0", stack + ("A",))
+            if ch == "{":
+                return ("key0", stack + ("O",))
+            if ch == "t":
+                return ("lit", "rue", stack)
+            if ch == "f":
+                return ("lit", "alse", stack)
+            if ch == "n":
+                return ("lit", "ull", stack)
+            if mode == "elem0" and ch == "]":
+                return ("after", stack[:-1])
+            return None
+        if mode == "lit":
+            rest, stack = state[1], state[2]
+            if ch != rest[0]:
+                return None
+            if len(rest) == 1:
+                return ("after", stack)
+            return ("lit", rest[1:], stack)
+        if mode == "num":
+            sub, stack = state[1], state[2]
+            if sub == "sign":
+                if ch == "0":
+                    return ("num", "zero", stack)
+                if ch in "123456789":
+                    return ("num", "int", stack)
+                return None
+            if sub in ("zero", "int"):
+                if sub == "int" and ch in _DIGITS:
+                    return state
+                if ch == ".":
+                    return ("num", "dot", stack)
+                if ch in "eE":
+                    return ("num", "e", stack)
+            elif sub == "dot":
+                return ("num", "frac", stack) if ch in _DIGITS else None
+            elif sub == "frac":
+                if ch in _DIGITS:
+                    return state
+                if ch in "eE":
+                    return ("num", "e", stack)
+            elif sub == "e":
+                if ch in "+-":
+                    return ("num", "esign", stack)
+                return ("num", "exp", stack) if ch in _DIGITS else None
+            elif sub == "esign":
+                return ("num", "exp", stack) if ch in _DIGITS else None
+            elif sub == "exp":
+                if ch in _DIGITS:
+                    return state
+            if sub in _NUM_DONE:      # the number ended; re-read ch
+                return self.step(("after", stack), ch)
+            return None
+        if mode in ("instr", "inkey"):
+            stack = state[1]
+            if ch == '"':
+                return ("after", stack) if mode == "instr" \
+                    else ("colon", stack)
+            if ch == "\\":
+                return ("esc" if mode == "instr" else "kesc", stack)
+            return state if ord(ch) >= 0x20 else None
+        if mode in ("esc", "kesc"):
+            stack = state[1]
+            back = "instr" if mode == "esc" else "inkey"
+            if ch in '"\\/bfnrt':
+                return (back, stack)
+            if ch == "u":
+                return ("u" if mode == "esc" else "ku", 4, stack)
+            return None
+        if mode in ("u", "ku"):
+            k, stack = state[1], state[2]
+            if ch not in _HEX:
+                return None
+            back = "instr" if mode == "u" else "inkey"
+            return (back, stack) if k == 1 else (mode, k - 1, stack)
+        if mode in ("key", "key0"):
+            stack = state[1]
+            if ch in _WS:
+                return state
+            if ch == '"':
+                return ("inkey", stack)
+            if mode == "key0" and ch == "}":
+                return ("after", stack[:-1])
+            return None
+        if mode == "colon":
+            stack = state[1]
+            if ch in _WS:
+                return state
+            if ch == ":":
+                return ("val", stack)
+            return None
+        if mode == "after":
+            stack = state[1]
+            if ch in _WS:
+                return state
+            if stack:
+                top = stack[-1]
+                if ch == ",":
+                    return (("val", stack) if top == "A"
+                            else ("key", stack))
+                if top == "A" and ch == "]":
+                    return ("after", stack[:-1])
+                if top == "O" and ch == "}":
+                    return ("after", stack[:-1])
+            return None
+        raise AssertionError(f"unknown JSON state {state!r}")
+
+
+class _ChoiceMachine:
+    """Trie over a fixed set of literal strings: the machine for enum/
+    tool-name constraints. State is a trie node index."""
+
+    def __init__(self, choices):
+        # node -> {ch: node}; node -> terminal?
+        self._next: List[Dict[str, int]] = [{}]
+        self._done: List[bool] = [False]
+        for text in choices:
+            node = 0
+            for ch in text:
+                nxt = self._next[node].get(ch)
+                if nxt is None:
+                    nxt = len(self._next)
+                    self._next.append({})
+                    self._done.append(False)
+                    self._next[node][ch] = nxt
+                node = nxt
+            self._done[node] = True
+
+    def start(self):
+        return 0
+
+    def step(self, state, ch):
+        return self._next[state].get(ch)
+
+    def accepting(self, state) -> bool:
+        return self._done[state]
+
+
+class _RegexMachine:
+    """Thompson-NFA over a pragmatic regex subset: literals, `.`,
+    classes `[a-z0-9]` (ranges, leading `^` negation), escapes
+    (`\\d \\w \\s` and literal `\\x`), quantifiers `* + ?`,
+    alternation `|`, groups `( )`. Anchored both ends (the whole
+    stream must match — that is what a structured-output constraint
+    means). State is a frozenset of NFA node ids."""
+
+    def __init__(self, pattern: str):
+        # nodes: ("ch", matcher, nxt) | ("split", a, b) | ("match",)
+        self._nodes: List[tuple] = []
+        start, outs = self._parse_alt(pattern, 0)
+        pos, frag_start = start
+        if pos != len(pattern):
+            raise ValueError(
+                f"regex: unbalanced ')' at {pos} in {pattern!r}")
+        match = self._emit(("match",))
+        for node, slot in outs:
+            self._patch(node, slot, match)
+        self._start = frag_start
+
+    # -- construction ------------------------------------------------------
+    def _emit(self, node) -> int:
+        self._nodes.append(node)
+        return len(self._nodes) - 1
+
+    def _patch(self, node: int, slot: int, target: int):
+        ent = list(self._nodes[node])
+        ent[slot] = target
+        self._nodes[node] = tuple(ent)
+
+    def _parse_alt(self, pat, pos):
+        (pos, start), outs = self._parse_concat(pat, pos)
+        while pos < len(pat) and pat[pos] == "|":
+            (pos, start2), outs2 = self._parse_concat(pat, pos + 1)
+            split = self._emit(("split", start, start2))
+            start = split
+            outs = outs + outs2
+        return (pos, start), outs
+
+    def _parse_concat(self, pat, pos):
+        start = None
+        outs: List[Tuple[int, int]] = []
+        while pos < len(pat) and pat[pos] not in "|)":
+            (pos, s), o = self._parse_repeat(pat, pos)
+            if start is None:
+                start = s
+            else:
+                for node, slot in outs:
+                    self._patch(node, slot, s)
+            outs = o
+        if start is None:              # empty branch: eps fragment
+            split = self._emit(("split", None, None))
+            # both arms point the same way: a pure pass-through
+            return (pos, split), [(split, 1), (split, 2)]
+        return (pos, start), outs
+
+    def _parse_repeat(self, pat, pos):
+        (pos, start), outs = self._parse_atom(pat, pos)
+        while pos < len(pat) and pat[pos] in "*+?":
+            op = pat[pos]
+            pos += 1
+            if op == "*":
+                split = self._emit(("split", start, None))
+                for node, slot in outs:
+                    self._patch(node, slot, split)
+                start, outs = split, [(split, 2)]
+            elif op == "+":
+                split = self._emit(("split", start, None))
+                for node, slot in outs:
+                    self._patch(node, slot, split)
+                outs = [(split, 2)]
+            else:                      # ?
+                split = self._emit(("split", start, None))
+                start, outs = split, outs + [(split, 2)]
+        return (pos, start), outs
+
+    def _parse_atom(self, pat, pos):
+        if pos >= len(pat):
+            raise ValueError(f"regex: dangling operator in {pat!r}")
+        ch = pat[pos]
+        if ch == "(":
+            (pos, start), outs = self._parse_alt(pat, pos + 1)
+            if pos >= len(pat) or pat[pos] != ")":
+                raise ValueError(f"regex: missing ')' in {pat!r}")
+            return (pos + 1, start), outs
+        if ch == "[":
+            matcher, pos = self._parse_class(pat, pos + 1)
+        elif ch == ".":
+            matcher, pos = (lambda c: c not in "\n"), pos + 1
+        elif ch == "\\":
+            matcher, pos = self._escape(pat, pos + 1)
+        elif ch in "*+?|)":
+            raise ValueError(
+                f"regex: unexpected {ch!r} at {pos} in {pat!r}")
+        else:
+            lit = ch
+            matcher, pos = (lambda c, lit=lit: c == lit), pos + 1
+        node = self._emit(("ch", matcher, None))
+        return (pos, node), [(node, 2)]
+
+    def _escape(self, pat, pos):
+        if pos >= len(pat):
+            raise ValueError(f"regex: dangling escape in {pat!r}")
+        ch = pat[pos]
+        table = {
+            "d": lambda c: c.isdigit(),
+            "w": lambda c: c.isalnum() or c == "_",
+            "s": lambda c: c in _WS,
+        }
+        if ch in table:
+            return table[ch], pos + 1
+        return (lambda c, lit=ch: c == lit), pos + 1
+
+    def _parse_class(self, pat, pos):
+        negate = pos < len(pat) and pat[pos] == "^"
+        if negate:
+            pos += 1
+        ranges: List[Tuple[str, str]] = []
+        singles: List = []
+        while pos < len(pat) and pat[pos] != "]":
+            if pat[pos] == "\\":
+                m, pos = self._escape(pat, pos + 1)
+                singles.append(m)
+                continue
+            lo = pat[pos]
+            if pos + 2 < len(pat) and pat[pos + 1] == "-" \
+                    and pat[pos + 2] != "]":
+                ranges.append((lo, pat[pos + 2]))
+                pos += 3
+            else:
+                singles.append(lambda c, lit=lo: c == lit)
+                pos += 1
+        if pos >= len(pat):
+            raise ValueError(f"regex: missing ']' in {pat!r}")
+
+        def matcher(c, ranges=tuple(ranges), singles=tuple(singles),
+                    negate=negate):
+            hit = any(lo <= c <= hi for lo, hi in ranges) or \
+                any(m(c) for m in singles)
+            return hit != negate
+        return matcher, pos + 1
+
+    # -- simulation --------------------------------------------------------
+    def _closure(self, ids) -> frozenset:
+        seen = set()
+        stack = list(ids)
+        while stack:
+            i = stack.pop()
+            if i is None or i in seen:
+                continue
+            seen.add(i)
+            node = self._nodes[i]
+            if node[0] == "split":
+                stack.append(node[1])
+                stack.append(node[2])
+        return frozenset(seen)
+
+    def start(self):
+        return self._closure([self._start])
+
+    def step(self, state, ch):
+        nxt = [node[2] for i in state
+               if (node := self._nodes[i])[0] == "ch" and node[1](ch)]
+        if not nxt:
+            return None
+        out = self._closure(nxt)
+        return out if out else None
+
+    def accepting(self, state) -> bool:
+        return any(self._nodes[i][0] == "match" for i in state)
+
+
+# ---------------------------------------------------------------------------
+# token-level grammars
+# ---------------------------------------------------------------------------
+
+class TokenGrammar(ABC):
+    """Host-side token-level automaton, one instance per constrained
+    request (the `Drafter` lifecycle: created at admission, advanced
+    on every committed token, dropped at retirement, re-created and
+    re-seeded from the emitted history after preemption/migration —
+    nothing device-side ever banks grammar state)."""
+
+    vocab_size: int
+
+    @abstractmethod
+    def allowed(self) -> np.ndarray:
+        """bool[vocab]: tokens the automaton permits next."""
+
+    @abstractmethod
+    def advance(self, token: int) -> None:
+        """Consume one committed token. Raises ValueError on a token
+        the automaton forbids — committed tokens are sampled under
+        this automaton's own mask, so a forbidden token here is a
+        state-banking bug, not a model choice."""
+
+    @abstractmethod
+    def accepting(self) -> bool:
+        """Whether the emitted-so-far stream is complete under the
+        grammar (EOS is legal here and only here)."""
+
+    @abstractmethod
+    def fork(self) -> "TokenGrammar":
+        """An independent copy at the current state, for walking a
+        speculative draft path without disturbing the committed
+        automaton. Memo tables are shared, state is not."""
+
+    def budget_allowed(self, left: int) -> np.ndarray:
+        """`allowed()` restricted to tokens that keep an accepting
+        state reachable within `left - 1` further tokens. Default:
+        no restriction (custom grammars may not support bounded
+        reachability)."""
+        return self.allowed()
+
+
+class CharTokenGrammar(TokenGrammar):
+    """A character-level machine lifted to the token vocabulary.
+
+    Memo tables (per-state allow-mask, per-(state, token) transition,
+    bounded accept-reachability) live in dicts shared across forks:
+    the speculative walk and every later request over the same spec
+    instance reuse work. Masks cost O(vocab * avg_token_len) once per
+    NEW machine state — fine at test scale and for the byte-vocab
+    table; a production tokenizer table would precompute per-state
+    token tries, which changes this class only."""
+
+    def __init__(self, machine, token_strings, _shared=None):
+        self._m = machine
+        self._tok = tuple(token_strings)
+        self.vocab_size = len(self._tok)
+        self._state = machine.start()
+        if _shared is not None:
+            self._masks, self._trans, self._reach = _shared
+        else:
+            self._masks: Dict = {}
+            self._trans: Dict = {}
+            self._reach: Dict = {}
+
+    # -- the char lift -----------------------------------------------------
+    def _tok_step(self, state, token: int):
+        key = (state, token)
+        hit = self._trans.get(key, False)
+        if hit is not False:
+            return hit
+        text = self._tok[token]
+        cur = state if text else None    # empty decode: never allowed
+        for ch in text:
+            cur = self._m.step(cur, ch)
+            if cur is None:
+                break
+        self._trans[key] = cur
+        return cur
+
+    def _mask_for(self, state) -> np.ndarray:
+        mask = self._masks.get(state)
+        if mask is None:
+            mask = np.zeros(self.vocab_size, dtype=bool)
+            for t in range(self.vocab_size):
+                if self._tok_step(state, t) is not None:
+                    mask[t] = True
+            mask.setflags(write=False)
+            self._masks[state] = mask
+        return mask
+
+    def _accept_within(self, state, n: int) -> bool:
+        """Bounded reachability: can `state` reach acceptance in at
+        most `n` tokens? Memoized on (state, n); recursion strictly
+        decreases n, so depth (and table growth) is bounded by the
+        remaining budget."""
+        if self._m.accepting(state):
+            return True
+        if n <= 0:
+            return False
+        key = (state, n)
+        hit = self._reach.get(key)
+        if hit is not None:
+            return hit
+        ok = False
+        for t in np.nonzero(self._mask_for(state))[0]:
+            nxt = self._tok_step(state, int(t))
+            if self._accept_within(nxt, n - 1):
+                ok = True
+                break
+        self._reach[key] = ok
+        return ok
+
+    # -- TokenGrammar ------------------------------------------------------
+    def allowed(self) -> np.ndarray:
+        return self._mask_for(self._state)
+
+    def advance(self, token: int) -> None:
+        nxt = self._tok_step(self._state, int(token))
+        if nxt is None:
+            raise ValueError(
+                f"grammar: token {int(token)} "
+                f"({self._tok[int(token)]!r}) is not allowed in the "
+                "current state — committed-state desync")
+        self._state = nxt
+
+    def accepting(self) -> bool:
+        return self._m.accepting(self._state)
+
+    def fork(self) -> "CharTokenGrammar":
+        dup = CharTokenGrammar.__new__(type(self))
+        CharTokenGrammar.__init__(
+            dup, self._m, self._tok,
+            _shared=(self._masks, self._trans, self._reach))
+        dup._state = self._state
+        return dup
+
+    def budget_allowed(self, left: int) -> np.ndarray:
+        base = self._mask_for(self._state)
+        if left is None:
+            return base
+        left = int(left)
+        if not self._accept_within(self._state, left):
+            # under-budgeted from the start: restricting would
+            # dead-end the stream NOW; emit freely instead (the
+            # request truncates by length like any other)
+            return base
+        out = base.copy()
+        for t in np.nonzero(base)[0]:
+            if not self._accept_within(self._tok_step(
+                    self._state, int(t)), left - 1):
+                out[t] = False
+        if out.any() or self.accepting():
+            # an empty set at an accepting state is meaningful: the
+            # engine's EOS composition forces termination
+            out.setflags(write=False)
+            return out
+        return base
+
+
+class JsonGrammar(CharTokenGrammar):
+    """JSON mode: any RFC 8259 value (object/array/string/number/
+    true/false/null), container nesting tracked on a stack."""
+
+    def __init__(self, token_strings):
+        super().__init__(_JsonMachine(), token_strings)
+
+
+class ChoiceGrammar(CharTokenGrammar):
+    """The stream must be exactly one of a fixed set of literal
+    strings (enum constraints, tool-name selection)."""
+
+    def __init__(self, choices, token_strings):
+        choices = tuple(str(c) for c in choices)
+        if not choices or any(not c for c in choices):
+            raise ValueError(
+                "grammar: choice requires non-empty choices")
+        super().__init__(_ChoiceMachine(choices), token_strings)
+
+
+class RegexGrammar(CharTokenGrammar):
+    """The stream must fully match a regex over the supported subset
+    (see `_RegexMachine`)."""
+
+    def __init__(self, pattern, token_strings):
+        super().__init__(_RegexMachine(str(pattern)), token_strings)
+
+
+_KINDS = ("json_object", "choice", "regex")
+
+
+@dataclass(frozen=True)
+class GrammarSpec:
+    """Declarative grammar constraint carried on `SamplingParams`
+    (the `SpecConfig` pattern: the request carries the SPEC, the
+    engine materializes the per-request automaton at admission).
+    `token_strings` overrides the id->text table; None means the
+    byte-vocab identity over the engine's vocab size."""
+
+    kind: str
+    choices: Optional[Tuple[str, ...]] = None
+    pattern: Optional[str] = None
+    token_strings: Optional[Tuple[str, ...]] = None
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(
+                f"grammar kind must be one of {_KINDS}, "
+                f"got {self.kind!r}")
+        if self.kind == "choice":
+            if not self.choices:
+                raise ValueError(
+                    "grammar kind 'choice' requires choices")
+            object.__setattr__(self, "choices",
+                               tuple(str(c) for c in self.choices))
+        if self.kind == "regex" and not self.pattern:
+            raise ValueError("grammar kind 'regex' requires pattern")
+
+    def make(self, vocab_size: int) -> TokenGrammar:
+        """Materialize a fresh automaton at its start state."""
+        toks = self.token_strings
+        if toks is None:
+            toks = default_token_strings(vocab_size)
+        elif len(toks) != int(vocab_size):
+            raise ValueError(
+                f"grammar token_strings has {len(toks)} entries for "
+                f"vocab {vocab_size}")
+        if self.kind == "json_object":
+            return JsonGrammar(toks)
+        if self.kind == "choice":
+            return ChoiceGrammar(self.choices, toks)
+        return RegexGrammar(self.pattern, toks)
+
+    def validates(self, text: str) -> bool:
+        """Host-side full-string check (bench/test oracle): does
+        `text` parse under this grammar?"""
+        if self.kind == "json_object":
+            import json
+            try:
+                json.loads(text)
+                return True
+            except (ValueError, TypeError):
+                return False
+        if self.kind == "choice":
+            return text in self.choices
+        m = _RegexMachine(self.pattern)
+        state = m.start()
+        for ch in text:
+            state = m.step(state, ch)
+            if state is None:
+                return False
+        return m.accepting(state)

@@ -51,11 +51,13 @@ import numpy as np
 
 from ..errors import (DeadlineExceeded, EngineClosed, PoisonedRequest,
                       QueueFull, RateLimited)
+from ..grammar import GrammarSpec
 from ..request import RequestOutput, SamplingParams
 from .driver import ReplicaDead
 
 __all__ = ["ProtocolError", "CompletionRequest",
-           "parse_completion_request", "completion_body",
+           "parse_completion_request", "parse_embeddings_request",
+           "completion_body", "embeddings_body",
            "stream_chunk", "stream_final", "sse", "SSE_DONE",
            "error_body", "status_for_error", "status_for_output"]
 
@@ -103,6 +105,43 @@ def _get(payload: dict, key: str, types, default=None):
     return v
 
 
+def _parse_response_format(payload: dict) -> Optional[GrammarSpec]:
+    """OpenAI-style `response_format` -> a `GrammarSpec` for the
+    engine's grammar-constrained decoding. Every malformed shape is a
+    typed 400 with err_type "invalid_grammar" — clients distinguish a
+    bad grammar from a bad request without string-matching."""
+    rf = payload.get("response_format")
+    if rf is None:
+        return None
+    if not isinstance(rf, dict):
+        raise ProtocolError(400, "\"response_format\" must be an "
+                            "object", "invalid_grammar")
+    kind = rf.get("type")
+    if kind == "text":
+        return None
+    if kind not in ("json_object", "choice", "regex"):
+        raise ProtocolError(
+            400, "\"response_format\".type must be one of "
+            "\"text\", \"json_object\", \"choice\", \"regex\"",
+            "invalid_grammar")
+    choices = rf.get("choices")
+    if choices is not None:
+        if (not isinstance(choices, list)
+                or not all(isinstance(c, str) for c in choices)):
+            raise ProtocolError(
+                400, "\"response_format\".choices must be a list of "
+                "strings", "invalid_grammar")
+        choices = tuple(choices)
+    pattern = rf.get("pattern")
+    if pattern is not None and not isinstance(pattern, str):
+        raise ProtocolError(400, "\"response_format\".pattern must be "
+                            "a string", "invalid_grammar")
+    try:
+        return GrammarSpec(kind=kind, choices=choices, pattern=pattern)
+    except ValueError as e:
+        raise ProtocolError(400, str(e), "invalid_grammar")
+
+
 def parse_completion_request(raw: bytes) -> CompletionRequest:
     try:
         payload = json.loads(raw.decode("utf-8"))
@@ -130,6 +169,13 @@ def parse_completion_request(raw: bytes) -> CompletionRequest:
     stream = bool(_get(payload, "stream", bool, False))
     request_id = _get(payload, "request_id", str)
     model = _get(payload, "model", str)
+    session = _get(payload, "session", str)
+    grammar = _parse_response_format(payload)
+    if grammar is not None and eos is None:
+        raise ProtocolError(
+            400, "\"response_format\" requires \"eos_token_id\": a "
+            "constrained stream terminates only via EOS in an "
+            "accepting state", "invalid_grammar")
     if request_id is not None and not _REQUEST_ID_RE.match(request_id):
         raise ProtocolError(
             400, "\"request_id\" must match [A-Za-z0-9_.:-]{1,128}")
@@ -151,13 +197,80 @@ def parse_completion_request(raw: bytes) -> CompletionRequest:
             eos_token_id=eos,
             timeout_s=None if timeout is None else float(timeout),
             priority=int(priority),
-            deadline_s=None if deadline is None else float(deadline))
+            deadline_s=None if deadline is None else float(deadline),
+            grammar=grammar,
+            session=session)
     except ValueError as e:
-        raise ProtocolError(400, str(e))
+        raise ProtocolError(400, str(e),
+                            "invalid_grammar" if grammar is not None
+                            else "invalid_request_error")
     return CompletionRequest(
         prompt_ids=np.asarray(prompt, dtype=np.int64),
         sampling=sampling, stream=stream, request_id=request_id,
         model=model)
+
+
+def parse_embeddings_request(raw: bytes) -> CompletionRequest:
+    """`POST /v1/embeddings`: `{"input": [token ids]}` (OpenAI-shaped;
+    same token-id convention as completions). Rides the completion
+    plumbing as a prefill-only request — `sampling.embed=True`, the
+    engine pools the final hidden state and retires the row at cursor
+    end without ever decoding."""
+    try:
+        payload = json.loads(raw.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ProtocolError(400, f"request body is not JSON: {e}")
+    if not isinstance(payload, dict):
+        raise ProtocolError(400, "request body must be a JSON object")
+    inp = payload.get("input")
+    if isinstance(inp, str):
+        raise ProtocolError(
+            400, "string inputs are not supported: this endpoint "
+            "serves token ids; send \"input\": [int, ...]")
+    if (not isinstance(inp, list) or not inp
+            or not all(isinstance(t, int) for t in inp)):
+        raise ProtocolError(400, "\"input\" must be a non-empty list "
+                            "of token ids")
+    timeout = _get(payload, "timeout", (int, float))
+    if timeout is not None and (timeout <= 0
+                                or not math.isfinite(timeout)):
+        raise ProtocolError(400, "\"timeout\" must be a positive "
+                            "finite number of seconds")
+    request_id = _get(payload, "request_id", str)
+    model = _get(payload, "model", str)
+    session = _get(payload, "session", str)
+    priority = _get(payload, "priority", int, 0)
+    if request_id is not None and not _REQUEST_ID_RE.match(request_id):
+        raise ProtocolError(
+            400, "\"request_id\" must match [A-Za-z0-9_.:-]{1,128}")
+    try:
+        sampling = SamplingParams(
+            max_new_tokens=1, embed=True,
+            timeout_s=None if timeout is None else float(timeout),
+            priority=int(priority), session=session)
+    except ValueError as e:
+        raise ProtocolError(400, str(e))
+    return CompletionRequest(
+        prompt_ids=np.asarray(inp, dtype=np.int64),
+        sampling=sampling, stream=False, request_id=request_id,
+        model=model)
+
+
+def embeddings_body(ticket_id: str, model: str,
+                    out: RequestOutput) -> dict:
+    emb = getattr(out, "embedding", None)
+    vec = [] if emb is None else [float(v) for v in np.asarray(emb)]
+    return {
+        "object": "list",
+        "data": [{"object": "embedding", "index": 0,
+                  "embedding": vec}],
+        "id": ticket_id,
+        "model": model,
+        "usage": {"prompt_tokens": len(out.prompt_token_ids),
+                  "total_tokens": len(out.prompt_token_ids),
+                  "cached_tokens": int(
+                      getattr(out, "cached_tokens", 0) or 0)},
+    }
 
 
 # -- responses -------------------------------------------------------------

@@ -366,7 +366,8 @@ class Ticket:
             ttft_s=self._ttft_s if self._ttft_s is not None
             else out.ttft_s,
             queue_wait_s=out.queue_wait_s,
-            e2e_s=out.e2e_s)
+            e2e_s=out.e2e_s,
+            embedding=out.embedding)
 
     def cancel(self):
         """Client went away: evict the live attempt and reclaim its
@@ -421,8 +422,17 @@ class Ticket:
         prompt = np.concatenate(
             [self._prompt_ids,
              np.asarray(self._history, dtype=self._prompt_ids.dtype)])
-        sampling = dataclasses.replace(self._sampling,
-                                       max_new_tokens=remaining)
+        # grammar continuity: the banked history is PROMPT on the
+        # survivor, but it is grammar OUTPUT — grammar_prefix tells
+        # the new replica's engine to replay those trailing prompt
+        # tokens through a fresh automaton before decoding, so the
+        # constraint resumes mid-structure, token-identically
+        sampling = dataclasses.replace(
+            self._sampling, max_new_tokens=remaining,
+            **({"grammar_prefix": self._sampling.grammar_prefix
+                + len(self._history)}
+               if getattr(self._sampling, "grammar", None) is not None
+               else {}))
         self._retry(prompt, sampling)
         self.migrations += 1
         with self._router._lock:
@@ -467,8 +477,14 @@ class Ticket:
         prompt = np.concatenate(
             [self._prompt_ids,
              np.asarray(self._history, dtype=self._prompt_ids.dtype)])
-        sampling = dataclasses.replace(self._sampling,
-                                       max_new_tokens=remaining)
+        # grammar continuity across the handoff (see _failover): the
+        # banked token is grammar output riding as prompt
+        sampling = dataclasses.replace(
+            self._sampling, max_new_tokens=remaining,
+            **({"grammar_prefix": self._sampling.grammar_prefix
+                + len(self._history)}
+               if getattr(self._sampling, "grammar", None) is not None
+               else {}))
         try:
             driver, request = r._place_on(dst, prompt, sampling,
                                           request_id=self.id)

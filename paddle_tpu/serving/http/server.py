@@ -76,8 +76,9 @@ from ..errors import (EngineClosed, QueueFull, RateLimited,
                       ServingError)
 from ..metrics import prometheus_render
 from ..obs import resolve_debug_flag, timeline_to_chrome
-from .protocol import (ProtocolError, completion_body, error_body,
-                       parse_completion_request, sse, SSE_DONE,
+from .protocol import (ProtocolError, completion_body, embeddings_body,
+                       error_body, parse_completion_request,
+                       parse_embeddings_request, sse, SSE_DONE,
                        status_for_error, status_for_output,
                        stream_chunk, stream_final)
 from .ratelimit import RateLimiter
@@ -284,13 +285,20 @@ class _Handler(BaseHTTPRequestHandler):
                                   "not_found")
 
     def do_POST(self):
-        if self.path != "/v1/completions":
+        if self.path == "/v1/completions":
+            parse = parse_completion_request
+        elif self.path == "/v1/embeddings":
+            # embeddings ride the completion plumbing end to end — a
+            # prefill-only request (sampling.embed=True) through the
+            # same admission, rate limiting and ticketing
+            parse = parse_embeddings_request
+        else:
             self._send_error_json(404, f"no route {self.path!r}",
                                   "not_found")
             return
         try:
             length = int(self.headers.get("Content-Length", 0))
-            creq = parse_completion_request(self.rfile.read(length))
+            creq = parse(self.rfile.read(length))
         except ProtocolError as e:
             self._send_error_json(e.status, str(e), e.err_type)
             return
@@ -346,7 +354,9 @@ class _Handler(BaseHTTPRequestHandler):
         except ServingError as e:
             self._send_error_json(status_for_error(e), str(e))
             return
-        if creq.stream:
+        if self.path == "/v1/embeddings":
+            self._respond_embeddings(ticket, creq.model)
+        elif creq.stream:
             self._respond_stream(ticket, creq.model)
         else:
             self._respond_blocking(ticket, creq.model)
@@ -378,6 +388,30 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send_json(status,
                         completion_body(
+                            ticket.id,
+                            model or self.server.model_name, out))
+
+    def _respond_embeddings(self, ticket, model=None):
+        poll = self.server.poll_interval_s
+        for kind, val in ticket.events(poll_s=poll):
+            if kind in ("idle", "token"):
+                if self._client_disconnected():
+                    ticket.cancel()
+                    return
+            elif kind == "error":
+                self._send_error_json(status_for_error(val), str(val))
+                return
+            elif kind == "done":
+                break
+        out = ticket.output()
+        status = status_for_output(out)
+        if status != 200:
+            self._send_error_json(
+                status, f"embedding request failed: "
+                f"{out.finish_reason}", "server_error")
+            return
+        self._send_json(status,
+                        embeddings_body(
                             ticket.id,
                             model or self.server.model_name, out))
 

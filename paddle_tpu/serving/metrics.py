@@ -249,6 +249,17 @@ class ServingMetrics:
         self.spec: Optional[str] = None
         self.spec_drafted_tokens = 0
         self.spec_accepted_tokens = 0
+        # grammar-constrained decoding (serving/grammar.py): whether
+        # the engine runs the gate (the `grammar` engine_info tag),
+        # requests carrying a grammar, decode rows that rode a
+        # constraining bias, and drafted tokens the host automaton
+        # walk flagged grammar-violating (rejected in-trace by the
+        # same fused greedy acceptance)
+        self.grammar: Optional[bool] = None
+        self.grammar_requests = 0
+        self.grammar_masked_steps = 0
+        self.grammar_masked_rows = 0
+        self.grammar_rejected_drafts = 0
         # off-path counter: engine steps where prefill chunk programs
         # ran ahead of the decode step, stalling every resident decoder
         # (the TTFT spike the unified step exists to kill; stays 0 with
@@ -394,6 +405,21 @@ class ServingMetrics:
         with self._lock:
             lbl = self._adapter_label(adapter_id)
             self._by_adapter[lbl] = self._by_adapter.get(lbl, 0) + 1
+
+    def on_grammar_request(self):
+        """One request submitted with a grammar constraint attached."""
+        with self._lock:
+            self.grammar_requests += 1
+
+    def on_grammar_step(self, rows: int, rejected: int = 0):
+        """One unified step masked `rows` decode rows with a grammar
+        bias; `rejected` drafts were flagged grammar-violating by the
+        host walk this step."""
+        with self._lock:
+            if rows > 0:
+                self.grammar_masked_steps += 1
+            self.grammar_masked_rows += int(rows)
+            self.grammar_rejected_drafts += int(rejected)
 
     def on_admit(self, req, now: float):
         with self._lock:
@@ -656,6 +682,11 @@ class ServingMetrics:
             "spec_accepted_tokens": self.spec_accepted_tokens,
             "spec_tokens_per_step":
                 self.spec_tokens_per_step.snapshot(),
+            "grammar": self.grammar,
+            "grammar_requests": self.grammar_requests,
+            "grammar_masked_steps": self.grammar_masked_steps,
+            "grammar_masked_rows": self.grammar_masked_rows,
+            "grammar_rejected_drafts": self.grammar_rejected_drafts,
             "grouped": self.grouped,
             "page_block_reads_total": self.page_block_reads,
             "shared_page_reads_saved_total":
@@ -817,6 +848,11 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                        ("spec_drafted_total", "counter"),
                        ("spec_accepted_total", "counter"),
                        ("spec_tokens_per_step", "histogram"),
+                       ("grammar_constrained_requests_total",
+                        "counter"),
+                       ("grammar_masked_steps_total", "counter"),
+                       ("grammar_rejected_drafts_total", "counter"),
+                       ("prefix_pinned_pages", "gauge"),
                        ("page_block_reads_total", "counter"),
                        ("shared_page_reads_saved_total", "counter"),
                        ("group_size_per_step", "histogram"),
@@ -858,7 +894,8 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                 "mp": snap.get("mp", 1) or 1,
                 "dp": snap.get("dp", 1) or 1,
                 "adapters": ("on" if snap.get("adapters_enabled")
-                             else "off")})
+                             else "off"),
+                "grammar": ("on" if snap.get("grammar") else "off")})
             + " 1")
         ad = snap.get("adapters")
         if ad is not None:
@@ -905,6 +942,15 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
         if snap.get("spec_tokens_per_step") is not None:
             _hist_lines(f"{namespace}_spec_tokens_per_step",
                         snap["spec_tokens_per_step"], lab, lines)
+        lines.append(f"{namespace}_grammar_constrained_requests_total"
+                     + _fmt_labels(lab)
+                     + f" {snap.get('grammar_requests', 0)}")
+        lines.append(f"{namespace}_grammar_masked_steps_total"
+                     + _fmt_labels(lab)
+                     + f" {snap.get('grammar_masked_steps', 0)}")
+        lines.append(f"{namespace}_grammar_rejected_drafts_total"
+                     + _fmt_labels(lab)
+                     + f" {snap.get('grammar_rejected_drafts', 0)}")
         if snap.get("packed_tokens_per_step") is not None:
             _hist_lines(f"{namespace}_packed_tokens_per_step",
                         snap["packed_tokens_per_step"], lab, lines)
@@ -977,7 +1023,9 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                                  "resident_pages"),
                                 ("prefix_tree_pages", "tree_pages"),
                                 ("prefix_spilled_nodes",
-                                 "spilled_nodes")]:
+                                 "spilled_nodes"),
+                                ("prefix_pinned_pages",
+                                 "pinned_pages")]:
                 lines.append(f"{namespace}_{metric}" + _fmt_labels(lab)
                              + f" {prefix.get(key, 0)}")
             lines.append(f"{namespace}_prefix_hit_rate"

@@ -76,6 +76,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -207,7 +208,7 @@ class _Node:
     `host` slot id until a match restores it."""
 
     __slots__ = ("tokens", "page", "parent", "children", "partials",
-                 "last_used", "host")
+                 "last_used", "host", "pin_until")
 
     def __init__(self, tokens: Optional[np.ndarray], page: Optional[int],
                  parent: Optional["_Node"]):
@@ -218,6 +219,7 @@ class _Node:
         self.partials: List["_Partial"] = []
         self.last_used = 0
         self.host = None              # host-tier slot id when spilled
+        self.pin_until = 0.0          # session-pin TTL deadline (clock)
 
 
 class _Partial:
@@ -269,9 +271,12 @@ class RadixPrefixCache:
     page tables: the engine calls it only between compiled steps.
     """
 
-    def __init__(self, pool: PagePool, page_size: int):
+    def __init__(self, pool: PagePool, page_size: int, clock=None):
         self.pool = pool
         self.page_size = int(page_size)
+        # injectable clock for the session-pin TTL tier (tests drive
+        # expiry deterministically; the engine passes its own clock)
+        self._clock = clock if clock is not None else time.monotonic
         self.root = _Node(None, None, None)
         # TENANT ISOLATION (multi-tenant LoRA serving): the tree is
         # namespaced by adapter id — KV written under adapter i is a
@@ -325,6 +330,20 @@ class RadixPrefixCache:
         self._host_load = load
         self._host_drop = drop
 
+    @property
+    def pinned_pages(self) -> int:
+        """Device-resident tree pages currently under an unexpired
+        session pin (the `prefix_pinned_pages` gauge)."""
+        now = self._clock()
+        count = 0
+        stack = list(self._roots.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if node.page is not None and node.pin_until > now:
+                count += 1
+        return count
+
     def stats(self) -> dict:
         return {
             "lookups": self.lookups,
@@ -336,6 +355,7 @@ class RadixPrefixCache:
             "spilled_pages": self.spilled_pages_total,
             "restored_pages": self.restored_pages_total,
             "spilled_nodes": self._n_spilled,
+            "pinned_pages": self.pinned_pages,
             "tree_pages": self.tree_pages,
             "resident_pages": self.pool.cached_pages,
             "hit_rate": (self.hits / self.lookups) if self.lookups
@@ -588,6 +608,37 @@ class RadixPrefixCache:
                 return False
         return True
 
+    # -- session pinning ---------------------------------------------------
+    def _pinned(self, node: _Node) -> bool:
+        return node.pin_until > self._clock()
+
+    def pin(self, tokens, ttl_s: float, adapter_id: int = 0) -> int:
+        """Session pinning: hold the full-page chain covering `tokens`
+        in a TTL tier between "referenced" and "evictable" — pinned
+        pages are skipped by LRU eviction AND host-tier spill until
+        the deadline passes, so a chat session's turn-2 follow-up hits
+        warm device KV by contract, not by LRU luck. Re-pinning
+        extends the deadline (max, never shortens); an EXPIRED pin
+        needs no sweep — `_pinned` compares against the injectable
+        clock, so the node simply becomes ordinary LRU fodder again.
+        Returns the number of pages pinned."""
+        if ttl_s <= 0:
+            return 0
+        deadline = self._clock() + float(ttl_s)
+        tok = _tok(tokens)
+        ps = self.page_size
+        node = self._root_for(adapter_id)
+        pinned = 0
+        for i in range(tok.size // ps):
+            child = node.children.get(tok[i * ps:(i + 1) * ps].tobytes())
+            if child is None:
+                break
+            child.pin_until = max(child.pin_until, deadline)
+            self._touch(child)
+            pinned += 1
+            node = child
+        return pinned
+
     # -- spill (host tier) -------------------------------------------------
     def spill(self, need: int) -> int:
         """Move up to `need` unreferenced parked FULL pages to the
@@ -605,7 +656,8 @@ class RadixPrefixCache:
             node = stack.pop()
             stack.extend(node.children.values())
             if (node.tokens is not None and node.page is not None
-                    and self.pool.refcount(node.page) == 0):
+                    and self.pool.refcount(node.page) == 0
+                    and not self._pinned(node)):
                 heapq.heappush(heap, (node.last_used, id(node), node))
         spilled = 0
         while spilled < need and heap:
@@ -630,6 +682,8 @@ class RadixPrefixCache:
             return self.pool.refcount(obj.page) == 0
         if obj.children or obj.partials:
             return False
+        if self._pinned(obj):
+            return False      # session-pinned: TTL tier, not LRU
         if obj.page is None:
             return True       # spilled leaf: only a host copy to drop
         return self.pool.refcount(obj.page) == 0
@@ -689,8 +743,14 @@ class RadixPrefixCache:
 
     def clear(self) -> int:
         """Drop every unreferenced cached page — device-resident AND
-        spilled (e.g. tests forcing a cold cache). Referenced nodes
-        survive."""
+        spilled (e.g. tests forcing a cold cache). Session pins do
+        NOT survive a clear (it is the explicit drop-everything
+        escape hatch); referenced nodes do."""
+        stack = list(self._roots.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            node.pin_until = 0.0
         return self.evict(self.tree_pages + self._n_spilled)
 
     # -- fleet fabric (serving/fabric.py) ----------------------------------

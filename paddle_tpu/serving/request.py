@@ -77,6 +77,30 @@ class SamplingParams:
     # attached through migration (the Ticket re-places the same
     # sampling) and preemption-resume for free.
     adapter_id: int = 0
+    # grammar-constrained decoding (serving/grammar.py): the
+    # declarative constraint this request's output must satisfy; the
+    # engine materializes a per-request automaton at admission.
+    # Requires eos_token_id (EOS is how a structurally complete
+    # stream terminates) and an engine built with the grammar gate on.
+    grammar: Optional[object] = None
+    # mid-stream migration support: when the router re-places a
+    # constrained request, the banked emitted tokens become the tail
+    # of the new prompt — this counts how many trailing PROMPT tokens
+    # are grammar-governed output the automaton must replay before
+    # resuming. 0 for every request that never migrated.
+    grammar_prefix: int = 0
+    # embeddings/scoring lane: prefill-only — the request runs its
+    # prompt through chunked prefill exactly like a generation
+    # request (same paging, same token-budget packing), then retires
+    # at cursor end returning the pooled last-hidden-state instead of
+    # decoding. max_new_tokens/eos/etc are ignored.
+    embed: bool = False
+    # session pinning: a stable conversation id. On normal retirement
+    # the request's radix-inserted prefix pages are PINNED for the
+    # engine's session TTL (a tier between "resident" and
+    # "evictable"), so the session's next turn hits warm KV by
+    # contract, not by LRU luck.
+    session: Optional[str] = None
 
     def __post_init__(self):
         if self.max_new_tokens < 1:
@@ -91,6 +115,16 @@ class SamplingParams:
             raise ValueError("top_p must be in (0, 1]")
         if self.top_k is not None or self.top_p is not None:
             self.greedy = False
+        if self.grammar_prefix < 0:
+            raise ValueError("grammar_prefix must be >= 0")
+        if self.grammar is not None:
+            if self.embed:
+                raise ValueError(
+                    "grammar and embed are mutually exclusive")
+            if self.eos_token_id is None:
+                raise ValueError(
+                    "grammar requires eos_token_id — EOS is the only "
+                    "way a structurally complete stream terminates")
 
 
 _FINISH_SENTINEL = object()
@@ -140,6 +174,10 @@ class Request:
         # pool page (released at retirement/preemption)
         self._adapter_binding = (0, 0.0)
         self._adapter_held = False
+        # embeddings lane: the pooled last-hidden-state (float32
+        # [hidden]) set when an embed=True request retires at cursor
+        # end; None for generation requests
+        self.embedding: Optional[np.ndarray] = None
         # preemption swap handle (engine-owned): host-tier slots +
         # coverage of the banked KV while the request waits to resume;
         # None whenever the request is not preempted-with-swapped-KV
@@ -240,6 +278,8 @@ class Request:
             cached_tokens=self.cached_tokens,
             accepted_draft_tokens=self.accepted_draft_tokens,
             preemptions=self.preemptions,
+            embedding=(None if self.embedding is None
+                       else np.asarray(self.embedding)),
             ttft_s=(None if self.first_token_t is None
                     else self.first_token_t - self.arrival_t),
             queue_wait_s=(None if self.admitted_t is None
@@ -279,3 +319,6 @@ class RequestOutput:
     queue_wait_s: Optional[float] = None
     e2e_s: Optional[float] = None
     metrics: dict = field(default_factory=dict)
+    # embeddings lane: pooled last-hidden-state for embed=True
+    # requests (float32 [hidden]); None for generation requests
+    embedding: Optional[np.ndarray] = None

@@ -203,7 +203,13 @@ class Scheduler:
         {slot: prefill tokens}, {slot: draft tokens}); a prefill slot
         that gets no grant simply idles one step (its q_len is 0 — no
         state changes, no retrace), a decode slot granted no drafts
-        just runs its plain q_len-1 step."""
+        just runs its plain q_len-1 step. Embedding rows
+        (sampling.embed — prefill-only, retired at cursor end by the
+        engine) and grammar-constrained rows need NO packing changes:
+        an embed row is just a PREFILL slot that never reaches
+        DECODE, and a constrained row is a decode row whose sampling
+        bias rides as operand data — the token budget split is
+        identical either way."""
         decode_slots = [s for s, r in sorted(self.running.items())
                         if r.state is RequestState.DECODE]
         spare = max(0, budget - len(decode_slots))

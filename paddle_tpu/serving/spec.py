@@ -43,6 +43,16 @@ A/B'd) or `ServingEngine(spec=...)`; requires the unified ragged step
 (the verify pass IS a unified-step row). Only greedy rows speculate:
 a sampled row's distribution would need rejection sampling to stay
 unbiased, and the serving contract here is exact greedy equivalence.
+
+COMPOSITION with grammar-constrained decoding (serving/grammar.py):
+speculation needs no grammar awareness here — the ENGINE forks the
+request's automaton, walks it down the drafted path, and biases each
+verify column's argmax with that column's automaton state, so a draft
+that violates the grammar simply loses the argmax match and is
+rejected by the same fused greedy acceptance above. Drafters keep
+proposing from raw token history; a grammar-heavy trace just sees a
+lower acceptance rate (the --grammar-ab spec arm pins it > 1.0
+accepted tokens/step on templated traffic).
 """
 from __future__ import annotations
 

@@ -11,7 +11,7 @@ in the bench trajectory. Prints ONE JSON line and writes the same
 stable-schema report to BENCH_serving.json (override with --out,
 suppress with --out -):
 
-    {"bench": "serving", "schema_version": 16, "attn_impl": "kernel",
+    {"bench": "serving", "schema_version": 17, "attn_impl": "kernel",
      "requests": ..., "ttft_p50_s": ..., "tokens_per_sec": ...,
      "decode_step_ms_p50": ..., "ab": {"kernel": {...},
      "gather": {...}}, "prefix_stats": {...}, "unified": {...},
@@ -42,6 +42,19 @@ drafted-vs-accepted economics, and the tokens/s ratio — and the
 script ASSERTS the two arms are token-identical, that
 accepted-tokens-per-step beat 1.0, and that tokens/s did not regress
 with speculation on.
+
+`--grammar-ab` adds the structured-output A/B (schema v17): the SAME
+Poisson arrivals over a templated prompt mix run three ways —
+unconstrained ("off"), grammar-constrained ("on": a regex GrammarSpec
+whose per-slot allow-mask rides the ONE unified step as operand
+data), and grammar COMPOSED with speculative decoding ("spec"). The
+report's "grammar" section records schema-valid stream counts per
+arm, the masking counters, the composed arm's accepted-tokens-per-
+step and the tokens/s ratio — and the script ASSERTS 100% validity
+in both constrained arms, >= 1 invalid stream unconstrained, masking
+actually ran, > 1.0 accepted tokens/step in the composed arm, and
+throughput within a noise pin of the unconstrained arm (masks are
+operand data, never a retrace).
 
 `--chaos` replays the standard Poisson trace through a 2-replica HTTP
 front-end TWICE — once fault-free, once with the FaultInjector
@@ -394,6 +407,15 @@ def main():
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft budget per slot per step for "
                     "--spec-ab (the SpecConfig k knob)")
+    ap.add_argument("--grammar-ab", action="store_true",
+                    help="run the same Poisson arrivals with grammar-"
+                    "constrained decoding off vs on (regex structured "
+                    "output via the unified step's per-slot mask "
+                    "operand) plus a spec+grammar composition arm; "
+                    "asserts 100%% schema-valid streams with the "
+                    "grammar on, >= 1 invalid stream off, bounded "
+                    "tokens/s cost, and > 1.0 accepted tokens/step "
+                    "in the composed arm")
     ap.add_argument("--quant-ab", action="store_true",
                     help="run the SAME burst trace with the paged KV "
                     "pool in fp vs int8 under the SAME HBM page-byte "
@@ -631,6 +653,63 @@ def main():
                 attempts,
                 key=lambda r: r["snap"]["tokens_per_sec"] or 0.0)
 
+    # the grammar-constrained-decoding A/B: the SAME Poisson arrivals
+    # over a templated prompt mix, three arms — unconstrained ("off"),
+    # grammar-on ("on"), and grammar COMPOSED with speculative
+    # decoding ("spec"). The grammar is a regex over token strings
+    # (chr-identity vocab); the off arm replays the same trace/EOS so
+    # the only delta is the per-slot mask operand riding the unified
+    # step. Tokens are collected so the report can VALIDATE every
+    # constrained stream against the grammar and show the off arm
+    # does emit invalid ones.
+    gram_runs = {}
+    gram_n = gram_max_new = 0
+    gram_spec_obj = gram_eos = None
+    if args.grammar_ab:
+        from paddle_tpu.serving import GrammarSpec
+        gram_max_new = 12 if args.smoke else (48 if on_tpu else 16)
+        gram_n = max(n_req, 2 * args.slots)
+        gram_eos = cfg.vocab_size - 1
+        gram_spec_obj = GrammarSpec(kind="regex", pattern="[A-C]+")
+        gram_arrivals = np.cumsum(
+            rng.exponential(1.0 / rate, size=gram_n))
+        # templated prompts biased into the A-C token band so the
+        # ngram drafter's proposals often ALREADY satisfy the grammar
+        # (that overlap is what keeps the composed arm's acceptance
+        # above 1.0 accepted tokens/step)
+        gram_tpl = (np.asarray([ord("A"), ord("B"), ord("C")],
+                               np.int64))
+        gram_prompts = []
+        for _ in range(gram_n):
+            head = rng.randint(0, cfg.vocab_size,
+                               size=int(rng.randint(1, 4))
+                               ).astype(np.int64)
+            gram_prompts.append(
+                np.concatenate([head, np.tile(gram_tpl, 4)]))
+        gram_budgets = np.full(gram_n, gram_max_new)
+        for mode in ("off", "on", "spec"):
+            # best-of-2 per arm by tokens/s (hiccup-absorbing, same
+            # convention as the spec A/B); each arm is deterministic
+            # across repeats, asserted below
+            attempts = [run_trace(
+                model, gram_arrivals, gram_prompts, gram_budgets,
+                slots=args.slots, max_len=max_len,
+                page_size=args.page_size, pages=args.pages,
+                chunk=chunk, attn_impl="kernel",
+                grammar=(mode != "off"),
+                grammar_spec=(None if mode == "off"
+                              else gram_spec_obj),
+                eos=gram_eos,
+                spec=(f"ngram:{args.spec_k}" if mode == "spec"
+                      else False),
+                collect_tokens=True) for _ in range(2)]
+            for a in attempts[1:]:
+                assert a["tokens"] == attempts[0]["tokens"], \
+                    "grammar arm not deterministic across repeats"
+            gram_runs[mode] = max(
+                attempts,
+                key=lambda r: r["snap"]["tokens_per_sec"] or 0.0)
+
     # the observability A/B: a DETERMINISTIC burst replay (every
     # request arrives at t=0, so both arms run the exact same engine
     # steps — a wall-clock Poisson replay would let arrival jitter
@@ -768,7 +847,7 @@ def main():
 
     report = {
         "bench": "serving",
-        "schema_version": 16,
+        "schema_version": 17,
         "platform": jax.devices()[0].platform,
         "attn_impl": "kernel",
         "requests": n_req,
@@ -828,6 +907,46 @@ def main():
             "tokens_per_sec_ratio": ratio,
             "token_identical": (spec_runs["on"]["tokens"]
                                 == spec_runs["off"]["tokens"]),
+        }
+    if gram_runs:
+        def _gram_summary(run):
+            s = run["snap"]
+            burst = s.get("spec_tokens_per_step") or {}
+            valid = sum(
+                1 for toks in run["tokens"]
+                if gram_spec_obj.validates(
+                    "".join(chr(t) for t in toks if t != gram_eos)))
+            return {
+                "wall_s": round(run["wall_s"], 4),
+                "tokens_per_sec": s["tokens_per_sec"],
+                "ttft_p50_s": s["ttft_s"]["p50"],
+                "valid_streams": valid,
+                "grammar_requests": s.get("grammar_requests", 0),
+                "grammar_masked_steps":
+                    s.get("grammar_masked_steps", 0),
+                "grammar_masked_rows": s.get("grammar_masked_rows", 0),
+                "grammar_rejected_drafts":
+                    s.get("grammar_rejected_drafts", 0),
+                "accepted_tokens_per_step": burst.get("mean"),
+                "completed": s["requests"]["completed"],
+            }
+
+        g_off, g_on, g_spec = (_gram_summary(gram_runs["off"]),
+                               _gram_summary(gram_runs["on"]),
+                               _gram_summary(gram_runs["spec"]))
+        g_ratio = (None if not g_off["tokens_per_sec"]
+                   else (g_on["tokens_per_sec"] or 0.0)
+                   / g_off["tokens_per_sec"])
+        report["grammar"] = {
+            "requests": gram_n,
+            "max_new": gram_max_new,
+            "kind": gram_spec_obj.kind,
+            "pattern": gram_spec_obj.pattern,
+            "eos": int(gram_eos),
+            "off": g_off,
+            "on": g_on,
+            "spec": g_spec,
+            "tokens_per_sec_ratio": g_ratio,
         }
     if obs_runs:
         def _obs_summary(run):
@@ -1021,6 +1140,32 @@ def main():
             and sp["accepted_tokens_per_step"] > 1.0, sp
         assert sp["on"]["tokens_per_sec"] >= \
             sp["off"]["tokens_per_sec"], sp
+    if gram_runs:
+        gm = report["grammar"]
+        # the acceptance numbers: every constrained stream (grammar on,
+        # and grammar composed with spec decode) is 100% schema-valid,
+        # the unconstrained arm really emitted at least one invalid
+        # stream (the constraint DID something), masking really ran,
+        # all three arms served the whole trace, the composed arm's
+        # verify pass still confirmed > 1 token per decode-row step
+        # (grammar-compatible drafts survive the fused acceptance),
+        # and the masked arm's throughput stays within a noise pin of
+        # unconstrained (the mask is operand data, not a retrace)
+        assert gm["on"]["valid_streams"] == gram_n, gm
+        assert gm["spec"]["valid_streams"] == gram_n, gm
+        assert gm["off"]["valid_streams"] < gram_n, gm
+        assert gm["on"]["completed"] == gm["off"]["completed"] \
+            == gm["spec"]["completed"] == gram_n, gm
+        assert gm["on"]["grammar_requests"] == gram_n, gm
+        assert gm["on"]["grammar_masked_steps"] > 0, gm
+        assert gm["off"]["grammar_requests"] == 0, gm
+        assert gm["spec"]["accepted_tokens_per_step"] is not None \
+            and gm["spec"]["accepted_tokens_per_step"] > 1.0, gm
+        # sub-second smoke arms get the wide scheduler-hiccup pin the
+        # grouped A/B uses; longer arms pin at 15%
+        gm_noise = 2.0 if gm["on"]["wall_s"] < 1.0 else 1.15
+        assert gm["tokens_per_sec_ratio"] is not None \
+            and gm["tokens_per_sec_ratio"] >= 1.0 / gm_noise, gm
     if obs_runs:
         ob = report["obs"]
         # the acceptance numbers: observability NEVER changes output
@@ -1234,7 +1379,8 @@ def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
               warm_prompts=(), unified=None, spec=None,
               collect_tokens=False, kv_dtype=None, grouped=None,
               obs=None, mesh=None, collect_collectives=False,
-              slo=None, cost_census=None):
+              slo=None, cost_census=None, grammar=None,
+              grammar_spec=None, eos=None):
     """One Poisson-trace replay through a fresh engine pinned to
     `attn_impl` (and, for the prefix A/B, to `prefix_cache` on/off;
     for the unified-step A/B, to `unified` on/off; for the spec A/B,
@@ -1256,7 +1402,15 @@ def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
                         prefix_cache=prefix_cache, unified=unified,
                         spec=spec, kv_dtype=kv_dtype, grouped=grouped,
                         obs=obs, mesh=mesh, slo=slo,
-                        cost_census=cost_census)
+                        cost_census=cost_census, grammar=grammar)
+    # --grammar-ab: every trace request carries the grammar (and the
+    # EOS a constrained stream needs to terminate); the off arm rides
+    # the same eos so the two arms replay a comparable trace
+    sp_kw = {}
+    if eos is not None:
+        sp_kw["eos_token_id"] = int(eos)
+    if grammar_spec is not None:
+        sp_kw["grammar"] = grammar_spec
 
     # warm the compiled programs so the trace measures steady state, not
     # XLA compile time: one request per distinct prompt length (chunk
@@ -1282,6 +1436,7 @@ def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
     eng.metrics.unified = eng.unified
     eng.metrics.grouped = eng.grouped
     eng.metrics.spec = None if eng.spec is None else eng.spec.mode
+    eng.metrics.grammar = eng.grammar_on
     eng.metrics.kv_dtype = eng.kv_dtype
     eng.metrics.pool_bytes_per_page = eng.page_bytes
     eng.metrics.mesh = None if eng.tp is None else eng.tp.shape
@@ -1296,7 +1451,8 @@ def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
         while submitted < n_req and arrivals[submitted] <= now:
             reqs.append(eng.add_request(
                 prompts[submitted],
-                SamplingParams(max_new_tokens=int(budgets[submitted]))))
+                SamplingParams(max_new_tokens=int(budgets[submitted]),
+                               **sp_kw)))
             submitted += 1
         if eng.has_work:
             eng.step()

@@ -6,6 +6,7 @@ memorizable subset), save/load round-trip, and the optimizer+loader+model
 stack working together.
 """
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
@@ -109,6 +110,7 @@ def test_resnet18_forward_backward():
     assert model.conv1.weight.grad is not None
 
 
+@pytest.mark.slow
 def test_mobilenet_vgg_forward():
     m1 = paddle.vision.models.mobilenet_v2(scale=0.25, num_classes=7)
     y = m1(paddle.to_tensor(

@@ -657,7 +657,7 @@ def test_serving_bench_smoke_writes_stable_schema(tmp_path,
     with open(out) as f:
         report = json.load(f)
     assert report["bench"] == "serving"
-    assert report["schema_version"] == 16
+    assert report["schema_version"] == 17
     for key in ("tokens_per_sec", "ttft_p50_s", "ttft_p99_s",
                 "pool_utilization_mean", "pool_utilization_max",
                 "prefill_chunks", "page_size", "num_pages",
@@ -679,6 +679,7 @@ def test_serving_bench_smoke_writes_stable_schema(tmp_path,
     assert "hit_rate" in report["prefix_stats"]
 
 
+@pytest.mark.slow
 def test_serving_bench_prefix_share_smoke(tmp_path, monkeypatch):
     """`serving_bench.py --smoke --prefix-share 0.8` (ISSUE
     acceptance): the same shared-prefix trace with the cache on does

@@ -754,6 +754,7 @@ def test_chaos_soak_random_schedule_token_identity():
             e.pool.assert_quiesced()
 
 
+@pytest.mark.slow
 def test_serving_bench_chaos_smoke(tmp_path, monkeypatch):
     """`serving_bench.py --smoke --chaos` in-process: the schema-v6
     report gains the chaos section and its own assertions hold
@@ -775,7 +776,7 @@ def test_serving_bench_chaos_smoke(tmp_path, monkeypatch):
     mod.main()
     with open(out) as f:
         report = json_mod.load(f)
-    assert report["schema_version"] == 16
+    assert report["schema_version"] == 17
     chaos = report["chaos"]
     assert chaos["replicas"] == 2
     assert chaos["truncated_streams"] == 0

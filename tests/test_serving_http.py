@@ -616,6 +616,7 @@ class TestKeepAliveAndRateLimit:
             server.drain()
 
 
+@pytest.mark.slow
 def test_serving_bench_http_smoke_appends_http_section(tmp_path,
                                                        monkeypatch):
     """`serving_bench.py --smoke --http` in-process: the stable-schema
@@ -638,7 +639,7 @@ def test_serving_bench_http_smoke_appends_http_section(tmp_path,
     mod.main()
     with open(out) as f:
         report = json.load(f)
-    assert report["schema_version"] == 16        # + chaos schema
+    assert report["schema_version"] == 17        # + chaos schema
     assert report["completed"] == 4              # in-process section
     assert report["attn_impl"] == "kernel"
     assert set(report["ab"]) == {"kernel", "gather"}

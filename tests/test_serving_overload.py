@@ -778,6 +778,7 @@ def _run_bench(tmp_path, monkeypatch, extra):
         return json.load(f)
 
 
+@pytest.mark.slow
 def test_serving_bench_overload_smoke():
     """The bench's deterministic virtual-time 3x-overload A/B (ISSUE
     acceptance), driven directly through `overload_trace` (the slow
@@ -818,7 +819,7 @@ def test_overload_soak(tmp_path, monkeypatch):
     report = _run_bench(tmp_path, monkeypatch,
                         ["--smoke", "--requests", "3", "--slots", "4",
                          "--overload", "--overload-scale", "3"])
-    assert report["schema_version"] == 16
+    assert report["schema_version"] == 17
     ov = report["overload"]
     assert ov["on"]["high_priority"]["deadline_misses"] == 0
     assert ov["on"]["high_priority"]["completed"] == \

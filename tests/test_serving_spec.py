@@ -285,6 +285,7 @@ class TestSpecTokenIdentity:
         assert "stop" in reasons     # EOS really fired somewhere
         assert eng.metrics.snapshot()["spec_accepted_tokens"] > 0
 
+    @pytest.mark.slow
     def test_page_pressure_prefix_cache_matrix(self):
         """The acceptance matrix: pool smaller than the trace wants
         (LRU eviction live) x prefix cache on/off x spec on/off, all
@@ -569,6 +570,7 @@ def _run_bench(tmp_path, monkeypatch, extra):
         return json.load(f)
 
 
+@pytest.mark.slow
 def test_serving_bench_spec_ab_smoke(tmp_path, monkeypatch):
     """`serving_bench.py --smoke --spec-ab` (ISSUE acceptance): the
     templated trace with speculation off vs ngram on lands in
@@ -576,7 +578,7 @@ def test_serving_bench_spec_ab_smoke(tmp_path, monkeypatch):
     with accepted-tokens-per-step > 1.0 and no tokens/s regression."""
     report = _run_bench(tmp_path, monkeypatch,
                         ["--smoke", "--requests", "4", "--spec-ab"])
-    assert report["schema_version"] == 16
+    assert report["schema_version"] == 17
     sp = report["spec"]
     assert set(sp) >= {"on", "off", "accepted_tokens_per_step",
                        "tokens_per_sec_ratio", "token_identical"}
@@ -609,5 +611,5 @@ def test_bench_default_run_has_no_spec_section(tmp_path, monkeypatch):
     keeps the key optional), and the default path still completes."""
     report = _run_bench(tmp_path, monkeypatch,
                         ["--smoke", "--requests", "3"])
-    assert report["schema_version"] == 16
+    assert report["schema_version"] == 17
     assert "spec" not in report

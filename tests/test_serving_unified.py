@@ -299,6 +299,7 @@ def test_chrome_trace_has_unified_step_and_request_spans(tmp_path):
     assert not any(n.startswith("serving::prefill[") for n in names)
 
 
+@pytest.mark.slow
 def test_serving_bench_unified_ab_smoke(tmp_path, monkeypatch):
     """`serving_bench.py --smoke --unified-ab` (ISSUE acceptance): the
     same long-prompt-heavy Poisson trace with the unified step on vs
@@ -319,7 +320,7 @@ def test_serving_bench_unified_ab_smoke(tmp_path, monkeypatch):
     mod.main()
     with open(out) as f:
         report = json.load(f)
-    assert report["schema_version"] == 16
+    assert report["schema_version"] == 17
     uni = report["unified"]
     assert set(uni) >= {"on", "off", "long_prompt_lens", "requests"}
     on, off = uni["on"], uni["off"]

@@ -495,6 +495,7 @@ class TestNewModelFamilies:
         paddle.seed(0)
         self._smoke(densenet121(num_classes=10))
 
+    @pytest.mark.slow
     def test_squeezenet(self):
         from paddle_tpu.vision.models import squeezenet1_0, \
             squeezenet1_1
@@ -502,6 +503,7 @@ class TestNewModelFamilies:
         self._smoke(squeezenet1_0(num_classes=10), size=96)
         self._smoke(squeezenet1_1(num_classes=10), size=96)
 
+    @pytest.mark.slow
     def test_shufflenet(self):
         from paddle_tpu.vision.models import shufflenet_v2_x0_25, \
             shufflenet_v2_swish
