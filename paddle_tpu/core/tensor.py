@@ -75,6 +75,24 @@ _mesh_hook = None
 _profile_hook = None
 _NULL_SPAN = contextlib.nullcontext()
 
+# set by the serving engine's launch-count probe (set_dispatch_probe):
+# called with the op name for every registered-op dispatch that inlines
+# into an enclosing trace (apply_op's traced branch). Counting at TRACE
+# time is what makes the number meaningful on CPU tier-1 too — each
+# such call is one fused-region seed XLA must schedule, the quantity
+# the decode megakernel collapses; a post-compile HLO count would
+# reflect CPU fusion heuristics instead.
+_dispatch_probe = None
+
+
+def set_dispatch_probe(fn):
+    """Install (or clear, fn=None) the traced-op dispatch probe.
+    Returns the previous probe so callers can nest/restore."""
+    global _dispatch_probe
+    prev = _dispatch_probe
+    _dispatch_probe = fn
+    return prev
+
 # set by paddle_tpu.static.enable_static: records each eager op into the
 # current static Program (build-time execution doubles as shape
 # inference; tracers are excluded — ops inside a jitted body are interior
@@ -593,6 +611,9 @@ def apply_op(op_name: str, *tensors, attrs: Optional[dict] = None,
         # under an outer trace (compiled train step, to_static, vmap...)
         # inline the raw op fn into the enclosing jaxpr: no nested-pjit
         # boundaries for XLA, no jit-cache lookup on the Python hot path
+        probe = _dispatch_probe  # read once (concurrent clear)
+        if probe is not None:
+            probe(op.name)
         out = op.fwd(*vals, **attrs) if attrs else op.fwd(*vals)
     else:
         fn = get_jitted(op, attrs)

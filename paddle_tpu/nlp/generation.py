@@ -39,7 +39,16 @@ from ..ops.pallas.paged_attention import (dequantize_paged_q8,
                                           ragged_paged_attention_q8,
                                           ragged_paged_attention_grouped,
                                           ragged_paged_attention_grouped_q8,
-                                          FP8_DTYPE)
+                                          FP8_DTYPE,
+                                          quantize_kv_rowwise,
+                                          paged_scatter,
+                                          paged_scatter_q8,
+                                          lora_delta,
+                                          lora_delta_paged,
+                                          megakernel_decode,
+                                          megakernel_decode_q8,
+                                          decode_greedy_argmax,
+                                          spec_verify_accept)
 
 __all__ = ["DecodeCache", "init_decode_caches", "update_and_attend",
            "CompiledGenerator", "decode_model_step", "sample_logits",
@@ -87,11 +96,12 @@ class DecodeCache:
 
     __slots__ = ("k", "v", "pos", "k_scale", "v_scale", "fresh",
                  "page_table", "attn_impl", "q_len", "group",
-                 "out_shard", "lora")
+                 "out_shard", "lora", "lora_paged", "megakernel")
 
     def __init__(self, k, v, pos, k_scale=None, v_scale=None,
                  fresh=False, page_table=None, attn_impl=None,
-                 q_len=None, group=None, out_shard=None, lora=None):
+                 q_len=None, group=None, out_shard=None, lora=None,
+                 lora_paged=None, megakernel=False):
         self.k = k
         self.v = v
         self.pos = pos
@@ -147,6 +157,24 @@ class DecodeCache:
         # is unchanged. Rows at page 0 / scale 0 (base model, idle)
         # see an exactly-zero delta.
         self.lora = lora
+        # megakernel LoRA operands (PADDLE_TPU_MEGAKERNEL + adapters):
+        # this layer's FULL paged adapter pools plus the per-row page
+        # ids/scales — a 10-tuple of Tensors (Aq [P, h, R],
+        # Bq [P, R, Hq*D], Ak, Bk, Av, Bv [P, ..., H_kv*D],
+        # Ao [P, Hq*D, R], Bo [P, R, h], apage [B] int32,
+        # ascale [B] f32). Unlike `lora` (per-row pairs gathered
+        # in-trace by XLA), the gather happens INSIDE the fused op:
+        # the megakernel's q/k/v prologue streams row b's page once,
+        # and the o-delta goes through the standalone
+        # `lora_delta_paged` op. Mutually exclusive with `lora`.
+        self.lora_paged = lora_paged
+        # decode megakernel gate (PADDLE_TPU_MEGAKERNEL, default off):
+        # routes the unified ragged step through the fused
+        # megakernel_decode[_q8] op — scatter(+quantize) + attend (+
+        # LoRA prologue) in ONE dispatch — instead of the op-pair
+        # path below. Requires q_len (unified mode), impl "kernel",
+        # and no user mask; identical outputs by construction.
+        self.megakernel = megakernel
         # True only on caches straight out of init_decode_caches (pos
         # is provably 0 even when it traces as a jit constant): the
         # int8 multi-token prefill guard keys on this
@@ -176,59 +204,17 @@ def _kv_update_fwd(buf, upd, pos):
 register_op("kv_cache_update", _kv_update_fwd)
 
 
-def _lora_delta_fwd(x, a, b, scale):
-    """Per-row batched LoRA delta (multi-tenant adapter serving):
-    x [B, W, in] hidden states, a [B, in, R] / b [B, R, out] the rows'
-    GATHERED low-rank pairs (each row carries ITS OWN adapter's
-    weights — tenant identity is operand data, not a trace), scale [B]
-    the per-row LoRA scaling (alpha/r; 0 for base-model rows). Returns
-    `(x @ a) @ b * scale` in x's dtype — rank-R zero padding and the
-    all-zero base page contribute exactly 0, so base rows degenerate
-    bit-exactly."""
-    t = jnp.einsum("bwi,bir->bwr", x, a.astype(x.dtype))
-    d = jnp.einsum("bwr,bro->bwo", t, b.astype(x.dtype))
-    return (d * scale[:, None, None].astype(x.dtype)).astype(x.dtype)
+# Per-row batched LoRA delta (multi-tenant adapter serving): the
+# shared expression body lives in pallas/paged_attention.lora_delta —
+# the megakernel's fused LoRA prologue composes the SAME floats, which
+# is what keeps gate-on/gate-off serving bit-identical on CPU.
+register_op("lora_delta", lora_delta)
 
 
-register_op("lora_delta", _lora_delta_fwd)
-
-
-def _kv_update_paged_fwd(pool, upd, pos, page_table):
-    """Scatter upd [B, l, H, D] into the shared pool
-    [num_pages, page_size, H, D]: row b's token t lands at logical
-    position pos[b] + t, i.e. pool slot
-    page_table[b, p // page_size] * page_size + p % page_size.
-
-    Positions past the row's addressable window (chunk padding on the
-    last prefill chunk) are redirected into page 0 — the reserved trash
-    page — so the scatter never needs a branch and never clobbers live
-    pages. Free/retired rows get an all-zero page-table row from the
-    host for the same reason: their (masked, ignored) writes land in
-    trash. One fixed-shape scatter serves decode (l=1, batch B) and
-    chunked prefill (l=chunk, batch 1) alike.
-    """
-    ps = pool.shape[1]
-    b, l = upd.shape[0], upd.shape[1]
-    addressable = page_table.shape[1] * ps
-    p = pos.astype(jnp.int32)[:, None] + \
-        jnp.arange(l, dtype=jnp.int32)[None, :]          # [B, l] logical
-    pidx = jnp.clip(p // ps, 0, page_table.shape[1] - 1)
-    ids = jnp.take_along_axis(page_table.astype(jnp.int32), pidx,
-                              axis=1)                    # [B, l] pages
-    flat = ids * ps + p % ps
-    flat = jnp.where(p < addressable, flat, p % ps)      # OOB -> trash
-    if jnp.dtype(pool.dtype) == jnp.dtype(FP8_DTYPE):
-        # fp8 lane: XLA's f32->e4m3 convert yields NaN past the
-        # format's range, not a saturate — clip to +-448 first so a
-        # pathological activation can never poison the pool
-        upd = jnp.clip(upd.astype(jnp.float32), -448.0, 448.0)
-    flat_pool = pool.reshape((-1,) + pool.shape[2:])
-    flat_pool = flat_pool.at[flat.reshape(-1)].set(
-        upd.astype(pool.dtype).reshape((-1,) + upd.shape[2:]))
-    return flat_pool.reshape(pool.shape)
-
-
-register_op("kv_cache_update_paged", _kv_update_paged_fwd, nondiff=True)
+# Paged KV scatter: fwd is pallas/paged_attention.paged_scatter (the
+# shared address math + trash-page redirect the megakernel's Pallas
+# write stage prefetches) — see its docstring for the slot map.
+register_op("kv_cache_update_paged", paged_scatter, nondiff=True)
 
 
 def _paged_gather_fwd(pool, page_table):
@@ -253,62 +239,14 @@ def _paged_gather_fwd(pool, page_table):
 register_op("paged_kv_gather", _paged_gather_fwd, nondiff=True)
 
 
-def quantize_kv_rowwise(u):
-    """Rowwise int8 quantization of K/V values [..., D]: one f32 scale
-    per leading row (per (token, kv head) in the paged pool), codes =
-    round(u / scale) clipped to [-127, 127]. Unlike the dense cache's
-    calibrated per-head CONSTANT scales (see _kv_update_q8_fwd), the
-    paged pool quantizes at WRITE time with the row's own absmax —
-    serving admits arbitrary traffic with no calibration pass, and the
-    scale rides in the page right next to its codes, so preemption
-    swap, COW copies and prefix sharing move (codes, scale) as one
-    unit and a later reader dequantizes to exactly the same floats.
-    Returns (codes int8 same shape, scales f32 u.shape[:-1])."""
-    uf = u.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(uf), axis=-1)
-    # written as a multiply by the f32 constant 1/127 (not a divide):
-    # XLA rewrites x / 127 into exactly this under jit, so spelling it
-    # out keeps eager and jitted scales BIT-identical — the roundtrip
-    # bit-exactness tests depend on it
-    scale = jnp.maximum(amax, jnp.float32(1e-8)) \
-        * jnp.float32(1.0 / 127.0)
-    codes = jnp.clip(jnp.round(uf / scale[..., None]),
-                     -127, 127).astype(jnp.int8)
-    return codes, scale
-
-
-def _kv_update_paged_q8_fwd(pool, scale_pool, upd, pos, page_table):
-    """Quantize-then-scatter in ONE jitted program: upd [B, l, H, D]
-    is rowwise-int8 quantized (quantize_kv_rowwise) and its codes land
-    in the int8 pool [num_pages, page_size, H, D] while the per-row
-    scales land at the SAME flat slots of the scale pool
-    [num_pages, page_size, H] — the int8 branch of
-    `kv_cache_update_paged`. Address math (including the trash-page-0
-    redirect for positions past the row's addressable window) is
-    identical to the float scatter, so the one-fixed-shape-program
-    discipline carries over unchanged. Returns (pool, scale_pool)."""
-    ps = pool.shape[1]
-    addressable = page_table.shape[1] * ps
-    l = upd.shape[1]
-    p = pos.astype(jnp.int32)[:, None] + \
-        jnp.arange(l, dtype=jnp.int32)[None, :]          # [B, l] logical
-    pidx = jnp.clip(p // ps, 0, page_table.shape[1] - 1)
-    ids = jnp.take_along_axis(page_table.astype(jnp.int32), pidx,
-                              axis=1)                    # [B, l] pages
-    flat = ids * ps + p % ps
-    flat = jnp.where(p < addressable, flat, p % ps)      # OOB -> trash
-    codes, scales = quantize_kv_rowwise(upd)   # [B,l,H,D] i8 / [B,l,H]
-    flat_pool = pool.reshape((-1,) + pool.shape[2:])
-    flat_pool = flat_pool.at[flat.reshape(-1)].set(
-        codes.reshape((-1,) + codes.shape[2:]))
-    flat_sc = scale_pool.reshape((-1,) + scale_pool.shape[2:])
-    flat_sc = flat_sc.at[flat.reshape(-1)].set(
-        scales.reshape((-1,) + scales.shape[2:]))
-    return (flat_pool.reshape(pool.shape),
-            flat_sc.reshape(scale_pool.shape))
-
-
-register_op("kv_cache_update_paged_q8", _kv_update_paged_q8_fwd,
+# Quantize-then-scatter in ONE jitted program (int8 branch of
+# `kv_cache_update_paged`): fwd is
+# pallas/paged_attention.paged_scatter_q8 — quantize_kv_rowwise (also
+# re-exported here; tests and decode_roofline import it from this
+# module) + the shared scatter address math. The megakernel's q8
+# write stage fuses the SAME quantization expressions into its Pallas
+# pass, so both pipelines commit bit-identical (codes, scales).
+register_op("kv_cache_update_paged_q8", paged_scatter_q8,
             nondiff=True)
 
 # Dequantizing multi-token gather over the int8 pool: codes + rowwise
@@ -356,6 +294,34 @@ register_op("ragged_paged_attention_grouped",
             ragged_paged_attention_grouped, nondiff=True)
 register_op("ragged_paged_attention_grouped_q8",
             ragged_paged_attention_grouped_q8, nondiff=True)
+
+# ---- decode megakernel ops (PADDLE_TPU_MEGAKERNEL, default off) ----
+# One registered op per attention layer replaces the unfused
+# scatter(+quantize) -> attend op pair (and, with adapters, the three
+# per-projection lora_delta dispatches): LoRA prologue + KV write +
+# the unchanged ragged/grouped walk in one dispatch. Off-TPU each
+# stage IS the unfused ops' shared forward (paged_scatter[_q8],
+# lora_delta, the ragged references), so gate-on CPU serving stays
+# bit-identical to gate-off — the oracle tests/test_megakernel.py
+# pins. The q8 variant also returns the updated rowwise scale pools.
+register_op("megakernel_decode", megakernel_decode, nondiff=True)
+register_op("megakernel_decode_q8", megakernel_decode_q8,
+            nondiff=True)
+
+# Paged LoRA delta with the page gather INSIDE the op (the
+# megakernel's fused adapter stream, also used standalone for the
+# o-projection and for rope models whose deltas can't ride the
+# attend): full pools + per-row page ids/scales in, delta out.
+register_op("lora_delta_paged", lora_delta_paged, nondiff=True)
+
+# Sampling/acceptance epilogues over the logits tile (megakernel
+# mode): greedy argmax (Pallas on-tile reduction on TPU/interpret,
+# jnp.argmax off-TPU — bit-identical first-max tie-breaking) and the
+# fused spec-decode acceptance (the unified step's exact expressions;
+# grammar bias masks are additive operand data added upstream).
+register_op("decode_greedy_argmax", decode_greedy_argmax,
+            nondiff=True)
+register_op("spec_verify_accept", spec_verify_accept, nondiff=True)
 
 
 # Grouped-query decode attention: attends q [B, l, H, D] over the full
@@ -503,7 +469,8 @@ def _tp_gather_out(out, cache):
 
 
 def update_and_attend(q, k_new, v_new, cache: DecodeCache,
-                      dropout_p=0.0, training=False, attn_mask=None):
+                      dropout_p=0.0, training=False, attn_mask=None,
+                      lora_x=None):
     """Write k_new/v_new at cache.pos, attend q over the valid prefix.
 
     q: [B, l, H, D]; k_new/v_new: [B, l, H_kv, D] (GQA repeat handled
@@ -520,12 +487,59 @@ def update_and_attend(q, k_new, v_new, cache: DecodeCache,
     dequantizing gather (impl "gather" / multi-token chunked
     prefill). The paged int8 mode has none of the dense int8 mode's
     write-pattern limits.
+
+    lora_x (optional, megakernel mode): the attention input hidden
+    states [B, l, h] — with `cache.lora_paged` set, the fused op
+    computes the per-row q/k/v LoRA deltas from it inside the kernel
+    (q/k_new/v_new then carry the BASE projections only; the caller
+    handles the o-delta via `lora_delta_paged`). Ignored otherwise.
     """
     from ..nn import functional as F
     from ..ops import manipulation
     quant = cache.k_scale is not None
     paged = cache.page_table is not None
     l = int(q.shape[1])
+    if (paged and cache.megakernel and cache.q_len is not None
+            and attn_mask is None
+            and resolve_paged_attn_impl(cache.attn_impl) == "kernel"):
+        # DECODE MEGAKERNEL (PADDLE_TPU_MEGAKERNEL): the layer's whole
+        # KV path — optional fused LoRA prologue, (quantize-then-)
+        # scatter of the new K/V, and the ragged/grouped walk — as ONE
+        # registered op instead of the 2-op (or, with adapters, 5-op)
+        # soup below. Same shared forwards, same floats; see the op
+        # registrations above.
+        grouped = cache.group is not None
+        lora = cache.lora_paged is not None and lora_x is not None
+        rest = []
+        if grouped:
+            rest.extend(cache.group)
+        if lora:
+            aq, bq, ak, bk, av, bv = cache.lora_paged[:6]
+            apage, ascale = cache.lora_paged[8], cache.lora_paged[9]
+            rest.extend([lora_x, aq, bq, ak, bk, av, bv, apage,
+                         ascale])
+        attrs = dict(grouped=grouped, lora=lora)
+        if quant:
+            out, k_buf, v_buf, k_sc, v_sc = apply_op(
+                "megakernel_decode_q8", q, k_new, v_new, cache.k,
+                cache.v, cache.k_scale, cache.v_scale,
+                cache.page_table, cache.pos, cache.q_len, *rest,
+                attrs=attrs)
+        else:
+            out, k_buf, v_buf = apply_op(
+                "megakernel_decode", q, k_new, v_new, cache.k,
+                cache.v, cache.page_table, cache.pos, cache.q_len,
+                *rest, attrs=attrs)
+            k_sc = v_sc = None
+        out = _tp_gather_out(out, cache)
+        return out, DecodeCache(k_buf, v_buf, cache.pos + cache.q_len,
+                                k_sc, v_sc,
+                                page_table=cache.page_table,
+                                attn_impl=cache.attn_impl,
+                                q_len=cache.q_len, group=cache.group,
+                                out_shard=cache.out_shard,
+                                lora_paged=cache.lora_paged,
+                                megakernel=True)
     k_sc = v_sc = None
     if quant and paged:
         # int8 PAGED pool: rowwise scale pools ride in k_scale/v_scale
@@ -756,7 +770,8 @@ def _pack_caches(caches):
 
 
 def _unpack_caches(ct, pos, page_table=None, attn_impl=None,
-                   q_len=None, group=None, out_shard=None, lora=None):
+                   q_len=None, group=None, out_shard=None, lora=None,
+                   lora_paged=None, megakernel=False):
     """page_table (optional [B, max_pages] raw int32 array) switches
     every layer's cache into paged-pool mode; the table is shared
     across layers (one page id addresses the same page in each
@@ -773,18 +788,27 @@ def _unpack_caches(ct, pos, page_table=None, attn_impl=None,
     A/B pairs for q/k/v/o plus the per-row scale, see
     serving/adapters.py) attaches that layer's multi-tenant LoRA
     weights; the attention modules fuse the per-row delta into their
-    projections."""
+    projections. lora_paged (optional, megakernel mode — mutually
+    exclusive with lora): one entry PER LAYER, a 10-tuple of raw
+    arrays — the layer's FULL paged adapter pools for q/k/v/o plus
+    the per-row page ids and scales (see DecodeCache.lora_paged);
+    the gather happens inside the fused op. megakernel=True routes
+    every layer's unified attend through megakernel_decode[_q8]."""
     pt = None if page_table is None else Tensor(page_table)
     ql = None if q_len is None else Tensor(q_len)
     grp = None if group is None else tuple(Tensor(g) for g in group)
     lora = ([None] * len(ct) if lora is None
             else [tuple(Tensor(a) for a in layer) for layer in lora])
+    lora_paged = ([None] * len(ct) if lora_paged is None
+                  else [tuple(Tensor(a) for a in layer)
+                        for layer in lora_paged])
     return [DecodeCache(Tensor(k), Tensor(v), Tensor(pos),
                         None if ks is None else Tensor(ks),
                         None if vs is None else Tensor(vs),
                         page_table=pt, attn_impl=attn_impl, q_len=ql,
-                        group=grp, out_shard=out_shard, lora=lo)
-            for (k, v, ks, vs), lo in zip(ct, lora)]
+                        group=grp, out_shard=out_shard, lora=lo,
+                        lora_paged=lp, megakernel=megakernel)
+            for (k, v, ks, vs), lo, lp in zip(ct, lora, lora_paged)]
 
 
 def decode_model_step(model, tokens, caches):

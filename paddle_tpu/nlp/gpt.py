@@ -107,6 +107,13 @@ class GPTAttention(nn.Layer):
         # stored per projection, not in the fused interleaved layout)
         lora = (cache.lora if isinstance(cache, DecodeCache)
                 else None)
+        # megakernel mode (PADDLE_TPU_MEGAKERNEL + adapters): the
+        # q/k/v deltas fuse INTO the attend op's prologue — no rope in
+        # GPT, so delta-then-attend and attend-with-fused-delta are
+        # the same floats. Only the o-delta stays outside (it needs
+        # the attention OUTPUT), via the paged-gather op.
+        lora_paged = (cache.lora_paged
+                      if isinstance(cache, DecodeCache) else None)
         if lora is not None:
             aq, bq, ak, bk, av, bv, ao, bo, sc = lora
             hd = [b, l, self.num_heads, self.head_dim]
@@ -117,12 +124,18 @@ class GPTAttention(nn.Layer):
             v = v + manipulation.reshape(
                 apply_op("lora_delta", x, av, bv, sc), hd)
         if isinstance(cache, DecodeCache):
-            out, new_cache = update_and_attend(q, k, v, cache,
-                                               training=False)
+            out, new_cache = update_and_attend(
+                q, k, v, cache, training=False,
+                lora_x=x if lora_paged is not None else None)
             out = manipulation.reshape(out, [b, l, h])
             o = self.out_proj(out)
             if lora is not None:
                 o = o + apply_op("lora_delta", out, ao, bo, sc)
+            elif lora_paged is not None:
+                ao, bo = lora_paged[6], lora_paged[7]
+                apage, ascale = lora_paged[8], lora_paged[9]
+                o = o + apply_op("lora_delta_paged", out, ao, bo,
+                                 apage, ascale)
             return o, new_cache
         if cache is not None:
             k = manipulation.concat([cache[0], k], axis=1)

@@ -126,12 +126,29 @@ class LlamaAttention(nn.Layer):
         # equivalence: rope((W + BA)x) == rope(Wx + BAx))
         lora = (cache.lora if isinstance(cache, DecodeCache)
                 else None)
+        # megakernel mode: rope sits between the projections and the
+        # attend (rope((W + BA)x) != rope(Wx) + BAx rearranged into
+        # the attend's prologue), so llama CANNOT bundle its deltas
+        # into megakernel_decode — each projection takes the
+        # standalone paged-gather op instead (the adapter page still
+        # streams through the fused kernel, once per projection).
+        lora_paged = (cache.lora_paged
+                      if isinstance(cache, DecodeCache) else None)
         qf, kf, vf = self.q_proj(x), self.k_proj(x), self.v_proj(x)
         if lora is not None:
             aq, bq, ak, bk, av, bv, ao, bo, sc = lora
             qf = qf + apply_op("lora_delta", x, aq, bq, sc)
             kf = kf + apply_op("lora_delta", x, ak, bk, sc)
             vf = vf + apply_op("lora_delta", x, av, bv, sc)
+        elif lora_paged is not None:
+            (aq, bq, ak, bk, av, bv, ao, bo, apage,
+             ascale) = lora_paged
+            qf = qf + apply_op("lora_delta_paged", x, aq, bq, apage,
+                               ascale)
+            kf = kf + apply_op("lora_delta_paged", x, ak, bk, apage,
+                               ascale)
+            vf = vf + apply_op("lora_delta_paged", x, av, bv, apage,
+                               ascale)
         q = manipulation.reshape(qf,
                                  [b, l, self.n_heads, self.head_dim])
         k = manipulation.reshape(kf, [b, l, self.n_kv, self.head_dim])
@@ -146,6 +163,9 @@ class LlamaAttention(nn.Layer):
             o = self.o_proj(out)
             if lora is not None:
                 o = o + apply_op("lora_delta", out, ao, bo, sc)
+            elif lora_paged is not None:
+                o = o + apply_op("lora_delta_paged", out, ao, bo,
+                                 apage, ascale)
             return o, new_cache
         offset = cache[0].shape[1] if cache is not None else 0
         q = apply_rotary(q, offset, self.theta)

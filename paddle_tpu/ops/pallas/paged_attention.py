@@ -93,6 +93,45 @@ tokens actually packed, not with the padded step shape. Outputs at
 query positions >= q_len[b] are unspecified-but-finite (the engine
 discards them).
 
+MEGAKERNEL (`megakernel_decode` / `megakernel_decode_q8`, gated
+PADDLE_TPU_MEGAKERNEL, default off): the decode layer's remaining op
+soup — per-row paged LoRA delta gather, KV quantize-then-scatter, and
+the attend itself — fused into ONE registered op so the unified step
+approaches a handful of launches ("Operator Fusion in XLA", PAPERS.md:
+XLA will not fuse across these data-dependent gather/scatter
+boundaries on its own; "Tensor Processing Primitives": build the layer
+from a small set of fused primitives instead). Composition:
+
+- LoRA prologue (`lora=True`): the per-row adapter page streams
+  through VMEM ONCE per layer (`lora_delta_paged` — a Pallas kernel
+  whose BlockSpec index maps chase `apage` via scalar prefetch, the
+  same trick the page walk plays with `page_table`) and its q/k/v
+  deltas are added to the base projections inside the op. Base rows
+  ride the all-zero adapter page 0 and contribute exactly 0. The
+  unfused path gathers the A/B pairs in-trace per projection — three
+  HBM gathers of the same page; the fused op streams it once.
+- quantize-on-write: the new tokens' K/V are quantized
+  (`quantize_kv_rowwise` — the SAME expression the unfused scatter
+  op uses) and scattered into the code+scale pools in the same pass
+  (Pallas scatter with `input_output_aliases`: grid step (b, t) DMAs
+  one token's [H, D] tile to pool slot `flat[b, t]`, untouched slots
+  keep their bytes, trash-slot collisions resolve last-write-wins in
+  sequential grid order — exactly the XLA scatter's semantics).
+- the attend is the unchanged ragged/grouped walk above (the fused op
+  CALLS the same kernel / reference dispatch), so every attention
+  guarantee — grouping, q8/fp8 lanes, causal tails — carries over.
+
+Off-TPU the fused op composes the SAME shared jnp expressions the
+unfused ops register (`paged_scatter`, `paged_scatter_q8`,
+`lora_delta`, the ragged references), so gate-on CPU serving is
+bit-identical to gate-off by construction — the oracle the engine
+tests pin. Greedy sampling + spec-decode acceptance fuse as separate
+epilogue ops over the logits tile (`decode_greedy_argmax`,
+`spec_verify_accept` — the verify columns' grammar bias masks are
+already additive operand data, so they compose unchanged).
+`count_page_block_reads(fused=...)` models both pipelines' HBM bytes
+so the cost census can assert bytes-accessed per token drops.
+
 INT8 LANE (`ragged_paged_attention_q8`): the same walk over an int8
 POOL — code pages [P, page_size, H_kv, D] int8 plus rowwise scale
 pages [P, page_size, H_kv] f32 (one scale per (position, kv head),
@@ -127,7 +166,12 @@ __all__ = ["paged_decode_attention", "paged_attention_reference",
            "ragged_attention_reference_q8", "dequantize_paged_q8",
            "ragged_paged_attention_grouped",
            "ragged_paged_attention_grouped_q8",
-           "count_page_block_reads", "FP8_DTYPE"]
+           "count_page_block_reads", "FP8_DTYPE",
+           "resolve_megakernel_flag", "MEGAKERNEL_ENV",
+           "quantize_kv_rowwise", "paged_scatter", "paged_scatter_q8",
+           "lora_delta", "lora_delta_paged", "megakernel_decode",
+           "megakernel_decode_q8", "decode_greedy_argmax",
+           "spec_verify_accept"]
 
 # interpret mode: run the kernel on CPU for testing (tests set this)
 _INTERPRET = os.environ.get("PADDLE_TPU_PALLAS_INTERPRET", "0") == "1"
@@ -157,6 +201,32 @@ def _use_kernel():
     except Exception:
         plat = "cpu"
     return plat == "tpu" or _INTERPRET
+
+
+# the decode-megakernel gate (see module doc): opt-in because the
+# fused ops trade per-op dispatch for one bigger program — the win is
+# real-chip launch overhead + HBM round-trips, which CPU tier-1 can
+# only model (count_page_block_reads(fused=...)), not time
+MEGAKERNEL_ENV = "PADDLE_TPU_MEGAKERNEL"
+
+
+def resolve_megakernel_flag(override=None):
+    """Resolve the decode-megakernel gate: explicit override wins,
+    else the PADDLE_TPU_MEGAKERNEL env var (on|off, default off) —
+    the same token set every other serving gate accepts."""
+    if override is not None:
+        if isinstance(override, bool):
+            return override
+        flag = str(override)
+    else:
+        flag = os.environ.get(MEGAKERNEL_ENV, "off")
+    low = flag.strip().lower()
+    if low in ("on", "1", "true", "yes"):
+        return True
+    if low in ("off", "0", "false", "no"):
+        return False
+    raise ValueError(
+        f"{MEGAKERNEL_ENV} / megakernel must be on|off, got {flag!r}")
 
 
 def _mask_to_additive(mask, b, h, lmax, lq=1):
@@ -1124,9 +1194,433 @@ def ragged_paged_attention_grouped_q8(q, k_pool, v_pool, k_scale,
                                      page_table, posv, qlv, mask)
 
 
+# ---------------------------------------------------------------------
+# Decode megakernel (PADDLE_TPU_MEGAKERNEL): the op-soup neighbors of
+# the walk — LoRA delta gather, quantize-then-scatter KV write, greedy
+# argmax / spec acceptance — as fused prologues/epilogues. The shared
+# jnp expression bodies live HERE and the unfused registered ops in
+# nlp/generation.py delegate to them, so fused and unfused paths are
+# the same floating-point program by construction (the CPU bit-identity
+# oracle), not two implementations that happen to agree.
+# ---------------------------------------------------------------------
+
+
+def quantize_kv_rowwise(u):
+    """Rowwise int8 quantization of K/V values [..., D]: one f32 scale
+    per leading row (per (token, kv head) in the paged pool), codes =
+    round(u / scale) clipped to [-127, 127]. Unlike the dense cache's
+    calibrated per-head CONSTANT scales (see _kv_update_q8_fwd), the
+    paged pool quantizes at WRITE time with the row's own absmax —
+    serving admits arbitrary traffic with no calibration pass, and the
+    scale rides in the page right next to its codes, so preemption
+    swap, COW copies and prefix sharing move (codes, scale) as one
+    unit and a later reader dequantizes to exactly the same floats.
+    Returns (codes int8 same shape, scales f32 u.shape[:-1])."""
+    uf = u.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(uf), axis=-1)
+    # written as a multiply by the f32 constant 1/127 (not a divide):
+    # XLA rewrites x / 127 into exactly this under jit, so spelling it
+    # out keeps eager and jitted scales BIT-identical — the roundtrip
+    # bit-exactness tests depend on it
+    scale = jnp.maximum(amax, jnp.float32(1e-8)) \
+        * jnp.float32(1.0 / 127.0)
+    codes = jnp.clip(jnp.round(uf / scale[..., None]),
+                     -127, 127).astype(jnp.int8)
+    return codes, scale
+
+
+def _paged_flat_slots(ps, pos, page_table, l):
+    """The ONE paged-write address map, shared by the XLA scatters and
+    the Pallas scatter kernels' prefetched indices: row b's token t
+    lands at logical position pos[b] + t, i.e. pool slot
+    page_table[b, p // page_size] * page_size + p % page_size.
+    Positions past the row's addressable window (chunk padding on the
+    last prefill chunk) redirect into page 0 — the reserved trash
+    page — so the write never needs a branch and never clobbers live
+    pages. Returns int32 [B, l] flat pool-slot indices."""
+    addressable = page_table.shape[1] * ps
+    p = pos.astype(jnp.int32)[:, None] + \
+        jnp.arange(l, dtype=jnp.int32)[None, :]          # [B, l] logical
+    pidx = jnp.clip(p // ps, 0, page_table.shape[1] - 1)
+    ids = jnp.take_along_axis(page_table.astype(jnp.int32), pidx,
+                              axis=1)                    # [B, l] pages
+    flat = ids * ps + p % ps
+    return jnp.where(p < addressable, flat, p % ps)      # OOB -> trash
+
+
+def paged_scatter(pool, upd, pos, page_table):
+    """Scatter upd [B, l, H, D] into the shared pool
+    [num_pages, page_size, H, D] (the `kv_cache_update_paged` op's
+    forward — see _paged_flat_slots for the address map, including the
+    trash-page redirect and the all-zero-table convention for
+    free/retired rows). One fixed-shape scatter serves decode (l=1,
+    batch B) and chunked prefill (l=chunk, batch 1) alike."""
+    ps = pool.shape[1]
+    l = upd.shape[1]
+    flat = _paged_flat_slots(ps, pos, page_table, l)
+    if _is_fp8(pool.dtype):
+        # fp8 lane: XLA's f32->e4m3 convert yields NaN past the
+        # format's range, not a saturate — clip to +-448 first so a
+        # pathological activation can never poison the pool
+        upd = jnp.clip(upd.astype(jnp.float32), -448.0, 448.0)
+    flat_pool = pool.reshape((-1,) + pool.shape[2:])
+    flat_pool = flat_pool.at[flat.reshape(-1)].set(
+        upd.astype(pool.dtype).reshape((-1,) + upd.shape[2:]))
+    return flat_pool.reshape(pool.shape)
+
+
+def paged_scatter_q8(pool, scale_pool, upd, pos, page_table):
+    """Quantize-then-scatter in ONE program (the
+    `kv_cache_update_paged_q8` op's forward): upd [B, l, H, D] is
+    rowwise-int8 quantized (quantize_kv_rowwise) and its codes land in
+    the int8 pool [num_pages, page_size, H, D] while the per-row
+    scales land at the SAME flat slots of the scale pool
+    [num_pages, page_size, H]. Address math identical to the float
+    scatter. Returns (pool, scale_pool)."""
+    ps = pool.shape[1]
+    l = upd.shape[1]
+    flat = _paged_flat_slots(ps, pos, page_table, l)
+    codes, scales = quantize_kv_rowwise(upd)   # [B,l,H,D] i8 / [B,l,H]
+    flat_pool = pool.reshape((-1,) + pool.shape[2:])
+    flat_pool = flat_pool.at[flat.reshape(-1)].set(
+        codes.reshape((-1,) + codes.shape[2:]))
+    flat_sc = scale_pool.reshape((-1,) + scale_pool.shape[2:])
+    flat_sc = flat_sc.at[flat.reshape(-1)].set(
+        scales.reshape((-1,) + scales.shape[2:]))
+    return (flat_pool.reshape(pool.shape),
+            flat_sc.reshape(scale_pool.shape))
+
+
+def _scatter_write_kernel(flat_ref, upd_ref, pool_ref, out_ref):
+    # grid step i owns token i's [1, H, D] tile; the out BlockSpec
+    # routes the write to pool slot flat[i], and the pool->out alias
+    # leaves every slot no grid step touches byte-identical
+    del flat_ref, pool_ref
+    out_ref[...] = upd_ref[...].astype(out_ref.dtype)
+
+
+def _paged_scatter_kernel(pool, upd, pos, page_table):
+    """Pallas paged KV scatter (the megakernel's write stage): the
+    flat slot of each of the B*l new tokens is prefetched as a scalar
+    and chased by the out BlockSpec's index map, so each grid step
+    DMAs one token's [H, D] tile straight into its pool slot.
+    `input_output_aliases` pins out to the pool operand — untouched
+    slots keep their bytes, and duplicate trash-slot writes resolve
+    last-write-wins under the sequential grid, exactly the XLA
+    scatter's semantics. fp8 pools clip to +-448 BEFORE the kernel
+    (same rationale as paged_scatter)."""
+    b, l, h, d = upd.shape
+    flat = _paged_flat_slots(pool.shape[1], pos, page_table, l)
+    if _is_fp8(pool.dtype):
+        upd = jnp.clip(upd.astype(jnp.float32), -448.0, 448.0)
+    flat_pool = pool.reshape((-1,) + pool.shape[2:])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * l,),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda i, f: (i, 0, 0)),
+            pl.BlockSpec((1, h, d), lambda i, f: (f[i], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda i, f: (f[i], 0, 0)),
+    )
+    from jax.experimental import disable_x64
+    with disable_x64():
+        out = pl.pallas_call(
+            _scatter_write_kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(flat_pool.shape,
+                                           pool.dtype),
+            # flattened-input indices COUNT the scalar-prefetch leaf:
+            # flat=0, upd=1, pool=2 (the jax megablox gmm convention)
+            input_output_aliases={2: 0},
+            compiler_params=pltpu.TPUCompilerParams(
+                dimension_semantics=("arbitrary",)),
+            interpret=_INTERPRET,
+        )(flat.reshape(-1), upd.reshape(b * l, h, d), flat_pool)
+    return out.reshape(pool.shape)
+
+
+def _scatter_q8_write_kernel(flat_ref, upd_ref, pool_ref, sc_pool_ref,
+                             code_ref, sc_ref):
+    # quantize-on-write: the SAME expressions as quantize_kv_rowwise,
+    # applied to this grid step's [1, H, D] tile while it is still in
+    # VMEM — codes and rowwise scales leave through the aliased pools
+    del flat_ref, pool_ref, sc_pool_ref
+    uf = upd_ref[...].astype(jnp.float32)
+    amax = jnp.max(jnp.abs(uf), axis=-1)
+    scale = jnp.maximum(amax, jnp.float32(1e-8)) \
+        * jnp.float32(1.0 / 127.0)
+    code_ref[...] = jnp.clip(jnp.round(uf / scale[..., None]),
+                             -127, 127).astype(code_ref.dtype)
+    sc_ref[...] = scale.astype(sc_ref.dtype)
+
+
+def _paged_scatter_q8_kernel(pool, scale_pool, upd, pos, page_table):
+    """Pallas quantize-then-scatter (the megakernel's q8 write stage):
+    same prefetched-slot routing as _paged_scatter_kernel, with the
+    rowwise int8 quantization fused into the write so the new token's
+    f32 K/V never round-trips HBM between projection and pool. Codes
+    and scales alias their pools; slot semantics as the fp kernel."""
+    b, l, h, d = upd.shape
+    flat = _paged_flat_slots(pool.shape[1], pos, page_table, l)
+    flat_pool = pool.reshape((-1,) + pool.shape[2:])
+    flat_sc = scale_pool.reshape((-1,) + scale_pool.shape[2:])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b * l,),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda i, f: (i, 0, 0)),
+            pl.BlockSpec((1, h, d), lambda i, f: (f[i], 0, 0)),
+            pl.BlockSpec((1, h), lambda i, f: (f[i], 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, h, d), lambda i, f: (f[i], 0, 0)),
+            pl.BlockSpec((1, h), lambda i, f: (f[i], 0)),
+        ],
+    )
+    from jax.experimental import disable_x64
+    with disable_x64():
+        codes, scales = pl.pallas_call(
+            _scatter_q8_write_kernel,
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct(flat_pool.shape, pool.dtype),
+                jax.ShapeDtypeStruct(flat_sc.shape, scale_pool.dtype),
+            ],
+            input_output_aliases={2: 0, 3: 1},
+            compiler_params=pltpu.TPUCompilerParams(
+                dimension_semantics=("arbitrary",)),
+            interpret=_INTERPRET,
+        )(flat.reshape(-1), upd.reshape(b * l, h, d), flat_pool,
+          flat_sc)
+    return (codes.reshape(pool.shape),
+            scales.reshape(scale_pool.shape))
+
+
+def lora_delta(x, a, b, scale):
+    """Per-row batched LoRA delta (the `lora_delta` op's forward —
+    multi-tenant adapter serving): x [B, W, in] hidden states,
+    a [B, in, R] / b [B, R, out] the rows' GATHERED low-rank pairs
+    (each row carries ITS OWN adapter's weights — tenant identity is
+    operand data, not a trace), scale [B] the per-row LoRA scaling
+    (alpha/r; 0 for base-model rows). Returns `(x @ a) @ b * scale`
+    in x's dtype — rank-R zero padding and the all-zero base page
+    contribute exactly 0, so base rows degenerate bit-exactly."""
+    t = jnp.einsum("bwi,bir->bwr", x, a.astype(x.dtype))
+    d = jnp.einsum("bwr,bro->bwo", t, b.astype(x.dtype))
+    return (d * scale[:, None, None].astype(x.dtype)).astype(x.dtype)
+
+
+def _lora_paged_kernel(page_ref, x_ref, a_ref, b_ref, s_ref, o_ref):
+    del page_ref
+    x = x_ref[...]                                # [1, W, IN]
+    a = a_ref[...].astype(x.dtype)                # [1, IN, R]
+    bw = b_ref[...].astype(x.dtype)               # [1, R, OUT]
+    t = jax.lax.dot_general(
+        x[0], a[0], (((1,), (0,)), ((), ())),
+        precision=_prec(x.dtype)).astype(x.dtype)
+    d = jax.lax.dot_general(
+        t, bw[0], (((1,), (0,)), ((), ())),
+        precision=_prec(x.dtype)).astype(x.dtype)
+    s = s_ref[0, 0].astype(x.dtype)
+    o_ref[...] = (d * s).astype(o_ref.dtype)[None]
+
+
+def lora_delta_paged(x, a_pool, b_pool, apage, ascale):
+    """Per-row PAGED LoRA delta (the megakernel's fused gather): the
+    same math as `lora_delta`, but each row's A/B pair is gathered
+    from the shared paged adapter pools INSIDE the op —
+    a_pool [P, in, R] / b_pool [P, R, out] are the WHOLE pools,
+    apage [B] int32 the rows' adapter page ids (0 = the reserved
+    all-zero base page, contributing exactly 0), ascale [B] f32 the
+    per-row scaling. On TPU (and interpret mode) a Pallas kernel's
+    BlockSpec index maps chase `apage` via scalar prefetch — row b's
+    adapter page streams through VMEM ONCE, the same trick the page
+    walk plays with `page_table`, instead of XLA materializing a
+    gathered [B, in, R] copy in HBM per projection. ascale rides as a
+    [B, 1] f32 VMEM operand (f32 can't share the int32 scalar-prefetch
+    lane). Off-TPU the forward IS gather + `lora_delta` — bit-identical
+    to the unfused in-trace path by construction."""
+    ap = apage.astype(jnp.int32)
+    sc = ascale.astype(jnp.float32)
+    if not _use_kernel():
+        a = jnp.take(a_pool, ap, axis=0)
+        b = jnp.take(b_pool, ap, axis=0)
+        return lora_delta(x, a, b, sc)
+    bsz, w, cin = x.shape
+    r, cout = a_pool.shape[2], b_pool.shape[2]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bsz,),
+        in_specs=[
+            pl.BlockSpec((1, w, cin), lambda i, p: (i, 0, 0)),
+            pl.BlockSpec((1, cin, r), lambda i, p: (p[i], 0, 0)),
+            pl.BlockSpec((1, r, cout), lambda i, p: (p[i], 0, 0)),
+            pl.BlockSpec((1, 1), lambda i, p: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, w, cout), lambda i, p: (i, 0, 0)),
+    )
+    from jax.experimental import disable_x64
+    with disable_x64():
+        out = pl.pallas_call(
+            _lora_paged_kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((bsz, w, cout), x.dtype),
+            compiler_params=pltpu.TPUCompilerParams(
+                dimension_semantics=("arbitrary",)),
+            interpret=_INTERPRET,
+        )(ap, x, a_pool, b_pool, sc.reshape(bsz, 1))
+    return out
+
+
+def _megakernel_lora_prologue(q, k_new, v_new, rest):
+    """Add the rows' paged q/k/v LoRA deltas to the base projections
+    (the megakernel's prologue). Deltas are computed on the flat
+    [B, W, out] view and reshaped — elementwise add commutes with
+    reshape bit-exactly, so this matches the unfused model path that
+    adds before the head split."""
+    x, aq, bq, ak, bk, av, bv, apage, ascale = rest
+    q = q + lora_delta_paged(x, aq, bq, apage, ascale).reshape(q.shape)
+    k_new = k_new + lora_delta_paged(x, ak, bk, apage,
+                                     ascale).reshape(k_new.shape)
+    v_new = v_new + lora_delta_paged(x, av, bv, apage,
+                                     ascale).reshape(v_new.shape)
+    return q, k_new, v_new
+
+
+def megakernel_decode(q, k_new, v_new, k_pool, v_pool, page_table,
+                      pos, q_len, *rest, grouped=False, lora=False):
+    """The fused decode layer (fp / fp8 pools — gated
+    PADDLE_TPU_MEGAKERNEL, see module doc): LoRA prologue (when
+    `lora`, `rest` carries (x, aq, bq, ak, bk, av, bv, apage,
+    ascale) after the group triple) -> paged scatter of the new K/V
+    (Pallas in-place kernel on TPU/interpret, the shared XLA scatter
+    off-TPU) -> the unchanged ragged[-grouped] walk over the updated
+    pools (when `grouped`, `rest` leads with (group_id, group_leader,
+    group_cnt)). Returns (out, k_pool, v_pool). Off-TPU every stage
+    IS the unfused ops' shared forward, so gate-on CPU serving is
+    bit-identical to gate-off by construction."""
+    rest = list(rest)
+    group = None
+    if grouped:
+        group, rest = rest[:3], rest[3:]
+    if lora:
+        q, k_new, v_new = _megakernel_lora_prologue(q, k_new, v_new,
+                                                    rest)
+    if _use_kernel():
+        k_pool = _paged_scatter_kernel(k_pool, k_new, pos, page_table)
+        v_pool = _paged_scatter_kernel(v_pool, v_new, pos, page_table)
+    else:
+        k_pool = paged_scatter(k_pool, k_new, pos, page_table)
+        v_pool = paged_scatter(v_pool, v_new, pos, page_table)
+    if grouped:
+        out = ragged_paged_attention_grouped(
+            q, k_pool, v_pool, page_table, pos, q_len, *group)
+    else:
+        out = ragged_paged_attention(q, k_pool, v_pool, page_table,
+                                     pos, q_len)
+    return out, k_pool, v_pool
+
+
+def megakernel_decode_q8(q, k_new, v_new, k_pool, v_pool,
+                         k_scale_pool, v_scale_pool, page_table, pos,
+                         q_len, *rest, grouped=False, lora=False):
+    """int8 lane of the fused decode layer: LoRA prologue ->
+    quantize-then-scatter (rowwise codes + scales produced in the
+    same kernel pass that reads the new token's K/V) -> the q8
+    ragged[-grouped] walk. `rest` layout as megakernel_decode.
+    Returns (out, k_pool, v_pool, k_scale_pool, v_scale_pool)."""
+    rest = list(rest)
+    group = None
+    if grouped:
+        group, rest = rest[:3], rest[3:]
+    if lora:
+        q, k_new, v_new = _megakernel_lora_prologue(q, k_new, v_new,
+                                                    rest)
+    if _use_kernel():
+        k_pool, k_scale_pool = _paged_scatter_q8_kernel(
+            k_pool, k_scale_pool, k_new, pos, page_table)
+        v_pool, v_scale_pool = _paged_scatter_q8_kernel(
+            v_pool, v_scale_pool, v_new, pos, page_table)
+    else:
+        k_pool, k_scale_pool = paged_scatter_q8(
+            k_pool, k_scale_pool, k_new, pos, page_table)
+        v_pool, v_scale_pool = paged_scatter_q8(
+            v_pool, v_scale_pool, v_new, pos, page_table)
+    if grouped:
+        out = ragged_paged_attention_grouped_q8(
+            q, k_pool, v_pool, k_scale_pool, v_scale_pool, page_table,
+            pos, q_len, *group)
+    else:
+        out = ragged_paged_attention_q8(
+            q, k_pool, v_pool, k_scale_pool, v_scale_pool, page_table,
+            pos, q_len)
+    return out, k_pool, v_pool, k_scale_pool, v_scale_pool
+
+
+def _argmax_epilogue_kernel(x_ref, o_ref):
+    # one grid step per batch row; the whole vocab row rides one VMEM
+    # block (V f32 « VMEM), so the reduction never leaves the tile.
+    # first-max tie-breaking == jnp.argmax: min index among positions
+    # equal to the row max
+    x = x_ref[...].astype(jnp.float32)               # [1, V]
+    m = jnp.max(x, axis=1, keepdims=True)
+    idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    first = jnp.min(jnp.where(x == m, idx, x.shape[1]), axis=1)
+    # int32 output keeps the lane dim: broadcast across _LANES and
+    # let the caller slice column 0
+    o_ref[...] = jnp.broadcast_to(first[:, None], o_ref.shape)
+
+
+def decode_greedy_argmax(logits):
+    """Greedy-sampling epilogue over the logits tile [B, V] -> int32
+    [B] (gated with the megakernel): on TPU/interpret the argmax
+    reduces on-tile in a Pallas kernel (first-occurrence tie-breaking,
+    bit-identical to jnp.argmax); off-TPU it IS jnp.argmax — the
+    exact expression the unfused sampler computes."""
+    if not _use_kernel():
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    b, v = logits.shape
+    from jax.experimental import disable_x64
+    with disable_x64():
+        out = pl.pallas_call(
+            _argmax_epilogue_kernel,
+            grid=(b,),
+            in_specs=[pl.BlockSpec((1, v), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((1, _LANES), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((b, _LANES), jnp.int32),
+            compiler_params=pltpu.TPUCompilerParams(
+                dimension_semantics=("arbitrary",)),
+            interpret=_INTERPRET,
+        )(logits)
+    return out[:, 0]
+
+
+def spec_verify_accept(logits_v, toks, q_len, is_decode):
+    """Fused spec-decode acceptance epilogue: logits_v [B, W, V] the
+    verify columns' logits (grammar bias masks, when constrained, are
+    ALREADY added upstream — they are additive operand data, so
+    violating drafts die in this same greedy acceptance), toks [B, W]
+    the packed draft tokens, q_len [B] int32, is_decode [B] bool.
+    Returns int32 [B] accepted-prefix lengths — the EXACT acceptance
+    expressions the unified step's in-trace epilogue computes, with
+    the per-column argmax routed through `decode_greedy_argmax` so the
+    gate-on path reduces on-tile."""
+    b, w, v = logits_v.shape
+    preds = decode_greedy_argmax(
+        logits_v.reshape(b * w, v)).reshape(b, w)
+    match = toks[:, 1:] == preds[:, :-1]
+    dcol = jnp.arange(w - 1, dtype=jnp.int32)[None, :]
+    valid = dcol < (q_len.astype(jnp.int32) - 1)[:, None]
+    accept = jnp.cumprod(
+        jnp.where(match & valid, 1, 0), axis=1).sum(axis=1) \
+        .astype(jnp.int32)
+    return jnp.where(is_decode, accept, 0)
+
+
 def count_page_block_reads(page_table, pos, q_len, group_id=None,
                            group_cnt=None, *, page_size, n_kv=1,
-                           mp=1):
+                           mp=1, fused=None):
     """Host-side (numpy) model of the kernels' page-block DMA traffic
     for ONE (kv_head, layer) walk — the number the serving metrics and
     the `--prefix-share` bench A/B report, and what tests pin.
@@ -1146,7 +1640,26 @@ def count_page_block_reads(page_table, pos, q_len, group_id=None,
     shards), and each block read moves a 1/mp page slice, so per-chip
     reads (and the grouped walk's per-chip reads SAVED) drop by mp.
     The defaults (n_kv=1, mp=1) keep the single-walk numbers every
-    pre-mesh pin was written against."""
+    pre-mesh pin was written against.
+
+    `fused=` (the megakernel's referee): pass a dict
+    {"head_dim": D, "kv_elt": bytes/KV element (4 f32, 2 bf16,
+    1 int8/fp8), "scale_elt": bytes/scale element per token-head
+    (4 when int8 rowwise scales exist, else 0), "lora_bytes": the
+    step's adapter-page bytes for ONE projection's A/B stream (0
+    without adapters)} and a fourth return slots in: a dict of
+    modeled HBM bytes for this (kv_head, layer) walk under BOTH
+    pipelines, {"unfused": ..., "fused": ...}. Shared by both:
+    `attn` (the grouped walk's page-block K+V stream, codes+scales)
+    and `write` (the new tokens' committed pool bytes). The UNFUSED
+    pipeline additionally pays `stage` — the new tokens' f32 K/V
+    round-tripping HBM between the projection and the standalone
+    scatter dispatch (the megakernel consumes them in VMEM) — and
+    gathers the adapter page PER PROJECTION (3x lora_bytes for
+    q/k/v) where the fused prologue streams it once. The o-delta
+    stays outside the megakernel in both pipelines and is excluded.
+    fused < unfused whenever any row is live — the strict drop the
+    census asserts."""
     pos = np.asarray(pos, np.int64)
     q_len = np.asarray(q_len, np.int64)
     ps = int(page_size)
@@ -1156,19 +1669,36 @@ def count_page_block_reads(page_table, pos, q_len, group_id=None,
     local_heads = max(1, int(n_kv) // max(1, int(mp)))
     flat = int(row_pages.sum()) * local_heads
     if group_id is None or group_cnt is None:
-        return flat, flat, []
-    group_id = np.asarray(group_id, np.int64)
-    group_cnt = np.asarray(group_cnt, np.int64)
-    grouped = 0
-    sizes = []
-    for g in np.unique(group_id[live]):
-        members = np.nonzero(live & (group_id == g))[0]
-        cnt = int(group_cnt[g])
-        shared = min(cnt, int(row_pages[members].min())) \
-            if members.size else 0
-        # the shared span streams once; each member walks its tail
-        grouped += shared
-        grouped += int((row_pages[members] - shared).sum())
-        if members.size >= 2 and shared > 0:
-            sizes.append(int(members.size))
-    return flat, grouped * local_heads, sizes
+        grouped_total = flat
+        sizes = []
+    else:
+        group_id = np.asarray(group_id, np.int64)
+        group_cnt = np.asarray(group_cnt, np.int64)
+        grouped = 0
+        sizes = []
+        for g in np.unique(group_id[live]):
+            members = np.nonzero(live & (group_id == g))[0]
+            cnt = int(group_cnt[g])
+            shared = min(cnt, int(row_pages[members].min())) \
+                if members.size else 0
+            # the shared span streams once; each member walks its tail
+            grouped += shared
+            grouped += int((row_pages[members] - shared).sum())
+            if members.size >= 2 and shared > 0:
+                sizes.append(int(members.size))
+        grouped_total = grouped * local_heads
+    if fused is None:
+        return flat, grouped_total, sizes
+    d = int(fused["head_dim"])
+    kv_elt = int(fused.get("kv_elt", 4))
+    scale_elt = int(fused.get("scale_elt", 0))
+    lora_bytes = int(fused.get("lora_bytes", 0))
+    # K and V streams both (x2); a block moves page_size tokens of
+    # (codes + rowwise scales) for one local head
+    attn = grouped_total * ps * (d * kv_elt + scale_elt) * 2
+    new_tokens = int(q_len[live].sum())
+    write = new_tokens * local_heads * (d * kv_elt + scale_elt) * 2
+    stage = new_tokens * local_heads * d * 4 * 2
+    walk_bytes = {"unfused": attn + write + stage + 3 * lora_bytes,
+                  "fused": attn + write + lora_bytes}
+    return flat, grouped_total, sizes, walk_bytes

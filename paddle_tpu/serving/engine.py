@@ -188,12 +188,15 @@ import jax.numpy as jnp
 
 from ..core import dtype as dtypes
 from ..core import random as random_mod
-from ..core.tensor import Tensor
+from ..core import tensor as tensor_mod
+from ..core.dispatch import get_op
+from ..core.tensor import Tensor, set_dispatch_probe
 from ..profiler import RecordEvent
 from ..nlp.generation import (_pack_caches, _top_p_filter,
                               _unpack_caches, decode_model_step,
                               resolve_paged_attn_impl, FP8_DTYPE)
-from ..ops.pallas.paged_attention import count_page_block_reads
+from ..ops.pallas.paged_attention import (count_page_block_reads,
+                                          resolve_megakernel_flag)
 from .adapters import (AdapterStore, BASE_ADAPTER,
                        resolve_adapters_flag)
 from .errors import DeadlineExceeded, EngineClosed, PoisonedRequest
@@ -338,15 +341,19 @@ def resolve_unified_flag(override=None) -> bool:
     return v == "on"
 
 
-def _sample_rows(logits, key, temps, top_k, top_p, greedy):
+def _sample_rows(logits, key, temps, top_k, top_p, greedy, argmax=None):
     """Per-slot sampling over f32 logits [S, V]: each row applies ITS
     OWN temperature/top-k/top-p (vectors [S]); greedy rows take argmax
     of the raw logits — exactly CompiledGenerator's greedy step, so
     greedy requests stay bit-identical to offline decode. top_k == 0
     and top_p == 1.0 disable the respective filter for that row; the
-    nucleus mask is the same `_top_p_filter` the offline path uses."""
+    nucleus mask is the same `_top_p_filter` the offline path uses.
+    `argmax` lets the megakernel path hand in the fused
+    decode_greedy_argmax epilogue's result (bit-identical to
+    jnp.argmax by the first-occurrence tie rule) instead of computing
+    it again here."""
     v = logits.shape[-1]
-    g = jnp.argmax(logits, axis=-1)
+    g = jnp.argmax(logits, axis=-1) if argmax is None else argmax
     l = logits / temps[:, None]
     sorted_desc = -jnp.sort(-l, axis=-1)
     kidx = (jnp.clip(top_k, 1, v) - 1).astype(jnp.int32)
@@ -384,7 +391,7 @@ class ServingEngine:
                  adapter_pages: Optional[int] = None,
                  adapter_ranks: Optional[Sequence[int]] = None,
                  slo=None, cost_census=None, grammar=None,
-                 session_ttl_s: float = 30.0):
+                 megakernel=None, session_ttl_s: float = 30.0):
         if cache_spec is None:
             if not hasattr(model, "_decode_cache_spec"):
                 raise ValueError(
@@ -512,10 +519,28 @@ class ServingEngine:
         # walk; on the legacy/gather paths the flag is inert.
         self.grouped = (resolve_grouped_flag(grouped) and self.unified
                         and self.attn_impl == "kernel")
+        # decode MEGAKERNEL (ops/pallas/paged_attention.py, default
+        # off, gated PADDLE_TPU_MEGAKERNEL / megakernel=): the unified
+        # step's per-layer scatter(+quantize)+attend op pair — and,
+        # with adapters, the per-projection LoRA gathers — collapse
+        # into ONE megakernel_decode[_q8] dispatch per layer, with
+        # greedy argmax + spec acceptance as fused epilogue ops over
+        # the logits tile. Only the unified + kernel path has a fused
+        # form (silent downgrade, mirroring the grouped gate); a tp
+        # mesh keeps the unfused path — in-place pool aliasing across
+        # shards is not in this PR's oracle matrix. Outputs are
+        # bit-identical either way (the shared-forward construction);
+        # the referees are the launch-count probe and the fused-byte
+        # census, not the floats.
+        self.megakernel = (resolve_megakernel_flag(megakernel)
+                           and self.unified
+                           and self.attn_impl == "kernel"
+                           and self.tp is None)
         self.metrics = metrics or ServingMetrics()
         self.metrics.attn_impl = self.attn_impl
         self.metrics.unified = self.unified
         self.metrics.grouped = self.grouped
+        self.metrics.megakernel = self.megakernel
         self.metrics.spec = (None if self.spec is None
                              else self.spec.mode)
         self.metrics.grammar = self.grammar_on
@@ -583,6 +608,17 @@ class ServingEngine:
         self._apage = np.zeros((self.num_slots,), np.int32)
         self._ascale = np.zeros((self.num_slots,), np.float32)
         self._slot_adapter: Dict[int, int] = {}
+        # modeled HBM bytes of ONE projection's adapter A/B page for
+        # one row (pool rank R): the unfused path streams it once per
+        # q/k/v projection, the megakernel streams it once total —
+        # the lora term of the fused-byte census
+        # (count_page_block_reads fused=)
+        self._adapter_row_bytes = 0
+        if self.adapters is not None:
+            ad = self.adapters
+            self._adapter_row_bytes = int(
+                (ad.hidden * ad.rank + ad.rank * ad.q_out)
+                * jnp.dtype(ad.dtype).itemsize)
         # paged-pool dtype (PADDLE_TPU_KV_DTYPE / kv_dtype=, default
         # "fp"): "int8" swaps every layer's float pools for int8 CODE
         # pages plus rowwise f32 SCALE pages [num_pages, page_size,
@@ -797,6 +833,14 @@ class ServingEngine:
         self._census: Optional[dict] = None
         self._census_captures = 0
         self._census_lock = threading.Lock()
+        # megakernel referees, refreshed per packed step and attached
+        # to the census on read: the launch-count probe's last TRACED
+        # dispatch histogram (registered-op launches per unified step
+        # — non-None only after a (re)trace; compiled replays run no
+        # Python dispatch) and the fused-vs-unfused modeled page-walk
+        # bytes of the last step (count_page_block_reads fused=)
+        self._dispatch_counts: Optional[dict] = None
+        self._last_walk_bytes: Optional[dict] = None
         self.step_capacity_tokens = self.num_slots * self.chunk_len
         self.metrics.step_capacity_tokens = self.step_capacity_tokens
         # engine step counter (timeline/flight step index) + the
@@ -834,6 +878,18 @@ class ServingEngine:
     def _slo_snap(self) -> Optional[dict]:
         return None if self.slo is None else self.slo.snapshot()
 
+    def _dispatch(self, name, *vals):
+        """Run a registered op's forward on RAW jnp values, firing the
+        launch-count probe exactly like apply_op's traced branch. The
+        fused epilogue ops (decode_greedy_argmax, spec_verify_accept)
+        run inside the unified trace on bare arrays — no Tensor boxing
+        — but they must still land in the per-step dispatch histogram
+        the megakernel A/B asserts on."""
+        probe = tensor_mod._dispatch_probe
+        if probe is not None:
+            probe(name)
+        return get_op(name).fwd(*vals)
+
     def cost_census(self) -> Optional[dict]:
         """The compiled-step cost census (None with the gate off):
         FLOPs + bytes accessed of THE one unified program's capacity,
@@ -858,6 +914,24 @@ class ServingEngine:
                 "pages_recv": self._fabric_pages_recv,
                 "bytes_recv": self._fabric_bytes_recv,
             }
+            # megakernel referees ride the same record (refreshed on
+            # read, like the fabric counters): fused vs unfused are
+            # bit-identical in floats, so launches and modeled bytes
+            # ARE the observable difference
+            if self._dispatch_counts is not None:
+                self._census["unified_dispatch"] = dict(
+                    self._dispatch_counts, megakernel=self.megakernel)
+            if self._last_walk_bytes is not None:
+                wb = self._last_walk_bytes
+                tok = max(1, int(wb["tokens"]))
+                self._census["page_walk"] = {
+                    "megakernel": self.megakernel,
+                    "modeled_step_bytes": {"unfused": wb["unfused"],
+                                           "fused": wb["fused"]},
+                    "modeled_bytes_per_token": {
+                        "unfused": wb["unfused"] / tok,
+                        "fused": wb["fused"] / tok},
+                }
         self.metrics.cost_census = self._census
         return self._census
 
@@ -1017,8 +1091,15 @@ class ServingEngine:
                 # biases must not bank).
                 samp_in = (last_logits if gsamp is None
                            else last_logits + gsamp)
+                # megakernel epilogue: the greedy argmax over the held
+                # logits is a registered fused op (bit-identical
+                # first-occurrence tie rule), handed into _sample_rows
+                # so greedy rows never recompute it
+                argmax0 = (self._dispatch("decode_greedy_argmax",
+                                          samp_in)
+                           if self.megakernel else None)
                 nxt = _sample_rows(samp_in, key, temps, top_k,
-                                   top_p, greedy)
+                                   top_p, greedy, argmax=argmax0)
                 nxt = jnp.where(is_decode, nxt, 0).astype(jnp.int32)
                 col0 = (jnp.arange(tokens.shape[1], dtype=jnp.int32)
                         == 0)[None, :]
@@ -1031,16 +1112,30 @@ class ServingEngine:
                 # Base-model and idle rows gather the all-zero page 0
                 # at scale 0: an exactly-zero delta.
                 lora_layers = None
+                lora_paged_layers = None
                 if lora is not None:
                     apools, apage, ascale = lora
-                    lora_layers = [
-                        tuple(t[apage] for t in layer) + (ascale,)
-                        for layer in apools]
+                    if self.megakernel:
+                        # megakernel mode: hand each layer the FULL
+                        # pools plus the per-row page/scale operands —
+                        # the gather happens INSIDE the fused attend
+                        # prologue (and lora_delta_paged for the
+                        # o-projection), one adapter-page stream per
+                        # row instead of one per projection
+                        lora_paged_layers = [
+                            tuple(layer) + (apage, ascale)
+                            for layer in apools]
+                    else:
+                        lora_layers = [
+                            tuple(t[apage] for t in layer) + (ascale,)
+                            for layer in apools]
                 caches = _unpack_caches(ct, pos, page_table,
                                         attn_impl=self.attn_impl,
                                         q_len=q_len, group=group,
                                         out_shard=self._out_shard,
-                                        lora=lora_layers)
+                                        lora=lora_layers,
+                                        lora_paged=lora_paged_layers,
+                                        megakernel=self.megakernel)
                 logits_t, caches = model(Tensor(toks), caches=caches)
                 lg = logits_t._value.astype(jnp.float32)   # [S, W, V]
                 # greedy draft verification: column i's argmax is the
@@ -1057,15 +1152,26 @@ class ServingEngine:
                 # — no second program. Only `preds` sees the bias;
                 # row_last below reads the unbiased lg.
                 lg_v = lg if gver is None else lg + gver
-                preds = jnp.argmax(lg_v, axis=-1).astype(jnp.int32)
-                match = (toks[:, 1:] == preds[:, :-1])
-                dcol = jnp.arange(tokens.shape[1] - 1,
-                                  dtype=jnp.int32)[None, :]
-                valid = dcol < (q_len - 1)[:, None]
-                accept = jnp.cumprod(
-                    jnp.where(match & valid, 1, 0), axis=1
-                ).sum(axis=1).astype(jnp.int32)
-                accept = jnp.where(is_decode, accept, 0)
+                if self.megakernel:
+                    # fused acceptance epilogue: the registered op is
+                    # the SAME expressions as the inline branch below
+                    # (argmax -> prefix match -> cumprod -> mask), so
+                    # tokens stay bit-identical; it exists so the
+                    # whole accept chain is ONE dispatched op the
+                    # launch census can count
+                    accept = self._dispatch("spec_verify_accept",
+                                            lg_v, toks, q_len,
+                                            is_decode)
+                else:
+                    preds = jnp.argmax(lg_v, axis=-1).astype(jnp.int32)
+                    match = (toks[:, 1:] == preds[:, :-1])
+                    dcol = jnp.arange(tokens.shape[1] - 1,
+                                      dtype=jnp.int32)[None, :]
+                    valid = dcol < (q_len - 1)[:, None]
+                    accept = jnp.cumprod(
+                        jnp.where(match & valid, 1, 0), axis=1
+                    ).sum(axis=1).astype(jnp.int32)
+                    accept = jnp.where(is_decode, accept, 0)
                 last_idx = jnp.where(is_decode, accept,
                                      jnp.maximum(q_len - 1, 0))
                 row_last = jnp.take_along_axis(
@@ -2318,23 +2424,42 @@ class ServingEngine:
         # per-chip reads AND per-chip reads saved drop by mp
         shard = dict(n_kv=self.n_kv, mp=self.mp) \
             if self.tp is not None else {}
+        # fused-byte model inputs (megakernel referee): per-element
+        # widths of the local KV lane + the per-row adapter stream
+        # bytes for rows that actually carry a non-base adapter page
+        kv_elt = (1 if self.kv_dtype in ("int8", "fp8")
+                  else int(jnp.dtype(self._fp).itemsize))
+        scale_elt = 4 if self.kv_dtype == "int8" else 0
+        lora_rows = (int(np.count_nonzero(self._apage[q_len > 0]))
+                     if self.adapters is not None else 0)
+        fused_spec = dict(head_dim=self.head_dim, kv_elt=kv_elt,
+                          scale_elt=scale_elt,
+                          lora_bytes=lora_rows
+                          * self._adapter_row_bytes)
         group_args = ()
         if self.grouped:
             gid, gld, gcn = shared_prefix_groups(self._pt_host, q_len)
             group_args = (self._dev(gid), self._dev(gld),
                           self._dev(gcn))
-            flat_reads, step_reads, group_sizes = \
+            flat_reads, step_reads, group_sizes, walk_bytes = \
                 count_page_block_reads(self._pt_host, pos_host, q_len,
                                        gid, gcn,
                                        page_size=self.page_size,
-                                       **shard)
+                                       fused=fused_spec, **shard)
         else:
-            flat_reads, step_reads, group_sizes = \
+            flat_reads, step_reads, group_sizes, walk_bytes = \
                 count_page_block_reads(self._pt_host, pos_host, q_len,
                                        page_size=self.page_size,
-                                       **shard)
+                                       fused=fused_spec, **shard)
         self.metrics.on_grouped_step(flat_reads, step_reads,
                                      group_sizes)
+        # per-layer walk bytes -> whole-step modeled bytes: every
+        # layer's attention issues the same walk over its own pools
+        self._last_walk_bytes = {
+            "unfused": int(walk_bytes["unfused"]) * self.n_layers,
+            "fused": int(walk_bytes["fused"]) * self.n_layers,
+            "tokens": int(q_len.sum()),
+        }
         self._round_stats["reads_saved"] += \
             int(flat_reads) - int(step_reads)
         key = random_mod.next_key_host()
@@ -2436,11 +2561,33 @@ class ServingEngine:
         # operand pytree (the live self._ct stands in for the pools)
         # the one trace lowers against — [S]-sized arrays, not pools
         self._unified_args_tail = args_tail
-        with RecordEvent("serving::unified_step"):
-            self._ct, self._pos, self._last_logits, toks, accept = \
-                self._unified_fn(self._ct, *args_tail)
-            toks = np.asarray(toks)   # sync point: host sees the tokens
-            accept = np.asarray(accept)
+        # launch-count probe: count registered-op dispatches while the
+        # launch runs. Only a (re)trace walks the Python op layer —
+        # compiled replays leave `counts` empty — so the histogram is
+        # the per-step LAUNCH census of the one program, captured once
+        # per compile at zero steady-state cost. Trace-time counting
+        # is deliberate: post-compile HLO computation counts would
+        # reflect the backend's fusion heuristics, not this codebase's
+        # op granularity.
+        counts: Dict[str, int] = {}
+        prev_probe = set_dispatch_probe(
+            lambda name: counts.__setitem__(name,
+                                            counts.get(name, 0) + 1))
+        try:
+            with RecordEvent("serving::unified_step"):
+                self._ct, self._pos, self._last_logits, toks, accept = \
+                    self._unified_fn(self._ct, *args_tail)
+                toks = np.asarray(toks)  # sync: host sees the tokens
+                accept = np.asarray(accept)
+        finally:
+            set_dispatch_probe(prev_probe)
+        if counts:
+            self._dispatch_counts = {
+                "total": int(sum(counts.values())),
+                "ops": dict(sorted(counts.items())),
+            }
+            self.metrics.unified_dispatch_ops = \
+                self._dispatch_counts["total"]
         self.step_tokens_inflight = 0
         self._beat()
         n_prefill = int(sum(grants.values()))

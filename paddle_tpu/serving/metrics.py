@@ -240,6 +240,15 @@ class ServingMetrics:
         self.grouped: Optional[bool] = None
         self.page_block_reads = 0
         self.shared_page_reads_saved = 0
+        # decode megakernel (ops/pallas/paged_attention.py): whether
+        # the engine fuses the per-layer scatter+attend(+LoRA) into
+        # one dispatch — the A/B tag — and the launch-count probe's
+        # registered-op dispatches in the last TRACED unified step
+        # (None until a trace runs; fewer with the megakernel on is
+        # the fusion's whole observable claim, since outputs are
+        # bit-identical)
+        self.megakernel: Optional[bool] = None
+        self.unified_dispatch_ops: Optional[int] = None
         # speculative decoding (serving/spec.py): the drafter mode tag
         # ("ngram"; None = off) — third A/B label next to
         # attn_impl/unified — plus the drafted-vs-accepted economics:
@@ -691,6 +700,8 @@ class ServingMetrics:
             "page_block_reads_total": self.page_block_reads,
             "shared_page_reads_saved_total":
                 self.shared_page_reads_saved,
+            "megakernel": self.megakernel,
+            "unified_dispatch_ops": self.unified_dispatch_ops,
             "group_size_per_step": self.group_size_hist.snapshot(),
             "prefill_stall_steps": self.prefill_stall_steps,
             "decode_step_s": self.decode_step_s.snapshot(),
@@ -854,6 +865,7 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                        ("grammar_rejected_drafts_total", "counter"),
                        ("prefix_pinned_pages", "gauge"),
                        ("page_block_reads_total", "counter"),
+                       ("unified_dispatch_ops", "gauge"),
                        ("shared_page_reads_saved_total", "counter"),
                        ("group_size_per_step", "histogram"),
                        ("packed_tokens_per_step", "histogram"),
@@ -895,7 +907,9 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                 "dp": snap.get("dp", 1) or 1,
                 "adapters": ("on" if snap.get("adapters_enabled")
                              else "off"),
-                "grammar": ("on" if snap.get("grammar") else "off")})
+                "grammar": ("on" if snap.get("grammar") else "off"),
+                "megakernel": ("on" if snap.get("megakernel")
+                               else "off")})
             + " 1")
         ad = snap.get("adapters")
         if ad is not None:
@@ -927,6 +941,10 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
         if snap.get("group_size_per_step") is not None:
             _hist_lines(f"{namespace}_group_size_per_step",
                         snap["group_size_per_step"], lab, lines)
+        if snap.get("unified_dispatch_ops") is not None:
+            lines.append(f"{namespace}_unified_dispatch_ops"
+                         + _fmt_labels(lab)
+                         + f" {snap.get('unified_dispatch_ops')}")
         lines.append(f"{namespace}_unified_steps_total"
                      + _fmt_labels(lab)
                      + f" {snap.get('unified_steps', 0)}")

@@ -240,6 +240,46 @@ def _cases():
                  [0, 4, 5, 6, 7, 0, 0, 0], np.int32)),
              paddle.to_tensor(np.asarray(
                  [4, 0, 0, 0, 0, 0, 0, 0], np.int32)))),
+        # decode MEGAKERNEL, greedy-epilogue variant: the fused
+        # scatter+attend over 8 decode rows (q_len 1) immediately
+        # followed by the decode_greedy_argmax epilogue over a held
+        # [S, V] logits tile — the gate-on hot pair the unified step
+        # dispatches per decode layer + once per step
+        "megakernel_decode_greedy": lambda: (
+            lambda q, kn, vn, kp, vp, pt, pos, ql, lg: (
+                apply_op("megakernel_decode", q, kn, vn, kp, vp, pt,
+                         pos, ql),
+                apply_op("decode_greedy_argmax", lg)),
+            (t(8, 1, 8, 64), t(8, 1, 8, 64), t(8, 1, 8, 64),
+             t(65, 16, 8, 64), t(65, 16, 8, 64),
+             paddle.to_tensor(np.arange(1, 65, dtype=np.int32)
+                              .reshape(8, 8)),
+             paddle.to_tensor(np.full((8,), 100, np.int32)),
+             paddle.to_tensor(np.ones((8,), np.int32)),
+             t(8, 4096))),
+        # ...and its LoRA-prologue variant: the same fused decode
+        # walk with 9 extra operands — per-row hidden states, full
+        # A/B adapter pools for q/k/v and the page/scale row operands
+        # — so the per-row low-rank deltas ride the kernel prologue
+        # (the multi-tenant gate-on shape)
+        "megakernel_decode_lora": lambda: (
+            lambda q, kn, vn, kp, vp, pt, pos, ql, x, aq, bq, ak, bk,
+            av, bv, ap, asc: apply_op(
+                "megakernel_decode", q, kn, vn, kp, vp, pt, pos, ql,
+                x, aq, bq, ak, bk, av, bv, ap, asc,
+                attrs=dict(lora=True)),
+            (t(8, 1, 8, 64), t(8, 1, 8, 64), t(8, 1, 8, 64),
+             t(65, 16, 8, 64), t(65, 16, 8, 64),
+             paddle.to_tensor(np.arange(1, 65, dtype=np.int32)
+                              .reshape(8, 8)),
+             paddle.to_tensor(np.full((8,), 100, np.int32)),
+             paddle.to_tensor(np.ones((8,), np.int32)),
+             t(8, 1, 256),
+             t(3, 256, 4), t(3, 4, 512), t(3, 256, 4), t(3, 4, 512),
+             t(3, 256, 4), t(3, 4, 512),
+             paddle.to_tensor(np.asarray(
+                 [0, 1, 2, 0, 1, 2, 0, 0], np.int32)),
+             paddle.to_tensor(np.full((8,), 0.5, np.float32)))),
     }
     return cases
 

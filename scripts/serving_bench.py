@@ -11,7 +11,7 @@ in the bench trajectory. Prints ONE JSON line and writes the same
 stable-schema report to BENCH_serving.json (override with --out,
 suppress with --out -):
 
-    {"bench": "serving", "schema_version": 17, "attn_impl": "kernel",
+    {"bench": "serving", "schema_version": 18, "attn_impl": "kernel",
      "requests": ..., "ttft_p50_s": ..., "tokens_per_sec": ...,
      "decode_step_ms_p50": ..., "ab": {"kernel": {...},
      "gather": {...}}, "prefix_stats": {...}, "unified": {...},
@@ -55,6 +55,18 @@ in both constrained arms, >= 1 invalid stream unconstrained, masking
 actually ran, > 1.0 accepted tokens/step in the composed arm, and
 throughput within a noise pin of the unconstrained arm (masks are
 operand data, never a retrace).
+
+`--fused-ab` adds the decode-megakernel A/B (schema v18): the
+STANDARD Poisson trace replayed once with the megakernel off and once
+on (PADDLE_TPU_MEGAKERNEL — each layer's KV quantize-then-scatter,
+paged LoRA gather and attend walk fused into ONE dispatched op, with
+greedy argmax + spec acceptance as kernel epilogues over the logits
+tile). Fusion is bit-exact by construction, so the report's "fused"
+section records the referees that CAN move: the launch-count probe's
+registered-op dispatches per unified step and the census's modeled
+page-walk bytes/token — and the script ASSERTS the arms are
+token-identical, dispatches drop, and modeled bytes/token strictly
+drops with the megakernel on.
 
 `--chaos` replays the standard Poisson trace through a 2-replica HTTP
 front-end TWICE — once fault-free, once with the FaultInjector
@@ -269,6 +281,7 @@ _SECTION_HEADLINES = {
     "serving": lambda r: r.get("tokens_per_sec"),
     "unified": lambda r: r["unified"]["on"]["tokens_per_sec"],
     "spec": lambda r: r["spec"]["on"]["tokens_per_sec"],
+    "fused": lambda r: r["fused"]["on"]["tokens_per_sec"],
     "obs": lambda r: r["obs"]["on"]["tokens_per_sec"],
     "grouped": lambda r: r["grouped"]["on"]["tokens_per_sec"],
     "quant": lambda r: r["quant"]["int8"]["tokens_per_sec"],
@@ -416,6 +429,14 @@ def main():
                     "grammar on, >= 1 invalid stream off, bounded "
                     "tokens/s cost, and > 1.0 accepted tokens/step "
                     "in the composed arm")
+    ap.add_argument("--fused-ab", action="store_true",
+                    help="run the STANDARD Poisson trace with the "
+                    "decode megakernel off vs on (per-layer "
+                    "scatter+attend+LoRA fused into one dispatch, "
+                    "greedy/spec acceptance as kernel epilogues); "
+                    "asserts bit-token-identity across the arms, a "
+                    "strictly lower modeled bytes/token, and fewer "
+                    "registered-op dispatches per unified step")
     ap.add_argument("--quant-ab", action="store_true",
                     help="run the SAME burst trace with the paged KV "
                     "pool in fp vs int8 under the SAME HBM page-byte "
@@ -653,6 +674,32 @@ def main():
                 attempts,
                 key=lambda r: r["snap"]["tokens_per_sec"] or 0.0)
 
+    # the decode-megakernel A/B: the STANDARD Poisson trace (the same
+    # arrivals/prompts/budgets the main serving run replays) once with
+    # the fused decode megakernel off, once on. Fusion is bit-exact by
+    # construction, so the arms must emit identical tokens; the
+    # numbers that CAN move — dispatches per unified step and modeled
+    # page-walk bytes/token — come from the launch-count probe and
+    # the fused-byte census riding each run's cost-census record.
+    fused_runs = {}
+    if args.fused_ab:
+        for mode in ("off", "on"):
+            # best-of-2 per arm by tokens/s (the spec A/B's
+            # hiccup-absorbing convention); tokens are identical
+            # across attempts, asserted
+            attempts = [run_trace(
+                model, arrivals, prompts, budgets, slots=args.slots,
+                max_len=max_len, page_size=args.page_size,
+                pages=args.pages, chunk=chunk, attn_impl="kernel",
+                megakernel=(mode == "on"),
+                collect_tokens=True) for _ in range(2)]
+            for a in attempts[1:]:
+                assert a["tokens"] == attempts[0]["tokens"], \
+                    "fused arm not deterministic across repeats"
+            fused_runs[mode] = max(
+                attempts,
+                key=lambda r: r["snap"]["tokens_per_sec"] or 0.0)
+
     # the grammar-constrained-decoding A/B: the SAME Poisson arrivals
     # over a templated prompt mix, three arms — unconstrained ("off"),
     # grammar-on ("on"), and grammar COMPOSED with speculative
@@ -847,7 +894,7 @@ def main():
 
     report = {
         "bench": "serving",
-        "schema_version": 17,
+        "schema_version": 18,
         "platform": jax.devices()[0].platform,
         "attn_impl": "kernel",
         "requests": n_req,
@@ -907,6 +954,45 @@ def main():
             "tokens_per_sec_ratio": ratio,
             "token_identical": (spec_runs["on"]["tokens"]
                                 == spec_runs["off"]["tokens"]),
+        }
+    if fused_runs:
+        def _fused_summary(run):
+            s = run["snap"]
+            cen = run.get("census") or {}
+            disp = cen.get("unified_dispatch") or {}
+            walk = cen.get("page_walk") or {}
+            bpt = walk.get("modeled_bytes_per_token") or {}
+            return {
+                "wall_s": round(run["wall_s"], 4),
+                "tokens_per_sec": s["tokens_per_sec"],
+                "decode_step_ms_p50": _ms(s["decode_step_s"]["p50"]),
+                # the two referees: registered-op dispatches in the
+                # one traced step, and the arm's OWN modeled
+                # bytes/token lane (fused model under the megakernel,
+                # unfused otherwise)
+                "dispatch_ops_per_step": disp.get("total"),
+                "modeled_bytes_per_token": (
+                    bpt.get("fused") if walk.get("megakernel")
+                    else bpt.get("unfused")),
+                "completed": s["requests"]["completed"],
+            }
+
+        f_off, f_on = (_fused_summary(fused_runs["off"]),
+                       _fused_summary(fused_runs["on"]))
+        report["fused"] = {
+            "requests": n_req,
+            "trace": "standard",
+            "off": f_off,
+            "on": f_on,
+            "dispatch_ops_saved":
+                (f_off["dispatch_ops_per_step"] or 0)
+                - (f_on["dispatch_ops_per_step"] or 0),
+            "modeled_bytes_per_token_ratio": (
+                None if not f_off["modeled_bytes_per_token"]
+                else (f_on["modeled_bytes_per_token"] or 0.0)
+                / f_off["modeled_bytes_per_token"]),
+            "token_identical": (fused_runs["on"]["tokens"]
+                                == fused_runs["off"]["tokens"]),
         }
     if gram_runs:
         def _gram_summary(run):
@@ -1140,6 +1226,22 @@ def main():
             and sp["accepted_tokens_per_step"] > 1.0, sp
         assert sp["on"]["tokens_per_sec"] >= \
             sp["off"]["tokens_per_sec"], sp
+    if fused_runs:
+        fu = report["fused"]
+        # the acceptance numbers: fusion is a pure plumbing change
+        # (bit-token-identical arms, whole trace served both ways),
+        # the one program really dispatches FEWER registered ops with
+        # the megakernel on, and the modeled page-walk bytes/token
+        # strictly drops (stage traffic + per-projection adapter
+        # streams folded into the fused pass)
+        assert fu["token_identical"], "fused on/off token mismatch"
+        assert fu["on"]["completed"] == fu["off"]["completed"] \
+            == n_req, fu
+        assert fu["dispatch_ops_saved"] > 0, fu
+        assert fu["on"]["modeled_bytes_per_token"] is not None \
+            and fu["off"]["modeled_bytes_per_token"] is not None \
+            and fu["on"]["modeled_bytes_per_token"] \
+            < fu["off"]["modeled_bytes_per_token"], fu
     if gram_runs:
         gm = report["grammar"]
         # the acceptance numbers: every constrained stream (grammar on,
@@ -1380,7 +1482,7 @@ def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
               collect_tokens=False, kv_dtype=None, grouped=None,
               obs=None, mesh=None, collect_collectives=False,
               slo=None, cost_census=None, grammar=None,
-              grammar_spec=None, eos=None):
+              grammar_spec=None, eos=None, megakernel=None):
     """One Poisson-trace replay through a fresh engine pinned to
     `attn_impl` (and, for the prefix A/B, to `prefix_cache` on/off;
     for the unified-step A/B, to `unified` on/off; for the spec A/B,
@@ -1402,7 +1504,8 @@ def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
                         prefix_cache=prefix_cache, unified=unified,
                         spec=spec, kv_dtype=kv_dtype, grouped=grouped,
                         obs=obs, mesh=mesh, slo=slo,
-                        cost_census=cost_census, grammar=grammar)
+                        cost_census=cost_census, grammar=grammar,
+                        megakernel=megakernel)
     # --grammar-ab: every trace request carries the grammar (and the
     # EOS a constrained stream needs to terminate); the off arm rides
     # the same eos so the two arms replay a comparable trace
@@ -1435,6 +1538,7 @@ def run_trace(model, arrivals, prompts, budgets, *, slots, max_len,
     eng.metrics.attn_impl = eng.attn_impl
     eng.metrics.unified = eng.unified
     eng.metrics.grouped = eng.grouped
+    eng.metrics.megakernel = eng.megakernel
     eng.metrics.spec = None if eng.spec is None else eng.spec.mode
     eng.metrics.grammar = eng.grammar_on
     eng.metrics.kv_dtype = eng.kv_dtype

@@ -935,6 +935,16 @@ ELSEWHERE = {
     # (W + B·A) oracle across churn/eviction/spill, both model
     # families (tests/test_serving_adapters.py)
     "lora_delta": EW("test_serving_adapters.py", "lora|merged"),
+    # decode megakernel family (PADDLE_TPU_MEGAKERNEL): the fused
+    # scatter+attend(+LoRA prologue) op, its int8 lane, the paged
+    # LoRA delta with in-kernel page chase, and the greedy-argmax /
+    # spec-acceptance epilogue ops — fused-vs-unfused bit-identity,
+    # interpret-mode kernel vs reference, engine gate on/off token
+    # identity, launch/byte census (tests/test_megakernel.py)
+    **{n: EW("test_megakernel.py", "megakernel|Megakernel") for n in [
+        "megakernel_decode", "megakernel_decode_q8",
+        "lora_delta_paged", "decode_greedy_argmax",
+        "spec_verify_accept"]},
     # rotary embedding — tests/test_nlp_models.py (Llama family)
     "rope": EW("test_nlp_models.py", "Llama|rope"),
     "rope_dyn": EW("test_nlp_models.py", "Llama|rope"),

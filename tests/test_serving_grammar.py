@@ -20,7 +20,7 @@ The tentpole contracts:
 - session pinning holds a finished `session=` request's radix prefix
   pages above LRU until an injectable-clock TTL expires;
 - `serving_bench.py --grammar-ab` lands the structured-output A/B in
-  the schema-v17 report.
+  the schema-v18 report.
 """
 import json
 import os
@@ -359,6 +359,35 @@ class TestConstrainedDecoding:
         assert "paddle_serving_grammar_rejected_drafts_total" in text
         eng.drain()
 
+    def test_megakernel_fused_acceptance_composition(self):
+        """Grammar bias x speculation THROUGH the fused megakernel
+        epilogues (PADDLE_TPU_MEGAKERNEL): the biased verify logits
+        feed `spec_verify_accept` / `decode_greedy_argmax` instead of
+        the engine's inline blocks — streams bit-identical to the
+        unfused engine, every stream still valid under the grammar,
+        and the fused ops really dispatched (histogram referee)."""
+        rng = np.random.RandomState(3)
+        prompts = [templated_prompt(rng) for _ in range(4)]
+        gspec = GrammarSpec(kind="regex", pattern="[A-C]+")
+        sp = SamplingParams(max_new_tokens=12, eos_token_id=EOS,
+                            grammar=gspec)
+        runs = {}
+        for mk in (False, True):
+            eng = self._engine(spec="ngram", megakernel=mk)
+            outs = eng.generate(prompts, sp)
+            runs[mk] = ([list(o.token_ids) for o in outs], eng)
+        on, eng_on = runs[True]
+        off, eng_off = runs[False]
+        assert on == off
+        for seq in on:
+            assert gspec.validates(text_of(seq))
+        assert eng_on.metrics.snapshot()["grammar_masked_rows"] > 0
+        ops = eng_on.cost_census()["unified_dispatch"]["ops"]
+        assert "spec_verify_accept" in ops
+        assert "decode_greedy_argmax" in ops
+        eng_on.drain()
+        eng_off.drain()
+
 
 # -- grammar state across preemption and migration --------------------------
 class TestGrammarPreemptionMigration:
@@ -694,14 +723,14 @@ def _run_bench(tmp_path, monkeypatch, extra):
 @pytest.mark.slow
 def test_serving_bench_grammar_ab_smoke(tmp_path, monkeypatch):
     """`serving_bench.py --smoke --grammar-ab` (ISSUE acceptance):
-    the three-arm structured-output A/B lands in the schema-v17
+    the three-arm structured-output A/B lands in the schema-v18
     report — 100% valid constrained streams, at least one invalid
     unconstrained stream, masking counters moving, and the composed
     spec+grammar arm still accepting > 1 token per step."""
     report = _run_bench(tmp_path, monkeypatch,
                         ["--smoke", "--requests", "4",
                          "--grammar-ab"])
-    assert report["schema_version"] == 17
+    assert report["schema_version"] == 18
     gm = report["grammar"]
     assert set(gm) >= {"off", "on", "spec", "tokens_per_sec_ratio"}
     n = gm["requests"]

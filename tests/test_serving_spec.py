@@ -336,6 +336,39 @@ class TestSpecTokenIdentity:
         assert eng.metrics.snapshot()[
             "packed_tokens_per_step"]["max"] <= 5
 
+    def test_megakernel_fused_acceptance_is_exact(self):
+        """Speculation THROUGH the fused acceptance epilogue
+        (PADDLE_TPU_MEGAKERNEL): the burst accept/reject decision is
+        the `spec_verify_accept` op instead of the engine's inline
+        argmax/match/cumprod block — tokens stay bit-identical to the
+        oracle AND to the unfused spec engine, with the same
+        accepted-draft accounting, and the fused engine really runs
+        the fused ops (dispatch histogram referees)."""
+        model = tiny_gpt()
+        rng = np.random.RandomState(6)
+        prompts = mixed_prompts(rng, n=4) + [templated_prompt(rng)]
+        want = [oracle_greedy(model, p, 12) for p in prompts]
+        on, outs_on, eng_on = self._run(
+            prompts, 12, num_slots=3, chunk_len=16, spec="ngram",
+            megakernel=True)
+        off, _, eng_off = self._run(
+            prompts, 12, num_slots=3, chunk_len=16, spec="ngram",
+            megakernel=False)
+        assert on == want and off == want
+        s_on = eng_on.metrics.snapshot()
+        s_off = eng_off.metrics.snapshot()
+        assert s_on["spec_accepted_tokens"] > 0
+        assert s_on["spec_accepted_tokens"] \
+            == s_off["spec_accepted_tokens"]
+        assert sum(o.accepted_draft_tokens for o in outs_on) \
+            == s_on["spec_accepted_tokens"]
+        d_on = eng_on.cost_census()["unified_dispatch"]
+        d_off = eng_off.cost_census()["unified_dispatch"]
+        assert "spec_verify_accept" in d_on["ops"]
+        assert "megakernel_decode" in d_on["ops"]
+        assert "spec_verify_accept" not in d_off["ops"]
+        assert d_on["total"] < d_off["total"]
+
 
 # -- retrace probe: speculation adds NO compiled program --------------------
 class TestSpecRetraceProbe:
@@ -578,7 +611,7 @@ def test_serving_bench_spec_ab_smoke(tmp_path, monkeypatch):
     with accepted-tokens-per-step > 1.0 and no tokens/s regression."""
     report = _run_bench(tmp_path, monkeypatch,
                         ["--smoke", "--requests", "4", "--spec-ab"])
-    assert report["schema_version"] == 17
+    assert report["schema_version"] == 18
     sp = report["spec"]
     assert set(sp) >= {"on", "off", "accepted_tokens_per_step",
                        "tokens_per_sec_ratio", "token_identical"}
@@ -611,5 +644,5 @@ def test_bench_default_run_has_no_spec_section(tmp_path, monkeypatch):
     keeps the key optional), and the default path still completes."""
     report = _run_bench(tmp_path, monkeypatch,
                         ["--smoke", "--requests", "3"])
-    assert report["schema_version"] == 17
+    assert report["schema_version"] == 18
     assert "spec" not in report
