@@ -8,12 +8,14 @@ on) — decode rows next to mid-prefill rows at q_len up to chunk_len
 in the same fixed-shape invocation, prefill tokens packed into spare
 decode capacity — each holding only the KV pages its prompt + output
 budget needs. A decode row is no longer pinned to one token per step:
-with SPECULATIVE DECODING on (PADDLE_TPU_SPEC_DECODE=ngram[:k] /
-ServingEngine(spec=...), serving/spec.py, default off) a model-free
-per-request drafter proposes up to k next tokens, the row verifies
-them at q_len 1+k through the SAME step, and the whole accepted burst
-is emitted at once — still bit-token-identical to one-at-a-time
-greedy decode:
+with SPECULATIVE DECODING on (PADDLE_TPU_SPEC_DECODE=ngram[:k] or
+model[:k] / ServingEngine(spec=...), serving/spec.py + serving/
+draft.py, default off) a per-request drafter — model-free n-gram
+lookup, or a small RESIDENT DRAFT MODEL decoding through its own
+paged KV pool — proposes up to k next tokens, the row verifies them
+at q_len 1+k through the SAME step, and the whole accepted burst is
+emitted at once — still bit-token-identical to one-at-a-time greedy
+decode:
 
     from paddle_tpu.serving import ServingEngine, SamplingParams
 
@@ -168,8 +170,10 @@ from .scheduler import Scheduler  # noqa: F401
 from .slo import (SLOConfig, SLOTracker,  # noqa: F401
                   model_cost_census, resolve_cost_census,
                   resolve_slo_config)
-from .spec import (Drafter, NgramDrafter, SpecConfig,  # noqa: F401
-                   resolve_spec_config)
+from .spec import (Drafter, ModelDrafter, NgramDrafter,  # noqa: F401
+                   SpecConfig, resolve_spec_config)
+from .draft import (DraftConfig, DraftEngine,  # noqa: F401
+                    make_draft_model)
 
 __all__ = ["AdapterStore", "LoRAWeights", "make_random_lora",
            "resolve_adapters_flag", "BASE_ADAPTER",
@@ -185,7 +189,9 @@ __all__ = ["AdapterStore", "LoRAWeights", "make_random_lora",
            "QueueFull", "EngineClosed", "RateLimited",
            "PoisonedRequest", "DeadlineExceeded", "FaultInjector",
            "InjectedFault", "resolve_faults", "Drafter",
-           "NgramDrafter", "SpecConfig", "resolve_spec_config",
+           "NgramDrafter", "ModelDrafter", "SpecConfig",
+           "resolve_spec_config", "DraftConfig", "DraftEngine",
+           "make_draft_model",
            "EngineObs", "FlightRecorder", "RequestTracer",
            "resolve_obs_flag", "resolve_debug_flag",
            "resolve_flight_steps", "timeline_to_chrome",

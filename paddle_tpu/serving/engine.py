@@ -199,6 +199,7 @@ from ..ops.pallas.paged_attention import (count_page_block_reads,
                                           resolve_megakernel_flag)
 from .adapters import (AdapterStore, BASE_ADAPTER,
                        resolve_adapters_flag)
+from .draft import DraftConfig, DraftEngine, make_draft_model
 from .errors import DeadlineExceeded, EngineClosed, PoisonedRequest
 from .fabric import decode_frame, encode_frame, frame_header
 from .grammar import (NEG_BIAS, TokenGrammar, resolve_grammar_flag)
@@ -212,7 +213,7 @@ from .request import Request, RequestOutput, RequestState, SamplingParams
 from .scheduler import Scheduler
 from .slo import (SLOTracker, capture_cost_census, model_cost_census,
                   resolve_cost_census, resolve_slo_config)
-from .spec import Drafter, resolve_spec_config
+from .spec import Drafter, ModelDrafter, resolve_spec_config
 from .tp import ServingTP, collective_counts, resolve_serving_mesh
 
 __all__ = ["ServingEngine", "resolve_unified_flag",
@@ -391,7 +392,8 @@ class ServingEngine:
                  adapter_pages: Optional[int] = None,
                  adapter_ranks: Optional[Sequence[int]] = None,
                  slo=None, cost_census=None, grammar=None,
-                 megakernel=None, session_ttl_s: float = 30.0):
+                 megakernel=None, session_ttl_s: float = 30.0,
+                 draft_pages: Optional[int] = None):
         if cache_spec is None:
             if not hasattr(model, "_decode_cache_spec"):
                 raise ValueError(
@@ -487,6 +489,28 @@ class ServingEngine:
         # per-request drafters, created at admission for greedy
         # requests and dropped at retirement (request_id -> Drafter)
         self._drafters: Dict[str, Drafter] = {}
+        # the MODEL drafter tier (serving/draft.py): a small draft
+        # model resident in THIS engine with its own paged KV pool —
+        # draft micro-steps are more ragged rows through the draft
+        # model's own ONE compiled program (the engine's second and
+        # LAST program). The draft model stays replicated on a mesh
+        # (it is tiny and its program has no collectives — the
+        # collective census is the target program's, unchanged).
+        # `draft_pages` mirrors `num_pages` semantics (total
+        # including trash page 0); default = the target pool's page
+        # COUNT, which is far fewer bytes (fewer layers per page).
+        self._draft: Optional[DraftEngine] = None
+        if self.spec is not None and self.spec.mode == "model":
+            dm = self.spec.draft_model
+            if dm is None:
+                dm = make_draft_model(model)
+            self._draft = DraftEngine(dm, DraftConfig(
+                num_slots=self.num_slots, chunk_len=self.chunk_len,
+                page_size=self.page_size,
+                num_pages=(self.num_pages if draft_pages is None
+                           else int(draft_pages)),
+                max_pages=self.max_pages,
+                attn_impl=self.attn_impl))
         # grammar-constrained decoding (serving/grammar.py, default
         # off, gated ServingEngine(grammar=...) / PADDLE_TPU_GRAMMAR):
         # constrained requests carry a host-side token automaton (the
@@ -543,6 +567,12 @@ class ServingEngine:
         self.metrics.megakernel = self.megakernel
         self.metrics.spec = (None if self.spec is None
                              else self.spec.mode)
+        self.metrics.spec_draft_model = self._draft is not None
+        if self._draft is not None:
+            # seed the capacity gauge so a scrape before the first
+            # step already shows the draft tier (host-tier pattern)
+            self.metrics.draft_pool_pages_total = \
+                self._draft.num_pages - 1
         self.metrics.grammar = self.grammar_on
         self._clock = clock
         self._id_counter = itertools.count()
@@ -848,6 +878,7 @@ class ServingEngine:
         self._step_idx = 0
         self._round_stats = {"prefill_tokens": 0, "decode_tokens": 0,
                              "draft_tokens": 0, "accepted_tokens": 0,
+                             "draft_seed_tokens": 0,
                              "reads_saved": 0, "collectives": 0,
                              "constrained_rows": 0,
                              "grammar_rejected": 0, "wall_s": 0.0}
@@ -1715,6 +1746,10 @@ class ServingEngine:
             pages = self._slot_pages.pop(slot, None)
             if pages:
                 self._retire_pages(req, reason, pages)
+            if self._draft is not None:
+                # draft KV is recomputable — every slot-freeing path
+                # just drops the pages (no host tier, no cache insert)
+                self._draft.release(slot)
             req.pages = None
             req._prefix_grant = None
             self._pt_host[slot, :] = TRASH_PAGE
@@ -1965,6 +2000,11 @@ class ServingEngine:
         self._vec_dirty = True
         self._pt_host[slot, :] = TRASH_PAGE
         self._pt_dirty = True
+        if self._draft is not None:
+            # draft pages drop outright (no swap — recomputable);
+            # resume re-seeds from the banked history via the spare
+            # budget, so a preempted stream pays zero dedicated steps
+            self._draft.release(slot)
         if req._adapter_held:
             # the adapter reference drops with the slot (the pool may
             # evict/spill it while the request waits); resume
@@ -2089,8 +2129,19 @@ class ServingEngine:
             # requests speculate (sampled rows would need rejection
             # sampling to stay unbiased).
             if self.spec is not None and req.sampling.greedy:
-                self._drafters[req.request_id] = \
-                    self.spec.make_drafter()
+                drafter = self.spec.make_drafter()
+                self._drafters[req.request_id] = drafter
+                if (self._draft is not None
+                        and isinstance(drafter, ModelDrafter)):
+                    # reserve the slot's draft page budget (the same
+                    # prompt+max_new bound the target reserved, so
+                    # draft writes can never leave the slot's pages).
+                    # Refusal = draft-pool pressure: the slot simply
+                    # doesn't model-draft until pages free up —
+                    # retried each propose, never a correctness event
+                    self._draft.admit(slot,
+                                      int(req.prompt_ids.size),
+                                      self._budget_new(req.sampling))
             # grammar automaton: one per constrained request, the
             # drafter lifecycle — nothing device-side banks grammar
             # state. Re-seeding replays the committed OUTPUT history:
@@ -2330,6 +2381,7 @@ class ServingEngine:
         reserved — page pressure can never make speculation scribble
         on a neighbor. Returns {slot: proposed token ids}."""
         proposals: Dict[int, np.ndarray] = {}
+        model_rows: Dict[int, tuple] = {}
         for slot, req in sorted(running.items()):
             if (req.state is not RequestState.DECODE
                     or slot in suppress or not req.sampling.greedy):
@@ -2337,18 +2389,147 @@ class ServingEngine:
             drafter = self._drafters.get(req.request_id)
             if drafter is None:
                 continue
-            cap = min(self.spec.k, self.chunk_len - 1,
-                      req.sampling.max_new_tokens
+            budget = (req.sampling.max_new_tokens
                       - len(req.output_tokens) - 1)
+            cap = min(self.spec.k, self.chunk_len - 1, budget)
             if cap <= 0:
+                continue
+            if isinstance(drafter, ModelDrafter):
+                # the model tier drafts BATCHED: every speculating
+                # row rides one compiled draft call, not per-row
+                # Python — collected here, proposed below
+                model_rows[slot] = (req, cap)
                 continue
             hist = np.concatenate(
                 [req.prompt_ids.astype(np.int64),
                  np.asarray(req.output_tokens, np.int64)])
-            prop = np.asarray(drafter.propose(hist, cap)).reshape(-1)
+            try:
+                prop = np.asarray(drafter.propose(
+                    hist, cap, budget=budget)).reshape(-1)
+            except TypeError:
+                # legacy Drafter subclass without the optional budget
+                # arg: the engine-side cap still bounds the grant
+                prop = np.asarray(drafter.propose(hist,
+                                                  cap)).reshape(-1)
             if prop.size:
                 proposals[slot] = prop[:cap].astype(np.int64)
+        if model_rows:
+            proposals.update(self._propose_model_rows(model_rows))
         return proposals
+
+    def _propose_model_rows(self, rows) -> Dict[int, np.ndarray]:
+        """Model-tier drafting: run the k draft micro-steps for EVERY
+        speculating slot at once through the draft model's own one
+        compiled ragged program. Per slot: sync the draft position
+        with the committed stream (the clamp IS the rollback of last
+        step's rejected drafts), recompute this step's t0 host-side —
+        the [grammar-biased] argmax over the held logits, bit-exact
+        with the device greedy pick (same f32 add, same
+        first-occurrence tie-break; only greedy rows draft) — and
+        feed the catch-up `committed[dpos:] + [t0]` raggedly; the
+        harvested argmax chain `[draft_1..draft_k]` is aligned so
+        draft_i predicts committed position P+i, exactly what the
+        fused greedy acceptance verifies against. Slots lagging more
+        than a chunk defer to `_draft_seed_step` (spare-budget
+        warming); slots whose t0 is EOS finish this step and skip."""
+        d = self._draft
+        proposals: Dict[int, np.ndarray] = {}
+        if d is None or self._last_logits is None:
+            return proposals
+        ll_host = None
+        entries: Dict[int, tuple] = {}
+        caps: Dict[int, int] = {}
+        for slot, (req, cap) in sorted(rows.items()):
+            if not d.resident(slot) and not d.admit(
+                    slot, int(req.prompt_ids.size),
+                    self._budget_new(req.sampling)):
+                continue            # draft-pool pressure: retry later
+            P = int(req.prompt_ids.size) + len(req.output_tokens)
+            dpos = d.committed(slot, P)
+            if (P - dpos) + 1 > self.chunk_len:
+                continue            # too cold: seeding catches it up
+            if ll_host is None:
+                ll_host = np.asarray(self._last_logits)
+            sp = req.sampling
+            eos = sp.eos_token_id
+            g = self._grammars.get(req.request_id)
+            if g is None:
+                t0 = int(np.argmax(ll_host[slot]))
+            else:
+                left = sp.max_new_tokens - len(req.output_tokens)
+                t0 = int(np.argmax(
+                    ll_host[slot] + self._grammar_bias(
+                        g, left, eos, int(ll_host.shape[-1]))))
+            if eos is not None and t0 == eos:
+                continue            # the row finishes this step
+            hist = np.concatenate(
+                [req.prompt_ids.astype(np.int64),
+                 np.asarray(req.output_tokens, np.int64)])
+            entries[slot] = (np.concatenate([hist[dpos:],
+                                             [t0]]), cap)
+            caps[slot] = cap
+        if not entries:
+            return proposals
+        for slot, p in d.propose_batch(entries).items():
+            p = np.asarray(p, np.int64).reshape(-1)[:caps[slot]]
+            if p.size:
+                proposals[slot] = p
+        return proposals
+
+    def _draft_seed_step(self, running, suppress, decode_slots,
+                         grants, draft_grants, proposals):
+        """Warm lagging slots' draft KV from this step's SPARE token
+        budget (what decode + prefill + draft packing left over —
+        Scheduler.pack_draft_seed): chunked draft-prefill of each
+        lagging slot's committed stream, all riding ONE ragged draft
+        call next to the target step. PREFILL rows seed from
+        `prefill_ids` (predetermined — a resumed or migrated stream's
+        banked history is its tail, so survivor re-seed is this same
+        path), DECODE rows from prompt + emitted. Slots that proposed
+        this step are skipped: their draft position is legitimately
+        AHEAD of the committed stream (speculation), not lagging."""
+        d = self._draft
+        spare = (self.token_budget - len(decode_slots)
+                 - sum(grants.values()) - sum(draft_grants.values()))
+        if spare <= 0:
+            return
+        wanted: Dict[int, int] = {}
+        src: Dict[int, np.ndarray] = {}
+        for slot, req in sorted(running.items()):
+            if slot in suppress or slot in proposals \
+                    or not req.sampling.greedy:
+                continue
+            if not isinstance(self._drafters.get(req.request_id),
+                              ModelDrafter):
+                continue
+            if req.state is RequestState.PREFILL:
+                committed = np.asarray(req.prefill_ids, np.int64)
+            elif req.state is RequestState.DECODE:
+                committed = np.concatenate(
+                    [req.prompt_ids.astype(np.int64),
+                     np.asarray(req.output_tokens, np.int64)])
+            else:
+                continue
+            if not d.resident(slot) and not d.admit(
+                    slot, int(req.prompt_ids.size),
+                    self._budget_new(req.sampling)):
+                continue            # draft-pool pressure
+            dpos = d.committed(slot, int(committed.size))
+            lag = int(committed.size) - dpos
+            if lag <= 1:
+                continue    # propose's own catch-up absorbs this
+            wanted[slot] = lag
+            src[slot] = committed[dpos:]
+        if not wanted:
+            return
+        seeds = self.scheduler.pack_draft_seed(spare, self.chunk_len,
+                                               wanted)
+        entries = {slot: src[slot][:take]
+                   for slot, take in seeds.items() if take > 0}
+        if entries:
+            d.seed(entries)
+            self._round_stats["draft_seed_tokens"] += sum(
+                int(v.size) for v in entries.values())
 
     def _unified_step(self, finished: List[RequestOutput],
                       suppress=frozenset()) -> int:
@@ -2390,6 +2571,13 @@ class ServingEngine:
                             if s not in suppress}
         if not decode_slots and not grants:
             return 0
+        if self._draft is not None:
+            # draft-cache warming rides the leftover budget (runs as
+            # its own small launch BEFORE the target program — the
+            # dispatch probe below wraps only the target launch, so
+            # the launch census stays the target's)
+            self._draft_seed_step(running, suppress, decode_slots,
+                                  grants, draft_grants, proposals)
         if self.step_fault_hook is not None:
             self.step_fault_hook(
                 [running[s].request_id for s in decode_slots]
@@ -2781,6 +2969,7 @@ class ServingEngine:
         self._step_idx += 1
         self._round_stats = {"prefill_tokens": 0, "decode_tokens": 0,
                              "draft_tokens": 0, "accepted_tokens": 0,
+                             "draft_seed_tokens": 0,
                              "reads_saved": 0, "collectives": 0,
                              "constrained_rows": 0,
                              "grammar_rejected": 0, "wall_s": 0.0}
@@ -2821,6 +3010,12 @@ class ServingEngine:
                              pages_swapped=self.pool.swapped_pages,
                              host_pages_used=self.host_pool.used_pages,
                              host_pages_total=self.host_pages,
+                             draft_pages_used=(
+                                 0 if self._draft is None
+                                 else self._draft.pool.used_pages),
+                             draft_pages_total=(
+                                 0 if self._draft is None
+                                 else self._draft.num_pages - 1),
                              prefix_stats=(
                                  self.prefix_cache.stats()
                                  if self.prefix_cache is not None
@@ -2869,6 +3064,12 @@ class ServingEngine:
                 "pages_cached": self.pool.cached_pages,
                 "pages_swapped": self.pool.swapped_pages,
                 "host_pages_used": self.host_pool.used_pages,
+                **({} if self._draft is None else {
+                    # draft-pool occupancy + spare-budget warming
+                    # tokens this step (flight_dump's "dpool" column)
+                    "draft_pages_used": self._draft.pool.used_pages,
+                    "draft_pages_total": self._draft.num_pages - 1,
+                    "draft_seed_tokens": rs["draft_seed_tokens"]}),
                 "collectives": rs["collectives"],
                 "step_wall_ms": round(rs["wall_s"] * 1e3, 4),
                 **({} if self.adapters is None else {
@@ -2911,6 +3112,8 @@ class ServingEngine:
         self.pool.assert_quiesced()
         if self.adapters is not None:
             self.adapters.assert_quiesced()
+        if self._draft is not None:
+            self._draft.assert_quiesced()
         return finished
 
     def abort_all(self, reason: str = "aborted") -> List[RequestOutput]:
@@ -2939,6 +3142,8 @@ class ServingEngine:
         self.pool.assert_quiesced()
         if self.adapters is not None:
             self.adapters.assert_quiesced()
+        if self._draft is not None:
+            self._draft.assert_quiesced()
         return finished
 
     # -- debug introspection ----------------------------------------------
@@ -2975,6 +3180,8 @@ class ServingEngine:
                      "bytes_per_page": self.page_bytes},
             "host_pool": {"pages_used": self.host_pool.used_pages,
                           "pages_total": self.host_pages},
+            "draft_pool": (None if self._draft is None
+                           else self._draft.stats()),
             "prefix_cache": (None if self.prefix_cache is None
                              else self.prefix_cache.stats()),
             "adapters": (None if self.adapters is None else {
@@ -2990,6 +3197,7 @@ class ServingEngine:
                        "preempt": self.preempt,
                        "spec": (None if self.spec is None
                                 else self.spec.mode),
+                       "spec_draft_model": self._draft is not None,
                        "grammar": self.grammar_on,
                        "num_pages": self.num_pages,
                        "page_size": self.page_size,

@@ -258,6 +258,14 @@ class ServingMetrics:
         self.spec: Optional[str] = None
         self.spec_drafted_tokens = 0
         self.spec_accepted_tokens = 0
+        # the model drafter tier (serving/draft.py): whether a draft
+        # MODEL is resident (the `spec_draft_model` engine_info tag)
+        # and its paged KV pool's occupancy gauges — capacity seeded
+        # at engine construction, usage updated every step, 0/0 when
+        # the tier is off (scrapes stay schema-stable either way)
+        self.spec_draft_model = False
+        self.draft_pool_pages_used = 0
+        self.draft_pool_pages_total = 0
         # grammar-constrained decoding (serving/grammar.py): whether
         # the engine runs the gate (the `grammar` engine_info tag),
         # requests carrying a grammar, decode rows that rode a
@@ -603,6 +611,8 @@ class ServingMetrics:
                 stall_chunks: int = 0, pages_cached: int = 0,
                 pages_swapped: int = 0, host_pages_used: int = 0,
                 host_pages_total: int = 0,
+                draft_pages_used: int = 0,
+                draft_pages_total: int = 0,
                 prefix_stats: Optional[dict] = None,
                 adapter_stats: Optional[dict] = None):
         with self._lock:
@@ -620,6 +630,9 @@ class ServingMetrics:
             self.pool_pages_swapped = pages_swapped
             self.host_pages_used = host_pages_used
             self.host_pages_total = host_pages_total
+            self.draft_pool_pages_used = draft_pages_used
+            if draft_pages_total:
+                self.draft_pool_pages_total = draft_pages_total
             if prefix_stats is not None:
                 self.prefix = dict(prefix_stats)
             self.prefill_stall = stall_chunks
@@ -726,6 +739,11 @@ class ServingMetrics:
                 "bytes_total": (self.host_pages_total
                                 * self.pool_bytes_per_page),
             },
+            "spec_draft_model": self.spec_draft_model,
+            "draft_pool": (None if not self.spec_draft_model else {
+                "pages_used": self.draft_pool_pages_used,
+                "pages_total": self.draft_pool_pages_total,
+            }),
             "prefix": (None if self.prefix is None else {
                 **self.prefix,
                 "cached_tokens_per_request":
@@ -859,6 +877,8 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                        ("spec_drafted_total", "counter"),
                        ("spec_accepted_total", "counter"),
                        ("spec_tokens_per_step", "histogram"),
+                       ("draft_pool_pages_used", "gauge"),
+                       ("draft_pool_pages_total", "gauge"),
                        ("grammar_constrained_requests_total",
                         "counter"),
                        ("grammar_masked_steps_total", "counter"),
@@ -900,6 +920,9 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
                 **lab, "attn_impl": snap.get("attn_impl") or "unknown",
                 "unified": ("on" if snap.get("unified") else "off"),
                 "spec": snap.get("spec") or "off",
+                "spec_draft_model": ("on"
+                                     if snap.get("spec_draft_model")
+                                     else "off"),
                 "kv_dtype": snap.get("kv_dtype") or "fp",
                 "grouped": ("on" if snap.get("grouped") else "off"),
                 "mesh": snap.get("mesh") or "off",
@@ -960,6 +983,14 @@ def prometheus_render(snapshots: dict, namespace: str = "paddle_serving",
         if snap.get("spec_tokens_per_step") is not None:
             _hist_lines(f"{namespace}_spec_tokens_per_step",
                         snap["spec_tokens_per_step"], lab, lines)
+        dpool = snap.get("draft_pool")
+        if dpool is not None:
+            lines.append(f"{namespace}_draft_pool_pages_used"
+                         + _fmt_labels(lab)
+                         + f" {dpool.get('pages_used', 0)}")
+            lines.append(f"{namespace}_draft_pool_pages_total"
+                         + _fmt_labels(lab)
+                         + f" {dpool.get('pages_total', 0)}")
         lines.append(f"{namespace}_grammar_constrained_requests_total"
                      + _fmt_labels(lab)
                      + f" {snap.get('grammar_requests', 0)}")

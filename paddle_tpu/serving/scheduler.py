@@ -235,6 +235,31 @@ class Scheduler:
                     spare -= take
         return decode_slots, grants, draft_grants
 
+    def pack_draft_seed(self, spare: int, width: int,
+                        seed_wanted: Dict[int, int]
+                        ) -> Dict[int, int]:
+        """Draft-cache warming grants (the model-drafter tier's
+        chunked draft-prefill): split whatever budget `pack_tokens`
+        left over — after decode, prefill AND draft packing — across
+        lagging draft slots in slot order, at most `width` tokens
+        each (one ragged row of the draft program). Spare-only by
+        design: the draft cache is a pure accelerant, so warming it
+        must never displace guaranteed work, and a step with no
+        slack simply leaves the slot cold one more round —
+        draft-pool pressure degrades speculation, never service.
+        `seed_wanted` maps slots to their committed-token lag.
+        Returns {slot: seed tokens granted}."""
+        grants: Dict[int, int] = {}
+        spare = int(spare)
+        for slot in sorted(seed_wanted):
+            if spare <= 0:
+                break
+            take = min(int(seed_wanted[slot]), int(width), spare)
+            if take > 0:
+                grants[slot] = take
+                spare -= take
+        return grants
+
     def retire(self, slot: int) -> Optional[Request]:
         """Evict policy endpoint: free a slot (EOS / max-tokens /
         timeout / cancel / preemption all land here, decided by the

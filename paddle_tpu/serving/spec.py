@@ -23,8 +23,9 @@ changes shape:
 
 - `Drafter` (ABC): host-side proposal source, one instance PER
   REQUEST (created at admission, re-created from prompt + banked
-  history when a stream migrates to another replica). Drafting is
-  pure host work — enabling speculation adds NO compiled program.
+  history when a stream migrates to another replica). Proposing may
+  consult engine-resident state (the model tier below), but the
+  drafter itself holds no device memory.
 - `NgramDrafter`: the model-free default — prompt-lookup over the
   request's own prompt + output history. It finds the most recent
   previous occurrence of the history's tail n-gram and proposes the
@@ -32,16 +33,23 @@ changes shape:
   match overlaps the tail (so a repeating pattern drafts a full `k`
   tokens, not just the sliver before history ran out). Zero extra
   weights; big wins on code/templated traffic and on the repetitive
-  tails greedy decode produces.
+  tails greedy decode produces. Collapses on NATURAL text — no
+  repeated n-grams means no proposals.
+- `ModelDrafter`: the model tier ("model[:k]"). A small draft MODEL
+  resident in the SAME engine (serving/draft.py's `DraftEngine`)
+  proposes by actually decoding k tokens ahead through its own tiny
+  paged-KV pool. The engine batches every ModelDrafter row into ONE
+  compiled draft call per micro-step (`DraftEngine.propose_batch`),
+  so this class is just the per-request marker the engine routes on —
+  standalone `propose` (outside an engine) proposes nothing.
 - `SpecConfig`: the engine-facing knob bundle (`k` drafts per slot
-  per step, drafter factory). A small draft MODEL sharing the batch
-  is a future `Drafter` subclass — the ABC takes token history in,
-  returns proposed ids out, and nothing in the engine cares how.
+  per step, drafter factory, the `mode` tag, and for the model tier
+  an optional `draft_model` the engine makes resident).
 
-Gated `PADDLE_TPU_SPEC_DECODE=off|ngram[:k]` (default off until
-A/B'd) or `ServingEngine(spec=...)`; requires the unified ragged step
-(the verify pass IS a unified-step row). Only greedy rows speculate:
-a sampled row's distribution would need rejection sampling to stay
+Gated `PADDLE_TPU_SPEC_DECODE=off|ngram[:k]|model[:k]` (default off)
+or `ServingEngine(spec=...)`; requires the unified ragged step (the
+verify pass IS a unified-step row). Only greedy rows speculate: a
+sampled row's distribution would need rejection sampling to stay
 unbiased, and the serving contract here is exact greedy equivalence.
 
 COMPOSITION with grammar-constrained decoding (serving/grammar.py):
@@ -59,15 +67,21 @@ from __future__ import annotations
 import os
 from abc import ABC, abstractmethod
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from typing import Callable, Optional, Union
 
 import numpy as np
 
-__all__ = ["Drafter", "NgramDrafter", "SpecConfig",
-           "resolve_spec_config", "SPEC_DECODE_ENV"]
+__all__ = ["Drafter", "NgramDrafter", "ModelDrafter", "SpecConfig",
+           "resolve_spec_config", "SPEC_DECODE_ENV", "SPEC_MODES"]
 
 SPEC_DECODE_ENV = "PADDLE_TPU_SPEC_DECODE"
-SPEC_MODES = ("off", "ngram")
+SPEC_MODES = ("off", "ngram", "model")
+
+# the one sentence every malformed-spec ValueError ends with, so a
+# fat-fingered env var tells the operator the whole legal grammar
+# instead of a bare int() traceback
+_LEGAL_FORMS = ("legal forms: 'off', 'ngram', 'ngram:<k>', 'model', "
+                "'model:<k>' with integer k >= 1")
 
 _EMPTY = np.empty((0,), np.int64)
 
@@ -88,10 +102,17 @@ class Drafter(ABC):
     """
 
     @abstractmethod
-    def propose(self, history: np.ndarray, k: int) -> np.ndarray:
+    def propose(self, history: np.ndarray, k: int,
+                budget: Optional[int] = None) -> np.ndarray:
         """Return up to `k` proposed next token ids (int array, may be
         empty) given the committed `history` (1-D int array,
-        prompt + emitted tokens, always non-empty)."""
+        prompt + emitted tokens, always non-empty). `budget` (None =
+        unlimited) is the request's remaining emission budget beyond
+        the step's own sampled token: proposing past it wastes verify
+        FLOPs on columns that can never be emitted, so drafters should
+        cap at min(k, budget). The parameter defaults to None and the
+        engine falls back to the 2-arg form, so pre-existing Drafter
+        subclasses stay source-compatible."""
 
 
 class NgramDrafter(Drafter):
@@ -118,7 +139,13 @@ class NgramDrafter(Drafter):
         self.max_ngram = int(max_ngram)
         self.min_ngram = int(min_ngram)
 
-    def propose(self, history: np.ndarray, k: int) -> np.ndarray:
+    def propose(self, history: np.ndarray, k: int,
+                budget: Optional[int] = None) -> np.ndarray:
+        if budget is not None:
+            # never propose columns the request can't emit: with only
+            # `budget` emission slots left past the sampled token,
+            # deeper drafts are guaranteed-dead verify work
+            k = min(int(k), max(0, int(budget)))
         h = np.asarray(history).reshape(-1).astype(np.int64)
         n_h = int(h.size)
         if k <= 0 or n_h < self.min_ngram + 1:
@@ -141,8 +168,35 @@ class NgramDrafter(Drafter):
         return _EMPTY
 
 
+class ModelDrafter(Drafter):
+    """Marker drafter for the engine-resident draft-MODEL tier.
+
+    The proposing machinery lives in serving/draft.py: the engine
+    keeps ONE `DraftEngine` (small model + its own paged KV pool) and
+    routes every slot whose drafter is a ModelDrafter through a
+    single batched `propose_batch` call per step — k draft
+    micro-steps of one compiled ragged program, all speculating rows
+    together, not per-row Python. This class therefore carries no
+    state; it exists so the per-request drafter lifecycle (created at
+    admission, dropped at retirement, re-created on a migration
+    survivor) is IDENTICAL across tiers and the engine can route on
+    `isinstance`. Standalone `propose` (outside an engine) has no
+    draft KV to decode from and proposes nothing."""
+
+    def propose(self, history: np.ndarray, k: int,
+                budget: Optional[int] = None) -> np.ndarray:
+        return _EMPTY
+
+
 def _default_drafter() -> Drafter:
     return NgramDrafter()
+
+
+def _model_drafter() -> Drafter:
+    return ModelDrafter()
+
+
+_DRAFTER_FACTORIES = {"ngram": _default_drafter, "model": _model_drafter}
 
 
 @dataclass
@@ -152,16 +206,31 @@ class SpecConfig:
     `k` is the per-slot per-step draft budget (the verify row runs at
     `q_len = 1 + granted drafts`, further capped by the step width and
     the request's remaining token budget); `drafter` is a zero-arg
-    factory producing one `Drafter` PER REQUEST; `mode` is the tag
-    metrics/Prometheus report next to `attn_impl`/`unified`."""
+    factory producing one `Drafter` PER REQUEST — or one of the tier
+    names "ngram"/"model", which also sets `mode`; `mode` is the tag
+    metrics/Prometheus report next to `attn_impl`/`unified`.
+    `draft_model` (model tier only) is the resident draft model the
+    engine's DraftEngine serves — None makes the engine shrink one
+    from the target via `serving.draft.make_draft_model`."""
 
     k: int = 4
-    drafter: Callable[[], Drafter] = field(default=_default_drafter)
+    drafter: Union[str, Callable[[], Drafter]] = \
+        field(default=_default_drafter)
     mode: str = "ngram"
+    draft_model: Optional[object] = None
 
     def __post_init__(self):
         if self.k < 1:
             raise ValueError("spec k must be >= 1")
+        if isinstance(self.drafter, str):
+            # SpecConfig(drafter="model", draft_model=...) — the gate
+            # spelling the docs advertise; the tier name IS the mode
+            if self.drafter not in _DRAFTER_FACTORIES:
+                raise ValueError(
+                    f"unknown drafter tier {self.drafter!r}: expected "
+                    f"one of {tuple(_DRAFTER_FACTORIES)}")
+            self.mode = self.drafter
+            self.drafter = _DRAFTER_FACTORIES[self.mode]
 
     def make_drafter(self) -> Drafter:
         d = self.drafter()
@@ -175,12 +244,15 @@ class SpecConfig:
 def resolve_spec_config(override=None) -> Optional[SpecConfig]:
     """Resolve the speculative-decoding gate to a SpecConfig (on) or
     None (off). An explicit override wins; otherwise
-    PADDLE_TPU_SPEC_DECODE=off|ngram[:k] (read at engine
+    PADDLE_TPU_SPEC_DECODE=off|ngram[:k]|model[:k] (read at engine
     construction, default off — same env-gate pattern as
     PADDLE_TPU_PAGED_ATTN / PADDLE_TPU_PREFIX_CACHE /
     PADDLE_TPU_UNIFIED_STEP). Accepted overrides: None (use the env),
-    a SpecConfig, a mode string ("off", "ngram", "ngram:8"), or a
-    bool (True = default ngram config)."""
+    a SpecConfig, a mode string ("off", "ngram", "ngram:8", "model",
+    "model:6"), or a bool (True = default ngram config). Every
+    malformed spelling — unknown mode, 'off' with a knob, an empty or
+    non-integer or < 1 ':k' suffix — raises a ValueError naming the
+    legal forms."""
     if override is None:
         spec = os.environ.get(SPEC_DECODE_ENV, "off")
     elif isinstance(override, SpecConfig):
@@ -193,20 +265,34 @@ def resolve_spec_config(override=None) -> Optional[SpecConfig]:
         raise TypeError(
             f"spec must be None, bool, str or SpecConfig, got "
             f"{type(override).__name__}")
-    mode, _, knob = spec.partition(":")
+    mode, sep, knob = spec.partition(":")
     if mode not in SPEC_MODES:
         raise ValueError(
-            f"{SPEC_DECODE_ENV} mode must be one of {SPEC_MODES} "
-            f"(optionally 'ngram:<k>'), got {spec!r}")
+            f"invalid {SPEC_DECODE_ENV} spec {spec!r}: unknown mode "
+            f"{mode!r}; {_LEGAL_FORMS}")
     if mode == "off":
-        if knob:
-            raise ValueError(f"'off' takes no ':k' suffix: {spec!r}")
+        if sep:
+            raise ValueError(
+                f"invalid {SPEC_DECODE_ENV} spec {spec!r}: 'off' "
+                f"takes no ':k' suffix; {_LEGAL_FORMS}")
         return None
-    if not knob:
-        return SpecConfig()
-    try:
-        k = int(knob)
-    except ValueError:
+    if sep and not knob:
         raise ValueError(
-            f"{SPEC_DECODE_ENV} ':k' suffix must be an int: {spec!r}")
-    return SpecConfig(k=k)
+            f"invalid {SPEC_DECODE_ENV} spec {spec!r}: empty ':k' "
+            f"suffix; {_LEGAL_FORMS}")
+    k = None
+    if knob:
+        try:
+            k = int(knob)
+        except ValueError:
+            raise ValueError(
+                f"invalid {SPEC_DECODE_ENV} spec {spec!r}: ':k' "
+                f"suffix must be an integer; {_LEGAL_FORMS}") from None
+        if k < 1:
+            raise ValueError(
+                f"invalid {SPEC_DECODE_ENV} spec {spec!r}: k must be "
+                f">= 1; {_LEGAL_FORMS}")
+    kw = {} if k is None else {"k": k}
+    if mode == "model":
+        return SpecConfig(drafter="model", **kw)
+    return SpecConfig(**kw)

@@ -59,6 +59,9 @@ COLUMNS = [
     ("cache", "pages_cached", 5),
     ("swap", "pages_swapped", 4),
     ("host", "host_pages_used", 4),
+    # draft-model KV pool occupancy (spec "model" tier; "-" without
+    # the subsystem — seed tokens ride in "draft_seed_tokens")
+    ("dpool", "draft_pages_used", 5),
     ("wall_ms", "step_wall_ms", 8),
 ]
 

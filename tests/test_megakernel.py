@@ -523,6 +523,38 @@ class TestEngineMegakernel:
         assert (e_on.metrics.spec_accepted_tokens
                 == e_off.metrics.spec_accepted_tokens)
 
+    def test_model_spec_identity(self):
+        """The MODEL drafter tier (PR 20) through the fused acceptance
+        epilogue: drafts come from the resident draft model's own
+        compiled program, the target verifies via `spec_verify_accept`
+        — gate-on tokens bit-identical to gate-off with the same
+        accepted-draft accounting, the fused ops really dispatched,
+        and BOTH engines' draft pools quiesce. The draft program never
+        fuses (it has no epilogue to fuse — its argmax IS the
+        output), so the megakernel gate leaves it untouched."""
+        m = tiny_gpt()
+        tpl = np.array([5, 9, 13], np.int64)
+        prompts = [np.concatenate([np.array([3], np.int64),
+                                   np.tile(tpl, 4)])] * 3
+        sp = SamplingParams(max_new_tokens=10, eos_token_id=96)
+        t_off, e_off = self._run(m, prompts, sp, False,
+                                 spec="model:4")
+        t_on, e_on = self._run(m, prompts, sp, True,
+                               spec="model:4")
+        assert t_on == t_off
+        d = e_on.cost_census()["unified_dispatch"]["ops"]
+        assert "spec_verify_accept" in d
+        assert "megakernel_decode" in d
+        assert e_on.metrics.spec_accepted_tokens > 0
+        assert (e_on.metrics.spec_accepted_tokens
+                == e_off.metrics.spec_accepted_tokens)
+        # still exactly TWO compiled programs per engine
+        assert e_on._unified_fn._cache_size() == 1
+        assert e_on._draft._fn._cache_size() == 1
+        for e in (e_on, e_off):
+            e.drain()
+            e._draft.assert_quiesced()
+
     def test_fp8_fused_quantize_on_write(self):
         """fp8 pure-convert lane through the fused write: gate-on ==
         gate-off exactly, and the lane keeps the pinned drift vs fp

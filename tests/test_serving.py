@@ -657,7 +657,7 @@ def test_serving_bench_smoke_writes_stable_schema(tmp_path,
     with open(out) as f:
         report = json.load(f)
     assert report["bench"] == "serving"
-    assert report["schema_version"] == 18
+    assert report["schema_version"] == 19
     for key in ("tokens_per_sec", "ttft_p50_s", "ttft_p99_s",
                 "pool_utilization_mean", "pool_utilization_max",
                 "prefill_chunks", "page_size", "num_pages",

@@ -720,7 +720,7 @@ class TestAdapterMatrixSlow:
         mod.main()
         with open(out) as f:
             report = json.load(f)
-        assert report["schema_version"] == 18
+        assert report["schema_version"] == 19
         lr = report["lora"]
         assert lr["token_identical"] is True
         assert lr["tokens_per_sec_ratio"] > 1.0

@@ -776,7 +776,7 @@ def test_serving_bench_chaos_smoke(tmp_path, monkeypatch):
     mod.main()
     with open(out) as f:
         report = json_mod.load(f)
-    assert report["schema_version"] == 18
+    assert report["schema_version"] == 19
     chaos = report["chaos"]
     assert chaos["replicas"] == 2
     assert chaos["truncated_streams"] == 0

@@ -20,7 +20,7 @@ The tentpole contracts:
 - session pinning holds a finished `session=` request's radix prefix
   pages above LRU until an injectable-clock TTL expires;
 - `serving_bench.py --grammar-ab` lands the structured-output A/B in
-  the schema-v18 report.
+  the schema-v19 report.
 """
 import json
 import os
@@ -358,6 +358,33 @@ class TestConstrainedDecoding:
         text = prometheus_render({"0": snap})
         assert "paddle_serving_grammar_rejected_drafts_total" in text
         eng.drain()
+
+    def test_model_spec_composition_keeps_validity(self):
+        """Grammar x the MODEL drafter tier (PR 20): the engine walks
+        the automaton down each drafted path and biases every verify
+        column, so a resident-draft-model proposal that violates the
+        grammar loses the argmax match and dies in the fused
+        acceptance — streams stay 100% valid, speculation still runs,
+        and the draft pool quiesces at drain. The catch-up token fed
+        to the draft model is itself grammar-biased (the host argmax
+        must agree bit-exactly with the device's constrained pick)."""
+        eng = self._engine(spec="model:4")
+        rng = np.random.RandomState(4)
+        prompts = [templated_prompt(rng) for _ in range(4)]
+        gspec = GrammarSpec(kind="regex", pattern="[A-C]+")
+        outs = eng.generate(
+            prompts, SamplingParams(max_new_tokens=12,
+                                    eos_token_id=EOS, grammar=gspec))
+        for o in outs:
+            assert gspec.validates(text_of(o.token_ids))
+        snap = eng.metrics.snapshot()
+        assert snap["grammar_masked_rows"] > 0
+        assert snap["spec_drafted_tokens"] > 0
+        assert snap["spec_accepted_tokens"] > 0
+        assert snap["grammar_rejected_drafts"] >= 0
+        assert snap["spec_draft_model"] is True
+        eng.drain()
+        eng._draft.assert_quiesced()
 
     def test_megakernel_fused_acceptance_composition(self):
         """Grammar bias x speculation THROUGH the fused megakernel
@@ -723,14 +750,14 @@ def _run_bench(tmp_path, monkeypatch, extra):
 @pytest.mark.slow
 def test_serving_bench_grammar_ab_smoke(tmp_path, monkeypatch):
     """`serving_bench.py --smoke --grammar-ab` (ISSUE acceptance):
-    the three-arm structured-output A/B lands in the schema-v18
+    the three-arm structured-output A/B lands in the schema-v19
     report — 100% valid constrained streams, at least one invalid
     unconstrained stream, masking counters moving, and the composed
     spec+grammar arm still accepting > 1 token per step."""
     report = _run_bench(tmp_path, monkeypatch,
                         ["--smoke", "--requests", "4",
                          "--grammar-ab"])
-    assert report["schema_version"] == 18
+    assert report["schema_version"] == 19
     gm = report["grammar"]
     assert set(gm) >= {"off", "on", "spec", "tokens_per_sec_ratio"}
     n = gm["requests"]

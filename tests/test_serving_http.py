@@ -639,7 +639,7 @@ def test_serving_bench_http_smoke_appends_http_section(tmp_path,
     mod.main()
     with open(out) as f:
         report = json.load(f)
-    assert report["schema_version"] == 18        # + chaos schema
+    assert report["schema_version"] == 19        # + chaos schema
     assert report["completed"] == 4              # in-process section
     assert report["attn_impl"] == "kernel"
     assert set(report["ab"]) == {"kernel", "gather"}

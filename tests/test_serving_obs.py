@@ -646,7 +646,7 @@ def test_serving_bench_obs_ab_smoke(tmp_path, monkeypatch):
     mod.main()
     with open(out) as f:
         report = json.load(f)
-    assert report["schema_version"] == 18
+    assert report["schema_version"] == 19
     ob = report["obs"]
     assert ob["token_identical"]
     assert ob["on"]["decode_steps"] == ob["off"]["decode_steps"]

@@ -819,7 +819,7 @@ def test_overload_soak(tmp_path, monkeypatch):
     report = _run_bench(tmp_path, monkeypatch,
                         ["--smoke", "--requests", "3", "--slots", "4",
                          "--overload", "--overload-scale", "3"])
-    assert report["schema_version"] == 18
+    assert report["schema_version"] == 19
     ov = report["overload"]
     assert ov["on"]["high_priority"]["deadline_misses"] == 0
     assert ov["on"]["high_priority"]["completed"] == \

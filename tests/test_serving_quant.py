@@ -664,7 +664,7 @@ def test_serving_bench_quant_ab_smoke(tmp_path, monkeypatch):
     mod.main()
     with open(out) as f:
         report = json.load(f)
-    assert report["schema_version"] == 18
+    assert report["schema_version"] == 19
     qt = report["quant"]
     assert set(qt) >= {"fp", "int8", "residents_ratio",
                        "tokens_per_sec_ratio", "max_logit_drift",

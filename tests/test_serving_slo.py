@@ -560,7 +560,7 @@ class TestBenchHistory:
         return mod
 
     def _report(self, tps, obs_tps=None):
-        r = {"schema_version": 18, "platform": "cpu", "requests": 4,
+        r = {"schema_version": 19, "platform": "cpu", "requests": 4,
              "tokens_per_sec": tps}
         if obs_tps is not None:
             r["obs"] = {"on": {"tokens_per_sec": obs_tps}}
@@ -572,7 +572,7 @@ class TestBenchHistory:
         e1 = mod.bench_history_entry(self._report(100.0, 200.0),
                                      t=1000.0)
         assert e1["sections"] == {"serving": 100.0, "obs": 200.0}
-        assert e1["schema_version"] == 18 and e1["git_rev"]
+        assert e1["schema_version"] == 19 and e1["git_rev"]
         assert mod.append_bench_history(path, e1) == []
         # a small dip stays quiet...
         e2 = mod.bench_history_entry(self._report(95.0, 195.0),

@@ -483,7 +483,7 @@ def test_serving_bench_tp_ab_smoke(tmp_path, monkeypatch):
     mod.main()
     with open(out) as f:
         report = json.load(f)
-    assert report["schema_version"] == 18
+    assert report["schema_version"] == 19
     tp = report["tp"]
     assert tp["token_identical"] is True
     assert tp["residents_ratio"] >= 1.5

@@ -320,7 +320,7 @@ def test_serving_bench_unified_ab_smoke(tmp_path, monkeypatch):
     mod.main()
     with open(out) as f:
         report = json.load(f)
-    assert report["schema_version"] == 18
+    assert report["schema_version"] == 19
     uni = report["unified"]
     assert set(uni) >= {"on", "off", "long_prompt_lens", "requests"}
     on, off = uni["on"], uni["off"]
